@@ -1,0 +1,27 @@
+"""operator-forge: a from-scratch, capability-equivalent rebuild of
+vmware-tanzu-labs/operator-builder.
+
+operator-forge generates complete Kubernetes operator projects (CRD API types,
+phase-driven controllers, RBAC, kustomize config, e2e tests, and a companion
+CLI) from declarative workload-config YAML plus ``+operator-builder:*`` markers
+embedded in ordinary Kubernetes manifests.
+
+Capability contract mirrors the reference (see SURVEY.md for the full layer
+map; reference layers cited per-module):
+
+- ``operator_forge.utils``     <-> reference ``internal/utils``
+- ``operator_forge.yamldoc``   <-> reference's use of gopkg.in/yaml.v3 node
+  trees (comment-preserving YAML round-trip)
+- ``operator_forge.markers``   <-> reference ``internal/markers`` (lexer,
+  parser, marker registry, inspector)
+- ``operator_forge.workload``  <-> reference ``internal/workload/v1``
+- ``operator_forge.gocodegen`` <-> the external module
+  vmware-tanzu-labs/object-code-generator-for-k8s used at
+  ``internal/workload/v1/kinds/workload.go:266``
+- ``operator_forge.scaffold``  <-> reference
+  ``internal/plugins/workload/v1/scaffolds`` + kubebuilder machinery
+- ``operator_forge.cli``       <-> reference ``pkg/cli`` + ``cmd``
+- ``operator_forge.licensing`` <-> reference ``internal/license``
+"""
+
+__version__ = "0.1.0"

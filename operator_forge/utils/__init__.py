@@ -1,0 +1,10 @@
+"""Shared utilities (reference: internal/utils)."""
+
+from .names import (  # noqa: F401
+    to_title,
+    title_words,
+    to_pascal_case,
+    to_file_name,
+    to_package_name,
+)
+from .globber import glob_files  # noqa: F401

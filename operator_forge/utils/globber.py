@@ -1,0 +1,70 @@
+"""File-glob expansion with double-star support.
+
+Reference: internal/utils/files.go:32-104.  Behavioral contract:
+- a plain path (no glob chars) must exist, otherwise it is an error
+  ("file ... defined in spec.resources cannot be found");
+- a single-star glob with zero matches is an error;
+- ``**`` recurses through directories (matches files at any depth);
+- results are deduplicated, directories matched by a pattern are walked so
+  their files are included.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+
+class GlobError(Exception):
+    """Raised when a resource path or glob cannot be resolved."""
+
+
+def _walk_all(path: str) -> list[str]:
+    """Return path plus, when it is a directory, everything beneath it."""
+    if not os.path.isdir(path):
+        return [path]
+    hits = [path]
+    for root, dirs, files in os.walk(path):
+        for name in sorted(dirs) + sorted(files):
+            hits.append(os.path.join(root, name))
+    return hits
+
+
+def glob_files(pattern: str) -> list[str]:
+    """Expand ``pattern`` into matching paths (files and directories)."""
+    if "**" not in pattern:
+        if "*" not in pattern and not os.path.exists(pattern):
+            raise GlobError(
+                f"file {pattern} defined in spec.resources cannot be found"
+            )
+        matches = sorted(_glob.glob(pattern))
+        if not matches:
+            raise GlobError(
+                f"unable to find any files from glob pattern {pattern}"
+            )
+        return matches
+
+    # double-star: expand segment by segment, walking matched directories
+    segments = pattern.split("**")
+    matches = [""]
+    for segment in segments:
+        hits: list[str] = []
+        seen: set[str] = set()
+        for match in matches:
+            for path in sorted(_glob.glob(match + segment)):
+                for hit in _walk_all(path):
+                    if hit not in seen:
+                        seen.add(hit)
+                        hits.append(hit)
+        matches = hits
+    return matches
+
+
+def glob_manifest_files(pattern: str) -> list[str]:
+    """Like :func:`glob_files` but keeps only regular files.
+
+    Manifest expansion (reference internal/workload/v1/manifests/manifest.go:
+    32-53) only loads file content, so directories picked up by a double-star
+    walk are filtered here.
+    """
+    return [p for p in glob_files(pattern) if os.path.isfile(p)]

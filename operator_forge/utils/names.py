@@ -1,0 +1,72 @@
+"""Name-casing helpers.
+
+Reference: internal/utils/names.go:12-43.  Behavioral contract:
+- ``to_pascal_case("my-app") == "MyApp"`` (kebab-case -> Go identifier)
+- ``to_file_name("my-app") == "my_app"`` (kebab-case -> snake_case filename)
+- ``to_package_name("my-app") == "myapp"`` (kebab-case -> go package name)
+- ``to_title``/``title_words`` mirror Go's deprecated ``strings.Title``:
+  uppercase the first letter of every word, leaving the rest of each word
+  untouched (NOT Python's ``str.title()``, which lowercases the tail).
+"""
+
+from __future__ import annotations
+
+
+def to_title(s: str) -> str:
+    """Uppercase the first letter of each space/punctuation-separated word.
+
+    Mirrors Go ``strings.Title`` semantics used throughout the reference for
+    identifier derivation (e.g. internal/workload/v1/markers/markers.go:185).
+    Word boundaries are any non-letter, non-digit characters; the remainder of
+    each word is preserved as-is.
+    """
+    out = []
+    prev_is_word = False
+    for ch in s:
+        if ch.isalpha():
+            out.append(ch.upper() if not prev_is_word else ch)
+            prev_is_word = True
+        elif ch.isdigit():
+            out.append(ch)
+            prev_is_word = True
+        else:
+            out.append(ch)
+            prev_is_word = False
+    return "".join(out)
+
+
+def title_words(s: str, seps: str = ".-_ :") -> str:
+    """Title-case ``s`` and drop the separator characters.
+
+    Used to build Go identifiers out of dotted marker paths, e.g.
+    ``"webstore.really.long.path" -> "WebstoreReallyLongPath"``.
+    """
+    result = to_title(s)
+    for sep in seps:
+        result = result.replace(sep, "")
+    return result
+
+
+def to_pascal_case(name: str) -> str:
+    """kebab-case -> PascalCase (reference internal/utils/names.go:12-31)."""
+    out = []
+    make_upper = True
+    for letter in name:
+        if make_upper:
+            out.append(letter.upper())
+            make_upper = False
+        elif letter == "-":
+            make_upper = True
+        else:
+            out.append(letter)
+    return "".join(out)
+
+
+def to_file_name(name: str) -> str:
+    """kebab-case -> snake_case (reference internal/utils/names.go:33-37)."""
+    return name.replace("-", "_").lower()
+
+
+def to_package_name(name: str) -> str:
+    """kebab-case -> flat lowercase (reference internal/utils/names.go:39-43)."""
+    return name.replace("-", "").lower()

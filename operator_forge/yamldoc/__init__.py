@@ -1,0 +1,29 @@
+"""Comment-preserving YAML document model.
+
+The reference leans on gopkg.in/yaml.v3's node trees, which retain
+Head/Line/Foot comments on every node (SURVEY.md L1/L2; e.g.
+internal/markers/inspect/yaml.go:22-60 walks them and
+internal/workload/v1/markers/markers.go:198-250 rewrites them).  PyYAML
+discards comments, so this package implements its own document model:
+
+- :mod:`model`: ``Document``/``Mapping``/``Sequence``/``Scalar`` wrappers with
+  comments attached to mapping *entries* and sequence *items*;
+- :mod:`load`: composes PyYAML nodes, scans raw lines for comments, and
+  associates each comment with the deepest syntactic element that owns it;
+- :mod:`emit`: re-serializes the (possibly marker-rewritten) tree back to
+  block-style YAML, preserving comments, scalar styles, and explicit tags such
+  as ``!!var`` (the variable-substitution tag used by the codegen layer).
+"""
+
+from .model import (  # noqa: F401
+    Document,
+    Mapping,
+    MapEntry,
+    Sequence,
+    SeqItem,
+    Scalar,
+    VAR_TAG,
+    STR_TAG,
+)
+from .load import load_documents, YamlDocError  # noqa: F401
+from .emit import emit_documents, emit_document  # noqa: F401

@@ -1,0 +1,263 @@
+"""Serialize the comment-preserving document model back to YAML text.
+
+Mirrors the re-marshal step of the reference's marker pipeline
+(internal/workload/v1/kinds/workload.go:299-311, which yaml.Marshal's each
+rewritten node back into the manifest buffer): block style, two-space
+indentation, comments preserved, explicit ``!!var`` tags emitted for
+substituted values.
+"""
+
+from __future__ import annotations
+
+import re
+
+import yaml as _yaml
+
+from .model import (
+    BOOL_TAG,
+    Document,
+    FLOAT_TAG,
+    INT_TAG,
+    MapEntry,
+    Mapping,
+    NULL_TAG,
+    Scalar,
+    SeqItem,
+    Sequence,
+    STR_TAG,
+    VAR_TAG,
+)
+
+_INDENT = "  "
+
+# characters which, at the start of a plain scalar, change its meaning
+_UNSAFE_START = set("!&*-?|>%@`\"'#,[]{}:= ")
+_resolver = _yaml.resolver.Resolver()
+
+
+def _needs_quote(value: str) -> bool:
+    if value == "":
+        return True
+    if value != value.strip():
+        return True
+    if "\n" in value or "\t" in value:
+        return True
+    first = value[0]
+    if first in _UNSAFE_START:
+        # "- x" / ": x" / "? x" only unsafe with following space; lone chars ok
+        if first in "-?:" and len(value) > 1 and value[1] not in " ":
+            pass
+        else:
+            return True
+    if ": " in value or value.endswith(":") or " #" in value:
+        return True
+    # would re-resolve to a non-string type (int, bool, null, ...)
+    resolved = _resolver.resolve(_yaml.ScalarNode, value, (True, False))
+    return resolved != STR_TAG
+
+
+def _quote(value: str) -> str:
+    out = ['"']
+    for ch in value:
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == '"':
+            out.append('\\"')
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ord(ch) < 0x20:
+            out.append(f"\\x{ord(ch):02x}")
+        else:
+            out.append(ch)
+    out.append('"')
+    return "".join(out)
+
+
+def _scalar_inline(scalar: Scalar) -> str:
+    """Render a scalar for inline (same-line) emission."""
+    if scalar.tag == VAR_TAG:
+        return f"!!var {scalar.value}"
+    if scalar.tag == NULL_TAG:
+        return "null" if scalar.value in ("", "~", None) else scalar.value
+    if scalar.tag in (INT_TAG, FLOAT_TAG, BOOL_TAG):
+        return scalar.value
+    if scalar.style == '"':
+        return _quote(scalar.value)
+    if scalar.style == "'" and "\n" not in scalar.value:
+        return "'" + scalar.value.replace("'", "''") + "'"
+    if _needs_quote(scalar.value):
+        return _quote(scalar.value)
+    return scalar.value
+
+
+def _is_block_scalar(scalar: Scalar) -> bool:
+    return scalar.style in ("|", ">") or (
+        scalar.style is None and "\n" in scalar.value
+    )
+
+
+_COMMENT_RE = re.compile(r"^#")
+
+
+def _comment_lines(comments: list[str], indent: int) -> list[str]:
+    out = []
+    for comment in comments:
+        for line in comment.split("\n"):
+            line = line.strip()
+            if line and not _COMMENT_RE.match(line):
+                line = "# " + line
+            out.append(_INDENT * indent + line if line else "#")
+    return out
+
+
+class _Emitter:
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+
+    def emit_document(self, doc: Document) -> None:
+        self.lines.extend(_comment_lines(doc.head_comments, 0))
+        if doc.root is None:
+            return
+        if isinstance(doc.root, Scalar):
+            self._emit_scalar_value(doc.root, prefix="", indent=0,
+                                    line_comment=None)
+        else:
+            self._emit_node_block(doc.root, indent=0)
+        self.lines.extend(_comment_lines(doc.foot_comments, 0))
+
+    # -- block emission -------------------------------------------------
+
+    def _emit_node_block(self, node, indent: int) -> None:
+        if isinstance(node, Mapping):
+            for entry in node.entries:
+                self._emit_entry(entry, indent)
+        elif isinstance(node, Sequence):
+            for item in node.items:
+                self._emit_item(item, indent)
+        else:
+            raise TypeError(f"cannot block-emit {type(node)!r}")
+
+    def _emit_entry(self, entry: MapEntry, indent: int) -> None:
+        self.lines.extend(_comment_lines(entry.head_comments, indent))
+        key_text = _scalar_inline(entry.key)
+        prefix = _INDENT * indent + key_text + ":"
+        self._emit_value(entry.value, prefix, indent, entry.line_comment)
+        self.lines.extend(_comment_lines(entry.foot_comments, indent))
+
+    def _emit_item(self, item: SeqItem, indent: int) -> None:
+        self.lines.extend(_comment_lines(item.head_comments, indent))
+        node = item.node
+        dash = _INDENT * indent + "-"
+        if isinstance(node, Mapping) and node.entries and not node.flow:
+            # first entry rides the dash line; the rest align beneath it
+            first, rest = node.entries[0], node.entries[1:]
+            self.lines.extend(_comment_lines(first.head_comments, indent + 1))
+            key_text = _scalar_inline(first.key)
+            prefix = dash + " " + key_text + ":"
+            self._emit_value(
+                first.value, prefix, indent + 1,
+                first.line_comment or item.line_comment,
+            )
+            self.lines.extend(_comment_lines(first.foot_comments, indent + 1))
+            for entry in rest:
+                self._emit_entry(entry, indent + 1)
+        elif isinstance(node, Sequence) and node.items and not node.flow:
+            self.lines.append(dash + (f"  {item.line_comment}" if item.line_comment else ""))
+            self._emit_node_block(node, indent + 1)
+        else:
+            self._emit_value(node, dash, indent, item.line_comment,
+                             is_seq_item=True)
+            self.lines.extend(_comment_lines(item.foot_comments, indent))
+
+    def _emit_value(
+        self,
+        node,
+        prefix: str,
+        indent: int,
+        line_comment,
+        is_seq_item: bool = False,
+    ) -> None:
+        suffix = f"  {line_comment}" if line_comment else ""
+        if isinstance(node, Scalar):
+            self._emit_scalar_value(node, prefix, indent, line_comment)
+        elif isinstance(node, Mapping):
+            if not node.entries:
+                self.lines.append(prefix + " {}" + suffix)
+            elif node.flow and not _has_comments(node):
+                self.lines.append(prefix + " " + _flow(node) + suffix)
+            elif is_seq_item:
+                self._emit_item(SeqItem(node=node), indent)
+            else:
+                self.lines.append(prefix + suffix)
+                self._emit_node_block(node, indent + 1)
+        elif isinstance(node, Sequence):
+            if not node.items:
+                self.lines.append(prefix + " []" + suffix)
+            elif node.flow and not _has_comments(node):
+                self.lines.append(prefix + " " + _flow(node) + suffix)
+            else:
+                self.lines.append(prefix + suffix)
+                self._emit_node_block(node, indent + 1)
+        else:
+            raise TypeError(f"cannot emit value {type(node)!r}")
+
+    def _emit_scalar_value(
+        self, scalar: Scalar, prefix: str, indent: int, line_comment
+    ) -> None:
+        suffix = f"  {line_comment}" if line_comment else ""
+        sep = " " if prefix else ""
+        if _is_block_scalar(scalar) and scalar.tag == STR_TAG:
+            chomp = "" if scalar.value.endswith("\n") else "-"
+            self.lines.append(prefix + sep + "|" + chomp + suffix)
+            content = scalar.value[:-1] if scalar.value.endswith("\n") else scalar.value
+            for line in content.split("\n"):
+                self.lines.append(_INDENT * (indent + 1) + line if line else "")
+        else:
+            self.lines.append(prefix + sep + _scalar_inline(scalar) + suffix)
+
+
+def _has_comments(node) -> bool:
+    if isinstance(node, Mapping):
+        for e in node.entries:
+            if e.head_comments or e.line_comment or e.foot_comments:
+                return True
+            if _has_comments(e.value):
+                return True
+    elif isinstance(node, Sequence):
+        for i in node.items:
+            if i.head_comments or i.line_comment or i.foot_comments:
+                return True
+            if _has_comments(i.node):
+                return True
+    return False
+
+
+def _flow(node) -> str:
+    if isinstance(node, Scalar):
+        return _scalar_inline(node)
+    if isinstance(node, Mapping):
+        inner = ", ".join(
+            f"{_scalar_inline(e.key)}: {_flow(e.value)}" for e in node.entries
+        )
+        return "{" + inner + "}"
+    inner = ", ".join(_flow(i.node) for i in node.items)
+    return "[" + inner + "]"
+
+
+def emit_document(doc: Document) -> str:
+    emitter = _Emitter()
+    emitter.emit_document(doc)
+    return "\n".join(emitter.lines) + ("\n" if emitter.lines else "")
+
+
+def emit_documents(docs: list[Document], explicit_start: bool = True) -> str:
+    parts = []
+    for doc in docs:
+        body = emit_document(doc)
+        if explicit_start:
+            parts.append("---\n" + body)
+        else:
+            parts.append(body)
+    return "".join(parts)

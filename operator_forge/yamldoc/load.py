@@ -1,0 +1,259 @@
+"""Load YAML text into the comment-preserving document model.
+
+Strategy: PyYAML's composer supplies the node structure with precise
+line/column marks but discards comments, so comments are recovered with a
+line-oriented scanner (quote-aware, with block/multiline-scalar ranges
+excluded) and then associated with the *deepest* mapping entry or sequence
+item that starts on the relevant line.  This reproduces the association
+behavior the reference gets from gopkg.in/yaml.v3 node comments
+(internal/markers/inspect/yaml.go:62-101) for the YAML shapes that occur in
+Kubernetes manifests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import yaml
+
+from .model import Document, MapEntry, Mapping, Scalar, SeqItem, Sequence
+
+
+class YamlDocError(Exception):
+    """Raised when YAML cannot be loaded into the document model."""
+
+
+# An element that can own comments: a MapEntry or SeqItem plus its position.
+@dataclass
+class _Element:
+    start_line: int
+    depth: int
+    obj: object  # MapEntry | SeqItem
+
+
+_OPENERS = {":", "-", "[", "{", ","}
+
+
+def _find_comment_start(line: str) -> Optional[int]:
+    """Return the column where a comment starts on this line, if any.
+
+    A ``#`` begins a comment when it is at the start of the line or preceded
+    by whitespace, and not inside a quoted scalar.  Quote characters only open
+    a quoted scalar when they appear at a value-start position (start of line
+    content or after ``: ``, ``- ``, ``[``, ``{`` or ``,``).
+    """
+    in_single = False
+    in_double = False
+    i = 0
+    n = len(line)
+    while i < n:
+        ch = line[i]
+        if in_double:
+            if ch == "\\":
+                i += 2
+                continue
+            if ch == '"':
+                in_double = False
+        elif in_single:
+            if ch == "'":
+                if i + 1 < n and line[i + 1] == "'":
+                    i += 2
+                    continue
+                in_single = False
+        else:
+            if ch in ('"', "'"):
+                before = line[:i].rstrip()
+                if not before or before[-1] in _OPENERS:
+                    if ch == '"':
+                        in_double = True
+                    else:
+                        in_single = True
+            elif ch == "#":
+                if i == 0 or line[i - 1] in " \t":
+                    return i
+        i += 1
+    return None
+
+
+class _TreeBuilder:
+    """Builds model trees from PyYAML nodes, recording comment-owning
+    elements and line ranges to exclude from comment scanning."""
+
+    def __init__(self) -> None:
+        self.elements: list[_Element] = []
+        self.excluded: set[int] = set()
+
+    def build(self, node: yaml.Node, depth: int = 0):
+        if isinstance(node, yaml.ScalarNode):
+            return self._scalar(node)
+        if isinstance(node, yaml.MappingNode):
+            mapping = Mapping(
+                flow=bool(node.flow_style),
+                line=node.start_mark.line,
+                col=node.start_mark.column,
+            )
+            for key_node, value_node in node.value:
+                if not isinstance(key_node, yaml.ScalarNode):
+                    raise YamlDocError(
+                        "non-scalar mapping keys are not supported "
+                        f"(line {key_node.start_mark.line + 1})"
+                    )
+                entry = MapEntry(
+                    key=self._scalar(key_node),
+                    value=self.build(value_node, depth + 1),
+                )
+                mapping.entries.append(entry)
+                self.elements.append(
+                    _Element(key_node.start_mark.line, depth + 1, entry)
+                )
+            return mapping
+        if isinstance(node, yaml.SequenceNode):
+            seq = Sequence(
+                flow=bool(node.flow_style),
+                line=node.start_mark.line,
+                col=node.start_mark.column,
+            )
+            for child in node.value:
+                item = SeqItem(node=self.build(child, depth + 1))
+                seq.items.append(item)
+                self.elements.append(
+                    _Element(child.start_mark.line, depth + 1, item)
+                )
+            return seq
+        raise YamlDocError(f"unsupported YAML node type: {type(node)!r}")
+
+    def _scalar(self, node: yaml.ScalarNode) -> Scalar:
+        start = node.start_mark
+        end = node.end_mark
+        if node.style in ("|", ">"):
+            # block scalar content lines are never comments
+            end_line = end.line - 1 if end.column == 0 else end.line
+            for ln in range(start.line + 1, end_line + 1):
+                self.excluded.add(ln)
+        elif node.style in ('"', "'") and end.line > start.line:
+            for ln in range(start.line, end.line + 1):
+                self.excluded.add(ln)
+        return Scalar(
+            value=node.value,
+            tag=node.tag,
+            style=node.style,
+            line=start.line,
+            col=start.column,
+        )
+
+
+def load_documents(text: str) -> list[Document]:
+    """Parse ``text`` (possibly multi-document) into :class:`Document` trees
+    with comments attached."""
+    text = text.replace("\r\n", "\n")
+    builder = _TreeBuilder()
+
+    try:
+        raw_nodes = list(yaml.compose_all(text, Loader=yaml.SafeLoader))
+    except yaml.YAMLError as exc:
+        raise YamlDocError(f"error parsing yaml: {exc}") from exc
+
+    documents: list[Document] = []
+    for raw in raw_nodes:
+        if raw is None:
+            documents.append(Document(root=None))
+            continue
+        documents.append(Document(root=builder.build(raw)))
+
+    _attach_comments(text, builder, documents)
+    return documents
+
+
+def _attach_comments(
+    text: str, builder: _TreeBuilder, documents: list[Document]
+) -> None:
+    lines = text.split("\n")
+
+    # classify each line: comment text (full-line or trailing) / content / blank
+    full_line: dict[int, str] = {}
+    trailing: dict[int, str] = {}
+    blank: set[int] = set()
+    for ln, line in enumerate(lines):
+        if ln in builder.excluded:
+            continue
+        stripped = line.strip()
+        if not stripped:
+            blank.add(ln)
+            continue
+        if stripped == "---" or stripped.startswith("%"):
+            continue
+        col = _find_comment_start(line)
+        if col is None:
+            continue
+        comment = line[col:].rstrip()
+        if not line[:col].strip():
+            full_line[ln] = comment
+        else:
+            trailing[ln] = comment
+
+    # deepest element per start line, plus ordered starts for head attachment
+    deepest: dict[int, _Element] = {}
+    for el in builder.elements:
+        cur = deepest.get(el.start_line)
+        if cur is None or el.depth > cur.depth:
+            deepest[el.start_line] = el
+    start_lines = sorted(deepest)
+
+    def element_after(line_no: int) -> Optional[_Element]:
+        """The element starting on the first content line after ``line_no``,
+        provided only blank lines intervene."""
+        for start in start_lines:
+            if start <= line_no:
+                continue
+            between = range(line_no + 1, start)
+            if all(ln in blank for ln in between):
+                return deepest[start]
+            return None
+        return None
+
+    def element_before(line_no: int) -> Optional[_Element]:
+        found = None
+        for start in start_lines:
+            if start < line_no:
+                found = deepest[start]
+            else:
+                break
+        return found
+
+    # group consecutive full-line comments into blocks
+    blocks: list[tuple[int, int, list[str]]] = []
+    for ln in sorted(full_line):
+        if blocks and blocks[-1][1] == ln - 1:
+            first, _, comments = blocks[-1]
+            blocks[-1] = (first, ln, comments + [full_line[ln]])
+        else:
+            blocks.append((ln, ln, [full_line[ln]]))
+
+    for first, last, comments in blocks:
+        target = element_after(last)
+        if target is not None:
+            _set_head(target.obj, comments)
+            continue
+        prev = element_before(first)
+        if prev is not None:
+            _get_foot(prev.obj).extend(comments)
+        elif documents:
+            documents[0].head_comments.extend(comments)
+
+    for ln, comment in trailing.items():
+        el = deepest.get(ln)
+        if el is not None:
+            _set_line(el.obj, comment)
+
+
+def _set_head(obj, comments: list[str]) -> None:
+    obj.head_comments.extend(comments)
+
+
+def _get_foot(obj) -> list[str]:
+    return obj.foot_comments
+
+
+def _set_line(obj, comment: str) -> None:
+    obj.line_comment = comment

@@ -1,0 +1,131 @@
+"""Node classes for the comment-preserving YAML document model."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Union
+
+STR_TAG = "tag:yaml.org,2002:str"
+INT_TAG = "tag:yaml.org,2002:int"
+FLOAT_TAG = "tag:yaml.org,2002:float"
+BOOL_TAG = "tag:yaml.org,2002:bool"
+NULL_TAG = "tag:yaml.org,2002:null"
+# Variable-substitution tag: a scalar carrying this tag holds a source-code
+# expression (e.g. ``parent.Spec.AppLabel``) rather than a literal.  Mirrors
+# the `!!var` tag contract between the reference and ocgk
+# (internal/workload/v1/markers/markers.go:227).
+VAR_TAG = "tag:yaml.org,2002:var"
+
+
+@dataclass
+class Scalar:
+    value: str
+    tag: str = STR_TAG
+    style: Optional[str] = None  # None=plain, '"', "'", '|', '>'
+    line: int = -1  # 0-based source line of the scalar's first token
+    col: int = -1
+
+    def python_value(self):
+        """Resolve the scalar to a Python value based on its tag."""
+        if self.tag == INT_TAG:
+            try:
+                return int(self.value, 0)
+            except ValueError:
+                return int(self.value)
+        if self.tag == FLOAT_TAG:
+            return float(self.value)
+        if self.tag == BOOL_TAG:
+            return self.value.lower() in ("true", "yes", "on", "y")
+        if self.tag == NULL_TAG:
+            return None
+        return self.value
+
+    def is_var(self) -> bool:
+        return self.tag == VAR_TAG
+
+
+Node = Union[Scalar, "Mapping", "Sequence"]
+
+
+@dataclass
+class MapEntry:
+    key: Scalar
+    value: Node
+    head_comments: list[str] = field(default_factory=list)
+    line_comment: Optional[str] = None
+    foot_comments: list[str] = field(default_factory=list)
+
+    def all_comment_text(self) -> str:
+        parts = list(self.head_comments)
+        if self.line_comment:
+            parts.append(self.line_comment)
+        parts.extend(self.foot_comments)
+        return "\n".join(parts)
+
+
+@dataclass
+class Mapping:
+    entries: list[MapEntry] = field(default_factory=list)
+    flow: bool = False
+    line: int = -1
+    col: int = -1
+
+    def get(self, key: str) -> Optional[Node]:
+        for entry in self.entries:
+            if entry.key.value == key:
+                return entry.value
+        return None
+
+    def get_scalar(self, key: str, default: str = "") -> str:
+        node = self.get(key)
+        if isinstance(node, Scalar):
+            return node.value
+        return default
+
+    def __iter__(self) -> Iterator[MapEntry]:
+        return iter(self.entries)
+
+
+@dataclass
+class SeqItem:
+    node: Node
+    head_comments: list[str] = field(default_factory=list)
+    line_comment: Optional[str] = None
+    foot_comments: list[str] = field(default_factory=list)
+
+    def all_comment_text(self) -> str:
+        parts = list(self.head_comments)
+        if self.line_comment:
+            parts.append(self.line_comment)
+        parts.extend(self.foot_comments)
+        return "\n".join(parts)
+
+
+@dataclass
+class Sequence:
+    items: list[SeqItem] = field(default_factory=list)
+    flow: bool = False
+    line: int = -1
+    col: int = -1
+
+    def __iter__(self) -> Iterator[SeqItem]:
+        return iter(self.items)
+
+
+@dataclass
+class Document:
+    root: Optional[Node]
+    head_comments: list[str] = field(default_factory=list)
+    foot_comments: list[str] = field(default_factory=list)
+
+
+def to_python(node: Optional[Node]):
+    """Convert a node tree to plain Python data (``!!var`` scalars stay as
+    their expression strings)."""
+    if node is None:
+        return None
+    if isinstance(node, Scalar):
+        return node.python_value()
+    if isinstance(node, Mapping):
+        return {e.key.value: to_python(e.value) for e in node.entries}
+    return [to_python(i.node) for i in node.items]

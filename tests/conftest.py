@@ -1,0 +1,15 @@
+"""Test configuration.
+
+JAX-based tests (the TPU demo payload, SURVEY.md §7.5) run on a virtual
+8-device CPU mesh so sharding logic is exercised without TPU hardware.  The
+environment must be set before jax is first imported, hence here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

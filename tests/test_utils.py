@@ -1,0 +1,70 @@
+"""Tests for operator_forge.utils (reference: internal/utils contract)."""
+
+import os
+
+import pytest
+
+from operator_forge.utils import (
+    to_file_name,
+    to_package_name,
+    to_pascal_case,
+    to_title,
+    title_words,
+)
+from operator_forge.utils.globber import GlobError, glob_files
+
+
+class TestNames:
+    def test_pascal_case(self):
+        assert to_pascal_case("my-app") == "MyApp"
+        assert to_pascal_case("webstore") == "Webstore"
+        assert to_pascal_case("a-b-c") == "ABC"
+        assert to_pascal_case("") == ""
+
+    def test_file_name(self):
+        assert to_file_name("my-app") == "my_app"
+        assert to_file_name("My-App") == "my_app"
+
+    def test_package_name(self):
+        assert to_package_name("my-app") == "myapp"
+        assert to_package_name("MyApp") == "myapp"
+
+    def test_title_preserves_tail_case(self):
+        # Go strings.Title semantics, not str.title()
+        assert to_title("webStore") == "WebStore"
+        assert to_title("hello world") == "Hello World"
+        assert to_title("a.b-c") == "A.B-C"
+
+    def test_title_words(self):
+        assert title_words("webstore.really.long.path") == "WebstoreReallyLongPath"
+        assert title_words("app.label") == "AppLabel"
+
+
+class TestGlob:
+    def test_plain_path_must_exist(self, tmp_path):
+        with pytest.raises(GlobError):
+            glob_files(str(tmp_path / "missing.yaml"))
+
+    def test_plain_path(self, tmp_path):
+        f = tmp_path / "a.yaml"
+        f.write_text("x: 1\n")
+        assert glob_files(str(f)) == [str(f)]
+
+    def test_single_star(self, tmp_path):
+        for name in ("a.yaml", "b.yaml", "c.txt"):
+            (tmp_path / name).write_text("x")
+        got = glob_files(str(tmp_path / "*.yaml"))
+        assert [os.path.basename(p) for p in got] == ["a.yaml", "b.yaml"]
+
+    def test_single_star_no_match_errors(self, tmp_path):
+        with pytest.raises(GlobError):
+            glob_files(str(tmp_path / "*.yaml"))
+
+    def test_double_star_recurses(self, tmp_path):
+        (tmp_path / "sub" / "deep").mkdir(parents=True)
+        (tmp_path / "top.yaml").write_text("x")
+        (tmp_path / "sub" / "mid.yaml").write_text("x")
+        (tmp_path / "sub" / "deep" / "leaf.yaml").write_text("x")
+        got = glob_files(str(tmp_path) + "/**")
+        names = {os.path.basename(p) for p in got}
+        assert {"top.yaml", "mid.yaml", "leaf.yaml"}.issubset(names)

@@ -1,0 +1,151 @@
+"""Tests for the comment-preserving YAML document model."""
+
+import yaml as pyyaml
+
+from operator_forge.yamldoc import (
+    Mapping,
+    Scalar,
+    Sequence,
+    VAR_TAG,
+    emit_documents,
+    load_documents,
+)
+from operator_forge.yamldoc.model import to_python
+
+MANIFEST = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: demo-deploy
+spec:
+  replicas: 2  # +operator-builder:field:name=replicas,default=2,type=int
+  selector:
+    matchLabels:
+      # +operator-builder:field:name=app.label,type=string,default="demo"
+      app: demo
+  template:
+    spec:
+      containers:
+      - name: app-container
+        #+operator-builder:field:name=image,default="nginx:1.17",type=string
+        image: nginx:1.17
+        ports:
+        - containerPort: 8080
+"""
+
+
+def _entry(mapping, key):
+    for e in mapping.entries:
+        if e.key.value == key:
+            return e
+    raise KeyError(key)
+
+
+class TestLoad:
+    def test_structure_roundtrip_to_python(self):
+        docs = load_documents(MANIFEST)
+        assert len(docs) == 1
+        data = to_python(docs[0].root)
+        assert data == pyyaml.safe_load(MANIFEST)
+
+    def test_line_comment_attaches_to_entry(self):
+        docs = load_documents(MANIFEST)
+        spec = docs[0].root.get("spec")
+        replicas = _entry(spec, "replicas")
+        assert replicas.line_comment.startswith(
+            "# +operator-builder:field:name=replicas"
+        )
+        assert replicas.value.python_value() == 2
+
+    def test_head_comment_attaches_to_entry(self):
+        docs = load_documents(MANIFEST)
+        labels = docs[0].root.get("spec").get("selector").get("matchLabels")
+        app = _entry(labels, "app")
+        assert app.head_comments == [
+            '# +operator-builder:field:name=app.label,type=string,default="demo"'
+        ]
+
+    def test_comment_inside_sequence_item(self):
+        docs = load_documents(MANIFEST)
+        containers = (
+            docs[0].root.get("spec").get("template").get("spec").get("containers")
+        )
+        container = containers.items[0].node
+        image = _entry(container, "image")
+        assert image.head_comments == [
+            '#+operator-builder:field:name=image,default="nginx:1.17",type=string'
+        ]
+
+    def test_multi_document(self):
+        docs = load_documents("a: 1\n---\nb: 2\n---\nc: 3\n")
+        assert len(docs) == 3
+        assert to_python(docs[1].root) == {"b": 2}
+
+    def test_block_scalar_hash_not_a_comment(self):
+        text = "data:\n  script: |\n    # not a comment\n    echo hi\nnext: 1\n"
+        docs = load_documents(text)
+        script = docs[0].root.get("data").get("script")
+        assert script.value == "# not a comment\necho hi\n"
+
+    def test_quoted_hash_not_a_comment(self):
+        docs = load_documents('key: "value # not comment"  # real\n')
+        entry = docs[0].root.entries[0]
+        assert entry.value.value == "value # not comment"
+        assert entry.line_comment == "# real"
+
+
+class TestEmit:
+    def test_roundtrip_preserves_structure_and_comments(self):
+        docs = load_documents(MANIFEST)
+        out = emit_documents(docs)
+        docs2 = load_documents(out)
+        assert to_python(docs2[0].root) == pyyaml.safe_load(MANIFEST)
+        spec = docs2[0].root.get("spec")
+        assert _entry(spec, "replicas").line_comment.startswith(
+            "# +operator-builder:field"
+        )
+        labels = docs2[0].root.get("spec").get("selector").get("matchLabels")
+        assert _entry(labels, "app").head_comments
+
+    def test_var_tag_emission(self):
+        docs = load_documents("spec:\n  replicas: 2\n")
+        entry = docs[0].root.get("spec").entries[0]
+        entry.value = Scalar(value="parent.Spec.Replicas", tag=VAR_TAG)
+        out = emit_documents(docs)
+        assert "replicas: !!var parent.Spec.Replicas" in out
+
+    def test_quoting_of_risky_strings(self):
+        docs = load_documents("a: 1\n")
+        root = docs[0].root
+        for i, value in enumerate(["yes", "1.5", "", "has: colon", "#lead"]):
+            root.entries.append(
+                type(root.entries[0])(
+                    key=Scalar(value=f"k{i}"), value=Scalar(value=value)
+                )
+            )
+        out = emit_documents(docs)
+        reparsed = pyyaml.safe_load(out)
+        assert reparsed["k0"] == "yes"
+        assert reparsed["k1"] == "1.5"
+        assert reparsed["k2"] == ""
+        assert reparsed["k3"] == "has: colon"
+        assert reparsed["k4"] == "#lead"
+
+    def test_multidoc_separator(self):
+        docs = load_documents("a: 1\n---\nb: 2\n")
+        out = emit_documents(docs)
+        assert out.count("---") == 2
+
+    def test_block_scalar_roundtrip(self):
+        text = "script: |\n  line one\n  line two\n"
+        out = emit_documents(load_documents(text))
+        assert pyyaml.safe_load(out)["script"] == "line one\nline two\n"
+
+    def test_flow_roundtrip(self):
+        text = 'rules:\n- apiGroups: ["apps", ""]\n  verbs: [get, list]\n'
+        out = emit_documents(load_documents(text))
+        assert pyyaml.safe_load(out) == pyyaml.safe_load(text)
+
+    def test_empty_collections(self):
+        out = emit_documents(load_documents("a: {}\nb: []\n"))
+        assert pyyaml.safe_load(out) == {"a": {}, "b": []}
