@@ -1,0 +1,36 @@
+"""Generic marker engine.
+
+Reference: internal/markers/{lexer,parser,marker,inspect} (SURVEY.md L1).
+Markers are annotations embedded in comments of YAML (or Go) sources with the
+shape::
+
+    +scope[:scope...]:arg[=value][,arg[=value]...]
+
+- scopes are colon-separated identifiers; the chain must match a registered
+  definition (e.g. ``+operator-builder:field``);
+- argument values are quoted strings (single/double/backtick, backtick
+  allowing multi-line continuation across comment lines), integers, floats,
+  booleans, or naked strings; an argument without ``=value`` is a boolean
+  flag implicitly set to ``true`` (internal/markers/lexer/state.go:96-101);
+- a space or end of line terminates the marker;
+- text in comments that does not form a well-formed marker yields warnings,
+  never errors (internal/markers/lexer/lexer.go warnings contract), while
+  malformed arguments *within* a recognized marker are errors.
+
+Modules:
+- :mod:`scanner`: hand-written scanner producing raw markers from text;
+- :mod:`registry`: dataclass-reflection marker definitions + registry
+  (reference internal/markers/marker/marker.go:28-88);
+- :mod:`inspector`: walks yamldoc trees, parsing every element's comments
+  (reference internal/markers/inspect/yaml.go:22-101).
+"""
+
+from .scanner import RawMarker, ScanError, scan_text  # noqa: F401
+from .registry import (  # noqa: F401
+    Definition,
+    MarkerError,
+    Registry,
+    define,
+    marker_arg,
+)
+from .inspector import InspectResult, inspect_documents, inspect_yaml  # noqa: F401

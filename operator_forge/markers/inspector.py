@@ -1,0 +1,97 @@
+"""YAML inspector: find registered markers attached to YAML elements.
+
+Reference: internal/markers/inspect/yaml.go:22-101.  Walks every mapping
+entry and sequence item of each document, feeds the element's comments
+(head + line + foot) to the marker parser, and pairs results with the
+element so the caller can rewrite values and comments in place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Union
+
+from ..yamldoc import Document, MapEntry, Mapping, Scalar, SeqItem, Sequence
+from ..yamldoc.load import load_documents
+from .registry import Registry
+
+Element = Union[MapEntry, SeqItem]
+
+
+@dataclass
+class InspectResult:
+    obj: Any  # the inflated marker object
+    marker_text: str  # exact marker substring (for comment rewriting)
+    element: Element  # the owning mapping entry or sequence item
+    document: Document
+
+    @property
+    def value_node(self):
+        """The YAML node the marker governs (the entry's value or the item's
+        node) — the reference's ``result.Nodes[1]``
+        (internal/workload/v1/markers/markers.go:189-195)."""
+        if isinstance(self.element, MapEntry):
+            return self.element.value
+        return self.element.node
+
+
+def _walk_elements(node) -> list[Element]:
+    out: list[Element] = []
+    if isinstance(node, Mapping):
+        for entry in node.entries:
+            out.append(entry)
+            out.extend(_walk_elements(entry.value))
+    elif isinstance(node, Sequence):
+        for item in node.items:
+            out.append(item)
+            out.extend(_walk_elements(item.node))
+    return out
+
+
+def inspect_documents(
+    documents: list[Document], registry: Registry
+) -> tuple[list[InspectResult], list[str]]:
+    """Inspect already-loaded documents.  Returns (results, warnings)."""
+    results: list[InspectResult] = []
+    warnings: list[str] = []
+    for doc in documents:
+        if doc.root is None:
+            continue
+        doc_comment_sources: list[tuple[Optional[Element], str]] = [
+            (None, "\n".join(doc.head_comments))
+        ]
+        for element in _walk_elements(doc.root):
+            doc_comment_sources.append((element, element.all_comment_text()))
+        for element, text in doc_comment_sources:
+            if not text:
+                continue
+            parsed, warns = registry.parse_text(text)
+            warnings.extend(warns)
+            if element is None:
+                # document-level comments can't govern a value; report markers
+                # found there as warnings rather than silently dropping them
+                for p in parsed:
+                    warnings.append(
+                        f"marker {p.text!r} found in document-level comment "
+                        "has no associated value"
+                    )
+                continue
+            for p in parsed:
+                results.append(
+                    InspectResult(
+                        obj=p.obj,
+                        marker_text=p.text,
+                        element=element,
+                        document=doc,
+                    )
+                )
+    return results, warnings
+
+
+def inspect_yaml(
+    text: str, registry: Registry
+) -> tuple[list[Document], list[InspectResult], list[str]]:
+    """Load ``text`` and inspect it.  Returns (documents, results, warnings)."""
+    documents = load_documents(text)
+    results, warnings = inspect_documents(documents, registry)
+    return documents, results, warnings

@@ -1,0 +1,179 @@
+"""Marker definitions and registry via dataclass reflection.
+
+Reference: internal/markers/marker/{marker,argument,registry}.go.  A marker
+definition binds a scope path (e.g. ``operator-builder:field``) to a dataclass
+whose fields describe the accepted arguments:
+
+- python field ``collection_field`` maps to marker argument
+  ``collectionField`` (override with ``marker_arg(name=...)``);
+- fields without a default are required arguments;
+- argument values are converted according to the field annotation: ``str``,
+  ``int``, ``bool``, ``float``, ``typing.Any`` (preserves the literal type),
+  ``Optional[...]`` of those, or any class providing a
+  ``from_marker_arg(value)`` classmethod (the analogue of the reference's
+  ``Unmarshaler`` interface, internal/markers/parser/unmarshal.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .scanner import Literal, RawMarker, ScanResult, scan_text
+
+
+class MarkerError(Exception):
+    """A recognized marker with invalid arguments."""
+
+
+def marker_arg(
+    *, name: Optional[str] = None, default: Any = dataclasses.MISSING
+) -> Any:
+    """Declare a dataclass field with an explicit marker-argument name."""
+    metadata = {"marker_name": name} if name else {}
+    if default is dataclasses.MISSING:
+        return dataclasses.field(metadata=metadata)
+    return dataclasses.field(default=default, metadata=metadata)
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+@dataclass
+class ArgSpec:
+    name: str
+    attr: str
+    required: bool
+    annotation: Any
+
+    def convert(self, value: Literal) -> Any:
+        ann = self.annotation
+        origin = typing.get_origin(ann)
+        if origin is typing.Union:
+            members = [a for a in typing.get_args(ann) if a is not type(None)]
+            ann = members[0] if len(members) == 1 else Any
+        if ann is Any or ann is object:
+            return value
+        if ann is str:
+            if not isinstance(value, str):
+                raise MarkerError(
+                    f"argument {self.name!r} expects a string, got {value!r}"
+                )
+            return value
+        if ann is bool:
+            if not isinstance(value, bool):
+                raise MarkerError(
+                    f"argument {self.name!r} expects a bool, got {value!r}"
+                )
+            return value
+        if ann is int:
+            if isinstance(value, bool) or not isinstance(value, int):
+                raise MarkerError(
+                    f"argument {self.name!r} expects an int, got {value!r}"
+                )
+            return value
+        if ann is float:
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise MarkerError(
+                    f"argument {self.name!r} expects a float, got {value!r}"
+                )
+            return float(value)
+        if hasattr(ann, "from_marker_arg"):
+            return ann.from_marker_arg(value)
+        raise MarkerError(
+            f"argument {self.name!r} has unsupported annotation {ann!r}"
+        )
+
+
+@dataclass
+class Definition:
+    scope_path: str  # colon-joined scopes without the leading '+'
+    cls: type
+    specs: dict[str, ArgSpec]
+
+    def inflate(self, raw: RawMarker) -> Any:
+        """Build a typed marker object from a raw scanned marker."""
+        kwargs: dict[str, Any] = {}
+        for arg_name, value in raw.args:
+            spec = self.specs.get(arg_name)
+            if spec is None:
+                raise MarkerError(
+                    f"unknown argument {arg_name!r} for marker "
+                    f"+{self.scope_path} in {raw.text!r}"
+                )
+            kwargs[spec.attr] = spec.convert(value)
+        for spec in self.specs.values():
+            if spec.required and spec.attr not in kwargs:
+                raise MarkerError(
+                    f"missing required argument {spec.name!r} for marker "
+                    f"+{self.scope_path} in {raw.text!r}"
+                )
+        return self.cls(**kwargs)
+
+
+def define(prefix: str, cls: type) -> Definition:
+    """Create a Definition for ``cls`` registered under ``prefix``.
+
+    ``prefix`` may include the leading ``+`` (as the reference constants do,
+    e.g. ``+operator-builder:field``); it is stripped for matching.
+    """
+    scope_path = prefix.lstrip("+")
+    hints = typing.get_type_hints(cls)
+    specs: dict[str, ArgSpec] = {}
+    for f in dataclasses.fields(cls):
+        if not f.init or f.metadata.get("marker_skip"):
+            continue
+        name = f.metadata.get("marker_name") or _camel(f.name)
+        required = (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        )
+        specs[name] = ArgSpec(
+            name=name,
+            attr=f.name,
+            required=required,
+            annotation=hints.get(f.name, Any),
+        )
+    return Definition(scope_path=scope_path, cls=cls, specs=specs)
+
+
+@dataclass
+class ParsedMarker:
+    obj: Any
+    text: str  # the exact marker substring from the source comment
+
+
+class Registry:
+    """Scope-path -> Definition registry (reference
+    internal/markers/marker/registry.go:8-42)."""
+
+    def __init__(self) -> None:
+        self._defs: dict[str, Definition] = {}
+
+    def add(self, definition: Definition) -> None:
+        self._defs[definition.scope_path] = definition
+
+    def lookup(self, scope_path: str) -> Optional[Definition]:
+        return self._defs.get(scope_path)
+
+    def parse_text(self, text: str) -> tuple[list[ParsedMarker], list[str]]:
+        """Scan ``text`` and inflate every registered marker found.
+
+        Returns (parsed markers, warnings).  Unregistered markers become
+        warnings; malformed arguments raise :class:`~.scanner.ScanError` or
+        :class:`MarkerError`.
+        """
+        result: ScanResult = scan_text(text)
+        parsed: list[ParsedMarker] = []
+        warnings = list(result.warnings)
+        for raw in result.markers:
+            definition = self.lookup(raw.scope_path)
+            if definition is None:
+                warnings.append(f"unknown marker +{raw.scope_path}")
+                continue
+            parsed.append(ParsedMarker(obj=definition.inflate(raw), text=raw.text))
+        return parsed, warnings

@@ -1,0 +1,251 @@
+"""Marker scanner: extracts raw markers from comment text.
+
+A faithful re-implementation of the reference's state-function lexer
+(internal/markers/lexer/state.go:15-317) as a single-pass scanner.  The
+grammar it accepts is documented in the package docstring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+# characters that terminate a scope or argument-name token
+# (internal/markers/lexer/state.go:72-76)
+_TOKEN_EXCEPTIONS = set(':= "\'`,+{}[]();\n')
+# naked string values additionally allow ';' (state.go:286-291)
+_NAKED_EXCEPTIONS = set(':= "\'`,+{}[]()\n')
+
+Literal = Union[str, int, float, bool]
+
+
+class ScanError(Exception):
+    """A malformed argument inside a recognized marker shape."""
+
+
+@dataclass
+class RawMarker:
+    scopes: list[str]
+    args: list[tuple[str, Literal]]
+    text: str  # exact marker substring, for comment rewriting
+
+    @property
+    def scope_path(self) -> str:
+        return ":".join(self.scopes)
+
+
+@dataclass
+class ScanResult:
+    markers: list[RawMarker] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+
+class _Scanner:
+    def __init__(self, text: str):
+        self.text = text
+        self.pos = 0
+        self.result = ScanResult()
+
+    # -- primitives -----------------------------------------------------
+
+    def peek(self) -> str:
+        return self.text[self.pos] if self.pos < len(self.text) else ""
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.text)
+
+    def take_until(self, exceptions: set[str]) -> str:
+        start = self.pos
+        while not self.at_end() and self.text[self.pos] not in exceptions:
+            self.pos += 1
+        return self.text[start : self.pos]
+
+    # -- top level ------------------------------------------------------
+
+    def scan(self) -> ScanResult:
+        while not self.at_end():
+            ch = self.text[self.pos]
+            if ch == "+":
+                start = self.pos
+                self.pos += 1
+                if self.peek().isalpha():
+                    self._scan_marker(start)
+                # '+' not followed by a letter: plain comment text
+            else:
+                self.pos += 1
+        return self.result
+
+    # -- marker body ----------------------------------------------------
+
+    def _scan_marker(self, start: int) -> None:
+        """Scan scopes then arguments; emits a RawMarker or a warning."""
+        scopes: list[str] = []
+        while True:
+            token = self.take_until(_TOKEN_EXCEPTIONS)
+            nxt = self.peek()
+            if token and nxt == ":":
+                scopes.append(token)
+                self.pos += 1
+                continue
+            if token and nxt in ("", " ", "\n"):
+                # e.g. "+optional" — a word, not a scoped marker
+                if not scopes:
+                    self.result.warnings.append(
+                        f"marker without scope found at position {start}"
+                    )
+                    return
+                # flag-style first argument: implicit =true
+                self._finish(start, scopes, [(token, True)])
+                return
+            if token and nxt == "=":
+                if not scopes:
+                    self.result.warnings.append(
+                        f"marker without scope found at position {start}"
+                    )
+                    return
+                self.pos += 1
+                args = [(token, self._scan_value())]
+                self._scan_more_args(start, scopes, args)
+                return
+            # anything else: not a marker shape
+            self.result.warnings.append(
+                f"invalid marker found at position {start}"
+            )
+            self._skip_to_break()
+            return
+
+    def _scan_more_args(
+        self, start: int, scopes: list[str], args: list[tuple[str, Literal]]
+    ) -> None:
+        while True:
+            nxt = self.peek()
+            if nxt == ",":
+                self.pos += 1
+                name = self.take_until(_TOKEN_EXCEPTIONS)
+                if not name:
+                    raise ScanError(
+                        f"malformed argument at position {self.pos} in marker "
+                        f"{self.text[start:self.pos]!r}"
+                    )
+                if self.peek() == "=":
+                    self.pos += 1
+                    args.append((name, self._scan_value()))
+                elif self.peek() in ("", " ", "\n", ","):
+                    args.append((name, True))
+                else:
+                    raise ScanError(
+                        f"malformed argument {name!r} at position {self.pos}"
+                    )
+            elif nxt in ("", " ", "\n"):
+                self._finish(start, scopes, args)
+                return
+            else:
+                raise ScanError(
+                    f"malformed argument at position {self.pos} in marker "
+                    f"{self.text[start:self.pos]!r}"
+                )
+
+    def _finish(
+        self, start: int, scopes: list[str], args: list[tuple[str, Literal]]
+    ) -> None:
+        self.result.markers.append(
+            RawMarker(scopes=scopes, args=args, text=self.text[start : self.pos])
+        )
+
+    def _skip_to_break(self) -> None:
+        while not self.at_end() and self.text[self.pos] not in " \n":
+            self.pos += 1
+
+    # -- literals -------------------------------------------------------
+
+    def _scan_value(self) -> Literal:
+        ch = self.peek()
+        if ch in ('"', "'", "`"):
+            return self._scan_quoted(ch)
+        if ch.isdigit() or ch in ".-":
+            return self._scan_number()
+        if self._try_consume("true"):
+            return True
+        if self._try_consume("false"):
+            return False
+        naked = self.take_until(_NAKED_EXCEPTIONS)
+        if not naked:
+            raise ScanError(f"malformed argument at position {self.pos}")
+        return naked
+
+    def _try_consume(self, word: str) -> bool:
+        end = self.pos + len(word)
+        if self.text[self.pos : end] == word:
+            follower = self.text[end : end + 1]
+            if follower == "" or follower in " \n,":
+                self.pos = end
+                return True
+        return False
+
+    def _scan_quoted(self, quote: str) -> str:
+        opened_at = self.pos
+        self.pos += 1
+        out: list[str] = []
+        while True:
+            if self.at_end():
+                raise ScanError(
+                    f"unmatched string delimiter {quote} at position {opened_at}"
+                )
+            ch = self.text[self.pos]
+            if ch == quote:
+                self.pos += 1
+                return "".join(out)
+            if ch == "\n":
+                if quote != "`":
+                    raise ScanError(
+                        f"unmatched string delimiter {quote} at position "
+                        f"{opened_at}"
+                    )
+                # backtick strings may continue across comment lines; the
+                # comment prefix of the next line is not part of the value
+                # (internal/markers/lexer/state.go:201-210)
+                out.append(ch)
+                self.pos += 1
+                self._skip_comment_prefix()
+                continue
+            out.append(ch)
+            self.pos += 1
+
+    def _skip_comment_prefix(self) -> None:
+        mark = self.pos
+        while self.peek() in " \t":
+            self.pos += 1
+        if self.peek() == "#":
+            self.pos += 1
+        elif self.text[self.pos : self.pos + 2] == "//":
+            self.pos += 2
+        else:
+            self.pos = mark
+
+    def _scan_number(self) -> Union[int, float]:
+        start = self.pos
+        is_float = self.peek() == "."
+        self.pos += 1
+        while not self.at_end():
+            ch = self.text[self.pos]
+            if ch in ".eE-":
+                is_float = True
+                self.pos += 1
+                continue
+            if ch.isdigit():
+                self.pos += 1
+                continue
+            break
+        raw = self.text[start : self.pos]
+        try:
+            return float(raw) if is_float else int(raw)
+        except ValueError as exc:
+            kind = "float" if is_float else "integer"
+            raise ScanError(
+                f"invalid {kind} literal {raw!r} before position {self.pos}"
+            ) from exc
+
+
+def scan_text(text: str) -> ScanResult:
+    """Scan arbitrary comment text for raw markers."""
+    return _Scanner(text).scan()

@@ -1,0 +1,198 @@
+"""Tests for the generic marker engine.
+
+Coverage modeled on the reference's table-driven lexer/parser tests
+(internal/markers/lexer/lexer_test.go, internal/markers/marker tests).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import pytest
+
+from operator_forge.markers import (
+    MarkerError,
+    Registry,
+    ScanError,
+    define,
+    inspect_yaml,
+    scan_text,
+)
+
+
+class TestScanner:
+    def test_basic_marker(self):
+        res = scan_text("# +operator-builder:field:name=app.label,type=string")
+        assert len(res.markers) == 1
+        m = res.markers[0]
+        assert m.scopes == ["operator-builder", "field"]
+        assert m.args == [("name", "app.label"), ("type", "string")]
+        assert m.text == "+operator-builder:field:name=app.label,type=string"
+
+    def test_three_scopes(self):
+        res = scan_text("# +operator-builder:collection:field:name=x,type=int")
+        assert res.markers[0].scopes == ["operator-builder", "collection", "field"]
+
+    def test_quoted_values(self):
+        res = scan_text(
+            "# +test:marker:a=\"double\",b='single',c=`tick`,d=\"with spaces\""
+        )
+        assert res.markers[0].args == [
+            ("a", "double"),
+            ("b", "single"),
+            ("c", "tick"),
+            ("d", "with spaces"),
+        ]
+
+    def test_typed_literals(self):
+        res = scan_text("# +test:marker:i=42,f=1.5,t=true,x=false,n=-3")
+        assert res.markers[0].args == [
+            ("i", 42),
+            ("f", 1.5),
+            ("t", True),
+            ("x", False),
+            ("n", -3),
+        ]
+
+    def test_flag_argument_is_implicit_true(self):
+        res = scan_text("# +test:marker:enabled")
+        assert res.markers[0].args == [("enabled", True)]
+
+    def test_flag_argument_between_others(self):
+        res = scan_text("# +test:marker:a=1,flag,b=2")
+        assert res.markers[0].args == [("a", 1), ("flag", True), ("b", 2)]
+
+    def test_space_terminates_marker(self):
+        res = scan_text("# +test:marker:a=1 trailing words")
+        assert res.markers[0].args == [("a", 1)]
+
+    def test_word_with_plus_is_warning_not_marker(self):
+        res = scan_text("# +optional")
+        assert res.markers == []
+        assert res.warnings
+
+    def test_plain_comment_no_markers(self):
+        res = scan_text("# just a comment about 2+2 math")
+        assert res.markers == []
+
+    def test_multiple_markers_multiline(self):
+        res = scan_text("# +a:b:x=1\n# +c:d:y=2\n")
+        assert [m.scope_path for m in res.markers] == ["a:b", "c:d"]
+
+    def test_backtick_multiline_string(self):
+        text = "# +test:marker:script=`line one\n#   line two`"
+        res = scan_text(text)
+        assert res.markers[0].args == [("script", "line one\n   line two")]
+
+    def test_unterminated_string_is_error(self):
+        with pytest.raises(ScanError):
+            scan_text('# +test:marker:a="unterminated\n')
+
+    def test_naked_value_with_dots_and_slashes(self):
+        res = scan_text("# +test:marker:path=some/path.to-thing")
+        assert res.markers[0].args == [("path", "some/path.to-thing")]
+
+    def test_quoted_number_stays_string(self):
+        res = scan_text('# +test:marker:v="2"')
+        assert res.markers[0].args == [("v", "2")]
+
+
+@dataclass
+class DemoType:
+    kind: str
+
+    @classmethod
+    def from_marker_arg(cls, value):
+        if value not in ("string", "int", "bool"):
+            raise MarkerError(f"unable to parse field {value!r}")
+        return cls(kind=value)
+
+
+@dataclass
+class DemoMarker:
+    name: str
+    type: DemoType
+    description: Optional[str] = None
+    default: Any = None
+    replace: Optional[str] = None
+    collection_field: Optional[str] = None
+
+
+def _registry():
+    reg = Registry()
+    reg.add(define("+test:demo", DemoMarker))
+    return reg
+
+
+class TestRegistry:
+    def test_inflate_with_types(self):
+        parsed, warnings = _registry().parse_text(
+            '# +test:demo:name=app.label,type=string,default="web"'
+        )
+        assert not warnings
+        obj = parsed[0].obj
+        assert obj.name == "app.label"
+        assert obj.type == DemoType("string")
+        assert obj.default == "web"
+
+    def test_default_preserves_literal_type(self):
+        parsed, _ = _registry().parse_text("# +test:demo:name=n,type=int,default=2")
+        assert parsed[0].obj.default == 2
+        parsed, _ = _registry().parse_text(
+            '# +test:demo:name=n,type=int,default="2"'
+        )
+        assert parsed[0].obj.default == "2"
+
+    def test_snake_to_camel_argument_name(self):
+        parsed, _ = _registry().parse_text(
+            "# +test:demo:name=n,type=string,collectionField=other"
+        )
+        assert parsed[0].obj.collection_field == "other"
+
+    def test_missing_required_argument(self):
+        with pytest.raises(MarkerError, match="missing required"):
+            _registry().parse_text("# +test:demo:name=onlyname")
+
+    def test_unknown_argument(self):
+        with pytest.raises(MarkerError, match="unknown argument"):
+            _registry().parse_text("# +test:demo:name=n,type=string,bogus=1")
+
+    def test_custom_type_error_propagates(self):
+        with pytest.raises(MarkerError, match="unable to parse field"):
+            _registry().parse_text("# +test:demo:name=n,type=banana")
+
+    def test_unregistered_marker_is_warning(self):
+        parsed, warnings = _registry().parse_text(
+            "# +kubebuilder:rbac:groups=apps,resources=deployments"
+        )
+        assert parsed == []
+        assert any("unknown marker" in w for w in warnings)
+
+
+MANIFEST = """\
+apiVersion: v1
+kind: Service
+metadata:
+  name: web-svc  # +test:demo:name=service.name,type=string
+spec:
+  ports:
+  - protocol: TCP
+    # +test:demo:name=service.port,type=int
+    port: 80
+"""
+
+
+class TestInspector:
+    def test_finds_markers_with_elements(self):
+        docs, results, warnings = inspect_yaml(MANIFEST, _registry())
+        assert len(results) == 2
+        by_name = {r.obj.name: r for r in results}
+        name_result = by_name["service.name"]
+        assert name_result.value_node.value == "web-svc"
+        port_result = by_name["service.port"]
+        assert port_result.value_node.python_value() == 80
+
+    def test_multi_document_inspection(self):
+        text = MANIFEST + "---\nkind: A\nmetadata:\n  # +test:demo:name=x,type=int\n  count: 1\n"
+        docs, results, _ = inspect_yaml(text, _registry())
+        assert len(docs) == 2
+        assert len(results) == 3
