@@ -1,0 +1,16 @@
+"""YAML-manifest -> Go object-construction source generator.
+
+The equivalent of the external module
+vmware-tanzu-labs/object-code-generator-for-k8s (``generate.Generate``,
+called by the reference at internal/workload/v1/kinds/workload.go:266).
+Given a (marker-rewritten) manifest document, emits Go source constructing an
+``unstructured.Unstructured`` object, honoring the marker substitution
+contract:
+
+- a ``!!var <expr>`` scalar becomes the bare Go expression ``<expr>``;
+- a string containing ``!!start <expr> !!end`` fragments becomes a
+  ``fmt.Sprintf`` interpolation of the surrounding literal text;
+- all other scalars become typed Go literals.
+"""
+
+from .generate import generate, generate_for_document  # noqa: F401

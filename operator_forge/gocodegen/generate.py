@@ -1,0 +1,153 @@
+"""Emit Go source that constructs an unstructured Kubernetes object."""
+
+from __future__ import annotations
+
+import re
+
+from ..yamldoc import Document, Mapping, Scalar, Sequence
+from ..yamldoc.load import load_documents
+from ..yamldoc.model import (
+    BOOL_TAG,
+    FLOAT_TAG,
+    INT_TAG,
+    NULL_TAG,
+    VAR_TAG,
+)
+
+_START_END_RE = re.compile(r"!!start\s+(.+?)\s+!!end")
+
+
+class GenerateError(Exception):
+    pass
+
+
+def _go_quote(value: str) -> str:
+    out = []
+    for ch in value:
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == '"':
+            out.append('\\"')
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ord(ch) < 0x20:
+            out.append(f"\\x{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return '"' + "".join(out) + '"'
+
+
+def _string_expr(value: str) -> str:
+    """Render a string that may contain ``!!start <expr> !!end`` fragments.
+
+    Plain strings render as quoted literals; mixed strings render as a
+    ``fmt.Sprintf`` call with ``%v`` verbs for each substituted expression;
+    a string that is exactly one fragment renders as the expression itself.
+    """
+    matches = list(_START_END_RE.finditer(value))
+    if not matches:
+        return _go_quote(value)
+    full = matches[0]
+    if len(matches) == 1 and full.start() == 0 and full.end() == len(value):
+        return full.group(1)
+    fmt_parts: list[str] = []
+    args: list[str] = []
+    pos = 0
+    for match in matches:
+        fmt_parts.append(value[pos : match.start()].replace("%", "%%"))
+        fmt_parts.append("%v")
+        args.append(match.group(1))
+        pos = match.end()
+    fmt_parts.append(value[pos:].replace("%", "%%"))
+    fmt_literal = _go_quote("".join(fmt_parts))
+    return f"fmt.Sprintf({fmt_literal}, {', '.join(args)})"
+
+
+def _scalar_expr(scalar: Scalar) -> str:
+    if scalar.tag == VAR_TAG:
+        return scalar.value
+    if scalar.tag == INT_TAG:
+        return str(scalar.python_value())
+    if scalar.tag == FLOAT_TAG:
+        return str(scalar.python_value())
+    if scalar.tag == BOOL_TAG:
+        return "true" if scalar.python_value() else "false"
+    if scalar.tag == NULL_TAG:
+        return "nil"
+    return _string_expr(scalar.value)
+
+
+def _node_expr(node, indent: int) -> str:
+    pad = "\t" * indent
+    child_pad = "\t" * (indent + 1)
+    if isinstance(node, Scalar):
+        return _scalar_expr(node)
+    if isinstance(node, Mapping):
+        if not node.entries:
+            return "map[string]interface{}{}"
+        lines = ["map[string]interface{}{"]
+        for entry in node.entries:
+            comments = [
+                f"{child_pad}// {c.lstrip('# ')}" for c in entry.head_comments
+                if c.strip("# ")
+            ]
+            lines.extend(comments)
+            value = _node_expr(entry.value, indent + 1)
+            suffix = (
+                f" // {entry.line_comment.lstrip('# ')}"
+                if entry.line_comment
+                else ""
+            )
+            lines.append(
+                f"{child_pad}{_go_quote(entry.key.value)}: {value},{suffix}"
+            )
+        lines.append(pad + "}")
+        return "\n".join(lines)
+    if isinstance(node, Sequence):
+        if not node.items:
+            return "[]interface{}{}"
+        lines = ["[]interface{}{"]
+        for item in node.items:
+            for c in item.head_comments:
+                if c.strip("# "):
+                    lines.append(f"{child_pad}// {c.lstrip('# ')}")
+            value = _node_expr(item.node, indent + 1)
+            suffix = (
+                f" // {item.line_comment.lstrip('# ')}"
+                if item.line_comment
+                else ""
+            )
+            lines.append(f"{child_pad}{value},{suffix}")
+        lines.append(pad + "}")
+        return "\n".join(lines)
+    raise GenerateError(f"cannot generate code for node {type(node)!r}")
+
+
+def uses_sprintf(code: str) -> bool:
+    return "fmt.Sprintf(" in code
+
+
+def generate_for_document(doc: Document, var_name: str) -> str:
+    """Generate a Go variable declaration constructing the manifest object."""
+    if not isinstance(doc.root, Mapping):
+        raise GenerateError("manifest document root must be a mapping")
+    object_expr = _node_expr(doc.root, indent=1)
+    return (
+        f"var {var_name} = &unstructured.Unstructured{{\n"
+        f"\tObject: {object_expr},\n"
+        f"}}"
+    )
+
+
+def generate(manifest_yaml: str, var_name: str) -> str:
+    """Parse one manifest document and generate its Go constructor source
+    (the ocgk ``generate.Generate`` equivalent)."""
+    docs = load_documents(manifest_yaml)
+    docs = [d for d in docs if d.root is not None]
+    if len(docs) != 1:
+        raise GenerateError(
+            f"expected exactly one manifest document, found {len(docs)}"
+        )
+    return generate_for_document(docs[0], var_name)

@@ -1,0 +1,14 @@
+"""Workload domain model (reference: internal/workload/v1).
+
+Modules:
+- :mod:`fieldmarkers`: the three concrete operator-builder markers and the
+  YAML transform that rewrites marked values into code variables;
+- :mod:`api_fields`: the CRD spec-field tree built from dotted marker paths;
+- :mod:`rbac`: RBAC rule inference (workload rules, child-resource rules,
+  Role/ClusterRole escalation);
+- :mod:`manifests`: manifest loading/expansion and the ChildResource model;
+- :mod:`companion`: companion-CLI naming metadata;
+- :mod:`kinds`: StandaloneWorkload / WorkloadCollection / ComponentWorkload;
+- :mod:`config`: workload-config parsing into a Processor tree;
+- :mod:`create_api`: the `create api` processing pipeline.
+"""

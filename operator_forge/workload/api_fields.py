@@ -1,0 +1,241 @@
+"""APIFields: the CRD spec-field tree built from dotted marker paths.
+
+Reference: internal/workload/v1/kinds/api.go.  Each field marker's dotted
+``name`` path inserts a chain of struct fields ending in a typed leaf; the
+tree then renders (a) Go type declarations for the generated API
+(``generate_api_spec``) and (b) sample CR YAML (``generate_sample_spec``),
+including kubebuilder default/optional/required markers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+from ..utils import to_title
+from .fieldmarkers import FieldType
+
+
+class FieldOverwriteError(Exception):
+    """An attempt to overwrite an existing value was made
+    (reference api.go:17 ErrOverwriteExistingValue)."""
+
+
+def _go_quote(value: str) -> str:
+    out = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return f'"{out}"'
+
+
+@dataclass
+class APIFields:
+    name: str
+    type: FieldType
+    manifest_name: str = ""
+    struct_name: str = ""
+    tags: str = ""
+    comments: list[str] = dc_field(default_factory=list)
+    markers: list[str] = dc_field(default_factory=list)
+    children: list["APIFields"] = dc_field(default_factory=list)
+    default: str = ""
+    sample: str = ""
+    last: bool = False
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def new_spec_root(cls) -> "APIFields":
+        """Reference workload.go:134-141 (WorkloadSpec.init)."""
+        return cls(
+            name="Spec",
+            type=FieldType.STRUCT,
+            tags='`json: "spec"`',
+            sample="spec:",
+        )
+
+    def add_field(
+        self,
+        path: str,
+        field_type: FieldType,
+        comments: Optional[list[str]],
+        sample: Any,
+        has_default: bool,
+    ) -> None:
+        """Insert a dotted-path field (reference api.go:33-90 AddField)."""
+        obj = self
+        parts = path.split(".")
+        last = parts[-1]
+
+        for part in parts[:-1]:
+            found = None
+            for child in obj.children:
+                if child.manifest_name == part:
+                    if child.type != FieldType.STRUCT:
+                        raise FieldOverwriteError(
+                            "an attempt to overwrite existing value was made "
+                            f"for api field {path}"
+                        )
+                    found = child
+                    break
+            if found is None:
+                found = self._new_child(part, FieldType.STRUCT, sample)
+                found.markers.append("+kubebuilder:validation:Optional")
+                found.set_struct_name(path)
+                obj.children.append(found)
+            obj = found
+
+        new_child = self._new_child(last, field_type, sample)
+        new_child.last = True
+        new_child.set_comments_and_default(comments, sample, has_default)
+
+        for child in obj.children:
+            if child.manifest_name == last:
+                if not child.is_equal(new_child):
+                    raise FieldOverwriteError(
+                        "an attempt to overwrite existing value was made "
+                        f"for api field {path}"
+                    )
+                child.set_comments_and_default(comments, sample, has_default)
+                return
+
+        obj.children.append(new_child)
+
+    @staticmethod
+    def _new_child(name: str, field_type: FieldType, sample: Any) -> "APIFields":
+        child = APIFields(
+            name=to_title(name),
+            manifest_name=name,
+            type=field_type,
+            tags=f'`json:"{name},omitempty"`',
+        )
+        child.set_sample(sample)
+        return child
+
+    def set_struct_name(self, path: str) -> None:
+        """Reference api.go:195-209 generateStructName."""
+        parts = ["Spec"]
+        for part in path.split("."):
+            parts.append(to_title(part))
+            if part == self.manifest_name:
+                break
+        self.struct_name = "".join(parts)
+
+    # -- equality / defaults --------------------------------------------
+
+    def is_equal(self, other: "APIFields") -> bool:
+        """Conflict detection for repeated paths (reference api.go:211-227)."""
+        if self.type != other.type:
+            return False
+        if self.default == "" or self.default == other.default or other.default == "":
+            if not self.comments or not other.comments:
+                return True
+            return self.comments == other.comments
+        return False
+
+    def get_sample_value(self, sample: Any) -> str:
+        """Reference api.go:232-253 getSampleValue."""
+        if isinstance(sample, bool):
+            return "true" if sample else "false"
+        if isinstance(sample, str):
+            if self.type == FieldType.STRING:
+                return _go_quote(sample)
+            return sample
+        return f"{sample}"
+
+    def set_sample(self, sample: Any) -> None:
+        if self.type == FieldType.STRUCT:
+            self.sample = f"{self.manifest_name}:"
+        else:
+            self.sample = f"{self.manifest_name}: {self.get_sample_value(sample)}"
+
+    def set_default(self, sample: Any) -> None:
+        """Reference api.go:264-277 setDefault."""
+        self.default = self.get_sample_value(sample)
+        if not self.markers:
+            self.markers.extend(
+                [
+                    f"+kubebuilder:default={self.default}",
+                    "+kubebuilder:validation:Optional",
+                    f"(Default: {self.default})",
+                ]
+            )
+        self.set_sample(sample)
+
+    def set_comments_and_default(
+        self, comments: Optional[list[str]], sample: Any, has_default: bool
+    ) -> None:
+        if has_default:
+            self.set_default(sample)
+        if comments:
+            self.comments.extend(comments)
+
+    # -- rendering ------------------------------------------------------
+
+    def generate_api_spec(self, kind: str) -> str:
+        """Render Go type declarations (reference api.go:92-116)."""
+        lines = [
+            "",
+            f"// {kind}Spec defines the desired state of {kind}.",
+            f"type {kind}Spec struct {{",
+            "\t// INSERT ADDITIONAL SPEC FIELDS - desired state of cluster",
+            '\t// Important: Run "make" to regenerate code after modifying this file',
+            "",
+        ]
+        for child in self.children:
+            lines.extend(child._spec_field_lines(kind))
+        lines.append("}")
+        lines.append("")
+        for child in self.children:
+            if child.children:
+                lines.extend(child._struct_lines(kind))
+        return "\n".join(lines) + "\n"
+
+    def _spec_field_lines(self, kind: str) -> list[str]:
+        type_name = self.type.go_type
+        if self.type == FieldType.STRUCT:
+            type_name = kind + self.struct_name
+        lines = []
+        for marker in self.markers:
+            lines.append(f"\t// {marker}")
+        for comment in self.comments:
+            lines.append(f"\t// {comment}")
+        lines.append(f"\t{self.name} {type_name} {self.tags}")
+        lines.append("")
+        return lines
+
+    def _struct_lines(self, kind: str) -> list[str]:
+        if self.type != FieldType.STRUCT:
+            return []
+        lines = [f"type {kind}{self.struct_name} struct {{"]
+        for child in self.children:
+            lines.extend(child._spec_field_lines(kind))
+        lines.append("}")
+        lines.append("")
+        for child in self.children:
+            if child.children:
+                lines.extend(child._struct_lines(kind))
+        return lines
+
+    def generate_sample_spec(self, required_only: bool) -> str:
+        """Render sample CR YAML (reference api.go:118-136)."""
+        lines: list[str] = []
+        self._sample_lines(lines, 0, required_only)
+        return "\n".join(lines) + "\n"
+
+    def _sample_lines(
+        self, lines: list[str], indent: int, required_only: bool
+    ) -> None:
+        lines.append("  " * indent + self.sample)
+        for child in self.children:
+            if child.needs_generate(required_only):
+                child._sample_lines(lines, indent + 1, required_only)
+
+    def needs_generate(self, required_only: bool) -> bool:
+        if not required_only:
+            return True
+        return self.has_required_field()
+
+    def has_required_field(self) -> bool:
+        """A leaf without a default is required (reference api.go:148-160)."""
+        if not self.children and self.default == "":
+            return True
+        return any(child.has_required_field() for child in self.children)

@@ -1,0 +1,71 @@
+"""Companion-CLI command metadata.
+
+Reference: internal/workload/v1/commands/companion/cli.go.  Captures the
+name/description of the generated CLI root command and per-workload
+subcommands, with defaulting rules per workload type.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..utils import to_file_name, to_pascal_case
+
+DEFAULT_DESCRIPTION = "Manage {kind} workload"
+DEFAULT_COLLECTION_SUBCOMMAND_NAME = "collection"
+DEFAULT_COLLECTION_ROOTCOMMAND_DESCRIPTION = (
+    "Manage {kind} collection and components"
+)
+
+
+@dataclass
+class CompanionCLI:
+    name: str = ""
+    description: str = ""
+    var_name: str = ""
+    file_name: str = ""
+    is_subcommand: bool = False
+    is_rootcommand: bool = False
+
+    def has_name(self) -> bool:
+        return self.name != ""
+
+    def has_description(self) -> bool:
+        return self.description != ""
+
+    def set_defaults(self, workload, is_subcommand: bool) -> None:
+        """Reference cli.go:39-50 SetDefaults."""
+        self.is_subcommand = is_subcommand
+        self.is_rootcommand = not is_subcommand
+        if not self.has_name():
+            self.name = self._default_name(workload)
+        if not self.has_description():
+            self.description = self._default_description(workload)
+
+    def set_common_values(self, workload, is_subcommand: bool) -> None:
+        """Reference cli.go:53-62 SetCommonValues."""
+        self.set_defaults(workload, is_subcommand)
+        self.file_name = to_file_name(self.name)
+        self.var_name = to_pascal_case(self.name)
+
+    def _default_name(self, workload) -> str:
+        if workload.is_collection() and self.is_subcommand:
+            return DEFAULT_COLLECTION_SUBCOMMAND_NAME
+        return workload.api_kind.lower()
+
+    def _default_description(self, workload) -> str:
+        kind = workload.api_kind.lower()
+        if workload.is_collection() and not self.is_subcommand:
+            return DEFAULT_COLLECTION_ROOTCOMMAND_DESCRIPTION.format(kind=kind)
+        return DEFAULT_DESCRIPTION.format(kind=kind)
+
+    @staticmethod
+    def subcommand_relative_filename(
+        root_cmd_name: str, subcommand_folder: str, group: str, file_name: str
+    ) -> str:
+        """Reference cli.go:76-83 GetSubCmdRelativeFileName."""
+        return os.path.join(
+            "cmd", root_cmd_name, "commands", subcommand_folder, group,
+            file_name + ".go",
+        )

@@ -1,0 +1,203 @@
+"""Workload-config parsing into a Processor tree.
+
+Reference: internal/workload/v1/config/{parse,processor,validate}.go.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field as dc_field
+
+import yaml as pyyaml
+
+from ..utils.globber import glob_files
+from .kinds import (
+    ComponentWorkload,
+    Workload,
+    WorkloadCollection,
+    WorkloadConfigError,
+    decode,
+)
+
+
+class ConfigParseError(Exception):
+    pass
+
+
+@dataclass
+class Processor:
+    """A parsed workload config plus its component children
+    (reference processor.go:16-24)."""
+
+    path: str
+    workload: Workload = None
+    children: list["Processor"] = dc_field(default_factory=list)
+
+    def get_workloads(self) -> list[Workload]:
+        workloads = [self.workload]
+        for child in self.children:
+            workloads.extend(child.get_workloads())
+        return workloads
+
+    def get_processors(self) -> list["Processor"]:
+        processors = [self]
+        for child in self.children:
+            processors.extend(child.get_processors())
+        return processors
+
+
+class _InlineValidator:
+    """Uniqueness validation while parsing (reference validate.go:20-77)."""
+
+    def __init__(self) -> None:
+        self.names: set[str] = set()
+        self.kinds_in_groups: dict[str, list[str]] = {}
+
+    def validate(self, workload: Workload, processor: Processor) -> None:
+        if workload.name in self.names:
+            raise ConfigParseError(
+                "each workload name must be unique; duplicate name "
+                f"{workload.name!r} at path {processor.path}"
+            )
+        try:
+            workload.validate()
+        except WorkloadConfigError as exc:
+            raise ConfigParseError(
+                f"error validating workload at path {processor.path}: {exc}"
+            ) from exc
+        existing = self.kinds_in_groups.get(workload.api_group, [])
+        if workload.api_kind in existing:
+            raise ConfigParseError(
+                "each kind within a group must be unique; duplicate kind "
+                f"{workload.api_kind!r} in group {workload.api_group!r} "
+                f"at path {processor.path}"
+            )
+        self.names.add(workload.name)
+        self.kinds_in_groups.setdefault(workload.api_group, []).append(
+            workload.api_kind
+        )
+
+
+def parse(config_path: str) -> Processor:
+    """Parse a workload config (and any component configs it references)
+    into a Processor tree (reference parse.go:32-70 Parse)."""
+    if not config_path:
+        raise ConfigParseError(
+            "no workload config provided - workload config required"
+        )
+    processor = Processor(path=config_path)
+    validator = _InlineValidator()
+    _parse_into(processor, validator)
+
+    if processor.workload is None:
+        raise ConfigParseError(
+            f"could not find a workload config at path {config_path}"
+        )
+    if processor.workload.is_component():
+        raise ConfigParseError(
+            "a WorkloadCollection is required when using WorkloadComponents; "
+            f"no WorkloadCollection found at config path {config_path}"
+        )
+
+    all_workloads = processor.get_workloads()
+    for child in processor.children:
+        _set_dependencies(child.workload, all_workloads)
+
+    return processor
+
+
+def _parse_into(processor: Processor, validator: _InlineValidator) -> None:
+    """Reference parse.go:74-134 (Processor.parse)."""
+    try:
+        with open(processor.path, "r", encoding="utf-8") as handle:
+            raw = handle.read()
+    except OSError as exc:
+        raise ConfigParseError(
+            f"{exc}; error reading file {processor.path}"
+        ) from exc
+
+    try:
+        documents = [d for d in pyyaml.safe_load_all(raw) if d is not None]
+    except pyyaml.YAMLError as exc:
+        raise ConfigParseError(
+            f"failed to read file {processor.path}: {exc}"
+        ) from exc
+
+    if not documents:
+        raise ConfigParseError(
+            f"no workload config documents found in {processor.path}"
+        )
+
+    for document in documents:
+        try:
+            workload = decode(document, processor.path)
+        except WorkloadConfigError as exc:
+            raise ConfigParseError(
+                f"failed to read file {processor.path}: {exc}"
+            ) from exc
+
+        validator.validate(workload, processor)
+        workload.set_names()
+        processor.workload = workload
+
+        if isinstance(workload, WorkloadCollection):
+            _parse_components(processor, workload, validator)
+
+
+def _parse_components(
+    processor: Processor,
+    collection: WorkloadCollection,
+    validator: _InlineValidator,
+) -> None:
+    """Reference parse.go:136-171 parseComponents."""
+    base_dir = os.path.dirname(processor.path)
+    for component_file in collection.component_files:
+        try:
+            component_paths = glob_files(os.path.join(base_dir, component_file))
+        except Exception as exc:
+            raise ConfigParseError(
+                f"{exc}; error globbing workload config at path {component_file}"
+            ) from exc
+        for component_path in component_paths:
+            if os.path.isdir(component_path):
+                continue
+            child = Processor(path=component_path)
+            processor.children.append(child)
+            try:
+                _parse_into(child, validator)
+            except ConfigParseError as exc:
+                raise ConfigParseError(
+                    f"{exc}; error parsing workload component config at path "
+                    f"{component_path}"
+                ) from exc
+            if isinstance(child.workload, ComponentWorkload):
+                child.workload.config_path = component_path
+
+
+def _set_dependencies(workload: Workload, workloads: list[Workload]) -> None:
+    """Resolve component dependency names to component objects
+    (reference parse.go:174-216)."""
+    if not isinstance(workload, ComponentWorkload):
+        raise ConfigParseError(
+            "error converting workload to component workload for workload "
+            f"[{workload.name}]"
+        )
+    workload.component_dependencies = []
+    missing = []
+    for expected in workload.dependencies:
+        dependency = None
+        for candidate in workloads:
+            if candidate.name == expected and isinstance(
+                candidate, ComponentWorkload
+            ):
+                dependency = candidate
+                break
+        if dependency is not None:
+            workload.component_dependencies.append(dependency)
+        else:
+            missing.append(expected)
+    if missing:
+        raise ConfigParseError(
+            f"missing dependencies - no workload config provided; missing "
+            f"{missing} for component: [{workload.name}]"
+        )

@@ -1,0 +1,89 @@
+"""The `create api` processing pipeline.
+
+Reference: internal/workload/v1/commands/subcommand/create_api.go.  Order
+matters: the collection is processed first (``get_processors`` returns the
+parent before its children) so collection markers in component manifests are
+rewritten before each component generates its child-resource source code;
+finally resource markers are resolved against the aggregated marker set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+import os
+
+from .config import Processor
+from .fieldmarkers import MarkerCollection
+from .kinds import ComponentWorkload, StandaloneWorkload, WorkloadCollection
+
+
+class CreateAPIError(Exception):
+    pass
+
+
+def init_workloads(processor: Processor) -> None:
+    """The `init` subcommand logic: just set names
+    (reference subcommand/init.go:12-18)."""
+    for p in processor.get_processors():
+        p.workload.set_names()
+
+
+@dataclass
+class _APIProcessor:
+    collection: WorkloadCollection = None
+    components: list = None
+
+
+def create_api(processor: Processor) -> None:
+    """Reference create_api.go:31-120 CreateAPI."""
+    config_processors = processor.get_processors()
+    state = _APIProcessor(components=[])
+
+    # pre-process: load manifests, find collection + components
+    # (create_api.go:52-75)
+    for p in config_processors:
+        workload = p.workload
+        workload.load_manifests(os.path.dirname(p.path))
+        if isinstance(workload, WorkloadCollection):
+            # a collection is still a collection to itself
+            state.collection = workload
+            workload.spec.collection = workload
+            workload.spec.for_collection = True
+        elif isinstance(workload, ComponentWorkload):
+            state.components.append(workload)
+
+    if state.components:
+        processor.workload.set_components(state.components)
+
+    # process: set resources + rbac, aggregate markers (create_api.go:77-111)
+    markers = MarkerCollection()
+    specs = []
+    for p in config_processors:
+        workload = p.workload
+        if isinstance(workload, ComponentWorkload):
+            workload.spec.collection = state.collection
+            workload.api_spec.domain = state.collection.api_spec.domain
+
+        try:
+            workload.set_resources(p.path)
+        except Exception as exc:
+            raise CreateAPIError(
+                f"{exc}; error setting resources for workload {workload.name}"
+            ) from exc
+
+        workload.set_rbac()
+
+        specs.append(workload.spec)
+        markers.field_markers.extend(workload.spec.field_markers)
+        markers.collection_field_markers.extend(
+            workload.spec.collection_field_markers
+        )
+
+    # resolve resource markers across all specs (create_api.go:113-119)
+    for spec in specs:
+        try:
+            spec.process_resource_markers(markers)
+        except Exception as exc:
+            raise CreateAPIError(
+                f"{exc}; error processing resource markers"
+            ) from exc

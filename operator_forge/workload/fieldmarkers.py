@@ -1,0 +1,409 @@
+"""The concrete operator-builder markers and their YAML transform.
+
+Reference: internal/workload/v1/markers/ — marker definitions
+(field_marker.go:18-38, collection_field_marker.go:12-15,
+resource_marker.go:24-57, field_types.go:15-23) and the transform pipeline
+(markers.go:76-250).
+
+Marker syntax accepted in manifests (identical to the reference so existing
+manifests work unchanged):
+
+- ``+operator-builder:field:name=<dotted.path>,type=<string|int|bool>``
+  with optional ``default=``, ``description=``, ``replace=`` arguments;
+- ``+operator-builder:collection:field:...`` — same, but the generated code
+  references the collection's spec;
+- ``+operator-builder:resource:field=<name>,value=<v>,include=<bool>``
+  (or ``collectionField=``) — includes/excludes the whole resource.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional, Union
+
+from ..markers import MarkerError, Registry, define
+from ..markers.inspector import InspectResult, inspect_documents
+from ..utils import to_title
+from ..yamldoc import Document, MapEntry, Scalar, VAR_TAG, STR_TAG
+from ..yamldoc.load import load_documents
+
+FIELD_MARKER_PREFIX = "+operator-builder:field"
+COLLECTION_FIELD_MARKER_PREFIX = "+operator-builder:collection:field"
+RESOURCE_MARKER_PREFIX = "+operator-builder:resource"
+
+FIELD_SPEC_PREFIX = "parent.Spec"
+COLLECTION_SPEC_PREFIX = "collection.Spec"
+
+RESOURCE_MARKER_FIELD_NAME = "field"
+RESOURCE_MARKER_COLLECTION_FIELD_NAME = "collectionField"
+
+
+class MarkerType(enum.Enum):
+    FIELD = "field"
+    COLLECTION = "collection"
+    RESOURCE = "resource"
+
+
+class FieldType(enum.Enum):
+    """Accepted CRD field types (reference field_types.go:15-23)."""
+
+    UNKNOWN = ""
+    STRING = "string"
+    INT = "int"
+    BOOL = "bool"
+    STRUCT = "struct"
+
+    @classmethod
+    def from_marker_arg(cls, value: Any) -> "FieldType":
+        mapping = {"string": cls.STRING, "int": cls.INT, "bool": cls.BOOL}
+        if not isinstance(value, str) or value not in mapping:
+            raise MarkerError(f"unable to parse field type {value!r}")
+        return mapping[value]
+
+    @property
+    def go_type(self) -> str:
+        return {
+            FieldType.STRING: "string",
+            FieldType.INT: "int",
+            FieldType.BOOL: "bool",
+            FieldType.STRUCT: "struct",
+            FieldType.UNKNOWN: "",
+        }[self]
+
+
+# field names reserved for internal purposes
+# (reference markers.go:155-173)
+RESERVED_FIELD_NAMES = ("collection", "collection.name", "collection.namespace")
+
+
+class ReservedMarkerError(Exception):
+    pass
+
+
+@dataclass
+class _FieldMarkerBase:
+    name: str
+    type: FieldType
+    description: Optional[str] = None
+    default: Any = None
+    replace: Optional[str] = None
+
+    # processing state (not marker arguments)
+    for_collection: bool = dc_field(
+        default=False, init=False, metadata={"marker_skip": True}
+    )
+    source_code_var: str = dc_field(
+        default="", init=False, metadata={"marker_skip": True}
+    )
+    original_value: Any = dc_field(
+        default=None, init=False, metadata={"marker_skip": True}
+    )
+
+    spec_prefix = FIELD_SPEC_PREFIX
+
+    @property
+    def replace_text(self) -> str:
+        return self.replace or ""
+
+    def is_field_marker(self) -> bool:
+        return isinstance(self, FieldMarker)
+
+    def is_collection_field_marker(self) -> bool:
+        return isinstance(self, CollectionFieldMarker)
+
+    def set_original_value(self, value: str) -> None:
+        # with replace=, the sample value is the replaced fragment itself
+        # (reference field_marker.go:117-125)
+        if self.replace_text:
+            self.original_value = self.replace_text
+        else:
+            self.original_value = value
+
+
+@dataclass
+class FieldMarker(_FieldMarkerBase):
+    """``+operator-builder:field`` (reference field_marker.go:26-38)."""
+
+    spec_prefix = FIELD_SPEC_PREFIX
+
+    def __str__(self) -> str:
+        return (
+            f"FieldMarker{{Name: {self.name} Type: {self.type.go_type} "
+            f"Default: {self.default}}}"
+        )
+
+
+@dataclass
+class CollectionFieldMarker(_FieldMarkerBase):
+    """``+operator-builder:collection:field``
+    (reference collection_field_marker.go:12-30)."""
+
+    spec_prefix = COLLECTION_SPEC_PREFIX
+
+    def __str__(self) -> str:
+        return (
+            f"CollectionFieldMarker{{Name: {self.name} "
+            f"Type: {self.type.go_type} Default: {self.default}}}"
+        )
+
+
+class ResourceMarkerError(Exception):
+    pass
+
+
+# include/exclude guard snippets emitted into generated create funcs
+# (reference resource_marker.go:33-41)
+INCLUDE_CODE = """if {var} != {value} {{
+\treturn []client.Object{{}}, nil
+}}"""
+
+EXCLUDE_CODE = """if {var} == {value} {{
+\treturn []client.Object{{}}, nil
+}}"""
+
+
+@dataclass
+class ResourceMarker:
+    """``+operator-builder:resource`` (reference resource_marker.go:47-57)."""
+
+    field: Optional[str] = None
+    collection_field: Optional[str] = None
+    value: Any = None
+    include: Optional[bool] = None
+
+    include_code: str = dc_field(
+        default="", init=False, metadata={"marker_skip": True}
+    )
+    field_marker: Optional[_FieldMarkerBase] = dc_field(
+        default=None, init=False, metadata={"marker_skip": True}
+    )
+
+    def __str__(self) -> str:
+        return (
+            f"ResourceMarker{{Field: {self.field or ''} "
+            f"CollectionField: {self.collection_field or ''} "
+            f"Value: {self.value} Include: {self.include}}}"
+        )
+
+    @property
+    def marker_name(self) -> str:
+        return self.field or self.collection_field or ""
+
+    @property
+    def spec_prefix(self) -> str:
+        if self.field is not None:
+            return FIELD_SPEC_PREFIX
+        return COLLECTION_SPEC_PREFIX
+
+    def validate(self) -> None:
+        if self.include is None:
+            raise ResourceMarkerError(
+                f"resource marker missing 'include' value for marker {self}"
+            )
+        if not self.marker_name or self.value is None:
+            raise ResourceMarkerError(
+                f"resource marker missing 'collectionField', 'field' or "
+                f"'value' for marker {self}"
+            )
+
+    def is_associated(self, marker: _FieldMarkerBase) -> bool:
+        """Reference resource_marker.go:196-213."""
+        if marker.is_collection_field_marker():
+            field_name = self.collection_field or ""
+        elif marker.is_field_marker() and marker.for_collection:
+            field_name = self.collection_field or self.field or ""
+        else:
+            field_name = self.field or ""
+        return field_name == marker.name
+
+    def process(self, collection: "MarkerCollection") -> None:
+        """Associate with a field marker and build the include/exclude guard
+        (reference resource_marker.go:142-279)."""
+        self.validate()
+        for fm in collection.field_markers:
+            if self.is_associated(fm):
+                self.field_marker = fm
+                break
+        else:
+            for cfm in collection.collection_field_markers:
+                if self.is_associated(cfm):
+                    self.field_marker = cfm
+                    break
+        if self.field_marker is None:
+            raise ResourceMarkerError(
+                f"unable to associate resource marker with 'field' or "
+                f"'collectionField' marker; {self}"
+            )
+        self._set_source_code()
+
+    def _set_source_code(self) -> None:
+        var = f"{self.spec_prefix}.{to_title(self.marker_name)}"
+        value = self.value
+        type_names = {str: "string", int: "int", bool: "bool"}
+        if isinstance(value, bool):
+            value_type = "bool"
+        elif type(value) in type_names:
+            value_type = type_names[type(value)]
+        else:
+            raise ResourceMarkerError(
+                f"resource marker 'value' is of unknown type; {self}"
+            )
+        marker_type = self.field_marker.type.go_type
+        if marker_type != value_type:
+            raise ResourceMarkerError(
+                "resource marker and field marker have mismatched types; "
+                f"expected: {value_type}, got: {marker_type} for marker {self}"
+            )
+        if value_type == "string":
+            rendered = _go_quote(value)
+        elif value_type == "bool":
+            rendered = "true" if value else "false"
+        else:
+            rendered = str(value)
+        template = INCLUDE_CODE if self.include else EXCLUDE_CODE
+        self.include_code = template.format(var=var, value=rendered)
+
+
+@dataclass
+class MarkerCollection:
+    """Aggregated field/collection-field markers used to resolve resource
+    markers (reference markers.go:56-59)."""
+
+    field_markers: list[FieldMarker] = dc_field(default_factory=list)
+    collection_field_markers: list[CollectionFieldMarker] = dc_field(
+        default_factory=list
+    )
+
+
+def _go_quote(value: str) -> str:
+    out = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return f'"{out}"'
+
+
+def build_registry(*marker_types: MarkerType) -> Registry:
+    registry = Registry()
+    for marker_type in marker_types:
+        if marker_type == MarkerType.FIELD:
+            registry.add(define(FIELD_MARKER_PREFIX, FieldMarker))
+        elif marker_type == MarkerType.COLLECTION:
+            registry.add(define(COLLECTION_FIELD_MARKER_PREFIX, CollectionFieldMarker))
+        elif marker_type == MarkerType.RESOURCE:
+            registry.add(define(RESOURCE_MARKER_PREFIX, ResourceMarker))
+    return registry
+
+
+def source_code_variable(prefix: str, name: str) -> str:
+    """``parent.Spec.Webstore.Really.Long.Path`` style variable path
+    (reference markers.go:184-186: spec prefix + strings.Title(name))."""
+    return f"{prefix}.{to_title(name)}"
+
+
+def source_code_field_variable(marker: _FieldMarkerBase) -> str:
+    """In-string variable delimiters consumed by the code generator
+    (reference markers.go:178-180)."""
+    return f"!!start {marker.source_code_var} !!end"
+
+
+def _is_reserved(name: str) -> bool:
+    return to_title(name) in {to_title(r) for r in RESERVED_FIELD_NAMES}
+
+
+def transform_results(results: list[InspectResult]) -> None:
+    """Rewrite marked values and comments in place
+    (reference markers.go:117-250 transformYAML)."""
+    for result in results:
+        marker = result.obj
+        if not isinstance(marker, _FieldMarkerBase):
+            continue
+
+        marker.source_code_var = source_code_variable(
+            marker.spec_prefix, marker.name
+        )
+
+        if _is_reserved(marker.name):
+            raise ReservedMarkerError(
+                f"{marker.name} field marker cannot be used and is reserved "
+                "for internal purposes"
+            )
+
+        _set_comments(marker, result)
+        _set_value(marker, result)
+
+
+def _append_text(marker: _FieldMarkerBase) -> str:
+    if marker.is_collection_field_marker():
+        return "controlled by collection field: " + marker.name
+    return "controlled by field: " + marker.name
+
+
+def _set_comments(marker: _FieldMarkerBase, result: InspectResult) -> None:
+    """Reference markers.go:198-222 setComments."""
+    element = result.element
+    if marker.description:
+        description = marker.description.lstrip("\n")
+        marker.description = description
+        for line in description.split("\n"):
+            element.head_comments.append("# " + line)
+
+    marker_text = result.marker_text.rstrip("\n")
+    replacement = _append_text(marker)
+
+    def rewrite(comment: str) -> str:
+        return comment.replace(marker_text, replacement)
+
+    element.foot_comments = []
+    element.head_comments = [rewrite(c) for c in element.head_comments]
+    if element.line_comment:
+        element.line_comment = rewrite(element.line_comment)
+
+
+def _set_value(marker: _FieldMarkerBase, result: InspectResult) -> None:
+    """Reference markers.go:226-250 setValue."""
+    node = result.value_node
+    if not isinstance(node, Scalar):
+        raise MarkerError(
+            f"field marker {marker.name!r} must annotate a scalar value, "
+            f"found {type(node).__name__}"
+        )
+
+    marker.set_original_value(node.value)
+
+    if marker.replace_text:
+        node.tag = STR_TAG
+        try:
+            pattern = re.compile(marker.replace_text)
+        except re.error as exc:
+            raise MarkerError(
+                f"unable to convert {marker.replace_text!r} to regex: {exc}"
+            ) from exc
+        node.value = pattern.sub(
+            source_code_field_variable(marker).replace("\\", "\\\\"), node.value
+        )
+        node.style = None
+    else:
+        node.tag = VAR_TAG
+        node.value = marker.source_code_var
+        node.style = None
+
+
+@dataclass
+class InspectedYAML:
+    documents: list[Document]
+    results: list[InspectResult]
+    warnings: list[str]
+
+
+def inspect_for_yaml(
+    content: Union[str, bytes], *marker_types: MarkerType
+) -> InspectedYAML:
+    """Inspect manifest YAML for the requested marker types and apply the
+    value/comment transform (reference markers.go:76-88 InspectForYAML)."""
+    if isinstance(content, bytes):
+        content = content.decode("utf-8")
+    registry = build_registry(*marker_types)
+    documents = load_documents(content)
+    results, warnings = inspect_documents(documents, registry)
+    transform_results(results)
+    return InspectedYAML(documents=documents, results=results, warnings=warnings)

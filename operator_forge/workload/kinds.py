@@ -1,0 +1,575 @@
+"""Workload kinds: Standalone, Collection, Component.
+
+Reference: internal/workload/v1/kinds/{workload,standalone,collection,
+component,kinds}.go.  Each workload kind carries a ``WorkloadSpec`` whose
+``process_manifests`` is the core codegen driver (workload.go:218-291):
+marker inspection -> value/comment rewriting -> child-resource creation
+(with RBAC) -> Go object source emission -> filename dedup.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+from .. import gocodegen
+from ..utils import to_package_name
+from ..yamldoc.load import load_documents
+from ..yamldoc.emit import emit_documents
+from ..yamldoc.model import to_python
+from . import manifests as manifests_mod
+from . import rbac
+from .api_fields import APIFields
+from .companion import CompanionCLI
+from .fieldmarkers import (
+    CollectionFieldMarker,
+    FieldMarker,
+    FieldType,
+    MarkerCollection,
+    MarkerType,
+    inspect_for_yaml,
+)
+
+
+class WorkloadKind(enum.Enum):
+    STANDALONE = "StandaloneWorkload"
+    COLLECTION = "WorkloadCollection"
+    COMPONENT = "ComponentWorkload"
+
+
+class WorkloadConfigError(Exception):
+    pass
+
+
+class ManifestProcessingError(Exception):
+    pass
+
+
+SAMPLE_API_DOMAIN = "acme.com"
+SAMPLE_API_GROUP = "apps"
+SAMPLE_API_KIND = "MyApp"
+SAMPLE_API_VERSION = "v1alpha1"
+
+
+@dataclass
+class WorkloadAPISpec:
+    """Reference workload.go:79-86."""
+
+    domain: str = ""
+    group: str = ""
+    version: str = ""
+    kind: str = ""
+    cluster_scoped: bool = False
+
+    @classmethod
+    def sample(cls) -> "WorkloadAPISpec":
+        return cls(
+            domain=SAMPLE_API_DOMAIN,
+            group=SAMPLE_API_GROUP,
+            version=SAMPLE_API_VERSION,
+            kind=SAMPLE_API_KIND,
+            cluster_scoped=False,
+        )
+
+
+@dataclass
+class WorkloadSpec:
+    """Processing state shared by all workload kinds
+    (reference workload.go:95-106)."""
+
+    resources: list[str] = dc_field(default_factory=list)
+    manifests: manifests_mod.Manifests = dc_field(
+        default_factory=manifests_mod.Manifests
+    )
+    field_markers: list[FieldMarker] = dc_field(default_factory=list)
+    collection_field_markers: list[CollectionFieldMarker] = dc_field(
+        default_factory=list
+    )
+    for_collection: bool = False
+    collection: Optional["WorkloadCollection"] = None
+    api_spec_fields: Optional[APIFields] = None
+    rbac_rules: Optional[rbac.Rules] = None
+
+    # -- the codegen driver ---------------------------------------------
+
+    def init_spec(self) -> None:
+        """Reference workload.go:134-148."""
+        self.api_spec_fields = APIFields.new_spec_root()
+        if self.needs_collection_ref():
+            self.append_collection_ref()
+        self.rbac_rules = rbac.Rules()
+
+    def needs_collection_ref(self) -> bool:
+        """Components of a collection get a collection reference in their
+        spec; the collection itself does not (workload.go:420-422)."""
+        return self.collection is not None and not self.for_collection
+
+    def append_collection_ref(self) -> None:
+        """Reference workload.go:150-212 appendCollectionRef."""
+        if self.api_spec_fields is None or self.collection is None:
+            return
+        if self.api_spec_fields.name != "Spec":
+            return
+        sample_namespace = "" if self.collection.is_cluster_scoped() else "default"
+        collection_field = APIFields(
+            name="Collection",
+            type=FieldType.STRUCT,
+            tags='`json:"collection"`',
+            sample="#collection:",
+            struct_name="CollectionSpec",
+            markers=[
+                "+kubebuilder:validation:Optional",
+                "Specifies a reference to the collection to use for this workload.",
+                "Requires the name and namespace input to find the collection.",
+                "If no collection field is set, default to selecting the only",
+                "workload collection in the cluster, which will result in an error",
+                "if not exactly one collection is found.",
+            ],
+            children=[
+                APIFields(
+                    name="Name",
+                    type=FieldType.STRING,
+                    tags='`json:"name"`',
+                    sample=f'#name: "{self.collection.api_kind.lower()}-sample"',
+                    markers=[
+                        "+kubebuilder:validation:Required",
+                        "Required if specifying collection.  The name of the collection",
+                        "within a specific collection.namespace to reference.",
+                    ],
+                ),
+                APIFields(
+                    name="Namespace",
+                    type=FieldType.STRING,
+                    tags='`json:"namespace"`',
+                    sample=f'#namespace: "{sample_namespace}"',
+                    markers=[
+                        "+kubebuilder:validation:Optional",
+                        '(Default: "") The namespace where the collection exists.  Required only if',
+                        "the collection is namespace scoped and not cluster scoped.",
+                    ],
+                ),
+            ],
+        )
+        self.api_spec_fields.children.append(collection_field)
+
+    def process_manifests(self, *marker_types: MarkerType) -> None:
+        """Reference workload.go:218-291."""
+        self.init_spec()
+        unique_names: set[str] = set()
+
+        for manifest in self.manifests:
+            self.process_markers(manifest, *marker_types)
+
+            child_resources: list[manifests_mod.ChildResource] = []
+            for extracted in manifest.extract_manifests():
+                try:
+                    docs = [
+                        d for d in load_documents(extracted) if d.root is not None
+                    ]
+                except Exception as exc:
+                    raise ManifestProcessingError(
+                        f"{exc}; unable to decode object in manifest file "
+                        f"{manifest.filename}"
+                    ) from exc
+                if not docs:
+                    continue
+                obj = to_python(docs[0].root)
+                if not isinstance(obj, dict) or not obj.get("kind"):
+                    raise ManifestProcessingError(
+                        "manifest object missing 'kind' in manifest file "
+                        f"{manifest.filename}"
+                    )
+
+                child = manifests_mod.ChildResource.from_object(obj)
+                if child.unique_name in unique_names:
+                    raise ManifestProcessingError(
+                        "child resource unique name error; error generating "
+                        f"resource definition for resource kind [{obj.get('kind')}] "
+                        f"with name [{(obj.get('metadata') or {}).get('name')}] "
+                        f"[{manifest.filename}]"
+                    )
+                unique_names.add(child.unique_name)
+
+                child.source_code = gocodegen.generate_for_document(
+                    docs[0], "resourceObj"
+                )
+                child.static_content = extracted
+                child_resources.append(child)
+
+            manifest.child_resources = child_resources
+
+        manifests_mod.deduplicate_file_names(self.manifests)
+
+    def process_markers(
+        self, manifest: manifests_mod.Manifest, *marker_types: MarkerType
+    ) -> None:
+        """Reference workload.go:293-329."""
+        try:
+            inspected = inspect_for_yaml(manifest.content, *marker_types)
+        except Exception as exc:
+            raise ManifestProcessingError(
+                f"{exc}; error processing manifest file {manifest.filename}"
+            ) from exc
+
+        content = emit_documents(inspected.documents)
+
+        self.process_marker_results(inspected.results)
+
+        # when processing a collection's own manifests, any surviving
+        # collection-variable references are references to self
+        # (reference workload.go:317-326)
+        if (
+            MarkerType.FIELD in marker_types
+            and MarkerType.COLLECTION in marker_types
+        ):
+            content = content.replace("!!var collection", "!!var parent")
+            content = content.replace("!!start collection", "!!start parent")
+
+        manifest.content = content
+
+    def process_marker_results(self, results) -> None:
+        """Reference workload.go:331-381."""
+        for result in results:
+            marker = result.obj
+            if isinstance(marker, CollectionFieldMarker):
+                self.collection_field_markers.append(marker)
+            elif isinstance(marker, FieldMarker):
+                self.field_markers.append(marker)
+            else:
+                continue
+
+            comments: list[str] = []
+            if marker.description:
+                comments.extend(marker.description.split("\n"))
+
+            if marker.default is not None:
+                has_default = True
+                sample = marker.default
+            else:
+                has_default = False
+                sample = marker.original_value
+
+            try:
+                self.api_spec_fields.add_field(
+                    marker.name, marker.type, comments, sample, has_default
+                )
+            except Exception as exc:
+                raise ManifestProcessingError(str(exc)) from exc
+
+            marker.for_collection = self.for_collection
+
+    def process_resource_markers(self, collection: MarkerCollection) -> None:
+        """Reference workload.go:122-132."""
+        for manifest in self.manifests:
+            for child in manifest.child_resources:
+                child.process_resource_markers(collection)
+
+
+class Workload:
+    """Base workload (reference WorkloadBuilder interface,
+    workload.go:37-71)."""
+
+    workload_kind: WorkloadKind
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.package_name = ""
+        self.api_spec = WorkloadAPISpec()
+        self.spec = WorkloadSpec()
+        self.companion_root_cmd = CompanionCLI()
+        self.companion_sub_cmd = CompanionCLI()
+
+    # -- identity -------------------------------------------------------
+
+    @property
+    def domain(self) -> str:
+        return self.api_spec.domain
+
+    @property
+    def api_group(self) -> str:
+        return self.api_spec.group
+
+    @property
+    def api_version(self) -> str:
+        return self.api_spec.version
+
+    @property
+    def api_kind(self) -> str:
+        return self.api_spec.kind
+
+    def is_cluster_scoped(self) -> bool:
+        return self.api_spec.cluster_scoped
+
+    def is_standalone(self) -> bool:
+        return self.workload_kind == WorkloadKind.STANDALONE
+
+    def is_collection(self) -> bool:
+        return self.workload_kind == WorkloadKind.COLLECTION
+
+    def is_component(self) -> bool:
+        return self.workload_kind == WorkloadKind.COMPONENT
+
+    # -- companion CLI --------------------------------------------------
+
+    def has_root_cmd_name(self) -> bool:
+        return self.companion_root_cmd.has_name()
+
+    def has_sub_cmd_name(self) -> bool:
+        return self.companion_sub_cmd.has_name()
+
+    def has_child_resources(self) -> bool:
+        return len(self.spec.manifests) > 0
+
+    # -- collection wiring ----------------------------------------------
+
+    def get_collection(self) -> Optional["WorkloadCollection"]:
+        return self.spec.collection
+
+    def get_components(self) -> list["ComponentWorkload"]:
+        return []
+
+    def get_dependencies(self) -> list["ComponentWorkload"]:
+        return []
+
+    def set_components(self, components: list["ComponentWorkload"]) -> None:
+        raise WorkloadConfigError(
+            "cannot set component workloads on a "
+            f"{self.workload_kind.value} - only on collections"
+        )
+
+    # -- processing -----------------------------------------------------
+
+    def set_names(self) -> None:
+        self.package_name = to_package_name(self.name)
+
+    def set_rbac(self) -> None:
+        self.spec.rbac_rules.add(rbac.for_workloads(self))
+
+    def set_resources(self, workload_path: str) -> None:
+        self.spec.process_manifests(MarkerType.FIELD)
+
+    def load_manifests(self, workload_path: str) -> None:
+        """Reference standalone.go:218-233 LoadManifests (same for all)."""
+        self.spec.manifests = manifests_mod.expand_manifests(
+            workload_path, self.spec.resources
+        )
+        for manifest in self.spec.manifests:
+            manifest.load_content(self.is_collection())
+
+    def validate(self) -> None:
+        missing = self._missing_fields()
+        if missing:
+            raise WorkloadConfigError(f"missing required fields: {missing}")
+
+    def _missing_fields(self) -> list[str]:
+        missing = []
+        if not self.name:
+            missing.append("name")
+        if not self.api_spec.group:
+            missing.append("spec.api.group")
+        if not self.api_spec.version:
+            missing.append("spec.api.version")
+        if not self.api_spec.kind:
+            missing.append("spec.api.kind")
+        return missing
+
+    def get_rbac_rules(self) -> list[rbac.Rule]:
+        return self.spec.rbac_rules.as_list() if self.spec.rbac_rules else []
+
+    def get_api_spec_fields(self) -> Optional[APIFields]:
+        return self.spec.api_spec_fields
+
+    def get_manifests(self) -> manifests_mod.Manifests:
+        return self.spec.manifests
+
+
+class StandaloneWorkload(Workload):
+    """Reference standalone.go:29-51."""
+
+    workload_kind = WorkloadKind.STANDALONE
+
+    def _missing_fields(self) -> list[str]:
+        missing = super()._missing_fields()
+        if not self.api_spec.domain:
+            missing.insert(1 if self.name else 0, "spec.api.domain")
+        return missing
+
+    def set_names(self) -> None:
+        super().set_names()
+        if self.has_root_cmd_name():
+            self.companion_root_cmd.set_common_values(self, False)
+
+
+class ComponentWorkload(Workload):
+    """Reference component.go:34-60."""
+
+    workload_kind = WorkloadKind.COMPONENT
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.dependencies: list[str] = []
+        self.component_dependencies: list["ComponentWorkload"] = []
+        self.config_path = ""
+
+    def get_dependencies(self) -> list["ComponentWorkload"]:
+        return self.component_dependencies
+
+    def set_names(self) -> None:
+        super().set_names()
+        self.companion_sub_cmd.set_common_values(self, True)
+
+    def set_rbac(self) -> None:
+        self.spec.rbac_rules.add(
+            rbac.for_workloads(self, self.spec.collection)
+        )
+
+
+class WorkloadCollection(Workload):
+    """Reference collection.go:31-53."""
+
+    workload_kind = WorkloadKind.COLLECTION
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.component_files: list[str] = []
+        self.components: list[ComponentWorkload] = []
+
+    def _missing_fields(self) -> list[str]:
+        missing = super()._missing_fields()
+        if not self.api_spec.domain:
+            missing.insert(1 if self.name else 0, "spec.api.domain")
+        return missing
+
+    def get_components(self) -> list[ComponentWorkload]:
+        return self.components
+
+    def set_components(self, components: list[ComponentWorkload]) -> None:
+        self.components = components
+
+    def set_names(self) -> None:
+        super().set_names()
+        if self.has_root_cmd_name():
+            self.companion_root_cmd.set_common_values(self, False)
+        self.companion_sub_cmd.set_common_values(self, True)
+
+    def set_resources(self, workload_path: str) -> None:
+        """Process own manifests with both marker types, then pull collection
+        markers out of every component's manifests into this collection's API
+        (reference collection.go:156-173)."""
+        self.spec.process_manifests(MarkerType.FIELD, MarkerType.COLLECTION)
+        for component in self.components:
+            for manifest in component.spec.manifests:
+                self.spec.process_markers(manifest, MarkerType.COLLECTION)
+
+
+# -- strict config decoding ---------------------------------------------
+
+
+def _require_keys(data: dict, allowed: set[str], context: str) -> None:
+    unknown = set(data) - allowed
+    if unknown:
+        raise WorkloadConfigError(
+            f"unknown field(s) {sorted(unknown)} in {context}"
+        )
+
+
+def _decode_api(data: Any, context: str) -> WorkloadAPISpec:
+    if data is None:
+        return WorkloadAPISpec()
+    if not isinstance(data, dict):
+        raise WorkloadConfigError(f"{context}.api must be a mapping")
+    _require_keys(
+        data, {"domain", "group", "version", "kind", "clusterScoped"},
+        f"{context}.api",
+    )
+    return WorkloadAPISpec(
+        domain=str(data.get("domain") or ""),
+        group=str(data.get("group") or ""),
+        version=str(data.get("version") or ""),
+        kind=str(data.get("kind") or ""),
+        cluster_scoped=bool(data.get("clusterScoped") or False),
+    )
+
+
+def _decode_cli(data: Any, context: str) -> CompanionCLI:
+    if data is None:
+        return CompanionCLI()
+    if not isinstance(data, dict):
+        raise WorkloadConfigError(f"{context} must be a mapping")
+    _require_keys(data, {"name", "description"}, context)
+    return CompanionCLI(
+        name=str(data.get("name") or ""),
+        description=str(data.get("description") or ""),
+    )
+
+
+def _string_list(data: Any, context: str) -> list[str]:
+    if data is None:
+        return []
+    if not isinstance(data, list):
+        raise WorkloadConfigError(f"{context} must be a list")
+    return [str(item) for item in data]
+
+
+def decode(data: dict, path: str = "") -> Workload:
+    """Decode one workload-config document into its workload object, with
+    strict unknown-field checking (reference kinds.go:25-42 Decode +
+    yaml KnownFields(true) at config/parse.go:87)."""
+    if not isinstance(data, dict):
+        raise WorkloadConfigError(f"workload config must be a mapping: {path}")
+    _require_keys(data, {"name", "kind", "spec"}, f"workload config {path}")
+
+    kind_str = str(data.get("kind") or "")
+    try:
+        kind = WorkloadKind(kind_str)
+    except ValueError:
+        raise WorkloadConfigError(
+            f"unrecognized workload kind {kind_str!r} in config {path}"
+        ) from None
+
+    name = str(data.get("name") or "")
+    spec = data.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise WorkloadConfigError(f"spec must be a mapping in config {path}")
+
+    common = {"api", "resources"}
+    if kind == WorkloadKind.STANDALONE:
+        _require_keys(spec, common | {"companionCliRootcmd"}, f"{path}.spec")
+        workload: Workload = StandaloneWorkload(name)
+        workload.companion_root_cmd = _decode_cli(
+            spec.get("companionCliRootcmd"), f"{path}.spec.companionCliRootcmd"
+        )
+    elif kind == WorkloadKind.COLLECTION:
+        _require_keys(
+            spec,
+            common | {"companionCliRootcmd", "companionCliSubcmd", "componentFiles"},
+            f"{path}.spec",
+        )
+        workload = WorkloadCollection(name)
+        workload.companion_root_cmd = _decode_cli(
+            spec.get("companionCliRootcmd"), f"{path}.spec.companionCliRootcmd"
+        )
+        workload.companion_sub_cmd = _decode_cli(
+            spec.get("companionCliSubcmd"), f"{path}.spec.companionCliSubcmd"
+        )
+        workload.component_files = _string_list(
+            spec.get("componentFiles"), f"{path}.spec.componentFiles"
+        )
+    else:
+        _require_keys(
+            spec, common | {"companionCliSubcmd", "dependencies"}, f"{path}.spec"
+        )
+        workload = ComponentWorkload(name)
+        workload.companion_sub_cmd = _decode_cli(
+            spec.get("companionCliSubcmd"), f"{path}.spec.companionCliSubcmd"
+        )
+        workload.dependencies = _string_list(
+            spec.get("dependencies"), f"{path}.spec.dependencies"
+        )
+
+    workload.api_spec = _decode_api(spec.get("api"), f"{path}.spec")
+    workload.spec.resources = _string_list(
+        spec.get("resources"), f"{path}.spec.resources"
+    )
+    return workload

@@ -1,0 +1,266 @@
+"""Manifest loading/expansion and the ChildResource model.
+
+Reference: internal/workload/v1/manifests/{manifest,child_resource}.go.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from ..utils import to_file_name, to_title
+from ..utils.globber import glob_manifest_files
+from ..yamldoc.model import to_python
+from . import rbac
+from .fieldmarkers import (
+    COLLECTION_FIELD_MARKER_PREFIX,
+    FIELD_MARKER_PREFIX,
+    MarkerCollection,
+    MarkerType,
+    RESOURCE_MARKER_COLLECTION_FIELD_NAME,
+    RESOURCE_MARKER_FIELD_NAME,
+    ResourceMarker,
+    inspect_for_yaml,
+)
+
+
+class ManifestError(Exception):
+    """Error processing a manifest file."""
+
+
+@dataclass
+class ChildResource:
+    """A resource created by the custom resource
+    (reference child_resource.go:29-58)."""
+
+    name: str
+    unique_name: str
+    group: str
+    version: str
+    kind: str
+    static_content: str = ""
+    source_code: str = ""
+    include_code: str = ""
+    rbac: Optional[rbac.Rules] = None
+    # whether metadata.name carries a marker substitution (a !!var expression
+    # or !!start/!!end fragment) and therefore has no literal name constant
+    name_is_dynamic: bool = False
+
+    def __str__(self) -> str:
+        return (
+            f"{{Group: {self.group}, Version: {self.version}, "
+            f"Kind: {self.kind}, Name: {self.name}}}"
+        )
+
+    @classmethod
+    def from_object(cls, obj: dict) -> "ChildResource":
+        """Build from a decoded manifest object
+        (reference child_resource.go:40-58 NewChildResource)."""
+        api_version = str(obj.get("apiVersion", ""))
+        if "/" in api_version:
+            group, version = api_version.split("/", 1)
+        else:
+            group, version = "", api_version
+        metadata = obj.get("metadata") or {}
+        name = str(metadata.get("name", ""))
+        return cls(
+            name=name,
+            unique_name=unique_name(obj),
+            group=group,
+            version=version,
+            kind=str(obj.get("kind", "")),
+            rbac=rbac.for_resource(obj),
+            name_is_dynamic=_is_dynamic_name(name),
+        )
+
+    def create_func_name(self) -> str:
+        return f"Create{self.unique_name}"
+
+    def init_func_name(self) -> str:
+        """CRD children get init funcs so CRDs apply before instances
+        (reference child_resource.go:108-120)."""
+        if self.kind.lower() == "customresourcedefinition":
+            return self.create_func_name()
+        return ""
+
+    def name_constant(self) -> str:
+        """Literal name, or empty when the name is marker-controlled
+        (reference child_resource.go:122-131)."""
+        if self.name_is_dynamic:
+            return ""
+        return self.name
+
+    def process_resource_markers(self, collection: MarkerCollection) -> None:
+        """Inspect this resource's static content for a resource marker and
+        compile its include/exclude guard
+        (reference child_resource.go:69-106)."""
+        inspected = inspect_for_yaml(self.static_content, MarkerType.RESOURCE)
+        results = [
+            r for r in inspected.results if isinstance(r.obj, ResourceMarker)
+        ]
+        if not results:
+            return
+        marker = results[0].obj
+        marker.process(collection)
+        if marker.include_code:
+            self.include_code = marker.include_code
+
+
+def _is_dynamic_name(name: str) -> bool:
+    lowered = name.lower()
+    return lowered.startswith("!!start") or name.startswith("parent.Spec") or (
+        name.startswith("collection.Spec")
+    )
+
+
+def unique_name(obj: dict) -> str:
+    """Kind + cleaned namespace + cleaned name
+    (reference child_resource.go:133-163)."""
+    metadata = obj.get("metadata") or {}
+
+    def clean(value: str) -> str:
+        out = to_title(str(value))
+        for token in ("-", ".", ":", "!!Start", "!!End",
+                      "ParentSpec", "CollectionSpec", " "):
+            out = out.replace(token, "")
+        return out
+
+    return (
+        f"{obj.get('kind', '')}"
+        f"{clean(metadata.get('namespace', '') or '')}"
+        f"{clean(metadata.get('name', '') or '')}"
+    )
+
+
+@dataclass
+class Manifest:
+    """A single input manifest file (reference manifest.go:19-26)."""
+
+    filename: str
+    source_filename: str = ""
+    content: str = ""
+    child_resources: list[ChildResource] = dc_field(default_factory=list)
+
+    def load_content(self, is_collection: bool) -> None:
+        """Read file content; for collection-owned manifests, rewrite
+        collection markers into plain field markers since a collection's
+        collection is itself (reference manifest.go:82-101)."""
+        try:
+            with open(self.filename, "r", encoding="utf-8") as handle:
+                content = handle.read()
+        except OSError as exc:
+            raise ManifestError(
+                f"{exc}; error processing manifest file {self.filename}"
+            ) from exc
+        if is_collection:
+            content = content.replace(
+                COLLECTION_FIELD_MARKER_PREFIX, FIELD_MARKER_PREFIX
+            )
+            content = content.replace(
+                RESOURCE_MARKER_COLLECTION_FIELD_NAME, RESOURCE_MARKER_FIELD_NAME
+            )
+        self.content = content
+
+    def extract_manifests(self) -> list[str]:
+        """Split multi-document content on ``---`` lines
+        (reference manifest.go:57-80)."""
+        manifests: list[str] = []
+        current: list[str] = []
+        for line in self.content.split("\n"):
+            if line.rstrip(" ") == "---":
+                if any(l.strip() for l in current):
+                    manifests.append("\n".join(current))
+                current = []
+            else:
+                current.append(line)
+        if any(l.strip() for l in current):
+            manifests.append("\n".join(current))
+        return manifests
+
+
+class Manifests(list):
+    """A collection of manifests (reference manifest.go:28-29)."""
+
+    def func_names(self) -> tuple[list[str], list[str]]:
+        """Create/init function names, deduplicated across resources
+        (reference manifest.go:118-153)."""
+        create_names: list[str] = []
+        init_names: list[str] = []
+        seen_create: dict[str, int] = {}
+        seen_init: dict[str, int] = {}
+        for manifest in self:
+            for child in manifest.child_resources:
+                create = child.create_func_name()
+                if seen_create.get(create, 0) > 0:
+                    deduped = f"{create}{seen_create[create]}"
+                    seen_create[create] += 1
+                    create_names.append(deduped)
+                else:
+                    seen_create[create] = 1
+                    create_names.append(create)
+
+                init = child.init_func_name()
+                if not init:
+                    continue
+                if seen_init.get(init, 0) > 0:
+                    deduped = f"{init}{seen_init[init]}"
+                    seen_init[init] += 1
+                    init_names.append(deduped)
+                else:
+                    seen_init[init] = 1
+                    init_names.append(init)
+        return create_names, init_names
+
+    def all_child_resources(self) -> list[ChildResource]:
+        out: list[ChildResource] = []
+        for manifest in self:
+            out.extend(manifest.child_resources)
+        return out
+
+
+def from_files(manifest_files: list[str]) -> Manifests:
+    return Manifests(Manifest(filename=f) for f in manifest_files)
+
+
+def expand_manifests(workload_path: str, manifest_paths: list[str]) -> Manifests:
+    """Expand glob patterns relative to the workload config directory
+    (reference manifest.go:31-53 ExpandManifests)."""
+    out = Manifests()
+    for pattern in manifest_paths:
+        files = glob_manifest_files(os.path.join(workload_path, pattern))
+        for path in files:
+            rel = os.path.relpath(path, workload_path)
+            out.append(
+                Manifest(filename=path, source_filename=source_filename(rel))
+            )
+    return out
+
+
+def source_filename(relative_name: str) -> str:
+    """Unique snake_case ``.go`` name for a source manifest
+    (reference manifest.go:156-174 getSourceFilename)."""
+    name = os.path.normpath(relative_name)
+    name = name.replace("/", "_")
+    ext = os.path.splitext(name)[1]
+    if ext:
+        name = name.replace(ext, "")
+    name = name.replace(".", "")
+    name += ".go"
+    name = to_file_name(name)
+    return name.lstrip("_")
+
+
+def deduplicate_file_names(manifests: Manifests) -> None:
+    """Ensure generated source filenames are unique within a workload
+    (reference workload.go:386-413 deduplicateFileNames)."""
+    taken: set[str] = {"resources.go"}
+    for manifest in manifests:
+        name = manifest.source_filename
+        if name in taken:
+            stem = name[: -len(".go")] if name.endswith(".go") else name
+            count = 1
+            while f"{stem}_{count}.go" in taken:
+                count += 1
+            manifest.source_filename = f"{stem}_{count}.go"
+        taken.add(manifest.source_filename)

@@ -1,0 +1,233 @@
+"""RBAC rule inference.
+
+Reference: internal/workload/v1/rbac/.  Derives the ``+kubebuilder:rbac``
+markers the generated controller needs:
+
+- per-workload rules: manage its own kind and ``<kind>/status``;
+- per-child-resource rules: manage whatever the manifests declare;
+- recursive escalation: when a child resource is a Role/ClusterRole, the
+  controller also needs every permission that role grants
+  (rules.go:58-93, role_rule.go:22-125) — otherwise the generated operator
+  fails at runtime with escalation errors;
+- verb deduplication and group/resource merging (rule.go:39-105).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+CORE_GROUP = "core"
+KUBEBUILDER_PREFIX = "// +kubebuilder:rbac"
+
+DEFAULT_RESOURCE_VERBS = [
+    "get", "list", "watch", "create", "update", "patch", "delete",
+]
+DEFAULT_STATUS_VERBS = ["get", "update", "patch"]
+
+# found value -> proper plural (reference rbac.go:56-60)
+KNOWN_IRREGULARS = {
+    "resourcequota": "resourcequotas",
+}
+
+_ES_SUFFIXES = ("ss", "us", "is", "os", "x", "z", "ch", "sh")
+
+
+def pluralize(kind: str) -> str:
+    """Lowercase-pluralize a kind the way kubebuilder's RegularPlural does
+    (flect-style English pluralization, good for Kubernetes kinds).
+    Already-plural words (``jobs``, ``deployments``) pass through unchanged,
+    as RBAC role rules list resources in plural form."""
+    word = kind.lower()
+    if word in KNOWN_IRREGULARS:
+        return KNOWN_IRREGULARS[word]
+    if word.endswith("y") and len(word) > 1 and word[-2] not in "aeiou":
+        plural = word[:-1] + "ies"
+    elif word.endswith(_ES_SUFFIXES):
+        plural = word + "es"
+    elif word.endswith("s"):
+        plural = word
+    else:
+        plural = word + "s"
+    return KNOWN_IRREGULARS.get(plural, plural)
+
+
+def get_group(group: str) -> str:
+    return group if group else CORE_GROUP
+
+
+def get_resource(kind: str) -> str:
+    """Format a kind (possibly ``kind/subresource`` or ``*``) for a rule
+    (reference rbac.go:99-116)."""
+    parts = kind.split("/")
+    base = "*" if parts[0] == "*" else pluralize(parts[0])
+    if len(parts) > 1:
+        return f"{base}/{parts[1]}"
+    return base
+
+
+@dataclass
+class Rule:
+    group: str = ""
+    resource: str = ""
+    urls: list[str] = dc_field(default_factory=list)
+    verbs: list[str] = dc_field(default_factory=list)
+
+    def to_marker(self) -> str:
+        """Reference rule.go:20-35 ToMarker."""
+        if self.urls:
+            return (
+                f"{KUBEBUILDER_PREFIX}:verbs={';'.join(self.verbs)},"
+                f"urls={';'.join(self.urls)}"
+            )
+        return (
+            f"{KUBEBUILDER_PREFIX}:groups={self.group},"
+            f"resources={self.resource},verbs={';'.join(self.verbs)}"
+        )
+
+    def is_resource_rule(self) -> bool:
+        return bool(self.group and self.resource)
+
+    def group_resource_equal(self, other: "Rule") -> bool:
+        return self.group == other.group and self.resource == other.resource
+
+
+class Rules:
+    """A deduplicating collection of RBAC rules (reference rules.go)."""
+
+    def __init__(self) -> None:
+        self._rules: list[Rule] = []
+
+    def __iter__(self):
+        return iter(self._rules)
+
+    def __len__(self) -> int:
+        return len(self._rules)
+
+    def as_list(self) -> list[Rule]:
+        return list(self._rules)
+
+    def add(self, *new_rules: "Rule | Rules") -> None:
+        for item in new_rules:
+            if isinstance(item, Rules):
+                for rule in item:
+                    self._add_rule(rule)
+            else:
+                self._add_rule(item)
+
+    def _add_rule(self, rule: Rule) -> None:
+        if not self._rules:
+            self._rules.append(_copy(rule))
+            return
+        if rule.is_resource_rule():
+            self._add_resource_rule(rule)
+        else:
+            self._add_non_resource_rule(rule)
+
+    def _add_resource_rule(self, rule: Rule) -> None:
+        for existing in self._rules:
+            if rule.group_resource_equal(existing):
+                for verb in rule.verbs:
+                    if verb not in existing.verbs:
+                        existing.verbs.append(verb)
+                return
+        self._rules.append(_copy(rule))
+
+    def _add_non_resource_rule(self, rule: Rule) -> None:
+        for url in rule.urls:
+            for existing in self._rules:
+                if url in existing.urls:
+                    for verb in rule.verbs:
+                        if verb not in existing.verbs:
+                            existing.verbs.append(verb)
+                    return
+        self._rules.append(_copy(rule))
+
+
+def _copy(rule: Rule) -> Rule:
+    return Rule(
+        group=rule.group,
+        resource=rule.resource,
+        urls=list(rule.urls),
+        verbs=list(rule.verbs),
+    )
+
+
+def for_workloads(*workloads) -> Rules:
+    """Rules for the workload kinds themselves (reference rules.go:37-55
+    via rbac.go:79-89 ForWorkloads).  ``workloads`` expose ``api_group``,
+    ``domain`` and ``api_kind`` attributes/properties."""
+    rules = Rules()
+    for workload in workloads:
+        if workload is None:
+            continue
+        group = f"{workload.api_group}.{workload.domain}"
+        resource = get_resource(workload.api_kind)
+        rules.add(
+            Rule(group=group, resource=resource,
+                 verbs=list(DEFAULT_RESOURCE_VERBS)),
+            Rule(group=group, resource=f"{resource}/status",
+                 verbs=list(DEFAULT_STATUS_VERBS)),
+        )
+    return rules
+
+
+def for_resource(manifest: dict) -> Rules:
+    """Rules for one child-resource manifest, with Role/ClusterRole
+    escalation (reference rules.go:58-93 addForResource)."""
+    rules = Rules()
+    api_version = str(manifest.get("apiVersion", ""))
+    group = api_version.split("/")[0] if "/" in api_version else ""
+    kind = str(manifest.get("kind", ""))
+
+    rules.add(
+        Rule(
+            group=get_group(group),
+            resource=get_resource(kind),
+            verbs=list(DEFAULT_RESOURCE_VERBS),
+        )
+    )
+
+    if kind.lower() in ("clusterrole", "role"):
+        role_rules = manifest.get("rules")
+        if isinstance(role_rules, list):
+            for role_rule in role_rules:
+                rules.add(_role_rule_to_rules(role_rule))
+    return rules
+
+
+def _string_list(value: Any) -> list[str]:
+    if isinstance(value, list):
+        return [str(v) for v in value]
+    if value is None:
+        return []
+    return [str(value)]
+
+
+def _role_rule_to_rules(role_rule: Any) -> Rules:
+    """Convert one Role/ClusterRole rule into controller rules
+    (reference role_rule.go:43-125)."""
+    rules = Rules()
+    if not isinstance(role_rule, dict):
+        return rules
+    groups = _string_list(role_rule.get("apiGroups"))
+    resources = _string_list(role_rule.get("resources"))
+    verbs = _string_list(role_rule.get("verbs"))
+    urls = _string_list(role_rule.get("nonResourceURLs"))
+
+    if not verbs:
+        return rules
+    if groups and resources:
+        for g in groups:
+            for r in resources:
+                rules.add(
+                    Rule(
+                        group=get_group(g),
+                        resource=get_resource(r),
+                        verbs=list(verbs),
+                        urls=list(urls),
+                    )
+                )
+    elif urls:
+        rules.add(Rule(verbs=list(verbs), urls=list(urls)))
+    return rules

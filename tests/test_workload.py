@@ -1,0 +1,410 @@
+"""Tests for the workload domain layer: config parsing, markers, API fields,
+RBAC, and the create-api pipeline over real fixtures.
+
+Coverage modeled on the reference's test strategy (SURVEY.md §4):
+markers/rbac/kinds/config unit tests plus fixture-driven functional flow.
+"""
+
+import os
+
+import pytest
+
+from operator_forge.workload import config as wconfig
+from operator_forge.workload import rbac
+from operator_forge.workload.api_fields import APIFields, FieldOverwriteError
+from operator_forge.workload.create_api import create_api, init_workloads
+from operator_forge.workload.fieldmarkers import FieldType
+from operator_forge.workload.kinds import (
+    StandaloneWorkload,
+    WorkloadCollection,
+    WorkloadConfigError,
+    decode,
+)
+from operator_forge.workload.manifests import source_filename, unique_name
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestConfigDecode:
+    def test_standalone_decode(self):
+        w = decode(
+            {
+                "name": "bookstore",
+                "kind": "StandaloneWorkload",
+                "spec": {
+                    "api": {
+                        "domain": "example.io",
+                        "group": "shop",
+                        "version": "v1alpha1",
+                        "kind": "BookStore",
+                    },
+                    "resources": ["app.yaml"],
+                },
+            }
+        )
+        assert isinstance(w, StandaloneWorkload)
+        assert w.api_kind == "BookStore"
+        w.validate()
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(WorkloadConfigError, match="unknown field"):
+            decode(
+                {
+                    "name": "x",
+                    "kind": "StandaloneWorkload",
+                    "spec": {"api": {}, "bogusField": 1},
+                }
+            )
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(WorkloadConfigError, match="unrecognized workload kind"):
+            decode({"name": "x", "kind": "Nope", "spec": {}})
+
+    @pytest.mark.parametrize(
+        "missing", ["domain", "group", "version", "kind"]
+    )
+    def test_missing_required_api_fields(self, missing):
+        api = {
+            "domain": "example.io",
+            "group": "shop",
+            "version": "v1",
+            "kind": "Thing",
+        }
+        del api[missing]
+        w = decode(
+            {"name": "x", "kind": "StandaloneWorkload", "spec": {"api": api}}
+        )
+        with pytest.raises(WorkloadConfigError, match="missing required"):
+            w.validate()
+
+    def test_component_does_not_require_domain(self):
+        w = decode(
+            {
+                "name": "comp",
+                "kind": "ComponentWorkload",
+                "spec": {
+                    "api": {"group": "g", "version": "v1", "kind": "K"},
+                },
+            }
+        )
+        w.validate()
+
+
+class TestConfigParse:
+    def test_parse_standalone_fixture(self):
+        processor = wconfig.parse(
+            os.path.join(FIXTURES, "standalone", "workload.yaml")
+        )
+        assert isinstance(processor.workload, StandaloneWorkload)
+        assert processor.workload.package_name == "bookstore"
+        assert processor.children == []
+
+    def test_parse_collection_fixture_with_components(self):
+        processor = wconfig.parse(
+            os.path.join(FIXTURES, "collection", "workload.yaml")
+        )
+        assert isinstance(processor.workload, WorkloadCollection)
+        assert len(processor.children) == 1
+        assert processor.children[0].workload.name == "cache"
+
+    def test_top_level_component_rejected(self, tmp_path):
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text(
+            "name: comp\nkind: ComponentWorkload\nspec:\n"
+            "  api: {group: g, version: v1, kind: K}\n  resources: []\n"
+        )
+        with pytest.raises(wconfig.ConfigParseError, match="WorkloadCollection"):
+            wconfig.parse(str(cfg))
+
+    def test_duplicate_names_rejected(self, tmp_path):
+        (tmp_path / "comp.yaml").write_text(
+            "name: dup\nkind: ComponentWorkload\nspec:\n"
+            "  api: {group: g, version: v1, kind: K2}\n  resources: []\n"
+        )
+        cfg = tmp_path / "col.yaml"
+        cfg.write_text(
+            "name: dup\nkind: WorkloadCollection\nspec:\n"
+            "  api: {domain: d.io, group: g, version: v1, kind: K}\n"
+            "  componentFiles: [comp.yaml]\n  resources: []\n"
+        )
+        with pytest.raises(wconfig.ConfigParseError, match="unique"):
+            wconfig.parse(str(cfg))
+
+    def test_duplicate_kind_in_group_rejected(self, tmp_path):
+        (tmp_path / "comp.yaml").write_text(
+            "name: a\nkind: ComponentWorkload\nspec:\n"
+            "  api: {group: g, version: v1, kind: K}\n  resources: []\n"
+        )
+        cfg = tmp_path / "col.yaml"
+        cfg.write_text(
+            "name: col\nkind: WorkloadCollection\nspec:\n"
+            "  api: {domain: d.io, group: g, version: v1, kind: K}\n"
+            "  componentFiles: [comp.yaml]\n  resources: []\n"
+        )
+        with pytest.raises(wconfig.ConfigParseError, match="unique"):
+            wconfig.parse(str(cfg))
+
+    def test_missing_dependency_rejected(self, tmp_path):
+        (tmp_path / "comp.yaml").write_text(
+            "name: a\nkind: ComponentWorkload\nspec:\n"
+            "  api: {group: g, version: v1, kind: K}\n"
+            "  dependencies: [ghost]\n  resources: []\n"
+        )
+        cfg = tmp_path / "col.yaml"
+        cfg.write_text(
+            "name: col\nkind: WorkloadCollection\nspec:\n"
+            "  api: {domain: d.io, group: g, version: v1, kind: C}\n"
+            "  componentFiles: [comp.yaml]\n  resources: []\n"
+        )
+        with pytest.raises(wconfig.ConfigParseError, match="missing"):
+            wconfig.parse(str(cfg))
+
+
+class TestAPIFields:
+    def _root(self):
+        return APIFields.new_spec_root()
+
+    def test_nested_path_builds_structs(self):
+        root = self._root()
+        root.add_field(
+            "web.really.long.path.replicas", FieldType.INT, None, 2, True
+        )
+        web = root.children[0]
+        assert web.name == "Web"
+        assert web.type == FieldType.STRUCT
+        assert web.struct_name == "SpecWeb"
+        leaf = web.children[0].children[0].children[0].children[0]
+        assert leaf.name == "Replicas"
+        assert leaf.type == FieldType.INT
+        assert leaf.default == "2"
+
+    def test_conflicting_type_rejected(self):
+        root = self._root()
+        root.add_field("a.b", FieldType.INT, None, 1, True)
+        with pytest.raises(FieldOverwriteError):
+            root.add_field("a.b", FieldType.STRING, None, "x", True)
+
+    def test_leaf_overwrite_by_struct_rejected(self):
+        root = self._root()
+        root.add_field("a", FieldType.INT, None, 1, True)
+        with pytest.raises(FieldOverwriteError):
+            root.add_field("a.b", FieldType.INT, None, 1, True)
+
+    def test_same_field_twice_is_ok(self):
+        root = self._root()
+        root.add_field("app.label", FieldType.STRING, None, "web", True)
+        root.add_field("app.label", FieldType.STRING, None, "web", True)
+        assert len(root.children) == 1
+        assert len(root.children[0].children) == 1
+
+    def test_api_spec_rendering(self):
+        root = self._root()
+        root.add_field(
+            "replicas", FieldType.INT, ["Number of replicas"], 2, True
+        )
+        root.add_field("app.label", FieldType.STRING, None, "web", True)
+        code = root.generate_api_spec("WebStore")
+        assert "type WebStoreSpec struct {" in code
+        assert "// +kubebuilder:default=2" in code
+        assert "// Number of replicas" in code
+        assert "Replicas int `json:\"replicas,omitempty\"`" in code
+        assert "App WebStoreSpecApp `json:\"app,omitempty\"`" in code
+        assert "type WebStoreSpecApp struct {" in code
+        assert 'Label string `json:"label,omitempty"`' in code
+        assert '+kubebuilder:default="web"' in code
+
+    def test_sample_rendering(self):
+        root = self._root()
+        root.add_field("replicas", FieldType.INT, None, 2, True)
+        root.add_field("port", FieldType.INT, None, "8080", False)
+        sample = root.generate_sample_spec(required_only=False)
+        assert "spec:" in sample
+        assert "  replicas: 2" in sample
+        assert "  port: 8080" in sample
+        required = root.generate_sample_spec(required_only=True)
+        assert "port" in required
+        assert "replicas" not in required
+
+
+class TestRBAC:
+    def test_pluralization(self):
+        assert rbac.pluralize("Deployment") == "deployments"
+        assert rbac.pluralize("Ingress") == "ingresses"
+        assert rbac.pluralize("NetworkPolicy") == "networkpolicies"
+        assert rbac.pluralize("ResourceQuota") == "resourcequotas"
+
+    def test_workload_rules(self):
+        class FakeWorkload:
+            api_group = "shop"
+            domain = "example.io"
+            api_kind = "BookStore"
+
+        rules = rbac.for_workloads(FakeWorkload())
+        markers = [r.to_marker() for r in rules]
+        assert (
+            "// +kubebuilder:rbac:groups=shop.example.io,"
+            "resources=bookstores,verbs=get;list;watch;create;update;patch;delete"
+            in markers
+        )
+        assert any("bookstores/status" in m for m in markers)
+
+    def test_resource_rule_core_group(self):
+        rules = rbac.for_resource(
+            {"apiVersion": "v1", "kind": "Service", "metadata": {"name": "s"}}
+        )
+        assert rules.as_list()[0].group == "core"
+        assert rules.as_list()[0].resource == "services"
+
+    def test_role_escalation(self):
+        manifest = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": "r"},
+            "rules": [
+                {
+                    "apiGroups": ["batch"],
+                    "resources": ["jobs"],
+                    "verbs": ["get", "create"],
+                }
+            ],
+        }
+        rules = rbac.for_resource(manifest)
+        as_markers = [r.to_marker() for r in rules]
+        assert any("resources=roles" in m for m in as_markers)
+        assert any(
+            "groups=batch,resources=jobs,verbs=get;create" in m
+            for m in as_markers
+        )
+
+    def test_verb_merge_dedup(self):
+        rules = rbac.Rules()
+        rules.add(rbac.Rule(group="g", resource="r", verbs=["get", "list"]))
+        rules.add(rbac.Rule(group="g", resource="r", verbs=["list", "watch"]))
+        assert len(rules) == 1
+        assert rules.as_list()[0].verbs == ["get", "list", "watch"]
+
+
+class TestManifestNames:
+    def test_source_filename(self):
+        assert source_filename("sub/dir/my-app.yaml") == "sub_dir_my_app.go"
+        assert source_filename(".hidden.yaml") == "hidden.go"
+
+    def test_unique_name(self):
+        obj = {
+            "kind": "Deployment",
+            "metadata": {"name": "book-store.app", "namespace": "pro-d"},
+        }
+        assert unique_name(obj) == "DeploymentProDBookStoreApp"
+
+    def test_unique_name_with_substitution(self):
+        obj = {
+            "kind": "Namespace",
+            "metadata": {"name": "parent.Spec.PlatformNamespace"},
+        }
+        assert unique_name(obj) == "NamespacePlatformNamespace"
+
+
+class TestCreateAPIStandalone:
+    @pytest.fixture(scope="class")
+    def processor(self):
+        p = wconfig.parse(os.path.join(FIXTURES, "standalone", "workload.yaml"))
+        init_workloads(p)
+        create_api(p)
+        return p
+
+    def test_field_markers_collected(self, processor):
+        names = {m.name for m in processor.workload.spec.field_markers}
+        assert names == {
+            "deployment.replicas",
+            "app.label",
+            "deployment.image",
+            "service.name",
+            "service.port",
+            "deployment.debug",
+        }
+
+    def test_api_fields_built(self, processor):
+        spec = processor.workload.spec.api_spec_fields
+        code = spec.generate_api_spec("BookStore")
+        assert "Replicas int" in code
+        assert "Image string" in code
+        assert "+kubebuilder:default=3" in code
+        assert "// The bookstore container image" in code
+
+    def test_child_resources_and_code(self, processor):
+        children = processor.workload.spec.manifests.all_child_resources()
+        kinds = [c.kind for c in children]
+        assert kinds == ["Deployment", "Service", "ConfigMap", "Role"]
+        deploy = children[0]
+        assert "unstructured.Unstructured" in deploy.source_code
+        assert '"replicas": parent.Spec.Deployment.Replicas' in deploy.source_code
+        assert '"app": parent.Spec.App.Label' in deploy.source_code
+
+    def test_replace_marker_generates_sprintf(self, processor):
+        children = processor.workload.spec.manifests.all_child_resources()
+        svc = children[1]
+        assert "fmt.Sprintf(" in svc.source_code
+        assert "parent.Spec.Service.Name" in svc.source_code
+        assert "-svc" in svc.source_code
+
+    def test_marker_comment_rewritten(self, processor):
+        content = processor.workload.spec.manifests[0].content
+        assert "controlled by field: deployment.replicas" in content
+        assert "+operator-builder:field:name=deployment.replicas" not in content
+
+    def test_resource_marker_include_code(self, processor):
+        children = processor.workload.spec.manifests.all_child_resources()
+        configmap = children[2]
+        assert configmap.include_code.startswith(
+            "if parent.Spec.Deployment.Debug != true"
+        )
+
+    def test_rbac_includes_role_escalation(self, processor):
+        markers = [r.to_marker() for r in processor.workload.get_rbac_rules()]
+        # own workload rule
+        assert any("groups=shop.example.io,resources=bookstores" in m for m in markers)
+
+    def test_child_resource_rbac(self, processor):
+        children = processor.workload.spec.manifests.all_child_resources()
+        role = children[3]
+        role_markers = [r.to_marker() for r in role.rbac]
+        assert any("resources=jobs" in m for m in role_markers)
+        assert any("resources=cronjobs" in m for m in role_markers)
+
+
+class TestCreateAPICollection:
+    @pytest.fixture(scope="class")
+    def processor(self):
+        p = wconfig.parse(os.path.join(FIXTURES, "collection", "workload.yaml"))
+        init_workloads(p)
+        create_api(p)
+        return p
+
+    def test_collection_markers_feed_collection_api(self, processor):
+        collection = processor.workload
+        code = collection.spec.api_spec_fields.generate_api_spec("Platform")
+        assert "PlatformNamespace string" in code
+        assert "CacheImage string" in code
+
+    def test_component_gets_collection_ref(self, processor):
+        component = processor.children[0].workload
+        spec = component.spec.api_spec_fields
+        names = [c.name for c in spec.children]
+        assert "Collection" in names
+
+    def test_component_inherits_domain(self, processor):
+        component = processor.children[0].workload
+        assert component.domain == "example.io"
+
+    def test_collection_own_manifest_uses_parent_var(self, processor):
+        # collection's own manifests: collection markers become field markers
+        content = processor.workload.spec.manifests[0].content
+        assert "!!var parent.Spec.PlatformNamespace" in content
+
+    def test_component_manifest_uses_collection_var(self, processor):
+        component = processor.children[0].workload
+        children = component.spec.manifests.all_child_resources()
+        deploy = children[0]
+        assert "collection.Spec.PlatformNamespace" in deploy.source_code
+        assert "collection.Spec.CacheImage" in deploy.source_code
+        assert "parent.Spec.CacheReplicas" in deploy.source_code
