@@ -1,0 +1,7 @@
+"""``python -m operator_forge`` entrypoint."""
+
+import sys
+
+from .cli.main import main
+
+sys.exit(main())

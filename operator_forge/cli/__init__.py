@@ -1,0 +1,1 @@
+"""operator-forge command-line interface (reference: pkg/cli + cmd)."""

@@ -1,0 +1,102 @@
+"""`init-config`: emit sample workload-config YAML.
+
+Reference: pkg/cli/init_config.go:50-170 +
+internal/workload/v1/commands/subcommand/init_config.go:35-152.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from ..workload.kinds import WorkloadAPISpec
+
+SAMPLE_RESOURCE_FILES = ["resources.yaml"]
+
+
+class InitConfigError(Exception):
+    pass
+
+
+def _api_block(spec: WorkloadAPISpec, include_domain: bool = True) -> list[str]:
+    lines = ["  api:"]
+    if include_domain:
+        lines.append(f"    domain: {spec.domain}")
+    lines.extend(
+        [
+            f"    group: {spec.group}",
+            f"    version: {spec.version}",
+            f"    kind: {spec.kind}",
+            f"    clusterScoped: {'true' if spec.cluster_scoped else 'false'}",
+        ]
+    )
+    return lines
+
+
+def sample_config(workload_type: str) -> str:
+    """Build the sample config for ``standalone``, ``collection`` or
+    ``component``."""
+    spec = WorkloadAPISpec.sample()
+    if workload_type == "standalone":
+        lines = [
+            "name: my-app",
+            "kind: StandaloneWorkload",
+            "spec:",
+            *_api_block(spec),
+            "  companionCliRootcmd:",
+            "    name: myappctl",
+            "    description: Manage my-app",
+            "  resources:",
+            *[f"  - {f}" for f in SAMPLE_RESOURCE_FILES],
+        ]
+    elif workload_type == "collection":
+        lines = [
+            "name: my-collection",
+            "kind: WorkloadCollection",
+            "spec:",
+            *_api_block(spec),
+            "  companionCliRootcmd:",
+            "    name: myctl",
+            "    description: Manage my-collection and its components",
+            "  companionCliSubcmd:",
+            "    name: collection",
+            "    description: Manage my-collection",
+            "  componentFiles:",
+            "  - my-component.yaml",
+            "  resources:",
+            *[f"  - {f}" for f in SAMPLE_RESOURCE_FILES],
+        ]
+    elif workload_type == "component":
+        lines = [
+            "name: my-component",
+            "kind: ComponentWorkload",
+            "spec:",
+            *_api_block(spec, include_domain=False),
+            "  companionCliSubcmd:",
+            "    name: mycomponent",
+            "    description: Manage my-component",
+            "  dependencies: []",
+            "  resources:",
+            *[f"  - {f}" for f in SAMPLE_RESOURCE_FILES],
+        ]
+    else:
+        raise InitConfigError(
+            f"unknown workload type {workload_type!r}; expected standalone, "
+            "collection or component"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def write_config(workload_type: str, path: str = "-", force: bool = False) -> None:
+    """Emit the sample to stdout (``-``) or a file
+    (reference init_config.go:64-88 outputFile)."""
+    content = sample_config(workload_type)
+    if path == "-" or not path:
+        sys.stdout.write(content)
+        return
+    if os.path.exists(path) and not force:
+        raise InitConfigError(
+            f"file {path} already exists; use --force to overwrite"
+        )
+    with open(path, "w", encoding="utf-8") as handle:
+        handle.write(content)

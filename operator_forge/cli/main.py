@@ -1,0 +1,265 @@
+"""The operator-forge CLI.
+
+Reference: pkg/cli/init.go:26-58 (command assembly), the workload plugin's
+init/create-api subcommands (internal/plugins/workload/v1/{init,api}.go),
+`init-config` (pkg/cli/init_config.go) and `update license`
+(pkg/cli/{update,license}.go).
+
+Commands:
+- ``operator-forge init --workload-config <path> [--repo <module>]``
+- ``operator-forge create api [--workload-config <path>]``
+- ``operator-forge init-config <standalone|collection|component>``
+- ``operator-forge update license --project-license/--source-header-license``
+- ``operator-forge completion <bash|zsh>``
+- ``operator-forge version``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml as pyyaml
+
+from .. import __version__
+from .. import licensing
+from ..scaffold.api import scaffold_api
+from ..scaffold.context import ProjectConfig
+from ..scaffold.project import scaffold_init
+from ..workload import config as wconfig
+from ..workload.create_api import create_api as run_create_api
+from ..workload.create_api import init_workloads
+from . import init_config as init_config_mod
+
+
+class CLIError(Exception):
+    pass
+
+
+def _load_project(output_dir: str) -> ProjectConfig:
+    project_path = os.path.join(output_dir, "PROJECT")
+    if not os.path.exists(project_path):
+        raise CLIError(
+            "no PROJECT file found; run `operator-forge init` first"
+        )
+    with open(project_path, "r", encoding="utf-8") as handle:
+        data = pyyaml.safe_load(handle.read()) or {}
+    return ProjectConfig.from_dict(data)
+
+
+def _boilerplate_text(output_dir: str) -> str:
+    path = os.path.join(output_dir, "hack", "boilerplate.go.txt")
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as handle:
+            return handle.read()
+    return ""
+
+
+def _default_repo(workload_name: str) -> str:
+    return f"github.com/example/{workload_name}"
+
+
+def cmd_init(args: argparse.Namespace) -> int:
+    processor = wconfig.parse(args.workload_config)
+    init_workloads(processor)
+    workload = processor.workload
+
+    repo = args.repo or _default_repo(workload.name)
+    config = ProjectConfig(
+        repo=repo,
+        domain=workload.domain,
+        workload_config_path=os.path.relpath(
+            args.workload_config, args.output_dir
+        ),
+        cli_root_command_name=workload.companion_root_cmd.name,
+        cli_root_command_description=workload.companion_root_cmd.description,
+    )
+
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    if args.source_header_license:
+        licensing.update_source_header(
+            args.output_dir, args.source_header_license
+        )
+    if args.project_license:
+        licensing.update_project_license(args.output_dir, args.project_license)
+
+    names = [w.name for w in processor.get_workloads()]
+    scaffold = scaffold_init(
+        args.output_dir,
+        config,
+        names,
+        boilerplate_text=_boilerplate_text(args.output_dir),
+    )
+    print(f"project scaffolded at {args.output_dir} "
+          f"({len(scaffold.written)} files)")
+    return 0
+
+
+def cmd_create_api(args: argparse.Namespace) -> int:
+    config = _load_project(args.output_dir)
+    workload_config = args.workload_config or os.path.join(
+        args.output_dir, config.workload_config_path
+    )
+    if not workload_config or not os.path.exists(workload_config):
+        raise CLIError(
+            f"workload config not found at {workload_config!r}; pass "
+            "--workload-config"
+        )
+
+    processor = wconfig.parse(workload_config)
+    init_workloads(processor)
+    run_create_api(processor)
+
+    scaffold = scaffold_api(
+        args.output_dir,
+        processor,
+        config,
+        boilerplate_text=_boilerplate_text(args.output_dir),
+    )
+    print(
+        f"api scaffolded at {args.output_dir} "
+        f"({len(scaffold.written)} files, {len(scaffold.skipped)} preserved)"
+    )
+    return 0
+
+
+def cmd_init_config(args: argparse.Namespace) -> int:
+    init_config_mod.write_config(args.workload_type, args.path, args.force)
+    return 0
+
+
+def cmd_update_license(args: argparse.Namespace) -> int:
+    if not args.project_license and not args.source_header_license:
+        raise CLIError(
+            "provide --project-license and/or --source-header-license"
+        )
+    if args.project_license:
+        licensing.update_project_license(args.output_dir, args.project_license)
+    if args.source_header_license:
+        licensing.update_source_header(
+            args.output_dir, args.source_header_license
+        )
+        rewritten = licensing.update_existing_source_headers(
+            args.output_dir, args.source_header_license
+        )
+        print(f"updated headers in {len(rewritten)} files")
+    return 0
+
+
+_BASH_COMPLETION = """# bash completion for operator-forge
+_operator_forge() {
+    local cur prev
+    cur="${COMP_WORDS[COMP_CWORD]}"
+    prev="${COMP_WORDS[COMP_CWORD-1]}"
+    case "$prev" in
+        operator-forge)
+            COMPREPLY=($(compgen -W "init create init-config update completion version" -- "$cur"));;
+        create)
+            COMPREPLY=($(compgen -W "api" -- "$cur"));;
+        init-config)
+            COMPREPLY=($(compgen -W "standalone collection component" -- "$cur"));;
+        update)
+            COMPREPLY=($(compgen -W "license" -- "$cur"));;
+        completion)
+            COMPREPLY=($(compgen -W "bash zsh" -- "$cur"));;
+        *)
+            COMPREPLY=($(compgen -f -- "$cur"));;
+    esac
+}
+complete -F _operator_forge operator-forge
+"""
+
+_ZSH_COMPLETION = """#compdef operator-forge
+_arguments '1: :(init create init-config update completion version)' '*: :_files'
+"""
+
+
+def cmd_completion(args: argparse.Namespace) -> int:
+    if args.shell == "bash":
+        sys.stdout.write(_BASH_COMPLETION)
+    elif args.shell == "zsh":
+        sys.stdout.write(_ZSH_COMPLETION)
+    else:
+        raise CLIError(f"unsupported shell {args.shell!r}")
+    return 0
+
+
+def cmd_version(_: argparse.Namespace) -> int:
+    print(f"operator-forge version {__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="operator-forge",
+        description=(
+            "Generate complete Kubernetes operator projects from workload "
+            "config YAML and marker-annotated manifests."
+        ),
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_init = sub.add_parser("init", help="scaffold a new operator project")
+    p_init.add_argument("--workload-config", required=True)
+    p_init.add_argument("--repo", default="", help="go module path")
+    p_init.add_argument("--output-dir", default=".")
+    p_init.add_argument("--project-license", default="")
+    p_init.add_argument("--source-header-license", default="")
+    p_init.set_defaults(func=cmd_init)
+
+    p_create = sub.add_parser("create", help="create resources in the project")
+    create_sub = p_create.add_subparsers(dest="create_command", required=True)
+    p_api = create_sub.add_parser(
+        "api", help="scaffold APIs, controllers and companion CLI"
+    )
+    p_api.add_argument("--workload-config", default="")
+    p_api.add_argument("--output-dir", default=".")
+    p_api.set_defaults(func=cmd_create_api)
+
+    p_cfg = sub.add_parser(
+        "init-config", help="emit a sample workload config"
+    )
+    p_cfg.add_argument(
+        "workload_type", choices=["standalone", "collection", "component"]
+    )
+    p_cfg.add_argument("--path", default="-")
+    p_cfg.add_argument("--force", action="store_true")
+    p_cfg.set_defaults(func=cmd_init_config)
+
+    p_update = sub.add_parser("update", help="update project attributes")
+    update_sub = p_update.add_subparsers(dest="update_command", required=True)
+    p_license = update_sub.add_parser("license", help="update license files")
+    p_license.add_argument("--project-license", default="")
+    p_license.add_argument("--source-header-license", default="")
+    p_license.add_argument("--output-dir", default=".")
+    p_license.set_defaults(func=cmd_update_license)
+
+    p_completion = sub.add_parser("completion", help="shell completion")
+    p_completion.add_argument("shell", choices=["bash", "zsh"])
+    p_completion.set_defaults(func=cmd_completion)
+
+    p_version = sub.add_parser("version", help="print the version")
+    p_version.set_defaults(func=cmd_version)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        return args.func(args)
+    except (
+        CLIError,
+        wconfig.ConfigParseError,
+        licensing.LicenseError,
+        init_config_mod.InitConfigError,
+    ) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""License management for generated projects.
+
+Reference: internal/license/license.go.
+- :func:`update_project_license` writes the project ``LICENSE`` file;
+- :func:`update_source_header` writes ``hack/boilerplate.go.txt`` with the
+  header applied to newly scaffolded ``.go`` files;
+- :func:`update_existing_source_headers` rewrites the header of every
+  existing ``.go`` file by replacing everything above the ``package``
+  declaration (reference license.go:71-96);
+- license source may be a local path or an http(s) URL
+  (reference license.go:98-125).
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.request
+
+
+class LicenseError(Exception):
+    pass
+
+
+def _read_source(path_or_url: str) -> str:
+    if path_or_url.startswith(("http://", "https://")):
+        try:
+            with urllib.request.urlopen(path_or_url, timeout=30) as response:
+                return response.read().decode("utf-8")
+        except Exception as exc:
+            raise LicenseError(
+                f"unable to fetch license from {path_or_url}: {exc}"
+            ) from exc
+    try:
+        with open(path_or_url, "r", encoding="utf-8") as handle:
+            return handle.read()
+    except OSError as exc:
+        raise LicenseError(
+            f"unable to read license file {path_or_url}: {exc}"
+        ) from exc
+
+
+def update_project_license(project_dir: str, source: str) -> str:
+    """Write LICENSE from a local path or URL.  Returns the target path."""
+    content = _read_source(source)
+    target = os.path.join(project_dir, "LICENSE")
+    with open(target, "w", encoding="utf-8") as handle:
+        handle.write(content)
+    return target
+
+
+def boilerplate_from_source(source: str) -> str:
+    """Build a Go comment-block boilerplate from raw license-header text."""
+    content = _read_source(source).rstrip("\n")
+    if content.lstrip().startswith(("/*", "//")):
+        return content + "\n"
+    return "/*\n" + content + "\n*/\n"
+
+
+def update_source_header(project_dir: str, source: str) -> str:
+    """Write hack/boilerplate.go.txt from a local path or URL."""
+    content = boilerplate_from_source(source)
+    target = os.path.join(project_dir, "hack", "boilerplate.go.txt")
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    with open(target, "w", encoding="utf-8") as handle:
+        handle.write(content)
+    return target
+
+
+def update_existing_source_headers(project_dir: str, source: str) -> list[str]:
+    """Replace the header (everything above ``package``) of every tracked
+    ``.go`` file with the new boilerplate.  Returns the rewritten paths."""
+    boilerplate = boilerplate_from_source(source)
+    rewritten = []
+    for root, dirs, files in os.walk(project_dir):
+        dirs[:] = [d for d in dirs if d not in (".git", "bin", "vendor")]
+        for name in files:
+            if not name.endswith(".go"):
+                continue
+            path = os.path.join(root, name)
+            with open(path, "r", encoding="utf-8") as handle:
+                lines = handle.read().split("\n")
+            package_idx = None
+            for i, line in enumerate(lines):
+                if line.startswith("package "):
+                    package_idx = i
+                    break
+            if package_idx is None:
+                continue
+            body = "\n".join(lines[package_idx:])
+            with open(path, "w", encoding="utf-8") as handle:
+                handle.write(boilerplate + "\n" + body)
+            rewritten.append(path)
+    return rewritten

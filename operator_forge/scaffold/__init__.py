@@ -1,0 +1,22 @@
+"""Scaffolding engine and generated-project templates.
+
+Reference: internal/plugins/workload/v1/scaffolds (+ kubebuilder's
+``machinery`` package which the reference builds on).  This package provides:
+
+- :mod:`machinery`: file specs, if-exists policies (overwrite / skip /
+  error), marker-based fragment insertion for idempotent re-scaffolding;
+- :mod:`context`: the scaffold-time view of a workload (naming, paths,
+  GVK, imports);
+- :mod:`project`: the ``init`` scaffolder (project skeleton);
+- :mod:`api`: the ``create api`` scaffolder (APIs, controllers, resources,
+  companion CLI, samples, tests);
+- :mod:`templates/`: the generated-code bodies.
+"""
+
+from .machinery import (  # noqa: F401
+    FileSpec,
+    Fragment,
+    IfExists,
+    Scaffold,
+    ScaffoldError,
+)

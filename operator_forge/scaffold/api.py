@@ -1,0 +1,122 @@
+"""The `create api` scaffolder: APIs, controllers, resources, hooks,
+config, samples, and main.go wiring for every workload in a config tree.
+
+Reference: internal/plugins/workload/v1/scaffolds/api.go:64-282
+(scaffoldWorkload recursing over collection components).
+"""
+
+from __future__ import annotations
+
+from ..workload.config import Processor
+from .context import ProjectConfig, WorkloadView, views_for
+from .machinery import FileSpec, Fragment, Scaffold
+from .templates import api as api_tpl
+from .templates import controller as controller_tpl
+from .templates import kustomize as kustomize_tpl
+from .templates import resources as resources_tpl
+
+
+def api_files(views: list[WorkloadView]) -> list[FileSpec]:
+    specs: list[FileSpec] = []
+    groups_done: set[str] = set()
+    group_versions_done: set[tuple[str, str]] = set()
+
+    for view in views:
+        if (view.group, view.version) not in group_versions_done:
+            group_versions_done.add((view.group, view.version))
+            specs.append(api_tpl.group_version_info(view))
+
+        specs.append(api_tpl.types_file(view))
+        specs.append(api_tpl.deepcopy_file(view))
+        specs.extend(api_tpl.kind_registry_files(view))
+
+        specs.append(resources_tpl.resources_file(view))
+        specs.extend(resources_tpl.definition_files(view))
+        specs.append(resources_tpl.mutate_hook(view))
+        specs.append(resources_tpl.dependencies_hook(view))
+
+        specs.append(controller_tpl.controller_file(view))
+        if view.group not in groups_done:
+            groups_done.add(view.group)
+            specs.append(
+                controller_tpl.suite_test_file(
+                    view, [v.kind for v in views if v.group == view.group]
+                )
+            )
+
+        specs.append(api_tpl.crd_yaml(view))
+        specs.append(api_tpl.sample_file(view))
+
+    specs.append(kustomize_tpl.crd_kustomization(views))
+    specs.append(kustomize_tpl.samples_kustomization(views))
+    specs.append(kustomize_tpl.manager_cluster_role(views))
+    return specs
+
+
+def main_go_fragments(views: list[WorkloadView]) -> list[Fragment]:
+    """Wire each workload's scheme and reconciler into main.go
+    (reference MainUpdater, scaffolds/api.go:149-156)."""
+    fragments: list[Fragment] = []
+    seen_apis: set[str] = set()
+    seen_controllers: set[str] = set()
+
+    for view in views:
+        api_alias = view.api_import_alias
+        if api_alias not in seen_apis:
+            seen_apis.add(api_alias)
+            fragments.append(
+                Fragment(
+                    path="main.go",
+                    marker="imports",
+                    code=f'{api_alias} "{view.api_types_import}"',
+                )
+            )
+            fragments.append(
+                Fragment(
+                    path="main.go",
+                    marker="scheme",
+                    code=f"utilruntime.Must({api_alias}.AddToScheme(scheme))",
+                )
+            )
+
+        controllers_alias = f"{view.group}controllers"
+        if controllers_alias not in seen_controllers:
+            seen_controllers.add(controllers_alias)
+            fragments.append(
+                Fragment(
+                    path="main.go",
+                    marker="imports",
+                    code=(
+                        f'{controllers_alias} '
+                        f'"{view.config.repo}/controllers/{view.group}"'
+                    ),
+                )
+            )
+
+        fragments.append(
+            Fragment(
+                path="main.go",
+                marker="reconcilers",
+                code=(
+                    f"if err := {controllers_alias}.New{view.kind}Reconciler"
+                    f"(mgr).SetupWithManager(mgr); err != nil {{\n"
+                    f'\tsetupLog.Error(err, "unable to create controller", '
+                    f'"controller", "{view.kind}")\n'
+                    f"\tos.Exit(1)\n"
+                    f"}}\n"
+                ),
+            )
+        )
+    return fragments
+
+
+def scaffold_api(
+    output_dir: str,
+    processor: Processor,
+    config: ProjectConfig,
+    boilerplate_text: str = "",
+) -> Scaffold:
+    views = views_for(processor.get_workloads(), config)
+    scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
+    scaffold.execute(api_files(views), main_go_fragments(views))
+    return scaffold

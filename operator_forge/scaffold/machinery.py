@@ -1,0 +1,144 @@
+"""Scaffolding machinery: file writing with if-exists policies and
+marker-based fragment insertion.
+
+Equivalent of the kubebuilder ``machinery`` package the reference relies on
+(Template execution with IfExistsAction, and Inserter templates targeting
+``+kubebuilder:scaffold:*``-style markers; see SURVEY.md §2.2).  Markers in
+generated files look like::
+
+    // +operator-builder:scaffold:imports
+
+Fragments are inserted immediately above their marker, each exactly once
+(re-scaffolding is idempotent).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+MARKER_PREFIX = "+operator-builder:scaffold:"
+
+
+class ScaffoldError(Exception):
+    pass
+
+
+class IfExists(enum.Enum):
+    """What to do when the target file already exists.
+
+    Mirrors kubebuilder machinery's IfExistsAction: user-owned hook files are
+    SKIP so regeneration never clobbers user edits (e.g. the reference's
+    mutate/dependencies templates, templates/int/mutate/component.go:34)."""
+
+    OVERWRITE = "overwrite"
+    SKIP = "skip"
+    ERROR = "error"
+
+
+@dataclass
+class FileSpec:
+    path: str  # relative to the project root
+    content: str
+    if_exists: IfExists = IfExists.OVERWRITE
+    # .go files get the boilerplate header prepended unless they provide one
+    add_boilerplate: bool = True
+
+
+@dataclass
+class Fragment:
+    """A code fragment inserted at a named marker inside an existing file."""
+
+    path: str
+    marker: str  # marker name, e.g. "imports"
+    code: str
+
+
+def marker_line(marker: str, comment_prefix: str = "//") -> str:
+    return f"{comment_prefix} {MARKER_PREFIX}{marker}"
+
+
+@dataclass
+class Scaffold:
+    """Executes file specs + fragments into an output directory."""
+
+    output_dir: str
+    boilerplate: str = ""
+    written: list[str] = dc_field(default_factory=list)
+    skipped: list[str] = dc_field(default_factory=list)
+
+    def execute(
+        self,
+        specs: list[FileSpec],
+        fragments: Optional[list[Fragment]] = None,
+    ) -> None:
+        for spec in specs:
+            self._write(spec)
+        for fragment in fragments or []:
+            self._insert(fragment)
+
+    # -- files ----------------------------------------------------------
+
+    def _write(self, spec: FileSpec) -> None:
+        target = os.path.join(self.output_dir, spec.path)
+        if os.path.exists(target):
+            if spec.if_exists == IfExists.SKIP:
+                self.skipped.append(spec.path)
+                return
+            if spec.if_exists == IfExists.ERROR:
+                raise ScaffoldError(f"file already exists: {spec.path}")
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        content = spec.content
+        if (
+            spec.add_boilerplate
+            and self.boilerplate
+            and spec.path.endswith(".go")
+            and not content.startswith(self.boilerplate)
+        ):
+            content = self.boilerplate.rstrip("\n") + "\n\n" + content
+        if not content.endswith("\n"):
+            content += "\n"
+        with open(target, "w", encoding="utf-8") as handle:
+            handle.write(content)
+        self.written.append(spec.path)
+
+    # -- fragments ------------------------------------------------------
+
+    def _insert(self, fragment: Fragment) -> None:
+        target = os.path.join(self.output_dir, fragment.path)
+        if not os.path.exists(target):
+            raise ScaffoldError(
+                f"cannot insert at marker {fragment.marker!r}: file "
+                f"{fragment.path} does not exist"
+            )
+        with open(target, "r", encoding="utf-8") as handle:
+            content = handle.read()
+
+        needle = MARKER_PREFIX + fragment.marker
+        lines = content.split("\n")
+        marker_idx = None
+        for i, line in enumerate(lines):
+            if needle in line and line.lstrip().startswith(("//", "#")):
+                marker_idx = i
+                break
+        if marker_idx is None:
+            raise ScaffoldError(
+                f"marker {fragment.marker!r} not found in {fragment.path}"
+            )
+
+        code = fragment.code.rstrip("\n")
+        # idempotency: skip when every fragment line is already present
+        fragment_lines = [l for l in code.split("\n") if l.strip()]
+        if fragment_lines and all(
+            any(l.strip() == existing.strip() for existing in lines)
+            for l in fragment_lines
+        ):
+            return
+
+        indent = lines[marker_idx][: len(lines[marker_idx]) - len(lines[marker_idx].lstrip())]
+        inserted = [indent + l if l.strip() else l for l in code.split("\n")]
+        lines[marker_idx:marker_idx] = inserted
+        with open(target, "w", encoding="utf-8") as handle:
+            handle.write("\n".join(lines))

@@ -1,0 +1,41 @@
+"""The `init` scaffolder: project skeleton.
+
+Reference: internal/plugins/workload/v1/scaffolds/init.go:33-90 (plus the
+kubebuilder golang/kustomize plugin output the reference's plugin bundle
+produces before it runs).
+"""
+
+from __future__ import annotations
+
+from .context import ProjectConfig
+from .machinery import FileSpec, Scaffold
+from .templates import kustomize, orchestrate, project
+
+
+def init_files(
+    config: ProjectConfig, workload_names: list[str]
+) -> list[FileSpec]:
+    specs = [
+        project.project_file(config),
+        project.boilerplate(),
+        project.gitignore(),
+        project.go_mod(config),
+        project.main_go(config),
+        project.dockerfile(),
+        project.makefile(config),
+        project.readme(config, workload_names),
+    ]
+    specs.extend(orchestrate.orchestrate_files(config.repo))
+    specs.extend(kustomize.default_tree(config))
+    return specs
+
+
+def scaffold_init(
+    output_dir: str,
+    config: ProjectConfig,
+    workload_names: list[str],
+    boilerplate_text: str = "",
+) -> Scaffold:
+    scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
+    scaffold.execute(init_files(config, workload_names))
+    return scaffold

@@ -1,0 +1,12 @@
+"""Generated-code template bodies.
+
+Equivalent of the reference's
+internal/plugins/workload/v1/scaffolds/templates/** tree (SURVEY.md §2.2),
+organized as Python modules that build Go/YAML/Make text from a
+:class:`~operator_forge.scaffold.context.WorkloadView`.
+
+A deliberate design difference from the reference: generated projects embed
+their reconciliation runtime (``pkg/orchestrate``) instead of depending on
+the external nukleros/operator-builder-tools module, so generated operators
+are self-contained.
+"""

@@ -1,0 +1,574 @@
+"""API templates: group/version info, kind types, kind registry files,
+deepcopy implementations, CRD YAML, and CR samples.
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/api/{types,group,
+kind}.go and config/samples/crd_sample.go.  Two deliberate additions over the
+reference: deepcopy code and CRD YAML are generated directly (the reference
+defers both to controller-gen via ``make manifests``/``make generate``), so a
+generated project is complete before any tooling runs.
+"""
+
+from __future__ import annotations
+
+from ...utils import to_file_name
+from ...workload.api_fields import APIFields
+from ...workload.fieldmarkers import FieldType
+from ..context import WorkloadView
+from ..machinery import FileSpec
+
+
+def group_version_info(view: WorkloadView) -> FileSpec:
+    content = f'''// Package {view.version} contains API Schema definitions for the {view.group}
+// {view.version} API group.
+// +kubebuilder:object:generate=true
+// +groupName={view.full_group}
+package {view.version}
+
+import (
+\t"k8s.io/apimachinery/pkg/runtime/schema"
+\t"sigs.k8s.io/controller-runtime/pkg/scheme"
+)
+
+var (
+\t// GroupVersion is group version used to register these objects.
+\tGroupVersion = schema.GroupVersion{{Group: "{view.full_group}", Version: "{view.version}"}}
+
+\t// SchemeBuilder is used to add go types to the GroupVersionKind scheme.
+\tSchemeBuilder = &scheme.Builder{{GroupVersion: GroupVersion}}
+
+\t// AddToScheme adds the types in this group-version to the given scheme.
+\tAddToScheme = SchemeBuilder.AddToScheme
+)
+'''
+    return FileSpec(
+        path=f"{view.api_types_dir}/groupversion_info.go", content=content
+    )
+
+
+def _dependency_imports(view: WorkloadView) -> list[str]:
+    imports = []
+    seen = set()
+    for dep in view.workload.get_dependencies():
+        if dep.api_group == view.group:
+            continue
+        alias = f"{dep.api_group}{dep.api_version}"
+        if alias in seen:
+            continue
+        seen.add(alias)
+        imports.append(
+            f'\t{alias} "{view.config.repo}/apis/{dep.api_group}/{dep.api_version}"'
+        )
+    return imports
+
+
+def _dependency_entries(view: WorkloadView) -> list[str]:
+    entries = []
+    for dep in view.workload.get_dependencies():
+        if dep.api_group == view.group:
+            entries.append(f"\t\t&{dep.api_kind}{{}},")
+        else:
+            entries.append(
+                f"\t\t&{dep.api_group}{dep.api_version}.{dep.api_kind}{{}},"
+            )
+    return entries
+
+
+def types_file(view: WorkloadView) -> FileSpec:
+    """The <kind>_types.go file (reference templates/api/types.go:50-196)."""
+    kind = view.kind
+    spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
+    spec_code = spec_fields.generate_api_spec(kind)
+
+    dep_imports = "\n".join(_dependency_imports(view))
+    if dep_imports:
+        dep_imports = "\n" + dep_imports
+    dep_entries = "\n".join(_dependency_entries(view))
+    if dep_entries:
+        dep_entries = "\n" + dep_entries + "\n\t"
+
+    cluster_scope_marker = (
+        "\n// +kubebuilder:resource:scope=Cluster"
+        if view.workload.is_cluster_scoped()
+        else ""
+    )
+
+    content = f'''package {view.version}
+
+import (
+\t"errors"
+
+\tmetav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+\t"k8s.io/apimachinery/pkg/runtime/schema"
+
+\t"{view.config.repo}/pkg/orchestrate"{dep_imports}
+)
+
+// ErrUnableToConvert{kind} is returned when an object cannot be converted
+// to a *{kind}.
+var ErrUnableToConvert{kind} = errors.New("unable to convert to {kind}")
+
+// EDIT THIS FILE!  THIS IS SCAFFOLDING FOR YOU TO OWN!
+// NOTE: json tags are required.  Any new fields you add must have json tags
+// for the fields to be serialized.
+{spec_code}
+// {kind}Status defines the observed state of {kind}.
+type {kind}Status struct {{
+\t// INSERT ADDITIONAL STATUS FIELD - define observed state of cluster
+\t// Important: Run "make" to regenerate code after modifying this file
+
+\tCreated               bool                                   `json:"created,omitempty"`
+\tDependenciesSatisfied bool                                   `json:"dependenciesSatisfied,omitempty"`
+\tConditions            []*orchestrate.PhaseCondition          `json:"conditions,omitempty"`
+\tResources             []*orchestrate.ChildResourceCondition  `json:"resources,omitempty"`
+}}
+
+// +kubebuilder:object:root=true
+// +kubebuilder:subresource:status{cluster_scope_marker}
+
+// {kind} is the Schema for the {view.plural} API.
+type {kind} struct {{
+\tmetav1.TypeMeta   `json:",inline"`
+\tmetav1.ObjectMeta `json:"metadata,omitempty"`
+\tSpec   {kind}Spec   `json:"spec,omitempty"`
+\tStatus {kind}Status `json:"status,omitempty"`
+}}
+
+// +kubebuilder:object:root=true
+
+// {kind}List contains a list of {kind}.
+type {kind}List struct {{
+\tmetav1.TypeMeta `json:",inline"`
+\tmetav1.ListMeta `json:"metadata,omitempty"`
+\tItems           []{kind} `json:"items"`
+}}
+
+//
+// orchestrate.Workload interface methods
+//
+
+// GetCreatedStatus returns whether the workload has been reconciled.
+func (workload *{kind}) GetCreatedStatus() bool {{
+\treturn workload.Status.Created
+}}
+
+// SetCreatedStatus records whether the workload has been reconciled.
+func (workload *{kind}) SetCreatedStatus(created bool) {{
+\tworkload.Status.Created = created
+}}
+
+// GetDependencyStatus returns the dependency satisfaction status.
+func (workload *{kind}) GetDependencyStatus() bool {{
+\treturn workload.Status.DependenciesSatisfied
+}}
+
+// SetDependencyStatus records the dependency satisfaction status.
+func (workload *{kind}) SetDependencyStatus(satisfied bool) {{
+\tworkload.Status.DependenciesSatisfied = satisfied
+}}
+
+// GetPhaseConditions returns the phase conditions of the workload.
+func (workload *{kind}) GetPhaseConditions() []*orchestrate.PhaseCondition {{
+\treturn workload.Status.Conditions
+}}
+
+// SetPhaseCondition records a phase condition, replacing any prior condition
+// for the same phase.
+func (workload *{kind}) SetPhaseCondition(condition *orchestrate.PhaseCondition) {{
+\tfor i, current := range workload.Status.Conditions {{
+\t\tif current.Phase == condition.Phase {{
+\t\t\tworkload.Status.Conditions[i] = condition
+
+\t\t\treturn
+\t\t}}
+\t}}
+
+\tworkload.Status.Conditions = append(workload.Status.Conditions, condition)
+}}
+
+// GetChildResourceConditions returns the child resource conditions.
+func (workload *{kind}) GetChildResourceConditions() []*orchestrate.ChildResourceCondition {{
+\treturn workload.Status.Resources
+}}
+
+// SetChildResourceCondition records a child resource condition, replacing any
+// prior condition for the same resource.
+func (workload *{kind}) SetChildResourceCondition(resource *orchestrate.ChildResourceCondition) {{
+\tfor i, current := range workload.Status.Resources {{
+\t\tif current.Group == resource.Group && current.Version == resource.Version &&
+\t\t\tcurrent.Kind == resource.Kind &&
+\t\t\tcurrent.Name == resource.Name && current.Namespace == resource.Namespace {{
+\t\t\tworkload.Status.Resources[i] = resource
+
+\t\t\treturn
+\t\t}}
+\t}}
+
+\tworkload.Status.Resources = append(workload.Status.Resources, resource)
+}}
+
+// GetDependencyWorkloads returns the workloads this workload depends upon.
+func (*{kind}) GetDependencyWorkloads() []orchestrate.Workload {{
+\treturn []orchestrate.Workload{{{dep_entries}}}
+}}
+
+// GetWorkloadGVK returns the GVK for this workload type.
+func (*{kind}) GetWorkloadGVK() schema.GroupVersionKind {{
+\treturn GroupVersion.WithKind("{kind}")
+}}
+
+func init() {{
+\tSchemeBuilder.Register(&{kind}{{}}, &{kind}List{{}})
+}}
+'''
+    return FileSpec(path=view.types_file, content=content)
+
+
+def _struct_names(kind: str, fields: APIFields) -> list[str]:
+    """Collect the nested struct type names of a spec tree."""
+    names = []
+
+    def walk(node: APIFields):
+        for child in node.children:
+            if child.type == FieldType.STRUCT:
+                names.append(kind + child.struct_name)
+                walk(child)
+
+    walk(fields)
+    return names
+
+
+def deepcopy_file(view: WorkloadView) -> FileSpec:
+    """Generated deepcopy implementations for the kind and its nested spec
+    structs (the reference defers this to controller-gen)."""
+    kind = view.kind
+    spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
+    structs = _struct_names(kind, spec_fields)
+
+    parts = [
+        f'''//go:build !ignore_autogenerated
+
+// Code generated by operator-forge. DO NOT EDIT.
+
+package {view.version}
+
+import (
+\truntime "k8s.io/apimachinery/pkg/runtime"
+
+\t"{view.config.repo}/pkg/orchestrate"
+)
+'''
+    ]
+
+    # nested spec structs hold only value types, so a shallow copy is a deep
+    # copy
+    for struct in [f"{kind}Spec"] + structs:
+        parts.append(f'''
+// DeepCopyInto copies the receiver into out.
+func (in *{struct}) DeepCopyInto(out *{struct}) {{
+\t*out = *in
+}}
+
+// DeepCopy returns a deep copy of the {struct}.
+func (in *{struct}) DeepCopy() *{struct} {{
+\tif in == nil {{
+\t\treturn nil
+\t}}
+
+\tout := new({struct})
+\tin.DeepCopyInto(out)
+
+\treturn out
+}}
+''')
+
+    parts.append(f'''
+// DeepCopyInto copies the receiver into out.
+func (in *{kind}Status) DeepCopyInto(out *{kind}Status) {{
+\t*out = *in
+
+\tif in.Conditions != nil {{
+\t\tout.Conditions = make([]*orchestrate.PhaseCondition, len(in.Conditions))
+\t\tfor i := range in.Conditions {{
+\t\t\tout.Conditions[i] = in.Conditions[i].DeepCopy()
+\t\t}}
+\t}}
+
+\tif in.Resources != nil {{
+\t\tout.Resources = make([]*orchestrate.ChildResourceCondition, len(in.Resources))
+\t\tfor i := range in.Resources {{
+\t\t\tout.Resources[i] = in.Resources[i].DeepCopy()
+\t\t}}
+\t}}
+}}
+
+// DeepCopy returns a deep copy of the {kind}Status.
+func (in *{kind}Status) DeepCopy() *{kind}Status {{
+\tif in == nil {{
+\t\treturn nil
+\t}}
+
+\tout := new({kind}Status)
+\tin.DeepCopyInto(out)
+
+\treturn out
+}}
+
+// DeepCopyInto copies the receiver into out.
+func (in *{kind}) DeepCopyInto(out *{kind}) {{
+\t*out = *in
+\tout.TypeMeta = in.TypeMeta
+\tin.ObjectMeta.DeepCopyInto(&out.ObjectMeta)
+\tout.Spec = in.Spec
+\tin.Status.DeepCopyInto(&out.Status)
+}}
+
+// DeepCopy returns a deep copy of the {kind}.
+func (in *{kind}) DeepCopy() *{kind} {{
+\tif in == nil {{
+\t\treturn nil
+\t}}
+
+\tout := new({kind})
+\tin.DeepCopyInto(out)
+
+\treturn out
+}}
+
+// DeepCopyObject returns a deep copy as a runtime.Object.
+func (in *{kind}) DeepCopyObject() runtime.Object {{
+\treturn in.DeepCopy()
+}}
+
+// DeepCopyInto copies the receiver into out.
+func (in *{kind}List) DeepCopyInto(out *{kind}List) {{
+\t*out = *in
+\tout.TypeMeta = in.TypeMeta
+\tin.ListMeta.DeepCopyInto(&out.ListMeta)
+
+\tif in.Items != nil {{
+\t\tout.Items = make([]{kind}, len(in.Items))
+\t\tfor i := range in.Items {{
+\t\t\tin.Items[i].DeepCopyInto(&out.Items[i])
+\t\t}}
+\t}}
+}}
+
+// DeepCopy returns a deep copy of the {kind}List.
+func (in *{kind}List) DeepCopy() *{kind}List {{
+\tif in == nil {{
+\t\treturn nil
+\t}}
+
+\tout := new({kind}List)
+\tin.DeepCopyInto(out)
+
+\treturn out
+}}
+
+// DeepCopyObject returns a deep copy as a runtime.Object.
+func (in *{kind}List) DeepCopyObject() runtime.Object {{
+\treturn in.DeepCopy()
+}}
+''')
+    content = "".join(parts)
+    return FileSpec(
+        path=f"{view.api_types_dir}/zz_generated_deepcopy_"
+        f"{to_file_name(view.kind_lower)}.go",
+        content=content,
+    )
+
+
+def kind_registry_files(view: WorkloadView) -> list[FileSpec]:
+    """apis/<group>/<kind>.go (+ _latest.go): version registry for a kind
+    (reference templates/api/kind.go:34-188)."""
+    kind = view.kind
+    alias = view.api_import_alias
+    kind_file = to_file_name(view.kind_lower)
+    registry = f'''package {view.group}
+
+import (
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+
+\t{alias} "{view.api_types_import}"
+\t// +operator-builder:scaffold:{view.kind_lower}:imports
+)
+
+// {kind}Objects returns one empty object for every known API version of
+// {kind}, newest first.  New versions of this kind are registered here as
+// they are scaffolded.
+func {kind}Objects() []client.Object {{
+\treturn []client.Object{{
+\t\t&{alias}.{kind}{{}},
+\t\t// +operator-builder:scaffold:{view.kind_lower}:versions
+\t}}
+}}
+'''
+    latest = f'''package {view.group}
+
+import (
+\t{alias} "{view.api_types_import}"
+)
+
+// {kind}Latest aliases the newest API version of {kind}.
+type {kind}Latest = {alias}.{kind}
+
+// {kind}LatestVersion is the newest API version of {kind}.
+const {kind}LatestVersion = "{view.version}"
+'''
+    return [
+        FileSpec(path=f"apis/{view.group}/{kind_file}.go", content=registry),
+        FileSpec(
+            path=f"apis/{view.group}/{kind_file}_latest.go", content=latest
+        ),
+    ]
+
+
+# -- CRD + sample YAML ----------------------------------------------------
+
+
+def _schema_for(field: APIFields) -> dict:
+    if field.type == FieldType.STRUCT:
+        props = {
+            child.manifest_name: _schema_for(child) for child in field.children
+        }
+        return {"type": "object", "properties": props}
+    type_map = {
+        FieldType.STRING: "string",
+        FieldType.INT: "integer",
+        FieldType.BOOL: "boolean",
+    }
+    schema: dict = {"type": type_map.get(field.type, "string")}
+    if field.default_value is not None:
+        schema["default"] = field.default_value
+    if field.comments:
+        schema["description"] = " ".join(field.comments)
+    return schema
+
+
+def _condition_schema() -> dict:
+    return {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {
+                "phase": {"type": "string"},
+                "state": {"type": "string"},
+                "message": {"type": "string"},
+            },
+            "required": ["phase", "state"],
+        },
+    }
+
+
+def _resource_condition_schema() -> dict:
+    return {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {
+                "group": {"type": "string"},
+                "version": {"type": "string"},
+                "kind": {"type": "string"},
+                "name": {"type": "string"},
+                "namespace": {"type": "string"},
+                "created": {"type": "boolean"},
+                "message": {"type": "string"},
+            },
+            "required": ["group", "version", "kind", "name", "created"],
+        },
+    }
+
+
+def _yaml_dump(data, indent: int = 0) -> str:
+    """Small deterministic YAML renderer for CRD documents."""
+    import yaml as pyyaml
+
+    return pyyaml.safe_dump(data, sort_keys=False, default_flow_style=False)
+
+
+def crd_yaml(view: WorkloadView) -> FileSpec:
+    """config/crd/bases/<group>_<plural>.yaml rendered directly from the
+    APIFields tree (the reference requires controller-gen for this)."""
+    spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
+    scope = "Cluster" if view.workload.is_cluster_scoped() else "Namespaced"
+    crd = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "annotations": {
+                "controller-gen.kubebuilder.io/version": "(operator-forge)"
+            },
+            "name": f"{view.plural}.{view.full_group}",
+        },
+        "spec": {
+            "group": view.full_group,
+            "names": {
+                "kind": view.kind,
+                "listKind": f"{view.kind}List",
+                "plural": view.plural,
+                "singular": view.kind_lower,
+            },
+            "scope": scope,
+            "versions": [
+                {
+                    "name": view.version,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "description": f"{view.kind} is the Schema for the "
+                            f"{view.plural} API.",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": _schema_for(spec_fields),
+                                "status": {
+                                    "type": "object",
+                                    "properties": {
+                                        "created": {"type": "boolean"},
+                                        "dependenciesSatisfied": {
+                                            "type": "boolean"
+                                        },
+                                        "conditions": _condition_schema(),
+                                        "resources": (
+                                            _resource_condition_schema()
+                                        ),
+                                    },
+                                },
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+    return FileSpec(
+        path=f"config/crd/bases/{view.crd_file_name}",
+        content=_yaml_dump(crd),
+        add_boilerplate=False,
+    )
+
+
+def sample_yaml(view: WorkloadView, required_only: bool = False) -> str:
+    """A sample custom resource manifest
+    (reference templates/config/samples/crd_sample.go:28-64)."""
+    spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
+    spec = spec_fields.generate_sample_spec(required_only)
+    return (
+        f"apiVersion: {view.full_group}/{view.version}\n"
+        f"kind: {view.kind}\n"
+        "metadata:\n"
+        f"  name: {view.kind_lower}-sample\n"
+        f"{spec}"
+    )
+
+
+def sample_file(view: WorkloadView) -> FileSpec:
+    return FileSpec(
+        path=f"config/samples/{view.sample_file_name}",
+        content=sample_yaml(view, required_only=False),
+        add_boilerplate=False,
+    )

@@ -1,0 +1,435 @@
+"""Controller templates: per-kind reconciler, phase wiring, and the envtest
+suite test.
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/controller/
+{controller,phases,controller_suitetest}.go.
+"""
+
+from __future__ import annotations
+
+from ...utils import to_file_name
+from ..context import WorkloadView
+from ..machinery import FileSpec
+
+
+def controller_file(view: WorkloadView) -> FileSpec:
+    kind = view.kind
+    alias = view.api_import_alias
+    pkg = view.package_name
+    coll = view.collection
+    is_component = view.is_component() and coll is not None
+
+    rbac_markers = "\n".join(
+        r.to_marker() for r in view.workload.get_rbac_rules()
+    )
+    child_rbac = []
+    seen = set()
+    for child in view.workload.get_manifests().all_child_resources():
+        for rule in child.rbac or []:
+            marker = rule.to_marker()
+            if marker not in seen:
+                seen.add(marker)
+                child_rbac.append(marker)
+    all_rbac = "\n".join([rbac_markers] + child_rbac)
+
+    coll_import = ""
+    if is_component:
+        coll_import = (
+            f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+        )
+
+    # -- NewRequest -----------------------------------------------------
+    if is_component:
+        new_request = f'''// NewRequest builds a reconciliation request, fetching the workload and its
+// collection.
+func (r *{kind}Reconciler) NewRequest(ctx context.Context, request ctrl.Request) (*orchestrate.Request, error) {{
+\tworkload := &{alias}.{kind}{{}}
+
+\tif err := r.Get(ctx, request.NamespacedName, workload); err != nil {{
+\t\treturn nil, err
+\t}}
+
+\tcollection, err := r.GetCollection(ctx, workload)
+\tif err != nil {{
+\t\treturn nil, err
+\t}}
+
+\treturn &orchestrate.Request{{
+\t\tContext:    ctx,
+\t\tWorkload:   workload,
+\t\tCollection: collection,
+\t\tLog:        r.Log.WithValues("{view.kind_lower}", request.NamespacedName),
+\t}}, nil
+}}
+
+// GetCollection returns the collection for a component workload: the
+// explicitly referenced collection when spec.collection is set, otherwise
+// the single collection in the cluster (erroring unless exactly one exists).
+func (r *{kind}Reconciler) GetCollection(
+\tctx context.Context,
+\tworkload *{alias}.{kind},
+) (*{coll.api_import_alias}.{coll.kind}, error) {{
+\tvar collectionList {coll.api_import_alias}.{coll.kind}List
+
+\tname, namespace := workload.Spec.Collection.Name, workload.Spec.Collection.Namespace
+
+\tif name != "" {{
+\t\tcollection := &{coll.api_import_alias}.{coll.kind}{{}}
+
+\t\tif err := r.Get(ctx, types.NamespacedName{{Name: name, Namespace: namespace}}, collection); err != nil {{
+\t\t\tif apierrs.IsNotFound(err) {{
+\t\t\t\treturn nil, orchestrate.ErrCollectionNotFound
+\t\t\t}}
+
+\t\t\treturn nil, err
+\t\t}}
+
+\t\treturn collection, nil
+\t}}
+
+\tif err := r.List(ctx, &collectionList); err != nil {{
+\t\treturn nil, err
+\t}}
+
+\tif len(collectionList.Items) != 1 {{
+\t\treturn nil, orchestrate.ErrCollectionNotFound
+\t}}
+
+\treturn &collectionList.Items[0], nil
+}}
+'''
+        get_resources_convert = f'''\tworkload, collection, err := {pkg}.ConvertWorkload(req.Workload, req.Collection)
+\tif err != nil {{
+\t\treturn nil, err
+\t}}
+
+\tresources, err := {pkg}.Generate(*workload, *collection)'''
+        mutate_call = f"mutate.{kind}Mutate(resource, workload, collection)"
+        collection_watch = f'''
+\t// watch the collection so components reconcile on collection changes
+\tif err := c.Watch(
+\t\t&source.Kind{{Type: &{coll.api_import_alias}.{coll.kind}{{}}}},
+\t\thandler.EnqueueRequestsFromMapFunc(r.requestsForAll),
+\t); err != nil {{
+\t\treturn err
+\t}}
+'''
+        requests_for_all = f'''
+// requestsForAll enqueues every {kind} in the cluster (used when the
+// collection changes, reference EnqueueRequestOnCollectionChange).
+func (r *{kind}Reconciler) requestsForAll(object client.Object) []reconcile.Request {{
+\tvar list {alias}.{kind}List
+
+\tif err := r.List(context.Background(), &list); err != nil {{
+\t\tr.Log.Error(err, "unable to list {view.plural} for collection watch")
+
+\t\treturn nil
+\t}}
+
+\trequests := make([]reconcile.Request, len(list.Items))
+\tfor i := range list.Items {{
+\t\trequests[i] = reconcile.Request{{NamespacedName: types.NamespacedName{{
+\t\t\tName:      list.Items[i].GetName(),
+\t\t\tNamespace: list.Items[i].GetNamespace(),
+\t\t}}}}
+\t}}
+
+\treturn requests
+}}
+'''
+        collection_requeue = f'''\t\tif errors.Is(err, orchestrate.ErrCollectionNotFound) {{
+\t\t\treturn ctrl.Result{{Requeue: true}}, nil
+\t\t}}
+
+'''
+        errors_import = '\t"errors"\n'
+    else:
+        new_request = f'''// NewRequest builds a reconciliation request for the workload.
+func (r *{kind}Reconciler) NewRequest(ctx context.Context, request ctrl.Request) (*orchestrate.Request, error) {{
+\tworkload := &{alias}.{kind}{{}}
+
+\tif err := r.Get(ctx, request.NamespacedName, workload); err != nil {{
+\t\treturn nil, err
+\t}}
+
+\treturn &orchestrate.Request{{
+\t\tContext:  ctx,
+\t\tWorkload: workload,
+\t\tLog:      r.Log.WithValues("{view.kind_lower}", request.NamespacedName),
+\t}}, nil
+}}
+'''
+        get_resources_convert = f'''\tworkload, err := {pkg}.ConvertWorkload(req.Workload)
+\tif err != nil {{
+\t\treturn nil, err
+\t}}
+
+\tresources, err := {pkg}.Generate(*workload)'''
+        mutate_call = f"mutate.{kind}Mutate(resource, workload)"
+        collection_watch = ""
+        requests_for_all = ""
+        collection_requeue = ""
+        errors_import = ""
+
+    component_only_imports = ""
+    if is_component:
+        component_only_imports = (
+            '\t"k8s.io/apimachinery/pkg/types"\n'
+        )
+    reconcile_pkg_import = (
+        '\t"sigs.k8s.io/controller-runtime/pkg/reconcile"\n'
+        if is_component
+        else ""
+    )
+    reconcile_imports = (
+        '\t"context"\n'
+        f"{errors_import}\n"
+        '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
+        '\t"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"\n'
+        '\t"k8s.io/apimachinery/pkg/runtime"\n'
+        f"{component_only_imports}"
+        '\t"k8s.io/client-go/tools/record"\n'
+        '\tctrl "sigs.k8s.io/controller-runtime"\n'
+        '\t"sigs.k8s.io/controller-runtime/pkg/client"\n'
+        '\t"sigs.k8s.io/controller-runtime/pkg/controller"\n'
+        '\t"sigs.k8s.io/controller-runtime/pkg/handler"\n'
+        f"{reconcile_pkg_import}"
+        '\t"sigs.k8s.io/controller-runtime/pkg/source"\n\n'
+        '\t"github.com/go-logr/logr"\n\n'
+        f'\t"{view.config.repo}/internal/dependencies"\n'
+        f'\t"{view.config.repo}/internal/mutate"\n'
+        f'\t"{view.config.repo}/pkg/orchestrate"\n\n'
+        f'\t{alias} "{view.api_types_import}"\n'
+        f'\t{pkg} "{view.resources_import}"\n'
+        f"{coll_import}"
+    )
+
+    content = f'''package {view.group}
+
+import (
+{reconcile_imports})
+
+// {kind}Reconciler reconciles a {kind} object.
+type {kind}Reconciler struct {{
+\tclient.Client
+
+\tName         string
+\tLog          logr.Logger
+\tController   controller.Controller
+\tEvents       record.EventRecorder
+\tFieldManager string
+\tScheme       *runtime.Scheme
+\tPhases       *orchestrate.Registry
+
+\twatches map[string]bool
+}}
+
+// New{kind}Reconciler returns a configured reconciler for the {kind} kind.
+func New{kind}Reconciler(mgr ctrl.Manager) *{kind}Reconciler {{
+\treconciler := &{kind}Reconciler{{
+\t\tName:         "{kind}",
+\t\tClient:       mgr.GetClient(),
+\t\tEvents:       mgr.GetEventRecorderFor("{kind}-Controller"),
+\t\tFieldManager: "{view.kind_lower}-reconciler",
+\t\tLog:          ctrl.Log.WithName("controllers").WithName("{view.group}").WithName("{kind}"),
+\t\tScheme:       mgr.GetScheme(),
+\t\tPhases:       &orchestrate.Registry{{}},
+\t\twatches:      map[string]bool{{}},
+\t}}
+
+\torchestrate.RegisterDefaultPhases(reconciler.Phases)
+
+\treturn reconciler
+}}
+
+{all_rbac}
+
+// Namespaces are listed and watched to ensure they exist before resources
+// are deployed into them.
+// +kubebuilder:rbac:groups=core,resources=namespaces,verbs=list;watch
+
+// Reconcile moves the current state of the cluster closer to the desired
+// state through the registered phase state machine.
+func (r *{kind}Reconciler) Reconcile(ctx context.Context, request ctrl.Request) (ctrl.Result, error) {{
+\treq, err := r.NewRequest(ctx, request)
+\tif err != nil {{
+{collection_requeue}\t\tif !apierrs.IsNotFound(err) {{
+\t\t\treturn ctrl.Result{{}}, err
+\t\t}}
+
+\t\treturn ctrl.Result{{}}, nil
+\t}}
+
+\treturn r.Phases.HandleExecution(r, req)
+}}
+
+{new_request}
+// GetResources renders this workload's child resources, running each through
+// the user mutation hook.
+func (r *{kind}Reconciler) GetResources(req *orchestrate.Request) ([]client.Object, error) {{
+{get_resources_convert}
+\tif err != nil {{
+\t\treturn nil, err
+\t}}
+
+\tmutated := []client.Object{{}}
+
+\tfor _, resource := range resources {{
+\t\tresults, err := {mutate_call}
+\t\tif err != nil {{
+\t\t\treturn nil, err
+\t\t}}
+
+\t\tmutated = append(mutated, results...)
+\t}}
+
+\treturn mutated, nil
+}}
+
+// CheckDependencies runs the user-owned dependency hook.
+func (r *{kind}Reconciler) CheckDependencies(req *orchestrate.Request) (bool, error) {{
+\treturn dependencies.{kind}CheckReady(r, req)
+}}
+
+// EnsureWatch begins watching a child resource kind exactly once so drift on
+// child resources re-triggers reconciliation.
+func (r *{kind}Reconciler) EnsureWatch(req *orchestrate.Request, resource client.Object) error {{
+\tif r.Controller == nil {{
+\t\treturn nil
+\t}}
+
+\tgvk := resource.GetObjectKind().GroupVersionKind()
+
+\tkey := gvk.String()
+\tif r.watches[key] {{
+\t\treturn nil
+\t}}
+
+\twatched := &unstructured.Unstructured{{}}
+\twatched.SetGroupVersionKind(gvk)
+
+\tif err := r.Controller.Watch(
+\t\t&source.Kind{{Type: watched}},
+\t\t&handler.EnqueueRequestForOwner{{OwnerType: &{alias}.{kind}{{}}, IsController: true}},
+\t); err != nil {{
+\t\treturn err
+\t}}
+
+\tr.watches[key] = true
+
+\treturn nil
+}}
+
+// GetLogger returns the reconciler's logger.
+func (r *{kind}Reconciler) GetLogger() logr.Logger {{
+\treturn r.Log
+}}
+
+// GetEventRecorder returns the reconciler's event recorder.
+func (r *{kind}Reconciler) GetEventRecorder() record.EventRecorder {{
+\treturn r.Events
+}}
+
+// GetFieldManager returns the server-side-apply field manager name.
+func (r *{kind}Reconciler) GetFieldManager() string {{
+\treturn r.FieldManager
+}}
+
+// GetScheme returns the runtime scheme.
+func (r *{kind}Reconciler) GetScheme() *runtime.Scheme {{
+\treturn r.Scheme
+}}
+{requests_for_all}
+// SetupWithManager registers the reconciler with the manager.
+func (r *{kind}Reconciler) SetupWithManager(mgr ctrl.Manager) error {{
+\tc, err := ctrl.NewControllerManagedBy(mgr).
+\t\tFor(&{alias}.{kind}{{}}).
+\t\tBuild(r)
+\tif err != nil {{
+\t\treturn err
+\t}}
+
+\tr.Controller = c
+{collection_watch}
+\treturn nil
+}}
+'''
+    return FileSpec(path=view.controller_file, content=content)
+
+
+def suite_test_file(view: WorkloadView, kinds_in_group: list[str]) -> FileSpec:
+    """Envtest-based suite test per controller group
+    (reference templates/controller/controller_suitetest.go:31-171)."""
+    content = f'''package {view.group}
+
+import (
+\t"os"
+\t"path/filepath"
+\t"testing"
+
+\t"k8s.io/client-go/kubernetes/scheme"
+\t"k8s.io/client-go/rest"
+\tctrl "sigs.k8s.io/controller-runtime"
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+\t"sigs.k8s.io/controller-runtime/pkg/envtest"
+\tlogf "sigs.k8s.io/controller-runtime/pkg/log"
+\t"sigs.k8s.io/controller-runtime/pkg/log/zap"
+
+\t{view.api_import_alias} "{view.api_types_import}"
+)
+
+// These tests use envtest: a real API server and etcd without nodes.
+// Run them with `make test`.
+
+var (
+\tcfg       *rest.Config
+\tk8sClient client.Client
+\ttestEnv   *envtest.Environment
+)
+
+func TestMain(m *testing.M) {{
+\tlogf.SetLogger(zap.New(zap.UseDevMode(true)))
+
+\ttestEnv = &envtest.Environment{{
+\t\tCRDDirectoryPaths:     []string{{filepath.Join("..", "..", "config", "crd", "bases")}},
+\t\tErrorIfCRDPathMissing: true,
+\t}}
+
+\tvar err error
+
+\tcfg, err = testEnv.Start()
+\tif err != nil || cfg == nil {{
+\t\tpanic("unable to start test environment: " + errString(err))
+\t}}
+
+\tif err := {view.api_import_alias}.AddToScheme(scheme.Scheme); err != nil {{
+\t\tpanic("unable to register scheme: " + err.Error())
+\t}}
+
+\tk8sClient, err = client.New(cfg, client.Options{{Scheme: scheme.Scheme}})
+\tif err != nil {{
+\t\tpanic("unable to create client: " + err.Error())
+\t}}
+
+\tcode := m.Run()
+
+\tif err := testEnv.Stop(); err != nil {{
+\t\tpanic("unable to stop test environment: " + err.Error())
+\t}}
+
+\tos.Exit(code)
+}}
+
+func errString(err error) string {{
+\tif err == nil {{
+\t\treturn "unknown error"
+\t}}
+
+\treturn err.Error()
+}}
+
+var _ = ctrl.Log
+'''
+    return FileSpec(
+        path=f"controllers/{view.group}/suite_test.go", content=content
+    )

@@ -1,0 +1,286 @@
+"""Kustomize config-tree templates.
+
+Reference: the kustomize tree the reference inherits from kubebuilder's
+kustomize plugin, plus its own CRD kustomization
+(templates/config/crd/kustomization.go:25-116).
+"""
+
+from __future__ import annotations
+
+from ..context import ProjectConfig, WorkloadView
+from ..machinery import FileSpec, IfExists
+
+
+def crd_kustomization(views: list[WorkloadView]) -> FileSpec:
+    resources = "\n".join(
+        f"- bases/{view.crd_file_name}" for view in views
+    )
+    content = (
+        "# This kustomization.yaml is not intended to be run by itself,\n"
+        "# since it depends on service name and namespace that are out of\n"
+        "# this kustomize package. It should be run by config/default.\n"
+        f"resources:\n{resources}\n"
+    )
+    return FileSpec(
+        path="config/crd/kustomization.yaml",
+        content=content,
+        add_boilerplate=False,
+    )
+
+
+def samples_kustomization(views: list[WorkloadView]) -> FileSpec:
+    resources = "\n".join(f"- {view.sample_file_name}" for view in views)
+    content = f"## Sample custom resources\nresources:\n{resources}\n"
+    return FileSpec(
+        path="config/samples/kustomization.yaml",
+        content=content,
+        add_boilerplate=False,
+    )
+
+
+def default_tree(config: ProjectConfig) -> list[FileSpec]:
+    project = config.repo.rsplit("/", 1)[-1]
+    namespace = f"{project}-system"
+    return [
+        FileSpec(
+            path="config/default/kustomization.yaml",
+            content=f"""# Adds namespace to all resources.
+namespace: {namespace}
+
+# Value of this field is prepended to the names of all resources.
+namePrefix: {project}-
+
+resources:
+- ../crd
+- ../rbac
+- ../manager
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/manager/kustomization.yaml",
+            content="""resources:
+- manager.yaml
+
+images:
+- name: controller
+  newName: controller
+  newTag: latest
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/manager/manager.yaml",
+            content=f"""apiVersion: v1
+kind: Namespace
+metadata:
+  labels:
+    control-plane: controller-manager
+  name: system
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: controller-manager
+  namespace: system
+  labels:
+    control-plane: controller-manager
+spec:
+  selector:
+    matchLabels:
+      control-plane: controller-manager
+  replicas: 1
+  template:
+    metadata:
+      labels:
+        control-plane: controller-manager
+    spec:
+      securityContext:
+        runAsNonRoot: true
+      containers:
+      - command:
+        - /manager
+        args:
+        - --leader-elect
+        image: controller:latest
+        name: manager
+        securityContext:
+          allowPrivilegeEscalation: false
+          capabilities:
+            drop:
+            - "ALL"
+        livenessProbe:
+          httpGet:
+            path: /healthz
+            port: 8081
+          initialDelaySeconds: 15
+          periodSeconds: 20
+        readinessProbe:
+          httpGet:
+            path: /readyz
+            port: 8081
+          initialDelaySeconds: 5
+          periodSeconds: 10
+        resources:
+          limits:
+            cpu: 500m
+            memory: 256Mi
+          requests:
+            cpu: 10m
+            memory: 64Mi
+      serviceAccountName: controller-manager
+      terminationGracePeriodSeconds: 10
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/rbac/kustomization.yaml",
+            content="""resources:
+- service_account.yaml
+- role.yaml
+- role_binding.yaml
+- leader_election_role.yaml
+- leader_election_role_binding.yaml
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/rbac/service_account.yaml",
+            content="""apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: controller-manager
+  namespace: system
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/rbac/role_binding.yaml",
+            content="""apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: manager-rolebinding
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: manager-role
+subjects:
+- kind: ServiceAccount
+  name: controller-manager
+  namespace: system
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/rbac/leader_election_role.yaml",
+            content="""apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: leader-election-role
+rules:
+- apiGroups:
+  - ""
+  resources:
+  - configmaps
+  verbs:
+  - get
+  - list
+  - watch
+  - create
+  - update
+  - patch
+  - delete
+- apiGroups:
+  - coordination.k8s.io
+  resources:
+  - leases
+  verbs:
+  - get
+  - list
+  - watch
+  - create
+  - update
+  - patch
+  - delete
+- apiGroups:
+  - ""
+  resources:
+  - events
+  verbs:
+  - create
+  - patch
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/rbac/leader_election_role_binding.yaml",
+            content="""apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: leader-election-rolebinding
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: leader-election-role
+subjects:
+- kind: ServiceAccount
+  name: controller-manager
+  namespace: system
+""",
+            add_boilerplate=False,
+        ),
+    ]
+
+
+def manager_cluster_role(views: list[WorkloadView]) -> FileSpec:
+    """config/rbac/role.yaml aggregated from every workload's inferred rules
+    (the reference defers this to controller-gen reading the
+    ``+kubebuilder:rbac`` markers; operator-forge emits it directly)."""
+    import yaml as pyyaml
+
+    rule_map: dict = {}
+    order: list = []
+
+    def add(group: str, resource: str, verbs: list[str]):
+        key = (group, resource)
+        if key not in rule_map:
+            rule_map[key] = []
+            order.append(key)
+        for verb in verbs:
+            if verb not in rule_map[key]:
+                rule_map[key].append(verb)
+
+    add("", "namespaces", ["list", "watch"])
+    add("", "events", ["create", "patch"])
+    for view in views:
+        for rule in view.workload.get_rbac_rules():
+            if not rule.is_resource_rule():
+                continue
+            group = "" if rule.group == "core" else rule.group
+            add(group, rule.resource, rule.verbs)
+        for child in view.workload.get_manifests().all_child_resources():
+            for rule in child.rbac or []:
+                if not rule.is_resource_rule():
+                    continue
+                group = "" if rule.group == "core" else rule.group
+                add(group, rule.resource, rule.verbs)
+
+    rules = [
+        {
+            "apiGroups": [group],
+            "resources": [resource],
+            "verbs": rule_map[(group, resource)],
+        }
+        for (group, resource) in order
+    ]
+    doc = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "manager-role"},
+        "rules": rules,
+    }
+    return FileSpec(
+        path="config/rbac/role.yaml",
+        content=pyyaml.safe_dump(doc, sort_keys=False),
+        add_boilerplate=False,
+    )

@@ -1,0 +1,379 @@
+"""Project-skeleton templates: main.go, go.mod, Dockerfile, Makefile,
+README, PROJECT, .gitignore, boilerplate.
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/{main,gomod,
+dockerfile,makefile,readme}.go plus the kubebuilder golang/kustomize plugin
+output the reference inherits.
+"""
+
+from __future__ import annotations
+
+from ..context import ProjectConfig
+from ..machinery import FileSpec, IfExists
+
+CONTROLLER_RUNTIME_VERSION = "v0.14.6"
+K8S_VERSION = "v0.26.3"
+GO_VERSION = "1.19"
+
+
+def _fnv1a(data: str) -> int:
+    h = 0xCBF29CE484222325
+    for byte in data.encode("utf-8"):
+        h ^= byte
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def leader_election_id(config: ProjectConfig) -> str:
+    """Stable leader-election ID (the reference hashes with FNV in the
+    generated main.go, templates/main.go:~250)."""
+    digest = _fnv1a(config.repo) & 0xFFFFFFFF
+    domain = config.domain or "operator-forge.io"
+    return f"{digest:08x}.{domain}"
+
+
+def project_file(config: ProjectConfig) -> FileSpec:
+    return FileSpec(
+        path="PROJECT", content=config.to_yaml(), add_boilerplate=False
+    )
+
+
+def boilerplate(license_header: str = "") -> FileSpec:
+    content = license_header or (
+        "/*\nCopyright 2026.\n\nLicensed under the Apache License, Version"
+        ' 2.0 (the "License");\nyou may not use this file except in'
+        " compliance with the License.\n*/\n"
+    )
+    return FileSpec(
+        path="hack/boilerplate.go.txt",
+        content=content,
+        add_boilerplate=False,
+        if_exists=IfExists.SKIP,
+    )
+
+
+def gitignore() -> FileSpec:
+    return FileSpec(
+        path=".gitignore",
+        content=(
+            "# binaries\nbin/\n*.exe\n*.so\n*.dylib\n\n"
+            "# test artifacts\n*.out\ntestbin/\n\n# editor state\n"
+            "*.swp\n*.swo\n*~\n.idea/\n.vscode/\n"
+        ),
+        add_boilerplate=False,
+        if_exists=IfExists.SKIP,
+    )
+
+
+def go_mod(config: ProjectConfig) -> FileSpec:
+    content = f"""module {config.repo}
+
+go {GO_VERSION}
+
+require (
+\tgithub.com/go-logr/logr v1.2.3
+\tgithub.com/spf13/cobra v1.6.1
+\tk8s.io/api {K8S_VERSION}
+\tk8s.io/apimachinery {K8S_VERSION}
+\tk8s.io/client-go {K8S_VERSION}
+\tsigs.k8s.io/controller-runtime {CONTROLLER_RUNTIME_VERSION}
+\tsigs.k8s.io/yaml v1.3.0
+)
+"""
+    return FileSpec(path="go.mod", content=content, add_boilerplate=False)
+
+
+def main_go(config: ProjectConfig) -> FileSpec:
+    election_id = leader_election_id(config)
+    content = f'''package main
+
+import (
+\t"flag"
+\t"os"
+
+\t"k8s.io/apimachinery/pkg/runtime"
+\tutilruntime "k8s.io/apimachinery/pkg/util/runtime"
+\tclientgoscheme "k8s.io/client-go/kubernetes/scheme"
+\tctrl "sigs.k8s.io/controller-runtime"
+\t"sigs.k8s.io/controller-runtime/pkg/healthz"
+\t"sigs.k8s.io/controller-runtime/pkg/log/zap"
+\t// +operator-builder:scaffold:imports
+)
+
+var (
+\tscheme   = runtime.NewScheme()
+\tsetupLog = ctrl.Log.WithName("setup")
+)
+
+func init() {{
+\tutilruntime.Must(clientgoscheme.AddToScheme(scheme))
+\t// +operator-builder:scaffold:scheme
+}}
+
+func main() {{
+\tvar metricsAddr string
+\tvar enableLeaderElection bool
+\tvar probeAddr string
+
+\tflag.StringVar(&metricsAddr, "metrics-bind-address", ":8080",
+\t\t"The address the metric endpoint binds to.")
+\tflag.StringVar(&probeAddr, "health-probe-bind-address", ":8081",
+\t\t"The address the probe endpoint binds to.")
+\tflag.BoolVar(&enableLeaderElection, "leader-elect", false,
+\t\t"Enable leader election for controller manager. "+
+\t\t\t"Enabling this will ensure there is only one active controller manager.")
+
+\topts := zap.Options{{Development: true}}
+\topts.BindFlags(flag.CommandLine)
+\tflag.Parse()
+
+\tctrl.SetLogger(zap.New(zap.UseFlagOptions(&opts)))
+
+\tmgr, err := ctrl.NewManager(ctrl.GetConfigOrDie(), ctrl.Options{{
+\t\tScheme:                 scheme,
+\t\tMetricsBindAddress:     metricsAddr,
+\t\tPort:                   9443,
+\t\tHealthProbeBindAddress: probeAddr,
+\t\tLeaderElection:         enableLeaderElection,
+\t\tLeaderElectionID:       "{election_id}",
+\t}})
+\tif err != nil {{
+\t\tsetupLog.Error(err, "unable to start manager")
+\t\tos.Exit(1)
+\t}}
+
+\t// +operator-builder:scaffold:reconcilers
+
+\tif err := mgr.AddHealthzCheck("healthz", healthz.Ping); err != nil {{
+\t\tsetupLog.Error(err, "unable to set up health check")
+\t\tos.Exit(1)
+\t}}
+
+\tif err := mgr.AddReadyzCheck("readyz", healthz.Ping); err != nil {{
+\t\tsetupLog.Error(err, "unable to set up ready check")
+\t\tos.Exit(1)
+\t}}
+
+\tsetupLog.Info("starting manager")
+
+\tif err := mgr.Start(ctrl.SetupSignalHandler()); err != nil {{
+\t\tsetupLog.Error(err, "problem running manager")
+\t\tos.Exit(1)
+\t}}
+}}
+'''
+    return FileSpec(path="main.go", content=content)
+
+
+def dockerfile() -> FileSpec:
+    content = f"""# Build the manager binary
+FROM golang:{GO_VERSION} as builder
+
+WORKDIR /workspace
+COPY go.mod go.mod
+COPY go.sum go.sum
+RUN go mod download
+
+COPY main.go main.go
+COPY apis/ apis/
+COPY controllers/ controllers/
+COPY internal/ internal/
+COPY pkg/ pkg/
+
+RUN CGO_ENABLED=0 GOOS=linux GOARCH=amd64 go build -a -o manager main.go
+
+# Use distroless as minimal base image to package the manager binary
+FROM gcr.io/distroless/static:nonroot
+WORKDIR /
+COPY --from=builder /workspace/manager .
+USER 65532:65532
+
+ENTRYPOINT ["/manager"]
+"""
+    return FileSpec(path="Dockerfile", content=content, add_boilerplate=False)
+
+
+def makefile(config: ProjectConfig) -> FileSpec:
+    cli_targets = ""
+    if config.cli_root_command_name:
+        cli = config.cli_root_command_name
+        cli_targets = f"""
+##@ Companion CLI
+
+.PHONY: build-cli
+build-cli: fmt vet ## Build the {cli} companion CLI.
+\tgo build -o bin/{cli} cmd/{cli}/main.go
+
+.PHONY: install-cli
+install-cli: build-cli ## Install the {cli} companion CLI into GOBIN.
+\tgo install ./cmd/{cli}
+"""
+    content = f"""# Image URL to use all building/pushing image targets
+IMG ?= controller:latest
+# ENVTEST_K8S_VERSION refers to the version of kubebuilder assets to be downloaded by envtest binary.
+ENVTEST_K8S_VERSION = 1.26.1
+
+GOBIN=$(shell go env GOBIN)
+ifeq ($(GOBIN),)
+GOBIN=$(shell go env GOPATH)/bin
+endif
+
+# Setting SHELL to bash allows bash commands to be executed by recipes.
+SHELL = /usr/bin/env bash -o pipefail
+.SHELLFLAGS = -ec
+
+.PHONY: all
+all: build
+
+##@ General
+
+.PHONY: help
+help: ## Display this help.
+\t@awk 'BEGIN {{FS = ":.*##"; printf "\\nUsage:\\n  make \\033[36m<target>\\033[0m\\n"}} /^[a-zA-Z_0-9-]+:.*?##/ {{ printf "  \\033[36m%-20s\\033[0m %s\\n", $$1, $$2 }} /^##@/ {{ printf "\\n\\033[1m%s\\033[0m\\n", substr($$0, 5) }} ' $(MAKEFILE_LIST)
+
+##@ Development
+
+.PHONY: manifests
+manifests: controller-gen ## Regenerate CRDs and RBAC from code markers.
+\t$(CONTROLLER_GEN) rbac:roleName=manager-role crd webhook paths="./..." output:crd:artifacts:config=config/crd/bases
+
+.PHONY: generate
+generate: controller-gen ## Generate deepcopy implementations.
+\t$(CONTROLLER_GEN) object:headerFile="hack/boilerplate.go.txt" paths="./..."
+
+.PHONY: fmt
+fmt: ## Run go fmt against code.
+\tgo fmt ./...
+
+.PHONY: vet
+vet: ## Run go vet against code.
+\tgo vet ./...
+
+.PHONY: test
+test: manifests generate fmt vet envtest ## Run tests.
+\tKUBEBUILDER_ASSETS="$(shell $(ENVTEST) use $(ENVTEST_K8S_VERSION) --bin-dir $(LOCALBIN) -p path)" go test ./... -coverprofile cover.out
+
+.PHONY: test-e2e
+test-e2e: ## Run e2e tests against the cluster in ~/.kube/config.
+\tgo test ./test/e2e/... -tags e2e_test -v
+
+##@ Build
+
+.PHONY: build
+build: generate fmt vet ## Build manager binary.
+\tgo build -o bin/manager main.go
+
+.PHONY: run
+run: manifests generate fmt vet ## Run a controller from your host.
+\tgo run ./main.go
+
+.PHONY: docker-build
+docker-build: test ## Build docker image with the manager.
+\tdocker build -t $(IMG) .
+
+.PHONY: docker-push
+docker-push: ## Push docker image with the manager.
+\tdocker push $(IMG)
+{cli_targets}
+##@ Deployment
+
+.PHONY: install
+install: manifests kustomize ## Install CRDs into the K8s cluster.
+\t$(KUSTOMIZE) build config/crd | kubectl apply -f -
+
+.PHONY: uninstall
+uninstall: manifests kustomize ## Uninstall CRDs from the K8s cluster.
+\t$(KUSTOMIZE) build config/crd | kubectl delete --ignore-not-found -f -
+
+.PHONY: deploy
+deploy: manifests kustomize ## Deploy controller to the K8s cluster.
+\tcd config/manager && $(KUSTOMIZE) edit set image controller=$(IMG)
+\t$(KUSTOMIZE) build config/default | kubectl apply -f -
+
+.PHONY: undeploy
+undeploy: ## Undeploy controller from the K8s cluster.
+\t$(KUSTOMIZE) build config/default | kubectl delete --ignore-not-found -f -
+
+##@ Build Dependencies
+
+LOCALBIN ?= $(shell pwd)/bin
+$(LOCALBIN):
+\tmkdir -p $(LOCALBIN)
+
+KUSTOMIZE ?= $(LOCALBIN)/kustomize
+CONTROLLER_GEN ?= $(LOCALBIN)/controller-gen
+ENVTEST ?= $(LOCALBIN)/setup-envtest
+
+KUSTOMIZE_VERSION ?= v4.5.7
+CONTROLLER_TOOLS_VERSION ?= v0.11.3
+
+.PHONY: kustomize
+kustomize: $(KUSTOMIZE)
+$(KUSTOMIZE): $(LOCALBIN)
+\ttest -s $(KUSTOMIZE) || GOBIN=$(LOCALBIN) go install sigs.k8s.io/kustomize/kustomize/v4@$(KUSTOMIZE_VERSION)
+
+.PHONY: controller-gen
+controller-gen: $(CONTROLLER_GEN)
+$(CONTROLLER_GEN): $(LOCALBIN)
+\ttest -s $(CONTROLLER_GEN) || GOBIN=$(LOCALBIN) go install sigs.k8s.io/controller-tools/cmd/controller-gen@$(CONTROLLER_TOOLS_VERSION)
+
+.PHONY: envtest
+envtest: $(ENVTEST)
+$(ENVTEST): $(LOCALBIN)
+\ttest -s $(ENVTEST) || GOBIN=$(LOCALBIN) go install sigs.k8s.io/controller-runtime/tools/setup-envtest@latest
+"""
+    return FileSpec(path="Makefile", content=content, add_boilerplate=False)
+
+
+def readme(config: ProjectConfig, workload_names: list[str]) -> FileSpec:
+    cli_section = ""
+    if config.cli_root_command_name:
+        cli = config.cli_root_command_name
+        cli_section = f"""
+## Companion CLI
+
+A companion CLI, `{cli}`, ships with this operator:
+
+```bash
+make build-cli
+./bin/{cli} init    # print a sample custom resource manifest
+./bin/{cli} generate --workload-manifest my-workload.yaml  # render child resources
+./bin/{cli} version # print supported API versions
+```
+"""
+    workloads = "\n".join(f"- {name}" for name in workload_names) or "- (none yet)"
+    content = f"""# {config.repo.rsplit('/', 1)[-1]}
+
+A Kubernetes operator generated by operator-forge.  It manages the following
+workloads:
+
+{workloads}
+
+## Getting started
+
+```bash
+# install CRDs
+make install
+
+# run the controller locally
+make run
+
+# or deploy it to the cluster
+make docker-build docker-push IMG=<registry>/<image>:<tag>
+make deploy IMG=<registry>/<image>:<tag>
+```
+
+Create an instance of a workload from the generated sample:
+
+```bash
+kubectl apply -f config/samples/
+```
+{cli_section}
+## Testing
+
+```bash
+make test       # unit + envtest suites
+make test-e2e   # e2e suite against the current kubeconfig context
+```
+"""
+    return FileSpec(path="README.md", content=content, add_boilerplate=False)

@@ -1,0 +1,357 @@
+"""Resources-package templates: resources.go, per-manifest child-resource
+definitions, and the user-owned mutate/dependencies hooks.
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/api/resources/
+{resources,definition}.go and templates/int/{mutate,dependencies}/
+component.go.
+"""
+
+from __future__ import annotations
+
+from ...gocodegen.generate import uses_sprintf
+from ..context import WorkloadView
+from ..machinery import FileSpec, IfExists
+from .api import sample_yaml
+
+
+def _workload_args_decl(view: WorkloadView) -> str:
+    """Argument list shared by create funcs: the parent workload and, for
+    components, its collection."""
+    args = [f"\tparent *{view.api_import_alias}.{view.kind},"]
+    coll = view.collection
+    if view.is_component() and coll is not None:
+        args.append(f"\tcollection *{coll.api_import_alias}.{coll.kind},")
+    elif view.is_collection():
+        # a collection is its own collection; create funcs take it as both
+        pass
+    return "\n".join(args)
+
+
+def _collection_import(view: WorkloadView) -> str:
+    coll = view.collection
+    if view.is_component() and coll is not None:
+        return (
+            f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+        )
+    return ""
+
+
+def resources_file(view: WorkloadView) -> FileSpec:
+    """The resources.go file for a workload's resources package
+    (reference templates/api/resources/resources.go:40-230)."""
+    kind = view.kind
+    alias = view.api_import_alias
+    pkg = view.package_name
+    coll = view.collection
+    is_component = view.is_component() and coll is not None
+
+    create_names, init_names = view.workload.get_manifests().func_names()
+
+    sample_all = sample_yaml(view, required_only=False).rstrip("\n")
+    sample_required = sample_yaml(view, required_only=True).rstrip("\n")
+
+    func_sig_args = f"*{alias}.{kind},"
+    call_args = "parent"
+    generate_params = f"workloadObj {alias}.{kind}"
+    generate_pass = "&workloadObj"
+    if is_component:
+        func_sig_args += f"\n\t*{coll.api_import_alias}.{coll.kind},"
+        call_args = "parent, collection"
+        generate_params = (
+            f"\n\tworkloadObj {alias}.{kind},"
+            f"\n\tcollectionObj {coll.api_import_alias}.{coll.kind},\n"
+        )
+        generate_pass = "&workloadObj, &collectionObj"
+
+    create_entries = "\n".join(f"\t{name}," for name in create_names)
+    init_entries = "\n".join(f"\t{name}," for name in init_names)
+
+    cli_block = ""
+    cli_imports = ""
+    if view.has_cli:
+        cli_imports = '\t"fmt"\n\n\t"sigs.k8s.io/yaml"\n'
+        if is_component:
+            cli_sig = "workloadFile []byte, collectionFile []byte"
+            cli_unmarshal = f'''\tvar workloadObj {alias}.{kind}
+\tif err := yaml.Unmarshal(workloadFile, &workloadObj); err != nil {{
+\t\treturn nil, fmt.Errorf("failed to unmarshal yaml into workload: %w", err)
+\t}}
+
+\tif err := orchestrate.Validate(&workloadObj); err != nil {{
+\t\treturn nil, fmt.Errorf("error validating workload yaml: %w", err)
+\t}}
+
+\tvar collectionObj {coll.api_import_alias}.{coll.kind}
+\tif err := yaml.Unmarshal(collectionFile, &collectionObj); err != nil {{
+\t\treturn nil, fmt.Errorf("failed to unmarshal yaml into collection: %w", err)
+\t}}
+
+\tif err := orchestrate.Validate(&collectionObj); err != nil {{
+\t\treturn nil, fmt.Errorf("error validating collection yaml: %w", err)
+\t}}
+
+\treturn Generate(workloadObj, collectionObj)'''
+        elif view.is_collection():
+            cli_sig = "collectionFile []byte"
+            cli_unmarshal = f'''\tvar collectionObj {alias}.{kind}
+\tif err := yaml.Unmarshal(collectionFile, &collectionObj); err != nil {{
+\t\treturn nil, fmt.Errorf("failed to unmarshal yaml into collection: %w", err)
+\t}}
+
+\tif err := orchestrate.Validate(&collectionObj); err != nil {{
+\t\treturn nil, fmt.Errorf("error validating collection yaml: %w", err)
+\t}}
+
+\treturn Generate(collectionObj)'''
+        else:
+            cli_sig = "workloadFile []byte"
+            cli_unmarshal = f'''\tvar workloadObj {alias}.{kind}
+\tif err := yaml.Unmarshal(workloadFile, &workloadObj); err != nil {{
+\t\treturn nil, fmt.Errorf("failed to unmarshal yaml into workload: %w", err)
+\t}}
+
+\tif err := orchestrate.Validate(&workloadObj); err != nil {{
+\t\treturn nil, fmt.Errorf("error validating workload yaml: %w", err)
+\t}}
+
+\treturn Generate(workloadObj)'''
+        cli_block = f'''
+// GenerateForCLI returns the child resources for this workload rendered
+// from YAML manifest files (used by the companion CLI's generate command).
+func GenerateForCLI({cli_sig}) ([]client.Object, error) {{
+{cli_unmarshal}
+}}
+'''
+
+    convert_block = _convert_workload_block(view)
+
+    content = f'''package {pkg}
+
+import (
+{cli_imports}\t"sigs.k8s.io/controller-runtime/pkg/client"
+
+\t"{view.config.repo}/pkg/orchestrate"
+
+\t{alias} "{view.api_types_import}"
+{_collection_import(view)})
+
+// sample{kind} is a sample manifest containing all configurable fields.
+const sample{kind} = `{sample_all}`
+
+// sample{kind}Required is a sample manifest containing only required fields.
+const sample{kind}Required = `{sample_required}`
+
+// Sample returns the sample manifest for this custom resource.
+func Sample(requiredOnly bool) string {{
+\tif requiredOnly {{
+\t\treturn sample{kind}Required
+\t}}
+
+\treturn sample{kind}
+}}
+
+// Generate returns the child resources that are associated with this
+// workload given appropriate structured inputs.
+func Generate({generate_params}) ([]client.Object, error) {{
+\tresourceObjects := []client.Object{{}}
+
+\tfor _, f := range CreateFuncs {{
+\t\tresources, err := f({generate_pass})
+\t\tif err != nil {{
+\t\t\treturn nil, err
+\t\t}}
+
+\t\tresourceObjects = append(resourceObjects, resources...)
+\t}}
+
+\treturn resourceObjects, nil
+}}
+{cli_block}
+// CreateFuncs is an array of functions called to render the child resources
+// of this workload during reconciliation.
+var CreateFuncs = []func(
+\t{func_sig_args}
+) ([]client.Object, error){{
+{create_entries}
+}}
+
+// InitFuncs is an array of functions called prior to starting the controller
+// manager.  CRD child resources are created here so the controller can own
+// custom resources of those types at startup.
+var InitFuncs = []func(
+\t{func_sig_args}
+) ([]client.Object, error){{
+{init_entries}
+}}
+{convert_block}
+'''
+    return FileSpec(
+        path=f"{view.resources_dir}/resources.go", content=content
+    )
+
+
+def _convert_workload_block(view: WorkloadView) -> str:
+    kind = view.kind
+    alias = view.api_import_alias
+    coll = view.collection
+    if view.is_component() and coll is not None:
+        coll_type = f"{coll.api_import_alias}.{coll.kind}"
+        return f'''
+// ConvertWorkload converts generic workloads into the typed workload and
+// collection for this package.
+func ConvertWorkload(component, collection orchestrate.Workload) (
+\t*{alias}.{kind},
+\t*{coll_type},
+\terror,
+) {{
+\tworkload, ok := component.(*{alias}.{kind})
+\tif !ok {{
+\t\treturn nil, nil, {alias}.ErrUnableToConvert{kind}
+\t}}
+
+\tcollectionObj, ok := collection.(*{coll_type})
+\tif !ok {{
+\t\treturn nil, nil, {coll.api_import_alias}.ErrUnableToConvert{coll.kind}
+\t}}
+
+\treturn workload, collectionObj, nil
+}}'''
+    return f'''
+// ConvertWorkload converts a generic workload into the typed workload for
+// this package.
+func ConvertWorkload(component orchestrate.Workload) (*{alias}.{kind}, error) {{
+\tworkload, ok := component.(*{alias}.{kind})
+\tif !ok {{
+\t\treturn nil, {alias}.ErrUnableToConvert{kind}
+\t}}
+
+\treturn workload, nil
+}}'''
+
+
+def definition_files(view: WorkloadView) -> list[FileSpec]:
+    """One Go file per source manifest, each containing the create funcs for
+    the manifest's child resources
+    (reference templates/api/resources/definition.go:45-88)."""
+    specs = []
+    for manifest in view.workload.get_manifests():
+        if not manifest.child_resources:
+            continue
+        specs.append(_definition_file(view, manifest))
+    return specs
+
+
+def _definition_file(view: WorkloadView, manifest) -> FileSpec:
+    pkg = view.package_name
+    args_decl = _workload_args_decl(view)
+    needs_fmt = any(uses_sprintf(c.source_code) for c in manifest.child_resources)
+
+    blocks = []
+    for child in manifest.child_resources:
+        rbac_markers = "\n".join(
+            f"// {r.to_marker().removeprefix('// ')}"
+            for r in (child.rbac or [])
+        )
+        const_decl = ""
+        if child.name_constant():
+            const_decl = (
+                f'// {child.unique_name} holds the name of the {child.kind} '
+                f'resource.\nconst {child.unique_name} = '
+                f'"{child.name_constant()}"\n\n'
+            )
+        include = ""
+        if child.include_code:
+            include = "\n" + "\n".join(
+                "\t" + line for line in child.include_code.split("\n")
+            ) + "\n"
+        namespace_default = ""
+        if not view.workload.is_cluster_scoped():
+            namespace_default = '''
+\tif resourceObj.GetNamespace() == "" {
+\t\tresourceObj.SetNamespace(parent.Namespace)
+\t}
+'''
+        source = "\n".join(
+            "\t" + line if line else "" for line in child.source_code.split("\n")
+        )
+        blocks.append(f'''{rbac_markers}
+
+{const_decl}// {child.create_func_name()} creates the {child.name} {child.kind}
+// resource for the workload.
+func {child.create_func_name()}(
+{args_decl}
+) ([]client.Object, error) {{{include}
+{source}
+{namespace_default}
+\treturn []client.Object{{resourceObj}}, nil
+}}
+''')
+
+    fmt_import = '\t"fmt"\n\n' if needs_fmt else ""
+    content = (
+        f"package {pkg}\n\n"
+        "import (\n"
+        f"{fmt_import}"
+        '\t"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"\n'
+        '\t"sigs.k8s.io/controller-runtime/pkg/client"\n\n'
+        f'\t{view.api_import_alias} "{view.api_types_import}"\n'
+        f"{_collection_import(view)})\n\n" + "\n".join(blocks)
+    )
+    return FileSpec(
+        path=f"{view.resources_dir}/{manifest.source_filename}",
+        content=content,
+    )
+
+
+def mutate_hook(view: WorkloadView) -> FileSpec:
+    """User-owned mutation hook, never overwritten on re-scaffold
+    (reference templates/int/mutate/component.go, SkipFile)."""
+    kind = view.kind
+    args_decl = _workload_args_decl(view)
+    content = f'''package mutate
+
+import (
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+
+\t{view.api_import_alias} "{view.api_types_import}"
+{_collection_import(view)})
+
+// {kind}Mutate mutates a child resource of the {kind} workload prior to
+// apply.  This file is scaffolded once and owned by you: edit it to inject
+// custom mutation logic.  Returning an empty slice drops the resource.
+func {kind}Mutate(
+\toriginal client.Object,
+{args_decl}
+) ([]client.Object, error) {{
+\treturn []client.Object{{original}}, nil
+}}
+'''
+    return FileSpec(
+        path=f"internal/mutate/{view.kind_lower}.go",
+        content=content,
+        if_exists=IfExists.SKIP,
+    )
+
+
+def dependencies_hook(view: WorkloadView) -> FileSpec:
+    """User-owned dependency-check hook, never overwritten on re-scaffold
+    (reference templates/int/dependencies/component.go, SkipFile)."""
+    kind = view.kind
+    content = f'''package dependencies
+
+import (
+\t"{view.config.repo}/pkg/orchestrate"
+)
+
+// {kind}CheckReady performs custom dependency checks for the {kind}
+// workload before resources are created.  This file is scaffolded once and
+// owned by you: edit it to gate reconciliation on external conditions.
+func {kind}CheckReady(r orchestrate.Reconciler, req *orchestrate.Request) (bool, error) {{
+\treturn true, nil
+}}
+'''
+    return FileSpec(
+        path=f"internal/dependencies/{view.kind_lower}.go",
+        content=content,
+        if_exists=IfExists.SKIP,
+    )

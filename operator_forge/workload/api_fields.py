@@ -37,6 +37,9 @@ class APIFields:
     markers: list[str] = dc_field(default_factory=list)
     children: list["APIFields"] = dc_field(default_factory=list)
     default: str = ""
+    # the raw (typed) default value, kept alongside the rendered string so
+    # downstream consumers (e.g. CRD schema generation) see real types
+    default_value: Any = None
     sample: str = ""
     last: bool = False
 
@@ -150,6 +153,7 @@ class APIFields:
     def set_default(self, sample: Any) -> None:
         """Reference api.go:264-277 setDefault."""
         self.default = self.get_sample_value(sample)
+        self.default_value = sample
         if not self.markers:
             self.markers.extend(
                 [

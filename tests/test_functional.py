@@ -1,0 +1,275 @@
+"""Functional tests: run the real CLI flow (init + create api) over the
+fixtures and validate the generated project tree.
+
+Models the reference's `make func-test` flow (Makefile:70-85) which builds
+the binary and runs init + create api over test/cases fixtures.
+"""
+
+import os
+import subprocess
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.cli.main import main as cli_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _generate(tmp_path, fixture: str, repo: str):
+    config = os.path.join(FIXTURES, fixture, "workload.yaml")
+    out = str(tmp_path / "project")
+    rc = cli_main(
+        [
+            "init",
+            "--workload-config", config,
+            "--repo", repo,
+            "--output-dir", out,
+        ]
+    )
+    assert rc == 0
+    rc = cli_main(
+        [
+            "create", "api",
+            "--workload-config", config,
+            "--output-dir", out,
+        ]
+    )
+    assert rc == 0
+    return out
+
+
+def _read(root, rel):
+    with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _go_files(root):
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f.endswith(".go"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def _check_braces_balanced(path):
+    text = open(path, encoding="utf-8").read()
+    # strip strings and comments crudely: count only outside backticks
+    depth = 0
+    in_backtick = False
+    in_string = False
+    in_char = False
+    in_line_comment = False
+    in_block_comment = False
+    prev = ""
+    for ch in text:
+        if in_line_comment:
+            if ch == "\n":
+                in_line_comment = False
+        elif in_block_comment:
+            if prev == "*" and ch == "/":
+                in_block_comment = False
+        elif in_backtick:
+            if ch == "`":
+                in_backtick = False
+        elif in_string:
+            if ch == '"' and prev != "\\":
+                in_string = False
+        elif in_char:
+            if ch == "'" and prev != "\\":
+                in_char = False
+        else:
+            if ch == "`":
+                in_backtick = True
+            elif ch == '"':
+                in_string = True
+            elif ch == "'":
+                in_char = True
+            elif prev == "/" and ch == "/":
+                in_line_comment = True
+            elif prev == "/" and ch == "*":
+                in_block_comment = True
+            elif ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                assert depth >= 0, f"unbalanced braces in {path}"
+        prev = ch
+    assert depth == 0, f"unbalanced braces in {path} (depth {depth})"
+
+
+class TestStandaloneProject:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("standalone")
+        return _generate(tmp, "standalone", "github.com/acme/bookstore-operator")
+
+    def test_project_skeleton(self, project):
+        for rel in [
+            "PROJECT", "go.mod", "main.go", "Dockerfile", "Makefile",
+            "README.md", "hack/boilerplate.go.txt",
+            "pkg/orchestrate/phases.go", "pkg/orchestrate/handlers.go",
+            "config/default/kustomization.yaml",
+            "config/manager/manager.yaml",
+        ]:
+            assert os.path.exists(os.path.join(project, rel)), rel
+
+    def test_api_files(self, project):
+        types = _read(project, "apis/shop/v1alpha1/bookstore_types.go")
+        assert "type BookStoreSpec struct {" in types
+        assert "type BookStoreStatus struct {" in types
+        assert "GetWorkloadGVK()" in types
+        assert "+kubebuilder:subresource:status" in types
+        assert os.path.exists(
+            os.path.join(project, "apis/shop/v1alpha1/groupversion_info.go")
+        )
+        assert os.path.exists(
+            os.path.join(
+                project,
+                "apis/shop/v1alpha1/zz_generated_deepcopy_bookstore.go",
+            )
+        )
+
+    def test_resources_package(self, project):
+        res = _read(project, "apis/shop/v1alpha1/bookstore/resources.go")
+        assert "func Generate(workloadObj shopv1alpha1.BookStore)" in res
+        assert "var CreateFuncs" in res
+        assert "func Sample(requiredOnly bool) string" in res
+        assert "GenerateForCLI" in res  # fixture defines a root command
+        app = _read(project, "apis/shop/v1alpha1/bookstore/app.go")
+        assert "func CreateDeploymentBookstoreApp(" in app
+        assert "parent.Spec.Deployment.Replicas" in app
+        assert "unstructured.Unstructured" in app
+
+    def test_resource_marker_guard_in_definition(self, project):
+        app = _read(project, "apis/shop/v1alpha1/bookstore/app.go")
+        assert "if parent.Spec.Deployment.Debug != true" in app
+
+    def test_controller(self, project):
+        ctl = _read(project, "controllers/shop/bookstore_controller.go")
+        assert "type BookStoreReconciler struct {" in ctl
+        assert "func NewBookStoreReconciler(" in ctl
+        assert "+kubebuilder:rbac:groups=shop.example.io,resources=bookstores" in ctl
+        assert "Phases.HandleExecution" in ctl
+        assert "func (r *BookStoreReconciler) SetupWithManager" in ctl
+        assert os.path.exists(
+            os.path.join(project, "controllers/shop/suite_test.go")
+        )
+
+    def test_hooks_are_skip_files(self, project):
+        mutate_path = os.path.join(project, "internal/mutate/bookstore.go")
+        assert os.path.exists(mutate_path)
+        with open(mutate_path, "a", encoding="utf-8") as fh:
+            fh.write("// user edit\n")
+        # re-scaffold must preserve user edits
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        rc = cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", project]
+        )
+        assert rc == 0
+        assert "// user edit" in _read(project, "internal/mutate/bookstore.go")
+
+    def test_main_go_wiring(self, project):
+        main = _read(project, "main.go")
+        assert 'shopv1alpha1 "github.com/acme/bookstore-operator/apis/shop/v1alpha1"' in main
+        assert "utilruntime.Must(shopv1alpha1.AddToScheme(scheme))" in main
+        assert "shopcontrollers.NewBookStoreReconciler(mgr)" in main
+        # idempotency: fragments inserted exactly once
+        assert main.count("NewBookStoreReconciler") == 1
+
+    def test_crd_yaml(self, project):
+        crd = pyyaml.safe_load(
+            _read(project, "config/crd/bases/shop.example.io_bookstores.yaml")
+        )
+        assert crd["kind"] == "CustomResourceDefinition"
+        assert crd["metadata"]["name"] == "bookstores.shop.example.io"
+        version = crd["spec"]["versions"][0]
+        schema = version["schema"]["openAPIV3Schema"]
+        spec_props = schema["properties"]["spec"]["properties"]
+        assert spec_props["deployment"]["properties"]["replicas"]["type"] == "integer"
+        assert spec_props["deployment"]["properties"]["replicas"]["default"] == 3
+        assert spec_props["app"]["properties"]["label"]["type"] == "string"
+
+    def test_sample(self, project):
+        sample = pyyaml.safe_load(
+            _read(project, "config/samples/shop_v1alpha1_bookstore.yaml")
+        )
+        assert sample["kind"] == "BookStore"
+        assert sample["spec"]["deployment"]["replicas"] == 3
+
+    def test_manager_role(self, project):
+        role = pyyaml.safe_load(_read(project, "config/rbac/role.yaml"))
+        pairs = {
+            (r["apiGroups"][0], r["resources"][0]) for r in role["rules"]
+        }
+        assert ("shop.example.io", "bookstores") in pairs
+        assert ("apps", "deployments") in pairs
+        assert ("batch", "jobs") in pairs  # role escalation
+
+    def test_go_files_brace_balanced(self, project):
+        files = _go_files(project)
+        assert len(files) > 15
+        for path in files:
+            _check_braces_balanced(path)
+
+    def test_gofmt_if_available(self, project):
+        import shutil
+        if not shutil.which("gofmt"):
+            pytest.skip("gofmt not available")
+        for path in _go_files(project):
+            result = subprocess.run(
+                ["gofmt", "-e", path], capture_output=True, text=True
+            )
+            assert result.returncode == 0, result.stderr
+
+
+class TestCollectionProject:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("collection")
+        return _generate(tmp, "collection", "github.com/acme/platform-operator")
+
+    def test_collection_and_component_apis(self, project):
+        assert os.path.exists(
+            os.path.join(project, "apis/platform/v1alpha1/platform_types.go")
+        )
+        assert os.path.exists(
+            os.path.join(project, "apis/platform/v1alpha1/cache_types.go")
+        )
+
+    def test_component_has_collection_ref(self, project):
+        types = _read(project, "apis/platform/v1alpha1/cache_types.go")
+        assert "Collection CacheCollectionSpec" in types
+
+    def test_component_resources_take_collection(self, project):
+        res = _read(project, "apis/platform/v1alpha1/cache/resources.go")
+        assert "collectionObj platformv1alpha1.Platform" in res
+        deploy = _read(project, "apis/platform/v1alpha1/cache/cache_deploy.go")
+        assert "collection *platformv1alpha1.Platform" in deploy
+        assert "collection.Spec.PlatformNamespace" in deploy
+
+    def test_component_controller_watches_collection(self, project):
+        ctl = _read(project, "controllers/platform/cache_controller.go")
+        assert "GetCollection" in ctl
+        assert "requestsForAll" in ctl
+        assert "ErrCollectionNotFound" in ctl
+
+    def test_cluster_scoped_collection_crd(self, project):
+        crd = pyyaml.safe_load(
+            _read(
+                project,
+                "config/crd/bases/platform.example.io_platforms.yaml",
+            )
+        )
+        assert crd["spec"]["scope"] == "Cluster"
+
+    def test_two_reconcilers_wired(self, project):
+        main = _read(project, "main.go")
+        assert "NewPlatformReconciler" in main
+        assert "NewCacheReconciler" in main
+
+    def test_go_files_brace_balanced(self, project):
+        for path in _go_files(project):
+            _check_braces_balanced(path)
