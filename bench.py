@@ -1,0 +1,103 @@
+"""Benchmark: end-to-end code generation (init + create api) throughput.
+
+The reference publishes no benchmark numbers (BASELINE.md); its only
+measurable end state is the functional-generation flow (`make func-test`:
+binary build + init + create api over fixtures, reference Makefile:70-85).
+This benchmark times operator-forge's equivalent end-to-end flow over the
+standalone and collection fixtures and reports generated lines-of-code per
+second.  ``vs_baseline`` is null because the reference defines no published
+number to compare against (BASELINE.json records "published": {}).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from operator_forge.cli.main import main as cli_main  # noqa: E402
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tests", "fixtures"
+)
+
+
+def generate(fixture: str, repo: str, out_dir: str) -> None:
+    config = os.path.join(FIXTURES, fixture, "workload.yaml")
+    rc = cli_main(
+        ["init", "--workload-config", config, "--repo", repo,
+         "--output-dir", out_dir]
+    )
+    assert rc == 0, f"init failed for {fixture}"
+    rc = cli_main(
+        ["create", "api", "--workload-config", config,
+         "--output-dir", out_dir]
+    )
+    assert rc == 0, f"create api failed for {fixture}"
+
+
+def count_loc(root: str) -> int:
+    total = 0
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, "r", encoding="utf-8") as handle:
+                    total += sum(1 for _ in handle)
+            except (UnicodeDecodeError, OSError):
+                pass
+    return total
+
+
+def main() -> None:
+    import io
+    import contextlib
+
+    runs = 5
+    tmp = tempfile.mkdtemp(prefix="operator-forge-bench-")
+    try:
+        # warmup (imports, pyc)
+        with contextlib.redirect_stdout(io.StringIO()):
+            generate("standalone", "github.com/bench/warmup",
+                     os.path.join(tmp, "warmup"))
+
+        start = time.perf_counter()
+        loc = 0
+        for i in range(runs):
+            out_a = os.path.join(tmp, f"standalone-{i}")
+            out_b = os.path.join(tmp, f"collection-{i}")
+            with contextlib.redirect_stdout(io.StringIO()):
+                generate("standalone", "github.com/bench/bookstore", out_a)
+                generate("collection", "github.com/bench/platform", out_b)
+            if i == 0:
+                loc = count_loc(out_a) + count_loc(out_b)
+        elapsed = time.perf_counter() - start
+        per_run = elapsed / runs
+        loc_per_s = (loc / per_run) if per_run > 0 else 0.0
+        print(
+            json.dumps(
+                {
+                    "metric": "codegen_loc_per_s",
+                    "value": round(loc_per_s, 1),
+                    "unit": "generated_loc/s",
+                    "vs_baseline": None,
+                    "detail": {
+                        "fixtures": ["standalone", "collection"],
+                        "runs": runs,
+                        "wall_s_per_run": round(per_run, 4),
+                        "generated_loc_per_run": loc,
+                        "note": "reference publishes no perf numbers "
+                        "(BASELINE.md); metric is self-baselined",
+                    },
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

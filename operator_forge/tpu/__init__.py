@@ -1,0 +1,15 @@
+"""TPU demo payload (SURVEY.md §7.5).
+
+The reference (vmware-tanzu-labs/operator-builder) is a pure-Go Kubernetes
+operator code generator with no numerical workload — there is no JAX/XLA
+surface in its capability contract (SURVEY.md §5, §7.1; BASELINE.json marks
+the pairing SKIP-tier).  Per SURVEY.md §7.5, the only honest TPU-adjacent
+deliverable is a demonstration payload: a JAX batch workload of the sort a
+generated operator would orchestrate as a managed workload (e.g. a training
+Job child resource).  This package provides that payload — a small
+tensor-parallel + data-parallel transformer LM training step, written
+TPU-first (bfloat16 matmuls for the MXU, static shapes, sharding via
+``jax.sharding.Mesh`` + NamedSharding so XLA inserts collectives) — and is
+deliberately NOT presented as part of the code-generation framework's
+capability contract.
+"""

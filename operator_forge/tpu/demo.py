@@ -1,0 +1,207 @@
+"""A small TPU-first transformer LM: the demo batch workload of SURVEY §7.5.
+
+Design notes (TPU-first, not a port of anything in the reference — the
+reference has no ML code):
+
+- matmuls run in bfloat16 (MXU-friendly) with float32 params/accumulation;
+- static shapes everywhere; no data-dependent Python control flow under jit;
+- parallelism via a 2-D ``jax.sharding.Mesh`` with axes ``("data",
+  "model")``: batch is sharded over ``data``; attention heads and MLP hidden
+  width are sharded over ``model`` (Megatron-style tensor parallelism), with
+  XLA inserting the all-reduces implied by the shardings;
+- the whole train step is one jitted function; XLA fuses elementwise ops
+  into the matmuls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class DemoConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 64
+    batch: int = 8
+    learning_rate: float = 1e-2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(config: DemoConfig, key: jax.Array) -> dict:
+    """Initialize parameters as a pytree of float32 arrays."""
+    keys = jax.random.split(key, 2 + config.n_layers)
+    scale = 0.02
+
+    def dense(k, shape):
+        return scale * jax.random.normal(k, shape, dtype=jnp.float32)
+
+    params: dict[str, Any] = {
+        "embed": dense(keys[0], (config.vocab, config.d_model)),
+        "unembed": dense(keys[1], (config.d_model, config.vocab)),
+        "layers": [],
+    }
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[2 + i], 6)
+        params["layers"].append(
+            {
+                "wqkv": dense(lk[0], (config.d_model, 3 * config.d_model)),
+                "wo": dense(lk[1], (config.d_model, config.d_model)),
+                "w1": dense(lk[2], (config.d_model, config.d_ff)),
+                "w2": dense(lk[3], (config.d_ff, config.d_model)),
+                "ln1": jnp.ones((config.d_model,), jnp.float32),
+                "ln2": jnp.ones((config.d_model,), jnp.float32),
+            }
+        )
+    return params
+
+
+def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
+    norm = jnp.sqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+    return (x / norm) * gain
+
+
+def _attention(x: jax.Array, layer: dict, config: DemoConfig) -> jax.Array:
+    b, s, d = x.shape
+    qkv = (x.astype(jnp.bfloat16) @ layer["wqkv"].astype(jnp.bfloat16))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, config.n_heads, config.head_dim).transpose(
+            0, 2, 1, 3
+        )
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(config.head_dim))
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    out = (probs @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    return (out @ layer["wo"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def _mlp(x: jax.Array, layer: dict) -> jax.Array:
+    h = x.astype(jnp.bfloat16) @ layer["w1"].astype(jnp.bfloat16)
+    h = jax.nn.gelu(h)
+    return (h @ layer["w2"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def forward(params: dict, tokens: jax.Array, config: DemoConfig) -> jax.Array:
+    """Token ids [batch, seq] -> logits [batch, seq, vocab]."""
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config)
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+    logits = x.astype(jnp.bfloat16) @ params["unembed"].astype(jnp.bfloat16)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, config: DemoConfig) -> jax.Array:
+    """Next-token cross-entropy."""
+    logits = forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def train_step(params: dict, tokens: jax.Array, config: DemoConfig) -> tuple:
+    """One SGD step; returns (new_params, loss)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, config)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: p - config.learning_rate * g, params, grads
+    )
+    return new_params, loss
+
+
+# -- sharding ------------------------------------------------------------
+
+
+def make_mesh(n_devices: int, devices=None) -> Mesh:
+    """A (data, model) mesh.  Model axis gets 2 when divisible, so tensor
+    parallelism is exercised alongside data parallelism."""
+    devices = devices if devices is not None else jax.devices()[:n_devices]
+    model = 2 if n_devices % 2 == 0 and n_devices >= 2 else 1
+    data = n_devices // model
+    import numpy as np
+
+    grid = np.asarray(devices).reshape(data, model)
+    return Mesh(grid, ("data", "model"))
+
+
+def param_specs(config: DemoConfig) -> dict:
+    """Megatron-style partition specs: qkv/w1 column-parallel, wo/w2
+    row-parallel over the ``model`` axis; norms and embeddings replicated."""
+    layer = {
+        "wqkv": P(None, "model"),
+        "wo": P("model", None),
+        "w1": P(None, "model"),
+        "w2": P("model", None),
+        "ln1": P(None),
+        "ln2": P(None),
+    }
+    return {
+        "embed": P(None, None),
+        "unembed": P(None, "model"),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
+def sharded_train_step(mesh: Mesh, config: DemoConfig):
+    """Build a jitted train step with explicit input/output shardings; XLA
+    lowers the implied cross-device communication onto the mesh (ICI on real
+    hardware)."""
+    specs = param_specs(config)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    data_sharding = NamedSharding(mesh, P("data", None))
+    return jax.jit(
+        partial(train_step, config=config),
+        in_shardings=(param_shardings, data_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+    )
+
+
+def run_dryrun(n_devices: int, config: DemoConfig | None = None) -> float:
+    """Create an n-device mesh, jit the full sharded train step, and run one
+    step on tiny shapes.  Returns the loss as a Python float."""
+    config = config or DemoConfig(
+        d_model=64, n_heads=2, n_layers=2, d_ff=128, seq_len=16, batch=8
+    )
+    mesh = make_mesh(n_devices)
+    key = jax.random.PRNGKey(0)
+    params = init_params(config, key)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (config.batch, config.seq_len + 1), 0,
+        config.vocab,
+    )
+    step = sharded_train_step(mesh, config)
+    with mesh:
+        params = jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec),
+                param_specs(config),
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        new_params, loss = step(params, tokens)
+        jax.block_until_ready(loss)
+    return float(loss)

@@ -13,3 +13,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# this environment's jax build can shadow JAX_PLATFORMS with its TPU tunnel
+# plugin; force the platform through the config API as well
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except Exception:
+    pass
