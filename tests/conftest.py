@@ -7,7 +7,10 @@ environment must be set before jax is first imported, hence here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the environment's axon pytest plugin pre-sets
+# JAX_PLATFORMS=axon (one real TPU chip), but tests need the virtual
+# 8-device CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
