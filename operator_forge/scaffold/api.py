@@ -11,7 +11,9 @@ from ..workload.config import Processor
 from .context import ProjectConfig, WorkloadView, views_for
 from .machinery import FileSpec, Fragment, Scaffold
 from .templates import api as api_tpl
+from .templates import companion_cli as cli_tpl
 from .templates import controller as controller_tpl
+from .templates import e2e as e2e_tpl
 from .templates import kustomize as kustomize_tpl
 from .templates import resources as resources_tpl
 
@@ -50,6 +52,9 @@ def api_files(views: list[WorkloadView]) -> list[FileSpec]:
     specs.append(kustomize_tpl.crd_kustomization(views))
     specs.append(kustomize_tpl.samples_kustomization(views))
     specs.append(kustomize_tpl.manager_cluster_role(views))
+    if views:
+        specs.extend(cli_tpl.cli_files(views, views[0].config))
+        specs.extend(e2e_tpl.e2e_files(views, views[0].config))
     return specs
 
 

@@ -1,0 +1,362 @@
+"""Companion-CLI templates: the generated cobra CLI that ships with the
+operator (init / generate / version commands).
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/cli/
+{main,cmd_root,cmd_init,cmd_init_sub,cmd_generate,cmd_generate_sub,
+cmd_version,cmd_version_sub}.go.  Capability contract (per SURVEY.md §2.2 and
+docs/companion-cli.md as corrected by the code): ``init`` prints sample CR
+manifests (``-r`` for required-only), ``generate`` renders child resources
+from CR manifest files, ``version`` prints the CLI version and supported API
+versions.
+
+Design deviation from the reference (documented): instead of marker-based
+fragment insertion into the root command, per-workload subcommand files live
+in the same package as their parent command and self-register via Go
+``init()`` — re-scaffolding is a plain overwrite and stays idempotent.
+
+Layout for a standalone workload (single workload, direct commands):
+    cmd/<root>/main.go
+    cmd/<root>/commands/root.go
+    cmd/<root>/commands/initcmd/init.go          (+ <kind>.go)
+    cmd/<root>/commands/generatecmd/generate.go  (+ <kind>.go)
+    cmd/<root>/commands/versioncmd/version.go    (+ <kind>.go)
+
+For collections, every workload (the collection and each component) gets a
+named subcommand under init/generate/version.
+"""
+
+from __future__ import annotations
+
+from ...utils import to_file_name
+from ..context import ProjectConfig, WorkloadView
+from ..machinery import FileSpec
+
+
+def cli_files(
+    views: list[WorkloadView], config: ProjectConfig
+) -> list[FileSpec]:
+    if not config.cli_root_command_name:
+        return []
+    root = config.cli_root_command_name
+    specs = [
+        _main_go(root, config),
+        _root_go(root, config),
+        _parent_cmd(root, config, "initcmd", "init",
+                    "Print sample custom resource manifests"),
+        _parent_cmd(root, config, "generatecmd", "generate",
+                    "Generate child resource manifests from a workload"),
+        _parent_cmd(root, config, "versioncmd", "version",
+                    "Print version and supported API versions"),
+    ]
+    for view in views:
+        specs.append(_init_sub(root, view))
+        specs.append(_generate_sub(root, view))
+        specs.append(_version_sub(root, view))
+    return specs
+
+
+def _cmd_name(view: WorkloadView) -> str:
+    """Subcommand name for a workload: its configured companion subcommand
+    name, defaulting to the lowercase kind."""
+    if view.workload.companion_sub_cmd.has_name():
+        return view.workload.companion_sub_cmd.name
+    return view.kind_lower
+
+
+def _cmd_description(view: WorkloadView) -> str:
+    if view.workload.companion_sub_cmd.has_description():
+        return view.workload.companion_sub_cmd.description
+    return f"Manage {view.kind_lower} workload"
+
+
+def _main_go(root: str, config: ProjectConfig) -> FileSpec:
+    content = f'''package main
+
+import (
+\t"os"
+
+\t"{config.repo}/cmd/{root}/commands"
+)
+
+func main() {{
+\tif err := commands.NewRootCommand().Execute(); err != nil {{
+\t\tos.Exit(1)
+\t}}
+}}
+'''
+    return FileSpec(path=f"cmd/{root}/main.go", content=content)
+
+
+def _root_go(root: str, config: ProjectConfig) -> FileSpec:
+    description = config.cli_root_command_description or f"Manage {root} workloads"
+    content = f'''package commands
+
+import (
+\t"github.com/spf13/cobra"
+
+\t"{config.repo}/cmd/{root}/commands/generatecmd"
+\t"{config.repo}/cmd/{root}/commands/initcmd"
+\t"{config.repo}/cmd/{root}/commands/versioncmd"
+)
+
+// NewRootCommand assembles the {root} command tree.
+func NewRootCommand() *cobra.Command {{
+\troot := &cobra.Command{{
+\t\tUse:   "{root}",
+\t\tShort: "{description}",
+\t\tLong:  "{description}",
+\t}}
+
+\troot.AddCommand(
+\t\tinitcmd.Command(),
+\t\tgeneratecmd.Command(),
+\t\tversioncmd.Command(),
+\t)
+
+\treturn root
+}}
+'''
+    return FileSpec(path=f"cmd/{root}/commands/root.go", content=content)
+
+
+def _parent_cmd(
+    root: str, config: ProjectConfig, pkg: str, use: str, short: str
+) -> FileSpec:
+    extra = ""
+    if pkg == "versioncmd":
+        extra = (
+            "\n// cliVersion is stamped at build time via\n"
+            '// -ldflags "-X .../versioncmd.cliVersion=v1.2.3".\n'
+            'var cliVersion = "dev"\n'
+        )
+    content = f'''package {pkg}
+
+import (
+\t"github.com/spf13/cobra"
+)
+{extra}
+// subcommands are registered by the per-workload files in this package via
+// init(), keeping re-scaffolding a plain overwrite.
+var subcommands []func() *cobra.Command
+
+// Command builds the `{use}` command with all registered workload
+// subcommands attached.
+func Command() *cobra.Command {{
+\tcmd := &cobra.Command{{
+\t\tUse:   "{use}",
+\t\tShort: "{short}",
+\t}}
+
+\tfor _, build := range subcommands {{
+\t\tcmd.AddCommand(build())
+\t}}
+
+\treturn cmd
+}}
+'''
+    return FileSpec(
+        path=f"cmd/{root}/commands/{pkg}/{use}.go", content=content
+    )
+
+
+def _init_sub(root: str, view: WorkloadView) -> FileSpec:
+    """Per-workload `init` subcommand: prints the sample CR manifest
+    (reference templates/cli/cmd_init_sub.go)."""
+    name = _cmd_name(view)
+    content = f'''package initcmd
+
+import (
+\t"fmt"
+
+\t"github.com/spf13/cobra"
+
+\t{view.package_name} "{view.resources_import}"
+)
+
+func init() {{
+\tsubcommands = append(subcommands, new{view.kind}SubCommand)
+}}
+
+// new{view.kind}SubCommand prints a sample {view.kind} manifest.
+func new{view.kind}SubCommand() *cobra.Command {{
+\tvar requiredOnly bool
+
+\tcmd := &cobra.Command{{
+\t\tUse:   "{name}",
+\t\tShort: "Print a sample {view.kind} manifest",
+\t\tRunE: func(cmd *cobra.Command, args []string) error {{
+\t\t\tfmt.Println({view.package_name}.Sample(requiredOnly))
+
+\t\t\treturn nil
+\t\t}},
+\t}}
+
+\tcmd.Flags().BoolVarP(
+\t\t&requiredOnly, "required-only", "r", false,
+\t\t"print only required fields",
+\t)
+
+\treturn cmd
+}}
+'''
+    return FileSpec(
+        path=f"cmd/{root}/commands/initcmd/"
+        f"{to_file_name(view.group)}_{to_file_name(view.kind_lower)}.go",
+        content=content,
+    )
+
+
+def _generate_sub(root: str, view: WorkloadView) -> FileSpec:
+    """Per-workload `generate` subcommand: renders child resources from CR
+    manifest files (reference templates/cli/cmd_generate_sub.go:49-332)."""
+    name = _cmd_name(view)
+    coll = view.collection
+    is_component = view.is_component() and coll is not None
+
+    if is_component:
+        flags = '''\tcmd.Flags().StringVarP(
+\t\t&workloadManifest, "workload-manifest", "w", "",
+\t\t"path to the workload manifest file",
+\t)
+\t_ = cmd.MarkFlagRequired("workload-manifest")
+
+\tcmd.Flags().StringVarP(
+\t\t&collectionManifest, "collection-manifest", "c", "",
+\t\t"path to the collection manifest file",
+\t)
+\t_ = cmd.MarkFlagRequired("collection-manifest")'''
+        vars_decl = "\tvar workloadManifest, collectionManifest string"
+        load = '''\t\t\tworkloadBytes, err := os.ReadFile(workloadManifest)
+\t\t\tif err != nil {
+\t\t\t\treturn fmt.Errorf("unable to read workload manifest: %w", err)
+\t\t\t}
+
+\t\t\tcollectionBytes, err := os.ReadFile(collectionManifest)
+\t\t\tif err != nil {
+\t\t\t\treturn fmt.Errorf("unable to read collection manifest: %w", err)
+\t\t\t}
+'''
+        call = (
+            f"{view.package_name}.GenerateForCLI(workloadBytes, "
+            "collectionBytes)"
+        )
+    elif view.is_collection():
+        flags = '''\tcmd.Flags().StringVarP(
+\t\t&collectionManifest, "collection-manifest", "c", "",
+\t\t"path to the collection manifest file",
+\t)
+\t_ = cmd.MarkFlagRequired("collection-manifest")'''
+        vars_decl = "\tvar collectionManifest string"
+        load = '''\t\t\tcollectionBytes, err := os.ReadFile(collectionManifest)
+\t\t\tif err != nil {
+\t\t\t\treturn fmt.Errorf("unable to read collection manifest: %w", err)
+\t\t\t}
+'''
+        call = f"{view.package_name}.GenerateForCLI(collectionBytes)"
+    else:
+        flags = '''\tcmd.Flags().StringVarP(
+\t\t&workloadManifest, "workload-manifest", "w", "",
+\t\t"path to the workload manifest file",
+\t)
+\t_ = cmd.MarkFlagRequired("workload-manifest")'''
+        vars_decl = "\tvar workloadManifest string"
+        load = '''\t\t\tworkloadBytes, err := os.ReadFile(workloadManifest)
+\t\t\tif err != nil {
+\t\t\t\treturn fmt.Errorf("unable to read workload manifest: %w", err)
+\t\t\t}
+'''
+        call = f"{view.package_name}.GenerateForCLI(workloadBytes)"
+
+    content = f'''package generatecmd
+
+import (
+\t"fmt"
+\t"os"
+
+\t"github.com/spf13/cobra"
+\t"sigs.k8s.io/yaml"
+
+\t{view.package_name} "{view.resources_import}"
+)
+
+func init() {{
+\tsubcommands = append(subcommands, new{view.kind}SubCommand)
+}}
+
+// new{view.kind}SubCommand renders the child resources of a {view.kind}.
+func new{view.kind}SubCommand() *cobra.Command {{
+{vars_decl}
+
+\tcmd := &cobra.Command{{
+\t\tUse:   "{name}",
+\t\tShort: "{_cmd_description(view)}",
+\t\tRunE: func(cmd *cobra.Command, args []string) error {{
+{load}
+\t\t\tresources, err := {call}
+\t\t\tif err != nil {{
+\t\t\t\treturn err
+\t\t\t}}
+
+\t\t\tfor _, resource := range resources {{
+\t\t\t\tout, err := yaml.Marshal(resource)
+\t\t\t\tif err != nil {{
+\t\t\t\t\treturn fmt.Errorf("unable to marshal resource: %w", err)
+\t\t\t\t}}
+
+\t\t\t\tfmt.Println("---")
+\t\t\t\tfmt.Print(string(out))
+\t\t\t}}
+
+\t\t\treturn nil
+\t\t}},
+\t}}
+
+{flags}
+
+\treturn cmd
+}}
+'''
+    return FileSpec(
+        path=f"cmd/{root}/commands/generatecmd/"
+        f"{to_file_name(view.group)}_{to_file_name(view.kind_lower)}.go",
+        content=content,
+    )
+
+
+def _version_sub(root: str, view: WorkloadView) -> FileSpec:
+    """Per-workload `version` subcommand
+    (reference templates/cli/cmd_version_sub.go)."""
+    name = _cmd_name(view)
+    content = f'''package versioncmd
+
+import (
+\t"fmt"
+
+\t"github.com/spf13/cobra"
+)
+
+func init() {{
+\tsubcommands = append(subcommands, new{view.kind}SubCommand)
+}}
+
+// new{view.kind}SubCommand prints the CLI version and the supported API
+// versions for {view.kind}.
+func new{view.kind}SubCommand() *cobra.Command {{
+\treturn &cobra.Command{{
+\t\tUse:   "{name}",
+\t\tShort: "Print version information for {view.kind}",
+\t\tRunE: func(cmd *cobra.Command, args []string) error {{
+\t\t\tfmt.Printf("CLI version: %s\\n", cliVersion)
+\t\t\tfmt.Printf("supported API versions for {view.kind}: %v\\n",
+\t\t\t\t[]string{{"{view.version}"}})
+
+\t\t\treturn nil
+\t\t}},
+\t}}
+}}
+'''
+    return FileSpec(
+        path=f"cmd/{root}/commands/versioncmd/"
+        f"{to_file_name(view.group)}_{to_file_name(view.kind_lower)}.go",
+        content=content,
+    )

@@ -1,0 +1,270 @@
+"""E2E test templates for generated projects.
+
+Reference: internal/plugins/workload/v1/scaffolds/templates/test/e2e/
+{e2e,workloads}.go — a suite (build tag ``e2e_test``) run against a real
+cluster via kubeconfig: create each workload from its sample, wait for child
+resources to converge, mutate the parent, delete, and verify teardown; wait
+helpers use a 90s timeout with a 3s interval (reference e2e.go:117-122).
+"""
+
+from __future__ import annotations
+
+from ...utils import to_file_name
+from ..context import ProjectConfig, WorkloadView
+from ..machinery import FileSpec
+
+
+def e2e_files(
+    views: list[WorkloadView], config: ProjectConfig
+) -> list[FileSpec]:
+    specs = [_common(views, config)]
+    for view in views:
+        specs.append(_workload_test(view))
+    return specs
+
+
+def _common(views: list[WorkloadView], config: ProjectConfig) -> FileSpec:
+    api_imports = []
+    schemes = []
+    seen = set()
+    for view in views:
+        alias = view.api_import_alias
+        if alias in seen:
+            continue
+        seen.add(alias)
+        api_imports.append(f'\t{alias} "{view.api_types_import}"')
+        schemes.append(
+            f"\tif err := {alias}.AddToScheme(scheme.Scheme); err != nil {{\n"
+            f"\t\tpanic(err)\n"
+            f"\t}}"
+        )
+
+    content = f'''//go:build e2e_test
+
+// Package e2e runs the operator's end-to-end suite against the cluster
+// selected by the current kubeconfig context.  Typical flow:
+//
+//\tmake install          # install CRDs
+//\tmake run &            # or deploy the controller in-cluster
+//\tmake test-e2e
+package e2e
+
+import (
+\t"context"
+\t"fmt"
+\t"os"
+\t"testing"
+\t"time"
+
+\t"k8s.io/apimachinery/pkg/api/errors"
+\t"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"
+\t"k8s.io/client-go/kubernetes/scheme"
+\tctrl "sigs.k8s.io/controller-runtime"
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+\tsigsyaml "sigs.k8s.io/yaml"
+
+{chr(10).join(api_imports)}
+)
+
+const (
+\twaitTimeout  = 90 * time.Second
+\twaitInterval = 3 * time.Second
+)
+
+var k8sClient client.Client
+
+func TestMain(m *testing.M) {{
+\tcfg, err := ctrl.GetConfig()
+\tif err != nil {{
+\t\tfmt.Println("unable to load kubeconfig:", err)
+\t\tos.Exit(1)
+\t}}
+
+{chr(10).join(schemes)}
+
+\tk8sClient, err = client.New(cfg, client.Options{{Scheme: scheme.Scheme}})
+\tif err != nil {{
+\t\tfmt.Println("unable to create client:", err)
+\t\tos.Exit(1)
+\t}}
+
+\tos.Exit(m.Run())
+}}
+
+// waitFor polls condition until it returns true or the suite wait timeout
+// elapses.
+func waitFor(t *testing.T, what string, condition func() (bool, error)) {{
+\tt.Helper()
+
+\tdeadline := time.Now().Add(waitTimeout)
+
+\tfor {{
+\t\tok, err := condition()
+\t\tif err != nil {{
+\t\t\tt.Logf("condition %s errored: %v", what, err)
+\t\t}}
+
+\t\tif ok {{
+\t\t\treturn
+\t\t}}
+
+\t\tif time.Now().After(deadline) {{
+\t\t\tt.Fatalf("timed out waiting for %s", what)
+\t\t}}
+
+\t\ttime.Sleep(waitInterval)
+\t}}
+}}
+
+// fromSampleYAML decodes a sample manifest into obj.
+func fromSampleYAML(sample string, obj client.Object) error {{
+\treturn sigsyaml.Unmarshal([]byte(sample), obj)
+}}
+
+// childExists reports whether the child resource described by gvk/name/ns
+// exists in the cluster.
+func childExists(ctx context.Context, group, version, kind, name, namespace string) (bool, error) {{
+\tlive := &unstructured.Unstructured{{}}
+\tlive.SetAPIVersion(apiVersionFor(group, version))
+\tlive.SetKind(kind)
+
+\terr := k8sClient.Get(ctx, client.ObjectKey{{Name: name, Namespace: namespace}}, live)
+\tif err != nil {{
+\t\tif errors.IsNotFound(err) {{
+\t\t\treturn false, nil
+\t\t}}
+
+\t\treturn false, err
+\t}}
+
+\treturn true, nil
+}}
+
+func apiVersionFor(group, version string) string {{
+\tif group == "" {{
+\t\treturn version
+\t}}
+
+\treturn group + "/" + version
+}}
+'''
+    return FileSpec(
+        path="test/e2e/e2e_test.go", content=content, add_boilerplate=False
+    )
+
+
+def _workload_test(view: WorkloadView) -> FileSpec:
+    kind = view.kind
+    alias = view.api_import_alias
+    pkg = view.package_name
+    coll = view.collection
+    is_component = view.is_component() and coll is not None
+
+    if is_component:
+        generate_children = f'''\tcollection := &{coll.api_import_alias}.{coll.kind}{{}}
+\tif err := fromSampleYAML({coll.package_name}.Sample(false), collection); err != nil {{
+\t\tt.Fatalf("unable to decode collection sample: %v", err)
+\t}}
+
+\tchildren, err := {pkg}.Generate(*workload, *collection)'''
+    else:
+        generate_children = f"\tchildren, err := {pkg}.Generate(*workload)"
+
+    extra_imports = ""
+    if is_component:
+        extra_imports = (
+            f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+            f'\t{coll.package_name} "{coll.resources_import}"\n'
+        )
+
+    content = f'''//go:build e2e_test
+
+package e2e
+
+import (
+\t"context"
+\t"testing"
+
+\t"k8s.io/apimachinery/pkg/api/errors"
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+
+\t{alias} "{view.api_types_import}"
+\t{pkg} "{view.resources_import}"
+{extra_imports})
+
+// Test{kind}Lifecycle creates the {kind} sample, waits for its child
+// resources to exist, updates the parent, deletes it, and verifies
+// teardown.
+func Test{kind}Lifecycle(t *testing.T) {{
+\tctx := context.Background()
+
+\tworkload := &{alias}.{kind}{{}}
+\tif err := fromSampleYAML({pkg}.Sample(false), workload); err != nil {{
+\t\tt.Fatalf("unable to decode sample: %v", err)
+\t}}
+
+\tif workload.GetNamespace() == "" {{
+\t\tworkload.SetNamespace("default")
+\t}}
+
+\t// create
+\tif err := k8sClient.Create(ctx, workload); err != nil {{
+\t\tt.Fatalf("unable to create workload: %v", err)
+\t}}
+
+\tdefer func() {{
+\t\t_ = k8sClient.Delete(ctx, workload)
+\t}}()
+
+\t// children converge
+{generate_children}
+\tif err != nil {{
+\t\tt.Fatalf("unable to render children: %v", err)
+\t}}
+
+\tfor _, child := range children {{
+\t\tchild := child
+\t\tgvk := child.GetObjectKind().GroupVersionKind()
+
+\t\tnamespace := child.GetNamespace()
+\t\tif namespace == "" {{
+\t\t\tnamespace = workload.GetNamespace()
+\t\t}}
+
+\t\twaitFor(t, "child "+gvk.Kind+"/"+child.GetName(), func() (bool, error) {{
+\t\t\treturn childExists(ctx, gvk.Group, gvk.Version, gvk.Kind, child.GetName(), namespace)
+\t\t}})
+\t}}
+
+\t// parent reports created
+\twaitFor(t, "{kind} status.created", func() (bool, error) {{
+\t\tlive := &{alias}.{kind}{{}}
+\t\tif err := k8sClient.Get(ctx, client.ObjectKeyFromObject(workload), live); err != nil {{
+\t\t\treturn false, err
+\t\t}}
+
+\t\treturn live.Status.Created, nil
+\t}})
+
+\t// delete and verify teardown
+\tif err := k8sClient.Delete(ctx, workload); err != nil {{
+\t\tt.Fatalf("unable to delete workload: %v", err)
+\t}}
+
+\twaitFor(t, "{kind} deletion", func() (bool, error) {{
+\t\tlive := &{alias}.{kind}{{}}
+\t\terr := k8sClient.Get(ctx, client.ObjectKeyFromObject(workload), live)
+\t\tif errors.IsNotFound(err) {{
+\t\t\treturn true, nil
+\t\t}}
+
+\t\treturn false, err
+\t}})
+}}
+'''
+    return FileSpec(
+        path=f"test/e2e/{to_file_name(view.group)}_"
+        f"{to_file_name(view.kind_lower)}_test.go",
+        content=content,
+        add_boilerplate=False,
+    )

@@ -273,3 +273,65 @@ class TestCollectionProject:
     def test_go_files_brace_balanced(self, project):
         for path in _go_files(project):
             _check_braces_balanced(path)
+
+
+class TestCompanionCLIAndE2E:
+    @pytest.fixture(scope="class")
+    def standalone(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("cli-standalone")
+        return _generate(tmp, "standalone", "github.com/acme/bookstore-operator")
+
+    @pytest.fixture(scope="class")
+    def collection(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("cli-collection")
+        return _generate(tmp, "collection", "github.com/acme/platform-operator")
+
+    def test_cli_tree(self, standalone):
+        for rel in [
+            "cmd/bookstorectl/main.go",
+            "cmd/bookstorectl/commands/root.go",
+            "cmd/bookstorectl/commands/initcmd/init.go",
+            "cmd/bookstorectl/commands/initcmd/shop_bookstore.go",
+            "cmd/bookstorectl/commands/generatecmd/generate.go",
+            "cmd/bookstorectl/commands/generatecmd/shop_bookstore.go",
+            "cmd/bookstorectl/commands/versioncmd/version.go",
+            "cmd/bookstorectl/commands/versioncmd/shop_bookstore.go",
+        ]:
+            assert os.path.exists(os.path.join(standalone, rel)), rel
+
+    def test_generate_subcommand_reads_workload_manifest(self, standalone):
+        gen = _read(
+            standalone, "cmd/bookstorectl/commands/generatecmd/shop_bookstore.go"
+        )
+        assert "workload-manifest" in gen
+        assert "GenerateForCLI(workloadBytes)" in gen
+
+    def test_component_generate_takes_collection_manifest(self, collection):
+        gen = _read(
+            collection, "cmd/platformctl/commands/generatecmd/platform_cache.go"
+        )
+        assert "collection-manifest" in gen
+        assert "GenerateForCLI(workloadBytes, collectionBytes)" in gen
+
+    def test_e2e_suite(self, standalone):
+        common = _read(standalone, "test/e2e/e2e_test.go")
+        assert "//go:build e2e_test" in common
+        assert "waitTimeout  = 90 * time.Second" in common
+        per = _read(standalone, "test/e2e/shop_bookstore_test.go")
+        assert "func TestBookStoreLifecycle(t *testing.T)" in per
+        assert "childExists" in per
+
+    def test_collection_subcommand_names(self, collection):
+        init_sub = _read(
+            collection, "cmd/platformctl/commands/initcmd/platform_platform.go"
+        )
+        assert '"core"' in init_sub  # configured companionCliSubcmd name
+        cache_sub = _read(
+            collection, "cmd/platformctl/commands/initcmd/platform_cache.go"
+        )
+        assert '"cache"' in cache_sub
+
+    def test_all_go_files_balanced(self, standalone, collection):
+        for project in (standalone, collection):
+            for path in _go_files(project):
+                _check_braces_balanced(path)
