@@ -1,0 +1,98 @@
+"""Edge-case functional tests, mirroring the reference's edge-standalone /
+edge-collection fixture matrix (globbed resources, dotfiles, nested dirs,
+dashes in names, resources up one level, CRD children, no companion CLI)."""
+
+import os
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _generate(tmp_path, fixture: str, repo: str):
+    config = os.path.join(FIXTURES, fixture, "workload.yaml")
+    out = str(tmp_path / "project")
+    assert cli_main(
+        ["init", "--workload-config", config, "--repo", repo,
+         "--output-dir", out]
+    ) == 0
+    assert cli_main(
+        ["create", "api", "--workload-config", config, "--output-dir", out]
+    ) == 0
+    return out
+
+
+def _read(root, rel):
+    with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+class TestEdgeStandalone:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("edge-standalone")
+        return _generate(tmp, "edge-standalone", "github.com/acme/edge-operator")
+
+    def test_glob_resources_expanded(self, project):
+        base = os.path.join(project, "apis/edge/v1alpha1/edgestandalone")
+        files = set(os.listdir(base))
+        assert "glob_a.go" in files
+        assert "glob_b.go" in files
+
+    def test_dotfile_source_name_sanitized(self, project):
+        base = os.path.join(project, "apis/edge/v1alpha1/edgestandalone")
+        assert "hidden_cm.go" in os.listdir(base)
+
+    def test_crd_child_gets_init_func(self, project):
+        res = _read(project, "apis/edge/v1alpha1/edgestandalone/resources.go")
+        # CRD child resources appear in InitFuncs
+        init_funcs = res.split("var InitFuncs")[1]
+        assert "CreateCustomResourceDefinitionWidgetsEdgeExampleIo" in init_funcs
+
+    def test_no_companion_cli(self, project):
+        assert not os.path.exists(os.path.join(project, "cmd"))
+        res = _read(project, "apis/edge/v1alpha1/edgestandalone/resources.go")
+        assert "GenerateForCLI" not in res
+
+
+class TestEdgeCollection:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("edge-collection")
+        return _generate(tmp, "edge-collection", "github.com/acme/fleet-operator")
+
+    def test_component_found_via_glob(self, project):
+        assert os.path.exists(
+            os.path.join(project, "apis/fleet/v1alpha1/queueworker_types.go")
+        )
+
+    def test_resource_up_one_level_loaded(self, project):
+        base = os.path.join(project, "apis/fleet/v1alpha1/queueworker")
+        files = os.listdir(base)
+        assert any(f.startswith("shared_queue") or "queue" in f for f in files)
+
+    def test_dashed_cli_names(self, project):
+        assert os.path.exists(
+            os.path.join(project, "cmd/edge-fleet-ctl/main.go")
+        )
+        makefile = _read(project, "Makefile")
+        assert "bin/edge-fleet-ctl" in makefile
+
+    def test_dashed_component_package_name(self, project):
+        # package names must be flattened lowercase (no dashes)
+        res = _read(project, "apis/fleet/v1alpha1/queueworker/resources.go")
+        assert "package queueworker" in res
+
+    def test_collection_marker_in_shared_resource(self, project):
+        deploy_files = os.listdir(
+            os.path.join(project, "apis/fleet/v1alpha1/queueworker")
+        )
+        target = [f for f in deploy_files if "queue" in f and f != "resources.go"]
+        assert target
+        content = _read(
+            project, f"apis/fleet/v1alpha1/queueworker/{target[0]}"
+        )
+        assert "collection.Spec.WorkerImage" in content
+        assert "parent.Spec.WorkerReplicas" in content
