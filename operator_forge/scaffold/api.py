@@ -123,5 +123,8 @@ def scaffold_api(
 ) -> Scaffold:
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
-    scaffold.execute(api_files(views), main_go_fragments(views))
+    fragments = main_go_fragments(views)
+    for view in views:
+        fragments.extend(api_tpl.kind_registry_fragments(view))
+    scaffold.execute(api_files(views), fragments)
     return scaffold

@@ -14,7 +14,7 @@ from ...utils import to_file_name
 from ...workload.api_fields import APIFields
 from ...workload.fieldmarkers import FieldType
 from ..context import WorkloadView
-from ..machinery import FileSpec
+from ..machinery import FileSpec, Fragment, IfExists
 
 
 def group_version_info(view: WorkloadView) -> FileSpec:
@@ -416,9 +416,36 @@ type {kind}Latest = {alias}.{kind}
 const {kind}LatestVersion = "{view.version}"
 '''
     return [
-        FileSpec(path=f"apis/{view.group}/{kind_file}.go", content=registry),
+        # the registry is created once, then grown through its scaffold
+        # markers as new API versions are added (see kind_registry_fragments)
+        FileSpec(
+            path=f"apis/{view.group}/{kind_file}.go",
+            content=registry,
+            if_exists=IfExists.SKIP,
+        ),
         FileSpec(
             path=f"apis/{view.group}/{kind_file}_latest.go", content=latest
+        ),
+    ]
+
+
+def kind_registry_fragments(view: WorkloadView) -> list[Fragment]:
+    """Insert the current API version into an existing kind registry
+    (reference templates/api/kind.go's Inserter markers
+    ``operator-builder:imports`` / ``operator-builder:groupversions``)."""
+    kind_file = to_file_name(view.kind_lower)
+    path = f"apis/{view.group}/{kind_file}.go"
+    alias = view.api_import_alias
+    return [
+        Fragment(
+            path=path,
+            marker=f"{view.kind_lower}:imports",
+            code=f'{alias} "{view.api_types_import}"',
+        ),
+        Fragment(
+            path=path,
+            marker=f"{view.kind_lower}:versions",
+            code=f"&{alias}.{view.kind}{{}},",
         ),
     ]
 

@@ -96,3 +96,47 @@ class TestEdgeCollection:
         )
         assert "collection.Spec.WorkerImage" in content
         assert "parent.Spec.WorkerReplicas" in content
+
+
+class TestMultiVersion:
+    def test_second_version_inserted_into_kind_registry(self, tmp_path):
+        import shutil
+        fixture = os.path.join(FIXTURES, "standalone")
+        work = tmp_path / "cfg"
+        shutil.copytree(fixture, work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/bookstore-operator",
+             "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        # bump the API version and re-scaffold
+        cfg_text = (work / "workload.yaml").read_text()
+        (work / "workload.yaml").write_text(
+            cfg_text.replace("version: v1alpha1", "version: v1beta1")
+        )
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        registry = _read(out, "apis/shop/bookstore.go")
+        assert "shopv1alpha1.BookStore{}" in registry
+        assert "shopv1beta1.BookStore{}" in registry
+        assert 'shopv1beta1 "github.com/acme/bookstore-operator/apis/shop/v1beta1"' in registry
+        # both version packages exist
+        assert os.path.exists(
+            os.path.join(out, "apis/shop/v1alpha1/bookstore_types.go")
+        )
+        assert os.path.exists(
+            os.path.join(out, "apis/shop/v1beta1/bookstore_types.go")
+        )
+        # latest alias points at the newest scaffolded version
+        latest = _read(out, "apis/shop/bookstore_latest.go")
+        assert 'BookStoreLatestVersion = "v1beta1"' in latest
