@@ -1,0 +1,93 @@
+"""Companion-CLI naming/defaulting tests (reference coverage model:
+internal/workload/v1/commands/companion tests, 581 LoC)."""
+
+from operator_forge.workload.companion import CompanionCLI
+from operator_forge.workload.kinds import (
+    ComponentWorkload,
+    StandaloneWorkload,
+    WorkloadAPISpec,
+    WorkloadCollection,
+)
+
+
+def _standalone(kind="WebStore"):
+    w = StandaloneWorkload("web")
+    w.api_spec = WorkloadAPISpec(domain="d.io", group="g", version="v1", kind=kind)
+    return w
+
+
+def _collection(kind="Platform"):
+    w = WorkloadCollection("plat")
+    w.api_spec = WorkloadAPISpec(domain="d.io", group="g", version="v1", kind=kind)
+    return w
+
+
+def _component(kind="Cache"):
+    w = ComponentWorkload("cache")
+    w.api_spec = WorkloadAPISpec(group="g", version="v1", kind=kind)
+    return w
+
+
+class TestDefaults:
+    def test_rootcmd_default_name_is_lower_kind(self):
+        cli = CompanionCLI()
+        cli.set_defaults(_standalone(), is_subcommand=False)
+        assert cli.name == "webstore"
+        assert cli.description == "Manage webstore workload"
+
+    def test_collection_subcommand_default_name(self):
+        cli = CompanionCLI()
+        cli.set_defaults(_collection(), is_subcommand=True)
+        assert cli.name == "collection"
+
+    def test_collection_rootcommand_description(self):
+        cli = CompanionCLI()
+        cli.set_defaults(_collection(), is_subcommand=False)
+        assert cli.description == "Manage platform collection and components"
+
+    def test_component_subcommand_default(self):
+        cli = CompanionCLI()
+        cli.set_defaults(_component(), is_subcommand=True)
+        assert cli.name == "cache"
+        assert cli.description == "Manage cache workload"
+
+    def test_explicit_values_not_overridden(self):
+        cli = CompanionCLI(name="customctl", description="Custom")
+        cli.set_defaults(_standalone(), is_subcommand=False)
+        assert cli.name == "customctl"
+        assert cli.description == "Custom"
+
+
+class TestCommonValues:
+    def test_kebab_names_derive_file_and_var_names(self):
+        cli = CompanionCLI(name="edge-fleet-ctl")
+        cli.set_common_values(_collection(), is_subcommand=False)
+        assert cli.file_name == "edge_fleet_ctl"
+        assert cli.var_name == "EdgeFleetCtl"
+        assert cli.is_rootcommand and not cli.is_subcommand
+
+    def test_subcommand_relative_filename(self):
+        path = CompanionCLI.subcommand_relative_filename(
+            "platformctl", "generate", "platform", "cache"
+        )
+        assert path == "cmd/platformctl/commands/generate/platform/cache.go"
+
+
+class TestWorkloadSetNames:
+    def test_standalone_without_rootcmd_skips_cli_names(self):
+        w = _standalone()
+        w.set_names()
+        assert w.package_name == "web"
+        assert w.companion_root_cmd.file_name == ""
+
+    def test_standalone_with_rootcmd(self):
+        w = _standalone()
+        w.companion_root_cmd = CompanionCLI(name="webstorectl")
+        w.set_names()
+        assert w.companion_root_cmd.var_name == "Webstorectl"
+
+    def test_component_always_gets_subcommand_values(self):
+        w = _component()
+        w.set_names()
+        assert w.companion_sub_cmd.name == "cache"
+        assert w.companion_sub_cmd.is_subcommand

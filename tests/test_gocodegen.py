@@ -1,0 +1,105 @@
+"""Unit tests for the manifest -> Go object-constructor generator (the
+ocgk-equivalent, reference workload.go:266 generate.Generate call site)."""
+
+import pytest
+
+from operator_forge.gocodegen import generate
+from operator_forge.gocodegen.generate import GenerateError
+
+
+class TestScalars:
+    def test_typed_literals(self):
+        code = generate(
+            "kind: T\nspec:\n  count: 3\n  ratio: 1.5\n  on: true\n  label: x\n",
+            "obj",
+        )
+        assert '"count": 3,' in code
+        assert '"ratio": 1.5,' in code
+        assert '"on": true,' in code
+        assert '"label": "x",' in code
+
+    def test_null_becomes_nil(self):
+        code = generate("kind: T\nspec:\n  empty: null\n", "obj")
+        assert '"empty": nil,' in code
+
+    def test_quoted_number_stays_string(self):
+        code = generate('kind: T\nspec:\n  v: "8080"\n', "obj")
+        assert '"v": "8080",' in code
+
+    def test_string_escaping(self):
+        code = generate('kind: T\nspec:\n  v: "say \\"hi\\""\n', "obj")
+        assert '"say \\"hi\\""' in code
+
+    def test_multiline_string(self):
+        code = generate("kind: T\nspec:\n  script: |\n    a\n    b\n", "obj")
+        assert '"a\\nb\\n"' in code
+
+
+class TestVarSubstitution:
+    def test_var_scalar_is_bare_expression(self):
+        code = generate("kind: T\nspec:\n  replicas: !!var parent.Spec.R\n", "obj")
+        assert '"replicas": parent.Spec.R,' in code
+
+    def test_full_start_end_is_bare_expression(self):
+        code = generate(
+            'kind: T\nspec:\n  name: "!!start parent.Spec.Name !!end"\n', "obj"
+        )
+        assert '"name": parent.Spec.Name,' in code
+
+    def test_mixed_string_is_sprintf(self):
+        code = generate(
+            'kind: T\nspec:\n  name: "!!start parent.Spec.Env !!end-suffix"\n',
+            "obj",
+        )
+        assert 'fmt.Sprintf("%v-suffix", parent.Spec.Env)' in code
+
+    def test_multiple_fragments(self):
+        code = generate(
+            'kind: T\nspec:\n  v: "!!start a.B !!end-!!start c.D !!end"\n', "obj"
+        )
+        assert 'fmt.Sprintf("%v-%v", a.B, c.D)' in code
+
+    def test_percent_escaped_in_sprintf(self):
+        code = generate(
+            'kind: T\nspec:\n  v: "100% !!start a.B !!end"\n', "obj"
+        )
+        assert 'fmt.Sprintf("100%% %v", a.B)' in code
+
+
+class TestCollections:
+    def test_nested_structure(self):
+        code = generate(
+            "kind: T\nspec:\n  tpl:\n    containers:\n    - name: a\n      ports:\n"
+            "      - containerPort: 80\n",
+            "obj",
+        )
+        assert '"containers": []interface{}{' in code
+        assert 'map[string]interface{}{' in code
+        assert '"containerPort": 80,' in code
+
+    def test_empty_collections(self):
+        code = generate("kind: T\nspec:\n  a: {}\n  b: []\n", "obj")
+        assert '"a": map[string]interface{}{},' in code
+        assert '"b": []interface{}{},' in code
+
+    def test_flow_style(self):
+        code = generate(
+            'kind: T\nrules:\n- apiGroups: ["apps", ""]\n', "obj"
+        )
+        assert '"apps",' in code
+        assert '"",' in code
+
+    def test_var_declaration_shape(self):
+        code = generate("kind: T\n", "resourceObj")
+        assert code.startswith("var resourceObj = &unstructured.Unstructured{")
+        assert code.rstrip().endswith("}")
+
+
+class TestErrors:
+    def test_multi_document_rejected(self):
+        with pytest.raises(GenerateError):
+            generate("a: 1\n---\nb: 2\n", "obj")
+
+    def test_non_mapping_root_rejected(self):
+        with pytest.raises(GenerateError):
+            generate("- a\n- b\n", "obj")

@@ -1,0 +1,235 @@
+"""Deep tests for the field-marker transform pipeline (value rewriting,
+comment rewriting, replace semantics, reserved names) and resource markers.
+
+Reference coverage model: internal/workload/v1/markers/*_internal_test.go
+(3,259 LoC — the heaviest-tested area of the reference).
+"""
+
+import pytest
+
+from operator_forge.markers import MarkerError
+from operator_forge.workload.fieldmarkers import (
+    CollectionFieldMarker,
+    FieldMarker,
+    FieldType,
+    MarkerCollection,
+    MarkerType,
+    ReservedMarkerError,
+    ResourceMarker,
+    ResourceMarkerError,
+    inspect_for_yaml,
+)
+from operator_forge.yamldoc import emit_documents
+
+
+def _inspect(text, *types):
+    if not types:
+        types = (MarkerType.FIELD,)
+    return inspect_for_yaml(text, *types)
+
+
+class TestValueRewrite:
+    def test_plain_field_becomes_var(self):
+        out = _inspect("spec:\n  replicas: 2  # +operator-builder:field:name=replicas,type=int\n")
+        content = emit_documents(out.documents)
+        assert "replicas: !!var parent.Spec.Replicas" in content
+
+    def test_dotted_name_titlecases_each_part(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:field:name=a.deeply.nested.path,type=string\n"
+        )
+        content = emit_documents(out.documents)
+        assert "!!var parent.Spec.A.Deeply.Nested.Path" in content
+
+    def test_collection_marker_uses_collection_prefix(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:collection:field:name=shared,type=string\n",
+            MarkerType.COLLECTION,
+        )
+        content = emit_documents(out.documents)
+        assert "!!var collection.Spec.Shared" in content
+
+    def test_replace_rewrites_substring(self):
+        out = _inspect(
+            'metadata:\n  name: dev-app  # +operator-builder:field:name=env,type=string,default="dev",replace="dev"\n'
+        )
+        content = emit_documents(out.documents)
+        assert "!!start parent.Spec.Env !!end-app" in content
+
+    def test_replace_is_regex(self):
+        out = _inspect(
+            'metadata:\n  name: app-v1-east  # +operator-builder:field:name=zone,type=string,default="east",replace="east|west"\n'
+        )
+        content = emit_documents(out.documents)
+        assert "app-v1-!!start parent.Spec.Zone !!end" in content
+
+    def test_original_value_kept_for_sample(self):
+        out = _inspect(
+            "spec:\n  port: 8080  # +operator-builder:field:name=port,type=int\n"
+        )
+        marker = out.results[0].obj
+        assert marker.original_value == "8080"
+
+    def test_replace_marker_original_value_is_replace_text(self):
+        out = _inspect(
+            'metadata:\n  name: dev-app  # +operator-builder:field:name=env,type=string,default="dev",replace="dev"\n'
+        )
+        marker = out.results[0].obj
+        assert marker.original_value == "dev"
+
+
+class TestCommentRewrite:
+    def test_line_comment_rewritten(self):
+        out = _inspect(
+            "spec:\n  replicas: 2  # +operator-builder:field:name=replicas,type=int\n"
+        )
+        content = emit_documents(out.documents)
+        assert "# controlled by field: replicas" in content
+        assert "+operator-builder:field" not in content
+
+    def test_head_comment_rewritten(self):
+        out = _inspect(
+            "spec:\n  # +operator-builder:field:name=label,type=string\n  label: x\n"
+        )
+        content = emit_documents(out.documents)
+        assert "# controlled by field: label" in content
+
+    def test_collection_comment_text(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:collection:field:name=shared,type=string\n",
+            MarkerType.COLLECTION,
+        )
+        content = emit_documents(out.documents)
+        assert "# controlled by collection field: shared" in content
+
+    def test_description_becomes_head_comment(self):
+        out = _inspect(
+            'spec:\n  x: v  # +operator-builder:field:name=f,type=string,description="Sets the thing"\n'
+        )
+        content = emit_documents(out.documents)
+        assert "# Sets the thing" in content
+
+    def test_multiline_description_backtick(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:field:name=f,type=string,"
+            "description=`line one\n#   line two`\n"
+        )
+        marker = out.results[0].obj
+        assert "line one" in marker.description
+        assert "line two" in marker.description
+
+
+class TestReservedAndErrors:
+    @pytest.mark.parametrize(
+        "name", ["collection", "collection.name", "collection.namespace"]
+    )
+    def test_reserved_names_rejected(self, name):
+        with pytest.raises(ReservedMarkerError):
+            _inspect(
+                f"spec:\n  x: v  # +operator-builder:field:name={name},type=string\n"
+            )
+
+    def test_marker_on_mapping_value_rejected(self):
+        with pytest.raises(MarkerError, match="scalar"):
+            _inspect(
+                "# +operator-builder:field:name=f,type=string\nspec:\n  a: 1\n"
+            )
+
+    def test_bad_replace_regex_rejected(self):
+        with pytest.raises(MarkerError, match="regex"):
+            _inspect(
+                'spec:\n  x: v  # +operator-builder:field:name=f,type=string,replace="[unclosed"\n'
+            )
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(MarkerError):
+            _inspect(
+                "spec:\n  x: v  # +operator-builder:field:name=f,type=float\n"
+            )
+
+
+def _field_marker(name, ftype, for_collection=False):
+    marker = FieldMarker(name=name, type=ftype)
+    marker.for_collection = for_collection
+    return marker
+
+
+def _collection_marker(name, ftype):
+    return CollectionFieldMarker(name=name, type=ftype)
+
+
+class TestResourceMarkers:
+    def test_include_code(self):
+        rm = ResourceMarker(field="debug", value=True, include=True)
+        rm.process(
+            MarkerCollection(
+                field_markers=[_field_marker("debug", FieldType.BOOL)]
+            )
+        )
+        assert rm.include_code.startswith("if parent.Spec.Debug != true")
+
+    def test_exclude_code(self):
+        rm = ResourceMarker(field="debug", value=True, include=False)
+        rm.process(
+            MarkerCollection(
+                field_markers=[_field_marker("debug", FieldType.BOOL)]
+            )
+        )
+        assert rm.include_code.startswith("if parent.Spec.Debug == true")
+
+    def test_string_value_quoted(self):
+        rm = ResourceMarker(field="tier", value="premium", include=True)
+        rm.process(
+            MarkerCollection(
+                field_markers=[_field_marker("tier", FieldType.STRING)]
+            )
+        )
+        assert 'parent.Spec.Tier != "premium"' in rm.include_code
+
+    def test_collection_field_uses_collection_prefix(self):
+        rm = ResourceMarker(collection_field="tier", value="a", include=True)
+        rm.process(
+            MarkerCollection(
+                collection_field_markers=[
+                    _collection_marker("tier", FieldType.STRING)
+                ]
+            )
+        )
+        assert "collection.Spec.Tier" in rm.include_code
+
+    def test_missing_include_rejected(self):
+        rm = ResourceMarker(field="x", value=1)
+        with pytest.raises(ResourceMarkerError, match="include"):
+            rm.process(MarkerCollection())
+
+    def test_missing_field_and_value_rejected(self):
+        rm = ResourceMarker(include=True)
+        with pytest.raises(ResourceMarkerError, match="missing"):
+            rm.process(MarkerCollection())
+
+    def test_type_mismatch_rejected(self):
+        rm = ResourceMarker(field="port", value="eighty", include=True)
+        with pytest.raises(ResourceMarkerError, match="mismatch"):
+            rm.process(
+                MarkerCollection(
+                    field_markers=[_field_marker("port", FieldType.INT)]
+                )
+            )
+
+    def test_unassociated_marker_rejected(self):
+        rm = ResourceMarker(field="ghost", value=1, include=True)
+        with pytest.raises(ResourceMarkerError, match="associate"):
+            rm.process(
+                MarkerCollection(
+                    field_markers=[_field_marker("other", FieldType.INT)]
+                )
+            )
+
+    def test_for_collection_marker_matches_collection_field_name(self):
+        # a field marker processed for a collection associates through the
+        # resource marker's collectionField name
+        # (reference resource_marker.go:196-213)
+        rm = ResourceMarker(collection_field="size", value=1, include=True)
+        marker = _field_marker("size", FieldType.INT, for_collection=True)
+        rm.process(MarkerCollection(field_markers=[marker]))
+        assert rm.field_marker is marker
