@@ -33,7 +33,7 @@ def controller_file(view: WorkloadView) -> FileSpec:
     all_rbac = "\n".join([rbac_markers] + child_rbac)
 
     coll_import = ""
-    if is_component:
+    if is_component and coll.api_types_import != view.api_types_import:
         coll_import = (
             f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
         )

@@ -172,10 +172,11 @@ def _workload_test(view: WorkloadView) -> FileSpec:
 
     extra_imports = ""
     if is_component:
-        extra_imports = (
-            f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
-            f'\t{coll.package_name} "{coll.resources_import}"\n'
-        )
+        if coll.api_types_import != view.api_types_import:
+            extra_imports += (
+                f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+            )
+        extra_imports += f'\t{coll.package_name} "{coll.resources_import}"\n'
 
     content = f'''//go:build e2e_test
 

@@ -29,7 +29,12 @@ def _workload_args_decl(view: WorkloadView) -> str:
 
 def _collection_import(view: WorkloadView) -> str:
     coll = view.collection
-    if view.is_component() and coll is not None:
+    if (
+        view.is_component()
+        and coll is not None
+        # same group/version: the workload's own api import already covers it
+        and coll.api_types_import != view.api_types_import
+    ):
         return (
             f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
         )

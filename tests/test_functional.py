@@ -335,3 +335,34 @@ class TestCompanionCLIAndE2E:
         for project in (standalone, collection):
             for path in _go_files(project):
                 _check_braces_balanced(path)
+
+
+class TestGoStructuralLint:
+    """Structural Go checks: unused/duplicate imports, duplicate top-level
+    functions (the likeliest generated-code compile failures)."""
+
+    @pytest.fixture(scope="class")
+    def projects(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("golint")
+        return [
+            _generate(tmp, "standalone", "github.com/acme/bookstore-operator"),
+            _generate(tmp, "collection", "github.com/acme/platform-operator"),
+            _generate(tmp, "edge-standalone", "github.com/acme/edge-operator"),
+            _generate(tmp, "edge-collection", "github.com/acme/fleet-operator"),
+        ]
+
+    def test_no_unused_or_duplicate_imports(self, projects):
+        from golint import check_file
+        problems = []
+        for project in projects:
+            for path in _go_files(project):
+                for problem in check_file(path):
+                    problems.append(f"{path}: {problem}")
+        assert not problems, "\n".join(problems)
+
+    def test_no_duplicate_toplevel_funcs(self, projects):
+        from golint import check_package_dirs
+        problems = []
+        for project in projects:
+            problems.extend(check_package_dirs(project))
+        assert not problems, "\n".join(problems)
