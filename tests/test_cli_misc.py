@@ -1,0 +1,107 @@
+"""Tests for init-config, update license, completion, and version commands."""
+
+import os
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.cli.init_config import sample_config, write_config, InitConfigError
+from operator_forge.cli.main import main as cli_main
+from operator_forge import licensing
+from operator_forge.workload import config as wconfig
+from operator_forge.workload.kinds import decode
+
+
+class TestInitConfig:
+    @pytest.mark.parametrize("wtype", ["standalone", "collection", "component"])
+    def test_sample_decodes_as_workload(self, wtype):
+        data = pyyaml.safe_load(sample_config(wtype))
+        workload = decode(data)
+        workload.validate()
+
+    def test_standalone_sample_parses_end_to_end(self, tmp_path):
+        (tmp_path / "w.yaml").write_text(sample_config("standalone"))
+        (tmp_path / "resources.yaml").write_text(
+            "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: x\n"
+        )
+        processor = wconfig.parse(str(tmp_path / "w.yaml"))
+        assert processor.workload.api_kind == "MyApp"
+
+    def test_write_to_file_and_force(self, tmp_path):
+        target = str(tmp_path / "out.yaml")
+        write_config("standalone", target)
+        assert os.path.exists(target)
+        with pytest.raises(InitConfigError, match="--force"):
+            write_config("standalone", target)
+        write_config("collection", target, force=True)
+        assert "WorkloadCollection" in open(target).read()
+
+    def test_cli_init_config_stdout(self, capsys):
+        assert cli_main(["init-config", "standalone"]) == 0
+        out = capsys.readouterr().out
+        assert "StandaloneWorkload" in out
+
+    def test_unknown_type(self):
+        with pytest.raises(SystemExit):
+            cli_main(["init-config", "bogus"])
+
+
+class TestLicense:
+    def test_project_license(self, tmp_path):
+        src = tmp_path / "LICENSE.src"
+        src.write_text("THE LICENSE TEXT\n")
+        licensing.update_project_license(str(tmp_path), str(src))
+        assert (tmp_path / "LICENSE").read_text() == "THE LICENSE TEXT\n"
+
+    def test_source_header_wraps_plain_text(self, tmp_path):
+        src = tmp_path / "header.txt"
+        src.write_text("Copyright ACME.\n")
+        licensing.update_source_header(str(tmp_path), str(src))
+        content = (tmp_path / "hack" / "boilerplate.go.txt").read_text()
+        assert content.startswith("/*")
+        assert "Copyright ACME." in content
+
+    def test_existing_headers_rewritten(self, tmp_path):
+        go_file = tmp_path / "a.go"
+        go_file.write_text("/*\nOld header\n*/\n\npackage main\n\nfunc main() {}\n")
+        src = tmp_path / "header.txt"
+        src.write_text("New header")
+        rewritten = licensing.update_existing_source_headers(
+            str(tmp_path), str(src)
+        )
+        assert rewritten
+        content = go_file.read_text()
+        assert "New header" in content
+        assert "Old header" not in content
+        assert "package main" in content
+
+    def test_update_license_command(self, tmp_path):
+        src = tmp_path / "lic"
+        src.write_text("L\n")
+        assert cli_main(
+            ["update", "license", "--project-license", str(src),
+             "--output-dir", str(tmp_path)]
+        ) == 0
+        assert (tmp_path / "LICENSE").exists()
+
+    def test_missing_flags_is_error(self, tmp_path):
+        assert cli_main(
+            ["update", "license", "--output-dir", str(tmp_path)]
+        ) == 1
+
+
+class TestMiscCommands:
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        assert "operator-forge version" in capsys.readouterr().out
+
+    @pytest.mark.parametrize("shell", ["bash", "zsh"])
+    def test_completion(self, shell, capsys):
+        assert cli_main(["completion", shell]) == 0
+        assert "operator-forge" in capsys.readouterr().out
+
+    def test_create_api_without_project_errors(self, tmp_path, capsys):
+        assert cli_main(
+            ["create", "api", "--output-dir", str(tmp_path)]
+        ) == 1
+        assert "PROJECT" in capsys.readouterr().err
