@@ -1,0 +1,125 @@
+"""Cross-file consistency checks on generated projects: every Go field path
+referenced by generated child-resource code (``parent.Spec.X.Y`` /
+``collection.Spec.X``) must exist as a field chain in the generated API
+types.  This validates the whole pipeline end to end: marker -> APIFields ->
+types codegen -> ocgk-style object codegen agree with each other."""
+
+import os
+import re
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+_STRUCT_RE = re.compile(
+    r"^type (\w+) struct \{(.*?)^\}", re.MULTILINE | re.DOTALL
+)
+_FIELD_RE = re.compile(r"^\s*(\w+)\s+([\w.\[\]*]+)\s+`", re.MULTILINE)
+_PATH_RE = re.compile(r"\b(parent|collection)\.Spec((?:\.\w+)+)")
+
+
+def _generate(tmp_path, fixture, repo):
+    config = os.path.join(FIXTURES, fixture, "workload.yaml")
+    out = str(tmp_path / "project")
+    assert cli_main(["init", "--workload-config", config, "--repo", repo,
+                     "--output-dir", out]) == 0
+    assert cli_main(["create", "api", "--workload-config", config,
+                     "--output-dir", out]) == 0
+    return out
+
+
+def _parse_structs(project):
+    """struct name -> {field name -> type} across all api types files."""
+    structs = {}
+    apis = os.path.join(project, "apis")
+    for dirpath, _, files in os.walk(apis):
+        for f in files:
+            if not f.endswith("_types.go"):
+                continue
+            text = open(os.path.join(dirpath, f), encoding="utf-8").read()
+            for match in _STRUCT_RE.finditer(text):
+                name, body = match.groups()
+                fields = dict(_FIELD_RE.findall(body))
+                structs[name] = fields
+    return structs
+
+
+def _spec_struct_for(structs, kind):
+    return structs.get(f"{kind}Spec")
+
+
+def _resolve(structs, spec_struct_name, path_parts):
+    """Walk a field chain through the struct graph."""
+    current = structs.get(spec_struct_name)
+    if current is None:
+        return False
+    for i, part in enumerate(path_parts):
+        if part not in current:
+            return False
+        type_name = current[part]
+        if i == len(path_parts) - 1:
+            return True
+        current = structs.get(type_name)
+        if current is None:
+            return False
+    return True
+
+
+def _check_project(project, kind_of_package):
+    structs = _parse_structs(project)
+    problems = []
+    apis = os.path.join(project, "apis")
+    for dirpath, _, files in os.walk(apis):
+        pkg = os.path.basename(dirpath)
+        if pkg not in kind_of_package:
+            continue
+        for f in files:
+            if not f.endswith(".go"):
+                continue
+            text = open(os.path.join(dirpath, f), encoding="utf-8").read()
+            for match in _PATH_RE.finditer(text):
+                who, chain = match.groups()
+                parts = chain.strip(".").split(".")
+                kind = kind_of_package[pkg][
+                    0 if who == "parent" else 1
+                ]
+                if not _resolve(structs, f"{kind}Spec", parts):
+                    problems.append(
+                        f"{os.path.join(dirpath, f)}: {who}.Spec.{chain} "
+                        f"does not resolve in {kind}Spec"
+                    )
+    assert not problems, "\n".join(problems)
+
+
+class TestFieldPathConsistency:
+    def test_standalone(self, tmp_path):
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        _check_project(project, {"bookstore": ("BookStore", None)})
+
+    def test_collection(self, tmp_path):
+        project = _generate(
+            tmp_path, "collection", "github.com/acme/platform-operator"
+        )
+        _check_project(
+            project,
+            {
+                "platform": ("Platform", "Platform"),
+                "cache": ("Cache", "Platform"),
+            },
+        )
+
+    def test_edge_collection(self, tmp_path):
+        project = _generate(
+            tmp_path, "edge-collection", "github.com/acme/fleet-operator"
+        )
+        _check_project(
+            project,
+            {
+                "edgefleet": ("EdgeFleet", "EdgeFleet"),
+                "queueworker": ("QueueWorker", "EdgeFleet"),
+            },
+        )

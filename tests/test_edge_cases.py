@@ -140,3 +140,32 @@ class TestMultiVersion:
         # latest alias points at the newest scaffolded version
         latest = _read(out, "apis/shop/bookstore_latest.go")
         assert 'BookStoreLatestVersion = "v1beta1"' in latest
+
+
+class TestComponentDependencies:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("deps")
+        return _generate(tmp, "deps-collection", "github.com/acme/stack-operator")
+
+    def test_dependency_wired_into_types(self, project):
+        types = _read(project, "apis/stack/v1alpha1/webapp_types.go")
+        block = types.split("func (*WebApp) GetDependencyWorkloads")[1]
+        assert "&Database{}" in block.split("}")[1]
+
+    def test_independent_component_has_no_deps(self, project):
+        types = _read(project, "apis/stack/v1alpha1/database_types.go")
+        block = types.split("func (*Database) GetDependencyWorkloads")[1]
+        body = block.split("return []orchestrate.Workload{")[1].split("}")[0]
+        assert "&" not in body
+
+    def test_lint_clean(self, project):
+        from golint import check_file, check_package_dirs
+        problems = []
+        for dirpath, _, files in os.walk(project):
+            for f in files:
+                if f.endswith(".go"):
+                    path = os.path.join(dirpath, f)
+                    problems += [f"{path}: {p}" for p in check_file(path)]
+        problems += check_package_dirs(project)
+        assert not problems, "\n".join(problems)
