@@ -151,7 +151,7 @@ class TestComponentDependencies:
     def test_dependency_wired_into_types(self, project):
         types = _read(project, "apis/stack/v1alpha1/webapp_types.go")
         block = types.split("func (*WebApp) GetDependencyWorkloads")[1]
-        assert "&Database{}" in block.split("}")[1]
+        assert "&Database{}," in block
 
     def test_independent_component_has_no_deps(self, project):
         types = _read(project, "apis/stack/v1alpha1/database_types.go")
