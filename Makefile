@@ -1,0 +1,38 @@
+# Developer workflow for operator-forge itself
+# (the reference's Makefile equivalents: test, func-test, lint, debug)
+
+PYTHON ?= python
+
+.PHONY: all
+all: test
+
+.PHONY: test
+test: ## Run the full test suite.
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: unit-test
+unit-test: ## Run unit tests only (skip functional project generation).
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_functional.py \
+		--ignore=tests/test_edge_cases.py --ignore=tests/test_consistency.py
+
+.PHONY: func-test
+func-test: ## Generate projects from every fixture into /tmp/operator-forge-func-test.
+	rm -rf /tmp/operator-forge-func-test
+	for fixture in standalone collection edge-standalone edge-collection deps-collection; do \
+		$(PYTHON) -m operator_forge init \
+			--workload-config tests/fixtures/$$fixture/workload.yaml \
+			--repo github.com/func-test/$$fixture \
+			--output-dir /tmp/operator-forge-func-test/$$fixture && \
+		$(PYTHON) -m operator_forge create api \
+			--workload-config tests/fixtures/$$fixture/workload.yaml \
+			--output-dir /tmp/operator-forge-func-test/$$fixture || exit 1; \
+	done
+	@echo "generated codebases in /tmp/operator-forge-func-test"
+
+.PHONY: bench
+bench: ## Run the codegen benchmark.
+	$(PYTHON) bench.py
+
+.PHONY: lint
+lint: ## Byte-compile all sources (syntax check).
+	$(PYTHON) -m compileall -q operator_forge tests

@@ -149,3 +149,52 @@ class TestEmit:
     def test_empty_collections(self):
         out = emit_documents(load_documents("a: {}\nb: []\n"))
         assert pyyaml.safe_load(out) == {"a": {}, "b": []}
+
+
+class TestRobustness:
+    def test_anchors_and_aliases_expand(self):
+        text = "defaults: &d\n  cpu: 1\nlimits: *d\n"
+        docs = load_documents(text)
+        data = to_python(docs[0].root)
+        assert data["limits"] == {"cpu": 1}
+        # emission expands the alias; reparse must agree
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == data
+
+    def test_deeply_nested_sequences(self):
+        text = "a:\n- - - leaf\n"
+        docs = load_documents(text)
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == {"a": [[["leaf"]]]}
+
+    def test_single_quoted_scalar_with_apostrophe(self):
+        text = "msg: 'it''s fine'  # note\n"
+        docs = load_documents(text)
+        entry = docs[0].root.entries[0]
+        assert entry.value.value == "it's fine"
+        assert entry.line_comment == "# note"
+
+    def test_windows_line_endings(self):
+        docs = load_documents("a: 1\r\nb: 2  # c\r\n")
+        assert docs[0].root.entries[1].line_comment == "# c"
+
+    def test_empty_document(self):
+        docs = load_documents("---\n---\na: 1\n")
+        assert docs[-1].root is not None
+
+    def test_folded_scalar_resolves(self):
+        docs = load_documents("msg: >\n  one\n  two\n")
+        assert docs[0].root.entries[0].value.value == "one two\n"
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out)["msg"].strip() == "one two"
+
+    def test_comment_only_document_between_docs(self):
+        text = "a: 1\n---\n# just a comment\nb: 2\n"
+        docs = load_documents(text)
+        assert to_python(docs[1].root) == {"b": 2}
+
+    def test_null_values(self):
+        docs = load_documents("a: null\nb: ~\nc:\n")
+        out = emit_documents(docs)
+        parsed = pyyaml.safe_load(out)
+        assert parsed == {"a": None, "b": None, "c": None}
