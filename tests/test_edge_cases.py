@@ -169,3 +169,45 @@ class TestComponentDependencies:
                     problems += [f"{path}: {p}" for p in check_file(path)]
         problems += check_package_dirs(project)
         assert not problems, "\n".join(problems)
+
+
+class TestUpdateFlow:
+    def test_marker_change_updates_types_and_crd(self, tmp_path):
+        import shutil
+        import yaml as pyyaml
+        work = tmp_path / "cfg"
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        for args in (
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/bookstore-operator",
+             "--output-dir", out],
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out],
+        ):
+            assert cli_main(args) == 0
+
+        # change a default and add a new marker, then re-scaffold
+        app = (work / "app.yaml").read_text()
+        app = app.replace("default=3", "default=5")
+        app = app.replace(
+            "- containerPort: 9090",
+            "# +operator-builder:field:name=service.nodePort,type=int,default=30080\n"
+            "        - containerPort: 9090",
+        )
+        (work / "app.yaml").write_text(app)
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        types = _read(out, "apis/shop/v1alpha1/bookstore_types.go")
+        assert "+kubebuilder:default=5" in types
+        assert "NodePort int" in types
+        crd = pyyaml.safe_load(
+            _read(out, "config/crd/bases/shop.example.io_bookstores.yaml")
+        )
+        spec = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"]["spec"]["properties"]
+        assert spec["deployment"]["properties"]["replicas"]["default"] == 5

@@ -1,0 +1,64 @@
+"""Property-style fuzz tests: random YAML trees must survive the
+load -> emit -> load round trip with identical data, and random marker-
+annotated manifests must process without crashing."""
+
+import random
+import string
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.yamldoc import emit_documents, load_documents
+from operator_forge.yamldoc.model import to_python
+
+_SCALARS = [
+    "plain", "with space", "with: colon", "# leading hash", "trailing ",
+    "", "yes", "no", "null", "~", "0755", "1e3", "v1.2.3", "100%",
+    "it's quoted", 'double "quoted"', "multi\nline\ntext", "-dash",
+    "[brackety]", "{bracey}", "*star", "&anchor", "|pipe", ">fold",
+    0, 1, -7, 3.5, True, False, None,
+]
+
+
+def _random_value(rng, depth):
+    if depth >= 3 or rng.random() < 0.4:
+        return rng.choice(_SCALARS)
+    if rng.random() < 0.5:
+        return {
+            "".join(rng.choices(string.ascii_lowercase, k=5)): _random_value(
+                rng, depth + 1
+            )
+            for _ in range(rng.randint(0, 4))
+        }
+    return [_random_value(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+
+
+def _random_doc(rng):
+    return {
+        "".join(rng.choices(string.ascii_lowercase, k=6)): _random_value(rng, 0)
+        for _ in range(rng.randint(1, 5))
+    }
+
+
+class TestFuzzRoundtrip:
+    @pytest.mark.parametrize("seed", range(40))
+    def test_random_tree_roundtrip(self, seed):
+        rng = random.Random(seed)
+        data = _random_doc(rng)
+        text = pyyaml.safe_dump(data, sort_keys=False, allow_unicode=True)
+        docs = load_documents(text)
+        assert to_python(docs[0].root) == data
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == data
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_multidoc_roundtrip(self, seed):
+        rng = random.Random(1000 + seed)
+        datas = [_random_doc(rng) for _ in range(3)]
+        text = "---\n".join(
+            pyyaml.safe_dump(d, sort_keys=False) for d in datas
+        )
+        docs = load_documents(text)
+        out = emit_documents(docs)
+        reparsed = list(pyyaml.safe_load_all(out))
+        assert reparsed == datas

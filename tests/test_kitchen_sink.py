@@ -1,0 +1,95 @@
+"""Kitchen-sink stress test: one workload with ten child-resource kinds and
+every tricky YAML shape (multiline scripts, percent signs, octal-ish modes,
+flow maps in strings, wildcards, non-resource URLs, replace markers in
+sequences, resource markers)."""
+
+import os
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.cli.main import main as cli_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sink")
+    config = os.path.join(FIXTURES, "kitchen-sink", "workload.yaml")
+    out = str(tmp / "project")
+    assert cli_main(["init", "--workload-config", config,
+                     "--repo", "github.com/acme/sink-operator",
+                     "--output-dir", out]) == 0
+    assert cli_main(["create", "api", "--workload-config", config,
+                     "--output-dir", out]) == 0
+    return out
+
+
+def _read(root, rel):
+    with open(os.path.join(root, rel), encoding="utf-8") as fh:
+        return fh.read()
+
+
+class TestKitchenSink:
+    def test_all_ten_kinds_have_create_funcs(self, project):
+        code = _read(project, "apis/sink/v1alpha1/sink/all.go")
+        for kind in ["Namespace", "ServiceAccount", "Secret", "ConfigMap",
+                     "Deployment", "Service", "Ingress",
+                     "HorizontalPodAutoscaler", "NetworkPolicy",
+                     "ClusterRole"]:
+            assert f"func Create{kind}" in code, kind
+
+    def test_multiline_script_preserved(self, project):
+        code = _read(project, "apis/sink/v1alpha1/sink/all.go")
+        assert "#!/bin/sh" in code
+        assert "100% ready" in code
+
+    def test_replace_marker_in_sequence_item(self, project):
+        code = _read(project, "apis/sink/v1alpha1/sink/all.go")
+        assert "parent.Spec.Hostname" in code
+
+    def test_resource_marker_guard(self, project):
+        code = _read(project, "apis/sink/v1alpha1/sink/all.go")
+        assert "if parent.Spec.EnableNetworkPolicy != true" in code
+
+    def test_cluster_role_escalation_with_wildcards(self, project):
+        ctl = _read(project, "controllers/sink/kitchensink_controller.go")
+        assert "resources=*" in ctl
+        assert "urls=/metrics" in ctl
+
+    def test_crd_has_all_fields(self, project):
+        crd = pyyaml.safe_load(
+            _read(project, "config/crd/bases/sink.example.io_kitchensinks.yaml")
+        )
+        props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"]["spec"]["properties"]
+        assert set(props) >= {
+            "targetNamespace", "auth", "replicas", "image", "logLevel",
+            "hostname", "maxReplicas", "enableNetworkPolicy",
+        }
+        assert props["auth"]["properties"]["apiKey"]["description"]
+
+    def test_sample_parses(self, project):
+        sample = pyyaml.safe_load(
+            _read(project, "config/samples/sink_v1alpha1_kitchensink.yaml")
+        )
+        assert sample["spec"]["maxReplicas"] == 10
+        assert sample["spec"]["enableNetworkPolicy"] is False
+
+    def test_structural_lint(self, project):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from golint import check_file, check_package_dirs
+        problems = []
+        for dirpath, _, files in os.walk(project):
+            for f in files:
+                if f.endswith(".go"):
+                    path = os.path.join(dirpath, f)
+                    problems += [f"{path}: {p}" for p in check_file(path)]
+        problems += check_package_dirs(project)
+        assert not problems, "\n".join(problems)
+
+    def test_field_path_consistency(self, project):
+        from test_consistency import _check_project
+        _check_project(project, {"sink": ("KitchenSink", None)})
