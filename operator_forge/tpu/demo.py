@@ -160,17 +160,25 @@ def param_specs(config: DemoConfig) -> dict:
     }
 
 
-def sharded_train_step(mesh: Mesh, config: DemoConfig):
+def sharded_train_step(
+    mesh: Mesh, config: DemoConfig, sequence_parallel: bool = False
+):
     """Build a jitted train step with explicit input/output shardings; XLA
     lowers the implied cross-device communication onto the mesh (ICI on real
-    hardware)."""
+    hardware).
+
+    With ``sequence_parallel`` the token inputs are additionally sharded
+    along the sequence dimension over the ``model`` axis — attention then
+    needs the full sequence per device and XLA inserts the corresponding
+    all-gathers, the standard SP recipe for pre-attention activations."""
     specs = param_specs(config)
     param_shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    data_sharding = NamedSharding(mesh, P("data", None))
+    token_spec = P("data", "model") if sequence_parallel else P("data", None)
+    data_sharding = NamedSharding(mesh, token_spec)
     return jax.jit(
         partial(train_step, config=config),
         in_shardings=(param_shardings, data_sharding),
@@ -179,19 +187,25 @@ def sharded_train_step(mesh: Mesh, config: DemoConfig):
 
 
 def run_dryrun(n_devices: int, config: DemoConfig | None = None) -> float:
-    """Create an n-device mesh, jit the full sharded train step, and run one
-    step on tiny shapes.  Returns the loss as a Python float."""
+    """Create an n-device mesh, jit the full sharded (dp x tp, with
+    sequence-parallel inputs) train step, and run one step on tiny shapes.
+    Returns the loss as a Python float."""
     config = config or DemoConfig(
         d_model=64, n_heads=2, n_layers=2, d_ff=128, seq_len=16, batch=8
     )
     mesh = make_mesh(n_devices)
     key = jax.random.PRNGKey(0)
     params = init_params(config, key)
+    # token length seq_len+1 must divide evenly across the model axis for
+    # the sequence-parallel input sharding; pad up if needed
+    model_size = mesh.devices.shape[1]
+    tok_len = config.seq_len + 1
+    if tok_len % model_size:
+        tok_len += model_size - (tok_len % model_size)
     tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (config.batch, config.seq_len + 1), 0,
-        config.vocab,
+        jax.random.PRNGKey(1), (config.batch, tok_len), 0, config.vocab
     )
-    step = sharded_train_step(mesh, config)
+    step = sharded_train_step(mesh, config, sequence_parallel=True)
     with mesh:
         params = jax.device_put(
             params,
@@ -201,7 +215,9 @@ def run_dryrun(n_devices: int, config: DemoConfig | None = None) -> float:
                 is_leaf=lambda x: isinstance(x, P),
             ),
         )
-        tokens = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P("data", "model"))
+        )
         new_params, loss = step(params, tokens)
         jax.block_until_ready(loss)
     return float(loss)
