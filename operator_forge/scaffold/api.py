@@ -121,6 +121,7 @@ def scaffold_api(
     config: ProjectConfig,
     boilerplate_text: str = "",
 ) -> Scaffold:
+    config.scaffold_output_dir = output_dir
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
     fragments = main_go_fragments(views)

@@ -513,6 +513,43 @@ def _yaml_dump(data, indent: int = 0) -> str:
     return pyyaml.safe_dump(data, sort_keys=False, default_flow_style=False)
 
 
+def _merge_crd_versions(view: WorkloadView, crd: dict) -> dict:
+    """Merge previously scaffolded API versions into a regenerated CRD.
+
+    A multi-version kind must present every version in one CRD document.
+    The current scaffold pass only knows the current config's version, so
+    prior versions are carried over from the existing CRD file on disk with
+    ``storage: false`` (the newest scaffolded version becomes the storage
+    version).  The reference reaches the same end state via controller-gen
+    reading all Go type versions."""
+    import os
+
+    import yaml as pyyaml
+
+    existing_path = os.path.join(
+        view.config.scaffold_output_dir or "",
+        "config", "crd", "bases", view.crd_file_name,
+    )
+    if not view.config.scaffold_output_dir or not os.path.exists(existing_path):
+        return crd
+    try:
+        with open(existing_path, "r", encoding="utf-8") as handle:
+            existing = pyyaml.safe_load(handle.read()) or {}
+    except Exception:
+        return crd
+    old_versions = (existing.get("spec") or {}).get("versions") or []
+    new_names = {v["name"] for v in crd["spec"]["versions"]}
+    carried = []
+    for version in old_versions:
+        if version.get("name") in new_names:
+            continue
+        version = dict(version)
+        version["storage"] = False
+        carried.append(version)
+    crd["spec"]["versions"] = carried + crd["spec"]["versions"]
+    return crd
+
+
 def crd_yaml(view: WorkloadView) -> FileSpec:
     """config/crd/bases/<group>_<plural>.yaml rendered directly from the
     APIFields tree (the reference requires controller-gen for this)."""
@@ -572,6 +609,7 @@ def crd_yaml(view: WorkloadView) -> FileSpec:
             ],
         },
     }
+    crd = _merge_crd_versions(view, crd)
     return FileSpec(
         path=f"config/crd/bases/{view.crd_file_name}",
         content=_yaml_dump(crd),

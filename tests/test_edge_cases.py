@@ -211,3 +211,38 @@ class TestUpdateFlow:
         spec = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
             "properties"]["spec"]["properties"]
         assert spec["deployment"]["properties"]["replicas"]["default"] == 5
+
+
+class TestMultiVersionCRD:
+    def test_crd_carries_all_versions(self, tmp_path):
+        import shutil
+        import yaml as pyyaml
+        work = tmp_path / "cfg"
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        for args in (
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/bookstore-operator",
+             "--output-dir", out],
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out],
+        ):
+            assert cli_main(args) == 0
+
+        cfg_text = (work / "workload.yaml").read_text()
+        (work / "workload.yaml").write_text(
+            cfg_text.replace("version: v1alpha1", "version: v1beta1")
+        )
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        crd = pyyaml.safe_load(
+            _read(out, "config/crd/bases/shop.example.io_bookstores.yaml")
+        )
+        versions = {v["name"]: v for v in crd["spec"]["versions"]}
+        assert set(versions) == {"v1alpha1", "v1beta1"}
+        assert versions["v1beta1"]["storage"] is True
+        assert versions["v1alpha1"]["storage"] is False
