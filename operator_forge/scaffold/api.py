@@ -18,7 +18,9 @@ from .templates import kustomize as kustomize_tpl
 from .templates import resources as resources_tpl
 
 
-def api_files(views: list[WorkloadView]) -> list[FileSpec]:
+def api_files(
+    views: list[WorkloadView], output_dir: str = ""
+) -> list[FileSpec]:
     specs: list[FileSpec] = []
     groups_done: set[str] = set()
     group_versions_done: set[tuple[str, str]] = set()
@@ -46,7 +48,7 @@ def api_files(views: list[WorkloadView]) -> list[FileSpec]:
                 )
             )
 
-        specs.append(api_tpl.crd_yaml(view))
+        specs.append(api_tpl.crd_yaml(view, output_dir))
         specs.append(api_tpl.sample_file(view))
 
     specs.append(kustomize_tpl.crd_kustomization(views))
@@ -121,11 +123,10 @@ def scaffold_api(
     config: ProjectConfig,
     boilerplate_text: str = "",
 ) -> Scaffold:
-    config.scaffold_output_dir = output_dir
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
     fragments = main_go_fragments(views)
     for view in views:
         fragments.extend(api_tpl.kind_registry_fragments(view))
-    scaffold.execute(api_files(views), fragments)
+    scaffold.execute(api_files(views, output_dir), fragments)
     return scaffold

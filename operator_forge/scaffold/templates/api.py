@@ -513,7 +513,7 @@ def _yaml_dump(data, indent: int = 0) -> str:
     return pyyaml.safe_dump(data, sort_keys=False, default_flow_style=False)
 
 
-def _merge_crd_versions(view: WorkloadView, crd: dict) -> dict:
+def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
     """Merge previously scaffolded API versions into a regenerated CRD.
 
     A multi-version kind must present every version in one CRD document.
@@ -523,25 +523,47 @@ def _merge_crd_versions(view: WorkloadView, crd: dict) -> dict:
     version).  The reference reaches the same end state via controller-gen
     reading all Go type versions."""
     import os
+    import sys
 
     import yaml as pyyaml
 
-    existing_path = os.path.join(
-        view.config.scaffold_output_dir or "",
-        "config", "crd", "bases", view.crd_file_name,
-    )
-    if not view.config.scaffold_output_dir or not os.path.exists(existing_path):
+    if not output_dir:
         return crd
+    existing_path = os.path.join(
+        output_dir, "config", "crd", "bases", view.crd_file_name
+    )
+    if not os.path.exists(existing_path):
+        return crd
+    def warn(reason: str) -> None:
+        # never silently drop previously scaffolded versions: overwriting
+        # with a single-version CRD would break clusters storing objects at
+        # an older version
+        print(
+            f"warning: unable to read existing CRD {existing_path} "
+            f"({reason}); keeping only the current API version "
+            f"{view.version} — restore older versions manually if needed",
+            file=sys.stderr,
+        )
+
     try:
         with open(existing_path, "r", encoding="utf-8") as handle:
-            existing = pyyaml.safe_load(handle.read()) or {}
-    except Exception:
+            existing = pyyaml.safe_load(handle.read())
+    except Exception as exc:
+        warn(str(exc))
         return crd
-    old_versions = (existing.get("spec") or {}).get("versions") or []
+
+    spec = existing.get("spec") if isinstance(existing, dict) else None
+    old_versions = spec.get("versions") if isinstance(spec, dict) else None
+    if not isinstance(old_versions, list):
+        # valid YAML but not a CRD document (hand edit, conflict markers
+        # that still parse as a scalar, ...)
+        warn("file does not contain a CRD with spec.versions")
+        return crd
+
     new_names = {v["name"] for v in crd["spec"]["versions"]}
     carried = []
     for version in old_versions:
-        if version.get("name") in new_names:
+        if not isinstance(version, dict) or version.get("name") in new_names:
             continue
         version = dict(version)
         version["storage"] = False
@@ -550,9 +572,11 @@ def _merge_crd_versions(view: WorkloadView, crd: dict) -> dict:
     return crd
 
 
-def crd_yaml(view: WorkloadView) -> FileSpec:
+def crd_yaml(view: WorkloadView, output_dir: str = "") -> FileSpec:
     """config/crd/bases/<group>_<plural>.yaml rendered directly from the
-    APIFields tree (the reference requires controller-gen for this)."""
+    APIFields tree (the reference requires controller-gen for this).
+    ``output_dir`` lets the renderer merge API versions already scaffolded
+    on disk."""
     spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
     scope = "Cluster" if view.workload.is_cluster_scoped() else "Namespaced"
     crd = {
@@ -609,7 +633,7 @@ def crd_yaml(view: WorkloadView) -> FileSpec:
             ],
         },
     }
-    crd = _merge_crd_versions(view, crd)
+    crd = _merge_crd_versions(view, crd, output_dir)
     return FileSpec(
         path=f"config/crd/bases/{view.crd_file_name}",
         content=_yaml_dump(crd),

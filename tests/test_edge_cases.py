@@ -246,3 +246,33 @@ class TestMultiVersionCRD:
         assert set(versions) == {"v1alpha1", "v1beta1"}
         assert versions["v1beta1"]["storage"] is True
         assert versions["v1alpha1"]["storage"] is False
+
+    def test_malformed_existing_crd_warns_and_keeps_current(self, tmp_path, capsys):
+        import shutil
+        import yaml as pyyaml
+        work = tmp_path / "cfg"
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        for args in (
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/bookstore-operator",
+             "--output-dir", out],
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out],
+        ):
+            assert cli_main(args) == 0
+
+        crd_path = os.path.join(
+            out, "config/crd/bases/shop.example.io_bookstores.yaml"
+        )
+        with open(crd_path, "w") as fh:
+            fh.write("<<<<<<< not yaml at all: [\n")
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+        err = capsys.readouterr().err
+        assert "warning: unable to read existing CRD" in err
+        crd = pyyaml.safe_load(_read(out, "config/crd/bases/shop.example.io_bookstores.yaml"))
+        assert [v["name"] for v in crd["spec"]["versions"]] == ["v1alpha1"]
