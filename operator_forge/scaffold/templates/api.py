@@ -537,11 +537,21 @@ def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
     def warn(reason: str) -> None:
         # never silently drop previously scaffolded versions: overwriting
         # with a single-version CRD would break clusters storing objects at
-        # an older version
+        # an older version; keep the unreadable file as a .bak so the
+        # recovery instruction is actionable
+        backup_note = ""
+        try:
+            import shutil
+
+            shutil.copyfile(existing_path, existing_path + ".bak")
+            backup_note = f"; original preserved at {existing_path}.bak"
+        except OSError:
+            pass
         print(
             f"warning: unable to read existing CRD {existing_path} "
             f"({reason}); keeping only the current API version "
-            f"{view.version} — restore older versions manually if needed",
+            f"{view.version} — restore older versions manually if "
+            f"needed{backup_note}",
             file=sys.stderr,
         )
 

@@ -1,0 +1,122 @@
+"""Unit tests for the scaffolding machinery: if-exists policies, boilerplate
+injection, and marker-based fragment insertion (the kubebuilder-machinery
+equivalent, reference SURVEY §2.2)."""
+
+import os
+
+import pytest
+
+from operator_forge.scaffold.machinery import (
+    FileSpec,
+    Fragment,
+    IfExists,
+    Scaffold,
+    ScaffoldError,
+)
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+class TestFileSpecs:
+    def test_overwrite_policy(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        s.execute([FileSpec(path="a.txt", content="one")])
+        s.execute([FileSpec(path="a.txt", content="two")])
+        assert _read(tmp_path / "a.txt") == "two\n"
+
+    def test_skip_policy(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        s.execute([FileSpec(path="a.txt", content="one", if_exists=IfExists.SKIP)])
+        s.execute([FileSpec(path="a.txt", content="two", if_exists=IfExists.SKIP)])
+        assert _read(tmp_path / "a.txt") == "one\n"
+        assert s.skipped == ["a.txt"]
+
+    def test_error_policy(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        s.execute([FileSpec(path="a.txt", content="one", if_exists=IfExists.ERROR)])
+        with pytest.raises(ScaffoldError, match="already exists"):
+            s.execute(
+                [FileSpec(path="a.txt", content="two", if_exists=IfExists.ERROR)]
+            )
+
+    def test_boilerplate_only_on_go_files(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path), boilerplate="/* legal */\n")
+        s.execute(
+            [
+                FileSpec(path="a.go", content="package a\n"),
+                FileSpec(path="b.yaml", content="x: 1\n"),
+            ]
+        )
+        assert _read(tmp_path / "a.go").startswith("/* legal */")
+        assert _read(tmp_path / "b.yaml") == "x: 1\n"
+
+    def test_boilerplate_opt_out(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path), boilerplate="/* legal */\n")
+        s.execute(
+            [FileSpec(path="a.go", content="package a\n", add_boilerplate=False)]
+        )
+        assert _read(tmp_path / "a.go") == "package a\n"
+
+    def test_nested_directories_created(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        s.execute([FileSpec(path="deep/nested/dir/a.txt", content="x")])
+        assert os.path.exists(tmp_path / "deep/nested/dir/a.txt")
+
+
+MAIN = """package main
+
+import (
+\t// +operator-builder:scaffold:imports
+)
+
+func main() {
+\t// +operator-builder:scaffold:reconcilers
+}
+"""
+
+
+class TestFragments:
+    def _scaffold(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        s.execute([FileSpec(path="main.go", content=MAIN)])
+        return s
+
+    def test_insertion_above_marker_with_indent(self, tmp_path):
+        s = self._scaffold(tmp_path)
+        s.execute([], [Fragment(path="main.go", marker="imports", code='"fmt"')])
+        content = _read(tmp_path / "main.go")
+        lines = content.split("\n")
+        idx = next(i for i, l in enumerate(lines) if '"fmt"' in l)
+        assert lines[idx].startswith("\t")
+        assert "scaffold:imports" in lines[idx + 1]
+
+    def test_insertion_is_idempotent(self, tmp_path):
+        s = self._scaffold(tmp_path)
+        frag = Fragment(path="main.go", marker="imports", code='"fmt"')
+        s.execute([], [frag])
+        s.execute([], [frag])
+        assert _read(tmp_path / "main.go").count('"fmt"') == 1
+
+    def test_multiline_fragment(self, tmp_path):
+        s = self._scaffold(tmp_path)
+        code = "if err := setup(); err != nil {\n\tpanic(err)\n}"
+        s.execute([], [Fragment(path="main.go", marker="reconcilers", code=code)])
+        content = _read(tmp_path / "main.go")
+        assert "if err := setup(); err != nil {" in content
+        # partial overlap: a different fragment sharing one line still inserts
+        code2 = "if err := setup2(); err != nil {\n\tpanic(err)\n}"
+        s.execute([], [Fragment(path="main.go", marker="reconcilers", code=code2)])
+        assert "setup2()" in _read(tmp_path / "main.go")
+
+    def test_unknown_marker_errors(self, tmp_path):
+        s = self._scaffold(tmp_path)
+        with pytest.raises(ScaffoldError, match="marker"):
+            s.execute([], [Fragment(path="main.go", marker="nope", code="x")])
+
+    def test_missing_file_errors(self, tmp_path):
+        s = Scaffold(output_dir=str(tmp_path))
+        with pytest.raises(ScaffoldError, match="does not exist"):
+            s.execute([], [Fragment(path="ghost.go", marker="imports", code="x")])
