@@ -27,6 +27,7 @@ def init_files(
     ]
     specs.extend(orchestrate.orchestrate_files(config.repo))
     specs.extend(kustomize.default_tree(config))
+    specs.extend(kustomize.prometheus_tree())
     return specs
 
 

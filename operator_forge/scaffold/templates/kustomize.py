@@ -54,6 +54,8 @@ resources:
 - ../crd
 - ../rbac
 - ../manager
+# Uncomment to scrape controller metrics with the Prometheus operator:
+#- ../prometheus
 """,
             add_boilerplate=False,
         ),
@@ -61,11 +63,32 @@ resources:
             path="config/manager/kustomization.yaml",
             content="""resources:
 - manager.yaml
+- metrics_service.yaml
 
 images:
 - name: controller
   newName: controller
   newTag: latest
+""",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/manager/metrics_service.yaml",
+            content="""apiVersion: v1
+kind: Service
+metadata:
+  labels:
+    control-plane: controller-manager
+  name: controller-manager-metrics-service
+  namespace: system
+spec:
+  ports:
+  - name: http
+    port: 8080
+    protocol: TCP
+    targetPort: 8080
+  selector:
+    control-plane: controller-manager
 """,
             add_boilerplate=False,
         ),
@@ -226,6 +249,39 @@ subjects:
 - kind: ServiceAccount
   name: controller-manager
   namespace: system
+""",
+            add_boilerplate=False,
+        ),
+    ]
+
+
+def prometheus_tree() -> list[FileSpec]:
+    """config/prometheus: an optional ServiceMonitor for the controller's
+    metrics endpoint (the kubebuilder kustomize plugin ships the same tree;
+    enable by uncommenting ``../prometheus`` in config/default)."""
+    return [
+        FileSpec(
+            path="config/prometheus/kustomization.yaml",
+            content="resources:\n- monitor.yaml\n",
+            add_boilerplate=False,
+        ),
+        FileSpec(
+            path="config/prometheus/monitor.yaml",
+            content="""# Prometheus Monitor Service (Metrics)
+apiVersion: monitoring.coreos.com/v1
+kind: ServiceMonitor
+metadata:
+  labels:
+    control-plane: controller-manager
+  name: controller-manager-metrics-monitor
+  namespace: system
+spec:
+  endpoints:
+  - path: /metrics
+    port: http
+  selector:
+    matchLabels:
+      control-plane: controller-manager
 """,
             add_boilerplate=False,
         ),

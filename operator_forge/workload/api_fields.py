@@ -223,6 +223,15 @@ class APIFields:
         """Render sample CR YAML (reference api.go:118-136)."""
         lines: list[str] = []
         self._sample_lines(lines, 0, required_only)
+        # a spec with no (rendered) fields must still parse as an object,
+        # not null — commented-out samples (e.g. the optional collection
+        # reference, rendered as "#collection:") don't count as fields
+        has_real_field = any(
+            line.strip() and not line.lstrip().startswith("#")
+            for line in lines[1:]
+        )
+        if lines and lines[0].endswith(":") and not has_real_field:
+            lines[0] += " {}"
         return "\n".join(lines) + "\n"
 
     def _sample_lines(

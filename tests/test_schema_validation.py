@@ -1,0 +1,105 @@
+"""Validate generated sample CRs against the generated CRD openAPI schemas
+(a consistency check the reference can't do without a cluster), plus
+pipeline coverage for markers on sequence items."""
+
+import os
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.workload.fieldmarkers import MarkerType, inspect_for_yaml
+from operator_forge.yamldoc import emit_documents
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _validate(instance, schema, path="$"):
+    """Minimal openAPI v3 structural validator (type/properties/default)."""
+    errors = []
+    stype = schema.get("type")
+    if stype == "object":
+        if not isinstance(instance, dict):
+            return [f"{path}: expected object, got {type(instance).__name__}"]
+        props = schema.get("properties", {})
+        for key, value in instance.items():
+            if key in props:
+                errors.extend(_validate(value, props[key], f"{path}.{key}"))
+            elif not schema.get("x-kubernetes-preserve-unknown-fields"):
+                errors.append(f"{path}.{key}: unknown property")
+    elif stype == "array":
+        if not isinstance(instance, list):
+            return [f"{path}: expected array"]
+        for i, item in enumerate(instance):
+            errors.extend(_validate(item, schema.get("items", {}), f"{path}[{i}]"))
+    elif stype == "integer":
+        if not isinstance(instance, int) or isinstance(instance, bool):
+            errors.append(f"{path}: expected integer, got {instance!r}")
+    elif stype == "boolean":
+        if not isinstance(instance, bool):
+            errors.append(f"{path}: expected boolean, got {instance!r}")
+    elif stype == "string":
+        if not isinstance(instance, str):
+            errors.append(f"{path}: expected string, got {instance!r}")
+    return errors
+
+
+def _generate(tmp_path, fixture, repo):
+    config = os.path.join(FIXTURES, fixture, "workload.yaml")
+    out = str(tmp_path / "project")
+    assert cli_main(["init", "--workload-config", config, "--repo", repo,
+                     "--output-dir", out]) == 0
+    assert cli_main(["create", "api", "--workload-config", config,
+                     "--output-dir", out]) == 0
+    return out
+
+
+@pytest.mark.parametrize(
+    "fixture,repo",
+    [
+        ("standalone", "github.com/acme/bookstore-operator"),
+        ("collection", "github.com/acme/platform-operator"),
+        ("kitchen-sink", "github.com/acme/sink-operator"),
+        ("deps-collection", "github.com/acme/stack-operator"),
+    ],
+)
+def test_samples_validate_against_crds(tmp_path, fixture, repo):
+    project = _generate(tmp_path, fixture, repo)
+    crd_dir = os.path.join(project, "config", "crd", "bases")
+    samples_dir = os.path.join(project, "config", "samples")
+
+    schemas = {}
+    for name in os.listdir(crd_dir):
+        crd = pyyaml.safe_load(open(os.path.join(crd_dir, name)))
+        kind = crd["spec"]["names"]["kind"]
+        for version in crd["spec"]["versions"]:
+            schemas[(kind, version["name"])] = version["schema"][
+                "openAPIV3Schema"
+            ]["properties"]["spec"]
+
+    checked = 0
+    for name in os.listdir(samples_dir):
+        if name == "kustomization.yaml":
+            continue
+        sample = pyyaml.safe_load(open(os.path.join(samples_dir, name)))
+        kind = sample["kind"]
+        version = sample["apiVersion"].rsplit("/", 1)[-1]
+        schema = schemas[(kind, version)]
+        errors = _validate(sample.get("spec", {}), schema)
+        assert not errors, f"{name}: " + "; ".join(errors)
+        checked += 1
+    assert checked > 0
+
+
+class TestSequenceItemMarker:
+    def test_marker_on_sequence_scalar(self):
+        text = (
+            "spec:\n  args:\n"
+            '  # +operator-builder:field:name=listenArg,type=string,default="--listen"\n'
+            "  - --listen\n  - --other\n"
+        )
+        out = inspect_for_yaml(text, MarkerType.FIELD)
+        content = emit_documents(out.documents)
+        assert "- !!var parent.Spec.ListenArg" in content
+        assert "# controlled by field: listenArg" in content
+        assert out.results[0].obj.original_value == "--listen"
