@@ -276,3 +276,68 @@ class TestMultiVersionCRD:
         assert "warning: unable to read existing CRD" in err
         crd = pyyaml.safe_load(_read(out, "config/crd/bases/shop.example.io_bookstores.yaml"))
         assert [v["name"] for v in crd["spec"]["versions"]] == ["v1alpha1"]
+
+
+class TestMultiGroupCollection:
+    """A component in a different API group than its collection exercises
+    cross-group imports everywhere."""
+
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("multigroup")
+        return _generate(tmp, "multigroup", "github.com/acme/org-operator")
+
+    def test_two_group_trees(self, project):
+        assert os.path.exists(
+            os.path.join(project, "apis/platform/v1alpha1/orgplatform_types.go")
+        )
+        assert os.path.exists(
+            os.path.join(project, "apis/data/v1/warehouse_types.go")
+        )
+
+    def test_component_imports_collection_group(self, project):
+        deploy = _read(project, "apis/data/v1/warehouse/warehouse.go")
+        assert (
+            'platformv1alpha1 "github.com/acme/org-operator/apis/platform/v1alpha1"'
+            in deploy
+        )
+        assert "collection *platformv1alpha1.OrgPlatform" in deploy
+        assert "collection.Spec.DataNamespace" in deploy
+
+    def test_controller_per_group(self, project):
+        assert os.path.exists(
+            os.path.join(project, "controllers/platform/orgplatform_controller.go")
+        )
+        ctl = _read(project, "controllers/data/warehouse_controller.go")
+        assert "platformv1alpha1.OrgPlatform" in ctl
+        assert os.path.exists(
+            os.path.join(project, "controllers/platform/suite_test.go")
+        )
+        assert os.path.exists(
+            os.path.join(project, "controllers/data/suite_test.go")
+        )
+
+    def test_main_wires_both_groups(self, project):
+        main = _read(project, "main.go")
+        assert "platformcontrollers.NewOrgPlatformReconciler" in main
+        assert "datacontrollers.NewWarehouseReconciler" in main
+        assert 'datav1 "github.com/acme/org-operator/apis/data/v1"' in main
+
+    def test_lint_and_consistency(self, project):
+        from golint import check_file, check_package_dirs
+        from test_consistency import _check_project
+        problems = []
+        for dirpath, _, files in os.walk(project):
+            for f in files:
+                if f.endswith(".go"):
+                    path = os.path.join(dirpath, f)
+                    problems += [f"{path}: {p}" for p in check_file(path)]
+        problems += check_package_dirs(project)
+        assert not problems, "\n".join(problems)
+        _check_project(
+            project,
+            {
+                "orgplatform": ("OrgPlatform", "OrgPlatform"),
+                "warehouse": ("Warehouse", "OrgPlatform"),
+            },
+        )

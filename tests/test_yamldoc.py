@@ -198,3 +198,70 @@ class TestRobustness:
         out = emit_documents(docs)
         parsed = pyyaml.safe_load(out)
         assert parsed == {"a": None, "b": None, "c": None}
+
+
+class TestCommentAssociation:
+    """Adversarial comment-association cases (the behavior driving marker
+    discovery, reference inspect/yaml.go:62-101)."""
+
+    def test_two_markers_in_one_block(self):
+        text = (
+            "spec:\n"
+            "  # first comment line\n"
+            "  # second comment line\n"
+            "  key: v\n"
+        )
+        docs = load_documents(text)
+        entry = docs[0].root.get("spec").entries[0]
+        assert entry.head_comments == [
+            "# first comment line", "# second comment line",
+        ]
+
+    def test_blank_line_separated_comment_still_attaches_forward(self):
+        text = "a: 1\n\n# about b\n\nb: 2\n"
+        docs = load_documents(text)
+        b = docs[0].root.entries[1]
+        assert b.head_comments == ["# about b"]
+
+    def test_trailing_comment_after_last_entry_becomes_foot(self):
+        text = "a: 1\nb: 2\n# trailing note\n"
+        docs = load_documents(text)
+        out = emit_documents(docs)
+        assert "# trailing note" in out
+
+    def test_comment_before_doc_separator_not_lost(self):
+        text = "a: 1\n# fenced comment\n---\nb: 2\n"
+        docs = load_documents(text)
+        out = emit_documents(docs)
+        assert "# fenced comment" in out
+
+    def test_head_and_line_comment_together(self):
+        text = "spec:\n  # above\n  key: v  # beside\n"
+        docs = load_documents(text)
+        entry = docs[0].root.get("spec").entries[0]
+        assert entry.head_comments == ["# above"]
+        assert entry.line_comment == "# beside"
+
+    def test_comment_on_nested_block_start_line(self):
+        text = "spec:  # on spec line\n  key: v\n"
+        docs = load_documents(text)
+        spec_entry = docs[0].root.entries[0]
+        assert spec_entry.line_comment == "# on spec line"
+
+    def test_comment_above_dash_attaches_to_first_entry(self):
+        text = "items:\n# above item\n- name: x\n  other: y\n"
+        docs = load_documents(text)
+        item = docs[0].root.get("items").items[0]
+        first_entry = item.node.entries[0]
+        assert (
+            first_entry.head_comments == ["# above item"]
+            or item.head_comments == ["# above item"]
+        )
+        out = emit_documents(docs)
+        assert "# above item" in out
+
+    def test_indented_comment_deeper_than_next_entry(self):
+        text = "a:\n  b: 1\n    # stray deep comment\nc: 2\n"
+        docs = load_documents(text)
+        out = emit_documents(docs)
+        assert "# stray deep comment" in out
