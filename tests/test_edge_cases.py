@@ -341,3 +341,67 @@ class TestMultiGroupCollection:
                 "warehouse": ("Warehouse", "OrgPlatform"),
             },
         )
+
+
+class TestEmptyAndScale:
+    def test_standalone_with_no_resources(self, tmp_path):
+        cfg_dir = tmp_path / "cfg"
+        cfg_dir.mkdir()
+        (cfg_dir / "workload.yaml").write_text(
+            "name: empty\nkind: StandaloneWorkload\nspec:\n"
+            "  api:\n    domain: x.io\n    group: g\n    version: v1\n"
+            "    kind: Empty\n  resources: []\n"
+        )
+        out = str(tmp_path / "project")
+        config = str(cfg_dir / "workload.yaml")
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/empty-operator",
+                         "--output-dir", out]) == 0
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out]) == 0
+        res = _read(out, "apis/g/v1/empty/resources.go")
+        assert "var CreateFuncs" in res
+        sample = _read(out, "config/samples/g_v1_empty.yaml")
+        assert "spec: {}" in sample
+        from golint import check_file
+        problems = []
+        for dirpath, _, files in os.walk(out):
+            for f in files:
+                if f.endswith(".go"):
+                    path = os.path.join(dirpath, f)
+                    problems += [f"{path}: {p}" for p in check_file(path)]
+        assert not problems, "\n".join(problems)
+
+    def test_hundred_document_manifest(self, tmp_path):
+        cfg_dir = tmp_path / "cfg"
+        cfg_dir.mkdir()
+        docs = []
+        for i in range(100):
+            docs.append(
+                f"apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: cm-{i}\n"
+                f"data:\n"
+                f"  # +operator-builder:field:name=bulk.value{i},type=string,default=\"v{i}\"\n"
+                f"  value: v{i}\n"
+            )
+        (cfg_dir / "bulk.yaml").write_text("---\n".join(docs))
+        (cfg_dir / "workload.yaml").write_text(
+            "name: bulk\nkind: StandaloneWorkload\nspec:\n"
+            "  api:\n    domain: x.io\n    group: g\n    version: v1\n"
+            "    kind: Bulk\n  resources: [bulk.yaml]\n"
+        )
+        out = str(tmp_path / "project")
+        config = str(cfg_dir / "workload.yaml")
+        import time
+        start = time.perf_counter()
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/bulk-operator",
+                         "--output-dir", out]) == 0
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out]) == 0
+        elapsed = time.perf_counter() - start
+        assert elapsed < 30, f"scale generation too slow: {elapsed:.1f}s"
+        code = _read(out, "apis/g/v1/bulk/bulk.go")
+        assert code.count("func CreateConfigMap") == 100
+        assert "parent.Spec.Bulk.Value99" in code
+        types = _read(out, "apis/g/v1/bulk_types.go")
+        assert "Value99 string" in types
