@@ -37,6 +37,14 @@ class CLIError(Exception):
     pass
 
 
+def _parse_bool(value: str) -> bool:
+    if value.lower() in ("true", "1", "yes", "y"):
+        return True
+    if value.lower() in ("false", "0", "no", "n"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {value!r}")
+
+
 def _load_project(output_dir: str) -> ProjectConfig:
     project_path = os.path.join(output_dir, "PROJECT")
     if not os.path.exists(project_path):
@@ -98,6 +106,11 @@ def cmd_init(args: argparse.Namespace) -> int:
 
 
 def cmd_create_api(args: argparse.Namespace) -> int:
+    if not args.resource and not args.controller:
+        raise CLIError(
+            "nothing to scaffold: --controller=false and --resource=false "
+            "cannot be combined"
+        )
     config = _load_project(args.output_dir)
     workload_config = args.workload_config or os.path.join(
         args.output_dir, config.workload_config_path
@@ -117,6 +130,8 @@ def cmd_create_api(args: argparse.Namespace) -> int:
         processor,
         config,
         boilerplate_text=_boilerplate_text(args.output_dir),
+        with_resources=args.resource,
+        with_controllers=args.controller,
     )
     print(
         f"api scaffolded at {args.output_dir} "
@@ -216,6 +231,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_api.add_argument("--workload-config", default="")
     p_api.add_argument("--output-dir", default=".")
+    # kubebuilder-compatible flags (reference docs/api-updates-upgrades.md):
+    # --controller=false skips controller scaffolding; --resource=false
+    # skips API/resource scaffolding; --force is accepted for compatibility
+    # (regeneration always overwrites generated files here)
+    p_api.add_argument(
+        "--controller", nargs="?", const="true", default="true", type=_parse_bool
+    )
+    p_api.add_argument(
+        "--resource", nargs="?", const="true", default="true", type=_parse_bool
+    )
+    p_api.add_argument("--force", action="store_true")
     p_api.set_defaults(func=cmd_create_api)
 
     p_cfg = sub.add_parser(

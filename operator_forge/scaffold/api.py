@@ -19,48 +19,62 @@ from .templates import resources as resources_tpl
 
 
 def api_files(
-    views: list[WorkloadView], output_dir: str = ""
+    views: list[WorkloadView],
+    output_dir: str = "",
+    with_resources: bool = True,
+    with_controllers: bool = True,
 ) -> list[FileSpec]:
+    """Build the create-api file set.  ``with_resources`` /
+    ``with_controllers`` mirror the reference's ``--resource`` /
+    ``--controller`` kubebuilder flags (docs/api-updates-upgrades.md:19-29:
+    API-only regeneration uses ``--controller=false --resource``)."""
     specs: list[FileSpec] = []
     groups_done: set[str] = set()
     group_versions_done: set[tuple[str, str]] = set()
 
     for view in views:
-        if (view.group, view.version) not in group_versions_done:
-            group_versions_done.add((view.group, view.version))
-            specs.append(api_tpl.group_version_info(view))
+        if with_resources:
+            if (view.group, view.version) not in group_versions_done:
+                group_versions_done.add((view.group, view.version))
+                specs.append(api_tpl.group_version_info(view))
 
-        specs.append(api_tpl.types_file(view))
-        specs.append(api_tpl.deepcopy_file(view))
-        specs.extend(api_tpl.kind_registry_files(view))
+            specs.append(api_tpl.types_file(view))
+            specs.append(api_tpl.deepcopy_file(view))
+            specs.extend(api_tpl.kind_registry_files(view))
 
-        specs.append(resources_tpl.resources_file(view))
-        specs.extend(resources_tpl.definition_files(view))
-        specs.append(resources_tpl.mutate_hook(view))
-        specs.append(resources_tpl.dependencies_hook(view))
+            specs.append(resources_tpl.resources_file(view))
+            specs.extend(resources_tpl.definition_files(view))
+            specs.append(resources_tpl.mutate_hook(view))
+            specs.append(resources_tpl.dependencies_hook(view))
 
-        specs.append(controller_tpl.controller_file(view))
-        if view.group not in groups_done:
-            groups_done.add(view.group)
-            specs.append(
-                controller_tpl.suite_test_file(
-                    view, [v.kind for v in views if v.group == view.group]
+            specs.append(api_tpl.crd_yaml(view, output_dir))
+            specs.append(api_tpl.sample_file(view))
+
+        if with_controllers:
+            specs.append(controller_tpl.controller_file(view))
+            if view.group not in groups_done:
+                groups_done.add(view.group)
+                specs.append(
+                    controller_tpl.suite_test_file(
+                        view, [v.kind for v in views if v.group == view.group]
+                    )
                 )
-            )
 
-        specs.append(api_tpl.crd_yaml(view, output_dir))
-        specs.append(api_tpl.sample_file(view))
-
-    specs.append(kustomize_tpl.crd_kustomization(views))
-    specs.append(kustomize_tpl.samples_kustomization(views))
-    specs.append(kustomize_tpl.manager_cluster_role(views))
-    if views:
-        specs.extend(cli_tpl.cli_files(views, views[0].config))
-        specs.extend(e2e_tpl.e2e_files(views, views[0].config))
+    if with_resources:
+        specs.append(kustomize_tpl.crd_kustomization(views))
+        specs.append(kustomize_tpl.samples_kustomization(views))
+        specs.append(kustomize_tpl.manager_cluster_role(views))
+        if views:
+            specs.extend(cli_tpl.cli_files(views, views[0].config))
+            specs.extend(e2e_tpl.e2e_files(views, views[0].config))
     return specs
 
 
-def main_go_fragments(views: list[WorkloadView]) -> list[Fragment]:
+def main_go_fragments(
+    views: list[WorkloadView],
+    with_resources: bool = True,
+    with_controllers: bool = True,
+) -> list[Fragment]:
     """Wire each workload's scheme and reconciler into main.go
     (reference MainUpdater, scaffolds/api.go:149-156)."""
     fragments: list[Fragment] = []
@@ -69,7 +83,7 @@ def main_go_fragments(views: list[WorkloadView]) -> list[Fragment]:
 
     for view in views:
         api_alias = view.api_import_alias
-        if api_alias not in seen_apis:
+        if with_resources and api_alias not in seen_apis:
             seen_apis.add(api_alias)
             fragments.append(
                 Fragment(
@@ -85,6 +99,9 @@ def main_go_fragments(views: list[WorkloadView]) -> list[Fragment]:
                     code=f"utilruntime.Must({api_alias}.AddToScheme(scheme))",
                 )
             )
+
+        if not with_controllers:
+            continue
 
         controllers_alias = f"{view.group}controllers"
         if controllers_alias not in seen_controllers:
@@ -122,11 +139,17 @@ def scaffold_api(
     processor: Processor,
     config: ProjectConfig,
     boilerplate_text: str = "",
+    with_resources: bool = True,
+    with_controllers: bool = True,
 ) -> Scaffold:
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
-    fragments = main_go_fragments(views)
-    for view in views:
-        fragments.extend(api_tpl.kind_registry_fragments(view))
-    scaffold.execute(api_files(views, output_dir), fragments)
+    fragments = main_go_fragments(views, with_resources, with_controllers)
+    if with_resources:
+        for view in views:
+            fragments.extend(api_tpl.kind_registry_fragments(view))
+    scaffold.execute(
+        api_files(views, output_dir, with_resources, with_controllers),
+        fragments,
+    )
     return scaffold

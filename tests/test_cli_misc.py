@@ -105,3 +105,64 @@ class TestMiscCommands:
             ["create", "api", "--output-dir", str(tmp_path)]
         ) == 1
         assert "PROJECT" in capsys.readouterr().err
+
+
+class TestCreateAPIFlags:
+    def _init(self, tmp_path):
+        import shutil
+        fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+        work = tmp_path / "cfg"
+        shutil.copytree(os.path.join(fixtures, "standalone"), work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/bookstore-operator",
+                         "--output-dir", out]) == 0
+        return config, out
+
+    def test_controller_false_skips_controllers(self, tmp_path):
+        config, out = self._init(tmp_path)
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out, "--controller=false",
+                         "--resource", "--force"]) == 0
+        assert os.path.exists(
+            os.path.join(out, "apis/shop/v1alpha1/bookstore_types.go")
+        )
+        assert not os.path.exists(os.path.join(out, "controllers"))
+        # main.go has scheme wiring but no reconciler registration
+        main = open(os.path.join(out, "main.go")).read()
+        assert "AddToScheme" in main
+        assert "NewBookStoreReconciler" not in main
+
+    def test_resource_false_skips_apis(self, tmp_path):
+        config, out = self._init(tmp_path)
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out, "--resource=false"]) == 0
+        assert not os.path.exists(
+            os.path.join(out, "apis/shop/v1alpha1/bookstore_types.go")
+        )
+        assert os.path.exists(
+            os.path.join(out, "controllers/shop/bookstore_controller.go")
+        )
+
+    def test_default_scaffolds_both(self, tmp_path):
+        config, out = self._init(tmp_path)
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out]) == 0
+        assert os.path.exists(
+            os.path.join(out, "apis/shop/v1alpha1/bookstore_types.go")
+        )
+        assert os.path.exists(
+            os.path.join(out, "controllers/shop/bookstore_controller.go")
+        )
+
+    def test_both_false_rejected(self, tmp_path, capsys):
+        config, out = self._init(tmp_path)
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out, "--controller=false",
+                         "--resource=false"]) == 1
+        assert "nothing to scaffold" in capsys.readouterr().err
+
+    def test_empty_flag_value_rejected(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli_main(["create", "api", "--controller="])
