@@ -26,8 +26,10 @@ from .. import __version__
 from .. import licensing
 from ..scaffold.api import scaffold_api
 from ..scaffold.context import ProjectConfig
+from ..scaffold.machinery import ScaffoldError
 from ..scaffold.project import scaffold_init
 from ..workload import config as wconfig
+from ..workload.create_api import CreateAPIError
 from ..workload.create_api import create_api as run_create_api
 from ..workload.create_api import init_workloads
 from . import init_config as init_config_mod
@@ -279,6 +281,8 @@ def main(argv: list[str] | None = None) -> int:
         return args.func(args)
     except (
         CLIError,
+        CreateAPIError,
+        ScaffoldError,
         wconfig.ConfigParseError,
         licensing.LicenseError,
         init_config_mod.InitConfigError,

@@ -310,6 +310,25 @@ def _is_reserved(name: str) -> bool:
     return to_title(name) in {to_title(r) for r in RESERVED_FIELD_NAMES}
 
 
+# each dot-separated path segment must title-case into a valid Go identifier
+# (the reference silently generates uncompilable code for names like
+# "my-field"; rejecting early is a deliberate improvement).  Underscores are
+# legal in both Go identifiers and CRD/JSON keys, so snake_case is allowed.
+_NAME_SEGMENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _validate_marker_name(name: str) -> None:
+    if not name or not all(
+        _NAME_SEGMENT_RE.match(segment) for segment in name.split(".")
+    ):
+        raise MarkerError(
+            f"invalid marker field name {name!r}: each dot-separated segment "
+            "must start with a letter or underscore and contain only "
+            "letters, digits and underscores (it becomes a Go identifier "
+            "and a CRD field name)"
+        )
+
+
 def transform_results(results: list[InspectResult]) -> None:
     """Rewrite marked values and comments in place
     (reference markers.go:117-250 transformYAML)."""
@@ -317,6 +336,8 @@ def transform_results(results: list[InspectResult]) -> None:
         marker = result.obj
         if not isinstance(marker, _FieldMarkerBase):
             continue
+
+        _validate_marker_name(marker.name)
 
         marker.source_code_var = source_code_variable(
             marker.spec_prefix, marker.name

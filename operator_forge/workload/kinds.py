@@ -10,6 +10,7 @@ marker inspection -> value/comment rewriting -> child-resource creation
 from __future__ import annotations
 
 import enum
+import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
@@ -357,10 +358,32 @@ class Workload:
         for manifest in self.spec.manifests:
             manifest.load_content(self.is_collection())
 
+    # GVK pieces become Go package names, directory names, and identifiers;
+    # validate their shape up front rather than generating broken code
+    _GROUP_RE = re.compile(r"^[a-z][a-z0-9]*$")
+    _VERSION_RE = re.compile(r"^v[0-9]+((alpha|beta)[0-9]+)?$")
+    _KIND_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
     def validate(self) -> None:
         missing = self._missing_fields()
         if missing:
             raise WorkloadConfigError(f"missing required fields: {missing}")
+        if not self._GROUP_RE.match(self.api_spec.group):
+            raise WorkloadConfigError(
+                f"invalid spec.api.group {self.api_spec.group!r}: must be "
+                "lowercase alphanumeric starting with a letter (it becomes a "
+                "Go package name)"
+            )
+        if not self._VERSION_RE.match(self.api_spec.version):
+            raise WorkloadConfigError(
+                f"invalid spec.api.version {self.api_spec.version!r}: must "
+                "look like v1, v1alpha1, v2beta3, ..."
+            )
+        if not self._KIND_RE.match(self.api_spec.kind):
+            raise WorkloadConfigError(
+                f"invalid spec.api.kind {self.api_spec.kind!r}: must be a "
+                "PascalCase Go identifier"
+            )
 
     def _missing_fields(self) -> list[str]:
         missing = []

@@ -125,3 +125,24 @@ def check_package_dirs(root: str) -> list[str]:
                         )
                 decls[key] = path
     return problems
+
+
+def check_tokens(path: str) -> list[str]:
+    """Token-level validation with Pygments' Go lexer: any Error token means
+    the file would not survive the Go scanner (unterminated strings, stray
+    characters).  Pygments is an optional test-only dependency."""
+    import pytest
+
+    pygments = pytest.importorskip("pygments")  # noqa: F841
+    from pygments.lexers import GoLexer
+    from pygments.token import Error
+
+    with open(path, "r", encoding="utf-8") as handle:
+        text = handle.read()
+    problems = []
+    line = 1
+    for token, value in GoLexer().get_tokens(text):
+        if token is Error:
+            problems.append(f"lexer error at line ~{line}: {value!r}")
+        line += value.count("\n")
+    return problems

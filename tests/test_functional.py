@@ -366,3 +366,15 @@ class TestGoStructuralLint:
         for project in projects:
             problems.extend(check_package_dirs(project))
         assert not problems, "\n".join(problems)
+
+
+class TestGoTokenLint:
+    def test_all_generated_go_lexes_cleanly(self, tmp_path):
+        from golint import check_tokens
+        project = _generate(
+            tmp_path, "kitchen-sink", "github.com/acme/sink-operator"
+        )
+        problems = []
+        for path in _go_files(project):
+            problems += [f"{path}: {p}" for p in check_tokens(path)]
+        assert not problems, "\n".join(problems)

@@ -233,3 +233,45 @@ class TestResourceMarkers:
         marker = _field_marker("size", FieldType.INT, for_collection=True)
         rm.process(MarkerCollection(field_markers=[marker]))
         assert rm.field_marker is marker
+
+
+class TestNameValidation:
+    """Invalid names are rejected before they become broken Go code (a
+    deliberate improvement over the reference, which generates uncompilable
+    identifiers for e.g. dashed names)."""
+
+    @pytest.mark.parametrize(
+        "bad", ["my-field", "my-field.replicas", "a..b", "a.9lives"]
+    )
+    def test_invalid_marker_names_rejected(self, bad):
+        with pytest.raises(MarkerError, match="invalid marker field name"):
+            _inspect(
+                f"spec:\n  x: v  # +operator-builder:field:name={bad},type=string\n"
+            )
+
+    def test_space_in_name_truncates_marker_missing_type(self):
+        # a space ends the marker at the scanner level, so `type` is missing
+        with pytest.raises(MarkerError, match="missing required"):
+            _inspect(
+                "spec:\n  x: v  # +operator-builder:field:name=a b,type=string\n"
+            )
+
+    def test_empty_name_value_is_scan_error(self):
+        from operator_forge.markers import ScanError
+
+        with pytest.raises(ScanError):
+            _inspect(
+                "spec:\n  x: v  # +operator-builder:field:name=,type=string\n"
+            )
+
+    def test_valid_names_accepted(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:field:name=app2.labelValue,type=string\n"
+        )
+        assert out.results[0].obj.name == "app2.labelValue"
+
+    def test_snake_case_names_accepted(self):
+        out = _inspect(
+            "spec:\n  x: v  # +operator-builder:field:name=my_field.sub_key,type=string\n"
+        )
+        assert out.results[0].obj.name == "my_field.sub_key"

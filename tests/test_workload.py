@@ -408,3 +408,42 @@ class TestCreateAPICollection:
         assert "collection.Spec.PlatformNamespace" in deploy.source_code
         assert "collection.Spec.CacheImage" in deploy.source_code
         assert "parent.Spec.CacheReplicas" in deploy.source_code
+
+
+class TestGVKValidation:
+    def _decode(self, group="shop", version="v1alpha1", kind="Thing"):
+        return decode(
+            {
+                "name": "x",
+                "kind": "StandaloneWorkload",
+                "spec": {
+                    "api": {
+                        "domain": "d.io",
+                        "group": group,
+                        "version": version,
+                        "kind": kind,
+                    }
+                },
+            }
+        )
+
+    @pytest.mark.parametrize("group", ["my-group", "My", "1x", "a.b"])
+    def test_invalid_group_rejected(self, group):
+        with pytest.raises(WorkloadConfigError, match="group"):
+            self._decode(group=group).validate()
+
+    @pytest.mark.parametrize("version", ["1", "alpha", "v1alpha", "V1"])
+    def test_invalid_version_rejected(self, version):
+        with pytest.raises(WorkloadConfigError, match="version"):
+            self._decode(version=version).validate()
+
+    @pytest.mark.parametrize("kind", ["thing", "My-Kind", "9K"])
+    def test_invalid_kind_rejected(self, kind):
+        with pytest.raises(WorkloadConfigError, match="kind"):
+            self._decode(kind=kind).validate()
+
+    @pytest.mark.parametrize(
+        "version", ["v1", "v1alpha1", "v2beta3", "v10"]
+    )
+    def test_valid_versions(self, version):
+        self._decode(version=version).validate()
