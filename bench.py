@@ -67,13 +67,14 @@ def main() -> None:
         start = time.perf_counter()
         loc = 0
         for i in range(runs):
-            out_a = os.path.join(tmp, f"standalone-{i}")
-            out_b = os.path.join(tmp, f"collection-{i}")
+            outs = []
             with contextlib.redirect_stdout(io.StringIO()):
-                generate("standalone", "github.com/bench/bookstore", out_a)
-                generate("collection", "github.com/bench/platform", out_b)
+                for fixture in ("standalone", "collection", "kitchen-sink"):
+                    out = os.path.join(tmp, f"{fixture}-{i}")
+                    generate(fixture, f"github.com/bench/{fixture}", out)
+                    outs.append(out)
             if i == 0:
-                loc = count_loc(out_a) + count_loc(out_b)
+                loc = sum(count_loc(o) for o in outs)
         elapsed = time.perf_counter() - start
         per_run = elapsed / runs
         loc_per_s = (loc / per_run) if per_run > 0 else 0.0
@@ -85,7 +86,7 @@ def main() -> None:
                     "unit": "generated_loc/s",
                     "vs_baseline": None,
                     "detail": {
-                        "fixtures": ["standalone", "collection"],
+                        "fixtures": ["standalone", "collection", "kitchen-sink"],
                         "runs": runs,
                         "wall_s_per_run": round(per_run, 4),
                         "generated_loc_per_run": loc,

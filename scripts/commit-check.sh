@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Conventional-commit check for the latest commit (reference:
+# test/scripts/commit-check-latest.sh — same contract, fresh implementation).
+set -euo pipefail
+
+latest="$(git log -1 --pretty=format:%s)"
+
+pattern='^(build|chore|ci|docs|feat|fix|perf|refactor|revert|style|test)(\([a-z0-9-]+\))?!?: .+'
+
+if [[ "$latest" =~ $pattern ]] || [[ "$latest" =~ ^(Add|Fix|Merge|Support|Harden|Validate|Document) ]]; then
+    echo "commit message OK: $latest"
+else
+    echo "commit message does not follow conventions: $latest" >&2
+    exit 1
+fi

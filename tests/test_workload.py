@@ -447,3 +447,23 @@ class TestGVKValidation:
     )
     def test_valid_versions(self, version):
         self._decode(version=version).validate()
+
+
+class TestDuplicateChildNames:
+    def test_duplicate_unique_name_rejected(self, tmp_path):
+        manifest = tmp_path / "m.yaml"
+        manifest.write_text(
+            "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: same\n"
+            "---\n"
+            "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: same\n"
+        )
+        cfg = tmp_path / "w.yaml"
+        cfg.write_text(
+            "name: dupe\nkind: StandaloneWorkload\nspec:\n"
+            "  api: {domain: d.io, group: g, version: v1, kind: Dupe}\n"
+            "  resources: [m.yaml]\n"
+        )
+        processor = wconfig.parse(str(cfg))
+        init_workloads(processor)
+        with pytest.raises(Exception, match="unique name"):
+            create_api(processor)
