@@ -19,6 +19,7 @@ def init_files(
         project.project_file(config),
         project.boilerplate(),
         project.gitignore(),
+        project.dockerignore(),
         project.go_mod(config),
         project.main_go(config),
         project.dockerfile(),

@@ -52,6 +52,15 @@ def boilerplate(license_header: str = "") -> FileSpec:
     )
 
 
+def dockerignore() -> FileSpec:
+    return FileSpec(
+        path=".dockerignore",
+        content="bin/\ntestbin/\nconfig/\ntest/\n.git/\n*.md\n",
+        add_boilerplate=False,
+        if_exists=IfExists.SKIP,
+    )
+
+
 def gitignore() -> FileSpec:
     return FileSpec(
         path=".gitignore",
@@ -170,8 +179,9 @@ def dockerfile() -> FileSpec:
 FROM golang:{GO_VERSION} as builder
 
 WORKDIR /workspace
-COPY go.mod go.mod
-COPY go.sum go.sum
+# go.sum exists only after the first `go mod tidy`; the wildcard keeps the
+# build working on a fresh scaffold
+COPY go.mod go.su[m] ./
 RUN go mod download
 
 COPY main.go main.go

@@ -378,3 +378,10 @@ class TestGoTokenLint:
         for path in _go_files(project):
             problems += [f"{path}: {p}" for p in check_tokens(path)]
         assert not problems, "\n".join(problems)
+
+
+def test_dockerfile_copy_does_not_require_go_sum(tmp_path):
+    project = _generate(tmp_path, "standalone", "github.com/acme/bookstore-operator")
+    dockerfile = _read(project, "Dockerfile")
+    assert "COPY go.sum go.sum" not in dockerfile
+    assert "go.su[m]" in dockerfile
