@@ -196,3 +196,37 @@ class TestInspector:
         docs, results, _ = inspect_yaml(text, _registry())
         assert len(docs) == 2
         assert len(results) == 3
+
+
+class TestScannerMore:
+    def test_two_markers_same_line(self):
+        res = scan_text("# +a:b:x=1 and +c:d:y=2")
+        assert [m.scope_path for m in res.markers] == ["a:b", "c:d"]
+
+    def test_marker_after_prose(self):
+        res = scan_text("# remember to set +test:thing:on before deploy")
+        assert res.markers[0].scope_path == "test:thing"
+        assert res.markers[0].args == [("on", True)]
+
+    def test_go_style_comment(self):
+        res = scan_text("// +test:marker:a=1")
+        assert res.markers[0].args == [("a", 1)]
+
+    def test_negative_float_and_exponent(self):
+        res = scan_text("# +t:m:a=-1.5,b=2e3")
+        assert res.markers[0].args == [("a", -1.5), ("b", 2000.0)]
+
+    def test_plus_in_email_like_text_ignored(self):
+        res = scan_text("# contact someone+tag@example.com for details")
+        assert res.markers == []
+        # 'tag@example.com' after '+' starts with letter: it scans as a
+        # marker candidate but fails the scope shape -> warning only
+        assert res.warnings
+
+    def test_value_with_equals_inside_quotes(self):
+        res = scan_text('# +t:m:expr="a=b=c"')
+        assert res.markers[0].args == [("expr", "a=b=c")]
+
+    def test_empty_quoted_string(self):
+        res = scan_text('# +t:m:v=""')
+        assert res.markers[0].args == [("v", "")]
