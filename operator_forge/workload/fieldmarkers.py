@@ -362,22 +362,61 @@ def _append_text(marker: _FieldMarkerBase) -> str:
 def _set_comments(marker: _FieldMarkerBase, result: InspectResult) -> None:
     """Reference markers.go:198-222 setComments."""
     element = result.element
+    marker_text = result.marker_text.rstrip("\n")
+    replacement = _append_text(marker)
+
+    # a marker with a backtick string can span several comment lines, so the
+    # rewrite must run over the joined comment block, not line by line
+    # (reference markers.go:203-222: markerText has "\n" -> "\n#" re-added to
+    # match the whole HeadComment; our marker_text is the exact substring of
+    # the joined text the scanner consumed)
+    scanned = element.all_comment_text()
+    foot_joined = "\n".join(element.foot_comments)
+    element.foot_comments = []
+    head_joined = "\n".join(element.head_comments)
+    if marker_text in head_joined:
+        head_joined = head_joined.replace(marker_text, replacement)
+    elif element.line_comment and marker_text in element.line_comment:
+        element.line_comment = element.line_comment.replace(
+            marker_text, replacement
+        )
+    elif marker_text in foot_joined:
+        pass  # foot comments are dropped (reference markers.go:219)
+    elif marker_text in scanned:
+        # the marker spans a head/line/foot boundary: rewrite over the same
+        # joined text the scanner saw and fold the result into head comments
+        # (foot comments are dropped afterwards, like the reference)
+        joined = scanned.replace(marker_text, replacement)
+        if foot_joined:
+            foot_start = len(scanned) - len(foot_joined)
+            marker_start = scanned.find(marker_text)
+            if marker_start + len(marker_text) > foot_start:
+                # the marker consumed part of the foot block, so everything
+                # after it is residual foot text — dropped like plain foot.
+                # The search is anchored at the marker position so an earlier
+                # pre-existing occurrence of the replacement phrase cannot
+                # truncate at the wrong spot (text before the first marker
+                # occurrence is unchanged by replace(), so scanned and joined
+                # positions coincide up to marker_start).
+                end = joined.find(replacement, marker_start) + len(replacement)
+                joined = joined[:end]
+            elif joined.endswith("\n" + foot_joined):
+                joined = joined[: -len("\n" + foot_joined)]
+        head_joined = joined
+        element.line_comment = None
+    # else: a prior result on this element already rewrote an identical
+    # marker text (replace() rewrites every occurrence at once) — nothing
+    # left to do, and the line comment must not be disturbed
+    element.head_comments = head_joined.split("\n") if head_joined else []
+
+    # description lines become comments after the rewritten marker comment
+    # (reference markers.go:199-203; appended after the rewrite here so the
+    # inserted lines cannot split the marker text the rewrite must match)
     if marker.description:
         description = marker.description.lstrip("\n")
         marker.description = description
         for line in description.split("\n"):
             element.head_comments.append("# " + line)
-
-    marker_text = result.marker_text.rstrip("\n")
-    replacement = _append_text(marker)
-
-    def rewrite(comment: str) -> str:
-        return comment.replace(marker_text, replacement)
-
-    element.foot_comments = []
-    element.head_comments = [rewrite(c) for c in element.head_comments]
-    if element.line_comment:
-        element.line_comment = rewrite(element.line_comment)
 
 
 def _set_value(marker: _FieldMarkerBase, result: InspectResult) -> None:
