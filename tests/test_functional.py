@@ -208,6 +208,19 @@ class TestStandaloneProject:
         assert ("apps", "deployments") in pairs
         assert ("batch", "jobs") in pairs  # role escalation
 
+    def test_runtime_readiness_checks(self, project):
+        ready = _read(project, "pkg/orchestrate/ready.go")
+        # kind-specific readiness beyond bare existence
+        for kind in [
+            '"Deployment"', '"StatefulSet"', '"ReplicaSet"', '"DaemonSet"',
+            '"Job"', '"Pod"', '"Namespace"', '"PersistentVolumeClaim"',
+            '"CustomResourceDefinition"', '"Ingress"',
+        ]:
+            assert f"case {kind}:" in ready, kind
+        assert 'conditionTrue(live, "Established")' in ready
+        assert 'phase == "Bound"' in ready
+        assert "ingressReady" in ready
+
     def test_go_files_brace_balanced(self, project):
         files = _go_files(project)
         assert len(files) > 15
