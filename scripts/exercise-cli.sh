@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Exercise a generated project's companion CLI, mirroring the reference's
+# CLI integration action (reference .github/common-actions/e2e-test-cli/
+# action.yaml): build the CLI, then run version / init / generate for every
+# workload subcommand and validate their output.  The generate step feeds
+# each workload's own `init` output back in as its manifest, so the CLI is
+# round-tripped end to end.  With DEPLOY=true (and a reachable cluster) the
+# generated child manifests are applied and removed again.
+#
+# Usage: exercise-cli.sh <generated-project-dir>
+set -euo pipefail
+
+PROJECT_DIR="${1:?usage: exercise-cli.sh <generated-project-dir>}"
+cd "${PROJECT_DIR}"
+
+if [[ ! -d cmd ]]; then
+  echo "no companion CLI scaffolded (no cmd/ directory); nothing to test"
+  exit 0
+fi
+
+CLI_NAME="$(find cmd -mindepth 1 -maxdepth 1 -type d -printf '%f\n' | head -1)"
+if [[ -z "${CLI_NAME}" ]]; then
+  echo "no CLI package under cmd/"
+  exit 1
+fi
+
+if [[ "${SKIP_BUILD:-false}" == "true" ]]; then
+  # test hook: exercise the driving logic against a prebuilt/stub binary
+  echo "==> SKIP_BUILD=true: using existing bin/${CLI_NAME}"
+else
+  echo "==> building companion CLI: ${CLI_NAME}"
+  go mod tidy
+  make build-cli
+fi
+CLI="${PWD}/bin/${CLI_NAME}"
+test -x "${CLI}"
+
+echo "==> ${CLI_NAME} version"
+"${CLI}" version
+
+# workload subcommands are nested under init/generate/version; discover
+# them from the init help text ("Available Commands:" section)
+mapfile -t SUBCOMMANDS < <(
+  "${CLI}" init --help \
+    | sed -n '/Available Commands:/,/^$/p' \
+    | awk 'NR > 1 && NF { print $1 }' \
+    | grep -vx help || true
+)
+if [[ ${#SUBCOMMANDS[@]} -eq 0 ]]; then
+  echo "no workload subcommands found under '${CLI_NAME} init'"
+  exit 1
+fi
+echo "==> workload subcommands: ${SUBCOMMANDS[*]}"
+
+WORK="$(mktemp -d)"
+
+validate_manifests() {
+  python3 - "$1" "$2" <<'EOF'
+import sys, yaml
+docs = [d for d in yaml.safe_load_all(open(sys.argv[1])) if d]
+assert docs, f"{sys.argv[2]} produced no manifests"
+for d in docs:
+    assert d.get("kind") and d.get("apiVersion"), d
+print(f"{sys.argv[2]} emitted {len(docs)} valid manifest(s)")
+EOF
+}
+
+# init every workload and keep the output as that workload's manifest
+COLLECTION_SUB=""
+for sub in "${SUBCOMMANDS[@]}"; do
+  echo "==> ${CLI_NAME} init ${sub}"
+  "${CLI}" init "${sub}" > "${WORK}/${sub}.yaml"
+  validate_manifests "${WORK}/${sub}.yaml" "init ${sub}"
+  flags="$("${CLI}" generate "${sub}" --help 2>&1 || true)"
+  if grep -q -- '--collection-manifest' <<<"${flags}" \
+      && ! grep -q -- '--workload-manifest' <<<"${flags}"; then
+    COLLECTION_SUB="${sub}"
+  fi
+done
+
+# generate children from each workload's own init output
+for sub in "${SUBCOMMANDS[@]}"; do
+  flags="$("${CLI}" generate "${sub}" --help 2>&1 || true)"
+  args=(generate "${sub}")
+  if grep -q -- '--workload-manifest' <<<"${flags}"; then
+    args+=(-w "${WORK}/${sub}.yaml")
+  fi
+  if grep -q -- '--collection-manifest' <<<"${flags}"; then
+    if [[ "${sub}" == "${COLLECTION_SUB}" || -z "${COLLECTION_SUB}" ]]; then
+      args+=(-c "${WORK}/${sub}.yaml")
+    else
+      args+=(-c "${WORK}/${COLLECTION_SUB}.yaml")
+    fi
+  fi
+  echo "==> ${CLI_NAME} ${args[*]}"
+  "${CLI}" "${args[@]}" > "${WORK}/${sub}-children.yaml"
+  validate_manifests "${WORK}/${sub}-children.yaml" "generate ${sub}"
+done
+
+if [[ "${DEPLOY:-false}" == "true" ]]; then
+  echo "==> installing CRDs and applying parent custom resources"
+  make install
+  for sub in "${SUBCOMMANDS[@]}"; do
+    kubectl apply -f "${WORK}/${sub}.yaml"
+  done
+  for sub in "${SUBCOMMANDS[@]}"; do
+    kubectl delete -f "${WORK}/${sub}.yaml"
+  done
+  make uninstall
+fi
+
+echo "companion CLI exercise passed"

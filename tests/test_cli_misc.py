@@ -166,3 +166,80 @@ class TestCreateAPIFlags:
     def test_empty_flag_value_rejected(self, tmp_path):
         with pytest.raises(SystemExit):
             cli_main(["create", "api", "--controller="])
+
+
+class TestRepoScripts:
+    """The repo's exercise scripts must at least be valid bash and executable."""
+
+    SCRIPTS = ["scripts/exercise-cli.sh", "scripts/commit-check.sh"]
+
+    def test_scripts_are_valid_bash(self):
+        import subprocess
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in self.SCRIPTS:
+            path = os.path.join(root, rel)
+            assert os.path.exists(path), rel
+            result = subprocess.run(
+                ["bash", "-n", path], capture_output=True, text=True
+            )
+            assert result.returncode == 0, f"{rel}: {result.stderr}"
+
+    def test_exercise_cli_noop_without_cmd_dir(self, tmp_path):
+        import subprocess
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(root, "scripts", "exercise-cli.sh")
+        result = subprocess.run(
+            ["bash", script, str(tmp_path)], capture_output=True, text=True
+        )
+        assert result.returncode == 0, result.stderr
+        assert "nothing to test" in result.stdout
+
+    def test_exercise_cli_drives_stub_cli(self, tmp_path):
+        """Full script flow against a stub companion CLI that mimics the
+        generated cobra command shape (init/generate/version with nested
+        workload subcommands, -w/-c flags)."""
+        import stat
+        import subprocess
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(root, "scripts", "exercise-cli.sh")
+
+        proj = tmp_path / "proj"
+        (proj / "cmd" / "stackctl").mkdir(parents=True)
+        (proj / "bin").mkdir()
+        stub = proj / "bin" / "stackctl"
+        stub.write_text(
+            "#!/usr/bin/env bash\n"
+            "# mimics the generated companion CLI's command surface\n"
+            'case "$1 $2 $3" in\n'
+            '"version  ") echo "stackctl version v0.0.1";;\n'
+            '"init --help ") cat <<EOF\n'
+            "Usage:\n"
+            "  stackctl init [command]\n"
+            "\n"
+            "Available Commands:\n"
+            "  platform    init a platform collection manifest\n"
+            "  webapp      init a webapp manifest\n"
+            "\n"
+            "Flags:\n"
+            "  -h, --help   help for init\n"
+            "EOF\n"
+            ";;\n"
+            '"init platform ") printf "apiVersion: apps.acme.io/v1\\nkind: Platform\\nmetadata:\\n  name: platform-sample\\n";;\n'
+            '"init webapp ") printf "apiVersion: apps.acme.io/v1\\nkind: WebApp\\nmetadata:\\n  name: webapp-sample\\n";;\n'
+            '"generate platform --help") printf -- "Flags:\\n  -c, --collection-manifest string\\n";;\n'
+            '"generate webapp --help") printf -- "Flags:\\n  -w, --workload-manifest string\\n  -c, --collection-manifest string\\n";;\n'
+            '"generate platform -c") printf "apiVersion: v1\\nkind: Namespace\\nmetadata:\\n  name: ns\\n";;\n'
+            '"generate webapp -w") printf "apiVersion: apps/v1\\nkind: Deployment\\nmetadata:\\n  name: web\\n";;\n'
+            '*) echo "unexpected invocation: $*" >&2; exit 64;;\n'
+            "esac\n"
+        )
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+
+        result = subprocess.run(
+            ["bash", script, str(proj)],
+            capture_output=True, text=True,
+            env={**os.environ, "SKIP_BUILD": "true"},
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "workload subcommands: platform webapp" in result.stdout
+        assert "companion CLI exercise passed" in result.stdout
