@@ -127,6 +127,9 @@ def cmd_create_api(args: argparse.Namespace) -> int:
     init_workloads(processor)
     run_create_api(processor)
 
+    newly_enabled = args.enable_conversion and not config.enable_conversion
+    config.enable_conversion = config.enable_conversion or args.enable_conversion
+
     scaffold = scaffold_api(
         args.output_dir,
         processor,
@@ -134,7 +137,17 @@ def cmd_create_api(args: argparse.Namespace) -> int:
         boilerplate_text=_boilerplate_text(args.output_dir),
         with_resources=args.resource,
         with_controllers=args.controller,
+        enable_conversion=config.enable_conversion,
     )
+
+    # persist the opt-in only after a successful scaffold: recording it
+    # first would make every later plain `create api` re-enter a failing
+    # conversion path
+    if newly_enabled:
+        with open(
+            os.path.join(args.output_dir, "PROJECT"), "w", encoding="utf-8"
+        ) as handle:
+            handle.write(config.to_yaml())
     print(
         f"api scaffolded at {args.output_dir} "
         f"({len(scaffold.written)} files, {len(scaffold.skipped)} preserved)"
@@ -244,6 +257,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--resource", nargs="?", const="true", default="true", type=_parse_bool
     )
     p_api.add_argument("--force", action="store_true")
+    p_api.add_argument(
+        "--enable-conversion", action="store_true",
+        help="scaffold conversion-webhook wiring (hub/spoke stubs, webhook "
+        "Service, cert-manager certificate, CRD conversion strategy) for "
+        "kinds with multiple API versions; persisted in the PROJECT file",
+    )
     p_api.set_defaults(func=cmd_create_api)
 
     p_cfg = sub.add_parser(

@@ -16,6 +16,7 @@ from .templates import controller as controller_tpl
 from .templates import e2e as e2e_tpl
 from .templates import kustomize as kustomize_tpl
 from .templates import resources as resources_tpl
+from .templates import webhook as webhook_tpl
 
 
 def api_files(
@@ -23,6 +24,7 @@ def api_files(
     output_dir: str = "",
     with_resources: bool = True,
     with_controllers: bool = True,
+    enable_conversion: bool = False,
 ) -> list[FileSpec]:
     """Build the create-api file set.  ``with_resources`` /
     ``with_controllers`` mirror the reference's ``--resource`` /
@@ -47,8 +49,12 @@ def api_files(
             specs.append(resources_tpl.mutate_hook(view))
             specs.append(resources_tpl.dependencies_hook(view))
 
-            specs.append(api_tpl.crd_yaml(view, output_dir))
+            specs.append(
+                api_tpl.crd_yaml(view, output_dir, conversion=enable_conversion)
+            )
             specs.append(api_tpl.sample_file(view))
+            if enable_conversion:
+                specs.extend(webhook_tpl.conversion_files(view, output_dir))
 
         if with_controllers:
             specs.append(controller_tpl.controller_file(view))
@@ -141,6 +147,7 @@ def scaffold_api(
     boilerplate_text: str = "",
     with_resources: bool = True,
     with_controllers: bool = True,
+    enable_conversion: bool = False,
 ) -> Scaffold:
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
@@ -148,8 +155,27 @@ def scaffold_api(
     if with_resources:
         for view in views:
             fragments.extend(api_tpl.kind_registry_fragments(view))
-    scaffold.execute(
-        api_files(views, output_dir, with_resources, with_controllers),
-        fragments,
+
+    specs = api_files(
+        views, output_dir, with_resources, with_controllers, enable_conversion
     )
+
+    multi_version = []
+    if enable_conversion and with_resources:
+        # infra is only scaffolded once a kind actually has 2+ versions
+        multi_version = [
+            v for v in views if webhook_tpl.other_versions(v, output_dir)
+        ]
+        if multi_version:
+            specs.extend(webhook_tpl.webhook_config_tree(config))
+            for view in multi_version:
+                fragments.append(
+                    webhook_tpl.main_go_webhook_fragment(
+                        view, webhook_tpl.hub_version(view, output_dir)
+                    )
+                )
+
+    scaffold.execute(specs, fragments)
+    if multi_version:
+        webhook_tpl.update_default_kustomization(output_dir)
     return scaffold

@@ -582,11 +582,15 @@ def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
     return crd
 
 
-def crd_yaml(view: WorkloadView, output_dir: str = "") -> FileSpec:
+def crd_yaml(
+    view: WorkloadView, output_dir: str = "", conversion: bool = False
+) -> FileSpec:
     """config/crd/bases/<group>_<plural>.yaml rendered directly from the
     APIFields tree (the reference requires controller-gen for this).
     ``output_dir`` lets the renderer merge API versions already scaffolded
-    on disk."""
+    on disk.  With ``conversion`` enabled, multi-version CRDs get a
+    webhook conversion strategy + cert-manager CA injection (see
+    templates/webhook.py)."""
     spec_fields = view.workload.get_api_spec_fields() or APIFields.new_spec_root()
     scope = "Cluster" if view.workload.is_cluster_scoped() else "Namespaced"
     crd = {
@@ -644,6 +648,14 @@ def crd_yaml(view: WorkloadView, output_dir: str = "") -> FileSpec:
         },
     }
     crd = _merge_crd_versions(view, crd, output_dir)
+    if conversion and len(crd["spec"]["versions"]) > 1:
+        from . import webhook as webhook_tpl
+
+        crd["spec"]["conversion"] = webhook_tpl.crd_conversion_stanza(
+            view.config
+        )
+        key, value = webhook_tpl.crd_ca_injection_annotation(view.config)
+        crd["metadata"].setdefault("annotations", {})[key] = value
     return FileSpec(
         path=f"config/crd/bases/{view.crd_file_name}",
         content=_yaml_dump(crd),

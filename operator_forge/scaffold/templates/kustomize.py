@@ -39,7 +39,7 @@ def samples_kustomization(views: list[WorkloadView]) -> FileSpec:
 
 
 def default_tree(config: ProjectConfig) -> list[FileSpec]:
-    project = config.repo.rsplit("/", 1)[-1]
+    project = config.project_name
     namespace = f"{project}-system"
     return [
         FileSpec(

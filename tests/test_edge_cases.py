@@ -142,6 +142,213 @@ class TestMultiVersion:
         assert 'BookStoreLatestVersion = "v1beta1"' in latest
 
 
+class TestConversionWebhooks:
+    """`create api --enable-conversion` scaffolds hub/spoke conversion +
+    webhook infrastructure for multi-version kinds (beyond the reference,
+    documented in PARITY.md)."""
+
+    def _scaffold(self, tmp_path, versions):
+        import shutil
+        fixture = os.path.join(FIXTURES, "standalone")
+        work = tmp_path / "cfg"
+        shutil.copytree(fixture, work)
+        out = str(tmp_path / "project")
+        config = str(work / "workload.yaml")
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/bookstore-operator",
+             "--output-dir", out]
+        ) == 0
+        base = (work / "workload.yaml").read_text()
+        for i, version in enumerate(versions):
+            (work / "workload.yaml").write_text(
+                base.replace("version: v1alpha1", f"version: {version}")
+            )
+            argv = ["create", "api", "--workload-config", config,
+                    "--output-dir", out]
+            if i == len(versions) - 1:
+                argv.append("--enable-conversion")
+            assert cli_main(argv) == 0
+        return out, work, config
+
+    def test_single_version_scaffolds_no_webhook_infra(self, tmp_path):
+        out, _, _ = self._scaffold(tmp_path, ["v1alpha1"])
+        assert not os.path.exists(
+            os.path.join(out, "config/webhook/service.yaml")
+        )
+        assert not os.path.exists(
+            os.path.join(out, "apis/shop/v1alpha1/bookstore_conversion.go")
+        )
+        # but the opt-in is persisted for when a second version arrives
+        assert "enableConversion: true" in _read(out, "PROJECT")
+
+    def test_multi_version_gets_hub_spoke_and_infra(self, tmp_path):
+        out, _, _ = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
+
+        hub = _read(out, "apis/shop/v1beta1/bookstore_conversion.go")
+        assert "func (*BookStore) Hub() {}" in hub
+
+        spoke = _read(out, "apis/shop/v1alpha1/bookstore_conversion.go")
+        assert "func (src *BookStore) ConvertTo(dstRaw conversion.Hub) error" in spoke
+        assert "func (dst *BookStore) ConvertFrom(srcRaw conversion.Hub) error" in spoke
+        assert ('shopv1beta1 "github.com/acme/bookstore-operator'
+                '/apis/shop/v1beta1"') in spoke
+
+        # webhook + certmanager kustomize trees
+        assert os.path.exists(os.path.join(out, "config/webhook/service.yaml"))
+        cert = _read(out, "config/certmanager/certificate.yaml")
+        assert ("bookstore-operator-webhook-service."
+                "bookstore-operator-system.svc") in cert
+        # issuerRef must follow the namePrefix applied to the Issuer
+        kcfg = _read(out, "config/certmanager/kustomizeconfig.yaml")
+        assert "spec/issuerRef/name" in kcfg
+        assert "kustomizeconfig.yaml" in _read(
+            out, "config/certmanager/kustomization.yaml"
+        )
+        assert os.path.exists(
+            os.path.join(out, "config/default/manager_webhook_patch.yaml")
+        )
+        kustomization = _read(out, "config/default/kustomization.yaml")
+        assert "- ../webhook" in kustomization
+        assert "- ../certmanager" in kustomization
+        assert "- path: manager_webhook_patch.yaml" in kustomization
+
+        # CRD conversion strategy + CA injection
+        import yaml as pyyaml
+        crd = pyyaml.safe_load(
+            _read(out, "config/crd/bases/shop.example.io_bookstores.yaml")
+        )
+        conv = crd["spec"]["conversion"]
+        assert conv["strategy"] == "Webhook"
+        service = conv["webhook"]["clientConfig"]["service"]
+        assert service["name"] == "bookstore-operator-webhook-service"
+        assert service["namespace"] == "bookstore-operator-system"
+        assert service["path"] == "/convert"
+        assert crd["metadata"]["annotations"][
+            "cert-manager.io/inject-ca-from"
+        ] == "bookstore-operator-system/bookstore-operator-serving-cert"
+
+        # manager wiring
+        main_go = _read(out, "main.go")
+        assert "ctrl.NewWebhookManagedBy(mgr).For(&shopv1beta1.BookStore{})" in main_go
+
+    def test_hub_migration_and_user_spoke_preserved(self, tmp_path):
+        out, work, config = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
+
+        # user customizes the v1alpha1 spoke
+        spoke_path = os.path.join(
+            out, "apis/shop/v1alpha1/bookstore_conversion.go"
+        )
+        custom = _read(out, "apis/shop/v1alpha1/bookstore_conversion.go")
+        custom = custom.replace(
+            "return nil", "// user-edited\n\treturn nil", 1
+        )
+        with open(spoke_path, "w", encoding="utf-8") as fh:
+            fh.write(custom)
+
+        # add a third version; --enable-conversion persisted via PROJECT
+        base = (work / "workload.yaml").read_text()
+        (work / "workload.yaml").write_text(
+            base.replace("version: v1beta1", "version: v1")
+        )
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        # hub moved to v1
+        hub = _read(out, "apis/shop/v1/bookstore_conversion.go")
+        assert "func (*BookStore) Hub() {}" in hub
+        # the old generated hub became a spoke (machine-owned: overwritten)
+        old_hub = _read(out, "apis/shop/v1beta1/bookstore_conversion.go")
+        assert "Hub() {}" not in old_hub
+        assert "ConvertTo" in old_hub
+        assert 'shopv1 "github.com/acme/bookstore-operator/apis/shop/v1"' in old_hub
+        # the user-edited spoke is preserved (SKIP)
+        assert "// user-edited" in _read(
+            out, "apis/shop/v1alpha1/bookstore_conversion.go"
+        )
+
+    def test_rescaffold_older_version_does_not_demote_hub(self, tmp_path):
+        out, work, config = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
+
+        # regenerate the OLDER version (documented partial re-scaffold flow)
+        base = (work / "workload.yaml").read_text()
+        (work / "workload.yaml").write_text(base)  # back to v1alpha1
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        # hub stays at the newest version; older version stays a spoke
+        hub = _read(out, "apis/shop/v1beta1/bookstore_conversion.go")
+        assert "func (*BookStore) Hub() {}" in hub
+        spoke = _read(out, "apis/shop/v1alpha1/bookstore_conversion.go")
+        assert "Hub() {}" not in spoke
+        assert "ConvertTo" in spoke
+
+    def test_kustomization_update_merges_user_patches(self, tmp_path):
+        out, work, config = self._scaffold(tmp_path, ["v1alpha1"])
+
+        # simulate a pre-marker / user-edited kustomization with an
+        # existing patches section
+        kpath = os.path.join(out, "config/default/kustomization.yaml")
+        with open(kpath, "w", encoding="utf-8") as fh:
+            fh.write(
+                "namespace: bookstore-operator-system\n"
+                "namePrefix: bookstore-operator-\n"
+                "resources:\n"
+                "- ../crd\n"
+                "- ../rbac\n"
+                "- ../manager\n"
+                "\n"
+                "patches:\n"
+                "- path: my_custom_patch.yaml\n"
+                "  target:\n"
+                "    kind: Deployment\n"
+                "    name: controller-manager\n"
+            )
+
+        base = (work / "workload.yaml").read_text()
+        (work / "workload.yaml").write_text(
+            base.replace("version: v1alpha1", "version: v1beta1")
+        )
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+        import yaml as pyyaml
+        kustomization = _read(out, "config/default/kustomization.yaml")
+        parsed = pyyaml.safe_load(kustomization)  # no duplicate keys
+        assert kustomization.count("patches:") == 1
+        assert "- path: my_custom_patch.yaml" in kustomization
+        assert "manager_webhook_patch.yaml" in str(parsed["patches"])
+        assert "../webhook" in parsed["resources"]
+        assert "../certmanager" in parsed["resources"]
+        # the user patch keeps its multi-line target block; the webhook
+        # patch entry is appended after it, not spliced into it
+        user_patch = next(
+            p for p in parsed["patches"]
+            if p["path"] == "my_custom_patch.yaml"
+        )
+        assert user_patch.get("target", {}).get("kind") == "Deployment"
+        webhook_patch = next(
+            p for p in parsed["patches"]
+            if p["path"] == "manager_webhook_patch.yaml"
+        )
+        assert "target" not in webhook_patch
+
+        # re-running is idempotent
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+        again = _read(out, "config/default/kustomization.yaml")
+        assert again.count("- ../webhook") == 1
+        assert again.count("manager_webhook_patch.yaml") == 1
+
+
 class TestComponentDependencies:
     @pytest.fixture(scope="class")
     def project(self, tmp_path_factory):
