@@ -11,7 +11,11 @@ divergences surface directly:
   -> TestResourceMarkerValidate/IsAssociated/Process
 - internal/workload/v1/rbac/{rbac,rule,role_rule}_internal_test.go
   -> TestRBACTables
-- internal/workload/v1/kinds/api_internal_test.go -> TestAPIFieldsTables
+- internal/workload/v1/kinds/api_internal_test.go -> TestAPIFieldsTables,
+  TestAPIFieldsInternals (generateStructName, getSampleValue, setDefault,
+  setCommentsAndDefault, isEqual, hasRequiredField)
+- internal/workload/v1/kinds/{standalone,collection,component}_internal_test.go
+  -> TestSetNamesTables
 
 The assertions mirror the reference tables' inputs and expected outputs; the
 implementation under test is operator-forge's own (different architecture,
@@ -740,3 +744,213 @@ class TestAPIFieldsTables:
         )
         with pytest.raises(FieldOverwriteError):
             api.add_field("nested.path", FieldType.STRING, ["test"], "test", True)
+
+
+class TestAPIFieldsInternals:
+    """Ports internal/workload/v1/kinds/api_internal_test.go tables not
+    covered by TestAPIFieldsTables: generateStructName, getSampleValue,
+    setDefault, setCommentsAndDefault, isEqual, hasRequiredField."""
+
+    # -- generateStructName (api_internal_test.go:113-156) ----------------
+
+    def test_struct_name_single_nest(self):
+        f = APIFields(name="", type=FieldType.STRUCT,
+                      manifest_name="webStore")
+        f.set_struct_name("webStore.image")
+        assert f.struct_name == "SpecWebStore"
+
+    def test_struct_name_multi_nest(self):
+        f = APIFields(name="", type=FieldType.STRUCT, manifest_name="tag")
+        f.set_struct_name("webStore.image.tag.extension")
+        assert f.struct_name == "SpecWebStoreImageTag"
+
+    # -- getSampleValue (api_internal_test.go:324-449) --------------------
+
+    @pytest.mark.parametrize("ftype,value,want", [
+        (FieldType.STRING, "testString", '"testString"'),
+        (FieldType.INT, 1, "1"),
+        (FieldType.BOOL, True, "true"),
+        (FieldType.BOOL, False, "false"),
+    ])
+    def test_sample_value(self, ftype, value, want):
+        f = APIFields(name="x", type=ftype)
+        assert f.get_sample_value(value) == want
+
+    def test_sample_value_unquoted_for_non_string_type(self):
+        # a string sample on a non-string-typed field stays raw
+        f = APIFields(name="x", type=FieldType.INT)
+        assert f.get_sample_value("7") == "7"
+
+    # -- setDefault (api_internal_test.go:531-614) ------------------------
+
+    def test_set_default_preserves_existing_markers(self):
+        f = APIFields(name="s", type=FieldType.STRING,
+                      manifest_name="string",
+                      markers=["marker1", "marker2"])
+        f.set_default("string")
+        assert f.default == '"string"'
+        assert f.sample == 'string: "string"'
+        assert f.markers == ["marker1", "marker2"]  # untouched
+
+    def test_set_default_adds_kubebuilder_markers_when_empty(self):
+        f = APIFields(name="s", type=FieldType.STRING,
+                      manifest_name="string")
+        f.set_default("string")
+        assert f.markers == [
+            '+kubebuilder:default="string"',
+            "+kubebuilder:validation:Optional",
+            '(Default: "string")',
+        ]
+
+    # -- setCommentsAndDefault (api_internal_test.go:615-705) -------------
+
+    def test_set_comments_and_default_appends_comments(self):
+        f = APIFields(name="s", type=FieldType.STRING,
+                      manifest_name="string",
+                      comments=["comment1", "comment2"])
+        f.set_comments_and_default(
+            ["comment3", "comment4"], "string", True
+        )
+        assert f.comments == [
+            "comment1", "comment2", "comment3", "comment4"
+        ]
+        assert f.default == '"string"'
+        assert f.markers[0] == '+kubebuilder:default="string"'
+
+    def test_set_comments_and_default_noop_without_either(self):
+        f = APIFields(name="o", type=FieldType.STRING, manifest_name="other")
+        f.set_comments_and_default(None, "other", False)
+        assert f.default == ""
+        assert f.comments == []
+        assert f.markers == []
+
+    # -- isEqual (api_internal_test.go:907-1036) --------------------------
+
+    def _pair(self, **kw):
+        a = APIFields(name="", type=kw.pop("a_type", FieldType.STRING),
+                      default=kw.pop("a_default", ""),
+                      comments=kw.pop("a_comments", []))
+        b = APIFields(name="", type=kw.pop("b_type", FieldType.STRING),
+                      default=kw.pop("b_default", ""),
+                      comments=kw.pop("b_comments", []))
+        return a, b
+
+    def test_is_equal_different_types(self):
+        a, b = self._pair(a_type=FieldType.STRUCT, b_type=FieldType.STRING)
+        assert not a.is_equal(b)
+
+    def test_is_equal_different_defaults(self):
+        a, b = self._pair(a_default="test2", b_default="test1")
+        assert not a.is_equal(b)
+
+    def test_is_equal_one_sided_comments(self):
+        a, b = self._pair(b_comments=["test"])
+        assert a.is_equal(b)
+        a, b = self._pair(a_comments=["test"])
+        assert a.is_equal(b)
+
+    def test_is_equal_misordered_comments(self):
+        a, b = self._pair(a_comments=["test2", "test1"],
+                          b_comments=["test1", "test2"])
+        assert not a.is_equal(b)
+
+    def test_is_equal_matching_comments(self):
+        a, b = self._pair(a_comments=["test1", "test2"],
+                          b_comments=["test1", "test2"])
+        assert a.is_equal(b)
+
+    def test_is_equal_empty_default_matches_set_default(self):
+        a, b = self._pair(a_default="", b_default="x")
+        assert a.is_equal(b)
+
+    # -- hasRequiredField / needsGenerate (api_internal_test.go:158-275) --
+
+    def test_flat_field_without_default_is_required(self):
+        f = APIFields(name="x", type=FieldType.STRING)
+        assert f.has_required_field()
+        assert f.needs_generate(required_only=True)
+
+    def test_flat_field_with_default_is_optional(self):
+        f = APIFields(name="x", type=FieldType.STRING, default='"v"')
+        assert not f.has_required_field()
+        assert not f.needs_generate(required_only=True)
+        assert f.needs_generate(required_only=False)
+
+    def test_nested_required_field_propagates(self):
+        leaf = APIFields(name="leaf", type=FieldType.STRING)
+        parent = APIFields(
+            name="p", type=FieldType.STRUCT, children=[leaf]
+        )
+        assert parent.has_required_field()
+
+    def test_nested_all_defaulted_not_required(self):
+        leaf = APIFields(name="leaf", type=FieldType.STRING, default='"v"')
+        parent = APIFields(
+            name="p", type=FieldType.STRUCT, children=[leaf]
+        )
+        assert not parent.has_required_field()
+
+
+class TestSetNamesTables:
+    """Ports internal/workload/v1/kinds/{standalone,collection,component}
+    _internal_test.go SetNames tables: package-name mangling and companion
+    CLI name/description/var/file defaulting."""
+
+    def _standalone(self, name="shared-name", kind="", cli_name="",
+                    cli_desc=""):
+        from operator_forge.workload.kinds import StandaloneWorkload
+        w = StandaloneWorkload(name)
+        w.api_spec.kind = kind
+        w.companion_root_cmd.name = cli_name
+        w.companion_root_cmd.description = cli_desc
+        return w
+
+    def test_standalone_package_name_strips_dashes(self):
+        w = self._standalone()
+        w.set_names()
+        assert w.package_name == "sharedname"
+
+    def test_standalone_missing_root_command_stays_empty(self):
+        w = self._standalone()
+        w.set_names()
+        assert w.companion_root_cmd.name == ""
+        assert w.companion_root_cmd.description == ""
+        assert w.companion_root_cmd.var_name == ""
+
+    def test_standalone_root_command_defaults_description(self):
+        w = self._standalone(kind="StandaloneWorkloadTest",
+                             cli_name="hasrootcommand")
+        w.set_names()
+        cli = w.companion_root_cmd
+        assert cli.description == "Manage standaloneworkloadtest workload"
+        assert cli.var_name == "Hasrootcommand"
+        assert cli.file_name == "hasrootcommand"
+
+    def test_standalone_custom_description_preserved(self):
+        w = self._standalone(
+            kind="StandaloneWorkloadTest", cli_name="hasrootcommand",
+            cli_desc="Manage standaloneworkloadtest workload custom",
+        )
+        w.set_names()
+        assert w.companion_root_cmd.description == (
+            "Manage standaloneworkloadtest workload custom"
+        )
+
+    def test_component_subcommand_defaults_from_kind(self):
+        from operator_forge.workload.kinds import ComponentWorkload
+        w = ComponentWorkload("comp-name")
+        w.api_spec.kind = "ProvisionThing"
+        w.set_names()
+        assert w.package_name == "compname"
+        sub = w.companion_sub_cmd
+        assert sub.name  # defaulted, not empty
+        assert sub.var_name and sub.file_name
+
+    def test_collection_gets_both_root_and_sub(self):
+        from operator_forge.workload.kinds import WorkloadCollection
+        w = WorkloadCollection("coll-name")
+        w.api_spec.kind = "Platform"
+        w.companion_root_cmd.name = "platformctl"
+        w.set_names()
+        assert w.companion_root_cmd.var_name == "Platformctl"
+        assert w.companion_sub_cmd.name  # collection also gets a subcommand
