@@ -146,3 +146,149 @@ def check_tokens(path: str) -> list[str]:
             problems.append(f"lexer error at line ~{line}: {value!r}")
         line += value.count("\n")
     return problems
+
+
+_GO_KEYWORDS = {
+    "break", "case", "chan", "const", "continue", "default", "defer",
+    "else", "fallthrough", "for", "func", "go", "goto", "if", "import",
+    "interface", "map", "package", "range", "return", "select", "struct",
+    "switch", "type", "var",
+}
+
+# identifiers used as `name.` qualifiers: not preceded by ident char, `.`,
+# `)` or `]` (those are field/method accesses on expressions)
+_QUAL_RE = re.compile(r"(?<![\w.\)\]])([A-Za-z_]\w*)\s*\.")
+_SHORT_DECL_RE = re.compile(r"^\s*([\w\s,]+?)\s*:?=", re.MULTILINE)
+_VAR_DECL_RE = re.compile(
+    r"^\s*(?:var|const)\s+([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)",
+    re.MULTILINE,
+)
+_FUNC_SIG_RE = re.compile(
+    r"func\s*(\(\s*[^)]*\))?\s*\w*\s*(\([^)]*\))\s*(\([^)]*\)|[\w\*\[\]\.]+)?"
+)
+_RANGE_RE = re.compile(r"for\s+([\w\s,]+?)\s*:=\s*range\b")
+
+
+def _param_names(paren: str) -> set[str]:
+    """Names from a Go parameter/receiver/result list ``(a, b Type, c *T)``."""
+    names: set[str] = set()
+    inner = paren.strip()
+    if inner.startswith("(") and inner.endswith(")"):
+        inner = inner[1:-1]
+    if not inner.strip():
+        return names
+    depth = 0
+    groups, cur = [], []
+    for ch in inner:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            groups.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    groups.append("".join(cur))
+    pending: list[str] = []
+    for group in groups:
+        tokens = group.strip().split()
+        if not tokens:
+            continue
+        if len(tokens) == 1:
+            # could be a bare name sharing a later type (`a, b Type`) or a
+            # bare type; keep as pending name candidate
+            if re.fullmatch(r"[A-Za-z_]\w*", tokens[0]):
+                pending.append(tokens[0])
+        else:
+            names.add(tokens[0])
+            names.update(pending)
+            pending = []
+    return names
+
+
+def _local_names(clean: str) -> set[str]:
+    """Every identifier the file plausibly declares locally."""
+    names: set[str] = set()
+    for match in _FUNC_SIG_RE.finditer(clean):
+        receiver, params, results = match.groups()
+        if receiver:
+            names.update(_param_names(receiver))
+        names.update(_param_names(params))
+        if results and results.startswith("("):
+            names.update(_param_names(results))
+    for pattern in (_SHORT_DECL_RE, _VAR_DECL_RE, _RANGE_RE):
+        for match in pattern.finditer(clean):
+            for name in match.group(1).split(","):
+                name = name.strip()
+                if re.fullmatch(r"[A-Za-z_]\w*", name):
+                    names.add(name)
+    return names
+
+
+def package_toplevel_decls(package_dir: str) -> set[str]:
+    """Top-level func/var/const/type names across all files of a package."""
+    decls: set[str] = set()
+    for f in os.listdir(package_dir):
+        if not f.endswith(".go"):
+            continue
+        with open(os.path.join(package_dir, f), "r", encoding="utf-8") as fh:
+            clean = _strip_strings_and_comments(fh.read())
+        for match in _FUNC_RE.finditer(clean):
+            decls.add(match.group(1))
+        for match in _TOPLEVEL_RE.finditer(clean):
+            decls.add(match.group(1))
+        # names inside var/const blocks: `var (\n  a = ...\n  b = ...\n)`
+        for block in re.finditer(
+            r"^(?:var|const)\s*\(\s*\n(.*?)^\)", clean,
+            re.MULTILINE | re.DOTALL,
+        ):
+            for line in block.group(1).split("\n"):
+                m = re.match(r"\s*([A-Za-z_]\w*)", line)
+                if m:
+                    decls.add(m.group(1))
+    return decls
+
+
+def check_unresolved_qualifiers(package_dir: str) -> list[str]:
+    """Flag ``name.Selector`` uses where ``name`` is not an import, a local
+    declaration, a package-level declaration, or a Go keyword — the compile
+    error a missing import fragment or stale alias would produce."""
+    problems: list[str] = []
+    pkg_decls = package_toplevel_decls(package_dir)
+    for f in sorted(os.listdir(package_dir)):
+        if not f.endswith(".go"):
+            continue
+        path = os.path.join(package_dir, f)
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        imports = {name for name, _ in parse_imports(text)}
+        clean = _strip_strings_and_comments(text)
+        block = _IMPORT_BLOCK_RE.search(clean)
+        if block:
+            clean = clean[: block.start()] + clean[block.end() :]
+        known = imports | pkg_decls | _local_names(clean) | _GO_KEYWORDS
+        for match in _QUAL_RE.finditer(clean):
+            name = match.group(1)
+            if name in known:
+                continue
+            line = clean[: match.start()].count("\n") + 1
+            problems.append(
+                f"{path}:{line}: unresolved qualifier {name!r}"
+            )
+            known.add(name)  # one report per name per file
+    return problems
+
+
+def lint_project(root: str) -> list[str]:
+    """Run every structural check over a generated project tree."""
+    problems: list[str] = []
+    for dirpath, _, files in os.walk(root):
+        go_files = [f for f in files if f.endswith(".go")]
+        for f in go_files:
+            path = os.path.join(dirpath, f)
+            problems += [f"{path}: {p}" for p in check_file(path)]
+        if go_files:
+            problems += check_unresolved_qualifiers(dirpath)
+    problems += check_package_dirs(root)
+    return problems

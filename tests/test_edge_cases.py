@@ -232,6 +232,11 @@ class TestConversionWebhooks:
         main_go = _read(out, "main.go")
         assert "ctrl.NewWebhookManagedBy(mgr).For(&shopv1beta1.BookStore{})" in main_go
 
+        # conversion files resolve cleanly (hub alias imported in spokes)
+        from golint import lint_project
+        problems = lint_project(out)
+        assert not problems, "\n".join(problems)
+
     def test_hub_migration_and_user_spoke_preserved(self, tmp_path):
         out, work, config = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
 
@@ -367,14 +372,8 @@ class TestComponentDependencies:
         assert "&" not in body
 
     def test_lint_clean(self, project):
-        from golint import check_file, check_package_dirs
-        problems = []
-        for dirpath, _, files in os.walk(project):
-            for f in files:
-                if f.endswith(".go"):
-                    path = os.path.join(dirpath, f)
-                    problems += [f"{path}: {p}" for p in check_file(path)]
-        problems += check_package_dirs(project)
+        from golint import lint_project
+        problems = lint_project(project)
         assert not problems, "\n".join(problems)
 
 
@@ -531,15 +530,9 @@ class TestMultiGroupCollection:
         assert 'datav1 "github.com/acme/org-operator/apis/data/v1"' in main
 
     def test_lint_and_consistency(self, project):
-        from golint import check_file, check_package_dirs
+        from golint import lint_project
         from test_consistency import _check_project
-        problems = []
-        for dirpath, _, files in os.walk(project):
-            for f in files:
-                if f.endswith(".go"):
-                    path = os.path.join(dirpath, f)
-                    problems += [f"{path}: {p}" for p in check_file(path)]
-        problems += check_package_dirs(project)
+        problems = lint_project(project)
         assert not problems, "\n".join(problems)
         _check_project(
             project,

@@ -380,6 +380,29 @@ class TestGoStructuralLint:
             problems.extend(check_package_dirs(project))
         assert not problems, "\n".join(problems)
 
+    def test_no_unresolved_qualifiers(self, projects):
+        """Every `pkg.Symbol` reference must resolve to an import, a local,
+        or a package-level declaration — the compile error a missing import
+        fragment or stale alias would produce."""
+        from golint import check_unresolved_qualifiers
+        problems = []
+        for project in projects:
+            for dirpath, _, files in os.walk(project):
+                if any(f.endswith(".go") for f in files):
+                    problems.extend(check_unresolved_qualifiers(dirpath))
+        assert not problems, "\n".join(problems)
+
+    def test_unresolved_qualifier_lint_detects_injected_bug(self, tmp_path):
+        from golint import check_unresolved_qualifiers
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        path = os.path.join(project, "apis/shop/v1alpha1/bookstore_types.go")
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write("\nfunc bad() { nosuchpkg.Call() }\n")
+        problems = check_unresolved_qualifiers(os.path.dirname(path))
+        assert any("nosuchpkg" in p for p in problems)
+
 
 class TestGoTokenLint:
     def test_all_generated_go_lexes_cleanly(self, tmp_path):
