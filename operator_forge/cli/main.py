@@ -221,6 +221,29 @@ def cmd_version(_: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_vet(args: argparse.Namespace) -> int:
+    """Syntax-check every .go file of a generated project.
+
+    Provides the syntax half of `go build` in environments without a Go
+    toolchain (the reference relies on CI compilation for this,
+    .github/workflows/test.yaml:55-105).
+    """
+    from operator_forge.gocheck import check_project
+
+    root = args.path
+    if not os.path.isdir(root):
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 1
+    errors = check_project(root)
+    for err in errors:
+        print(err, file=sys.stderr)
+    if errors:
+        print(f"vet: {len(errors)} syntax error(s)", file=sys.stderr)
+        return 1
+    print("vet: all Go files parse cleanly")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="operator-forge",
@@ -289,6 +312,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_version = sub.add_parser("version", help="print the version")
     p_version.set_defaults(func=cmd_version)
+
+    p_vet = sub.add_parser(
+        "vet", help="syntax-check the Go files of a generated project"
+    )
+    p_vet.add_argument("path", help="root of the generated project")
+    p_vet.set_defaults(func=cmd_vet)
 
     return parser
 

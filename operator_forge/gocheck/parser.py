@@ -1,0 +1,823 @@
+"""Recursive-descent syntax parser for Go source files.
+
+Covers the Go 1.x grammar as used by operator-forge's generated projects
+and the upstream ecosystem code they resemble: package/import clauses,
+const/var/type/func declarations (methods, variadics, multiple results),
+the full statement set (if/else, all for forms incl. range, expression
+and type switches, select, go/defer/return/goto/labels/send/inc-dec),
+and the full expression grammar with Go's operator precedence, composite
+literals (including the control-clause TypeName ambiguity rule), slice
+expressions, type assertions, conversions and function literals.
+Generics are not parsed (nothing generated emits them).
+
+This is a *syntax* checker: it accepts exactly the shapes `go/parser`
+would and reports the first error per file with line/column.  Type
+checking and name resolution are out of scope (see tests/golint.py for
+the heuristic cross-file checks layered on top).
+"""
+
+from __future__ import annotations
+
+from .tokens import (
+    EOF,
+    FLOAT,
+    IDENT,
+    IMAG,
+    INT,
+    KEYWORD,
+    OP,
+    RUNE,
+    STRING,
+    GoTokenError,
+    Token,
+    tokenize,
+)
+
+_LITERALS = frozenset({INT, FLOAT, IMAG, RUNE, STRING})
+
+_BINARY_PREC = {
+    "||": 1,
+    "&&": 2,
+    "==": 3, "!=": 3, "<": 3, "<=": 3, ">": 3, ">=": 3,
+    "+": 4, "-": 4, "|": 4, "^": 4,
+    "*": 5, "/": 5, "%": 5, "<<": 5, ">>": 5, "&": 5, "&^": 5,
+}
+
+_UNARY_OPS = frozenset({"+", "-", "!", "^", "*", "&", "<-"})
+
+_ASSIGN_OPS = frozenset(
+    {"=", ":=", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<=", ">>=", "&^="}
+)
+
+# Tokens that can begin a type (used for parameter-list disambiguation).
+_TYPE_START_OPS = frozenset({"*", "[", "(", "<-"})
+_TYPE_START_KEYWORDS = frozenset({"map", "chan", "func", "interface", "struct"})
+
+
+class GoSyntaxError(Exception):
+    def __init__(self, filename: str, line: int, col: int, msg: str):
+        super().__init__(f"{filename}:{line}:{col}: {msg}")
+        self.filename = filename
+        self.line = line
+        self.col = col
+        self.msg = msg
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token], filename: str):
+        self.toks = tokens
+        self.i = 0
+        self.filename = filename
+        # Composite-literal permission for the control-clause ambiguity:
+        # `if x == T{}` is illegal; braces open the block instead.
+        self.allow_composite = True
+
+    # -- token plumbing ---------------------------------------------------
+
+    @property
+    def tok(self) -> Token:
+        return self.toks[self.i]
+
+    def peek(self, k: int = 1) -> Token:
+        j = min(self.i + k, len(self.toks) - 1)
+        return self.toks[j]
+
+    def error(self, msg: str, tok: Token | None = None):
+        t = tok or self.tok
+        shown = t.value if t.kind != EOF else "EOF"
+        raise GoSyntaxError(self.filename, t.line, t.col, f"{msg} (got {shown!r})")
+
+    def advance(self) -> Token:
+        t = self.tok
+        if t.kind != EOF:
+            self.i += 1
+        return t
+
+    def at_op(self, *vals: str) -> bool:
+        return self.tok.kind == OP and self.tok.value in vals
+
+    def at_kw(self, *vals: str) -> bool:
+        return self.tok.kind == KEYWORD and self.tok.value in vals
+
+    def expect_op(self, val: str) -> Token:
+        # Spec semicolon rule 2: a ";" is elided before ")" or "}"; the
+        # tokenizer inserts them at newlines, so skip one here.
+        if val in (")", "}") and self.at_op(";"):
+            self.advance()
+        if not self.at_op(val):
+            self.error(f"expected {val!r}")
+        return self.advance()
+
+    def expect_kw(self, val: str) -> Token:
+        if not self.at_kw(val):
+            self.error(f"expected keyword {val!r}")
+        return self.advance()
+
+    def expect_ident(self) -> Token:
+        if self.tok.kind != IDENT:
+            self.error("expected identifier")
+        return self.advance()
+
+    def expect_semi(self):
+        # ";" terminates statements/specs; also satisfied by a following
+        # ")" or "}" (spec rule 2) which the caller consumes.
+        if self.at_op(";"):
+            self.advance()
+        elif not (self.at_op(")", "}") or self.tok.kind == EOF):
+            self.error("expected ';'")
+
+    def skip_semis(self):
+        while self.at_op(";"):
+            self.advance()
+
+    # -- source file ------------------------------------------------------
+
+    def parse_file(self):
+        self.expect_kw("package")
+        self.expect_ident()
+        self.expect_semi()
+        self.skip_semis()
+        while self.at_kw("import"):
+            self.advance()
+            if self.at_op("("):
+                self.advance()
+                self.skip_semis()
+                while not self.at_op(")"):
+                    self.import_spec()
+                    self.expect_semi()
+                    self.skip_semis()
+                self.expect_op(")")
+            else:
+                self.import_spec()
+            self.expect_semi()
+            self.skip_semis()
+        while self.tok.kind != EOF:
+            self.top_level_decl()
+            self.skip_semis()
+
+    def import_spec(self):
+        if self.tok.kind == IDENT or self.at_op("."):
+            self.advance()
+        if self.tok.kind != STRING:
+            self.error("expected import path string")
+        self.advance()
+
+    def top_level_decl(self):
+        if self.at_kw("func"):
+            self.func_decl()
+        elif self.at_kw("const", "var", "type"):
+            self.generic_decl()
+        else:
+            self.error("expected declaration")
+
+    # -- declarations -----------------------------------------------------
+
+    def generic_decl(self):
+        kw = self.advance().value
+        spec = {"const": self.const_spec, "var": self.var_spec, "type": self.type_spec}[kw]
+        if self.at_op("("):
+            self.advance()
+            self.skip_semis()
+            while not self.at_op(")"):
+                spec()
+                self.expect_semi()
+                self.skip_semis()
+            self.expect_op(")")
+        else:
+            spec()
+        self.expect_semi()
+
+    def ident_list(self):
+        self.expect_ident()
+        while self.at_op(","):
+            self.advance()
+            self.expect_ident()
+
+    def const_spec(self):
+        self.ident_list()
+        if not (self.at_op("=", ";", ")") or self.tok.kind == EOF):
+            self.parse_type()
+        if self.at_op("="):
+            self.advance()
+            self.expr_list()
+
+    def var_spec(self):
+        self.ident_list()
+        if self.at_op("="):
+            self.advance()
+            self.expr_list()
+            return
+        self.parse_type()
+        if self.at_op("="):
+            self.advance()
+            self.expr_list()
+
+    def type_spec(self):
+        self.expect_ident()
+        if self.at_op("="):  # alias
+            self.advance()
+        self.parse_type()
+
+    def func_decl(self):
+        self.expect_kw("func")
+        if self.at_op("("):  # method receiver
+            self.param_list()
+        self.expect_ident()
+        self.signature()
+        if self.at_op("{"):
+            self.block()
+        self.expect_semi()
+
+    def signature(self):
+        self.param_list()
+        self.results()
+
+    def results(self):
+        if self.at_op("("):
+            self.param_list()
+        elif self.type_starts() and not self.at_op("{"):
+            self.parse_type()
+
+    def type_starts(self) -> bool:
+        t = self.tok
+        if t.kind == IDENT:
+            return True
+        if t.kind == KEYWORD and t.value in _TYPE_START_KEYWORDS:
+            return True
+        if t.kind == OP and t.value in _TYPE_START_OPS:
+            return True
+        return False
+
+    def param_list(self):
+        """Parse `( params )` leniently.
+
+        Each item is `[IdentList] ["..."] Type`; the name/type ambiguity
+        (`func(a, b int)` vs `func(int, string)`) is resolved by treating
+        a bare identifier followed by a type-start as a name.
+        """
+        self.expect_op("(")
+        saved = self.allow_composite
+        self.allow_composite = True
+        while not self.at_op(")"):
+            if self.at_op("..."):
+                self.advance()
+                self.parse_type()
+            elif self.tok.kind == IDENT and (
+                self.peek().kind == IDENT
+                or (self.peek().kind == KEYWORD and self.peek().value in _TYPE_START_KEYWORDS)
+                or (self.peek().kind == OP and self.peek().value in (_TYPE_START_OPS | {"..."}))
+            ):
+                self.advance()  # parameter name
+                if self.at_op("..."):
+                    self.advance()
+                self.parse_type()
+            else:
+                self.parse_type()
+            if self.at_op(","):
+                self.advance()
+            elif not self.at_op(")"):
+                self.error("expected ',' or ')' in parameter list")
+        self.expect_op(")")
+        self.allow_composite = saved
+
+    # -- types ------------------------------------------------------------
+
+    def parse_type(self):
+        t = self.tok
+        if t.kind == IDENT:
+            self.advance()
+            while self.at_op(".") and self.peek().kind == IDENT:
+                self.advance()
+                self.advance()
+            return
+        if t.kind == OP:
+            if t.value == "*":
+                self.advance()
+                self.parse_type()
+                return
+            if t.value == "[":
+                self.advance()
+                if self.at_op("]"):
+                    self.advance()
+                else:
+                    if self.at_op("..."):
+                        self.advance()
+                    else:
+                        saved = self.allow_composite
+                        self.allow_composite = True
+                        self.expression()
+                        self.allow_composite = saved
+                    self.expect_op("]")
+                self.parse_type()
+                return
+            if t.value == "(":
+                self.advance()
+                self.parse_type()
+                self.expect_op(")")
+                return
+            if t.value == "<-":
+                self.advance()
+                self.expect_kw("chan")
+                self.parse_type()
+                return
+        if t.kind == KEYWORD:
+            if t.value == "map":
+                self.advance()
+                self.expect_op("[")
+                self.parse_type()
+                self.expect_op("]")
+                self.parse_type()
+                return
+            if t.value == "chan":
+                self.advance()
+                if self.at_op("<-"):
+                    self.advance()
+                self.parse_type()
+                return
+            if t.value == "func":
+                self.advance()
+                self.signature()
+                return
+            if t.value == "struct":
+                self.struct_type()
+                return
+            if t.value == "interface":
+                self.interface_type()
+                return
+        self.error("expected type")
+
+    def struct_type(self):
+        self.expect_kw("struct")
+        self.expect_op("{")
+        self.skip_semis()
+        while not self.at_op("}"):
+            self.field_decl()
+            self.expect_semi()
+            self.skip_semis()
+        self.expect_op("}")
+
+    def field_decl(self):
+        # Embedded: [*] TypeName | named: IdentList Type — disambiguate by
+        # what follows the leading identifier(s).
+        if self.at_op("*"):
+            self.advance()
+            self.qualified_ident()
+        elif self.tok.kind == IDENT and (
+            self.peek().kind == OP and self.peek().value in (";", "}", ".")
+        ) and not (self.peek().value == "." and self.peek(2).kind == IDENT and self._field_has_type_after_qualifier()):
+            # embedded plain / qualified identifier
+            self.qualified_ident()
+        elif self.tok.kind == IDENT and self.peek().kind == STRING:
+            self.qualified_ident()  # embedded with tag
+        else:
+            self.ident_list()
+            self.parse_type()
+        if self.tok.kind == STRING:  # field tag
+            self.advance()
+
+    def _field_has_type_after_qualifier(self) -> bool:
+        # For `a.B c` (named field of qualified type) vs embedded `a.B`:
+        # look past the qualified ident for a type-start token.
+        j = self.i
+        toks = self.toks
+        if toks[j].kind != IDENT:
+            return False
+        j += 1
+        while j + 1 < len(toks) and toks[j].kind == OP and toks[j].value == "." and toks[j + 1].kind == IDENT:
+            j += 2
+        t = toks[j]
+        return t.kind == IDENT or (
+            t.kind == KEYWORD and t.value in _TYPE_START_KEYWORDS
+        ) or (t.kind == OP and t.value in _TYPE_START_OPS)
+
+    def qualified_ident(self):
+        self.expect_ident()
+        while self.at_op(".") and self.peek().kind == IDENT:
+            self.advance()
+            self.advance()
+
+    def interface_type(self):
+        self.expect_kw("interface")
+        self.expect_op("{")
+        self.skip_semis()
+        while not self.at_op("}"):
+            self.expect_ident()
+            if self.at_op("("):  # method spec
+                self.signature()
+            else:  # embedded interface (possibly qualified)
+                while self.at_op(".") and self.peek().kind == IDENT:
+                    self.advance()
+                    self.advance()
+            self.expect_semi()
+            self.skip_semis()
+        self.expect_op("}")
+
+    # -- statements -------------------------------------------------------
+
+    def block(self):
+        self.expect_op("{")
+        self.stmt_list()
+        self.expect_op("}")
+
+    def stmt_list(self):
+        self.skip_semis()
+        while not (self.at_op("}") or self.at_kw("case", "default") or self.tok.kind == EOF):
+            self.statement()
+            self.skip_semis()
+
+    def statement(self):
+        t = self.tok
+        if t.kind == KEYWORD:
+            v = t.value
+            if v in ("const", "var", "type"):
+                self.generic_decl()
+                return
+            if v == "if":
+                self.if_stmt()
+                return
+            if v == "for":
+                self.for_stmt()
+                return
+            if v == "switch":
+                self.switch_stmt()
+                return
+            if v == "select":
+                self.select_stmt()
+                return
+            if v == "return":
+                self.advance()
+                if not (self.at_op(";", "}") or self.tok.kind == EOF):
+                    self.expr_list()
+                self.expect_semi()
+                return
+            if v in ("break", "continue"):
+                self.advance()
+                if self.tok.kind == IDENT:
+                    self.advance()
+                self.expect_semi()
+                return
+            if v == "goto":
+                self.advance()
+                self.expect_ident()
+                self.expect_semi()
+                return
+            if v == "fallthrough":
+                self.advance()
+                self.expect_semi()
+                return
+            if v in ("go", "defer"):
+                self.advance()
+                self.expression()
+                self.expect_semi()
+                return
+        if t.kind == OP and t.value == "{":
+            self.block()
+            self.expect_semi()
+            return
+        # Labeled statement: IDENT ':' (but not ':=')
+        if t.kind == IDENT and self.peek().kind == OP and self.peek().value == ":":
+            self.advance()
+            self.advance()
+            if not (self.at_op("}") or self.at_kw("case", "default") or self.tok.kind == EOF):
+                self.statement()
+            else:
+                self.expect_semi()
+            return
+        self.simple_stmt()
+        self.expect_semi()
+
+    def simple_stmt(self, in_header: bool = False) -> str:
+        """ExpressionStmt | SendStmt | IncDec | Assignment | ShortVarDecl.
+
+        Returns a tag used by header parsers: 'expr', 'assign', or 'range'
+        (when `in_header` and a range clause was consumed).
+        """
+        self.expression()
+        while self.at_op(","):
+            self.advance()
+            self.expression()
+        if self.at_op("++", "--"):
+            self.advance()
+            return "assign"
+        if self.at_op("<-"):
+            self.advance()
+            self.expression()
+            return "assign"
+        if self.tok.kind == OP and self.tok.value in _ASSIGN_OPS:
+            self.advance()
+            if in_header and self.at_kw("range"):
+                self.advance()
+                self.expression()
+                return "range"
+            self.expr_list()
+            return "assign"
+        return "expr"
+
+    def header_clause(self, keyword: str):
+        """Parse the clause of if/switch: [SimpleStmt ;] [Expr] before '{'.
+
+        Returns True if a tag/cond expression is present.
+        """
+        saved = self.allow_composite
+        self.allow_composite = False
+        try:
+            if self.at_op("{"):
+                return False
+            if self.at_op(";"):
+                self.advance()
+                if self.at_op("{"):
+                    return False
+                self.simple_stmt()
+                return True
+            self.simple_stmt()
+            if self.at_op(";"):
+                self.advance()
+                if self.at_op("{"):
+                    return False
+                self.simple_stmt()
+            return True
+        finally:
+            self.allow_composite = saved
+
+    def if_stmt(self):
+        self.expect_kw("if")
+        if not self.header_clause("if"):
+            self.error("missing condition in if statement")
+        self.block()
+        if self.at_kw("else"):
+            self.advance()
+            if self.at_kw("if"):
+                self.if_stmt()
+                return
+            self.block()
+            self.expect_semi()
+        else:
+            self.expect_semi()
+
+    def for_stmt(self):
+        self.expect_kw("for")
+        saved = self.allow_composite
+        self.allow_composite = False
+        if self.at_op("{"):
+            pass  # infinite loop
+        elif self.at_kw("range"):
+            self.advance()
+            self.expression()
+        else:
+            tag = None
+            if not self.at_op(";"):
+                tag = self.simple_stmt(in_header=True)
+            if tag != "range" and self.at_op(";"):
+                self.advance()
+                if not self.at_op(";"):
+                    self.simple_stmt()
+                self.expect_op(";")
+                if not self.at_op("{"):
+                    self.simple_stmt()
+        self.allow_composite = saved
+        self.block()
+        self.expect_semi()
+
+    def switch_stmt(self):
+        self.expect_kw("switch")
+        saved = self.allow_composite
+        self.allow_composite = False
+        if not self.at_op("{"):
+            if self.at_op(";"):
+                self.advance()
+            else:
+                self.simple_stmt(in_header=True)
+                if self.at_op(";"):
+                    self.advance()
+                    if not self.at_op("{"):
+                        self.simple_stmt(in_header=True)
+        self.allow_composite = saved
+        self.expect_op("{")
+        self.skip_semis()
+        while self.at_kw("case", "default"):
+            if self.advance().value == "case":
+                # expression list or (type switch) type list; types parse
+                # as expressions syntactically except literals like
+                # chan/map/func/struct/interface/*T/[]T — accept either.
+                self.case_item()
+                while self.at_op(","):
+                    self.advance()
+                    self.case_item()
+            self.expect_op(":")
+            self.stmt_list()
+        self.expect_op("}")
+        self.expect_semi()
+
+    def case_item(self):
+        # In type switches a case may list types (incl. nil); type
+        # literals that are not valid expressions start with these:
+        if self.at_kw("chan", "map", "func", "interface", "struct") or self.at_op("[", "*", "<-"):
+            # `func` could begin a func literal expression, and `*`/`<-`/
+            # `[` unary exprs; try type first, fall back to expression.
+            mark = self.i
+            try:
+                self.parse_type()
+                if self.at_op(",", ":"):
+                    return
+            except GoSyntaxError:
+                pass
+            self.i = mark
+        self.expression()
+
+    def select_stmt(self):
+        self.expect_kw("select")
+        self.expect_op("{")
+        self.skip_semis()
+        while self.at_kw("case", "default"):
+            if self.advance().value == "case":
+                self.simple_stmt()
+            self.expect_op(":")
+            self.stmt_list()
+        self.expect_op("}")
+        self.expect_semi()
+
+    # -- expressions ------------------------------------------------------
+
+    def expr_list(self):
+        self.expression()
+        while self.at_op(","):
+            self.advance()
+            self.expression()
+
+    def expression(self, min_prec: int = 1):
+        self.unary_expr()
+        while True:
+            t = self.tok
+            if t.kind != OP:
+                return
+            prec = _BINARY_PREC.get(t.value, 0)
+            if prec < min_prec:
+                return
+            self.advance()
+            self.expression(prec + 1)
+
+    def unary_expr(self):
+        if self.tok.kind == OP and self.tok.value in _UNARY_OPS:
+            self.advance()
+            self.unary_expr()
+            return
+        self.primary_expr()
+
+    def primary_expr(self):
+        self.operand()
+        while True:
+            if self.at_op("."):
+                self.advance()
+                if self.at_op("("):  # type assertion
+                    self.advance()
+                    if self.at_kw("type"):
+                        self.advance()
+                    else:
+                        self.parse_type()
+                    self.expect_op(")")
+                else:
+                    self.expect_ident()
+                continue
+            if self.at_op("("):  # call / conversion
+                self.call_args()
+                continue
+            if self.at_op("["):  # index / slice
+                self.advance()
+                saved = self.allow_composite
+                self.allow_composite = True
+                if not self.at_op(":"):
+                    self.expression()
+                while self.at_op(":"):
+                    self.advance()
+                    if not self.at_op("]", ":"):
+                        self.expression()
+                self.allow_composite = saved
+                self.expect_op("]")
+                continue
+            if self.at_op("{") and self.allow_composite:
+                # Composite literal after a TypeName-shaped operand; the
+                # operand parser only reaches here for ident/selector/
+                # type-literal operands, all valid LiteralTypes.
+                self.literal_value()
+                continue
+            return
+
+    def call_args(self):
+        self.expect_op("(")
+        saved = self.allow_composite
+        self.allow_composite = True
+        while not self.at_op(")"):
+            # Arguments may be types (new/make/conversions); the operand
+            # parser already accepts type-literal heads as expressions.
+            self.expression()
+            if self.at_op("..."):
+                self.advance()
+            if self.at_op(","):
+                self.advance()
+            elif not self.at_op(")"):
+                self.error("expected ',' or ')' in argument list")
+        self.allow_composite = saved
+        self.expect_op(")")
+
+    def operand(self):
+        t = self.tok
+        if t.kind in _LITERALS:
+            self.advance()
+            return
+        if t.kind == IDENT:
+            self.advance()
+            return
+        if t.kind == OP:
+            if t.value == "(":
+                self.advance()
+                saved = self.allow_composite
+                self.allow_composite = True
+                # Parenthesized expression or type (conversion head).
+                if self.at_kw("chan", "map", "interface", "struct") or self.at_op("*") and self._paren_is_type():
+                    mark = self.i
+                    try:
+                        self.parse_type()
+                        self.allow_composite = saved
+                        self.expect_op(")")
+                        return
+                    except GoSyntaxError:
+                        self.i = mark
+                self.expression()
+                self.allow_composite = saved
+                self.expect_op(")")
+                return
+            if t.value == "[":  # slice/array type head: []int{...} or []byte(x)
+                self.parse_type()
+                if self.at_op("{"):
+                    self.literal_value()
+                elif self.at_op("("):
+                    self.call_args()
+                return
+        if t.kind == KEYWORD:
+            if t.value == "func":
+                self.advance()
+                self.signature()
+                if self.at_op("{"):
+                    saved = self.allow_composite
+                    self.allow_composite = True
+                    self.block()
+                    self.allow_composite = saved
+                else:
+                    self.error("function literal requires a body")
+                return
+            if t.value in ("map", "chan", "struct", "interface"):
+                self.parse_type()
+                if self.at_op("{"):
+                    self.literal_value()
+                elif self.at_op("("):  # conversion, e.g. chan int(x) illegal but map[...]... (x) rare
+                    self.call_args()
+                return
+        self.error("expected expression")
+
+    def _paren_is_type(self) -> bool:
+        # Heuristic for `(*T)(x)` conversions: `(*` is always a type head
+        # in valid Go when followed by ident and `)` then `(`.
+        return self.peek().kind == IDENT or (
+            self.peek().kind == OP and self.peek().value == "*"
+        )
+
+    def literal_value(self):
+        self.expect_op("{")
+        saved = self.allow_composite
+        self.allow_composite = True
+        self.skip_semis()
+        while not self.at_op("}"):
+            self.element()
+            if self.at_op(":"):
+                self.advance()
+                self.element()
+            if self.at_op(","):
+                self.advance()
+                self.skip_semis()
+            else:
+                self.skip_semis()
+                if not self.at_op("}"):
+                    self.error("expected ',' or '}' in composite literal")
+        self.allow_composite = saved
+        self.expect_op("}")
+
+    def element(self):
+        if self.at_op("{"):  # nested literal with elided type
+            self.literal_value()
+        else:
+            self.expression()
+
+
+def parse_source(text: str, filename: str = "<go>"):
+    """Parse a Go source file; raises GoTokenError/GoSyntaxError on failure."""
+    toks = tokenize(text, filename)
+    _Parser(toks, filename).parse_file()
+
+
+def check_source(text: str, filename: str = "<go>") -> list[str]:
+    """Return a list of error strings ([] if the file parses)."""
+    try:
+        parse_source(text, filename)
+    except (GoTokenError, GoSyntaxError) as exc:
+        return [str(exc)]
+    return []

@@ -1,0 +1,22 @@
+"""Project-tree walker for the Go syntax checker."""
+
+from __future__ import annotations
+
+import os
+
+from .parser import check_source
+
+
+def check_project(root: str) -> list[str]:
+    """Syntax-check every ``.go`` file under *root*; returns all errors."""
+    errors: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        for name in sorted(filenames):
+            if not name.endswith(".go"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            errors.extend(check_source(text, path))
+    return errors

@@ -1,0 +1,282 @@
+"""Go tokenizer with automatic semicolon insertion.
+
+Implements the lexical grammar of the Go spec (Tokens, Semicolons,
+Identifiers, Keywords, Operators and punctuation, Integer/Floating-point/
+Imaginary/Rune/String literals).  Semicolon insertion follows spec rule 1:
+a ";" is inserted at the end of a non-blank line when the final token is
+an identifier, a literal, one of the keywords break/continue/fallthrough/
+return, one of ++/--, or one of )/]/}.  (Rule 2 — eliding semicolons
+before ")" or "}" — is handled by the parser accepting optional
+semicolons there.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class GoTokenError(Exception):
+    def __init__(self, filename: str, line: int, col: int, msg: str):
+        super().__init__(f"{filename}:{line}:{col}: {msg}")
+        self.filename = filename
+        self.line = line
+        self.col = col
+        self.msg = msg
+
+
+KEYWORDS = frozenset(
+    """break case chan const continue default defer else fallthrough for
+    func go goto if import interface map package range return select
+    struct switch type var""".split()
+)
+
+# Longest-first so the scanner can use greedy matching.
+OPERATORS = sorted(
+    [
+        "<<=", ">>=", "&^=", "...",
+        "&&", "||", "<-", "++", "--", "==", "!=", "<=", ">=", ":=",
+        "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<", ">>", "&^",
+        "+", "-", "*", "/", "%", "&", "|", "^", "<", ">", "=", "!",
+        "(", ")", "[", "]", "{", "}", ",", ";", ".", ":",
+    ],
+    key=len,
+    reverse=True,
+)
+
+# Tokens after which a newline triggers semicolon insertion (spec rule 1).
+_ASI_AFTER_OPS = frozenset({")", "]", "}", "++", "--"})
+_ASI_AFTER_KEYWORDS = frozenset({"break", "continue", "fallthrough", "return"})
+
+IDENT = "IDENT"
+KEYWORD = "KEYWORD"
+INT = "INT"
+FLOAT = "FLOAT"
+IMAG = "IMAG"
+RUNE = "RUNE"
+STRING = "STRING"
+OP = "OP"
+EOF = "EOF"
+
+_LITERAL_KINDS = frozenset({INT, FLOAT, IMAG, RUNE, STRING})
+
+
+@dataclass
+class Token:
+    kind: str
+    value: str
+    line: int
+    col: int
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Token({self.kind}, {self.value!r}, {self.line}:{self.col})"
+
+
+def _is_ident_start(ch: str) -> bool:
+    return ch == "_" or ch.isalpha()
+
+
+def _is_ident_char(ch: str) -> bool:
+    return ch == "_" or ch.isalnum()
+
+
+_DIGITS = {
+    "b": "01_",
+    "o": "01234567_",
+    "x": "0123456789abcdefABCDEF_",
+}
+
+
+def tokenize(text: str, filename: str = "<go>") -> list[Token]:
+    """Tokenize Go source, applying semicolon insertion.
+
+    Returns the token stream terminated by an EOF token.  Comments are
+    discarded (a general comment containing no newline counts as nothing;
+    one containing newlines acts as a newline for ASI, per spec).
+    """
+    tokens: list[Token] = []
+    i = 0
+    n = len(text)
+    line = 1
+    col = 1
+
+    def err(msg: str, l: int | None = None, c: int | None = None):
+        raise GoTokenError(filename, l if l is not None else line, c if c is not None else col, msg)
+
+    def asi_pending() -> bool:
+        if not tokens:
+            return False
+        t = tokens[-1]
+        if t.kind in (IDENT,) or t.kind in _LITERAL_KINDS:
+            return True
+        if t.kind == KEYWORD and t.value in _ASI_AFTER_KEYWORDS:
+            return True
+        if t.kind == OP and t.value in _ASI_AFTER_OPS:
+            return True
+        return False
+
+    def insert_semi():
+        if asi_pending():
+            tokens.append(Token(OP, ";", line, col))
+
+    while i < n:
+        ch = text[i]
+
+        if ch == "\n":
+            insert_semi()
+            i += 1
+            line += 1
+            col = 1
+            continue
+        if ch in " \t\r":
+            i += 1
+            col += 1
+            continue
+
+        # Comments.
+        if ch == "/" and i + 1 < n:
+            nxt = text[i + 1]
+            if nxt == "/":
+                j = text.find("\n", i)
+                if j == -1:
+                    i = n
+                else:
+                    col += j - i
+                    i = j  # the newline itself handles ASI
+                continue
+            if nxt == "*":
+                j = text.find("*/", i + 2)
+                if j == -1:
+                    err("unterminated block comment")
+                body = text[i + 2 : j]
+                if "\n" in body:
+                    insert_semi()
+                    line += body.count("\n")
+                    col = len(body) - body.rfind("\n") + 2
+                else:
+                    col += (j + 2) - i
+                i = j + 2
+                continue
+
+        start_line, start_col = line, col
+
+        # Identifiers / keywords.
+        if _is_ident_start(ch):
+            j = i + 1
+            while j < n and _is_ident_char(text[j]):
+                j += 1
+            word = text[i:j]
+            kind = KEYWORD if word in KEYWORDS else IDENT
+            tokens.append(Token(kind, word, start_line, start_col))
+            col += j - i
+            i = j
+            continue
+
+        # Numbers (incl. ".5" floats).
+        if ch.isdigit() or (ch == "." and i + 1 < n and text[i + 1].isdigit()):
+            j = i
+            kind = INT
+            if ch == "0" and j + 1 < n and text[j + 1] in "bBoOxX":
+                base = text[j + 1].lower()
+                digits = _DIGITS[base]
+                j += 2
+                k = j
+                while j < n and text[j] in digits:
+                    j += 1
+                if j == k:
+                    err(f"malformed 0{base} literal")
+                if base == "x":
+                    # hex float: mantissa may contain '.', needs p-exponent
+                    if j < n and text[j] == ".":
+                        j += 1
+                        while j < n and text[j] in digits:
+                            j += 1
+                        kind = FLOAT
+                    if j < n and text[j] in "pP":
+                        kind = FLOAT
+                        j += 1
+                        if j < n and text[j] in "+-":
+                            j += 1
+                        if j >= n or not text[j].isdigit():
+                            err("malformed hex float exponent")
+                        while j < n and (text[j].isdigit() or text[j] == "_"):
+                            j += 1
+                    elif kind == FLOAT:
+                        err("hex float requires p exponent")
+            else:
+                while j < n and (text[j].isdigit() or text[j] == "_"):
+                    j += 1
+                if j < n and text[j] == ".":
+                    kind = FLOAT
+                    j += 1
+                    while j < n and (text[j].isdigit() or text[j] == "_"):
+                        j += 1
+                if j < n and text[j] in "eE":
+                    kind = FLOAT
+                    j += 1
+                    if j < n and text[j] in "+-":
+                        j += 1
+                    if j >= n or not text[j].isdigit():
+                        err("malformed exponent")
+                    while j < n and (text[j].isdigit() or text[j] == "_"):
+                        j += 1
+            if j < n and text[j] == "i":
+                kind = IMAG
+                j += 1
+            tokens.append(Token(kind, text[i:j], start_line, start_col))
+            col += j - i
+            i = j
+            continue
+
+        # Raw string literal.
+        if ch == "`":
+            j = text.find("`", i + 1)
+            if j == -1:
+                err("unterminated raw string literal")
+            body = text[i : j + 1]
+            tokens.append(Token(STRING, body, start_line, start_col))
+            nl = body.count("\n")
+            if nl:
+                line += nl
+                col = len(body) - body.rfind("\n")
+            else:
+                col += len(body)
+            i = j + 1
+            continue
+
+        # Interpreted string / rune literal.
+        if ch in "\"'":
+            quote = ch
+            j = i + 1
+            while j < n:
+                c = text[j]
+                if c == "\\":
+                    j += 2
+                    continue
+                if c == "\n":
+                    err("newline in string literal", start_line, start_col)
+                if c == quote:
+                    break
+                j += 1
+            if j >= n:
+                err("unterminated string literal", start_line, start_col)
+            tokens.append(
+                Token(RUNE if quote == "'" else STRING, text[i : j + 1], start_line, start_col)
+            )
+            col += j + 1 - i
+            i = j + 1
+            continue
+
+        # Operators / punctuation.
+        for op in OPERATORS:
+            if text.startswith(op, i):
+                tokens.append(Token(OP, op, start_line, start_col))
+                i += len(op)
+                col += len(op)
+                break
+        else:
+            err(f"unexpected character {ch!r}")
+
+    # EOF acts like a newline for semicolon insertion.
+    insert_semi()
+    tokens.append(Token(EOF, "", line, col))
+    return tokens

@@ -148,17 +148,18 @@ def check_tokens(path: str) -> list[str]:
     return problems
 
 
-_GO_KEYWORDS = {
-    "break", "case", "chan", "const", "continue", "default", "defer",
-    "else", "fallthrough", "for", "func", "go", "goto", "if", "import",
-    "interface", "map", "package", "range", "return", "select", "struct",
-    "switch", "type", "var",
-}
+from operator_forge.gocheck.tokens import KEYWORDS as _GO_KEYWORDS
 
 # identifiers used as `name.` qualifiers: not preceded by ident char, `.`,
 # `)` or `]` (those are field/method accesses on expressions)
 _QUAL_RE = re.compile(r"(?<![\w.\)\]])([A-Za-z_]\w*)\s*\.")
-_SHORT_DECL_RE = re.compile(r"^\s*([\w\s,]+?)\s*:?=", re.MULTILINE)
+# declarations/assignments at line start or after `{`/`;`/header keywords
+# (`if x := ...;`, `switch v := ...`, `for i := ...`)
+_SHORT_DECL_RE = re.compile(
+    r"(?:^|[{;]|\belse\b|\bif\b|\bswitch\b|\bfor\b)\s*"
+    r"([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*:?=(?!=)",
+    re.MULTILINE,
+)
 _VAR_DECL_RE = re.compile(
     r"^\s*(?:var|const)\s+([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)",
     re.MULTILINE,
@@ -266,7 +267,10 @@ def check_unresolved_qualifiers(package_dir: str) -> list[str]:
         clean = _strip_strings_and_comments(text)
         block = _IMPORT_BLOCK_RE.search(clean)
         if block:
-            clean = clean[: block.start()] + clean[block.end() :]
+            # blank the import block rather than excising it so reported
+            # line numbers stay aligned with the source file
+            blanked = "\n" * clean[block.start() : block.end()].count("\n")
+            clean = clean[: block.start()] + blanked + clean[block.end() :]
         known = imports | pkg_decls | _local_names(clean) | _GO_KEYWORDS
         for match in _QUAL_RE.finditer(clean):
             name = match.group(1)

@@ -106,6 +106,22 @@ class TestMiscCommands:
         ) == 1
         assert "PROJECT" in capsys.readouterr().err
 
+    def test_vet_clean_and_broken(self, tmp_path, capsys):
+        good = tmp_path / "ok"
+        good.mkdir()
+        (good / "main.go").write_text("package main\n\nfunc main() {}\n")
+        assert cli_main(["vet", str(good)]) == 0
+        assert "parse cleanly" in capsys.readouterr().out
+
+        (good / "broken.go").write_text("package main\n\nfunc bad( {\n")
+        assert cli_main(["vet", str(good)]) == 1
+        err = capsys.readouterr().err
+        assert "broken.go" in err and "syntax error" in err
+
+    def test_vet_missing_dir(self, tmp_path, capsys):
+        assert cli_main(["vet", str(tmp_path / "nope")]) == 1
+        assert "not a directory" in capsys.readouterr().err
+
 
 class TestCreateAPIFlags:
     def _init(self, tmp_path):

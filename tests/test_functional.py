@@ -392,6 +392,43 @@ class TestGoStructuralLint:
                     problems.extend(check_unresolved_qualifiers(dirpath))
         assert not problems, "\n".join(problems)
 
+    def test_qualifier_lint_accepts_header_short_decls(self, tmp_path):
+        """`if x := ...`, `switch v := ...` declare locals; the lint must
+        not flag their later use as qualifiers."""
+        from golint import check_unresolved_qualifiers
+        (tmp_path / "a.go").write_text(
+            "package p\n\n"
+            "func f(a interface{}) {\n"
+            "\tif x := get(); x.Ready {\n"
+            "\t}\n"
+            "\tswitch v := a.(type) {\n"
+            "\tcase error:\n"
+            "\t\t_ = v.Error()\n"
+            "\t}\n"
+            "\tfor i := first(); i.Next() {\n"
+            "\t}\n"
+            "}\n"
+        )
+        assert check_unresolved_qualifiers(str(tmp_path)) == []
+
+    def test_qualifier_lint_reports_source_line_numbers(self, tmp_path):
+        """Reported positions must match the original file even when an
+        import block precedes the offending line."""
+        from golint import check_unresolved_qualifiers
+        src = (
+            "package p\n\n"
+            "import (\n\t\"fmt\"\n\t\"os\"\n)\n\n"
+            "func f() {\n"
+            "\tfmt.Println(os.Args)\n"
+            "\tbogus.Call()\n"
+            "}\n"
+        )
+        (tmp_path / "b.go").write_text(src)
+        problems = check_unresolved_qualifiers(str(tmp_path))
+        assert len(problems) == 1
+        want_line = src[: src.index("bogus")].count("\n") + 1
+        assert f"b.go:{want_line}:" in problems[0]
+
     def test_unresolved_qualifier_lint_detects_injected_bug(self, tmp_path):
         from golint import check_unresolved_qualifiers
         project = _generate(
@@ -414,6 +451,31 @@ class TestGoTokenLint:
         for path in _go_files(project):
             problems += [f"{path}: {p}" for p in check_tokens(path)]
         assert not problems, "\n".join(problems)
+
+
+class TestGoSyntax:
+    """Every generated file must be valid Go per the full-grammar parser
+    (operator_forge/gocheck) — the syntax half of what `go build` checks
+    in the reference's CI (.github/workflows/test.yaml:55-105)."""
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "standalone",
+            "edge-standalone",
+            "collection",
+            "edge-collection",
+            "deps-collection",
+            "multigroup",
+            "kitchen-sink",
+            "tpu-workload",
+        ],
+    )
+    def test_generated_project_parses(self, tmp_path, fixture):
+        from operator_forge.gocheck import check_project
+        project = _generate(tmp_path, fixture, f"github.com/acme/{fixture}-operator")
+        errors = check_project(project)
+        assert not errors, "\n".join(errors)
 
 
 def test_dockerfile_copy_does_not_require_go_sum(tmp_path):

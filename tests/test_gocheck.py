@@ -1,0 +1,237 @@
+"""Tests for the Go syntax checker (operator_forge/gocheck).
+
+Three tiers:
+- tokenizer unit tests incl. semicolon-insertion rules (Go spec "Semicolons");
+- parser accept/reject tables over grammar features the generated projects
+  and their ecosystem use;
+- a corpus test parsing every .go file of the reference checkout when one
+  is mounted (the strongest available oracle: all 120 files are valid Go).
+"""
+
+import os
+
+import pytest
+
+from operator_forge.gocheck import (
+    GoSyntaxError,
+    GoTokenError,
+    check_source,
+    parse_source,
+    tokenize,
+)
+
+REFERENCE = "/root/reference"
+
+
+def toks(src):
+    return [(t.kind, t.value) for t in tokenize(src)][:-1]  # drop EOF
+
+
+class TestTokenizer:
+    def test_idents_keywords_literals(self):
+        got = toks('x := 42 + 0x2a_f / 1.5e-3 + `raw` + "s\\"t" + \'\\n\' + 3i')
+        kinds = [k for k, _ in got]
+        assert "IDENT" in kinds and "KEYWORD" not in kinds
+        values = [v for _, v in got]
+        assert "0x2a_f" in values and "1.5e-3" in values and "`raw`" in values
+        assert '"s\\"t"' in values and "'\\n'" in values and "3i" in values
+
+    def test_asi_after_ident_literal_paren(self):
+        # Newlines after ident/literal/)/]/}/++/--/return insert ';'.
+        for src, want in [
+            ("a\n", True),
+            ("42\n", True),
+            (")\n", True),
+            ("}\n", True),
+            ("++\n", True),
+            ("return\n", True),
+            ("+\n", False),
+            (",\n", False),
+            ("{\n", False),
+            ("func\n", False),
+        ]:
+            values = [v for _, v in toks(src)]
+            assert (";" in values) == want, src
+
+    def test_asi_at_eof_without_newline(self):
+        assert toks("x")[-1] == ("OP", ";")
+
+    def test_line_comment_acts_as_newline(self):
+        assert (";" in [v for _, v in toks("x // c\n")])
+
+    def test_multiline_block_comment_acts_as_newline(self):
+        assert (";" in [v for _, v in toks("x /* a\nb */ y")])
+
+    def test_single_line_block_comment_does_not(self):
+        stream = toks("x /* c */ y\n")
+        assert [v for _, v in stream] == ["x", "y", ";"]
+
+    def test_raw_string_spans_lines_without_asi_inside(self):
+        stream = toks("`a\nb`\n")
+        assert [v for _, v in stream] == ["`a\nb`", ";"]
+
+    def test_errors(self):
+        for bad in ["\"unterminated", "`unterminated", "'x", "@", "/* open"]:
+            with pytest.raises(GoTokenError):
+                tokenize(bad)
+
+    def test_newline_in_interpreted_string(self):
+        with pytest.raises(GoTokenError):
+            tokenize('"a\nb"')
+
+
+def accept(body):
+    parse_source("package p\n" + body)
+
+
+def reject(body):
+    with pytest.raises((GoSyntaxError, GoTokenError)):
+        parse_source("package p\n" + body)
+
+
+class TestParserAccepts:
+    def test_imports(self):
+        parse_source('package p\nimport "fmt"\nimport (\n\t"os"\n\tx "io"\n\t. "strings"\n\t_ "embed"\n)\n')
+
+    def test_decl_forms(self):
+        accept("const a = 1\nconst (\n\tb = iota\n\tc\n)\nvar d, e int = 1, 2\nvar f = []string{}\ntype T struct{}\ntype A = T\n")
+
+    def test_func_methods_variadic_results(self):
+        accept("func f(a, b int, c ...string) (int, error) { return 0, nil }\n"
+               "func (r *T) M() error { return nil }\n"
+               "func g() (n int, err error) { return }\n"
+               "type T struct{}\n")
+
+    def test_struct_and_interface(self):
+        accept("type S struct {\n\tName string `json:\"name\"`\n\tmeta.ObjectMeta `json:\",inline\"`\n\t*Embedded\n\tNested struct{ X int }\n\tm map[string][]*S\n}\n"
+               "type I interface {\n\tio.Reader\n\tClose() error\n\tDo(x int) (y string, err error)\n}\n")
+
+    def test_statements(self):
+        accept("""func f() {
+\tif x := g(); x != nil {
+\t} else if y {
+\t} else {
+\t}
+\tfor i := 0; i < 10; i++ {
+\t}
+\tfor ; ; i++ {
+\t}
+\tfor k, v := range m {
+\t\t_, _ = k, v
+\t}
+\tfor range ch {
+\t}
+\tswitch x := v.(type) {
+\tcase string, int:
+\tcase *T, []byte, map[string]int:
+\tdefault:
+\t}
+\tswitch {
+\tcase a < b:
+\t\tfallthrough
+\tdefault:
+\t}
+\tselect {
+\tcase v := <-ch:
+\t\t_ = v
+\tcase ch <- 1:
+\tdefault:
+\t}
+\tgo func() { defer close(ch) }()
+\tL:
+\tfor {
+\t\tbreak L
+\t}
+\tgoto L
+}
+""")
+
+    def test_expressions(self):
+        accept("""func f() {
+\ta := []byte("x")
+\tb := map[string][]string{"k": {"v"}}
+\tc := &T{Name: "n", Inner: T2{1, 2}}
+\td := (*T)(nil)
+\te := x.(interface{ Foo() }).Foo
+\tg := a[1:2:3]
+\th := fn(args...)
+\ti := <-ch
+\tj := func(x int) int { return x * 2 }(3)
+\t_ = struct{ X int }{X: 1}
+\t_ = [...]int{1, 2}
+\t_ = chan int(nil)
+\t_, _, _, _, _, _, _, _, _, _ = a, b, c, d, e, g, h, i, j, j
+}
+""")
+
+    def test_composite_literal_control_clause_rules(self):
+        # Parenthesized TypeName literal in a condition is legal…
+        accept("func f() {\n\tif (T{}) == x {\n\t}\n\tfor i := range ([]int{1, 2}) {\n\t\t_ = i\n\t}\n}\n")
+        # …and non-TypeName literal types are legal unparenthesized.
+        accept("func f() {\n\tfor _, v := range []string{\"a\"} {\n\t\t_ = v\n\t}\n\tif m := map[string]int{}; len(m) == 0 {\n\t}\n}\n")
+
+    def test_semicolon_styles(self):
+        accept("func f() { x := 1; x++; _ = x }\n")
+
+
+class TestParserRejects:
+    def test_missing_package(self):
+        with pytest.raises(GoSyntaxError):
+            parse_source("import \"fmt\"\n")
+
+    def test_unbalanced_brace(self):
+        reject("func f() {\n")
+
+    def test_bad_composite_in_if(self):
+        # The classic ambiguity: unparenthesized TypeName literal.
+        reject("func f() {\n\tif x == T{} {\n\t}\n}\n")
+
+    def test_stray_tokens(self):
+        reject("func f() { 1 2 }\n")
+        reject("func f() { x := }\n")
+        reject("func f() { return,, }\n")
+
+    def test_bad_struct(self):
+        reject("type S struct { 1 int }\n")
+        reject("type S struct { x int,\n}\n")
+
+    def test_bad_decl(self):
+        reject("const = 3\n")
+        reject("var\n")
+        reject("func () {}\n")
+
+    def test_bad_call(self):
+        reject("func f() { g(a,, b) }\n")
+        reject("func f() { g(a b) }\n")
+
+    def test_keyword_as_expr(self):
+        reject("func f() { x := for }\n")
+
+    def test_double_dot_selector(self):
+        reject("func f() { a..b() }\n")
+
+
+class TestCheckSource:
+    def test_ok_returns_empty(self):
+        assert check_source("package p\n") == []
+
+    def test_error_has_position(self):
+        errs = check_source("package p\nfunc f() {\n\tx :=\n}\n", "f.go")
+        assert len(errs) == 1 and errs[0].startswith("f.go:")
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference checkout not mounted")
+class TestReferenceCorpus:
+    def test_all_reference_go_files_parse(self):
+        failures = []
+        count = 0
+        for dirpath, _, files in os.walk(REFERENCE):
+            for name in sorted(files):
+                if not name.endswith(".go"):
+                    continue
+                count += 1
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as fh:
+                    failures.extend(check_source(fh.read(), path))
+        assert count > 100  # the corpus is real
+        assert failures == []
