@@ -513,10 +513,11 @@ class _Parser:
             return "assign"
         return "expr"
 
-    def header_clause(self, keyword: str):
-        """Parse the clause of if/switch: [SimpleStmt ;] [Expr] before '{'.
+    def header_clause(self) -> bool:
+        """Parse an if/switch clause: [SimpleStmt ;] [SimpleStmt] before '{'.
 
-        Returns True if a tag/cond expression is present.
+        Returns True if a final cond/tag clause is present (required for
+        `if`, optional for `switch`).
         """
         saved = self.allow_composite
         self.allow_composite = False
@@ -541,7 +542,7 @@ class _Parser:
 
     def if_stmt(self):
         self.expect_kw("if")
-        if not self.header_clause("if"):
+        if not self.header_clause():
             self.error("missing condition in if statement")
         self.block()
         if self.at_kw("else"):
@@ -580,18 +581,7 @@ class _Parser:
 
     def switch_stmt(self):
         self.expect_kw("switch")
-        saved = self.allow_composite
-        self.allow_composite = False
-        if not self.at_op("{"):
-            if self.at_op(";"):
-                self.advance()
-            else:
-                self.simple_stmt(in_header=True)
-                if self.at_op(";"):
-                    self.advance()
-                    if not self.at_op("{"):
-                        self.simple_stmt(in_header=True)
-        self.allow_composite = saved
+        self.header_clause()
         self.expect_op("{")
         self.skip_semis()
         while self.at_kw("case", "default"):
@@ -732,16 +722,23 @@ class _Parser:
                 self.advance()
                 saved = self.allow_composite
                 self.allow_composite = True
-                # Parenthesized expression or type (conversion head).
-                if self.at_kw("chan", "map", "interface", "struct") or self.at_op("*") and self._paren_is_type():
+                # Parenthesized expression or type (conversion head like
+                # `(*T)(x)` / `(func())(nil)`): try the type reading, but
+                # only commit when ')' follows; otherwise reparse as an
+                # expression with composite literals still allowed.
+                if self.at_kw("chan", "map", "interface", "struct", "func") or (
+                    self.at_op("*") and self._paren_is_type()
+                ):
                     mark = self.i
                     try:
                         self.parse_type()
-                        self.allow_composite = saved
-                        self.expect_op(")")
-                        return
+                        if self.at_op(")"):
+                            self.allow_composite = saved
+                            self.advance()
+                            return
                     except GoSyntaxError:
-                        self.i = mark
+                        pass
+                    self.i = mark
                 self.expression()
                 self.allow_composite = saved
                 self.expect_op(")")

@@ -8,15 +8,29 @@ from .parser import check_source
 
 
 def check_project(root: str) -> list[str]:
-    """Syntax-check every ``.go`` file under *root*; returns all errors."""
+    """Syntax-check every ``.go`` file under *root*; returns all errors.
+
+    Directories Go tooling ignores are pruned: dot-dirs, ``vendor``,
+    ``testdata``, and ``_``-prefixed dirs (vendored third-party code may
+    use language features the checker does not cover, e.g. generics).
+    Unreadable or non-UTF-8 files are reported as errors, not raised.
+    """
     errors: list[str] = []
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        dirnames[:] = sorted(
+            d
+            for d in dirnames
+            if not d.startswith((".", "_")) and d not in ("vendor", "testdata")
+        )
         for name in sorted(filenames):
             if not name.endswith(".go"):
                 continue
             path = os.path.join(dirpath, name)
-            with open(path, encoding="utf-8") as fh:
-                text = fh.read()
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except (OSError, UnicodeDecodeError) as exc:
+                errors.append(f"{path}: unreadable: {exc}")
+                continue
             errors.extend(check_source(text, path))
     return errors

@@ -250,6 +250,8 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
             while j < n:
                 c = text[j]
                 if c == "\\":
+                    if j + 1 < n and text[j + 1] == "\n":
+                        err("newline in string literal", start_line, start_col)
                     j += 2
                     continue
                 if c == "\n":

@@ -79,6 +79,11 @@ class TestTokenizer:
         with pytest.raises(GoTokenError):
             tokenize('"a\nb"')
 
+    def test_escaped_newline_in_string_still_rejected(self):
+        # Go rejects any newline in an interpreted string, escaped or not.
+        with pytest.raises(GoTokenError):
+            tokenize('"a\\\nb"')
+
 
 def accept(body):
     parse_source("package p\n" + body)
@@ -173,6 +178,20 @@ class TestParserAccepts:
     def test_semicolon_styles(self):
         accept("func f() { x := 1; x++; _ = x }\n")
 
+    def test_paren_expr_in_header_lifts_composite_restriction(self):
+        # The type-attempt fallback must keep composites allowed inside
+        # the parentheses even when the ')' does not directly follow.
+        accept("func f(p *T) {\n\tif (*p == T{}) {\n\t}\n}\ntype T struct{}\n")
+
+    def test_func_type_conversion(self):
+        accept("var f = (func())(nil)\n")
+        accept("var g = (func(int) error)(nil)\n")
+        # immediately-invoked paren-wrapped literal still parses
+        accept("var h = (func() int { return 1 })()\n")
+
+    def test_switch_with_init_and_tag(self):
+        accept("func f() {\n\tswitch x := g(); x {\n\tcase 1:\n\t}\n\tswitch ; {\n\tdefault:\n\t}\n}\n")
+
 
 class TestParserRejects:
     def test_missing_package(self):
@@ -218,6 +237,22 @@ class TestCheckSource:
     def test_error_has_position(self):
         errs = check_source("package p\nfunc f() {\n\tx :=\n}\n", "f.go")
         assert len(errs) == 1 and errs[0].startswith("f.go:")
+
+
+class TestCheckProject:
+    def test_prunes_vendor_and_reports_unreadable(self, tmp_path):
+        from operator_forge.gocheck import check_project
+
+        (tmp_path / "main.go").write_text("package main\n\nfunc main() {}\n")
+        vendor = tmp_path / "vendor" / "dep"
+        vendor.mkdir(parents=True)
+        # vendored code may use features the checker doesn't parse
+        (vendor / "generic.go").write_text("package dep\n\ntype S[T any] struct{}\n")
+        assert check_project(str(tmp_path)) == []
+
+        (tmp_path / "binary.go").write_bytes(b"\xff\xfe\x00bad")
+        errors = check_project(str(tmp_path))
+        assert len(errors) == 1 and "unreadable" in errors[0]
 
 
 @pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference checkout not mounted")
