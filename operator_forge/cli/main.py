@@ -221,6 +221,46 @@ def cmd_version(_: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_preview(args: argparse.Namespace) -> int:
+    """Render child manifests for a CR without building the operator —
+    the native equivalent of the generated companion CLI's `generate`
+    subcommand (reference templates/cli/cmd_generate_sub.go:49-332)."""
+    from operator_forge.markers import MarkerError
+    from operator_forge.workload.config import ConfigParseError
+    from operator_forge.workload.create_api import CreateAPIError
+    from operator_forge.workload.kinds import (
+        ManifestProcessingError,
+        WorkloadConfigError,
+    )
+    from operator_forge.workload.preview import PreviewError, preview
+    from operator_forge.yamldoc import YamlDocError
+
+    try:
+        rendered = preview(
+            args.workload_config,
+            args.workload_manifest,
+            collection_manifest=args.collection_manifest,
+        )
+    except (
+        PreviewError,
+        ConfigParseError,
+        CreateAPIError,
+        WorkloadConfigError,
+        ManifestProcessingError,
+        MarkerError,
+        YamlDocError,
+        OSError,
+    ) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if not rendered:
+        # a valid CR can legitimately render zero children (all guards off)
+        print("no child resources to render", file=sys.stderr)
+        return 0
+    sys.stdout.write(rendered)
+    return 0
+
+
 def cmd_vet(args: argparse.Namespace) -> int:
     """Syntax-check every .go file of a generated project.
 
@@ -318,6 +358,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_vet.add_argument("path", help="root of the generated project")
     p_vet.set_defaults(func=cmd_vet)
+
+    p_preview = sub.add_parser(
+        "preview",
+        help="render child manifests for a custom resource without "
+        "building the operator",
+    )
+    p_preview.add_argument(
+        "--workload-config", required=True, help="workload config YAML"
+    )
+    p_preview.add_argument(
+        "--workload-manifest",
+        required=True,
+        help="custom-resource manifest to render children for",
+    )
+    p_preview.add_argument(
+        "--collection-manifest",
+        default=None,
+        help="collection custom-resource manifest (for components)",
+    )
+    p_preview.set_defaults(func=cmd_preview)
 
     return parser
 

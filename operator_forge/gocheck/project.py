@@ -23,7 +23,8 @@ def check_project(root: str) -> list[str]:
             if not d.startswith((".", "_")) and d not in ("vendor", "testdata")
         )
         for name in sorted(filenames):
-            if not name.endswith(".go"):
+            # like Go tooling: only .go files not prefixed with '_' or '.'
+            if not name.endswith(".go") or name.startswith(("_", ".")):
                 continue
             path = os.path.join(dirpath, name)
             try:

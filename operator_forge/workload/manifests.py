@@ -42,6 +42,9 @@ class ChildResource:
     static_content: str = ""
     source_code: str = ""
     include_code: str = ""
+    # the processed ResourceMarker behind include_code, kept for consumers
+    # that evaluate the guard directly (e.g. `operator-forge preview`)
+    resource_marker: object = None
     rbac: Optional[rbac.Rules] = None
     # whether metadata.name carries a marker substitution (a !!var expression
     # or !!start/!!end fragment) and therefore has no literal name constant
@@ -105,6 +108,7 @@ class ChildResource:
         marker.process(collection)
         if marker.include_code:
             self.include_code = marker.include_code
+            self.resource_marker = marker
 
 
 def _is_dynamic_name(name: str) -> bool:

@@ -1,0 +1,363 @@
+"""Render a workload's child manifests for a given custom resource.
+
+This is the native equivalent of the generated companion CLI's
+``generate`` subcommand (reference templates/cli/cmd_generate_sub.go:49-332
+→ resources.go ``GenerateForCLI``): take a custom-resource manifest plus
+the workload config, run the same marker-processing pipeline ``create
+api`` uses, substitute the CR's spec values (and the collection CR's, for
+components) into each child resource, evaluate resource-marker
+include/exclude guards, and emit the resulting manifests.  Unlike the
+reference — which requires compiling the generated Go CLI first —
+``operator-forge preview`` works straight from the workload config.
+
+It also serves as the round-trip check of SURVEY §7.3: sample CR in,
+child manifests out, without a Kubernetes cluster or Go toolchain.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..utils.names import to_title
+from ..yamldoc import (
+    Document,
+    MapEntry,
+    Mapping,
+    Scalar,
+    Sequence,
+    STR_TAG,
+    load_documents,
+)
+from ..yamldoc.emit import emit_documents
+from ..yamldoc.model import BOOL_TAG, FLOAT_TAG, INT_TAG, to_python
+from .config import Processor, parse
+from .create_api import create_api, init_workloads
+from .fieldmarkers import (
+    COLLECTION_SPEC_PREFIX,
+    FIELD_SPEC_PREFIX,
+    FieldType,
+    source_code_variable,
+)
+from .kinds import Workload, WorkloadCollection
+
+_START_END_RE = re.compile(r"!!start\s+(.+?)\s+!!end")
+
+
+class PreviewError(Exception):
+    pass
+
+
+@dataclass
+class _VarInfo:
+    """Resolution data for one substitution variable."""
+
+    dotted_name: str
+    field_type: FieldType
+    default: Any = None
+    has_default: bool = False
+
+
+@dataclass
+class _Resolver:
+    """Resolves ``parent.Spec.X`` / ``collection.Spec.X`` variable paths
+    against CR spec dicts, falling back to marker defaults."""
+
+    parent_vars: dict[str, _VarInfo] = field(default_factory=dict)
+    collection_vars: dict[str, _VarInfo] = field(default_factory=dict)
+    parent_spec: dict = field(default_factory=dict)
+    collection_spec: Optional[dict] = None
+
+    def resolve(self, var_path: str):
+        if var_path.startswith(f"{FIELD_SPEC_PREFIX}."):
+            info = self.parent_vars.get(var_path)
+            spec = self.parent_spec
+            source = "spec"
+        elif var_path.startswith(f"{COLLECTION_SPEC_PREFIX}."):
+            info = self.collection_vars.get(var_path)
+            if self.collection_spec is None:
+                raise PreviewError(
+                    f"variable {var_path!r} needs a collection manifest "
+                    "(--collection-manifest)"
+                )
+            spec = self.collection_spec
+            source = "collection spec"
+        else:
+            raise PreviewError(f"unknown variable prefix in {var_path!r}")
+        if info is None:
+            raise PreviewError(f"no field marker defines variable {var_path!r}")
+
+        found, value = _lookup(spec, info.dotted_name)
+        # an explicit YAML null means unset, like the Kubernetes API
+        # server's null pruning on apply
+        if not found or value is None:
+            if info.has_default:
+                return info.default
+            raise PreviewError(
+                f"required field {info.dotted_name!r} missing from {source} "
+                "and has no default"
+            )
+        _check_type(info, value)
+        return value
+
+
+def _lookup(spec: dict, dotted: str):
+    node: Any = spec
+    for segment in dotted.split("."):
+        if not isinstance(node, dict) or segment not in node:
+            return False, None
+        node = node[segment]
+    return True, node
+
+
+def _check_type(info: _VarInfo, value: Any) -> None:
+    expected = {
+        FieldType.STRING: str,
+        FieldType.INT: int,
+        FieldType.BOOL: bool,
+    }.get(info.field_type)
+    if expected is None:  # struct or unknown: accept as-is
+        return
+    if expected is int and isinstance(value, bool):
+        ok = False
+    else:
+        ok = isinstance(value, expected)
+    if not ok:
+        raise PreviewError(
+            f"field {info.dotted_name!r} expects {info.field_type.value}, "
+            f"got {type(value).__name__} ({value!r})"
+        )
+
+
+def _var_infos(workload: Workload) -> tuple[dict, dict]:
+    parent: dict[str, _VarInfo] = {}
+    collection: dict[str, _VarInfo] = {}
+    for marker in workload.spec.field_markers:
+        parent[source_code_variable(FIELD_SPEC_PREFIX, marker.name)] = _VarInfo(
+            dotted_name=marker.name,
+            field_type=marker.type,
+            default=marker.default,
+            has_default=marker.default is not None,
+        )
+    for marker in workload.spec.collection_field_markers:
+        collection[
+            source_code_variable(COLLECTION_SPEC_PREFIX, marker.name)
+        ] = _VarInfo(
+            dotted_name=marker.name,
+            field_type=marker.type,
+            default=marker.default,
+            has_default=marker.default is not None,
+        )
+    return parent, collection
+
+
+def _collection_own_vars(collection: Optional[WorkloadCollection]) -> dict:
+    """Variables of the collection's own API spec, addressable as
+    ``collection.Spec.*`` from component manifests."""
+    if collection is None:
+        return {}
+    own: dict[str, _VarInfo] = {}
+    for marker in (
+        collection.spec.field_markers + collection.spec.collection_field_markers
+    ):
+        own[
+            source_code_variable(COLLECTION_SPEC_PREFIX, marker.name)
+        ] = _VarInfo(
+            dotted_name=marker.name,
+            field_type=marker.type,
+            default=marker.default,
+            has_default=marker.default is not None,
+        )
+    return own
+
+
+def _render_scalar(value: Any) -> Scalar:
+    if isinstance(value, bool):
+        return Scalar(value="true" if value else "false", tag=BOOL_TAG)
+    if isinstance(value, int):
+        return Scalar(value=str(value), tag=INT_TAG)
+    if isinstance(value, float):
+        return Scalar(value=repr(value), tag=FLOAT_TAG)
+    return Scalar(value=str(value), tag=STR_TAG)
+
+
+def _inline_str(value: Any) -> str:
+    """Render a substitution inside a larger string the way the generated
+    Go code's fmt.Sprintf("%v", ...) would."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return str(value)
+
+
+def _substitute_node(node, resolver: _Resolver):
+    if isinstance(node, Scalar):
+        if node.is_var():
+            return _render_scalar(resolver.resolve(node.value))
+        if "!!start" in node.value:
+            new = _START_END_RE.sub(
+                lambda m: _inline_str(resolver.resolve(m.group(1))), node.value
+            )
+            return Scalar(value=new, tag=node.tag, style=node.style)
+        return node
+    if isinstance(node, Mapping):
+        for entry in node.entries:
+            entry.value = _substitute_node(entry.value, resolver)
+        return node
+    if isinstance(node, Sequence):
+        for item in node.items:
+            item.node = _substitute_node(item.node, resolver)
+        return node
+    return node
+
+
+def _guard_allows(child, resolver: _Resolver) -> bool:
+    """Evaluate a resource marker's include/exclude guard the way the
+    generated Create func's IncludeCode does
+    (reference resource_marker.go:241-279)."""
+    marker = child.resource_marker
+    if marker is None:
+        return True
+    var = f"{marker.spec_prefix}.{to_title(marker.marker_name)}"
+    actual = resolver.resolve(var)
+    if marker.include:
+        return actual == marker.value
+    return actual != marker.value
+
+
+def _default_namespace(doc: Document, namespace: str) -> None:
+    """Default metadata.namespace to the parent's, matching the generated
+    create funcs for namespace-scoped parents
+    (reference templates/api/resources/definition.go:59-87)."""
+    root = doc.root
+    if not isinstance(root, Mapping) or not namespace:
+        return
+    metadata = root.get("metadata")
+    if not isinstance(metadata, Mapping):
+        return
+    existing = metadata.get("namespace")
+    if isinstance(existing, Scalar) and existing.value:
+        return
+    if existing is None:
+        metadata.entries.append(
+            MapEntry(key=Scalar(value="namespace"), value=Scalar(value=namespace))
+        )
+    else:
+        metadata.entries = [
+            e if e.key.value != "namespace"
+            else MapEntry(key=e.key, value=Scalar(value=namespace))
+            for e in metadata.entries
+        ]
+
+
+def _cr_kind_and_spec(obj: dict, path: str) -> tuple[str, dict, dict]:
+    if not isinstance(obj, dict) or not obj.get("kind"):
+        raise PreviewError(f"manifest in {path} has no 'kind'")
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise PreviewError(f"manifest in {path} has a non-mapping 'spec'")
+    metadata = obj.get("metadata") or {}
+    if not isinstance(metadata, dict):
+        metadata = {}
+    return str(obj["kind"]), spec, metadata
+
+
+def preview(
+    config_path: str,
+    workload_manifest: str,
+    collection_manifest: Optional[str] = None,
+) -> str:
+    """Render child manifests for every CR document in *workload_manifest*.
+
+    Returns a ``---``-separated YAML stream, like the generated companion
+    CLI's ``generate`` output.
+    """
+    processor: Processor = parse(config_path)
+    init_workloads(processor)
+    create_api(processor)
+
+    workloads = [p.workload for p in processor.get_processors()]
+    by_kind = {w.api_kind: w for w in workloads}
+    collection = next(
+        (w for w in workloads if isinstance(w, WorkloadCollection)), None
+    )
+
+    collection_spec: Optional[dict] = None
+    if collection_manifest is not None:
+        col_docs = _load_cr_docs(collection_manifest)
+        if not col_docs:
+            raise PreviewError(f"no documents in {collection_manifest}")
+        kind, collection_spec, _ = _cr_kind_and_spec(
+            col_docs[0], collection_manifest
+        )
+        if collection is None:
+            raise PreviewError(
+                "--collection-manifest given but the workload config has "
+                "no collection"
+            )
+        if kind != collection.api_kind:
+            raise PreviewError(
+                f"collection manifest kind {kind!r} does not match the "
+                f"collection kind {collection.api_kind!r}"
+            )
+
+    outputs: list[str] = []
+    for obj in _load_cr_docs(workload_manifest):
+        kind, spec, metadata = _cr_kind_and_spec(obj, workload_manifest)
+        workload = by_kind.get(kind)
+        if workload is None:
+            raise PreviewError(
+                f"kind {kind!r} does not match any workload in "
+                f"{config_path} (known: {sorted(by_kind)})"
+            )
+
+        parent_vars, collection_vars = _var_infos(workload)
+        collection_vars.update(_collection_own_vars(collection))
+        resolver = _Resolver(
+            parent_vars=parent_vars,
+            collection_vars=collection_vars,
+            parent_spec=spec,
+            collection_spec=(
+                spec
+                if isinstance(workload, WorkloadCollection)
+                and collection_spec is None
+                else collection_spec
+            ),
+        )
+        namespace = (
+            str(metadata.get("namespace") or "")
+            if not workload.is_cluster_scoped()
+            else ""
+        )
+
+        for manifest in workload.spec.manifests:
+            for child in manifest.child_resources:
+                if not _guard_allows(child, resolver):
+                    continue
+                docs = load_documents(child.static_content)
+                for doc in docs:
+                    if doc.root is None:
+                        continue
+                    doc.root = _substitute_node(doc.root, resolver)
+                    _default_namespace(doc, namespace)
+                    outputs.append(
+                        emit_documents([doc], explicit_start=False).strip("\n")
+                    )
+
+    if not outputs:
+        return ""
+    return "---\n" + "\n---\n".join(outputs) + "\n"
+
+
+def _load_cr_docs(path: str) -> list[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError as exc:
+        raise PreviewError(f"unable to read manifest {path}: {exc}") from exc
+    docs = []
+    for doc in load_documents(text):
+        if doc.root is None:
+            continue
+        docs.append(to_python(doc.root))
+    return docs

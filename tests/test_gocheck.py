@@ -254,6 +254,14 @@ class TestCheckProject:
         errors = check_project(str(tmp_path))
         assert len(errors) == 1 and "unreadable" in errors[0]
 
+    def test_ignores_underscore_and_dot_prefixed_files(self, tmp_path):
+        from operator_forge.gocheck import check_project
+
+        (tmp_path / "ok.go").write_text("package p\n")
+        (tmp_path / "_scratch.go").write_text("package p\ntype S[T any] int\n")
+        (tmp_path / ".#backup.go").write_text("not go at all {{{")
+        assert check_project(str(tmp_path)) == []
+
 
 @pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference checkout not mounted")
 class TestReferenceCorpus:
