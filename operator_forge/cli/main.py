@@ -185,7 +185,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create init-config update completion version" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create init-config update completion version preview vet" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api" -- "$cur"));;
         init-config)
@@ -202,7 +202,7 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create init-config update completion version)' '*: :_files'
+_arguments '1: :(init create init-config update completion version preview vet)' '*: :_files'
 """
 
 
@@ -278,9 +278,9 @@ def cmd_vet(args: argparse.Namespace) -> int:
     for err in errors:
         print(err, file=sys.stderr)
     if errors:
-        print(f"vet: {len(errors)} syntax error(s)", file=sys.stderr)
+        print(f"vet: {len(errors)} problem(s)", file=sys.stderr)
         return 1
-    print("vet: all Go files parse cleanly")
+    print("vet: all Go files check cleanly")
     return 0
 
 

@@ -18,6 +18,7 @@ Public API:
 
 from .tokens import GoTokenError, Token, tokenize
 from .parser import GoSyntaxError, check_source, parse_source
+from .lint import check_semantics
 from .project import check_project
 
 __all__ = [
@@ -27,5 +28,6 @@ __all__ = [
     "tokenize",
     "parse_source",
     "check_source",
+    "check_semantics",
     "check_project",
 ]

@@ -71,6 +71,12 @@ class _Parser:
         # Composite-literal permission for the control-clause ambiguity:
         # `if x == T{}` is illegal; braces open the block instead.
         self.allow_composite = True
+        # Semantic-pass events (see lint.py): function body token spans,
+        # local declarations, and label definitions.
+        self.func_spans: list[tuple[int, int]] = []
+        self.local_decls: list[int] = []  # token index of declared ident
+        self.labels: list[int] = []  # token index of label ident
+        self.func_depth = 0
 
     # -- token plumbing ---------------------------------------------------
 
@@ -187,11 +193,14 @@ class _Parser:
             spec()
         self.expect_semi()
 
-    def ident_list(self):
+    def ident_list(self) -> list[int]:
+        indices = [self.i]
         self.expect_ident()
         while self.at_op(","):
             self.advance()
+            indices.append(self.i)
             self.expect_ident()
+        return indices
 
     def const_spec(self):
         self.ident_list()
@@ -202,7 +211,9 @@ class _Parser:
             self.expr_list()
 
     def var_spec(self):
-        self.ident_list()
+        indices = self.ident_list()
+        if self.func_depth > 0:
+            self.local_decls.extend(indices)
         if self.at_op("="):
             self.advance()
             self.expr_list()
@@ -225,8 +236,17 @@ class _Parser:
         self.expect_ident()
         self.signature()
         if self.at_op("{"):
-            self.block()
+            self.func_body()
         self.expect_semi()
+
+    def func_body(self):
+        start = self.i
+        self.func_depth += 1
+        try:
+            self.block()
+        finally:
+            self.func_depth -= 1
+        self.func_spans.append((start, self.i))
 
     def signature(self):
         self.param_list()
@@ -476,6 +496,7 @@ class _Parser:
             return
         # Labeled statement: IDENT ':' (but not ':=')
         if t.kind == IDENT and self.peek().kind == OP and self.peek().value == ":":
+            self.labels.append(self.i)
             self.advance()
             self.advance()
             if not (self.at_op("}") or self.at_kw("case", "default") or self.tok.kind == EOF):
@@ -492,6 +513,7 @@ class _Parser:
         Returns a tag used by header parsers: 'expr', 'assign', or 'range'
         (when `in_header` and a range clause was consumed).
         """
+        lhs_start = self.i
         self.expression()
         while self.at_op(","):
             self.advance()
@@ -504,6 +526,8 @@ class _Parser:
             self.expression()
             return "assign"
         if self.tok.kind == OP and self.tok.value in _ASSIGN_OPS:
+            if self.tok.value == ":=":
+                self._record_short_decl(lhs_start, self.i)
             self.advance()
             if in_header and self.at_kw("range"):
                 self.advance()
@@ -512,6 +536,25 @@ class _Parser:
             self.expr_list()
             return "assign"
         return "expr"
+
+    def _record_short_decl(self, lhs_start: int, assign_i: int) -> None:
+        """Record the LHS idents of a ``:=`` (a valid LHS is a plain
+        comma-separated identifier list, so anything else is skipped)."""
+        if self.func_depth == 0:
+            return
+        indices = []
+        expect_ident = True
+        for j in range(lhs_start, assign_i):
+            t = self.toks[j]
+            if expect_ident and t.kind == IDENT:
+                indices.append(j)
+                expect_ident = False
+            elif not expect_ident and t.kind == OP and t.value == ",":
+                expect_ident = True
+            else:
+                return  # not a plain ident list (syntactically invalid Go)
+        if not expect_ident:
+            self.local_decls.extend(indices)
 
     def header_clause(self) -> bool:
         """Parse an if/switch clause: [SimpleStmt ;] [SimpleStmt] before '{'.
@@ -757,7 +800,7 @@ class _Parser:
                 if self.at_op("{"):
                     saved = self.allow_composite
                     self.allow_composite = True
-                    self.block()
+                    self.func_body()
                     self.allow_composite = saved
                 else:
                     self.error("function literal requires a body")
@@ -805,10 +848,16 @@ class _Parser:
             self.expression()
 
 
-def parse_source(text: str, filename: str = "<go>"):
-    """Parse a Go source file; raises GoTokenError/GoSyntaxError on failure."""
+def parse_source(text: str, filename: str = "<go>") -> _Parser:
+    """Parse a Go source file; raises GoTokenError/GoSyntaxError on failure.
+
+    Returns the parser, whose recorded ``func_spans``/``local_decls``/
+    ``labels`` feed the semantic pass (lint.py).
+    """
     toks = tokenize(text, filename)
-    _Parser(toks, filename).parse_file()
+    parser = _Parser(toks, filename)
+    parser.parse_file()
+    return parser
 
 
 def check_source(text: str, filename: str = "<go>") -> list[str]:

@@ -4,7 +4,9 @@ from __future__ import annotations
 
 import os
 
-from .parser import check_source
+from .lint import semantics_of
+from .parser import GoSyntaxError, parse_source
+from .tokens import GoTokenError
 
 
 def check_project(root: str) -> list[str]:
@@ -33,5 +35,10 @@ def check_project(root: str) -> list[str]:
             except (OSError, UnicodeDecodeError) as exc:
                 errors.append(f"{path}: unreadable: {exc}")
                 continue
-            errors.extend(check_source(text, path))
+            try:
+                parsed = parse_source(text, path)
+            except (GoSyntaxError, GoTokenError) as exc:
+                errors.append(str(exc))
+                continue
+            errors.extend(semantics_of(parsed, path))
     return errors

@@ -111,12 +111,12 @@ class TestMiscCommands:
         good.mkdir()
         (good / "main.go").write_text("package main\n\nfunc main() {}\n")
         assert cli_main(["vet", str(good)]) == 0
-        assert "parse cleanly" in capsys.readouterr().out
+        assert "check cleanly" in capsys.readouterr().out
 
         (good / "broken.go").write_text("package main\n\nfunc bad( {\n")
         assert cli_main(["vet", str(good)]) == 1
         err = capsys.readouterr().err
-        assert "broken.go" in err and "syntax error" in err
+        assert "broken.go" in err and "problem" in err
 
     def test_vet_missing_dir(self, tmp_path, capsys):
         assert cli_main(["vet", str(tmp_path / "nope")]) == 1

@@ -239,6 +239,77 @@ class TestCheckSource:
         assert len(errs) == 1 and errs[0].startswith("f.go:")
 
 
+class TestSemantics:
+    """Go's 'declared and not used' / 'label defined and not used'
+    compile errors, caught without a toolchain."""
+
+    def sem(self, body):
+        from operator_forge.gocheck import check_semantics
+        return check_semantics("package p\n" + body)
+
+    def test_unused_short_decl_flagged(self):
+        assert any("x declared" in f for f in self.sem("func f() {\n\tx := 1\n}\n"))
+
+    def test_unused_var_decl_flagged(self):
+        assert any("y declared" in f for f in self.sem("func f() {\n\tvar y int\n}\n"))
+
+    def test_unused_in_multi_assign_flagged(self):
+        out = self.sem("func f() {\n\ta, b := g()\n\t_ = b\n}\nfunc g() (int, int) { return 1, 2 }\n")
+        assert any("a declared" in f for f in out)
+        assert not any("b declared" in f for f in out)
+
+    def test_redeclaring_assign_reported_once_at_decl_site(self):
+        # `x, y := g()` re-records x; go build reports unused x once,
+        # at its first declaration
+        out = self.sem(
+            "func f() int {\n\tx := 1\n\tx, y := g()\n\treturn y\n}\n"
+            "func g() (int, int) { return 1, 2 }\n"
+        )
+        assert len(out) == 1 and ":3:" in out[0] and "x declared" in out[0]
+
+    def test_used_local_not_flagged(self):
+        assert self.sem("func f() int {\n\tx := 1\n\treturn x\n}\n") == []
+
+    def test_blank_identifier_exempt(self):
+        assert self.sem("func f() {\n\tvar _ = g()\n}\nfunc g() int { return 1 }\n") == []
+
+    def test_package_level_vars_exempt(self):
+        assert self.sem("var unused = 1\n") == []
+
+    def test_use_in_closure_counts(self):
+        assert self.sem(
+            "func f() {\n\tx := 1\n\tgo func() {\n\t\tprintln(x)\n\t}()\n}\n"
+        ) == []
+
+    def test_selector_is_not_a_use(self):
+        out = self.sem(
+            "func f(o O) {\n\tname := 1\n\to.name()\n}\ntype O struct{}\n"
+        )
+        assert any("name declared" in f for f in out)
+
+    def test_unused_label_flagged(self):
+        assert any(
+            "label L" in f
+            for f in self.sem("func f() {\nL:\n\tfor {\n\t\tbreak\n\t}\n}\n")
+        )
+
+    def test_used_label_not_flagged(self):
+        assert self.sem("func f() {\nL:\n\tfor {\n\t\tcontinue L\n\t}\n}\n") == []
+
+    def test_if_header_decl_used_in_body(self):
+        assert self.sem("func f() {\n\tif v := g(); v > 0 {\n\t}\n}\nfunc g() int { return 1 }\n") == []
+
+    def test_range_decl_unused_flagged(self):
+        out = self.sem("func f(m map[string]int) {\n\tfor k, v := range m {\n\t\t_ = k\n\t}\n}\n")
+        assert any("v declared" in f for f in out)
+
+    def test_check_project_includes_semantics(self, tmp_path):
+        from operator_forge.gocheck import check_project
+        (tmp_path / "a.go").write_text("package p\n\nfunc f() {\n\tdead := 1\n}\n")
+        errors = check_project(str(tmp_path))
+        assert any("dead declared and not used" in e for e in errors)
+
+
 class TestCheckProject:
     def test_prunes_vendor_and_reports_unreadable(self, tmp_path):
         from operator_forge.gocheck import check_project
@@ -278,3 +349,18 @@ class TestReferenceCorpus:
                     failures.extend(check_source(fh.read(), path))
         assert count > 100  # the corpus is real
         assert failures == []
+
+    def test_reference_corpus_semantically_clean(self):
+        """The reference compiles, so the conservative unused-local pass
+        must produce zero findings on it (no false positives)."""
+        from operator_forge.gocheck import check_semantics
+
+        findings = []
+        for dirpath, _, files in os.walk(REFERENCE):
+            for name in sorted(files):
+                if not name.endswith(".go"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as fh:
+                    findings.extend(check_semantics(fh.read(), path))
+        assert findings == []
