@@ -19,10 +19,114 @@ from __future__ import annotations
 from .parser import parse_source
 from .tokens import IDENT, KEYWORD, OP
 
+# Statement-leading keywords treated as (possibly) terminating.  The Go
+# spec's terminating-statement rules for if/switch/select require every
+# branch to terminate; this pass conservatively accepts them whole, so it
+# flags only bodies whose final statement clearly cannot terminate.
+_MAYBE_TERMINATING_KEYWORDS = frozenset(
+    {"return", "goto", "if", "switch", "select"}
+)
+
+
+def _for_has_no_condition(toks, for_i: int, end: int) -> bool:
+    """A `for` is terminating when its condition is absent (spec:
+    Terminating statements): `for {`, `for ; ; post {`, `for init; ; {`.
+    (Break statements inside would make it non-terminating; ignoring them
+    errs on the no-false-positive side.)"""
+    depth = 0
+    semis = []
+    j = for_i + 1
+    while j < end - 1:
+        t = toks[j]
+        if t.kind == OP and t.value in ("(", "[", "{"):
+            if t.value == "{" and depth == 0:
+                break  # the loop body
+            depth += 1
+        elif t.kind == OP and t.value in (")", "]", "}"):
+            depth -= 1
+        elif depth == 0 and t.kind == OP and t.value == ";":
+            semis.append(j)
+        elif depth == 0 and t.kind == KEYWORD and t.value == "range":
+            return False
+        j += 1
+    if j == for_i + 1:
+        return True  # for {
+    if len(semis) == 2 and semis[1] == semis[0] + 1:
+        return True  # empty condition clause
+    return False
+
+
+def _body_terminates(toks, span) -> bool:
+    """Conservatively decide whether a function body's final statement can
+    be a terminating statement (spec: Terminating statements).  Returns
+    True when unsure; a False means `go build` would say "missing return".
+    """
+    start, end = span  # toks[start] == '{'; toks[end-1] == '}'
+    # find the first token of the last top-level statement in the body;
+    # a ';' inside an if/for/switch header clause (`if x := 1; x > 0 {`)
+    # is not a statement boundary, so header mode suppresses it
+    depth = 0
+    last_start = None
+    i = start + 1
+    at_stmt_start = True
+    in_header = False
+    while i < end - 1:
+        t = toks[i]
+        if t.kind == OP and t.value in ("(", "[", "{"):
+            if t.value == "{" and depth == 0:
+                if at_stmt_start:
+                    last_start = i  # a bare block statement
+                    at_stmt_start = False
+                in_header = False
+            depth += 1
+        elif t.kind == OP and t.value in (")", "]", "}"):
+            depth -= 1
+        elif depth == 0 and t.kind == KEYWORD and t.value in (
+            "if", "for", "switch", "select",
+        ):
+            if at_stmt_start:
+                last_start = i
+                at_stmt_start = False
+            in_header = True
+        elif depth == 0 and t.kind == OP and t.value == ";":
+            if not in_header:
+                at_stmt_start = True
+        elif depth == 0 and at_stmt_start:
+            last_start = i
+            at_stmt_start = False
+        i += 1
+    if last_start is None:
+        return False  # empty body with results: missing return
+
+    # look past `label:` prefixes
+    while (
+        toks[last_start].kind == IDENT
+        and toks[last_start + 1].kind == OP
+        and toks[last_start + 1].value == ":"
+    ):
+        last_start += 2
+
+    t = toks[last_start]
+    if t.kind == KEYWORD:
+        if t.value in _MAYBE_TERMINATING_KEYWORDS:
+            return True
+        if t.value == "for":
+            return _for_has_no_condition(toks, last_start, end)
+        return False
+    if t.kind == OP and t.value == "{":
+        return True  # block: may end in a return; accept
+    if t.kind == IDENT and t.value == "panic":
+        return True
+    return False
+
 
 def check_semantics(text: str, filename: str = "<go>") -> list[str]:
     """Return "declared and not used" findings for one file."""
-    return semantics_of(parse_source(text, filename), filename)
+    try:
+        parsed = parse_source(text, filename)
+    except RecursionError:
+        return [f"{filename}: nesting too deep to parse"]
+    return semantics_of(parsed, filename)
 
 
 def semantics_of(parser, filename: str = "<go>") -> list[str]:
@@ -73,6 +177,13 @@ def semantics_of(parser, filename: str = "<go>") -> list[str]:
                 f"{filename}:{tok.line}:{tok.col}: "
                 f"{name} declared and not used"
             )
+
+    for span, has_results in zip(parser.func_spans, parser.func_results):
+        if not has_results:
+            continue
+        if not _body_terminates(toks, span):
+            tok = toks[span[1] - 1]  # the closing '}'
+            findings.append(f"{filename}:{tok.line}:{tok.col}: missing return")
 
     for l in sorted(label_indices):
         name = toks[l].value

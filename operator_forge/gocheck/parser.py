@@ -74,6 +74,7 @@ class _Parser:
         # Semantic-pass events (see lint.py): function body token spans,
         # local declarations, and label definitions.
         self.func_spans: list[tuple[int, int]] = []
+        self.func_results: list[bool] = []  # parallel: declares results?
         self.local_decls: list[int] = []  # token index of declared ident
         self.labels: list[int] = []  # token index of label ident
         self.func_depth = 0
@@ -234,12 +235,12 @@ class _Parser:
         if self.at_op("("):  # method receiver
             self.param_list()
         self.expect_ident()
-        self.signature()
+        has_results = self.signature()
         if self.at_op("{"):
-            self.func_body()
+            self.func_body(has_results)
         self.expect_semi()
 
-    def func_body(self):
+    def func_body(self, has_results: bool = False):
         start = self.i
         self.func_depth += 1
         try:
@@ -247,16 +248,21 @@ class _Parser:
         finally:
             self.func_depth -= 1
         self.func_spans.append((start, self.i))
+        self.func_results.append(has_results)
 
-    def signature(self):
+    def signature(self) -> bool:
         self.param_list()
-        self.results()
+        return self.results()
 
-    def results(self):
+    def results(self) -> bool:
         if self.at_op("("):
+            empty = self.peek().kind == OP and self.peek().value == ")"
             self.param_list()
-        elif self.type_starts() and not self.at_op("{"):
+            return not empty
+        if self.type_starts() and not self.at_op("{"):
             self.parse_type()
+            return True
+        return False
 
     def type_starts(self) -> bool:
         t = self.tok
@@ -796,11 +802,11 @@ class _Parser:
         if t.kind == KEYWORD:
             if t.value == "func":
                 self.advance()
-                self.signature()
+                has_results = self.signature()
                 if self.at_op("{"):
                     saved = self.allow_composite
                     self.allow_composite = True
-                    self.func_body()
+                    self.func_body(has_results)
                     self.allow_composite = saved
                 else:
                     self.error("function literal requires a body")
@@ -866,4 +872,8 @@ def check_source(text: str, filename: str = "<go>") -> list[str]:
         parse_source(text, filename)
     except (GoTokenError, GoSyntaxError) as exc:
         return [str(exc)]
+    except RecursionError:
+        # pathological nesting depth (go/parser has the same guard, as
+        # "max nesting depth") — report instead of crashing the walker
+        return [f"{filename}: nesting too deep to parse"]
     return []

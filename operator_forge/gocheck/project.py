@@ -40,5 +40,8 @@ def check_project(root: str) -> list[str]:
             except (GoSyntaxError, GoTokenError) as exc:
                 errors.append(str(exc))
                 continue
+            except RecursionError:
+                errors.append(f"{path}: nesting too deep to parse")
+                continue
             errors.extend(semantics_of(parsed, path))
     return errors

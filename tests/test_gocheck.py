@@ -303,6 +303,44 @@ class TestSemantics:
         out = self.sem("func f(m map[string]int) {\n\tfor k, v := range m {\n\t\t_ = k\n\t}\n}\n")
         assert any("v declared" in f for f in out)
 
+    def test_missing_return_flagged(self):
+        out = self.sem("func f() int {\n\tx := 1\n\t_ = x\n}\n")
+        assert any("missing return" in f for f in out)
+        out = self.sem("func f() int {\n}\n")
+        assert any("missing return" in f for f in out)
+        out = self.sem(
+            "func f() error {\n\tfor i := 0; i < 3; i++ {\n\t\tprintln(i)\n\t}\n}\n"
+        )
+        assert any("missing return" in f for f in out)
+
+    def test_terminating_bodies_not_flagged(self):
+        for body in [
+            "func f() int {\n\treturn 1\n}\n",
+            "func f() int {\n\tpanic(\"x\")\n}\n",
+            "func f() int {\n\tfor {\n\t}\n}\n",
+            "func f() int {\n\tif true {\n\t\treturn 1\n\t}\n\treturn 0\n}\n",
+            "func f() {\n\tprintln(1)\n}\n",  # no results: exempt
+            "func f() int {\n\tswitch {\n\tdefault:\n\t\treturn 1\n\t}\n}\n",
+            "func f() int {\nL:\n\tfor {\n\t\tbreak L\n\t}\n}\n",
+            "func f() (x int) {\n\treturn\n}\n",  # named results, bare return
+            "var g = func() int { return 2 }\n",
+            # header-clause semicolons are not statement boundaries
+            "func f() int {\n\tif x := 1; x > 0 {\n\t\treturn 1\n\t} else {\n\t\treturn 0\n\t}\n}\n",
+            "func f() int {\n\tswitch x := 1; x {\n\tdefault:\n\t\treturn x\n\t}\n}\n",
+            "func f() int {\n\tprintln(1)\n\tfor i := 0; ; i++ {\n\t\tprintln(i)\n\t}\n}\n",
+        ]:
+            assert self.sem(body) == [], body
+
+    def test_check_semantics_guards_recursion(self):
+        from operator_forge.gocheck import check_semantics
+        deep = "package p\nvar x = " + "(" * 100000 + "1" + ")" * 100000 + "\n"
+        out = check_semantics(deep)
+        assert out and "deep" in out[0]
+
+    def test_func_literal_missing_return_flagged(self):
+        out = self.sem("func f() {\n\tg := func() int {\n\t\tprintln(1)\n\t}\n\t_ = g\n}\n")
+        assert any("missing return" in f for f in out)
+
     def test_check_project_includes_semantics(self, tmp_path):
         from operator_forge.gocheck import check_project
         (tmp_path / "a.go").write_text("package p\n\nfunc f() {\n\tdead := 1\n}\n")
@@ -332,6 +370,48 @@ class TestCheckProject:
         (tmp_path / "_scratch.go").write_text("package p\ntype S[T any] int\n")
         (tmp_path / ".#backup.go").write_text("not go at all {{{")
         assert check_project(str(tmp_path)) == []
+
+
+class TestRobustness:
+    """check_source must return errors, never raise or hang, on mangled
+    input — it runs over arbitrary user project trees via `vet`."""
+
+    SEED_SRC = (
+        "package p\n\nimport \"fmt\"\n\n"
+        "func f(a int, b string) (int, error) {\n"
+        "\tif a > 0 {\n\t\treturn a, nil\n\t}\n"
+        "\tm := map[string][]int{\"k\": {1, 2}}\n"
+        "\tfor k, v := range m {\n\t\tfmt.Println(k, v, b)\n\t}\n"
+        "\treturn 0, fmt.Errorf(\"neg\")\n}\n"
+    )
+
+    def test_mutated_sources_never_raise(self):
+        import random
+
+        rng = random.Random(1234)
+        chars = list(self.SEED_SRC)
+        for _ in range(300):
+            mutated = list(chars)
+            for _ in range(rng.randint(1, 4)):
+                op = rng.randint(0, 2)
+                pos = rng.randrange(len(mutated))
+                if op == 0:
+                    mutated[pos] = rng.choice("{}()[];:=.,+-*/\"'`\n aZ0")
+                elif op == 1:
+                    del mutated[pos]
+                else:
+                    mutated.insert(pos, rng.choice("{}()[];\"`\n x"))
+            out = check_source("".join(mutated))
+            assert isinstance(out, list)
+
+    def test_truncations_never_raise(self):
+        for i in range(0, len(self.SEED_SRC), 7):
+            assert isinstance(check_source(self.SEED_SRC[:i]), list)
+
+    def test_pathological_nesting_reports_instead_of_crashing(self):
+        deep = "package p\nvar x = " + "(" * 100000 + "1" + ")" * 100000 + "\n"
+        out = check_source(deep)
+        assert out and "deep" in out[0]
 
 
 @pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference checkout not mounted")
