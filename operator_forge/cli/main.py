@@ -20,7 +20,7 @@ import argparse
 import os
 import sys
 
-import yaml as pyyaml
+from operator_forge.utils import yamlcompat as pyyaml
 
 from .. import __version__
 from .. import licensing

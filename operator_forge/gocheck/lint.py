@@ -56,55 +56,28 @@ def _for_has_no_condition(toks, for_i: int, end: int) -> bool:
     return False
 
 
-def _body_terminates(toks, span) -> bool:
+def _body_terminates(toks, span, last_start) -> bool:
     """Conservatively decide whether a function body's final statement can
     be a terminating statement (spec: Terminating statements).  Returns
     True when unsure; a False means `go build` would say "missing return".
+
+    *last_start* is the parser-recorded first token index of the body's
+    last top-level statement (None when the body is empty).
     """
-    start, end = span  # toks[start] == '{'; toks[end-1] == '}'
-    # find the first token of the last top-level statement in the body;
-    # a ';' inside an if/for/switch header clause (`if x := 1; x > 0 {`)
-    # is not a statement boundary, so header mode suppresses it
-    depth = 0
-    last_start = None
-    i = start + 1
-    at_stmt_start = True
-    in_header = False
-    while i < end - 1:
-        t = toks[i]
-        if t.kind == OP and t.value in ("(", "[", "{"):
-            if t.value == "{" and depth == 0:
-                if at_stmt_start:
-                    last_start = i  # a bare block statement
-                    at_stmt_start = False
-                in_header = False
-            depth += 1
-        elif t.kind == OP and t.value in (")", "]", "}"):
-            depth -= 1
-        elif depth == 0 and t.kind == KEYWORD and t.value in (
-            "if", "for", "switch", "select",
-        ):
-            if at_stmt_start:
-                last_start = i
-                at_stmt_start = False
-            in_header = True
-        elif depth == 0 and t.kind == OP and t.value == ";":
-            if not in_header:
-                at_stmt_start = True
-        elif depth == 0 and at_stmt_start:
-            last_start = i
-            at_stmt_start = False
-        i += 1
+    start, end = span
     if last_start is None:
         return False  # empty body with results: missing return
 
-    # look past `label:` prefixes
+    # look past `label:` prefixes (the parser records the inner statement,
+    # but a trailing bare `L:` before '}' records the label itself)
     while (
         toks[last_start].kind == IDENT
         and toks[last_start + 1].kind == OP
         and toks[last_start + 1].value == ":"
     ):
         last_start += 2
+    if last_start >= end - 1:
+        return False  # body ends on a bare label
 
     t = toks[last_start]
     if t.kind == KEYWORD:
@@ -178,10 +151,12 @@ def semantics_of(parser, filename: str = "<go>") -> list[str]:
                 f"{name} declared and not used"
             )
 
-    for span, has_results in zip(parser.func_spans, parser.func_results):
+    for span, has_results, last_stmt in zip(
+        parser.func_spans, parser.func_results, parser.func_last_stmts
+    ):
         if not has_results:
             continue
-        if not _body_terminates(toks, span):
+        if not _body_terminates(toks, span, last_stmt):
             tok = toks[span[1] - 1]  # the closing '}'
             findings.append(f"{filename}:{tok.line}:{tok.col}: missing return")
 

@@ -75,9 +75,13 @@ class _Parser:
         # local declarations, and label definitions.
         self.func_spans: list[tuple[int, int]] = []
         self.func_results: list[bool] = []  # parallel: declares results?
+        self.func_last_stmts: list[int | None] = []  # parallel: last
+        # top-level statement's first token index (None for empty bodies)
         self.local_decls: list[int] = []  # token index of declared ident
         self.labels: list[int] = []  # token index of label ident
         self.func_depth = 0
+        self.block_depth = 0
+        self._func_stack: list[dict] = []
 
     # -- token plumbing ---------------------------------------------------
 
@@ -243,12 +247,17 @@ class _Parser:
     def func_body(self, has_results: bool = False):
         start = self.i
         self.func_depth += 1
+        self._func_stack.append(
+            {"entry_depth": self.block_depth, "last_stmt": None}
+        )
         try:
             self.block()
         finally:
+            ctx = self._func_stack.pop()
             self.func_depth -= 1
         self.func_spans.append((start, self.i))
         self.func_results.append(has_results)
+        self.func_last_stmts.append(ctx["last_stmt"])
 
     def signature(self) -> bool:
         self.param_list()
@@ -442,7 +451,11 @@ class _Parser:
 
     def block(self):
         self.expect_op("{")
-        self.stmt_list()
+        self.block_depth += 1
+        try:
+            self.stmt_list()
+        finally:
+            self.block_depth -= 1
         self.expect_op("}")
 
     def stmt_list(self):
@@ -452,6 +465,15 @@ class _Parser:
             self.skip_semis()
 
     def statement(self):
+        # Record the last statement directly inside the current function's
+        # body block (block_depth == entry_depth + 1) for the
+        # missing-return analysis; labeled statements recurse, so the
+        # recorded index lands on the statement proper.
+        if (
+            self._func_stack
+            and self.block_depth == self._func_stack[-1]["entry_depth"] + 1
+        ):
+            self._func_stack[-1]["last_stmt"] = self.i
         t = self.tok
         if t.kind == KEYWORD:
             v = t.value
@@ -632,18 +654,22 @@ class _Parser:
         self.expect_kw("switch")
         self.header_clause()
         self.expect_op("{")
-        self.skip_semis()
-        while self.at_kw("case", "default"):
-            if self.advance().value == "case":
-                # expression list or (type switch) type list; types parse
-                # as expressions syntactically except literals like
-                # chan/map/func/struct/interface/*T/[]T — accept either.
-                self.case_item()
-                while self.at_op(","):
-                    self.advance()
+        self.block_depth += 1  # case bodies are nested statements
+        try:
+            self.skip_semis()
+            while self.at_kw("case", "default"):
+                if self.advance().value == "case":
+                    # expression list or (type switch) type list; types
+                    # parse as expressions syntactically except literals
+                    # like chan/map/func/struct/interface/*T/[]T.
                     self.case_item()
-            self.expect_op(":")
-            self.stmt_list()
+                    while self.at_op(","):
+                        self.advance()
+                        self.case_item()
+                self.expect_op(":")
+                self.stmt_list()
+        finally:
+            self.block_depth -= 1
         self.expect_op("}")
         self.expect_semi()
 
@@ -666,12 +692,16 @@ class _Parser:
     def select_stmt(self):
         self.expect_kw("select")
         self.expect_op("{")
-        self.skip_semis()
-        while self.at_kw("case", "default"):
-            if self.advance().value == "case":
-                self.simple_stmt()
-            self.expect_op(":")
-            self.stmt_list()
+        self.block_depth += 1  # comm-clause bodies are nested statements
+        try:
+            self.skip_semis()
+            while self.at_kw("case", "default"):
+                if self.advance().value == "case":
+                    self.simple_stmt()
+                self.expect_op(":")
+                self.stmt_list()
+        finally:
+            self.block_depth -= 1
         self.expect_op("}")
         self.expect_semi()
 

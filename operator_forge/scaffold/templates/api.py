@@ -508,7 +508,7 @@ def _resource_condition_schema() -> dict:
 
 def _yaml_dump(data, indent: int = 0) -> str:
     """Small deterministic YAML renderer for CRD documents."""
-    import yaml as pyyaml
+    from operator_forge.utils import yamlcompat as pyyaml
 
     return pyyaml.safe_dump(data, sort_keys=False, default_flow_style=False)
 
@@ -525,7 +525,7 @@ def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
     import os
     import sys
 
-    import yaml as pyyaml
+    from operator_forge.utils import yamlcompat as pyyaml
 
     if not output_dir:
         return crd

@@ -292,7 +292,7 @@ def manager_cluster_role(views: list[WorkloadView]) -> FileSpec:
     """config/rbac/role.yaml aggregated from every workload's inferred rules
     (the reference defers this to controller-gen reading the
     ``+kubebuilder:rbac`` markers; operator-forge emits it directly)."""
-    import yaml as pyyaml
+    from operator_forge.utils import yamlcompat as pyyaml
 
     rule_map: dict = {}
     order: list = []

@@ -1,0 +1,29 @@
+"""libyaml-backed safe_load/safe_dump with pure-Python fallback.
+
+PyYAML's pure-Python emitter dominates generation profiles (a third of
+`create api` wall time goes to serializing CRD YAML); the C variants cut
+that roughly 5x.  Mirrors yamldoc/load.py, which already prefers the C
+parser for manifest loading.
+"""
+
+from __future__ import annotations
+
+import yaml as _yaml
+
+_SAFE_LOADER = getattr(_yaml, "CSafeLoader", _yaml.SafeLoader)
+_SAFE_DUMPER = getattr(_yaml, "CSafeDumper", _yaml.SafeDumper)
+
+# error type passthrough so callers can except pyyaml.YAMLError
+YAMLError = _yaml.YAMLError
+
+
+def safe_load(stream):
+    return _yaml.load(stream, Loader=_SAFE_LOADER)
+
+
+def safe_load_all(stream):
+    return _yaml.load_all(stream, Loader=_SAFE_LOADER)
+
+
+def safe_dump(data, stream=None, **kwargs):
+    return _yaml.dump(data, stream, Dumper=_SAFE_DUMPER, **kwargs)

@@ -8,7 +8,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field as dc_field
 
-import yaml as pyyaml
+from operator_forge.utils import yamlcompat as pyyaml
 
 from ..utils.globber import glob_files
 from .kinds import (

@@ -151,9 +151,10 @@ def load_documents(text: str) -> list[Document]:
 
     # libyaml's C parser emits the same events/marks ~10x faster; the
     # composer (and all mark/style handling) stays in Python either way
-    loader = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+    from ..utils.yamlcompat import _SAFE_LOADER
+
     try:
-        raw_nodes = list(yaml.compose_all(text, Loader=loader))
+        raw_nodes = list(yaml.compose_all(text, Loader=_SAFE_LOADER))
     except yaml.YAMLError as exc:
         raise YamlDocError(f"error parsing yaml: {exc}") from exc
 

@@ -326,6 +326,12 @@ class TestSemantics:
             "var g = func() int { return 2 }\n",
             # header-clause semicolons are not statement boundaries
             "func f() int {\n\tif x := 1; x > 0 {\n\t\treturn 1\n\t} else {\n\t\treturn 0\n\t}\n}\n",
+            # ...even with func literals inside the header clause
+            "func f() int {\n\tif g := func() int { return 1 }; true {\n\t\treturn g()\n\t} else {\n\t\treturn 0\n\t}\n}\n",
+            "func f() int {\n\tswitch g := func() int { return 1 }(); g {\n\tdefault:\n\t\treturn g\n\t}\n}\n",
+            # a switch whose last case ends non-terminating is accepted
+            # whole (conservative), not classified by its case bodies
+            "func f() int {\n\tif true {\n\t\treturn 1\n\t}\n\tswitch {\n\tdefault:\n\t\treturn 2\n\t}\n}\n",
             "func f() int {\n\tswitch x := 1; x {\n\tdefault:\n\t\treturn x\n\t}\n}\n",
             "func f() int {\n\tprintln(1)\n\tfor i := 0; ; i++ {\n\t\tprintln(i)\n\t}\n}\n",
         ]:
