@@ -185,7 +185,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create init-config update completion version preview vet" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create init-config update completion version preview validate vet" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api" -- "$cur"));;
         init-config)
@@ -202,7 +202,7 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create init-config update completion version preview vet)' '*: :_files'
+_arguments '1: :(init create init-config update completion version preview validate vet)' '*: :_files'
 """
 
 
@@ -259,6 +259,47 @@ def cmd_preview(args: argparse.Namespace) -> int:
         return 0
     sys.stdout.write(rendered)
     return 0
+
+
+def cmd_validate(args: argparse.Namespace) -> int:
+    """Validate CR manifests against a generated project's CRD schemas
+    (types, unknown properties, required fields) without a cluster."""
+    from operator_forge.workload.crdschema import (
+        ValidationError,
+        load_project_crds,
+        validate_cr,
+    )
+
+    try:
+        with open(args.manifest, encoding="utf-8") as fh:
+            docs = [
+                d for d in pyyaml.safe_load_all(fh.read()) if d is not None
+            ]
+    except (OSError, pyyaml.YAMLError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if not docs:
+        print(f"error: no documents in {args.manifest}", file=sys.stderr)
+        return 1
+    try:
+        crds = load_project_crds(args.project)
+    except ValidationError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    failures = 0
+    for doc in docs:
+        if isinstance(doc, dict):
+            label = f"{doc.get('apiVersion')}/{doc.get('kind')}"
+        else:
+            label = f"document ({type(doc).__name__})"
+        errors = validate_cr(args.project, doc, crds=crds)
+        if errors:
+            failures += 1
+            for err in errors:
+                print(f"{label}: {err}", file=sys.stderr)
+        else:
+            print(f"{label}: valid")
+    return 1 if failures else 0
 
 
 def cmd_vet(args: argparse.Namespace) -> int:
@@ -378,6 +419,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="collection custom-resource manifest (for components)",
     )
     p_preview.set_defaults(func=cmd_preview)
+
+    p_validate = sub.add_parser(
+        "validate",
+        help="validate CR manifests against the generated CRD schemas",
+    )
+    p_validate.add_argument(
+        "--project",
+        required=True,
+        help="root of the generated project (reads config/crd/bases)",
+    )
+    p_validate.add_argument(
+        "--manifest", required=True, help="CR manifest(s) to validate"
+    )
+    p_validate.set_defaults(func=cmd_validate)
 
     return parser
 

@@ -458,7 +458,19 @@ def _schema_for(field: APIFields) -> dict:
         props = {
             child.manifest_name: _schema_for(child) for child in field.children
         }
-        return {"type": "object", "properties": props}
+        schema: dict = {"type": "object", "properties": props}
+        # controller-gen semantics on the generated types: every field
+        # carries `omitempty` (reference api.go:294) so nothing is
+        # required unless explicitly marked +kubebuilder:validation:Required
+        # (only the injected collection-ref name is, workload.go:150-212)
+        required = [
+            child.manifest_name
+            for child in field.children
+            if any("validation:Required" in m for m in child.markers)
+        ]
+        if required:
+            schema["required"] = required
+        return schema
     type_map = {
         FieldType.STRING: "string",
         FieldType.INT: "integer",

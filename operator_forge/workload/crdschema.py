@@ -1,0 +1,122 @@
+"""Structural-schema validation of custom resources against generated CRDs.
+
+The reference ecosystem leaves this to the API server at apply time (or
+the compiled companion CLI's workload.Validate, which checks much less);
+`operator-forge validate` checks a CR manifest against the generated
+CRD's openAPIV3Schema without a cluster: types, unknown properties, and
+required fields.  The same validator backs the test-suite consistency
+check that every generated sample satisfies its own CRD schema.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from ..utils import yamlcompat as pyyaml
+
+
+class ValidationError(Exception):
+    pass
+
+
+def validate_instance(instance: Any, schema: dict, path: str = "$") -> list[str]:
+    """Validate a decoded object against an openAPI v3 structural schema.
+
+    Covers the subset generated CRDs use: type (object/array/integer/
+    boolean/string/number), properties + unknown-field rejection (unless
+    x-kubernetes-preserve-unknown-fields), items, and required.
+    """
+    errors: list[str] = []
+    stype = schema.get("type")
+    if stype == "object":
+        if not isinstance(instance, dict):
+            return [f"{path}: expected object, got {type(instance).__name__}"]
+        props = schema.get("properties")
+        for key in schema.get("required", []):
+            if key not in instance or instance.get(key) is None:
+                errors.append(f"{path}.{key}: required property missing")
+        if props is None:
+            return errors  # schema-less object (e.g. metadata): accept all
+        for key, value in instance.items():
+            if key in props:
+                errors.extend(validate_instance(value, props[key], f"{path}.{key}"))
+            elif not schema.get("x-kubernetes-preserve-unknown-fields"):
+                errors.append(f"{path}.{key}: unknown property")
+    elif stype == "array":
+        if not isinstance(instance, list):
+            return [f"{path}: expected array, got {type(instance).__name__}"]
+        for i, item in enumerate(instance):
+            errors.extend(
+                validate_instance(item, schema.get("items", {}), f"{path}[{i}]")
+            )
+    elif stype == "integer":
+        if not isinstance(instance, int) or isinstance(instance, bool):
+            errors.append(f"{path}: expected integer, got {instance!r}")
+    elif stype == "number":
+        if isinstance(instance, bool) or not isinstance(instance, (int, float)):
+            errors.append(f"{path}: expected number, got {instance!r}")
+    elif stype == "boolean":
+        if not isinstance(instance, bool):
+            errors.append(f"{path}: expected boolean, got {instance!r}")
+    elif stype == "string":
+        if not isinstance(instance, str):
+            errors.append(f"{path}: expected string, got {instance!r}")
+    return errors
+
+
+def load_project_crds(project_dir: str) -> list[dict]:
+    """Read every CRD under config/crd/bases of a generated project."""
+    base = os.path.join(project_dir, "config", "crd", "bases")
+    if not os.path.isdir(base):
+        raise ValidationError(
+            f"no CRDs found under {base}; run `operator-forge create api` first"
+        )
+    crds = []
+    for name in sorted(os.listdir(base)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(base, name), encoding="utf-8") as fh:
+            for doc in pyyaml.safe_load_all(fh.read()):
+                if isinstance(doc, dict) and doc.get("kind") == "CustomResourceDefinition":
+                    crds.append(doc)
+    return crds
+
+
+def _version_schema(crd: dict, version: str) -> dict | None:
+    for v in crd.get("spec", {}).get("versions", []):
+        if v.get("name") == version:
+            return v.get("schema", {}).get("openAPIV3Schema", {})
+    return None
+
+
+def validate_cr(project_dir: str, cr: Any, crds: list[dict] | None = None) -> list[str]:
+    """Validate one decoded CR against the project's generated CRDs.
+
+    Pass *crds* (from :func:`load_project_crds`) to validate many
+    documents without re-reading the CRD files per document.
+    """
+    if not isinstance(cr, dict):
+        return [f"manifest document must be a mapping, got {type(cr).__name__}"]
+    kind = cr.get("kind")
+    api_version = str(cr.get("apiVersion", ""))
+    if not kind or "/" not in api_version:
+        return ["manifest needs kind and group/version apiVersion"]
+    group, version = api_version.rsplit("/", 1)
+    if crds is None:
+        crds = load_project_crds(project_dir)
+    for crd in crds:
+        spec = crd.get("spec", {})
+        if spec.get("names", {}).get("kind") != kind:
+            continue
+        if spec.get("group") != group:
+            continue
+        schema = _version_schema(crd, version)
+        if schema is None:
+            served = [v.get("name") for v in spec.get("versions", [])]
+            return [
+                f"version {version!r} not served by CRD "
+                f"{crd['metadata']['name']} (has: {served})"
+            ]
+        return validate_instance(cr, schema)
+    return [f"no generated CRD matches {api_version} {kind}"]

@@ -116,6 +116,7 @@ class WorkloadSpec:
         collection_field = APIFields(
             name="Collection",
             type=FieldType.STRUCT,
+            manifest_name="collection",
             tags='`json:"collection"`',
             sample="#collection:",
             struct_name="CollectionSpec",
@@ -131,6 +132,7 @@ class WorkloadSpec:
                 APIFields(
                     name="Name",
                     type=FieldType.STRING,
+                    manifest_name="name",
                     tags='`json:"name"`',
                     sample=f'#name: "{self.collection.api_kind.lower()}-sample"',
                     markers=[
@@ -142,6 +144,7 @@ class WorkloadSpec:
                 APIFields(
                     name="Namespace",
                     type=FieldType.STRING,
+                    manifest_name="namespace",
                     tags='`json:"namespace"`',
                     sample=f'#namespace: "{sample_namespace}"',
                     markers=[

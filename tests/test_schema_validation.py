@@ -14,36 +14,6 @@ from operator_forge.yamldoc import emit_documents
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 
-def _validate(instance, schema, path="$"):
-    """Minimal openAPI v3 structural validator (type/properties/default)."""
-    errors = []
-    stype = schema.get("type")
-    if stype == "object":
-        if not isinstance(instance, dict):
-            return [f"{path}: expected object, got {type(instance).__name__}"]
-        props = schema.get("properties", {})
-        for key, value in instance.items():
-            if key in props:
-                errors.extend(_validate(value, props[key], f"{path}.{key}"))
-            elif not schema.get("x-kubernetes-preserve-unknown-fields"):
-                errors.append(f"{path}.{key}: unknown property")
-    elif stype == "array":
-        if not isinstance(instance, list):
-            return [f"{path}: expected array"]
-        for i, item in enumerate(instance):
-            errors.extend(_validate(item, schema.get("items", {}), f"{path}[{i}]"))
-    elif stype == "integer":
-        if not isinstance(instance, int) or isinstance(instance, bool):
-            errors.append(f"{path}: expected integer, got {instance!r}")
-    elif stype == "boolean":
-        if not isinstance(instance, bool):
-            errors.append(f"{path}: expected boolean, got {instance!r}")
-    elif stype == "string":
-        if not isinstance(instance, str):
-            errors.append(f"{path}: expected string, got {instance!r}")
-    return errors
-
-
 def _generate(tmp_path, fixture, repo):
     config = os.path.join(FIXTURES, fixture, "workload.yaml")
     out = str(tmp_path / "project")
@@ -64,31 +34,134 @@ def _generate(tmp_path, fixture, repo):
     ],
 )
 def test_samples_validate_against_crds(tmp_path, fixture, repo):
-    project = _generate(tmp_path, fixture, repo)
-    crd_dir = os.path.join(project, "config", "crd", "bases")
-    samples_dir = os.path.join(project, "config", "samples")
+    """Every generated sample (full and required-only) must satisfy its
+    own generated CRD schema — via the framework validator that also
+    backs `operator-forge validate`."""
+    from operator_forge.workload.crdschema import validate_cr
 
-    schemas = {}
-    for name in os.listdir(crd_dir):
-        crd = pyyaml.safe_load(open(os.path.join(crd_dir, name)))
-        kind = crd["spec"]["names"]["kind"]
-        for version in crd["spec"]["versions"]:
-            schemas[(kind, version["name"])] = version["schema"][
-                "openAPIV3Schema"
-            ]["properties"]["spec"]
+    project = _generate(tmp_path, fixture, repo)
+    samples_dir = os.path.join(project, "config", "samples")
 
     checked = 0
     for name in os.listdir(samples_dir):
         if name == "kustomization.yaml":
             continue
         sample = pyyaml.safe_load(open(os.path.join(samples_dir, name)))
-        kind = sample["kind"]
-        version = sample["apiVersion"].rsplit("/", 1)[-1]
-        schema = schemas[(kind, version)]
-        errors = _validate(sample.get("spec", {}), schema)
+        errors = validate_cr(project, sample)
         assert not errors, f"{name}: " + "; ".join(errors)
         checked += 1
     assert checked > 0
+
+
+def test_component_crd_collection_ref_schema(tmp_path):
+    """The injected collection reference must appear in the CRD schema
+    under its JSON names, optional at the spec level, with name required
+    within (regression: empty-named properties)."""
+    project = _generate(
+        tmp_path, "collection", "github.com/acme/platform-operator"
+    )
+    crd_dir = os.path.join(project, "config", "crd", "bases")
+    cache_crd = next(
+        pyyaml.safe_load(open(os.path.join(crd_dir, f)))
+        for f in os.listdir(crd_dir)
+        if "cache" in f
+    )
+    spec = cache_crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"
+    ]["spec"]
+    assert "" not in spec["properties"]
+    col = spec["properties"]["collection"]
+    assert set(col["properties"]) == {"name", "namespace"}
+    assert col["required"] == ["name"]
+    assert "collection" not in spec.get("required", [])
+
+
+class TestValidateCommand:
+    def test_valid_and_invalid_crs(self, tmp_path, capsys):
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        sample = os.path.join(
+            project, "config", "samples", "shop_v1alpha1_bookstore.yaml"
+        )
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", sample]
+        ) == 0
+        assert "valid" in capsys.readouterr().out
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(
+            "apiVersion: shop.example.io/v1alpha1\n"
+            "kind: BookStore\n"
+            "metadata:\n  name: x\n"
+            "spec:\n"
+            "  nosuchfield: true\n"
+            "  service:\n    port: \"not-int\"\n"
+        )
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", str(bad)]
+        ) == 1
+        err = capsys.readouterr().err
+        assert "unknown property" in err and "expected integer" in err
+
+    def test_omitted_optional_fields_accepted(self, tmp_path, capsys):
+        """controller-gen semantics: every generated field carries
+        omitempty, so an empty spec is schema-valid (defaults and the
+        operator handle the rest) — mirror of reference api.go:294."""
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        cr = tmp_path / "cr.yaml"
+        cr.write_text(
+            "apiVersion: shop.example.io/v1alpha1\n"
+            "kind: BookStore\n"
+            "metadata:\n  name: x\n"
+            "spec: {}\n"
+        )
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", str(cr)]
+        ) == 0
+
+    def test_missing_required_field_reported(self, tmp_path, capsys):
+        """The injected collection-ref name carries an explicit
+        +kubebuilder:validation:Required marker, so a present-but-empty
+        collection block must fail."""
+        project = _generate(
+            tmp_path, "collection", "github.com/acme/platform-operator"
+        )
+        cr = tmp_path / "cr.yaml"
+        cr.write_text(
+            "apiVersion: platform.example.io/v1alpha1\n"
+            "kind: Cache\n"
+            "metadata:\n  name: c\n"
+            "spec:\n  collection: {}\n"
+        )
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", str(cr)]
+        ) == 1
+        assert "name: required property missing" in capsys.readouterr().err
+
+    def test_non_mapping_document_reported(self, tmp_path, capsys):
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        cr = tmp_path / "cr.yaml"
+        cr.write_text("- a\n- b\n")
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", str(cr)]
+        ) == 1
+        assert "must be a mapping" in capsys.readouterr().err
+
+    def test_unknown_gvk_reported(self, tmp_path, capsys):
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        cr = tmp_path / "cr.yaml"
+        cr.write_text("apiVersion: other.io/v1\nkind: Widget\nspec: {}\n")
+        assert cli_main(
+            ["validate", "--project", project, "--manifest", str(cr)]
+        ) == 1
+        assert "no generated CRD matches" in capsys.readouterr().err
 
 
 class TestSequenceItemMarker:
