@@ -1,0 +1,173 @@
+"""Property-based pipeline fuzz: any valid workload config + marker-
+annotated manifests must generate a project that parses as Go (gocheck),
+passes the structural lint, and whose samples validate against its CRDs.
+
+Complements test_fuzz_roundtrip.py (yamldoc-level) by fuzzing the whole
+generator: random field names, types, defaults, nesting, replace=
+substitutions, resource-marker guards, and multi-resource manifests.
+"""
+
+import os
+import random
+import sys
+
+import pytest
+import yaml as pyyaml
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import check_project
+from operator_forge.workload.crdschema import validate_cr
+from operator_forge.workload.preview import preview
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+WORDS = [
+    "alpha", "bravo", "cache", "delta", "edge", "flux", "gamma", "host",
+    "index", "jolt", "kilo", "lima", "mango", "nexus", "oxide", "pulse",
+]
+
+
+def rand_name(rng):
+    segs = rng.randint(1, 3)
+    return ".".join(rng.choice(WORDS) + str(rng.randint(0, 99)) for _ in range(segs))
+
+
+def rand_field(rng, used):
+    while True:
+        name = rand_name(rng)
+        # avoid conflicting leaf/struct reuse across markers
+        if all(not (n == name or n.startswith(name + ".") or name.startswith(n + "."))
+               for n in used):
+            used.add(name)
+            return name
+
+
+def build_standalone(rng, tmp_path, idx):
+    used = set()
+    fields = []
+    for _ in range(rng.randint(2, 6)):
+        name = rand_field(rng, used)
+        ftype, value = rng.choice(
+            [
+                ("string", f"v{rng.randint(0, 999)}"),
+                ("int", rng.randint(0, 9999)),
+                ("bool", rng.choice([True, False])),
+            ]
+        )
+        has_default = rng.random() < 0.6
+        fields.append((name, ftype, value, has_default))
+
+    lines = [
+        "apiVersion: v1",
+        "kind: ConfigMap",
+        "metadata:",
+        f"  name: fuzz-cm-{idx}",
+        "data:",
+    ]
+    for i, (name, ftype, value, has_default) in enumerate(fields):
+        rendered = (
+            f'"{value}"' if ftype == "string"
+            else str(value).lower() if ftype == "bool" else value
+        )
+        marker = f"+operator-builder:field:name={name},type={ftype}"
+        if has_default:
+            marker += f",default={rendered}"
+        lines.append(f"  key{i}: {rendered}  # {marker}")
+
+    # a second resource with an include guard tied to the first bool field
+    guard = next(
+        ((n, v) for (n, t, v, d) in fields if t == "bool" and d), None
+    )
+    if guard is not None:
+        lines += [
+            "---",
+            f"# +operator-builder:resource:field={guard[0]},"
+            f"value={str(guard[1]).lower()},include",
+            "apiVersion: v1",
+            "kind: Secret",
+            "metadata:",
+            f"  name: fuzz-secret-{idx}",
+            "type: Opaque",
+        ]
+
+    manifest = tmp_path / f"resources-{idx}.yaml"
+    manifest.write_text("\n".join(lines) + "\n")
+
+    config = tmp_path / f"workload-{idx}.yaml"
+    config.write_text(
+        pyyaml.safe_dump(
+            {
+                "name": f"fuzz-{idx}",
+                "kind": "StandaloneWorkload",
+                "spec": {
+                    "api": {
+                        "domain": "fuzz.io",
+                        "group": f"grp{idx}",
+                        "version": "v1alpha1",
+                        "kind": f"FuzzApp{idx}",
+                        "clusterScoped": False,
+                    },
+                    "resources": [os.path.basename(str(manifest))],
+                },
+            },
+            sort_keys=False,
+        )
+    )
+    return str(config), guard
+
+
+@pytest.mark.parametrize("seed", [7, 21, 99, 1234, 4242])
+def test_random_standalone_generates_valid_project(tmp_path, seed):
+    rng = random.Random(seed)
+    config, guard = build_standalone(rng, tmp_path, seed)
+    out = str(tmp_path / "project")
+    assert cli_main(
+        ["init", "--workload-config", config,
+         "--repo", f"example.com/fuzz{seed}", "--output-dir", out]
+    ) == 0
+    assert cli_main(
+        ["create", "api", "--workload-config", config, "--output-dir", out]
+    ) == 0
+
+    errors = check_project(out)
+    assert not errors, "\n".join(errors)
+
+    from golint import lint_project
+    problems = lint_project(out)
+    assert not problems, "\n".join(problems)
+
+    # every sample must satisfy the generated CRD schema…
+    samples_dir = os.path.join(out, "config", "samples")
+    samples = [
+        os.path.join(samples_dir, f)
+        for f in os.listdir(samples_dir)
+        if f != "kustomization.yaml"
+    ]
+    assert samples
+    for path in samples:
+        sample = pyyaml.safe_load(open(path))
+        errs = validate_cr(out, sample)
+        assert not errs, f"{path}: {errs}"
+
+    # …and the full sample must preview back into child manifests
+    # (config/samples holds exactly the one full sample per kind)
+    rendered = preview(config, samples[0])
+    docs = [d for d in pyyaml.safe_load_all(rendered) if d]
+    assert any(d.get("kind") == "ConfigMap" for d in docs)
+
+    # the include guard matches the sample's default value, so the
+    # guarded Secret must render with it — and must disappear when the
+    # CR flips the guard field
+    if guard is not None:
+        assert any(d.get("kind") == "Secret" for d in docs)
+        cr = pyyaml.safe_load(open(samples[0]))
+        node = cr["spec"]
+        *parents, leaf = guard[0].split(".")
+        for part in parents:
+            node = node[part]
+        node[leaf] = not guard[1]
+        flipped = tmp_path / "flipped.yaml"
+        flipped.write_text(pyyaml.safe_dump(cr))
+        rendered_off = preview(config, str(flipped))
+        docs_off = [d for d in pyyaml.safe_load_all(rendered_off) if d]
+        assert not any(d.get("kind") == "Secret" for d in docs_off)
