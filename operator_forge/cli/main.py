@@ -138,7 +138,24 @@ def cmd_create_api(args: argparse.Namespace) -> int:
         with_resources=args.resource,
         with_controllers=args.controller,
         enable_conversion=config.enable_conversion,
+        dry_run=args.dry_run,
     )
+
+    if args.dry_run:
+        if newly_enabled:
+            # the real run records the conversion opt-in in PROJECT
+            scaffold.changes.append(("overwrite", "PROJECT"))
+        counts: dict[str, int] = {}
+        for action, path in scaffold.changes:
+            counts[action] = counts.get(action, 0) + 1
+            print(f"{action:9s} {path}")
+        summary = ", ".join(
+            f"{counts[a]} {a}"
+            for a in ("create", "overwrite", "fragment", "unchanged", "preserve")
+            if a in counts
+        )
+        print(f"dry run: {summary or 'no changes'}; nothing written")
+        return 0
 
     # persist the opt-in only after a successful scaffold: recording it
     # first would make every later plain `create api` re-enter a failing
@@ -361,6 +378,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--resource", nargs="?", const="true", default="true", type=_parse_bool
     )
     p_api.add_argument("--force", action="store_true")
+    p_api.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report what would be created/overwritten/preserved "
+        "without writing anything",
+    )
     p_api.add_argument(
         "--enable-conversion", action="store_true",
         help="scaffold conversion-webhook wiring (hub/spoke stubs, webhook "

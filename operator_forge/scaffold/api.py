@@ -148,9 +148,12 @@ def scaffold_api(
     with_resources: bool = True,
     with_controllers: bool = True,
     enable_conversion: bool = False,
+    dry_run: bool = False,
 ) -> Scaffold:
     views = views_for(processor.get_workloads(), config)
-    scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
+    scaffold = Scaffold(
+        output_dir=output_dir, boilerplate=boilerplate_text, dry_run=dry_run
+    )
     fragments = main_go_fragments(views, with_resources, with_controllers)
     if with_resources:
         for view in views:
@@ -177,5 +180,11 @@ def scaffold_api(
 
     scaffold.execute(specs, fragments)
     if multi_version:
-        webhook_tpl.update_default_kustomization(output_dir)
+        changed = webhook_tpl.update_default_kustomization(
+            output_dir, dry_run=dry_run
+        )
+        if dry_run and changed:
+            scaffold.changes.append(
+                ("fragment", "config/default/kustomization.yaml")
+            )
     return scaffold

@@ -68,6 +68,11 @@ class Scaffold:
     boilerplate: str = ""
     written: list[str] = dc_field(default_factory=list)
     skipped: list[str] = dc_field(default_factory=list)
+    # dry-run mode: classify without touching disk; see `changes`
+    dry_run: bool = False
+    # (action, path) pairs: create / overwrite / unchanged / preserve /
+    # fragment — populated in dry-run mode only
+    changes: list = dc_field(default_factory=list)
 
     def execute(
         self,
@@ -83,13 +88,15 @@ class Scaffold:
 
     def _write(self, spec: FileSpec) -> None:
         target = os.path.join(self.output_dir, spec.path)
-        if os.path.exists(target):
+        exists = os.path.exists(target)
+        if exists:
             if spec.if_exists == IfExists.SKIP:
                 self.skipped.append(spec.path)
+                if self.dry_run:
+                    self.changes.append(("preserve", spec.path))
                 return
             if spec.if_exists == IfExists.ERROR:
                 raise ScaffoldError(f"file already exists: {spec.path}")
-        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
         content = spec.content
         if (
             spec.add_boilerplate
@@ -100,14 +107,64 @@ class Scaffold:
             content = self.boilerplate.rstrip("\n") + "\n\n" + content
         if not content.endswith("\n"):
             content += "\n"
+        if self.dry_run:
+            if not exists:
+                self.changes.append(("create", spec.path))
+            else:
+                with open(target, "r", encoding="utf-8") as handle:
+                    current = handle.read()
+                self.changes.append(
+                    ("unchanged" if current == content else "overwrite", spec.path)
+                )
+            self.written.append(spec.path)
+            return
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
         with open(target, "w", encoding="utf-8") as handle:
             handle.write(content)
         self.written.append(spec.path)
 
     # -- fragments ------------------------------------------------------
 
+    @staticmethod
+    def _fragment_present(lines: list[str], code: str) -> bool:
+        """Idempotency: the fragment is already inserted when every
+        non-blank fragment line appears in the file."""
+        fragment_lines = [l for l in code.rstrip("\n").split("\n") if l.strip()]
+        return bool(fragment_lines) and all(
+            any(l.strip() == existing.strip() for existing in lines)
+            for l in fragment_lines
+        )
+
+    def _find_marker(self, lines: list[str], fragment: Fragment) -> int | None:
+        needle = MARKER_PREFIX + fragment.marker
+        for i, line in enumerate(lines):
+            if needle in line and line.lstrip().startswith(("//", "#")):
+                return i
+        return None
+
     def _insert(self, fragment: Fragment) -> None:
         target = os.path.join(self.output_dir, fragment.path)
+        if self.dry_run:
+            # a target pending creation in this same run can't be
+            # evaluated against disk; anything else gets the real run's
+            # error checks so the dry run predicts failures too
+            if fragment.path in self.written:
+                self.changes.append(("fragment", fragment.path))
+                return
+            if not os.path.exists(target):
+                raise ScaffoldError(
+                    f"cannot insert at marker {fragment.marker!r}: file "
+                    f"{fragment.path} does not exist"
+                )
+            with open(target, "r", encoding="utf-8") as handle:
+                existing_lines = handle.read().split("\n")
+            if self._find_marker(existing_lines, fragment) is None:
+                raise ScaffoldError(
+                    f"marker {fragment.marker!r} not found in {fragment.path}"
+                )
+            if not self._fragment_present(existing_lines, fragment.code):
+                self.changes.append(("fragment", fragment.path))
+            return
         if not os.path.exists(target):
             raise ScaffoldError(
                 f"cannot insert at marker {fragment.marker!r}: file "
@@ -116,25 +173,15 @@ class Scaffold:
         with open(target, "r", encoding="utf-8") as handle:
             content = handle.read()
 
-        needle = MARKER_PREFIX + fragment.marker
         lines = content.split("\n")
-        marker_idx = None
-        for i, line in enumerate(lines):
-            if needle in line and line.lstrip().startswith(("//", "#")):
-                marker_idx = i
-                break
+        marker_idx = self._find_marker(lines, fragment)
         if marker_idx is None:
             raise ScaffoldError(
                 f"marker {fragment.marker!r} not found in {fragment.path}"
             )
 
         code = fragment.code.rstrip("\n")
-        # idempotency: skip when every fragment line is already present
-        fragment_lines = [l for l in code.split("\n") if l.strip()]
-        if fragment_lines and all(
-            any(l.strip() == existing.strip() for existing in lines)
-            for l in fragment_lines
-        ):
+        if self._fragment_present(lines, code):
             return
 
         indent = lines[marker_idx][: len(lines[marker_idx]) - len(lines[marker_idx].lstrip())]

@@ -323,7 +323,7 @@ spec:
     ]
 
 
-def update_default_kustomization(output_dir: str) -> None:
+def update_default_kustomization(output_dir: str, dry_run: bool = False) -> bool:
     """Wire the webhook + certmanager trees and the manager patch into
     config/default/kustomization.yaml.
 
@@ -331,12 +331,16 @@ def update_default_kustomization(output_dir: str) -> None:
     scaffold markers existed and files the user has edited — by editing the
     YAML lines directly and idempotently: resource entries are inserted
     into the existing ``resources:`` list, and the patch entry is added to
-    an existing ``patches:`` section rather than duplicating the key."""
+    an existing ``patches:`` section rather than duplicating the key.
+
+    Returns True when the file changed (or would change, with *dry_run*).
+    """
     path = os.path.join(output_dir, "config", "default", "kustomization.yaml")
     if not os.path.exists(path):
-        return
+        return False
     with open(path, "r", encoding="utf-8") as handle:
-        lines = handle.read().split("\n")
+        original = handle.read()
+    lines = original.split("\n")
 
     def has_entry(entry: str) -> bool:
         return any(line.strip() == entry for line in lines)
@@ -382,8 +386,13 @@ def update_default_kustomization(output_dir: str) -> None:
         else:
             lines.insert(at, patch_entry)
 
-    with open(path, "w", encoding="utf-8") as handle:
-        handle.write("\n".join(lines))
+    updated = "\n".join(lines)
+    if updated == original:
+        return False
+    if not dry_run:
+        with open(path, "w", encoding="utf-8") as handle:
+            handle.write(updated)
+    return True
 
 
 def main_go_webhook_fragment(view: WorkloadView, hub: str) -> Fragment:

@@ -118,6 +118,68 @@ class TestMiscCommands:
         err = capsys.readouterr().err
         assert "broken.go" in err and "problem" in err
 
+    def test_create_api_dry_run(self, tmp_path, capsys):
+        import hashlib
+
+        cfg = os.path.join(
+            os.path.dirname(__file__), "fixtures", "standalone", "workload.yaml"
+        )
+        out = str(tmp_path / "proj")
+        assert cli_main(
+            ["init", "--workload-config", cfg,
+             "--repo", "e.com/x", "--output-dir", out]
+        ) == 0
+        capsys.readouterr()
+
+        def tree_hash(root):
+            h = hashlib.sha256()
+            for dirpath, _, files in sorted(os.walk(root)):
+                for f in sorted(files):
+                    p = os.path.join(dirpath, f)
+                    h.update(p.encode())
+                    h.update(open(p, "rb").read())
+            return h.hexdigest()
+
+        before = tree_hash(out)
+        assert cli_main(
+            ["create", "api", "--workload-config", cfg,
+             "--output-dir", out, "--dry-run"]
+        ) == 0
+        first = capsys.readouterr().out
+        assert "create" in first and "nothing written" in first
+        assert tree_hash(out) == before  # dry run touches nothing
+
+        assert cli_main(
+            ["create", "api", "--workload-config", cfg, "--output-dir", out]
+        ) == 0
+        capsys.readouterr()
+        assert cli_main(
+            ["create", "api", "--workload-config", cfg,
+             "--output-dir", out, "--dry-run"]
+        ) == 0
+        second = capsys.readouterr().out
+        # idempotent re-scaffold: everything unchanged or preserved
+        assert "unchanged" in second and "preserve" in second
+        assert "create  " not in second and "overwrite" not in second
+
+    def test_dry_run_predicts_missing_fragment_target(self, tmp_path, capsys):
+        """If main.go was deleted, the dry run must fail the way the real
+        run would, not print success."""
+        cfg = os.path.join(
+            os.path.dirname(__file__), "fixtures", "standalone", "workload.yaml"
+        )
+        out = str(tmp_path / "proj")
+        assert cli_main(
+            ["init", "--workload-config", cfg,
+             "--repo", "e.com/x", "--output-dir", out]
+        ) == 0
+        os.remove(os.path.join(out, "main.go"))
+        capsys.readouterr()
+        assert cli_main(
+            ["create", "api", "--workload-config", cfg,
+             "--output-dir", out, "--dry-run"]
+        ) != 0
+
     def test_vet_missing_dir(self, tmp_path, capsys):
         assert cli_main(["vet", str(tmp_path / "nope")]) == 1
         assert "not a directory" in capsys.readouterr().err
