@@ -3,8 +3,9 @@
 The environment ships no Go toolchain, so generated projects cannot be
 compiled here.  This package closes most of that gap with a real Go
 tokenizer (including the automatic-semicolon-insertion rules of the Go
-spec) and a full recursive-descent parser for the Go 1.x grammar as used
-by the generated projects (generics are not emitted and not parsed).
+spec) and a full recursive-descent parser for the modern Go grammar,
+including 1.18+ generics (type parameters, instantiations, union
+constraints, approximation terms).
 
 Contract parity note: the reference (vmware-tanzu-labs/operator-builder)
 relies on `go build` in CI for this guarantee

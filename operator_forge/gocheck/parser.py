@@ -8,7 +8,10 @@ and type switches, select, go/defer/return/goto/labels/send/inc-dec),
 and the full expression grammar with Go's operator precedence, composite
 literals (including the control-clause TypeName ambiguity rule), slice
 expressions, type assertions, conversions and function literals.
-Generics are not parsed (nothing generated emits them).
+Go 1.18+ generics parse too: type parameters (with the `type A[N any] T`
+vs `type A [N]T` array ambiguity resolved by backtracking),
+instantiations in type and expression positions, union constraints and
+approximation (`~`) terms, and generic method receivers.
 
 This is a *syntax* checker: it accepts exactly the shapes `go/parser`
 would and reports the first error per file with line/column.  Type
@@ -230,15 +233,67 @@ class _Parser:
 
     def type_spec(self):
         self.expect_ident()
+        if self.at_op("["):
+            # `type A[T any] ...` (type params) vs `type A [N]T` (array):
+            # try params, fall back to the array reading
+            mark = self.i
+            try:
+                self.type_param_list()
+            except GoSyntaxError:
+                self.i = mark
         if self.at_op("="):  # alias
+            self.advance()
+        self.parse_type()
+
+    def type_args(self):
+        """Instantiation type arguments: ``[T]`` / ``[K, V]``."""
+        self.expect_op("[")
+        self.parse_type()
+        while self.at_op(","):
+            self.advance()
+            if self.at_op("]"):
+                break
+            self.parse_type()
+        self.expect_op("]")
+
+    def type_param_list(self):
+        """Go 1.18 TypeParameters: ``[K comparable, V any]``."""
+        self.expect_op("[")
+        while True:
+            self.ident_list()
+            self.constraint()
+            if self.at_op(","):
+                self.advance()
+                if self.at_op("]"):
+                    break
+                continue
+            break
+        self.expect_op("]")
+
+    def constraint(self):
+        """Type constraint: union of optionally-approximated terms
+        (``int | ~string``)."""
+        self.constraint_elem()
+        while self.at_op("|"):
+            self.advance()
+            self.constraint_elem()
+
+    def constraint_elem(self):
+        if self.at_op("~"):
             self.advance()
         self.parse_type()
 
     def func_decl(self):
         self.expect_kw("func")
+        has_receiver = False
         if self.at_op("("):  # method receiver
+            has_receiver = True
             self.param_list()
         self.expect_ident()
+        if self.at_op("["):  # generic function type parameters
+            if has_receiver:
+                self.error("method must have no type parameters")
+            self.type_param_list()
         has_results = self.signature()
         if self.at_op("{"):
             self.func_body(has_results)
@@ -324,6 +379,8 @@ class _Parser:
             while self.at_op(".") and self.peek().kind == IDENT:
                 self.advance()
                 self.advance()
+            if self.at_op("["):  # generic instantiation: S[T], pkg.M[K, V]
+                self.type_args()
             return
         if t.kind == OP:
             if t.value == "*":
@@ -397,16 +454,29 @@ class _Parser:
         if self.at_op("*"):
             self.advance()
             self.qualified_ident()
+            if self.at_op("["):  # embedded *S[T]
+                self.type_args()
         elif self.tok.kind == IDENT and (
             self.peek().kind == OP and self.peek().value in (";", "}", ".")
         ) and not (self.peek().value == "." and self.peek(2).kind == IDENT and self._field_has_type_after_qualifier()):
             # embedded plain / qualified identifier
             self.qualified_ident()
+            if self.at_op("["):
+                self.type_args()
         elif self.tok.kind == IDENT and self.peek().kind == STRING:
             self.qualified_ident()  # embedded with tag
         else:
-            self.ident_list()
-            self.parse_type()
+            mark = self.i
+            try:
+                self.ident_list()
+                self.parse_type()
+            except GoSyntaxError:
+                # embedded generic instantiation: `S[T]` (ident + type
+                # args, no field name) — ambiguous with `x [3]int` which
+                # the named-field reading above already handles
+                self.i = mark
+                self.qualified_ident()
+                self.type_args()
         if self.tok.kind == STRING:  # field tag
             self.advance()
 
@@ -436,13 +506,13 @@ class _Parser:
         self.expect_op("{")
         self.skip_semis()
         while not self.at_op("}"):
-            self.expect_ident()
-            if self.at_op("("):  # method spec
+            if self.tok.kind == IDENT and self.peek().kind == OP and self.peek().value == "(":
+                self.advance()  # method spec
                 self.signature()
-            else:  # embedded interface (possibly qualified)
-                while self.at_op(".") and self.peek().kind == IDENT:
-                    self.advance()
-                    self.advance()
+            else:
+                # embedded interface / constraint element, possibly a
+                # union with approximation terms: ~int | fmt.Stringer
+                self.constraint()
             self.expect_semi()
             self.skip_semis()
         self.expect_op("}")
@@ -750,12 +820,21 @@ class _Parser:
             if self.at_op("("):  # call / conversion
                 self.call_args()
                 continue
-            if self.at_op("["):  # index / slice
+            if self.at_op("["):  # index / slice / generic instantiation
                 self.advance()
                 saved = self.allow_composite
                 self.allow_composite = True
                 if not self.at_op(":"):
-                    self.expression()
+                    self._index_item()
+                saw_comma = False
+                while self.at_op(","):  # F[K, V] instantiation args
+                    saw_comma = True
+                    self.advance()
+                    if self.at_op("]"):
+                        break
+                    self._index_item()
+                if saw_comma and self.at_op(":"):
+                    self.error("cannot slice after an index list")
                 while self.at_op(":"):
                     self.advance()
                     if not self.at_op("]", ":"):
@@ -770,6 +849,16 @@ class _Parser:
                 self.literal_value()
                 continue
             return
+
+    def _index_item(self):
+        """One element of an index/instantiation bracket: an expression,
+        or a type-only shape like `func(int) string` in `F[func(int) string]`."""
+        mark = self.i
+        try:
+            self.expression()
+        except GoSyntaxError:
+            self.i = mark
+            self.parse_type()
 
     def call_args(self):
         self.expect_op("(")

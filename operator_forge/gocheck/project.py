@@ -12,10 +12,13 @@ from .tokens import GoTokenError
 def check_project(root: str) -> list[str]:
     """Syntax-check every ``.go`` file under *root*; returns all errors.
 
-    Directories Go tooling ignores are pruned: dot-dirs, ``vendor``,
-    ``testdata``, and ``_``-prefixed dirs (vendored third-party code may
-    use language features the checker does not cover, e.g. generics).
-    Unreadable or non-UTF-8 files are reported as errors, not raised.
+    Pruned: dot-dirs, ``testdata``, ``_``-prefixed dirs, and
+    ``_``/``.``-prefixed files (ignored by Go tooling), plus ``vendor``
+    — which `go build` does compile when present, but which belongs to
+    third-party modules the project's generator is not responsible for
+    and which may use build tags or language versions this checker does
+    not model.  Unreadable or non-UTF-8 files are reported as errors,
+    not raised.
     """
     errors: list[str] = []
     for dirpath, dirnames, filenames in os.walk(root):

@@ -36,7 +36,7 @@ OPERATORS = sorted(
         "<<=", ">>=", "&^=", "...",
         "&&", "||", "<-", "++", "--", "==", "!=", "<=", ">=", ":=",
         "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<", ">>", "&^",
-        "+", "-", "*", "/", "%", "&", "|", "^", "<", ">", "=", "!",
+        "+", "-", "*", "/", "%", "&", "|", "^", "<", ">", "=", "!", "~",
         "(", ")", "[", "]", "{", "}", ",", ";", ".", ":",
     ],
     key=len,

@@ -230,6 +230,54 @@ class TestParserRejects:
         reject("func f() { a..b() }\n")
 
 
+class TestGenerics:
+    """Go 1.18+ grammar: type parameters, instantiations, unions, ~."""
+
+    def test_generic_declarations_and_uses(self):
+        accept(
+            "type Number interface {\n\t~int | ~int64 | ~float64\n}\n"
+            "type Pair[K comparable, V any] struct {\n\tKey K\n\tVal V\n}\n"
+            "type List[T any] []T\n"
+            "type Wrapper[T any] struct {\n\t*Pair[string, T]\n\tList[T]\n\tinner List[T]\n}\n"
+            "type Alias = Pair[string, int]\n"
+            "func Map[T, U any](xs []T, f func(T) U) []U {\n"
+            "\tout := make([]U, 0, len(xs))\n"
+            "\tfor _, x := range xs {\n\t\tout = append(out, f(x))\n\t}\n"
+            "\treturn out\n}\n"
+            "func (p *Pair[K, V]) Swap(o Pair[K, V]) {\n\t_ = o\n}\n"
+            "func use() {\n"
+            "\tp := Pair[string, int]{Key: \"a\", Val: 1}\n"
+            "\txs := Map[int, string]([]int{1}, func(i int) string { return \"\" })\n"
+            "\tvar l List[List[int]]\n"
+            "\t_, _, _ = p, xs, l\n}\n"
+        )
+
+    def test_array_type_decls_still_parse(self):
+        accept("type A [3]int\ntype B [len(\"abc\")]byte\ntype C [][]string\n")
+
+    def test_func_type_in_instantiation_args(self):
+        accept("var x = F[func(int) string](nil)\nfunc F[T any](v T) T { return v }\n")
+
+    def test_generic_method_rejected(self):
+        # go/parser: "method must have no type parameters"
+        reject("type T struct{}\nfunc (t T) M[P any]() {}\n")
+
+    def test_slice_after_index_list_rejected(self):
+        reject("func f(a []int) {\n\t_ = a[1, 2:3]\n}\n")
+
+    def test_empty_func_type_params_rejected(self):
+        # `type A[] int` is the same token stream as `type A []int` and
+        # therefore valid; empty brackets on a func are not
+        accept("type A[] int\n")
+        reject("func F[](x int) {}\n")
+
+    def test_generic_semantics_clean(self):
+        from operator_forge.gocheck import check_semantics
+        assert check_semantics(
+            "package p\nfunc F[T any](x T) T {\n\treturn x\n}\n"
+        ) == []
+
+
 class TestCheckSource:
     def test_ok_returns_empty(self):
         assert check_source("package p\n") == []
