@@ -20,6 +20,7 @@ Public API:
 from .tokens import GoTokenError, Token, tokenize
 from .parser import GoSyntaxError, check_source, parse_source
 from .lint import check_semantics
+from .structural import check_structure
 from .project import check_project
 
 __all__ = [
@@ -30,5 +31,6 @@ __all__ = [
     "parse_source",
     "check_source",
     "check_semantics",
+    "check_structure",
     "check_project",
 ]

@@ -357,10 +357,18 @@ class _Parser:
                 or (self.peek().kind == KEYWORD and self.peek().value in _TYPE_START_KEYWORDS)
                 or (self.peek().kind == OP and self.peek().value in (_TYPE_START_OPS | {"..."}))
             ):
-                self.advance()  # parameter name
-                if self.at_op("..."):
-                    self.advance()
-                self.parse_type()
+                # `name Type` — but `P[int]` (generic instantiation as a
+                # bare parameter type) also matches IDENT `[`, so fall
+                # back to the type reading if name+type fails
+                mark = self.i
+                try:
+                    self.advance()  # parameter name
+                    if self.at_op("..."):
+                        self.advance()
+                    self.parse_type()
+                except GoSyntaxError:
+                    self.i = mark
+                    self.parse_type()
             else:
                 self.parse_type()
             if self.at_op(","):

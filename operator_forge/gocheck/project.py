@@ -6,6 +6,7 @@ import os
 
 from .lint import semantics_of
 from .parser import GoSyntaxError, parse_source
+from .structural import check_structure, prune_go_dirs
 from .tokens import GoTokenError
 
 
@@ -22,11 +23,7 @@ def check_project(root: str) -> list[str]:
     """
     errors: list[str] = []
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(
-            d
-            for d in dirnames
-            if not d.startswith((".", "_")) and d not in ("vendor", "testdata")
-        )
+        dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
             # like Go tooling: only .go files not prefixed with '_' or '.'
             if not name.endswith(".go") or name.startswith(("_", ".")):
@@ -47,4 +44,8 @@ def check_project(root: str) -> list[str]:
                 errors.append(f"{path}: nesting too deep to parse")
                 continue
             errors.extend(semantics_of(parsed, path))
+    # package-level structural checks (imports, duplicate funcs,
+    # unresolved qualifiers) — these tolerate unreadable files, so an
+    # error in one package doesn't suppress findings in another
+    errors.extend(check_structure(root))
     return errors

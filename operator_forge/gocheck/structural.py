@@ -1,0 +1,331 @@
+"""Structural per-package checks: the cross-file compile errors.
+
+These complement parser.py (syntax) and lint.py (per-function semantics)
+with the package-level errors `go build` would raise: unused and
+duplicate imports, duplicate top-level declarations, and unresolved
+`pkg.Symbol` qualifiers (the error a missing import produces).
+
+Heuristic by design — the checks run on stripped source text, erring on
+the side of no false positives (an identifier that might be a local
+counts as one).  Originally lived in tests/golint.py; promoted so
+`operator-forge vet` covers them for users, not just the test suite.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import defaultdict
+
+from .tokens import KEYWORDS as _GO_KEYWORDS
+
+_IMPORT_BLOCK_RE = re.compile(r"import\s*\(\s*\n(.*?)\n\)", re.DOTALL)
+_IMPORT_LINE_RE = re.compile(r'^\s*(?:(\w+)\s+)?"([^"]+)"\s*$')
+_FUNC_RE = re.compile(r"^func\s+(?:\([^)]*\)\s+)?(\w+)\s*\(", re.MULTILINE)
+_TOPLEVEL_RE = re.compile(r"^(?:var|const|type)\s+(\w+)", re.MULTILINE)
+
+# identifiers used as `name.` qualifiers: not preceded by ident char, `.`,
+# `)` or `]` (those are field/method accesses on expressions)
+_QUAL_RE = re.compile(r"(?<![\w.\)\]])([A-Za-z_]\w*)\s*\.")
+# declarations/assignments at line start or after `{`/`;`/header keywords
+_SHORT_DECL_RE = re.compile(
+    r"(?:^|[{;]|\belse\b|\bif\b|\bswitch\b|\bfor\b)\s*"
+    r"([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*:?=(?!=)",
+    re.MULTILINE,
+)
+_VAR_DECL_RE = re.compile(
+    r"^\s*(?:var|const)\s+([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)",
+    re.MULTILINE,
+)
+_FUNC_SIG_RE = re.compile(
+    r"func\s*(\(\s*[^)]*\))?\s*\w*\s*(\([^)]*\))\s*(\([^)]*\)|[\w\*\[\]\.]+)?"
+)
+_RANGE_RE = re.compile(r"for\s+([\w\s,]+?)\s*:=\s*range\b")
+
+
+def strip_strings_and_comments(text: str) -> str:
+    out = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if ch == "/" and nxt == "/":
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif ch == "/" and nxt == "*":
+            j = text.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        elif ch == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append('""')
+            i = j + 1
+        elif ch == "'":
+            # rune literal — may contain quote/backtick/slash chars that
+            # would otherwise derail the scanner ('"', '\'', '`', '/')
+            j = i + 1
+            while j < n and text[j] != "'":
+                j += 2 if text[j] == "\\" else 1
+            out.append("''")
+            i = j + 1
+        elif ch == "`":
+            j = text.find("`", i + 1)
+            out.append('""')
+            i = n if j < 0 else j + 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def parse_imports(text: str) -> list[tuple[str, str]]:
+    """Return (effective_name, path) for every import."""
+    imports: list[tuple[str, str]] = []
+    block = _IMPORT_BLOCK_RE.search(text)
+    lines = block.group(1).split("\n") if block else []
+    single = re.findall(r'^import\s+(?:(\w+)\s+)?"([^"]+)"', text, re.MULTILINE)
+    entries = [m.groups() for l in lines for m in [_IMPORT_LINE_RE.match(l)] if m]
+    entries.extend(single)
+    for alias, path in entries:
+        name = alias or path.rsplit("/", 1)[-1].replace("-", "_")
+        # versioned module suffixes like .../v4 import as the parent name
+        if re.fullmatch(r"v\d+", name) and "/" in path:
+            name = path.rsplit("/", 2)[-2]
+        # gopkg.in-style suffixes: gopkg.in/yaml.v3 imports as `yaml`
+        m = re.fullmatch(r"(.+)\.v\d+", name)
+        if m:
+            name = m.group(1)
+        imports.append((name, path))
+    return imports
+
+
+def check_imports(text: str) -> list[str]:
+    """Unused and duplicate imports for one file's source text."""
+    problems: list[str] = []
+    imports = parse_imports(text)
+    body = strip_strings_and_comments(text)
+    block = _IMPORT_BLOCK_RE.search(body)
+    if block:
+        body = body[: block.start()] + body[block.end() :]
+
+    seen_paths: set[str] = set()
+    seen_names: set[str] = set()
+    for name, ipath in imports:
+        if ipath in seen_paths:
+            problems.append(f"duplicate import path {ipath!r}")
+        seen_paths.add(ipath)
+        if name in seen_names:
+            problems.append(f"duplicate import name {name!r}")
+        seen_names.add(name)
+        if name == "_":
+            continue
+        if not re.search(rf"\b{re.escape(name)}\s*\.", body):
+            problems.append(f"unused import {name!r} ({ipath})")
+    return problems
+
+
+def _param_names(paren: str) -> set[str]:
+    """Names from a Go parameter/receiver/result list ``(a, b Type, c *T)``."""
+    names: set[str] = set()
+    inner = paren.strip()
+    if inner.startswith("(") and inner.endswith(")"):
+        inner = inner[1:-1]
+    if not inner.strip():
+        return names
+    depth = 0
+    groups, cur = [], []
+    for ch in inner:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            groups.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    groups.append("".join(cur))
+    pending: list[str] = []
+    for group in groups:
+        tokens = group.strip().split()
+        if not tokens:
+            continue
+        if len(tokens) == 1:
+            # could be a bare name sharing a later type (`a, b Type`) or a
+            # bare type; keep as pending name candidate
+            if re.fullmatch(r"[A-Za-z_]\w*", tokens[0]):
+                pending.append(tokens[0])
+        else:
+            names.add(tokens[0])
+            names.update(pending)
+            pending = []
+    return names
+
+
+def _local_names(clean: str) -> set[str]:
+    """Every identifier the file plausibly declares locally."""
+    names: set[str] = set()
+    for match in _FUNC_SIG_RE.finditer(clean):
+        receiver, params, results = match.groups()
+        if receiver:
+            names.update(_param_names(receiver))
+        names.update(_param_names(params))
+        if results and results.startswith("("):
+            names.update(_param_names(results))
+    for pattern in (_SHORT_DECL_RE, _VAR_DECL_RE, _RANGE_RE):
+        for match in pattern.finditer(clean):
+            for name in match.group(1).split(","):
+                name = name.strip()
+                if re.fullmatch(r"[A-Za-z_]\w*", name):
+                    names.add(name)
+    # grouped declarations at any indentation: `var (\n  b Builder\n  ...)`
+    for block in re.finditer(
+        r"\b(?:var|const)\s*\(\s*\n(.*?)\n\s*\)", clean, re.DOTALL
+    ):
+        for line in block.group(1).split("\n"):
+            m = re.match(r"\s*([A-Za-z_]\w*)", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def prune_go_dirs(dirnames: list[str]) -> list[str]:
+    """In-place-assignable filter for os.walk: directories Go tooling and
+    vet skip (dot/_-prefixed, vendor, testdata)."""
+    return sorted(
+        d
+        for d in dirnames
+        if not d.startswith((".", "_")) and d not in ("vendor", "testdata")
+    )
+
+
+def _load_packages(root: str) -> tuple[dict, list[str]]:
+    """Read every checked .go file once: {dir: [(path, text, clean)]}.
+    Unreadable files are reported, not fatal."""
+    packages: dict[str, list[tuple[str, str, str]]] = defaultdict(list)
+    problems: list[str] = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = prune_go_dirs(dirnames)
+        for f in sorted(files):
+            if not f.endswith(".go") or f.startswith(("_", ".")):
+                continue
+            path = os.path.join(dirpath, f)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+            except (OSError, UnicodeDecodeError):
+                continue  # the parse pass reports unreadable files
+            packages[dirpath].append(
+                (path, text, strip_strings_and_comments(text))
+            )
+    return packages, problems
+
+
+def _toplevel_decls(cleans: list[str]) -> set[str]:
+    decls: set[str] = set()
+    for clean in cleans:
+        for match in _FUNC_RE.finditer(clean):
+            decls.add(match.group(1))
+        for match in _TOPLEVEL_RE.finditer(clean):
+            decls.add(match.group(1))
+        # names inside var/const blocks: `var (\n  a = ...\n  b = ...\n)`
+        for block in re.finditer(
+            r"^(?:var|const)\s*\(\s*\n(.*?)^\)", clean,
+            re.MULTILINE | re.DOTALL,
+        ):
+            for line in block.group(1).split("\n"):
+                m = re.match(r"\s*([A-Za-z_]\w*)", line)
+                if m:
+                    decls.add(m.group(1))
+    return decls
+
+
+def package_toplevel_decls(package_dir: str) -> set[str]:
+    """Top-level func/var/const/type names across all files of a package."""
+    cleans = []
+    for f in os.listdir(package_dir):
+        if not f.endswith(".go") or f.startswith(("_", ".")):
+            continue
+        with open(os.path.join(package_dir, f), "r", encoding="utf-8") as fh:
+            cleans.append(strip_strings_and_comments(fh.read()))
+    return _toplevel_decls(cleans)
+
+
+def _unresolved_qualifiers(files: list[tuple[str, str, str]], pkg_decls: set[str]) -> list[str]:
+    problems: list[str] = []
+    for path, text, clean in files:
+        imports = {name for name, _ in parse_imports(text)}
+        block = _IMPORT_BLOCK_RE.search(clean)
+        if block:
+            # blank the import block rather than excising it so reported
+            # line numbers stay aligned with the source file
+            blanked = "\n" * clean[block.start() : block.end()].count("\n")
+            clean = clean[: block.start()] + blanked + clean[block.end() :]
+        known = imports | pkg_decls | _local_names(clean) | set(_GO_KEYWORDS)
+        for match in _QUAL_RE.finditer(clean):
+            name = match.group(1)
+            if name in known:
+                continue
+            line = clean[: match.start()].count("\n") + 1
+            problems.append(
+                f"{path}:{line}: unresolved qualifier {name!r}"
+            )
+            known.add(name)  # one report per name per file
+    return problems
+
+
+def check_unresolved_qualifiers(package_dir: str) -> list[str]:
+    """Flag ``name.Selector`` uses where ``name`` is not an import, a local
+    declaration, a package-level declaration, or a Go keyword — the compile
+    error a missing import fragment or stale alias would produce."""
+    files = []
+    for f in sorted(os.listdir(package_dir)):
+        if not f.endswith(".go") or f.startswith(("_", ".")):
+            continue
+        path = os.path.join(package_dir, f)
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        files.append((path, text, strip_strings_and_comments(text)))
+    return _unresolved_qualifiers(files, _toplevel_decls([c for _, _, c in files]))
+
+
+def _duplicate_funcs(packages: dict) -> list[str]:
+    problems: list[str] = []
+    for dirpath in sorted(packages):
+        decls: dict[str, str] = {}
+        for path, _, clean in packages[dirpath]:
+            for match in _FUNC_RE.finditer(clean):
+                line_start = clean.rfind("\n", 0, match.start()) + 1
+                if clean[line_start : match.start()].strip():
+                    continue
+                name = match.group(1)
+                if "func (" in match.group(0):
+                    continue
+                if name in decls and decls[name] != path and name != "init":
+                    problems.append(
+                        f"duplicate func {name!r} in {path} and {decls[name]}"
+                    )
+                decls[name] = path
+    return problems
+
+
+def check_duplicate_funcs(root: str) -> list[str]:
+    """Detect duplicate top-level function declarations within packages."""
+    packages, _ = _load_packages(root)
+    return _duplicate_funcs(packages)
+
+
+def check_structure(root: str) -> list[str]:
+    """All structural checks over a project tree (each file read and
+    stripped exactly once)."""
+    packages, problems = _load_packages(root)
+    for dirpath in sorted(packages):
+        files = packages[dirpath]
+        for path, text, _ in files:
+            problems += [f"{path}: {p}" for p in check_imports(text)]
+        pkg_decls = _toplevel_decls([c for _, _, c in files])
+        problems += _unresolved_qualifiers(files, pkg_decls)
+    problems += _duplicate_funcs(packages)
+    return problems

@@ -1,130 +1,22 @@
-"""Lightweight structural checks for generated Go files.
+"""Thin shim: the structural Go checks now live in the framework
+(operator_forge.gocheck.structural) so `operator-forge vet` runs them
+for users; tests import through this module's historical names."""
 
-Without a Go toolchain in this environment, these checks catch the compile
-errors generated code is most likely to have: unused imports, duplicate
-imports, duplicate top-level declarations in a package, and unbalanced
-braces.
-"""
-
-from __future__ import annotations
-
-import os
-import re
-from collections import defaultdict
-
-_IMPORT_BLOCK_RE = re.compile(r"import\s*\(\s*\n(.*?)\n\)", re.DOTALL)
-_IMPORT_LINE_RE = re.compile(r'^\s*(?:(\w+)\s+)?"([^"]+)"\s*$')
-_FUNC_RE = re.compile(r"^func\s+(?:\([^)]*\)\s+)?(\w+)\s*\(", re.MULTILINE)
-_TOPLEVEL_RE = re.compile(r"^(?:var|const|type)\s+(\w+)", re.MULTILINE)
-_PACKAGE_RE = re.compile(r"^package\s+(\w+)", re.MULTILINE)
-
-
-def _strip_strings_and_comments(text: str) -> str:
-    out = []
-    i = 0
-    n = len(text)
-    while i < n:
-        ch = text[i]
-        nxt = text[i + 1] if i + 1 < n else ""
-        if ch == "/" and nxt == "/":
-            j = text.find("\n", i)
-            i = n if j < 0 else j
-        elif ch == "/" and nxt == "*":
-            j = text.find("*/", i + 2)
-            i = n if j < 0 else j + 2
-        elif ch == '"':
-            j = i + 1
-            while j < n and text[j] != '"':
-                j += 2 if text[j] == "\\" else 1
-            out.append('""')
-            i = j + 1
-        elif ch == "`":
-            j = text.find("`", i + 1)
-            out.append('""')
-            i = n if j < 0 else j + 1
-        else:
-            out.append(ch)
-            i += 1
-    return "".join(out)
-
-
-def parse_imports(text: str) -> list[tuple[str, str]]:
-    """Return (effective_name, path) for every import."""
-    imports: list[tuple[str, str]] = []
-    block = _IMPORT_BLOCK_RE.search(text)
-    lines = block.group(1).split("\n") if block else []
-    single = re.findall(r'^import\s+(?:(\w+)\s+)?"([^"]+)"', text, re.MULTILINE)
-    entries = [m.groups() for l in lines for m in [_IMPORT_LINE_RE.match(l)] if m]
-    entries.extend(single)
-    for alias, path in entries:
-        name = alias or path.rsplit("/", 1)[-1].replace("-", "_")
-        # versioned module suffixes like .../v4 import as the parent name
-        if re.fullmatch(r"v\d+", name) and "/" in path:
-            name = path.rsplit("/", 2)[-2]
-        imports.append((name, path))
-    return imports
+from operator_forge.gocheck.structural import (  # noqa: F401
+    _local_names,
+    _param_names,
+    check_duplicate_funcs as check_package_dirs,
+    check_imports,
+    check_unresolved_qualifiers,
+    package_toplevel_decls,
+    parse_imports,
+    strip_strings_and_comments as _strip_strings_and_comments,
+)
 
 
 def check_file(path: str) -> list[str]:
     with open(path, "r", encoding="utf-8") as handle:
-        text = handle.read()
-    problems: list[str] = []
-
-    imports = parse_imports(text)
-    body = _strip_strings_and_comments(text)
-    # strip the import block itself from the body before usage analysis
-    block = _IMPORT_BLOCK_RE.search(body)
-    if block:
-        body = body[: block.start()] + body[block.end() :]
-
-    seen_paths: set[str] = set()
-    seen_names: set[str] = set()
-    for name, ipath in imports:
-        if ipath in seen_paths:
-            problems.append(f"duplicate import path {ipath!r}")
-        seen_paths.add(ipath)
-        if name in seen_names:
-            problems.append(f"duplicate import name {name!r}")
-        seen_names.add(name)
-        if name == "_":
-            continue
-        if not re.search(rf"\b{re.escape(name)}\s*\.", body):
-            problems.append(f"unused import {name!r} ({ipath})")
-    return problems
-
-
-def check_package_dirs(root: str) -> list[str]:
-    """Detect duplicate top-level declarations within each package dir."""
-    problems: list[str] = []
-    by_dir: dict[str, list[str]] = defaultdict(list)
-    for dirpath, _, files in os.walk(root):
-        for f in files:
-            if f.endswith(".go"):
-                by_dir[dirpath].append(os.path.join(dirpath, f))
-    for dirpath, files in by_dir.items():
-        decls: dict[str, str] = {}
-        for path in files:
-            with open(path, "r", encoding="utf-8") as handle:
-                text = handle.read()
-            clean = _strip_strings_and_comments(text)
-            for match in _FUNC_RE.finditer(clean):
-                # methods (with receivers) are excluded by the regex's
-                # receiver group only when unnamed; dedupe plain funcs only
-                line_start = clean.rfind("\n", 0, match.start()) + 1
-                if clean[line_start:match.start()].strip():
-                    continue
-                name = match.group(1)
-                if "func (" in match.group(0):
-                    continue
-                key = name
-                if key in decls and decls[key] != path:
-                    if name != "init":
-                        problems.append(
-                            f"duplicate func {name!r} in {path} and "
-                            f"{decls[key]}"
-                        )
-                decls[key] = path
-    return problems
+        return check_imports(handle.read())
 
 
 def check_tokens(path: str) -> list[str]:
@@ -148,151 +40,8 @@ def check_tokens(path: str) -> list[str]:
     return problems
 
 
-from operator_forge.gocheck.tokens import KEYWORDS as _GO_KEYWORDS
-
-# identifiers used as `name.` qualifiers: not preceded by ident char, `.`,
-# `)` or `]` (those are field/method accesses on expressions)
-_QUAL_RE = re.compile(r"(?<![\w.\)\]])([A-Za-z_]\w*)\s*\.")
-# declarations/assignments at line start or after `{`/`;`/header keywords
-# (`if x := ...;`, `switch v := ...`, `for i := ...`)
-_SHORT_DECL_RE = re.compile(
-    r"(?:^|[{;]|\belse\b|\bif\b|\bswitch\b|\bfor\b)\s*"
-    r"([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*:?=(?!=)",
-    re.MULTILINE,
-)
-_VAR_DECL_RE = re.compile(
-    r"^\s*(?:var|const)\s+([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)",
-    re.MULTILINE,
-)
-_FUNC_SIG_RE = re.compile(
-    r"func\s*(\(\s*[^)]*\))?\s*\w*\s*(\([^)]*\))\s*(\([^)]*\)|[\w\*\[\]\.]+)?"
-)
-_RANGE_RE = re.compile(r"for\s+([\w\s,]+?)\s*:=\s*range\b")
-
-
-def _param_names(paren: str) -> set[str]:
-    """Names from a Go parameter/receiver/result list ``(a, b Type, c *T)``."""
-    names: set[str] = set()
-    inner = paren.strip()
-    if inner.startswith("(") and inner.endswith(")"):
-        inner = inner[1:-1]
-    if not inner.strip():
-        return names
-    depth = 0
-    groups, cur = [], []
-    for ch in inner:
-        if ch in "([{":
-            depth += 1
-        elif ch in ")]}":
-            depth -= 1
-        if ch == "," and depth == 0:
-            groups.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    groups.append("".join(cur))
-    pending: list[str] = []
-    for group in groups:
-        tokens = group.strip().split()
-        if not tokens:
-            continue
-        if len(tokens) == 1:
-            # could be a bare name sharing a later type (`a, b Type`) or a
-            # bare type; keep as pending name candidate
-            if re.fullmatch(r"[A-Za-z_]\w*", tokens[0]):
-                pending.append(tokens[0])
-        else:
-            names.add(tokens[0])
-            names.update(pending)
-            pending = []
-    return names
-
-
-def _local_names(clean: str) -> set[str]:
-    """Every identifier the file plausibly declares locally."""
-    names: set[str] = set()
-    for match in _FUNC_SIG_RE.finditer(clean):
-        receiver, params, results = match.groups()
-        if receiver:
-            names.update(_param_names(receiver))
-        names.update(_param_names(params))
-        if results and results.startswith("("):
-            names.update(_param_names(results))
-    for pattern in (_SHORT_DECL_RE, _VAR_DECL_RE, _RANGE_RE):
-        for match in pattern.finditer(clean):
-            for name in match.group(1).split(","):
-                name = name.strip()
-                if re.fullmatch(r"[A-Za-z_]\w*", name):
-                    names.add(name)
-    return names
-
-
-def package_toplevel_decls(package_dir: str) -> set[str]:
-    """Top-level func/var/const/type names across all files of a package."""
-    decls: set[str] = set()
-    for f in os.listdir(package_dir):
-        if not f.endswith(".go"):
-            continue
-        with open(os.path.join(package_dir, f), "r", encoding="utf-8") as fh:
-            clean = _strip_strings_and_comments(fh.read())
-        for match in _FUNC_RE.finditer(clean):
-            decls.add(match.group(1))
-        for match in _TOPLEVEL_RE.finditer(clean):
-            decls.add(match.group(1))
-        # names inside var/const blocks: `var (\n  a = ...\n  b = ...\n)`
-        for block in re.finditer(
-            r"^(?:var|const)\s*\(\s*\n(.*?)^\)", clean,
-            re.MULTILINE | re.DOTALL,
-        ):
-            for line in block.group(1).split("\n"):
-                m = re.match(r"\s*([A-Za-z_]\w*)", line)
-                if m:
-                    decls.add(m.group(1))
-    return decls
-
-
-def check_unresolved_qualifiers(package_dir: str) -> list[str]:
-    """Flag ``name.Selector`` uses where ``name`` is not an import, a local
-    declaration, a package-level declaration, or a Go keyword — the compile
-    error a missing import fragment or stale alias would produce."""
-    problems: list[str] = []
-    pkg_decls = package_toplevel_decls(package_dir)
-    for f in sorted(os.listdir(package_dir)):
-        if not f.endswith(".go"):
-            continue
-        path = os.path.join(package_dir, f)
-        with open(path, "r", encoding="utf-8") as fh:
-            text = fh.read()
-        imports = {name for name, _ in parse_imports(text)}
-        clean = _strip_strings_and_comments(text)
-        block = _IMPORT_BLOCK_RE.search(clean)
-        if block:
-            # blank the import block rather than excising it so reported
-            # line numbers stay aligned with the source file
-            blanked = "\n" * clean[block.start() : block.end()].count("\n")
-            clean = clean[: block.start()] + blanked + clean[block.end() :]
-        known = imports | pkg_decls | _local_names(clean) | _GO_KEYWORDS
-        for match in _QUAL_RE.finditer(clean):
-            name = match.group(1)
-            if name in known:
-                continue
-            line = clean[: match.start()].count("\n") + 1
-            problems.append(
-                f"{path}:{line}: unresolved qualifier {name!r}"
-            )
-            known.add(name)  # one report per name per file
-    return problems
-
-
 def lint_project(root: str) -> list[str]:
     """Run every structural check over a generated project tree."""
-    problems: list[str] = []
-    for dirpath, _, files in os.walk(root):
-        go_files = [f for f in files if f.endswith(".go")]
-        for f in go_files:
-            path = os.path.join(dirpath, f)
-            problems += [f"{path}: {p}" for p in check_file(path)]
-        if go_files:
-            problems += check_unresolved_qualifiers(dirpath)
-    problems += check_package_dirs(root)
-    return problems
+    from operator_forge.gocheck.structural import check_structure
+
+    return check_structure(root)

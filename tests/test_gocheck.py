@@ -255,6 +255,15 @@ class TestGenerics:
     def test_array_type_decls_still_parse(self):
         accept("type A [3]int\ntype B [len(\"abc\")]byte\ntype C [][]string\n")
 
+    def test_instantiation_as_bare_parameter_or_result(self):
+        accept(
+            "type P[T any] struct{}\n"
+            "func f() (P[int], error) { return P[int]{}, nil }\n"
+            "type L[T any] []T\n"
+            "func (L[T]) Kind() int { return 0 }\n"
+            "func g(P[int]) {}\n"
+        )
+
     def test_func_type_in_instantiation_args(self):
         accept("var x = F[func(int) string](nil)\nfunc F[T any](v T) T { return v }\n")
 
@@ -402,6 +411,58 @@ class TestSemantics:
         assert any("dead declared and not used" in e for e in errors)
 
 
+class TestStructural:
+    def test_rune_literals_do_not_derail_import_usage(self):
+        from operator_forge.gocheck.structural import check_imports
+
+        src = (
+            "package p\n\n"
+            'import "strconv"\n\n'
+            "func f(r rune) int {\n"
+            "\tif r == '\"' {\n\t\treturn 0\n\t}\n"
+            "\tn, _ := strconv.Atoi(string(r))\n"
+            "\treturn n\n}\n"
+        )
+        assert check_imports(src) == []
+
+    def test_gopkg_in_import_name(self):
+        from operator_forge.gocheck.structural import parse_imports
+
+        assert parse_imports('package p\nimport "gopkg.in/yaml.v3"\n') == [
+            ("yaml", "gopkg.in/yaml.v3")
+        ]
+
+    def test_local_grouped_var_block_not_flagged(self, tmp_path):
+        from operator_forge.gocheck import check_structure
+
+        (tmp_path / "a.go").write_text(
+            "package p\n\ntype Builder struct{}\n"
+            "func (Builder) Len() int { return 0 }\n"
+            "func f() int {\n\tvar (\n\t\tb Builder\n\t)\n\treturn b.Len()\n}\n"
+        )
+        assert check_structure(str(tmp_path)) == []
+
+    def test_unreadable_file_does_not_suppress_other_findings(self, tmp_path):
+        from operator_forge.gocheck import check_project
+
+        (tmp_path / "bad.go").write_bytes(b"\xff\xfe")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.go").write_text('package p\n\nimport "fmt"\n\nfunc f() {}\n')
+        errors = check_project(str(tmp_path))
+        assert any("unreadable" in e for e in errors)
+        assert any("unused import" in e for e in errors)
+
+    def test_vet_reports_unused_import(self, tmp_path):
+        from operator_forge.gocheck import check_project
+
+        (tmp_path / "a.go").write_text(
+            'package p\n\nimport "fmt"\n\nfunc f() {}\n'
+        )
+        errors = check_project(str(tmp_path))
+        assert any("unused import" in e for e in errors)
+
+
 class TestCheckProject:
     def test_prunes_vendor_and_reports_unreadable(self, tmp_path):
         from operator_forge.gocheck import check_project
@@ -483,6 +544,14 @@ class TestReferenceCorpus:
                     failures.extend(check_source(fh.read(), path))
         assert count > 100  # the corpus is real
         assert failures == []
+
+    def test_reference_tree_structurally_clean(self):
+        """Imports/duplicates/qualifier checks over the whole compiling
+        reference tree must report nothing (exercises rune literals,
+        gopkg.in-style import names, and real-world package layouts)."""
+        from operator_forge.gocheck import check_structure
+
+        assert check_structure(REFERENCE) == []
 
     def test_reference_corpus_semantically_clean(self):
         """The reference compiles, so the conservative unused-local pass
