@@ -17,6 +17,7 @@ Commands:
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 
@@ -342,7 +343,10 @@ def cmd_vet(args: argparse.Namespace) -> int:
     return 0
 
 
+@functools.cache
 def build_parser() -> argparse.ArgumentParser:
+    # cached: construction is ~4ms and the parser is safely reusable
+    # (no append-actions or mutable defaults)
     parser = argparse.ArgumentParser(
         prog="operator-forge",
         description=(
@@ -461,8 +465,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
-    parser = build_parser()
-    args = parser.parse_args(argv)
+    args = build_parser().parse_args(argv)
     try:
         return args.func(args)
     except (
