@@ -201,10 +201,15 @@ def prune_go_dirs(dirnames: list[str]) -> list[str]:
     )
 
 
+_PACKAGE_CLAUSE_RE = re.compile(r"^package\s+(\w+)", re.MULTILINE)
+_BUILD_TAG_RE = re.compile(r"^//(?:go:build\s|\s*\+build\s)", re.MULTILINE)
+
+
 def _load_packages(root: str) -> tuple[dict, list[str]]:
-    """Read every checked .go file once: {dir: [(path, text, clean)]}.
-    Unreadable files are reported, not fatal."""
-    packages: dict[str, list[tuple[str, str, str]]] = defaultdict(list)
+    """Read every checked .go file once, grouped by Go package — keyed on
+    (directory, package-clause name) so external ``_test`` packages and
+    the like don't collide.  Unreadable files are reported, not fatal."""
+    packages: dict[tuple[str, str], list[tuple[str, str, str]]] = defaultdict(list)
     problems: list[str] = []
     for dirpath, dirnames, files in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
@@ -217,9 +222,10 @@ def _load_packages(root: str) -> tuple[dict, list[str]]:
                     text = fh.read()
             except (OSError, UnicodeDecodeError):
                 continue  # the parse pass reports unreadable files
-            packages[dirpath].append(
-                (path, text, strip_strings_and_comments(text))
-            )
+            clean = strip_strings_and_comments(text)
+            m = _PACKAGE_CLAUSE_RE.search(clean)
+            pkg = m.group(1) if m else ""
+            packages[(dirpath, pkg)].append((path, text, clean))
     return packages, problems
 
 
@@ -293,9 +299,16 @@ def check_unresolved_qualifiers(package_dir: str) -> list[str]:
 
 def _duplicate_funcs(packages: dict) -> list[str]:
     problems: list[str] = []
-    for dirpath in sorted(packages):
+    for key in sorted(packages):
+        # files under build constraints may be mutually exclusive
+        # (per-OS pairs legally re-declare the same names): exclude them
+        files = [
+            (path, text, clean)
+            for path, text, clean in packages[key]
+            if not _BUILD_TAG_RE.search(text)
+        ]
         decls: dict[str, str] = {}
-        for path, _, clean in packages[dirpath]:
+        for path, _, clean in files:
             for match in _FUNC_RE.finditer(clean):
                 line_start = clean.rfind("\n", 0, match.start()) + 1
                 if clean[line_start : match.start()].strip():
@@ -308,6 +321,20 @@ def _duplicate_funcs(packages: dict) -> list[str]:
                         f"duplicate func {name!r} in {path} and {decls[name]}"
                     )
                 decls[name] = path
+        # duplicate top-level var/const/type across files of one package
+        # (same-file duplicates are left to the heavier semantic passes)
+        toplevel: dict[str, str] = {}
+        for path, _, clean in files:
+            for match in _TOPLEVEL_RE.finditer(clean):
+                name = match.group(1)
+                if name == "_":
+                    continue
+                if name in toplevel and toplevel[name] != path:
+                    problems.append(
+                        f"duplicate declaration {name!r} in {path} "
+                        f"and {toplevel[name]}"
+                    )
+                toplevel[name] = path
     return problems
 
 
@@ -321,8 +348,8 @@ def check_structure(root: str) -> list[str]:
     """All structural checks over a project tree (each file read and
     stripped exactly once)."""
     packages, problems = _load_packages(root)
-    for dirpath in sorted(packages):
-        files = packages[dirpath]
+    for key in sorted(packages):
+        files = packages[key]
         for path, text, _ in files:
             problems += [f"{path}: {p}" for p in check_imports(text)]
         pkg_decls = _toplevel_decls([c for _, _, c in files])

@@ -25,3 +25,18 @@ try:
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 except Exception:
     pass
+
+
+def list_samples(project: str, full_only: bool = False) -> list[str]:
+    """Sample CR manifests of a generated project (config/samples minus
+    the kustomization); ``full_only`` drops required-only variants if a
+    future layout adds them."""
+    samples_dir = os.path.join(project, "config", "samples")
+    out = [
+        os.path.join(samples_dir, f)
+        for f in sorted(os.listdir(samples_dir))
+        if f != "kustomization.yaml"
+    ]
+    if full_only:
+        out = [p for p in out if "required" not in os.path.basename(p)]
+    return out

@@ -453,6 +453,14 @@ class TestStructural:
         assert any("unreadable" in e for e in errors)
         assert any("unused import" in e for e in errors)
 
+    def test_duplicate_toplevel_decl_across_files(self, tmp_path):
+        from operator_forge.gocheck import check_structure
+
+        (tmp_path / "a.go").write_text("package p\n\nvar Version = \"1\"\n")
+        (tmp_path / "b.go").write_text("package p\n\nconst Version = \"2\"\n")
+        errors = check_structure(str(tmp_path))
+        assert any("duplicate declaration 'Version'" in e for e in errors)
+
     def test_vet_reports_unused_import(self, tmp_path):
         from operator_forge.gocheck import check_project
 

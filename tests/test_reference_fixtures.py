@@ -123,3 +123,46 @@ class TestFunctionalCases:
             apis = os.path.join(out, "apis")
             groups = [d for d in os.listdir(apis) if not d.startswith(".")]
             assert len(groups) >= 2, groups
+
+        # Every generated sample must satisfy its own CRD schema.
+        from operator_forge.workload.crdschema import validate_cr
+        import yaml as pyyaml
+
+        samples_dir = os.path.join(out, "config", "samples")
+        samples = [
+            os.path.join(samples_dir, f)
+            for f in sorted(os.listdir(samples_dir))
+            if f != "kustomization.yaml"
+        ]
+        assert samples
+        for path in samples:
+            sample = pyyaml.safe_load(open(path))
+            errs = validate_cr(out, sample)
+            assert not errs, f"{path}: {errs}"
+
+    @pytest.mark.parametrize("case", ["standalone", "edge-standalone"])
+    def test_standalone_samples_preview(self, tmp_path, case):
+        """The generated sample CR renders child manifests through
+        preview — the reference needs a compiled companion CLI for this."""
+        from operator_forge.workload.preview import preview
+        import yaml as pyyaml
+
+        config = os.path.join(CASES, case, ".workloadConfig", "workload.yaml")
+        out = str(tmp_path / "project")
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/acme-cnp-mgr", "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+        samples_dir = os.path.join(out, "config", "samples")
+        (sample,) = [
+            os.path.join(samples_dir, f)
+            for f in sorted(os.listdir(samples_dir))
+            if f != "kustomization.yaml"
+        ]
+        rendered = preview(config, sample)
+        docs = [d for d in pyyaml.safe_load_all(rendered) if d]
+        assert docs and all(d.get("kind") for d in docs)
