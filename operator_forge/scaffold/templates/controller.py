@@ -41,7 +41,9 @@ def controller_file(view: WorkloadView) -> FileSpec:
     # -- NewRequest -----------------------------------------------------
     if is_component:
         new_request = f'''// NewRequest builds a reconciliation request, fetching the workload and its
-// collection.
+// collection.  On ErrCollectionNotFound the partially-built request (with
+// the workload set) is returned alongside the error so Reconcile can
+// release a deleting workload whose collection is gone.
 func (r *{kind}Reconciler) NewRequest(ctx context.Context, request ctrl.Request) (*orchestrate.Request, error) {{
 \tworkload := &{alias}.{kind}{{}}
 
@@ -49,17 +51,20 @@ func (r *{kind}Reconciler) NewRequest(ctx context.Context, request ctrl.Request)
 \t\treturn nil, err
 \t}}
 
-\tcollection, err := r.GetCollection(ctx, workload)
-\tif err != nil {{
-\t\treturn nil, err
+\treq := &orchestrate.Request{{
+\t\tContext:  ctx,
+\t\tWorkload: workload,
+\t\tLog:      r.Log.WithValues("{view.kind_lower}", request.NamespacedName),
 \t}}
 
-\treturn &orchestrate.Request{{
-\t\tContext:    ctx,
-\t\tWorkload:   workload,
-\t\tCollection: collection,
-\t\tLog:        r.Log.WithValues("{view.kind_lower}", request.NamespacedName),
-\t}}, nil
+\tcollection, err := r.GetCollection(ctx, workload)
+\tif err != nil {{
+\t\treturn req, err
+\t}}
+
+\treq.Collection = collection
+
+\treturn req, nil
 }}
 
 // GetCollection returns the collection for a component workload: the
@@ -138,6 +143,14 @@ func (r *{kind}Reconciler) requestsForAll(object client.Object) []reconcile.Requ
 }}
 '''
         collection_requeue = f'''\t\tif errors.Is(err, orchestrate.ErrCollectionNotFound) {{
+\t\t\tif req != nil && req.Deleting() {{
+\t\t\t\t// teardown needs only the static child-kind list and the
+\t\t\t\t// owner annotation, not the collection: run the delete
+\t\t\t\t// phases so children are torn down and the finalizer
+\t\t\t\t// released instead of blocking deletion forever
+\t\t\t\treturn r.Phases.HandleExecution(r, req)
+\t\t\t}}
+
 \t\t\treturn ctrl.Result{{Requeue: true}}, nil
 \t\t}}
 
@@ -187,6 +200,7 @@ func (r *{kind}Reconciler) NewRequest(ctx context.Context, request ctrl.Request)
         '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
         '\t"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"\n'
         '\t"k8s.io/apimachinery/pkg/runtime"\n'
+        '\t"k8s.io/apimachinery/pkg/runtime/schema"\n'
         f"{component_only_imports}"
         '\t"k8s.io/client-go/tools/record"\n'
         '\tctrl "sigs.k8s.io/controller-runtime"\n'
@@ -289,6 +303,14 @@ func (r *{kind}Reconciler) GetResources(req *orchestrate.Request) ([]client.Obje
 // CheckDependencies runs the user-owned dependency hook.
 func (r *{kind}Reconciler) CheckDependencies(req *orchestrate.Request) (bool, error) {{
 \treturn dependencies.{kind}CheckReady(r, req)
+}}
+
+// GetChildGVKs returns the static set of child resource kinds this
+// workload can create, fixed at code generation.  Teardown sweeps these
+// kinds for owner-annotated children, so deletion never depends on a
+// successful render.
+func (r *{kind}Reconciler) GetChildGVKs() []schema.GroupVersionKind {{
+\treturn {pkg}.ChildResourceGVKs
 }}
 
 // EnsureWatch begins watching a child resource kind exactly once so drift on

@@ -71,6 +71,18 @@ def resources_file(view: WorkloadView) -> FileSpec:
     create_entries = "\n".join(f"\t{name}," for name in create_names)
     init_entries = "\n".join(f"\t{name}," for name in init_names)
 
+    seen_gvks = set()
+    gvk_entries = []
+    for child in view.workload.get_manifests().all_child_resources():
+        key = (child.group, child.version, child.kind)
+        if key not in seen_gvks:
+            seen_gvks.add(key)
+            gvk_entries.append(
+                f'\t{{Group: "{child.group}", Version: "{child.version}", '
+                f'Kind: "{child.kind}"}},'
+            )
+    gvk_block = "\n".join(gvk_entries)
+
     cli_block = ""
     cli_imports = ""
     if view.has_cli:
@@ -133,12 +145,22 @@ func GenerateForCLI({cli_sig}) ([]client.Object, error) {{
     content = f'''package {pkg}
 
 import (
-{cli_imports}\t"sigs.k8s.io/controller-runtime/pkg/client"
+{cli_imports}\t"k8s.io/apimachinery/pkg/runtime/schema"
+\t"sigs.k8s.io/controller-runtime/pkg/client"
 
 \t"{view.config.repo}/pkg/orchestrate"
 
 \t{alias} "{view.api_types_import}"
 {_collection_import(view)})
+
+// ChildResourceGVKs is the static set of child resource kinds this
+// workload's manifests define.  It is fixed at code generation —
+// independent of include/exclude markers and spec contents — so teardown
+// can enumerate annotated children even when the current spec renders none
+// of a kind, or when a component's collection is gone.
+var ChildResourceGVKs = []schema.GroupVersionKind{{
+{gvk_block}
+}}
 
 // sample{kind} is a sample manifest containing all configurable fields.
 const sample{kind} = `{sample_all}`

@@ -168,6 +168,12 @@ def for_workloads(*workloads) -> Rules:
                  verbs=list(DEFAULT_RESOURCE_VERBS)),
             Rule(group=group, resource=f"{resource}/status",
                  verbs=list(DEFAULT_STATUS_VERBS)),
+            # the orchestrate runtime registers a teardown finalizer on the
+            # workload; clusters running the OwnerReferencesPermission-
+            # Enforcement admission plugin require explicit permission on
+            # the finalizers subresource for that update
+            Rule(group=group, resource=f"{resource}/finalizers",
+                 verbs=["update"]),
         )
     return rules
 

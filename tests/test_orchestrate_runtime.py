@@ -1,0 +1,161 @@
+"""Tests for the generated reconciliation runtime (``pkg/orchestrate``),
+focused on the finalizer-based delete path: children that owner-reference
+garbage collection cannot cover (cross-namespace children, cluster-scoped
+children of a namespaced parent) must be explicitly torn down on parent
+delete (reference: phases.RegisterDeleteHooks at
+internal/plugins/workload/v1/scaffolds/templates/controller/controller.go:192).
+"""
+
+import os
+import re
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.scaffold.templates.orchestrate import orchestrate_files
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _rendered():
+    return {
+        spec.path: spec.content
+        for spec in orchestrate_files("github.com/acme/test")
+    }
+
+
+class TestPhaseRegistration:
+    def test_finalizer_phase_runs_first(self):
+        handlers = _rendered()["pkg/orchestrate/handlers.go"]
+        names = re.findall(r'Name:\s+"([\w-]+)"', handlers)
+        assert names[0] == "Register-Finalizer"
+
+    def test_teardown_precedes_deletion_complete(self):
+        handlers = _rendered()["pkg/orchestrate/handlers.go"]
+        names = re.findall(r'Name:\s+"([\w-]+)"', handlers)
+        assert "Teardown-Children" in names
+        assert names.index("Teardown-Children") < names.index(
+            "Deletion-Complete"
+        )
+
+    def test_delete_phases_target_delete_event(self):
+        handlers = _rendered()["pkg/orchestrate/handlers.go"]
+        for phase in ("Teardown-Children", "Deletion-Complete"):
+            block = handlers.split(f'Name:         "{phase}"')[1]
+            events = block.split("Events:")[1].split("\n")[0]
+            assert "DeleteEvent" in events
+
+
+class TestFinalizerRuntime:
+    def test_finalizer_registered_and_removed(self):
+        handlers = _rendered()["pkg/orchestrate/handlers.go"]
+        assert "AddFinalizer(req.Workload, Finalizer(req.Workload))" in (
+            handlers
+        )
+        assert "RemoveFinalizer(req.Workload, Finalizer(req.Workload))" in (
+            handlers
+        )
+
+    def test_teardown_only_deletes_owned_children(self):
+        handlers = _rendered()["pkg/orchestrate/handlers.go"]
+        teardown = handlers.split("func TeardownChildrenHandler")[1].split(
+            "\nfunc "
+        )[0]
+        # sweeps the static child-kind list (never the current render) and
+        # only deletes objects stamped with this workload's owner annotation
+        assert "r.GetChildGVKs()" in teardown
+        assert "GetResources" not in teardown
+        assert "if !OwnedBy(req.Workload, live) {" in teardown
+        # requeues until every explicitly-owned child is gone
+        assert "return remaining == 0, nil" in teardown
+
+    def test_stale_render_unit_test_emitted(self):
+        test_file = _rendered()["pkg/orchestrate/orchestrate_test.go"]
+        assert "func TestTeardownStaleRenderChild" in test_file
+
+    def test_apply_marks_unownable_children(self):
+        resources = _rendered()["pkg/orchestrate/resources.go"]
+        assert "MarkOwned(req.Workload, resource)" in resources
+
+    def test_delete_pass_tolerates_pruned_parent(self):
+        phases = _rendered()["pkg/orchestrate/phases.go"]
+        assert "event == DeleteEvent && apierrs.IsNotFound(err)" in phases
+
+    def test_unit_tests_cover_verdict_cases(self):
+        test_file = _rendered()["pkg/orchestrate/orchestrate_test.go"]
+        assert "func TestTeardownCrossNamespaceChild" in test_file
+        assert "func TestTeardownClusterScopedParent" in test_file
+        assert "func TestTeardownSkipsUnownedChild" in test_file
+
+
+class TestGeneratedProjectWiring:
+    def test_finalizers_rbac_emitted(self, tmp_path):
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        out = str(tmp_path / "project")
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/webstore",
+                         "--output-dir", out]) == 0
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out]) == 0
+
+        controllers = []
+        for dirpath, _, files in os.walk(os.path.join(out, "controllers")):
+            controllers += [
+                os.path.join(dirpath, f)
+                for f in files
+                if f.endswith("_controller.go")
+            ]
+        assert controllers
+        for path in controllers:
+            with open(path, encoding="utf-8") as handle:
+                content = handle.read()
+            assert re.search(
+                r"\+kubebuilder:rbac:groups=[\w.]+,"
+                r"resources=\w+/finalizers,verbs=update",
+                content,
+            ), f"missing finalizers rbac marker in {path}"
+
+        role = os.path.join(out, "config", "rbac", "role.yaml")
+        with open(role, encoding="utf-8") as handle:
+            assert "/finalizers" in handle.read()
+
+    def test_static_child_gvks_and_orphaned_delete(self, tmp_path):
+        """Teardown scope is codegen-static (ChildResourceGVKs) and a
+        deleting component whose collection is gone still runs the delete
+        phases instead of requeueing forever."""
+        config = os.path.join(FIXTURES, "collection", "workload.yaml")
+        out = str(tmp_path / "project")
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/platform",
+                         "--output-dir", out]) == 0
+        assert cli_main(["create", "api", "--workload-config", config,
+                         "--output-dir", out]) == 0
+
+        gvk_lists = []
+        component_controllers = []
+        for dirpath, _, files in os.walk(out):
+            for f in files:
+                path = os.path.join(dirpath, f)
+                if f == "resources.go" and "orchestrate" not in dirpath:
+                    with open(path, encoding="utf-8") as handle:
+                        content = handle.read()
+                    assert "var ChildResourceGVKs" in content, path
+                    gvk_lists.append(content)
+                if f.endswith("_controller.go"):
+                    with open(path, encoding="utf-8") as handle:
+                        content = handle.read()
+                    assert "func (r *" in content
+                    assert "GetChildGVKs()" in content, path
+                    if "ErrCollectionNotFound" in content:
+                        component_controllers.append(content)
+        assert gvk_lists
+        # at least one child GVK entry is emitted with a concrete kind
+        assert any(
+            re.search(r'\{Group: "[^"]*", Version: "v\w*", Kind: "\w+"\}', c)
+            for c in gvk_lists
+        )
+        # component controllers release deleting workloads via the phase
+        # machine even when the collection is gone
+        assert component_controllers
+        for content in component_controllers:
+            branch = content.split("ErrCollectionNotFound")[1]
+            assert "req.Deleting()" in branch.split("Requeue: true")[0]
+            assert "HandleExecution" in branch.split("Requeue: true")[0]
