@@ -85,6 +85,7 @@ def cmd_init(args: argparse.Namespace) -> int:
         ),
         cli_root_command_name=workload.companion_root_cmd.name,
         cli_root_command_description=workload.companion_root_cmd.description,
+        component_config=args.component_config,
     )
 
     os.makedirs(args.output_dir, exist_ok=True)
@@ -362,6 +363,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_init.add_argument("--output-dir", default=".")
     p_init.add_argument("--project-license", default="")
     p_init.add_argument("--source-header-license", default="")
+    p_init.add_argument(
+        "--component-config", action="store_true",
+        help="generated main.go loads manager options from a "
+             "component-config file (--config flag) instead of "
+             "individual flags",
+    )
     p_init.set_defaults(func=cmd_init)
 
     p_create = sub.add_parser("create", help="create resources in the project")

@@ -58,6 +58,7 @@ def api_files(
 
         if with_controllers:
             specs.append(controller_tpl.controller_file(view))
+            specs.append(controller_tpl.reconcile_test_file(view))
             if view.group not in groups_done:
                 groups_done.add(view.group)
                 specs.append(

@@ -111,18 +111,26 @@ func (r *{kind}Reconciler) GetCollection(
 \tresources, err := {pkg}.Generate(*workload, *collection)'''
         mutate_call = f"mutate.{kind}Mutate(resource, workload, collection)"
         collection_watch = f'''
-\t// watch the collection so components reconcile on collection changes
+\t// watch the collection kind, update-only, enqueueing just the
+\t// components the changed collection affects
 \tif err := c.Watch(
 \t\t&source.Kind{{Type: &{coll.api_import_alias}.{coll.kind}{{}}}},
-\t\thandler.EnqueueRequestsFromMapFunc(r.requestsForAll),
+\t\thandler.EnqueueRequestsFromMapFunc(r.requestsForCollection),
+\t\torchestrate.CollectionPredicates(),
 \t); err != nil {{
 \t\treturn err
 \t}}
 '''
         requests_for_all = f'''
-// requestsForAll enqueues every {kind} in the cluster (used when the
-// collection changes, reference EnqueueRequestOnCollectionChange).
-func (r *{kind}Reconciler) requestsForAll(object client.Object) []reconcile.Request {{
+// requestsForCollection enqueues the components a collection change
+// affects: those referencing it explicitly via spec.collection, and those
+// with no explicit reference (they resolve the cluster's singleton
+// collection, so any collection change may concern them).  This replaces
+// the reference's per-request dynamic watch
+// (EnqueueRequestOnCollectionChange, controller.go:286-340) with one
+// static watch filtered per component — same targeting, without unbounded
+// watch registration.
+func (r *{kind}Reconciler) requestsForCollection(object client.Object) []reconcile.Request {{
 \tvar list {alias}.{kind}List
 
 \tif err := r.List(context.Background(), &list); err != nil {{
@@ -131,12 +139,26 @@ func (r *{kind}Reconciler) requestsForAll(object client.Object) []reconcile.Requ
 \t\treturn nil
 \t}}
 
-\trequests := make([]reconcile.Request, len(list.Items))
+\trequests := []reconcile.Request{{}}
+
 \tfor i := range list.Items {{
-\t\trequests[i] = reconcile.Request{{NamespacedName: types.NamespacedName{{
-\t\t\tName:      list.Items[i].GetName(),
-\t\t\tNamespace: list.Items[i].GetNamespace(),
-\t\t}}}}
+\t\tcomponent := &list.Items[i]
+
+\t\tname := component.Spec.Collection.Name
+\t\tnamespace := component.Spec.Collection.Namespace
+
+\t\tif name != "" && name != object.GetName() {{
+\t\t\tcontinue
+\t\t}}
+
+\t\tif name != "" && namespace != "" && namespace != object.GetNamespace() {{
+\t\t\tcontinue
+\t\t}}
+
+\t\trequests = append(requests, reconcile.Request{{NamespacedName: types.NamespacedName{{
+\t\t\tName:      component.GetName(),
+\t\t\tNamespace: component.GetNamespace(),
+\t\t}}}})
 \t}}
 
 \treturn requests
@@ -362,9 +384,13 @@ func (r *{kind}Reconciler) GetScheme() *runtime.Scheme {{
 \treturn r.Scheme
 }}
 {requests_for_all}
-// SetupWithManager registers the reconciler with the manager.
+// SetupWithManager registers the reconciler with the manager.  The event
+// filter skips status-only updates on the primary workload so the
+// controller's own status writes do not re-trigger reconciliation
+// (reference controller.go:426-440).
 func (r *{kind}Reconciler) SetupWithManager(mgr ctrl.Manager) error {{
 \tc, err := ctrl.NewControllerManagedBy(mgr).
+\t\tWithEventFilter(orchestrate.WorkloadPredicates()).
 \t\tFor(&{alias}.{kind}{{}}).
 \t\tBuild(r)
 \tif err != nil {{
@@ -377,6 +403,135 @@ func (r *{kind}Reconciler) SetupWithManager(mgr ctrl.Manager) error {{
 }}
 '''
     return FileSpec(path=view.controller_file, content=content)
+
+
+def reconcile_test_file(view: WorkloadView) -> FileSpec:
+    """A real envtest case per kind: create the sample CR and require the
+    reconciler to register its finalizer, run its create phases, and record
+    phase conditions.  Goes beyond the reference, whose scaffolded suite
+    test is harness-only (templates/controller/controller_suitetest.go)."""
+    kind = view.kind
+    alias = view.api_import_alias
+    pkg = view.package_name
+    coll = view.collection
+    is_component = view.is_component() and coll is not None
+
+    collection_setup = ""
+    extra_imports = ""
+    apierrs_import = ""
+    if is_component:
+        apierrs_import = '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
+        if coll.api_types_import != view.api_types_import:
+            extra_imports += (
+                f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+            )
+        extra_imports += f'\t{coll.package_name} "{coll.resources_import}"\n'
+        coll_ns_default = ""
+        if not coll.workload.is_cluster_scoped():
+            coll_ns_default = '''
+\tif collection.GetNamespace() == "" {
+\t\tcollection.SetNamespace("default")
+\t}
+'''
+        collection_setup = f'''\t// components resolve their collection before rendering; create it
+\t// first (tolerating an earlier test of this group having done so)
+\tif err := {coll.api_import_alias}.AddToScheme(scheme.Scheme); err != nil {{
+\t\tt.Fatalf("unable to register collection scheme: %v", err)
+\t}}
+
+\tcollection := &{coll.api_import_alias}.{coll.kind}{{}}
+\tif err := sigsyaml.Unmarshal([]byte({coll.package_name}.Sample(false)), collection); err != nil {{
+\t\tt.Fatalf("unable to decode collection sample: %v", err)
+\t}}
+{coll_ns_default}
+\tif err := k8sClient.Create(ctx, collection); err != nil && !apierrs.IsAlreadyExists(err) {{
+\t\tt.Fatalf("unable to create collection: %v", err)
+\t}}
+
+'''
+
+    ns_default = ""
+    if not view.workload.is_cluster_scoped():
+        ns_default = '''
+\tif workload.GetNamespace() == "" {
+\t\tworkload.SetNamespace("default")
+\t}
+'''
+
+    content = f'''package {view.group}
+
+import (
+\t"context"
+\t"testing"
+\t"time"
+
+{apierrs_import}\t"k8s.io/client-go/kubernetes/scheme"
+\tctrl "sigs.k8s.io/controller-runtime"
+\t"sigs.k8s.io/controller-runtime/pkg/client"
+\tsigsyaml "sigs.k8s.io/yaml"
+
+\t{alias} "{view.api_types_import}"
+\t{pkg} "{view.resources_import}"
+{extra_imports})
+
+// Test{kind}Reconcile drives the {kind} reconciler against envtest: the
+// sample CR is created and the reconciler must register its teardown
+// finalizer, run its create phases, and record phase conditions.  Child
+// readiness (and therefore status.created) is deliberately not asserted:
+// envtest runs no workload controllers, so children such as Deployments
+// never report ready.
+func Test{kind}Reconcile(t *testing.T) {{
+\tctx, cancel := context.WithCancel(context.Background())
+\tdefer cancel()
+
+\tmgr, err := ctrl.NewManager(cfg, ctrl.Options{{
+\t\tScheme:             scheme.Scheme,
+\t\tMetricsBindAddress: "0",
+\t}})
+\tif err != nil {{
+\t\tt.Fatalf("unable to create manager: %v", err)
+\t}}
+
+\tif err := New{kind}Reconciler(mgr).SetupWithManager(mgr); err != nil {{
+\t\tt.Fatalf("unable to set up reconciler: %v", err)
+\t}}
+
+\tgo func() {{
+\t\t_ = mgr.Start(ctx)
+\t}}()
+
+{collection_setup}\tworkload := &{alias}.{kind}{{}}
+\tif err := sigsyaml.Unmarshal([]byte({pkg}.Sample(false)), workload); err != nil {{
+\t\tt.Fatalf("unable to decode sample: %v", err)
+\t}}
+{ns_default}
+\tif err := k8sClient.Create(ctx, workload); err != nil {{
+\t\tt.Fatalf("unable to create workload: %v", err)
+\t}}
+
+\tdeadline := time.Now().Add(90 * time.Second)
+
+\tfor {{
+\t\tlive := &{alias}.{kind}{{}}
+
+\t\terr := k8sClient.Get(ctx, client.ObjectKeyFromObject(workload), live)
+\t\tif err == nil && len(live.GetFinalizers()) > 0 && len(live.Status.Conditions) > 0 {{
+\t\t\tbreak
+\t\t}}
+
+\t\tif time.Now().After(deadline) {{
+\t\t\tt.Fatalf("timed out waiting for the reconciler to act (last get error: %v)", err)
+\t\t}}
+
+\t\ttime.Sleep(250 * time.Millisecond)
+\t}}
+}}
+'''
+    return FileSpec(
+        path=f"controllers/{view.group}/"
+        f"{to_file_name(view.kind_lower)}_controller_test.go",
+        content=content,
+    )
 
 
 def suite_test_file(view: WorkloadView, kinds_in_group: list[str]) -> FileSpec:

@@ -9,6 +9,29 @@ from __future__ import annotations
 
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec, IfExists
+from .project import leader_election_id
+
+
+def _controller_manager_config(config: ProjectConfig) -> FileSpec:
+    """The ControllerManagerConfig file mounted into --component-config
+    deployments; must set the same probe/metrics addresses the flag-driven
+    variant defaults to, since the Deployment probes target them."""
+    return FileSpec(
+        path="config/manager/controller_manager_config.yaml",
+        content=f"""apiVersion: controller-runtime.sigs.k8s.io/v1alpha1
+kind: ControllerManagerConfig
+health:
+  healthProbeBindAddress: :8081
+metrics:
+  bindAddress: :8080
+webhook:
+  port: 9443
+leaderElection:
+  leaderElect: true
+  resourceName: {leader_election_id(config)}
+""",
+        add_boilerplate=False,
+    )
 
 
 def crd_kustomization(views: list[WorkloadView]) -> FileSpec:
@@ -41,7 +64,41 @@ def samples_kustomization(views: list[WorkloadView]) -> FileSpec:
 def default_tree(config: ProjectConfig) -> list[FileSpec]:
     project = config.project_name
     namespace = f"{project}-system"
-    return [
+
+    # --component-config projects read manager options from a mounted
+    # ControllerManagerConfig file instead of flags (reference
+    # templates/main.go:236-257); the deployment must agree with main.go on
+    # which of the two is in use or the manager exits on an unknown flag
+    if config.component_config:
+        manager_args = "- --config=/controller_manager_config.yaml"
+        manager_mounts = """
+        volumeMounts:
+        - name: manager-config
+          mountPath: /controller_manager_config.yaml
+          subPath: controller_manager_config.yaml"""
+        manager_volumes = """
+      volumes:
+      - name: manager-config
+        configMap:
+          name: manager-config"""
+        manager_kustomization_extra = """
+generatorOptions:
+  disableNameSuffixHash: true
+
+configMapGenerator:
+- name: manager-config
+  files:
+  - controller_manager_config.yaml
+"""
+        component_config_files = [_controller_manager_config(config)]
+    else:
+        manager_args = "- --leader-elect"
+        manager_mounts = ""
+        manager_volumes = ""
+        manager_kustomization_extra = ""
+        component_config_files = []
+
+    return component_config_files + [
         FileSpec(
             path="config/default/kustomization.yaml",
             content=f"""# Adds namespace to all resources.
@@ -61,10 +118,10 @@ resources:
         ),
         FileSpec(
             path="config/manager/kustomization.yaml",
-            content="""resources:
+            content=f"""resources:
 - manager.yaml
 - metrics_service.yaml
-
+{manager_kustomization_extra}
 images:
 - name: controller
   newName: controller
@@ -124,7 +181,7 @@ spec:
       - command:
         - /manager
         args:
-        - --leader-elect
+        {manager_args}
         image: controller:latest
         name: manager
         securityContext:
@@ -150,9 +207,9 @@ spec:
             memory: 256Mi
           requests:
             cpu: 10m
-            memory: 64Mi
+            memory: 64Mi{manager_mounts}
       serviceAccountName: controller-manager
-      terminationGracePeriodSeconds: 10
+      terminationGracePeriodSeconds: 10{manager_volumes}
 """,
             add_boilerplate=False,
         ),

@@ -94,6 +94,51 @@ require (
 
 def main_go(config: ProjectConfig) -> FileSpec:
     election_id = leader_election_id(config)
+
+    if config.component_config:
+        # manager options come from a component-config file (reference
+        # templates/main.go:236-257, the `{{ else }}` branch of
+        # `{{ if not .ComponentConfig }}`)
+        flags_block = '''\tvar configFile string
+
+\tflag.StringVar(&configFile, "config", "",
+\t\t"The controller will load its initial configuration from this file. "+
+\t\t\t"Omit this flag to use the default configuration values. "+
+\t\t\t"Command-line flags override configuration from this file.")'''
+        manager_block = '''\tvar err error
+
+\toptions := ctrl.Options{Scheme: scheme}
+
+\tif configFile != "" {
+\t\toptions, err = options.AndFrom(ctrl.ConfigFile().AtPath(configFile))
+\t\tif err != nil {
+\t\t\tsetupLog.Error(err, "unable to load the config file")
+\t\t\tos.Exit(1)
+\t\t}
+\t}
+
+\tmgr, err := ctrl.NewManager(ctrl.GetConfigOrDie(), options)'''
+    else:
+        flags_block = '''\tvar metricsAddr string
+\tvar enableLeaderElection bool
+\tvar probeAddr string
+
+\tflag.StringVar(&metricsAddr, "metrics-bind-address", ":8080",
+\t\t"The address the metric endpoint binds to.")
+\tflag.StringVar(&probeAddr, "health-probe-bind-address", ":8081",
+\t\t"The address the probe endpoint binds to.")
+\tflag.BoolVar(&enableLeaderElection, "leader-elect", false,
+\t\t"Enable leader election for controller manager. "+
+\t\t\t"Enabling this will ensure there is only one active controller manager.")'''
+        manager_block = f'''\tmgr, err := ctrl.NewManager(ctrl.GetConfigOrDie(), ctrl.Options{{
+\t\tScheme:                 scheme,
+\t\tMetricsBindAddress:     metricsAddr,
+\t\tPort:                   9443,
+\t\tHealthProbeBindAddress: probeAddr,
+\t\tLeaderElection:         enableLeaderElection,
+\t\tLeaderElectionID:       "{election_id}",
+\t}})'''
+
     content = f'''package main
 
 import (
@@ -103,6 +148,7 @@ import (
 \t"k8s.io/apimachinery/pkg/runtime"
 \tutilruntime "k8s.io/apimachinery/pkg/util/runtime"
 \tclientgoscheme "k8s.io/client-go/kubernetes/scheme"
+\t"k8s.io/client-go/rest"
 \tctrl "sigs.k8s.io/controller-runtime"
 \t"sigs.k8s.io/controller-runtime/pkg/healthz"
 \t"sigs.k8s.io/controller-runtime/pkg/log/zap"
@@ -120,17 +166,7 @@ func init() {{
 }}
 
 func main() {{
-\tvar metricsAddr string
-\tvar enableLeaderElection bool
-\tvar probeAddr string
-
-\tflag.StringVar(&metricsAddr, "metrics-bind-address", ":8080",
-\t\t"The address the metric endpoint binds to.")
-\tflag.StringVar(&probeAddr, "health-probe-bind-address", ":8081",
-\t\t"The address the probe endpoint binds to.")
-\tflag.BoolVar(&enableLeaderElection, "leader-elect", false,
-\t\t"Enable leader election for controller manager. "+
-\t\t\t"Enabling this will ensure there is only one active controller manager.")
+{flags_block}
 
 \topts := zap.Options{{Development: true}}
 \topts.BindFlags(flag.CommandLine)
@@ -138,14 +174,15 @@ func main() {{
 
 \tctrl.SetLogger(zap.New(zap.UseFlagOptions(&opts)))
 
-\tmgr, err := ctrl.NewManager(ctrl.GetConfigOrDie(), ctrl.Options{{
-\t\tScheme:                 scheme,
-\t\tMetricsBindAddress:     metricsAddr,
-\t\tPort:                   9443,
-\t\tHealthProbeBindAddress: probeAddr,
-\t\tLeaderElection:         enableLeaderElection,
-\t\tLeaderElectionID:       "{election_id}",
-\t}})
+\t// only print a given warning the first time it is received
+\t// (reference templates/main.go:229-234)
+\trest.SetDefaultWarningHandler(
+\t\trest.NewWarningWriter(os.Stderr, rest.WarningWriterOptions{{
+\t\t\tDeduplicate: true,
+\t\t}}),
+\t)
+
+{manager_block}
 \tif err != nil {{
 \t\tsetupLog.Error(err, "unable to start manager")
 \t\tos.Exit(1)

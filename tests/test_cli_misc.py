@@ -321,3 +321,85 @@ class TestRepoScripts:
         assert result.returncode == 0, result.stdout + result.stderr
         assert "workload subcommands: platform webapp" in result.stdout
         assert "companion CLI exercise passed" in result.stdout
+
+
+class TestMainGoVariants:
+    """Dedup warning handler and the ComponentConfig manager-option branch
+    (reference templates/main.go:229-257)."""
+
+    def _init(self, tmp_path, extra_flags=()):
+        fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+        out = str(tmp_path / "project")
+        config = os.path.join(fixtures, "standalone", "workload.yaml")
+        assert cli_main(["init", "--workload-config", config,
+                         "--repo", "github.com/acme/bookstore-operator",
+                         "--output-dir", out, *extra_flags]) == 0
+        return config, out
+
+    def _read_main(self, out):
+        with open(os.path.join(out, "main.go"), encoding="utf-8") as handle:
+            return handle.read()
+
+    def test_default_main_has_dedup_warning_writer(self, tmp_path):
+        _, out = self._init(tmp_path)
+        main = self._read_main(out)
+        assert "rest.NewWarningWriter(os.Stderr, rest.WarningWriterOptions{" in main
+        assert "Deduplicate: true," in main
+        # flag-driven manager options remain the default
+        assert "metrics-bind-address" in main
+        assert "LeaderElectionID" in main
+
+    def test_component_config_branch(self, tmp_path):
+        config, out = self._init(tmp_path, ("--component-config",))
+        main = self._read_main(out)
+        assert 'flag.StringVar(&configFile, "config", "",' in main
+        assert "ctrl.ConfigFile().AtPath(configFile)" in main
+        assert "metrics-bind-address" not in main
+        # dedup warning writer emitted in both variants
+        assert "Deduplicate: true," in main
+        # persisted in PROJECT so re-scaffolds keep the branch
+        with open(os.path.join(out, "PROJECT"), encoding="utf-8") as handle:
+            assert "componentConfig: true" in handle.read()
+
+    def test_component_config_deployment_wiring(self, tmp_path):
+        """The deployment must agree with main.go on flags vs config file:
+        it passes --config, mounts the generated ControllerManagerConfig,
+        and never passes the now-undefined --leader-elect."""
+        _, out = self._init(tmp_path, ("--component-config",))
+        manager_dir = os.path.join(out, "config", "manager")
+        with open(os.path.join(manager_dir, "manager.yaml"),
+                  encoding="utf-8") as handle:
+            deployment = handle.read()
+        assert "--config=/controller_manager_config.yaml" in deployment
+        assert "--leader-elect" not in deployment
+        assert "subPath: controller_manager_config.yaml" in deployment
+        assert "name: manager-config" in deployment
+        with open(os.path.join(manager_dir, "kustomization.yaml"),
+                  encoding="utf-8") as handle:
+            kustomization = handle.read()
+        assert "configMapGenerator" in kustomization
+        assert "disableNameSuffixHash: true" in kustomization
+        cfg_file = os.path.join(manager_dir, "controller_manager_config.yaml")
+        with open(cfg_file, encoding="utf-8") as handle:
+            cmc = pyyaml.safe_load(handle)
+        assert cmc["kind"] == "ControllerManagerConfig"
+        # probes in the deployment target :8081; the config must bind it
+        assert cmc["health"]["healthProbeBindAddress"] == ":8081"
+        assert cmc["leaderElection"]["leaderElect"] is True
+
+    def test_flag_driven_deployment_keeps_leader_elect(self, tmp_path):
+        _, out = self._init(tmp_path)
+        with open(os.path.join(out, "config", "manager", "manager.yaml"),
+                  encoding="utf-8") as handle:
+            deployment = handle.read()
+        assert "--leader-elect" in deployment
+        assert "--config=" not in deployment
+        assert not os.path.exists(os.path.join(
+            out, "config", "manager", "controller_manager_config.yaml"))
+
+    def test_component_config_project_is_vet_clean(self, tmp_path):
+        config, out = self._init(tmp_path, ("--component-config",))
+        assert cli_main(["create", "api", "--workload-config", config,
+                        "--output-dir", out]) == 0
+        from operator_forge.gocheck import check_project
+        assert check_project(out) == []

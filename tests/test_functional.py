@@ -157,6 +157,15 @@ class TestStandaloneProject:
             os.path.join(project, "controllers/shop/suite_test.go")
         )
 
+    def test_envtest_reconcile_case_emitted(self, project):
+        """Beyond the reference: `make test` exercises the reconciler with
+        a real envtest case per kind, not just the harness."""
+        test = _read(project, "controllers/shop/bookstore_controller_test.go")
+        assert "func TestBookStoreReconcile(t *testing.T)" in test
+        assert "NewBookStoreReconciler(mgr).SetupWithManager(mgr)" in test
+        assert "k8sClient.Create(ctx, workload)" in test
+        assert "len(live.GetFinalizers()) > 0" in test
+
     def test_hooks_are_skip_files(self, project):
         mutate_path = os.path.join(project, "internal/mutate/bookstore.go")
         assert os.path.exists(mutate_path)
@@ -266,8 +275,19 @@ class TestCollectionProject:
     def test_component_controller_watches_collection(self, project):
         ctl = _read(project, "controllers/platform/cache_controller.go")
         assert "GetCollection" in ctl
-        assert "requestsForAll" in ctl
         assert "ErrCollectionNotFound" in ctl
+        # targeted watch: update-only predicates, and the map function
+        # enqueues only components referencing the changed collection
+        # (reference EnqueueRequestOnCollectionChange, controller.go:286-340)
+        assert "requestsForCollection" in ctl
+        assert "orchestrate.CollectionPredicates()" in ctl
+        assert "component.Spec.Collection.Name" in ctl
+
+    def test_workload_predicates_on_primary_watch(self, project):
+        for path in ("controllers/platform/cache_controller.go",
+                     "controllers/platform/platform_controller.go"):
+            ctl = _read(project, path)
+            assert "WithEventFilter(orchestrate.WorkloadPredicates())" in ctl
 
     def test_cluster_scoped_collection_crd(self, project):
         crd = pyyaml.safe_load(
