@@ -66,6 +66,11 @@ class TestFinalizerRuntime:
         assert "if !OwnedBy(req.Workload, live) {" in teardown
         # requeues until every explicitly-owned child is gone
         assert "return remaining == 0, nil" in teardown
+        # cluster-scoped parents own everything via owner references;
+        # the sweep is skipped outright
+        assert 'if req.Workload.GetNamespace() == ""' in teardown
+        # listing is server-side filtered by the owner label
+        assert "client.MatchingLabels{labelKey: labelValue}" in teardown
 
     def test_stale_render_unit_test_emitted(self):
         test_file = _rendered()["pkg/orchestrate/orchestrate_test.go"]
