@@ -422,5 +422,13 @@ kubectl apply -f config/samples/
 make test       # unit + envtest suites
 make test-e2e   # e2e suite against the current kubeconfig context
 ```
+
+## Notes
+
+Source manifests using YAML anchors/aliases are expanded during
+generation — each alias becomes an independent copy, and merge keys
+(`<<:`) are applied with standard YAML merge semantics.  The generated Go
+object code and rendered child manifests therefore carry the expanded
+form; the data is identical, only the sharing notation is gone.
 """
     return FileSpec(path="README.md", content=content, add_boilerplate=False)

@@ -8,6 +8,12 @@ item that starts on the relevant line.  This reproduces the association
 behavior the reference gets from gopkg.in/yaml.v3 node comments
 (internal/markers/inspect/yaml.go:62-101) for the YAML shapes that occur in
 Kubernetes manifests.
+
+Anchors/aliases are deliberately expanded on load (each alias becomes an
+independent copy — code generation cannot share structure anyway) and
+merge keys (``<<:``) are applied with YAML merge semantics: explicit keys
+win, earlier merge sources win over later ones.  Round-tripped output
+carries the expanded form; the data is identical.
 """
 
 from __future__ import annotations
@@ -22,6 +28,9 @@ from .model import Document, MapEntry, Mapping, Scalar, SeqItem, Sequence
 
 class YamlDocError(Exception):
     """Raised when YAML cannot be loaded into the document model."""
+
+
+_MERGE_TAG = "tag:yaml.org,2002:merge"
 
 
 # An element that can own comments: a MapEntry or SeqItem plus its position.
@@ -93,12 +102,7 @@ class _TreeBuilder:
                 line=node.start_mark.line,
                 col=node.start_mark.column,
             )
-            for key_node, value_node in node.value:
-                if not isinstance(key_node, yaml.ScalarNode):
-                    raise YamlDocError(
-                        "non-scalar mapping keys are not supported "
-                        f"(line {key_node.start_mark.line + 1})"
-                    )
+            for key_node, value_node in self._flattened_entries(node):
                 entry = MapEntry(
                     key=self._scalar(key_node),
                     value=self.build(value_node, depth + 1),
@@ -122,6 +126,68 @@ class _TreeBuilder:
                 )
             return seq
         raise YamlDocError(f"unsupported YAML node type: {type(node)!r}")
+
+    def _flattened_entries(self, node: yaml.MappingNode):
+        """The key/value pairs of a mapping with merge keys (``<<``)
+        TRANSITIVELY expanded, in YAML merge precedence: explicit keys
+        win, earlier merge sources win over later ones (and over their
+        own nested merges)."""
+        seen: set = set()
+        visited_nodes: set = set()
+        entries: list = []
+
+        def visit(mapping_node: yaml.MappingNode) -> None:
+            if id(mapping_node) in visited_nodes:
+                raise YamlDocError(
+                    "cyclic merge-key reference "
+                    f"(line {mapping_node.start_mark.line + 1})"
+                )
+            visited_nodes.add(id(mapping_node))
+
+            merge_values = []
+            for key_node, value_node in mapping_node.value:
+                if not isinstance(key_node, yaml.ScalarNode):
+                    raise YamlDocError(
+                        "non-scalar mapping keys are not supported "
+                        f"(line {key_node.start_mark.line + 1})"
+                    )
+                if key_node.tag == _MERGE_TAG:
+                    merge_values.append(value_node)
+                    continue
+                if key_node.value in seen:
+                    continue
+                seen.add(key_node.value)
+                entries.append((key_node, value_node))
+
+            for merge_value in merge_values:
+                for source in self._merge_sources(merge_value):
+                    visit(source)
+
+            visited_nodes.discard(id(mapping_node))
+
+        visit(node)
+        return entries
+
+    @staticmethod
+    def _merge_sources(value_node: yaml.Node) -> list[yaml.MappingNode]:
+        """The mapping(s) a merge key pulls in: a single aliased mapping or
+        a sequence of them."""
+        if isinstance(value_node, yaml.MappingNode):
+            return [value_node]
+        if isinstance(value_node, yaml.SequenceNode):
+            sources = []
+            for child in value_node.value:
+                if not isinstance(child, yaml.MappingNode):
+                    raise YamlDocError(
+                        "merge key sources must be mappings "
+                        f"(line {child.start_mark.line + 1})"
+                    )
+                sources.append(child)
+            return sources
+        raise YamlDocError(
+            "merge key value must be a mapping or list of mappings "
+            f"(line {value_node.start_mark.line + 1})"
+        )
 
     def _scalar(self, node: yaml.ScalarNode) -> Scalar:
         start = node.start_mark

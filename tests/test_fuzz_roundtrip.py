@@ -62,3 +62,37 @@ class TestFuzzRoundtrip:
         out = emit_documents(docs)
         reparsed = list(pyyaml.safe_load_all(out))
         assert reparsed == datas
+
+
+class TestAnchorMergeFuzz:
+    """Anchored/aliased/merged/folded inputs: the model must agree with
+    PyYAML's safe_load (which applies YAML merge semantics) and survive
+    the load -> emit -> load round trip with identical data."""
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_anchored_input_matches_pyyaml_semantics(self, seed):
+        rng = random.Random(7000 + seed)
+        base = {
+            "".join(rng.choices(string.ascii_lowercase, k=4)): rng.randint(0, 9)
+            for _ in range(rng.randint(1, 4))
+        }
+        override_key = rng.choice(sorted(base))
+        folded_lines = [
+            "".join(rng.choices(string.ascii_lowercase, k=6))
+            for _ in range(rng.randint(1, 3))
+        ]
+        text = "base: &b\n"
+        for key, value in base.items():
+            text += f"  {key}: {value}\n"
+        text += "copy: *b\n"
+        text += f"merged:\n  <<: *b\n  {override_key}: 99\n"
+        text += "folded: >\n"
+        for line in folded_lines:
+            text += f"  {line}\n"
+
+        expected = pyyaml.safe_load(text)
+        docs = load_documents(text)
+        assert to_python(docs[0].root) == expected
+
+        out = emit_documents(docs)
+        assert to_python(load_documents(out)[0].root) == expected

@@ -57,7 +57,7 @@ class TestFinalizerRuntime:
     def test_teardown_only_deletes_owned_children(self):
         handlers = _rendered()["pkg/orchestrate/handlers.go"]
         teardown = handlers.split("func TeardownChildrenHandler")[1].split(
-            "\nfunc "
+            "\n// DeletionCompleteHandler"
         )[0]
         # sweeps the static child-kind list (never the current render) and
         # only deletes objects stamped with this workload's owner annotation
@@ -69,12 +69,33 @@ class TestFinalizerRuntime:
         # cluster-scoped parents own everything via owner references;
         # the sweep is skipped outright
         assert 'if req.Workload.GetNamespace() == ""' in teardown
-        # listing is server-side filtered by the owner label
+        # listing is server-side filtered by the owner label, with an
+        # unfiltered fallback for children stamped before the label existed
         assert "client.MatchingLabels{labelKey: labelValue}" in teardown
+        assert "if swept == 0 {" in teardown
 
     def test_stale_render_unit_test_emitted(self):
         test_file = _rendered()["pkg/orchestrate/orchestrate_test.go"]
         assert "func TestTeardownStaleRenderChild" in test_file
+
+
+class TestReadinessTable:
+    def test_every_special_cased_kind_has_table_coverage(self):
+        """Each kind ready.go special-cases must appear in the emitted
+        readiness table test (VERDICT round-1 item 8)."""
+        rendered = _rendered()
+        ready = rendered["pkg/orchestrate/ready.go"]
+        table = rendered["pkg/orchestrate/ready_test.go"]
+        kinds = re.findall(r'case "(\w+)":', ready)
+        assert kinds, "ready.go lost its kind dispatch"
+        for kind in kinds:
+            assert f'"{kind}"' in table, (
+                f"readiness table test does not cover {kind}"
+            )
+
+    def test_absent_child_not_ready(self):
+        table = _rendered()["pkg/orchestrate/ready_test.go"]
+        assert "func TestResourceIsReadyAbsentObject" in table
 
     def test_apply_marks_unownable_children(self):
         resources = _rendered()["pkg/orchestrate/resources.go"]
