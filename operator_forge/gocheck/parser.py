@@ -85,6 +85,14 @@ class _Parser:
         self.func_depth = 0
         self.block_depth = 0
         self._func_stack: list[dict] = []
+        # Type-layer events (see typecheck.py): qualified references
+        # (`alias.Name`), qualified calls with argument counts, and
+        # qualified composite literals with their top-level field keys.
+        # Token indices let the checker report line/col.
+        self.qual_refs: list[tuple[int, int]] = []  # (alias tok, name tok)
+        self.qual_calls: list[tuple[int, int, int, bool]] = []
+        # (alias tok, name tok, nargs, call-site `...` spread)
+        self.qual_literals: list[tuple[int, int, list[str]]] = []
 
     # -- token plumbing ---------------------------------------------------
 
@@ -811,7 +819,11 @@ class _Parser:
         self.primary_expr()
 
     def primary_expr(self):
+        head = self.i if self.tok.kind == IDENT else None
         self.operand()
+        # a bare-identifier head may begin a qualified reference
+        pending_alias = head if (head is not None and self.i == head + 1) else None
+        qual: tuple[int, int] | None = None
         while True:
             if self.at_op("."):
                 self.advance()
@@ -822,11 +834,22 @@ class _Parser:
                     else:
                         self.parse_type()
                     self.expect_op(")")
+                    pending_alias = None
+                    qual = None
                 else:
                     self.expect_ident()
+                    if pending_alias is not None:
+                        qual = (pending_alias, self.i - 1)
+                        self.qual_refs.append(qual)
+                        pending_alias = None
+                    else:
+                        qual = None
                 continue
             if self.at_op("("):  # call / conversion
-                self.call_args()
+                nargs, spread = self.call_args()
+                if qual is not None:
+                    self.qual_calls.append((qual[0], qual[1], nargs, spread))
+                qual = None
                 continue
             if self.at_op("["):  # index / slice / generic instantiation
                 self.advance()
@@ -854,7 +877,10 @@ class _Parser:
                 # Composite literal after a TypeName-shaped operand; the
                 # operand parser only reaches here for ident/selector/
                 # type-literal operands, all valid LiteralTypes.
-                self.literal_value()
+                keys = self.literal_value()
+                if qual is not None:
+                    self.qual_literals.append((qual[0], qual[1], keys))
+                qual = None
                 continue
             return
 
@@ -868,15 +894,21 @@ class _Parser:
             self.i = mark
             self.parse_type()
 
-    def call_args(self):
+    def call_args(self) -> tuple[int, bool]:
+        """Parse an argument list; returns (argument count, whether the
+        call spreads a slice with `...`) for the type layer."""
         self.expect_op("(")
         saved = self.allow_composite
         self.allow_composite = True
+        nargs = 0
+        spread = False
         while not self.at_op(")"):
             # Arguments may be types (new/make/conversions); the operand
             # parser already accepts type-literal heads as expressions.
             self.expression()
+            nargs += 1
             if self.at_op("..."):
+                spread = True
                 self.advance()
             if self.at_op(","):
                 self.advance()
@@ -884,6 +916,7 @@ class _Parser:
                 self.error("expected ',' or ')' in argument list")
         self.allow_composite = saved
         self.expect_op(")")
+        return nargs, spread
 
     def operand(self):
         t = self.tok
@@ -954,16 +987,30 @@ class _Parser:
             self.peek().kind == OP and self.peek().value == "*"
         )
 
-    def literal_value(self):
+    def literal_value(self) -> list[str]:
+        """Parse a composite-literal body; returns the top-level
+        identifier keys (struct-literal field names) for the type layer.
+        Expression keys (map literals, array indices) are not recorded."""
         self.expect_op("{")
         saved = self.allow_composite
         self.allow_composite = True
         self.skip_semis()
+        keys: list[str] = []
         while not self.at_op("}"):
-            self.element()
-            if self.at_op(":"):
+            if (
+                self.tok.kind == IDENT
+                and self.peek().kind == OP
+                and self.peek().value == ":"
+            ):
+                keys.append(self.tok.value)
+                self.advance()
                 self.advance()
                 self.element()
+            else:
+                self.element()
+                if self.at_op(":"):
+                    self.advance()
+                    self.element()
             if self.at_op(","):
                 self.advance()
                 self.skip_semis()
@@ -973,6 +1020,7 @@ class _Parser:
                     self.error("expected ',' or '}' in composite literal")
         self.allow_composite = saved
         self.expect_op("}")
+        return keys
 
     def element(self):
         if self.at_op("{"):  # nested literal with elided type

@@ -8,6 +8,7 @@ from .lint import semantics_of
 from .parser import GoSyntaxError, parse_source
 from .structural import check_structure, prune_go_dirs
 from .tokens import GoTokenError
+from .typecheck import types_of
 
 
 def check_project(root: str) -> list[str]:
@@ -44,6 +45,7 @@ def check_project(root: str) -> list[str]:
                 errors.append(f"{path}: nesting too deep to parse")
                 continue
             errors.extend(semantics_of(parsed, path))
+            errors.extend(types_of(parsed, text, path))
     # package-level structural checks (imports, duplicate funcs,
     # unresolved qualifiers) — these tolerate unreadable files, so an
     # error in one package doesn't suppress findings in another

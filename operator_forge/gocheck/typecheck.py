@@ -1,0 +1,167 @@
+"""Type-layer checks against the vendored symbol manifest.
+
+This is the no-toolchain substitute for the compile gate the reference
+gets from CI (`go build ./... && go vet ./...`,
+.github/workflows/test.yaml:53-54).  Three checks, all driven by the
+events the parser records while validating syntax:
+
+1. **Symbol existence** — ``alias.Name`` where ``alias`` is an import of
+   a manifest package marked ``closed`` must name a known func, type, or
+   value.
+2. **Call arity** — ``alias.Fn(a, b)`` where the manifest records an
+   arity for ``Fn`` must pass an argument count inside its bounds.  A
+   type name in call position is a conversion (always one argument,
+   checked as such).  Calls that spread a slice (``f(xs...)``) skip the
+   upper bound only.
+3. **Struct-literal fields** — ``alias.Type{Field: ...}`` where the
+   manifest enumerates ``Type``'s fields must use only those names.
+
+False-positive guards: aliases shadowed by any file-local declaration or
+function parameter are skipped, and packages absent from the manifest are
+never checked.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .manifest import MANIFEST
+from .parser import _Parser
+from .structural import parse_imports, strip_strings_and_comments
+
+# parameter lists of func declarations/literals: a cheap superset of the
+# names that could shadow an import alias inside some scope
+_PARAM_RE = re.compile(r"func\b[^(]*\(([^()]*)\)")
+_NAME_RE = re.compile(r"\b([A-Za-z_]\w*)\b")
+
+
+def _shadowed_names(parser: _Parser, text: str) -> set[str]:
+    """Names declared locally anywhere in the file (vars, consts, params,
+    receivers) — a qualified reference through one of these is a field or
+    method access on a local, not a package reference."""
+    names = {
+        parser.toks[i].value
+        for i in parser.local_decls
+        if i < len(parser.toks)
+    }
+    clean = strip_strings_and_comments(text)
+    for match in _PARAM_RE.finditer(clean):
+        for name in _NAME_RE.findall(match.group(1)):
+            names.add(name)
+    return names
+
+
+def types_of(parser: _Parser, text: str, filename: str = "<go>") -> list[str]:
+    """Run the manifest checks over one parsed file."""
+    imports: dict[str, str] = {}
+    for alias, path in parse_imports(text):
+        if alias not in ("_", "."):
+            imports[alias] = path
+
+    # only aliases that resolve into the manifest matter
+    checked = {
+        alias: MANIFEST[path]
+        for alias, path in imports.items()
+        if path in MANIFEST
+    }
+    if not checked:
+        return []
+
+    shadowed = _shadowed_names(parser, text)
+    toks = parser.toks
+    problems: list[str] = []
+
+    def where(tok_index: int) -> str:
+        tok = toks[tok_index]
+        return f"{filename}:{tok.line}:{tok.col}"
+
+    def known(pkg: dict, name: str) -> bool:
+        return (
+            name in pkg["funcs"]
+            or name in pkg["types"]
+            or name in pkg["values"]
+        )
+
+    called_or_constructed: set[tuple[int, int]] = set()
+
+    for alias_i, name_i, nargs, spread in parser.qual_calls:
+        alias = toks[alias_i].value
+        pkg = checked.get(alias)
+        if pkg is None or alias in shadowed:
+            continue
+        called_or_constructed.add((alias_i, name_i))
+        name = toks[name_i].value
+        path = imports[alias]
+        if name in pkg["funcs"]:
+            lo, hi = pkg["funcs"][name]
+            if nargs < lo and not spread:
+                problems.append(
+                    f"{where(name_i)}: {alias}.{name} expects at least "
+                    f"{lo} argument(s), got {nargs}"
+                )
+            elif hi is not None and nargs > hi:
+                problems.append(
+                    f"{where(name_i)}: {alias}.{name} expects at most "
+                    f"{hi} argument(s), got {nargs}"
+                )
+        elif name in pkg["types"]:
+            if nargs != 1:
+                problems.append(
+                    f"{where(name_i)}: conversion to {alias}.{name} "
+                    f"takes exactly 1 argument, got {nargs}"
+                )
+        elif name in pkg["values"]:
+            pass  # calling a func-typed var; arity unknown
+        elif pkg["closed"]:
+            problems.append(
+                f"{where(name_i)}: {path} has no symbol {name!r}"
+            )
+
+    for alias_i, name_i, keys in parser.qual_literals:
+        alias = toks[alias_i].value
+        pkg = checked.get(alias)
+        if pkg is None or alias in shadowed:
+            continue
+        called_or_constructed.add((alias_i, name_i))
+        name = toks[name_i].value
+        path = imports[alias]
+        fields = pkg["types"].get(name)
+        if name in pkg["types"]:
+            if fields is not None:
+                for key in keys:
+                    if key not in fields:
+                        problems.append(
+                            f"{where(name_i)}: {alias}.{name} has no "
+                            f"field {key!r}"
+                        )
+        elif pkg["closed"] and not known(pkg, name):
+            problems.append(
+                f"{where(name_i)}: {path} has no symbol {name!r}"
+            )
+
+    for alias_i, name_i in parser.qual_refs:
+        if (alias_i, name_i) in called_or_constructed:
+            continue
+        alias = toks[alias_i].value
+        pkg = checked.get(alias)
+        if pkg is None or alias in shadowed:
+            continue
+        if pkg["closed"] and not known(pkg, toks[name_i].value):
+            problems.append(
+                f"{where(name_i)}: {imports[alias]} has no symbol "
+                f"{toks[name_i].value!r}"
+            )
+
+    return problems
+
+
+def check_types(text: str, filename: str = "<go>") -> list[str]:
+    """Parse + type-layer check one file (syntax errors reported as-is)."""
+    from .parser import GoSyntaxError, parse_source
+    from .tokens import GoTokenError
+
+    try:
+        parser = parse_source(text, filename)
+    except (GoSyntaxError, GoTokenError) as exc:
+        return [str(exc)]
+    return types_of(parser, text, filename)
