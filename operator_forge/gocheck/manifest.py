@@ -333,7 +333,9 @@ MANIFEST: dict[str, dict] = {
             "FromObject": (1, 1),
             "NewNotFound": (2, 2),
             "NewAlreadyExists": (2, 2),
+            "NewGenerateNameConflict": (3, 3),
             "NewConflict": (3, 3),
+            "NewApplyConflict": (2, 2),
             "NewBadRequest": (1, 1),
             "NewForbidden": (3, 3),
             "NewUnauthorized": (1, 1),
@@ -341,15 +343,18 @@ MANIFEST: dict[str, dict] = {
             "NewInvalid": (3, 3),
             "NewInternalError": (1, 1),
             "NewServiceUnavailable": (1, 1),
+            "NewMethodNotSupported": (2, 2),
             "NewTimeoutError": (2, 2),
             "NewServerTimeout": (3, 3),
+            "NewServerTimeoutForKind": (3, 3),
             "NewTooManyRequests": (2, 2),
+            "NewTooManyRequestsError": (1, 1),
+            "NewRequestEntityTooLargeError": (1, 1),
             "NewResourceExpired": (1, 1),
             "NewGenericServerResponse": (7, 7),
             "SuggestsClientDelay": (1, 1),
             "HasStatusCause": (2, 2),
             "StatusCause": (2, 2),
-            "IsStatusError": (1, 1),
         },
         "types": {
             "StatusError": None,

@@ -29,10 +29,90 @@ from .manifest import MANIFEST
 from .parser import _Parser
 from .structural import parse_imports, strip_strings_and_comments
 
-# parameter lists of func declarations/literals: a cheap superset of the
-# names that could shadow an import alias inside some scope
-_PARAM_RE = re.compile(r"func\b[^(]*\(([^()]*)\)")
+# header of a func declaration/literal: a cheap superset of the names
+# that could shadow an import alias inside some scope
+_FUNC_RE = re.compile(r"\bfunc\b")
 _NAME_RE = re.compile(r"\b([A-Za-z_]\w*)\b")
+
+
+def _declared_names(group: str) -> set[str]:
+    """The DECLARED names of one header paren group (receiver, params, or
+    named results): the first identifier of each top-level comma item,
+    excluding identifiers that begin a qualified type (``ctrl.Request``).
+    Type names this still picks up (``int`` in ``func(int)``) are harmless
+    over-collection; collecting the package qualifier of a type would NOT
+    be — it is usually the very import alias being checked."""
+    names: set[str] = set()
+    items: list[str] = []
+    depth = 0
+    start = 0
+    for i, ch in enumerate(group):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            items.append(group[start:i])
+            start = i + 1
+    items.append(group[start:])
+    for item in items:
+        match = _NAME_RE.search(item)
+        if match is None:
+            continue
+        rest = item[match.end():].lstrip()
+        if rest.startswith("."):
+            continue  # qualified type, not a declared name
+        names.add(match.group(1))
+    return names
+
+
+def _func_header_names(clean: str) -> set[str]:
+    """Declared names from every paren group of each func header:
+    receiver, parameters, and named results.  Methods have their receiver
+    in the first group and their parameters in the second, so a
+    first-group-only regex would miss every method parameter — scan every
+    group (up to the three a header can have), balancing parens so nested
+    func types don't truncate a group.  A newline outside a group ends the
+    header (Go's semicolon insertion ends the declaration there), so a
+    bodiless func *type* can't leak the following statement's call
+    arguments into the shadow set."""
+    names: set[str] = set()
+    n = len(clean)
+    for match in _FUNC_RE.finditer(clean):
+        j = match.end()
+        groups = 0
+        while j < n and groups < 3:
+            c = clean[j]
+            if c == "(":
+                depth, k = 1, j + 1
+                while k < n and depth:
+                    if clean[k] == "(":
+                        depth += 1
+                    elif clean[k] == ")":
+                        depth -= 1
+                    k += 1
+                names.update(_declared_names(clean[j + 1 : k - 1]))
+                j = k
+                groups += 1
+            elif c == "[":
+                # generic type-parameter list (or an array/map type in a
+                # bare result): skip it wholesale — constraints may hold
+                # `~`, `|`, or newlines that must not end the header scan
+                depth, k = 1, j + 1
+                while k < n and depth:
+                    if clean[k] == "[":
+                        depth += 1
+                    elif clean[k] == "]":
+                        depth -= 1
+                    k += 1
+                j = k
+            elif c in " \t" or c.isalnum() or c in "_*.,":
+                # method name or a bare result type between groups —
+                # keep scanning the header
+                j += 1
+            else:
+                break
+    return names
 
 
 def _shadowed_names(parser: _Parser, text: str) -> set[str]:
@@ -44,10 +124,7 @@ def _shadowed_names(parser: _Parser, text: str) -> set[str]:
         for i in parser.local_decls
         if i < len(parser.toks)
     }
-    clean = strip_strings_and_comments(text)
-    for match in _PARAM_RE.finditer(clean):
-        for name in _NAME_RE.findall(match.group(1)):
-            names.add(name)
+    names.update(_func_header_names(strip_strings_and_comments(text)))
     return names
 
 

@@ -33,6 +33,24 @@ class YamlDocError(Exception):
 _MERGE_TAG = "tag:yaml.org,2002:merge"
 
 
+def _resolve_key(key_node: yaml.ScalarNode):
+    """The key as a dict built by ``yaml.safe_load`` would hash it —
+    duplicate-key identity must compare resolved values, not spellings.
+    Falls back to (tag, text) for text ``python_value`` can't parse
+    (e.g. an explicitly ``!!int``-tagged non-number)."""
+    scalar = Scalar(
+        value=key_node.value,
+        tag=key_node.tag,
+        style=key_node.style,
+        line=0,
+        col=0,
+    )
+    try:
+        return scalar.python_value()
+    except (ValueError, OverflowError, IndexError):
+        return (key_node.tag, key_node.value)
+
+
 # An element that can own comments: a MapEntry or SeqItem plus its position.
 @dataclass
 class _Element:
@@ -131,7 +149,9 @@ class _TreeBuilder:
         """The key/value pairs of a mapping with merge keys (``<<``)
         TRANSITIVELY expanded, in YAML merge precedence: explicit keys
         win, earlier merge sources win over later ones (and over their
-        own nested merges)."""
+        own nested merges).  A key repeated explicitly within one mapping
+        is LAST-wins (matching ``yaml.safe_load``), while merge-source
+        precedence between mappings stays first-wins per the merge spec."""
         seen: set = set()
         visited_nodes: set = set()
         entries: list = []
@@ -145,6 +165,7 @@ class _TreeBuilder:
             visited_nodes.add(id(mapping_node))
 
             merge_values = []
+            own: dict[object, tuple] = {}
             for key_node, value_node in mapping_node.value:
                 if not isinstance(key_node, yaml.ScalarNode):
                     raise YamlDocError(
@@ -154,10 +175,17 @@ class _TreeBuilder:
                 if key_node.tag == _MERGE_TAG:
                     merge_values.append(value_node)
                     continue
-                if key_node.value in seen:
+                # identity is the RESOLVED key, as a dict built by
+                # yaml.safe_load would have it: `1` and `"1"` differ
+                # (int vs str), `1` and `0x1` collide; dict insertion
+                # keeps first position, the overwrite keeps last value
+                ident = _resolve_key(key_node)
+                own[ident] = (key_node, value_node)
+            for ident, pair in own.items():
+                if ident in seen:
                     continue
-                seen.add(key_node.value)
-                entries.append((key_node, value_node))
+                seen.add(ident)
+                entries.append(pair)
 
             for merge_value in merge_values:
                 for source in self._merge_sources(merge_value):

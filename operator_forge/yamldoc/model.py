@@ -17,6 +17,51 @@ NULL_TAG = "tag:yaml.org,2002:null"
 VAR_TAG = "tag:yaml.org,2002:var"
 
 
+def _construct_int(text: str) -> int:
+    """Mirror yaml.SafeLoader.construct_yaml_int."""
+    text = text.replace("_", "")
+    sign = -1 if text.startswith("-") else 1
+    text = text.lstrip("+-")
+    if not text:
+        raise ValueError(f"not an int: {text!r}")
+    if text == "0":
+        return 0
+    if text.startswith("0b"):
+        return sign * int(text[2:], 2)
+    if text.startswith("0x"):
+        return sign * int(text[2:], 16)
+    if text.startswith("0o"):
+        return sign * int(text[2:], 8)
+    if text[0] == "0":
+        return sign * int(text, 8)
+    if ":" in text:
+        value = 0
+        for part in text.split(":"):
+            value = value * 60 + int(part)
+        return sign * value
+    return sign * int(text)
+
+
+_NAN = float("nan")
+
+
+def _construct_float(text: str) -> float:
+    """Mirror yaml.SafeLoader.construct_yaml_float."""
+    text = text.replace("_", "").lower()
+    sign = -1.0 if text.startswith("-") else 1.0
+    text = text.lstrip("+-")
+    if text == ".inf":
+        return sign * float("inf")
+    if text == ".nan":
+        return _NAN  # one shared object, so repeated .nan keys dedup
+    if ":" in text:
+        value = 0.0
+        for part in text.split(":"):
+            value = value * 60 + float(part)
+        return sign * value
+    return sign * float(text)
+
+
 @dataclass
 class Scalar:
     value: str
@@ -26,14 +71,13 @@ class Scalar:
     col: int = -1
 
     def python_value(self):
-        """Resolve the scalar to a Python value based on its tag."""
+        """Resolve the scalar to a Python value based on its tag, matching
+        PyYAML's construction (YAML 1.1: leading-0 octal, sexagesimal
+        ``190:20:30``, ``.inf``/``.nan``)."""
         if self.tag == INT_TAG:
-            try:
-                return int(self.value, 0)
-            except ValueError:
-                return int(self.value)
+            return _construct_int(self.value)
         if self.tag == FLOAT_TAG:
-            return float(self.value)
+            return _construct_float(self.value)
         if self.tag == BOOL_TAG:
             return self.value.lower() in ("true", "yes", "on", "y")
         if self.tag == NULL_TAG:
@@ -127,5 +171,7 @@ def to_python(node: Optional[Node]):
     if isinstance(node, Scalar):
         return node.python_value()
     if isinstance(node, Mapping):
-        return {e.key.value: to_python(e.value) for e in node.entries}
+        # keys resolve by tag like values do: `1:` is the int key 1,
+        # `"1":` the str key "1" — matching yaml.safe_load
+        return {e.key.python_value(): to_python(e.value) for e in node.entries}
     return [to_python(i.node) for i in node.items]

@@ -64,6 +64,50 @@ class TestFuzzRoundtrip:
         assert reparsed == datas
 
 
+class TestDuplicateKeyFuzz:
+    """Mappings with repeated keys: YAML processors (and the PyYAML
+    oracle) resolve explicit duplicates LAST-wins; the model must agree
+    and hold that through the round trip."""
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_duplicate_keys_match_pyyaml_semantics(self, seed):
+        rng = random.Random(3000 + seed)
+        keys = ["".join(rng.choices(string.ascii_lowercase, k=3))
+                for _ in range(rng.randint(2, 4))]
+        lines = []
+        for _ in range(rng.randint(3, 8)):
+            lines.append(f"{rng.choice(keys)}: {rng.randint(0, 99)}")
+        text = "\n".join(lines) + "\n"
+
+        expected = pyyaml.safe_load(text)
+        docs = load_documents(text)
+        assert to_python(docs[0].root) == expected
+
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == expected
+
+    @pytest.mark.parametrize("seed", range(15))
+    def test_duplicates_with_merge_keys_match_pyyaml(self, seed):
+        # duplicates inside the anchor source, inside the merging mapping,
+        # or both — explicit keys still beat the merge, and duplicates
+        # resolve last-wins on both sides
+        rng = random.Random(4000 + seed)
+        key = rng.choice(["x", "y"])
+        text = "base: &b\n"
+        for _ in range(rng.randint(1, 3)):
+            text += f"  {key}: {rng.randint(0, 9)}\n"
+        text += "merged:\n  <<: *b\n"
+        for _ in range(rng.randint(0, 3)):
+            text += f"  {key}: {rng.randint(10, 99)}\n"
+
+        expected = pyyaml.safe_load(text)
+        docs = load_documents(text)
+        assert to_python(docs[0].root) == expected
+
+        out = emit_documents(docs)
+        assert to_python(load_documents(out)[0].root) == expected
+
+
 class TestAnchorMergeFuzz:
     """Anchored/aliased/merged/folded inputs: the model must agree with
     PyYAML's safe_load (which applies YAML merge semantics) and survive

@@ -471,6 +471,138 @@ class TestStructural:
         assert any("unused import" in e for e in errors)
 
 
+class TestTypecheck:
+    """Manifest-driven symbol/arity checks and their shadow guards."""
+
+    def types(self, src):
+        from operator_forge.gocheck.typecheck import check_types
+        return check_types(src)
+
+    def test_method_param_shadows_import_alias(self):
+        # a method's params live in the SECOND paren group after `func`
+        # (the first is the receiver) — they must still suppress checks
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type helper struct{}\n\n"
+            "func (t *helper) Do(ctrl helper) int {\n"
+            "\tctrl.Whatever(1)\n"
+            "\treturn 0\n"
+            "}\n\n"
+            "var _ = ctrl.NewManager\n"
+        )
+        assert self.types(src) == []
+
+    def test_named_result_shadows_import_alias(self):
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type helper struct{}\n\n"
+            "func mk() (ctrl helper, err error) {\n"
+            "\tctrl.Whatever(1)\n"
+            "\treturn\n"
+            "}\n\n"
+            "var _ = ctrl.NewManager\n"
+        )
+        assert self.types(src) == []
+
+    def test_generic_constraint_param_shadows(self):
+        # `~`/`|`/newlines inside the type-param brackets must not end
+        # the header scan before the param group is reached
+        for constraint in ("~int", "int | string", "interface{ ~int }"):
+            src = (
+                "package main\n\n"
+                'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+                "type helper struct{}\n\n"
+                f"func run[T {constraint}](ctrl helper, v T) {{\n"
+                "\tctrl.Whatever(v)\n"
+                "}\n\n"
+                "var _ = ctrl.NewManager\n"
+            )
+            assert self.types(src) == [], constraint
+
+    def test_nested_func_type_param_shadows(self):
+        # balanced-paren scan: a func-typed param must not truncate the
+        # group and hide the names after it
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type helper struct{}\n\n"
+            "func run(cb func(int) int, ctrl helper) {\n"
+            "\tctrl.Whatever(cb(1))\n"
+            "}\n\n"
+            "var _ = ctrl.NewManager\n"
+        )
+        assert self.types(src) == []
+
+    def test_reconcile_signature_does_not_shadow_alias(self):
+        # the alias used as a TYPE QUALIFIER in the signature must not
+        # shadow itself — else the checker is silent in every reconciler
+        src = (
+            "package controllers\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type R struct{}\n\n"
+            "func (r *R) Reconcile(req ctrl.Request) (ctrl.Result, error) {\n"
+            "\tctrl.Whatever(1)\n"
+            "\treturn ctrl.Result{}, nil\n"
+            "}\n"
+        )
+        assert any("no symbol 'Whatever'" in e for e in self.types(src))
+
+    def test_bodiless_func_type_does_not_leak_into_next_statement(self):
+        # `var h func(int)` has no body; the newline ends the header, so
+        # the following call's arguments must not enter the shadow set
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type X struct{}\n\n"
+            "func (x X) Do(v int) {}\n\n"
+            "func f() {\n"
+            "\tvar h func(int)\n"
+            "\t_ = h\n"
+            "\tx := X{}\n"
+            "\tx.Do(1)\n"
+            "\tctrl.Whatever(1)\n"
+            "}\n"
+        )
+        assert any("no symbol 'Whatever'" in e for e in self.types(src))
+
+    def test_apierrors_new_apply_conflict_is_valid(self):
+        # exported in pinned apimachinery v0.26 — must not be flagged
+        src = (
+            "package main\n\n"
+            "import (\n"
+            '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
+            '\tmetav1 "k8s.io/apimachinery/pkg/apis/meta/v1"\n'
+            ")\n\n"
+            "func f(causes []metav1.StatusCause) error {\n"
+            '\treturn apierrs.NewApplyConflict(causes, "conflict")\n'
+            "}\n"
+        )
+        assert self.types(src) == []
+
+    def test_apierrors_is_status_error_does_not_exist(self):
+        # not in the real package — referencing it must be flagged
+        src = (
+            "package main\n\n"
+            'import apierrs "k8s.io/apimachinery/pkg/api/errors"\n\n'
+            "func f(err error) bool {\n"
+            "\treturn apierrs.IsStatusError(err)\n"
+            "}\n"
+        )
+        assert any("no symbol 'IsStatusError'" in e for e in self.types(src))
+
+    def test_true_misuse_still_flagged(self):
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "func run() {\n"
+            "\tctrl.Whatever(1)\n"
+            "}\n"
+        )
+        assert any("no symbol 'Whatever'" in e for e in self.types(src))
+
+
 class TestCheckProject:
     def test_prunes_vendor_and_reports_unreadable(self, tmp_path):
         from operator_forge.gocheck import check_project

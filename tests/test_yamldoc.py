@@ -307,6 +307,48 @@ class TestAnchorsAliasesAndMerges:
         with pytest.raises(YamlDocError):
             load_documents("m:\n  <<: [1, 2]\n")
 
+    def test_duplicate_key_last_wins(self):
+        # VERDICT round-3 weak item 3: must agree with yaml.safe_load,
+        # which resolves explicit duplicates last-wins
+        docs = load_documents("a: 1\na: 2\n")
+        assert to_python(docs[0].root) == {"a": 2}
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == {"a": 2}
+
+    def test_duplicate_key_keeps_first_position(self):
+        docs = load_documents("a: 1\nb: 3\na: 2\n")
+        assert to_python(docs[0].root) == {"a": 2, "b": 3}
+        # order matches PyYAML dict construction: a establishes position
+        # at its first occurrence, the later value overwrites
+        assert emit_documents(docs).lstrip("-\n") == "a: 2\nb: 3\n"
+
+    def test_same_text_different_type_keys_stay_distinct(self):
+        # `1` (int) and `"1"` (str) are different keys; both survive
+        docs = load_documents('1: x\n"1": y\n')
+        assert to_python(docs[0].root) == {1: "x", "1": "y"}
+        out = emit_documents(docs)
+        assert pyyaml.safe_load(out) == {1: "x", "1": "y"}
+
+    def test_bool_vs_string_keys_stay_distinct(self):
+        docs = load_documents('yes: 1\n"yes": 2\n')
+        assert to_python(docs[0].root) == {True: 1, "yes": 2}
+
+    def test_different_spellings_of_same_key_collapse(self):
+        # identity is the RESOLVED key: 1 and 0x1 are the same int
+        docs = load_documents("1: a\n0x1: b\n1: c\n")
+        assert to_python(docs[0].root) == {1: "c"}
+        assert pyyaml.safe_load(emit_documents(docs)) == {1: "c"}
+
+    def test_yaml11_numeric_spellings_resolve_like_pyyaml(self):
+        src = "k: .inf\nn: -.inf\no: 0755\ns: 190:20:30\n"
+        assert to_python(load_documents(src)[0].root) == pyyaml.safe_load(src)
+
+    def test_duplicate_explicit_key_still_beats_merge(self):
+        docs = load_documents(
+            "base: &b\n  x: 5\nm:\n  <<: *b\n  x: 1\n  x: 2\n"
+        )
+        assert to_python(docs[0].root)["m"] == {"x": 2}
+
     def test_folded_scalar_value_preserved_on_roundtrip(self):
         docs = load_documents("f: >\n  hello\n  world\n")
         assert to_python(docs[0].root) == {"f": "hello world\n"}
