@@ -1,0 +1,1064 @@
+"""Project-local symbol index and intra-project call checks.
+
+The manifest layer (typecheck.py) validates calls into *pinned
+dependencies*; nothing validated calls into the project's OWN packages —
+precisely the generated ``pkg/orchestrate`` API the emitted tests
+exercise but which no toolchain here ever compiles.  This module closes
+that hole (reference bar: the generated project compiles in CI,
+.github/workflows/test.yaml:55-105):
+
+1. **Project manifest** — every package under the module is indexed
+   (exported funcs with arity, types, values) and qualified references
+   between project packages are checked closed, with the same machinery
+   the dependency manifest uses.
+2. **Method-chain checks** — calls of the shape ``recv.Field.Method(…)``
+   are resolved through the index: the receiver/param's declared type,
+   each field's declared type, then the final type's method set (with
+   arity).  A misspelled ``r.Phases.HandleExecutionn(…)`` or a
+   wrong-arity ``HandleExecution`` call is an error.
+
+False positives are worse than misses, so every resolution step bails
+out silently when anything is uncertain: a name rebound with ``:=``, a
+type with external embeds (its method set is open), generic types, type
+aliases to external packages, chains through calls or indexing.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from .structural import parse_imports, prune_go_dirs
+from .tokens import IDENT, KEYWORD, OP, STRING, GoTokenError, Token, tokenize
+
+_BUILTIN_FUNCS = frozenset({
+    "append", "cap", "clear", "close", "complex", "copy", "delete",
+    "imag", "len", "make", "max", "min", "new", "panic", "print",
+    "println", "real", "recover",
+})
+_BASIC_TYPES = frozenset({
+    "bool", "byte", "complex64", "complex128", "error", "float32",
+    "float64", "int", "int8", "int16", "int32", "int64", "rune",
+    "string", "uint", "uint8", "uint16", "uint32", "uint64", "uintptr",
+    "any", "comparable",
+})
+
+
+@dataclass
+class TypeInfo:
+    """One declared type: its fields, embeds, and attached methods."""
+
+    kind: str  # "struct" | "interface" | "other" | "alias"
+    # named fields (structs): name -> type-ref or None when unresolvable
+    fields: dict = field(default_factory=dict)
+    # embedded types (structs + interfaces): type-refs; None entries mean
+    # an embed did not resolve, which OPENS the field/method set
+    embeds: list = field(default_factory=list)
+    # methods: receiver methods (structs/defined) or specs (interfaces)
+    methods: dict = field(default_factory=dict)  # name -> (min, max)
+    generic: bool = False
+    # aliases/defined types: the target type-ref (or None)
+    underlying: object = None
+    # defined over a basic type (closed method set) vs anything else
+    basic_underlying: bool = False
+
+
+@dataclass
+class Package:
+    dir: str
+    name: str
+    import_path: str | None
+    funcs: dict = field(default_factory=dict)  # name -> (min, max)
+    types: dict = field(default_factory=dict)  # name -> TypeInfo
+    values: dict = field(default_factory=dict)  # name -> type-ref or None
+    # False when a file in this dir failed to scan: the surface is then
+    # a SUBSET of the real one, so absence proves nothing
+    complete: bool = True
+
+
+# A type-ref is (package_import_path, TypeName) for project types,
+# ("", basic_name) for basic types, or None for anything unresolvable.
+
+
+class _FileScan:
+    """Top-level declarations of one file, token-scanned."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.toks: list[Token] = tokenize(text, path)
+        pairs = parse_imports(text)
+        self.imports = {
+            alias: p for alias, p in pairs if alias not in ("_", ".")
+        }
+        self.has_dot_import = any(alias == "." for alias, _ in pairs)
+        self.package = ""
+        # raw declarations; type expressions stay as token slices until
+        # the index resolves them against this file's imports
+        self.funcs: list[dict] = []      # {name, arity, recv, generic, body}
+        self.typedecls: list[dict] = []  # {name, kind, ...}
+        self.values: list[tuple[str, list[Token] | None]] = []
+        self._scan()
+
+    # -- token helpers ----------------------------------------------------
+
+    def _skip_group(self, i: int, open_ch: str, close_ch: str) -> int:
+        """i is at the opening token; return index after the match."""
+        depth = 0
+        n = len(self.toks)
+        while i < n:
+            v = self.toks[i].value
+            if self.toks[i].kind == OP:
+                if v == open_ch:
+                    depth += 1
+                elif v == close_ch:
+                    depth -= 1
+                    if depth == 0:
+                        return i + 1
+            i += 1
+        return i
+
+    def _skip_any_groups(self, i: int) -> int:
+        """Skip one balanced (), [], or {} group starting at i."""
+        v = self.toks[i].value
+        pairs = {"(": ")", "[": "]", "{": "}"}
+        return self._skip_group(i, v, pairs[v])
+
+    def _group_span(self, i: int) -> tuple[int, int]:
+        """(first-inner, one-past-closer) indices for the group at i."""
+        end = self._skip_any_groups(i)
+        return i + 1, end - 1
+
+    # -- scanning ---------------------------------------------------------
+
+    def _scan(self) -> None:
+        toks = self.toks
+        n = len(toks)
+        i = 0
+        depth = 0
+        while i < n:
+            t = toks[i]
+            if t.kind == OP and t.value in "([{":
+                i = self._skip_any_groups(i)
+                continue
+            if t.kind == OP and t.value in ")]}":
+                i += 1
+                continue
+            if t.kind != KEYWORD or depth != 0:
+                i += 1
+                continue
+            if t.value == "package" and i + 1 < n:
+                self.package = toks[i + 1].value
+                i += 2
+            elif t.value == "func":
+                i = self._scan_func(i)
+            elif t.value == "type":
+                i = self._scan_type(i)
+            elif t.value in ("var", "const"):
+                i = self._scan_value(i)
+            else:
+                i += 1
+
+    def _parse_params(self, lo: int, hi: int) -> tuple[int, int | None, list]:
+        """Arity (min, max) and [(names, type-token-slice)] items of the
+        param group spanning toks[lo:hi]."""
+        items: list[tuple[int, int]] = []
+        depth = 0
+        start = lo
+        for j in range(lo, hi):
+            t = self.toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif t.value == "," and depth == 0:
+                    items.append((start, j))
+                    start = j + 1
+        if start < hi:
+            items.append((start, hi))
+        parsed = []
+        variadic = False
+        for lo_i, hi_i in items:
+            span = self.toks[lo_i:hi_i]
+            if any(t.kind == OP and t.value == "..." for t in span):
+                variadic = True
+            # names: leading IDENTs of a `name Type` / `name, name Type`
+            # item; a type-only item has no declared name
+            name = None
+            if (
+                len(span) >= 2
+                and span[0].kind == IDENT
+                and not (span[1].kind == OP and span[1].value == ".")
+            ):
+                name = span[0].value
+                span = span[1:]
+            parsed.append((name, span))
+        count = len(items)
+        if variadic:
+            return max(count - 1, 0), None, parsed
+        return count, count, parsed
+
+    def _scan_func(self, i: int) -> int:
+        toks = self.toks
+        n = len(toks)
+        j = i + 1
+        recv = None  # (name, type-token-slice)
+        generic = False
+        if j < n and toks[j].value == "(":
+            lo, hi = self._group_span(j)
+            _, _, items = self._parse_params(lo, hi)
+            if items:
+                recv = items[0]
+            j = hi + 1
+        if j < n and toks[j].kind == IDENT:
+            name = toks[j].value
+            name_tok = toks[j]
+            j += 1
+        else:
+            return j  # func literal/type at top level: var scan covers it
+        if j < n and toks[j].value == "[":
+            generic = True
+            j = self._skip_group(j, "[", "]")
+        if j >= n or toks[j].value != "(":
+            return j
+        lo, hi = self._group_span(j)
+        amin, amax, items = self._parse_params(lo, hi)
+        j = hi + 1
+        # skip results: a paren group or a bare type, up to the body `{`
+        # or the end of the logical line (bodiless decl)
+        body = None
+        while j < n:
+            t = toks[j]
+            if t.kind == KEYWORD and t.value in ("struct", "interface"):
+                # a struct/interface RESULT type: its braces are not
+                # the body
+                j += 1
+                if j < n and toks[j].value == "{":
+                    j = self._skip_group(j, "{", "}")
+                continue
+            if t.kind == OP and t.value == "{":
+                body = self._group_span(j)
+                j = self._skip_group(j, "{", "}")
+                break
+            if t.kind == OP and t.value == ";":
+                break
+            if t.kind == OP and t.value in "([":
+                j = self._skip_any_groups(j)
+                continue
+            j += 1
+        self.funcs.append({
+            "name": name, "tok": name_tok, "arity": (amin, amax),
+            "recv": recv, "params": items, "generic": generic,
+            "body": body,
+        })
+        return j
+
+    def _scan_type(self, i: int) -> int:
+        toks = self.toks
+        n = len(toks)
+        j = i + 1
+        if j < n and toks[j].value == "(":
+            lo, hi = self._group_span(j)
+            k = lo
+            while k < hi:
+                if toks[k].kind == IDENT:
+                    k = self._scan_typespec(k, hi)
+                else:
+                    k += 1
+            return hi + 1
+        if j < n and toks[j].kind == IDENT:
+            return self._scan_typespec(j, n)
+        return j
+
+    def _scan_typespec(self, j: int, limit: int) -> int:
+        toks = self.toks
+        name = toks[j].value
+        j += 1
+        generic = False
+        if j < limit and toks[j].value == "[":
+            generic = True
+            j = self._skip_group(j, "[", "]")
+        alias = False
+        if j < limit and toks[j].value == "=":
+            alias = True
+            j += 1
+        if j < limit and toks[j].kind == KEYWORD and toks[j].value == "struct":
+            lo, hi = self._group_span(j + 1)
+            fields, embeds = self._parse_struct_fields(lo, hi)
+            self.typedecls.append({
+                "name": name, "kind": "struct", "fields": fields,
+                "embeds": embeds, "generic": generic,
+            })
+            return self._skip_group(j + 1, "{", "}")
+        if (
+            j < limit
+            and toks[j].kind == KEYWORD
+            and toks[j].value == "interface"
+        ):
+            lo, hi = self._group_span(j + 1)
+            methods, embeds = self._parse_interface_specs(lo, hi)
+            self.typedecls.append({
+                "name": name, "kind": "interface", "methods": methods,
+                "embeds": embeds, "generic": generic,
+            })
+            return self._skip_group(j + 1, "{", "}")
+        # other: defined type or alias over some type expression — capture
+        # the expression up to the logical end of line
+        start = j
+        depth = 0
+        while j < limit:
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    if depth == 0:
+                        break
+                    depth -= 1
+                elif t.value == ";" and depth == 0:
+                    break
+            j += 1
+        self.typedecls.append({
+            "name": name, "kind": "alias" if alias else "other",
+            "expr": toks[start:j], "generic": generic,
+        })
+        return j
+
+    def _parse_struct_fields(self, lo: int, hi: int):
+        """Split a struct body into named fields and embeds (lines)."""
+        toks = self.toks
+        fields: list[tuple[str, list[Token]]] = []
+        embeds: list[list[Token]] = []
+        j = lo
+        line_start = lo
+        depth = 0
+        while j <= hi:
+            end_line = j == hi or (
+                toks[j].kind == OP and toks[j].value == ";" and depth == 0
+            )
+            if not end_line:
+                if toks[j].kind == OP and toks[j].value in "([{":
+                    depth += 1
+                elif toks[j].kind == OP and toks[j].value in ")]}":
+                    depth -= 1
+                j += 1
+                continue
+            span = toks[line_start:j]
+            j += 1
+            line_start = j
+            # drop a trailing tag string
+            if span and span[-1].kind == STRING:
+                span = span[:-1]
+            if not span:
+                continue
+            names: list[str] = []
+            k = 0
+            while (
+                k + 1 < len(span)
+                and span[k].kind == IDENT
+                and span[k + 1].kind == OP
+                and span[k + 1].value == ","
+            ):
+                names.append(span[k].value)
+                k += 2
+            if (
+                k + 1 < len(span)
+                and span[k].kind == IDENT
+                and not (span[k + 1].kind == OP and span[k + 1].value == ".")
+            ):
+                names.append(span[k].value)
+                type_span = span[k + 1:]
+                for nm in names:
+                    fields.append((nm, type_span))
+            else:
+                embeds.append(span)
+        return fields, embeds
+
+    def _parse_interface_specs(self, lo: int, hi: int):
+        """Method specs and embedded types of an interface body."""
+        toks = self.toks
+        methods: dict[str, tuple] = {}
+        embeds: list[list[Token]] = []
+        j = lo
+        line_start = lo
+        depth = 0
+        while j <= hi:
+            end_line = j == hi or (
+                toks[j].kind == OP and toks[j].value == ";" and depth == 0
+            )
+            if not end_line:
+                if toks[j].kind == OP and toks[j].value in "([{":
+                    depth += 1
+                elif toks[j].kind == OP and toks[j].value in ")]}":
+                    depth -= 1
+                j += 1
+                continue
+            span_lo, span_hi = line_start, j
+            j += 1
+            line_start = j
+            if span_hi <= span_lo:
+                continue
+            first = toks[span_lo]
+            if (
+                first.kind == IDENT
+                and span_lo + 1 < span_hi
+                and toks[span_lo + 1].kind == OP
+                and toks[span_lo + 1].value == "("
+            ):
+                plo, phi = self._group_span(span_lo + 1)
+                amin, amax, _ = self._parse_params(plo, phi)
+                methods[first.value] = (amin, amax)
+            else:
+                embeds.append(toks[span_lo:span_hi])
+        return methods, embeds
+
+    def _scan_value(self, i: int) -> int:
+        toks = self.toks
+        n = len(toks)
+        j = i + 1
+        if j < n and toks[j].value == "(":
+            lo, hi = self._group_span(j)
+            k = lo
+            line_start = lo
+            depth = 0
+            while k <= hi:
+                end_line = k == hi or (
+                    toks[k].kind == OP
+                    and toks[k].value == ";"
+                    and depth == 0
+                )
+                if not end_line:
+                    if toks[k].kind == OP and toks[k].value in "([{":
+                        depth += 1
+                    elif toks[k].kind == OP and toks[k].value in ")]}":
+                        depth -= 1
+                    k += 1
+                    continue
+                self._value_line(line_start, k)
+                k += 1
+                line_start = k
+            return hi + 1
+        # single: var a, b Type = ... — up to the logical end of line
+        start = j
+        depth = 0
+        while j < n:
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    if depth == 0:
+                        break
+                    depth -= 1
+                elif t.value == ";" and depth == 0:
+                    break
+            j += 1
+        self._value_line(start, j)
+        return j
+
+    def _value_line(self, lo: int, hi: int) -> None:
+        toks = self.toks
+        names: list[str] = []
+        k = lo
+        while k < hi and toks[k].kind == IDENT:
+            names.append(toks[k].value)
+            if k + 1 < hi and toks[k + 1].kind == OP and toks[k + 1].value == ",":
+                k += 2
+            else:
+                k += 1
+                break
+        if not names:
+            return
+        # explicit type: tokens between the last name and `=` (or EOL)
+        type_span: list[Token] | None = None
+        if k < hi and not (toks[k].kind == OP and toks[k].value == "="):
+            end = k
+            depth = 0
+            while end < hi:
+                t = toks[end]
+                if t.kind == OP:
+                    if t.value in "([{":
+                        depth += 1
+                    elif t.value in ")]}":
+                        depth -= 1
+                    elif t.value == "=" and depth == 0:
+                        break
+                end += 1
+            type_span = toks[k:end]
+        for nm in names:
+            self.values.append((nm, type_span))
+
+
+class ProjectIndex:
+    """Cross-package index of one generated project tree."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.module = _read_module_path(root)
+        self.packages: dict[str, Package] = {}  # import path -> Package
+        self.scans: list[_FileScan] = []
+        self._build()
+
+    def _build(self) -> None:
+        if self.module is None:
+            return  # no go.mod: nothing to index
+        failed_dirs: set[str] = set()
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = prune_go_dirs(dirnames)
+            for name in sorted(filenames):
+                if not name.endswith(".go") or name.startswith(("_", ".")):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        text = fh.read()
+                    scan = _FileScan(path, text)
+                except (OSError, UnicodeDecodeError, GoTokenError,
+                        RecursionError):
+                    # unreadable/unparsable is reported elsewhere; here
+                    # it means this package's indexed surface is partial
+                    failed_dirs.add(dirpath)
+                    continue
+                self.scans.append(scan)
+        # register every package FIRST: type resolution inside
+        # _index_scan must see packages that os.walk visits later
+        for scan in self.scans:
+            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
+            imp = self.module if rel == "." else f"{self.module}/{rel}"
+            if scan.package.endswith("_test"):
+                continue  # external test packages add no API
+            if imp not in self.packages:
+                self.packages[imp] = Package(
+                    dir=os.path.dirname(scan.path),
+                    name=scan.package,
+                    import_path=imp,
+                    complete=os.path.dirname(scan.path) not in failed_dirs,
+                )
+        for scan in self.scans:
+            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
+            imp = self.module if rel == "." else f"{self.module}/{rel}"
+            pkg = self.packages.get(imp)
+            if pkg is None or pkg.name != scan.package:
+                continue  # _test package or mixed names
+            self._index_scan(pkg, scan)
+        # second pass: attach methods now that all types exist
+        for scan in self.scans:
+            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
+            imp = self.module if rel == "." else f"{self.module}/{rel}"
+            pkg = self.packages.get(imp)
+            if pkg is None or scan.package != pkg.name:
+                continue
+            for fn in scan.funcs:
+                if fn["recv"] is None:
+                    continue
+                base = _receiver_base(fn["recv"][1])
+                if base is None:
+                    continue
+                info = pkg.types.get(base)
+                if info is None:
+                    continue
+                if fn["generic"]:
+                    info.generic = True
+                info.methods[fn["name"]] = fn["arity"]
+
+    def _index_scan(self, pkg: Package, scan: _FileScan) -> None:
+        resolve = lambda span: self.resolve_type(scan, span)  # noqa: E731
+        for fn in scan.funcs:
+            if fn["recv"] is None:
+                pkg.funcs[fn["name"]] = fn["arity"]
+        for td in scan.typedecls:
+            if td["kind"] == "struct":
+                info = TypeInfo(kind="struct", generic=td["generic"])
+                for nm, span in td["fields"]:
+                    info.fields[nm] = resolve(span)
+                for span in td["embeds"]:
+                    info.embeds.append(resolve(span))
+                pkg.types[td["name"]] = info
+            elif td["kind"] == "interface":
+                info = TypeInfo(kind="interface", generic=td["generic"])
+                info.methods.update(td["methods"])
+                for span in td["embeds"]:
+                    info.embeds.append(resolve(span))
+                pkg.types[td["name"]] = info
+            else:
+                expr = td["expr"]
+                ref = resolve(expr)
+                basic = (
+                    len(expr) == 1
+                    and expr[0].kind == IDENT
+                    and expr[0].value in _BASIC_TYPES
+                )
+                pkg.types[td["name"]] = TypeInfo(
+                    kind=td["kind"], underlying=ref, generic=td["generic"],
+                    basic_underlying=basic,
+                )
+        for nm, span in scan.values:
+            pkg.values[nm] = resolve(span) if span else None
+
+    # -- type resolution --------------------------------------------------
+
+    def resolve_type(self, scan: _FileScan, span) -> tuple | None:
+        """Reduce a type expression to a (pkg_path, Name) project ref,
+        ("", basic) for basic types, or None when unresolvable (external,
+        composite beyond pointers, generic instantiation...)."""
+        toks = [t for t in span if not (t.kind == OP and t.value == "*")]
+        if len(toks) == 1 and toks[0].kind == IDENT:
+            name = toks[0].value
+            if name in _BASIC_TYPES:
+                return ("", name)
+            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
+            imp = (
+                self.module if rel == "." else f"{self.module}/{rel}"
+            ) if self.module else None
+            if imp and imp in self.packages:
+                return (imp, name)
+            return None
+        if (
+            len(toks) == 3
+            and toks[0].kind == IDENT
+            and toks[1].kind == OP
+            and toks[1].value == "."
+            and toks[2].kind == IDENT
+        ):
+            path = scan.imports.get(toks[0].value)
+            if path in self.packages:
+                return (path, toks[2].value)
+            return None
+        return None
+
+    def type_info(self, ref) -> TypeInfo | None:
+        """TypeInfo for a project ref, following alias chains."""
+        return self._type_info_pkg(ref)[0]
+
+    def _type_info_pkg(self, ref):
+        """(TypeInfo, owning Package) for a ref, following aliases."""
+        seen = set()
+        while ref is not None and ref not in seen:
+            seen.add(ref)
+            path, name = ref
+            if path == "":
+                return None, None  # basic type
+            pkg = self.packages.get(path)
+            if pkg is None:
+                return None, None
+            info = pkg.types.get(name)
+            if info is None:
+                return None, None
+            if info.kind == "alias":
+                ref = info.underlying
+                continue
+            return info, pkg
+        return None, None
+
+    # -- method/field sets with promotion ---------------------------------
+
+    def method_set(self, ref, _seen=None) -> tuple[dict, bool]:
+        """(methods, closed) for a project type ref, following embeds.
+        ``closed=False`` when any embed is unresolvable — then unknown
+        method names must pass."""
+        if _seen is None:
+            _seen = set()
+        if ref in _seen:
+            return {}, True
+        _seen.add(ref)
+        info, pkg = self._type_info_pkg(ref)
+        if info is None:
+            return {}, False
+        if info.generic:
+            return dict(info.methods), False
+        methods = dict(info.methods)
+        # a package with unscanned files may declare methods we missed
+        closed = pkg is None or pkg.complete
+        if info.kind == "other" and not info.basic_underlying:
+            # a defined type over a non-basic underlying (possibly an
+            # external interface) may carry methods we can't see
+            closed = False
+        for emb in info.embeds:
+            if emb is None:
+                closed = False
+                continue
+            sub, sub_closed = self.method_set(emb, _seen)
+            for nm, ar in sub.items():
+                methods.setdefault(nm, ar)
+            closed = closed and sub_closed
+        return methods, closed
+
+    def field_type(self, ref, name: str, _seen=None):
+        """(found, type-ref) for field ``name`` on struct ``ref``,
+        following embedded project structs.  found=None means the field
+        set is open (unresolvable embed) and absence proves nothing."""
+        if _seen is None:
+            _seen = set()
+        if ref in _seen:
+            return False, None
+        _seen.add(ref)
+        info, _pkg = self._type_info_pkg(ref)
+        if info is not None and info.kind == "interface":
+            return False, None  # interfaces have no fields, ever
+        if info is None or info.kind != "struct" or info.generic:
+            return None, None
+        if name in info.fields:
+            return True, info.fields[name]
+        open_set = False
+        for emb in info.embeds:
+            if emb is None:
+                open_set = True
+                continue
+            # the embedded type's base name acts as a field name
+            if emb[1] == name:
+                return True, emb
+            found, ftype = self.field_type(emb, name, _seen)
+            if found:
+                return True, ftype
+            if found is None:
+                open_set = True
+        if open_set:
+            return None, None
+        return False, None
+
+    # -- manifest for the qualified-reference layer -----------------------
+
+    def as_manifest(self) -> dict:
+        """Exported surface of every project package, in the shape
+        typecheck.MANIFEST uses, all packages closed."""
+        out: dict[str, dict] = {}
+        for imp, pkg in self.packages.items():
+            funcs = {
+                n: a for n, a in pkg.funcs.items() if n[:1].isupper()
+            }
+            types: dict[str, object] = {}
+            for n, info in pkg.types.items():
+                if not n[:1].isupper():
+                    continue
+                if (
+                    info.kind == "struct"
+                    and not info.generic
+                    and all(e is not None for e in info.embeds)
+                ):
+                    names = set(info.fields)
+                    names.update(e[1] for e in info.embeds)
+                    types[n] = frozenset(names)
+                else:
+                    types[n] = None
+            values = {n for n in pkg.values if n[:1].isupper()}
+            out[imp] = {
+                # a package with unscanned files has a PARTIAL surface;
+                # claiming it closed would error on its real symbols
+                "closed": pkg.complete,
+                "funcs": funcs,
+                "types": types,
+                "values": values,
+            }
+        return out
+
+
+class _UNRESOLVED:
+    """Marker: name is locally bound to something we can't type."""
+
+
+def _body_env(idx: ProjectIndex, scan: _FileScan, fn: dict) -> dict:
+    """name -> type-ref for the receiver and params, with every name
+    rebound inside the body (``:=``, ``var``, func-literal params)
+    demoted to _UNRESOLVED so shadowing can't mislead the checker."""
+    env: dict[str, object] = {}
+    if fn["recv"] is not None and fn["recv"][0]:
+        env[fn["recv"][0]] = idx.resolve_type(scan, fn["recv"][1])
+    for name, span in fn["params"]:
+        if name:
+            env[name] = idx.resolve_type(scan, span)
+        elif len(span) == 1 and span[0].kind == IDENT:
+            # `x` in `(x, y T)` parses as a type-only item; the name
+            # must still shadow package-level vars
+            env[span[0].value] = _UNRESOLVED
+    lo, hi = fn["body"]
+    toks = scan.toks
+    j = lo
+    while j < hi:
+        t = toks[j]
+        if t.kind == OP and t.value == ":=":
+            k = j - 1
+            while k >= lo:
+                if toks[k].kind == IDENT:
+                    env[toks[k].value] = _UNRESOLVED
+                    if (
+                        k - 1 >= lo
+                        and toks[k - 1].kind == OP
+                        and toks[k - 1].value == ","
+                    ):
+                        k -= 2
+                        continue
+                break
+        elif t.kind == KEYWORD and t.value == "var":
+            k = j + 1
+            names = []
+            while k < hi and toks[k].kind == IDENT:
+                names.append(toks[k].value)
+                if (
+                    k + 1 < hi
+                    and toks[k + 1].kind == OP
+                    and toks[k + 1].value == ","
+                ):
+                    k += 2
+                else:
+                    k += 1
+                    break
+            type_start = k
+            depth = 0
+            while k < hi:
+                tk = toks[k]
+                if tk.kind == OP:
+                    if tk.value in "([{":
+                        depth += 1
+                    elif tk.value in ")]}":
+                        if depth == 0:
+                            break
+                        depth -= 1
+                    elif tk.value in ("=", ";") and depth == 0:
+                        break
+                k += 1
+            span = toks[type_start:k]
+            ref = idx.resolve_type(scan, span) if span else _UNRESOLVED
+            for nm in names:
+                env[nm] = ref if ref is not None else _UNRESOLVED
+            j = k
+            continue
+        elif t.kind == KEYWORD and t.value == "func":
+            # func literal: its params shadow within it; demote file-wide
+            k = j + 1
+            if k < hi and toks[k].kind == OP and toks[k].value == "(":
+                plo, phi = scan._group_span(k)
+                _, _, items = scan._parse_params(plo, phi)
+                for name, span in items:
+                    if name:
+                        env[name] = _UNRESOLVED
+                    elif len(span) == 1 and span[0].kind == IDENT:
+                        env[span[0].value] = _UNRESOLVED
+        j += 1
+    return env
+
+
+def _count_args(toks: list[Token], lo: int, hi: int) -> tuple[int, bool]:
+    """(nargs, spread) for the call-argument span toks[lo:hi].  -1 means
+    a single argument containing a call: Go's ``f(g())`` multi-value
+    expansion makes the effective count unknowable."""
+    depth = 0
+    spread = False
+    segments = [[]]
+    for j in range(lo, hi):
+        t = toks[j]
+        if t.kind == OP:
+            if t.value in "([{":
+                depth += 1
+            elif t.value in ")]}":
+                depth -= 1
+            elif depth == 0:
+                if t.value == ",":
+                    segments.append([])
+                    continue
+                if t.value == "...":
+                    spread = True
+                    continue
+                if t.value == ";":
+                    continue  # ASI inside a multi-line call
+        segments[-1].append(t)
+    nonempty = [seg for seg in segments if seg]
+    if len(nonempty) == 1 and any(
+        t.kind == OP and t.value == "(" for t in nonempty[0]
+    ):
+        return -1, spread
+    return len(nonempty), spread
+
+
+def check_local_calls(root: str, idx: ProjectIndex | None = None) -> list[str]:
+    """Validate intra-project calls through the index: method chains on
+    fields of known project types, and bare same-package func arity."""
+    if idx is None:
+        idx = ProjectIndex(root)
+    if idx.module is None:
+        return []
+    errors: list[str] = []
+    for scan in idx.scans:
+        rel = os.path.relpath(os.path.dirname(scan.path), idx.root)
+        imp = idx.module if rel == "." else f"{idx.module}/{rel}"
+        pkg = idx.packages.get(imp)
+        own = pkg if pkg is not None and pkg.name == scan.package else None
+        for fn in scan.funcs:
+            if fn["body"] is None:
+                continue
+            env = _body_env(idx, scan, fn)
+            errors.extend(_check_body(idx, scan, own, fn, env))
+    return errors
+
+
+def _check_body(idx, scan, own, fn, env) -> list[str]:
+    toks = scan.toks
+    lo, hi = fn["body"]
+    errors: list[str] = []
+    j = lo
+    while j < hi:
+        t = toks[j]
+        if t.kind != IDENT:
+            j += 1
+            continue
+        prev = toks[j - 1] if j > lo else None
+        if prev is not None and (
+            prev.kind == IDENT
+            or (prev.kind == OP and prev.value in (".", ")", "]", "}"))
+        ):
+            j += 1
+            continue
+        # collect the selector chain
+        parts = [j]
+        k = j
+        while (
+            k + 2 < hi
+            and toks[k + 1].kind == OP
+            and toks[k + 1].value == "."
+            and toks[k + 2].kind == IDENT
+        ):
+            parts.append(k + 2)
+            k += 2
+        is_call = (
+            k + 1 < hi
+            and toks[k + 1].kind == OP
+            and toks[k + 1].value == "("
+        )
+        if not is_call:
+            j = k + 1
+            continue
+        glo, ghi = scan._group_span(k + 1)
+        nargs, spread = _count_args(toks, glo, ghi)
+        errors.extend(
+            _check_call(idx, scan, own, env, parts, nargs, spread)
+        )
+        j = k + 1  # the args group is scanned for its own chains
+    return errors
+
+
+def _check_call(idx, scan, own, env, parts, nargs, spread) -> list[str]:
+    toks = scan.toks
+    head = toks[parts[0]]
+
+    def where(tok):
+        return f"{scan.path}:{tok.line}:{tok.col}"
+
+    def arity_errors(label: str, tok, arity) -> list[str]:
+        amin, amax = arity
+        if nargs < 0:
+            return []  # f(g()): effective count unknown
+        if nargs < amin and not spread:
+            return [
+                f"{where(tok)}: {label} expects at least {amin} "
+                f"argument(s), got {nargs}"
+            ]
+        if amax is not None and nargs > amax and not spread:
+            return [
+                f"{where(tok)}: {label} expects at most {amax} "
+                f"argument(s), got {nargs}"
+            ]
+        return []
+
+    if len(parts) == 1:
+        # bare call: same-package func arity / conversion arity
+        name = head.value
+        if (
+            own is None
+            or name in env
+            or name in _BUILTIN_FUNCS
+            or scan.has_dot_import
+        ):
+            return []
+        if name in own.funcs:
+            return arity_errors(name, head, own.funcs[name])
+        return []
+
+    # chain: resolve the head
+    ref = env.get(head.value)
+    if ref is _UNRESOLVED:
+        return []
+    start = 1
+    if ref is None:
+        if head.value in env:
+            return []
+        if head.value in scan.imports:
+            path = scan.imports[head.value]
+            pkg = idx.packages.get(path)
+            if pkg is None or len(parts) < 3:
+                return []  # alias.Func(...) is the manifest layer's job
+            ref = pkg.values.get(toks[parts[1]].value)
+            if ref is None or ref is _UNRESOLVED:
+                return []
+            start = 2
+        elif own is not None and head.value in own.values:
+            ref = own.values[head.value]
+            if ref is None:
+                return []
+        else:
+            return []
+
+    # walk intermediate fields
+    for pi in parts[start:-1]:
+        name_tok = toks[pi]
+        found, ftype = idx.field_type(ref, name_tok.value)
+        if found is None:
+            return []  # open field set — absence proves nothing
+        if found is False:
+            info = idx.type_info(ref)
+            # a method used as a value mid-chain, or anything else we
+            # don't model, must not error — only a CLOSED miss does
+            ms, closed = idx.method_set(ref)
+            if name_tok.value in ms or not closed:
+                return []
+            if info is None:
+                return []
+            return [
+                f"{where(name_tok)}: type {ref[1]} has no field or "
+                f"method {name_tok.value!r}"
+            ]
+        if ftype is None:
+            return []
+        ref = ftype
+        if ref[0] == "":
+            return []  # basic-typed field: no further resolution
+
+    # final part: a method (arity-checked) or a func-typed field
+    name_tok = toks[parts[-1]]
+    ms, closed = idx.method_set(ref)
+    if name_tok.value in ms:
+        return arity_errors(
+            f"{ref[1]}.{name_tok.value}", name_tok, ms[name_tok.value]
+        )
+    found, _ftype = idx.field_type(ref, name_tok.value)
+    if found:
+        return []  # func-typed field call; arity unknown
+    if found is None or not closed:
+        return []
+    info = idx.type_info(ref)
+    if info is None:
+        return []
+    return [
+        f"{where(name_tok)}: type {ref[1]} has no method "
+        f"{name_tok.value!r}"
+    ]
+
+
+def _read_module_path(root: str) -> str | None:
+    gomod = os.path.join(root, "go.mod")
+    try:
+        with open(gomod, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line.startswith("module "):
+                    return line.split()[1]
+    except OSError:
+        return None
+    return None
+
+
+def _receiver_base(span) -> str | None:
+    """Base type name of a receiver type expression (`*Registry` ->
+    Registry, `Registry[T]` -> Registry)."""
+    toks = [t for t in span if not (t.kind == OP and t.value == "*")]
+    if toks and toks[0].kind == IDENT:
+        return toks[0].value
+    return None

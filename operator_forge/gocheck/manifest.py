@@ -646,3 +646,9 @@ MANIFEST: dict[str, dict] = {
         "values": set(),
     },
 }
+
+# stdlib surfaces live in their own module (they are large and closed);
+# merged here so the type layer sees one map
+from .stdmanifest import STD_MANIFEST  # noqa: E402
+
+MANIFEST.update(STD_MANIFEST)

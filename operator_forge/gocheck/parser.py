@@ -896,16 +896,26 @@ class _Parser:
 
     def call_args(self) -> tuple[int, bool]:
         """Parse an argument list; returns (argument count, whether the
-        call spreads a slice with `...`) for the type layer."""
+        call spreads a slice with `...`) for the type layer.  A count of
+        -1 means a SINGLE argument that itself contains a call — Go's
+        ``f(g())`` multi-value expansion makes the effective count
+        unknowable here, so arity checks must skip it."""
         self.expect_op("(")
         saved = self.allow_composite
         self.allow_composite = True
         nargs = 0
         spread = False
+        first_start = self.i
+        first_has_call = False
         while not self.at_op(")"):
             # Arguments may be types (new/make/conversions); the operand
             # parser already accepts type-literal heads as expressions.
             self.expression()
+            if nargs == 0:
+                first_has_call = any(
+                    t.kind == OP and t.value == "("
+                    for t in self.toks[first_start:self.i]
+                )
             nargs += 1
             if self.at_op("..."):
                 spread = True
@@ -916,6 +926,8 @@ class _Parser:
                 self.error("expected ',' or ')' in argument list")
         self.allow_composite = saved
         self.expect_op(")")
+        if nargs == 1 and first_has_call:
+            return -1, spread
         return nargs, spread
 
     def operand(self):

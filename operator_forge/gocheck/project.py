@@ -5,6 +5,8 @@ from __future__ import annotations
 import os
 
 from .lint import semantics_of
+from .localindex import ProjectIndex, check_local_calls
+from .manifest import MANIFEST
 from .parser import GoSyntaxError, parse_source
 from .structural import check_structure, prune_go_dirs
 from .tokens import GoTokenError
@@ -23,6 +25,12 @@ def check_project(root: str) -> list[str]:
     not raised.
     """
     errors: list[str] = []
+    # index the project's own packages so qualified references between
+    # them are checked closed, like the dependency manifest
+    index = ProjectIndex(root)
+    manifest = MANIFEST
+    if index.module is not None:
+        manifest = {**MANIFEST, **index.as_manifest()}
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
@@ -45,9 +53,11 @@ def check_project(root: str) -> list[str]:
                 errors.append(f"{path}: nesting too deep to parse")
                 continue
             errors.extend(semantics_of(parsed, path))
-            errors.extend(types_of(parsed, text, path))
+            errors.extend(types_of(parsed, text, path, manifest))
     # package-level structural checks (imports, duplicate funcs,
     # unresolved qualifiers) — these tolerate unreadable files, so an
     # error in one package doesn't suppress findings in another
     errors.extend(check_structure(root))
+    # intra-project method chains and same-package call arity
+    errors.extend(check_local_calls(root, index))
     return errors

@@ -1,0 +1,327 @@
+"""Closed symbol surfaces for the stdlib packages generated code imports.
+
+The no-toolchain vet gate (see manifest.py) covered only the pinned
+*dependency* surface; stdlib misuse — ``os.Exit()`` with no argument,
+``fmt.Errorf()`` with no format — passed clean, though the reference bar
+is "the generated project compiles" (reference CI:
+.github/workflows/test.yaml:55-105).  This module enumerates the FULL
+exported surface of every stdlib package the generated projects (and
+their emitted tests) import, so those packages can be ``closed``.
+
+Completeness rule: a closed package's enumeration must be a superset of
+what a user could validly reference, else the gate errors on valid code.
+Surfaces are per Go 1.19 (the version pinned in generated go.mod), PLUS
+the small 1.20/1.21 additions (``errors.Join``, ``strings.CutPrefix``,
+``context.Cause``…) so projects built with a newer toolchain don't get
+false positives — an unknown-symbol miss is recoverable, a false error
+on valid code is not.
+
+Shape matches manifest.MANIFEST: funcs name -> (min_args, max_args)
+with ``None`` = variadic; types name -> None (stdlib struct literals are
+not field-checked); values = exported vars/consts.
+"""
+
+from __future__ import annotations
+
+STD_MANIFEST: dict[str, dict] = {
+    "fmt": {
+        "closed": True,
+        "funcs": {
+            "Print": (0, None), "Println": (0, None), "Printf": (1, None),
+            "Sprint": (0, None), "Sprintln": (0, None), "Sprintf": (1, None),
+            "Fprint": (1, None), "Fprintln": (1, None), "Fprintf": (2, None),
+            "Errorf": (1, None),
+            "Scan": (0, None), "Scanln": (0, None), "Scanf": (1, None),
+            "Sscan": (1, None), "Sscanln": (1, None), "Sscanf": (2, None),
+            "Fscan": (1, None), "Fscanln": (1, None), "Fscanf": (2, None),
+            "Append": (1, None), "Appendln": (1, None), "Appendf": (2, None),
+            "FormatString": (2, 2),
+        },
+        "types": {
+            "Stringer": None, "GoStringer": None, "Formatter": None,
+            "Scanner": None, "State": None, "ScanState": None,
+        },
+        "values": set(),
+    },
+    "errors": {
+        "closed": True,
+        "funcs": {
+            "New": (1, 1), "Is": (2, 2), "As": (2, 2), "Unwrap": (1, 1),
+            "Join": (0, None),
+        },
+        "types": {},
+        "values": {"ErrUnsupported"},
+    },
+    "os": {
+        "closed": True,
+        "funcs": {
+            "Chdir": (1, 1), "Chmod": (2, 2), "Chown": (3, 3),
+            "Chtimes": (3, 3), "Clearenv": (0, 0), "Create": (1, 1),
+            "CreateTemp": (2, 2), "DirFS": (1, 1), "Environ": (0, 0),
+            "Executable": (0, 0), "Exit": (1, 1), "Expand": (2, 2),
+            "ExpandEnv": (1, 1), "FindProcess": (1, 1),
+            "Getegid": (0, 0), "Getenv": (1, 1), "Geteuid": (0, 0),
+            "Getgid": (0, 0), "Getgroups": (0, 0), "Getpagesize": (0, 0),
+            "Getpid": (0, 0), "Getppid": (0, 0), "Getuid": (0, 0),
+            "Getwd": (0, 0), "Hostname": (0, 0),
+            "IsExist": (1, 1), "IsNotExist": (1, 1),
+            "IsPathSeparator": (1, 1), "IsPermission": (1, 1),
+            "IsTimeout": (1, 1), "Lchown": (3, 3), "Link": (2, 2),
+            "LookupEnv": (1, 1), "Lstat": (1, 1), "Mkdir": (2, 2),
+            "MkdirAll": (2, 2), "MkdirTemp": (2, 2), "NewFile": (2, 2),
+            "NewSyscallError": (2, 2), "Open": (1, 1), "OpenFile": (3, 3),
+            "Pipe": (0, 0), "ReadDir": (1, 1), "ReadFile": (1, 1),
+            "Readlink": (1, 1), "Remove": (1, 1), "RemoveAll": (1, 1),
+            "Rename": (2, 2), "SameFile": (2, 2), "Setenv": (2, 2),
+            "StartProcess": (3, 3), "Stat": (1, 1), "Symlink": (2, 2),
+            "TempDir": (0, 0), "Truncate": (2, 2), "Unsetenv": (1, 1),
+            "UserCacheDir": (0, 0), "UserConfigDir": (0, 0),
+            "UserHomeDir": (0, 0), "WriteFile": (3, 3),
+        },
+        "types": {
+            "File": None, "FileInfo": None, "FileMode": None,
+            "DirEntry": None, "Process": None, "ProcessState": None,
+            "ProcAttr": None, "LinkError": None, "PathError": None,
+            "SyscallError": None, "Signal": None,
+        },
+        "values": {
+            "Args", "Stdin", "Stdout", "Stderr",
+            "ErrInvalid", "ErrPermission", "ErrExist", "ErrNotExist",
+            "ErrClosed", "ErrNoDeadline", "ErrDeadlineExceeded",
+            "ErrProcessDone", "Interrupt", "Kill", "DevNull",
+            "PathSeparator", "PathListSeparator",
+            "O_RDONLY", "O_WRONLY", "O_RDWR", "O_APPEND", "O_CREATE",
+            "O_EXCL", "O_SYNC", "O_TRUNC",
+            "SEEK_SET", "SEEK_CUR", "SEEK_END",
+            "ModeDir", "ModeAppend", "ModeExclusive", "ModeTemporary",
+            "ModeSymlink", "ModeDevice", "ModeNamedPipe", "ModeSocket",
+            "ModeSetuid", "ModeSetgid", "ModeCharDevice", "ModeSticky",
+            "ModeIrregular", "ModeType", "ModePerm",
+        },
+    },
+    "strings": {
+        "closed": True,
+        "funcs": {
+            "Clone": (1, 1), "Compare": (2, 2), "Contains": (2, 2),
+            "ContainsAny": (2, 2), "ContainsRune": (2, 2), "Count": (2, 2),
+            "Cut": (2, 2), "CutPrefix": (2, 2), "CutSuffix": (2, 2),
+            "EqualFold": (2, 2), "Fields": (1, 1), "FieldsFunc": (2, 2),
+            "HasPrefix": (2, 2), "HasSuffix": (2, 2), "Index": (2, 2),
+            "IndexAny": (2, 2), "IndexByte": (2, 2), "IndexFunc": (2, 2),
+            "IndexRune": (2, 2), "Join": (2, 2), "LastIndex": (2, 2),
+            "LastIndexAny": (2, 2), "LastIndexByte": (2, 2),
+            "LastIndexFunc": (2, 2), "Map": (2, 2), "NewReader": (1, 1),
+            "NewReplacer": (0, None), "Repeat": (2, 2), "Replace": (4, 4),
+            "ReplaceAll": (3, 3), "Split": (2, 2), "SplitAfter": (2, 2),
+            "SplitAfterN": (3, 3), "SplitN": (3, 3), "Title": (1, 1),
+            "ToLower": (1, 1), "ToLowerSpecial": (2, 2), "ToTitle": (1, 1),
+            "ToTitleSpecial": (2, 2), "ToUpper": (1, 1),
+            "ToUpperSpecial": (2, 2), "ToValidUTF8": (2, 2), "Trim": (2, 2),
+            "TrimFunc": (2, 2), "TrimLeft": (2, 2), "TrimLeftFunc": (2, 2),
+            "TrimPrefix": (2, 2), "TrimRight": (2, 2),
+            "TrimRightFunc": (2, 2), "TrimSpace": (1, 1),
+            "TrimSuffix": (2, 2),
+        },
+        "types": {"Builder": None, "Reader": None, "Replacer": None},
+        "values": set(),
+    },
+    "bytes": {
+        "closed": True,
+        "funcs": {
+            "Clone": (1, 1), "Compare": (2, 2), "Contains": (2, 2),
+            "ContainsAny": (2, 2), "ContainsRune": (2, 2), "Count": (2, 2),
+            "Cut": (2, 2), "CutPrefix": (2, 2), "CutSuffix": (2, 2),
+            "Equal": (2, 2), "EqualFold": (2, 2), "Fields": (1, 1),
+            "FieldsFunc": (2, 2), "HasPrefix": (2, 2), "HasSuffix": (2, 2),
+            "Index": (2, 2), "IndexAny": (2, 2), "IndexByte": (2, 2),
+            "IndexFunc": (2, 2), "IndexRune": (2, 2), "Join": (2, 2),
+            "LastIndex": (2, 2), "LastIndexAny": (2, 2),
+            "LastIndexByte": (2, 2), "LastIndexFunc": (2, 2), "Map": (2, 2),
+            "NewBuffer": (1, 1), "NewBufferString": (1, 1),
+            "NewReader": (1, 1), "Repeat": (2, 2), "Replace": (4, 4),
+            "ReplaceAll": (3, 3), "Runes": (1, 1), "Split": (2, 2),
+            "SplitAfter": (2, 2), "SplitAfterN": (3, 3), "SplitN": (3, 3),
+            "Title": (1, 1), "ToLower": (1, 1), "ToLowerSpecial": (2, 2),
+            "ToTitle": (1, 1), "ToTitleSpecial": (2, 2), "ToUpper": (1, 1),
+            "ToUpperSpecial": (2, 2), "ToValidUTF8": (2, 2), "Trim": (2, 2),
+            "TrimFunc": (2, 2), "TrimLeft": (2, 2), "TrimLeftFunc": (2, 2),
+            "TrimPrefix": (2, 2), "TrimRight": (2, 2),
+            "TrimRightFunc": (2, 2), "TrimSpace": (1, 1),
+            "TrimSuffix": (2, 2),
+        },
+        "types": {"Buffer": None, "Reader": None},
+        "values": {"ErrTooLarge", "MinRead"},
+    },
+    "context": {
+        "closed": True,
+        "funcs": {
+            "Background": (0, 0), "TODO": (0, 0), "Cause": (1, 1),
+            "WithCancel": (1, 1), "WithCancelCause": (1, 1),
+            "WithDeadline": (2, 2), "WithDeadlineCause": (3, 3),
+            "WithTimeout": (2, 2), "WithTimeoutCause": (3, 3),
+            "WithValue": (3, 3), "WithoutCancel": (1, 1),
+            "AfterFunc": (2, 2),
+        },
+        "types": {
+            "Context": None, "CancelFunc": None, "CancelCauseFunc": None,
+        },
+        "values": {"Canceled", "DeadlineExceeded"},
+    },
+    "time": {
+        "closed": True,
+        "funcs": {
+            "After": (1, 1), "AfterFunc": (2, 2), "Date": (8, 8),
+            "FixedZone": (2, 2), "LoadLocation": (1, 1),
+            "LoadLocationFromTZData": (2, 2), "NewTicker": (1, 1),
+            "NewTimer": (1, 1), "Now": (0, 0), "Parse": (2, 2),
+            "ParseDuration": (1, 1), "ParseInLocation": (3, 3),
+            "Since": (1, 1), "Sleep": (1, 1), "Tick": (1, 1),
+            "Unix": (2, 2), "UnixMicro": (1, 1), "UnixMilli": (1, 1),
+            "Until": (1, 1),
+        },
+        "types": {
+            "Duration": None, "Location": None, "Month": None,
+            "ParseError": None, "Ticker": None, "Time": None,
+            "Timer": None, "Weekday": None,
+        },
+        "values": {
+            "Nanosecond", "Microsecond", "Millisecond", "Second",
+            "Minute", "Hour",
+            "January", "February", "March", "April", "May", "June",
+            "July", "August", "September", "October", "November",
+            "December",
+            "Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday",
+            "Local", "UTC",
+            "Layout", "ANSIC", "UnixDate", "RubyDate", "RFC822",
+            "RFC822Z", "RFC850", "RFC1123", "RFC1123Z", "RFC3339",
+            "RFC3339Nano", "Kitchen", "Stamp", "StampMilli",
+            "StampMicro", "StampNano", "DateTime", "DateOnly",
+            "TimeOnly",
+        },
+    },
+    "flag": {
+        "closed": True,
+        "funcs": {
+            "Arg": (1, 1), "Args": (0, 0), "Bool": (3, 3),
+            "BoolFunc": (2, 2), "BoolVar": (4, 4), "Duration": (3, 3),
+            "DurationVar": (4, 4), "Float64": (3, 3), "Float64Var": (4, 4),
+            "Func": (3, 3), "Int": (3, 3), "Int64": (3, 3),
+            "Int64Var": (4, 4), "IntVar": (4, 4), "Lookup": (1, 1),
+            "NArg": (0, 0), "NFlag": (0, 0), "NewFlagSet": (2, 2),
+            "Parse": (0, 0), "Parsed": (0, 0), "PrintDefaults": (0, 0),
+            "Set": (2, 2), "String": (3, 3), "StringVar": (4, 4),
+            "TextVar": (4, 4), "Uint": (3, 3), "Uint64": (3, 3),
+            "Uint64Var": (4, 4), "UintVar": (4, 4), "UnquoteUsage": (1, 1),
+            "Var": (3, 3), "Visit": (1, 1), "VisitAll": (1, 1),
+        },
+        "types": {
+            "ErrorHandling": None, "Flag": None, "FlagSet": None,
+            "Getter": None, "Value": None,
+        },
+        "values": {
+            "CommandLine", "ContinueOnError", "ExitOnError",
+            "PanicOnError", "ErrHelp", "Usage",
+        },
+    },
+    "hash/fnv": {
+        "closed": True,
+        "funcs": {
+            "New32": (0, 0), "New32a": (0, 0), "New64": (0, 0),
+            "New64a": (0, 0), "New128": (0, 0), "New128a": (0, 0),
+        },
+        "types": {},
+        "values": set(),
+    },
+    "io": {
+        "closed": True,
+        "funcs": {
+            "Copy": (2, 2), "CopyBuffer": (3, 3), "CopyN": (3, 3),
+            "LimitReader": (2, 2), "MultiReader": (0, None),
+            "MultiWriter": (0, None), "NewOffsetWriter": (2, 2),
+            "NewSectionReader": (3, 3), "Pipe": (0, 0), "ReadAll": (1, 1),
+            "ReadAtLeast": (3, 3), "ReadFull": (2, 2), "TeeReader": (2, 2),
+            "WriteString": (2, 2),
+        },
+        "types": {
+            "Reader": None, "Writer": None, "Closer": None, "Seeker": None,
+            "ReadCloser": None, "ReadSeekCloser": None, "ReadSeeker": None,
+            "ReadWriteCloser": None, "ReadWriteSeeker": None,
+            "ReadWriter": None, "WriteCloser": None, "WriteSeeker": None,
+            "ByteReader": None, "ByteScanner": None, "ByteWriter": None,
+            "RuneReader": None, "RuneScanner": None, "StringWriter": None,
+            "ReaderAt": None, "ReaderFrom": None, "WriterAt": None,
+            "WriterTo": None, "SectionReader": None, "LimitedReader": None,
+            "PipeReader": None, "PipeWriter": None, "OffsetWriter": None,
+        },
+        "values": {
+            "EOF", "ErrClosedPipe", "ErrNoProgress", "ErrShortBuffer",
+            "ErrShortWrite", "ErrUnexpectedEOF", "Discard",
+            "SeekStart", "SeekCurrent", "SeekEnd",
+        },
+    },
+    "os/exec": {
+        "closed": True,
+        "funcs": {
+            "Command": (1, None), "CommandContext": (2, None),
+            "LookPath": (1, 1),
+        },
+        "types": {"Cmd": None, "Error": None, "ExitError": None},
+        "values": {"ErrNotFound", "ErrDot", "ErrWaitDelay"},
+    },
+    "path/filepath": {
+        "closed": True,
+        "funcs": {
+            "Abs": (1, 1), "Base": (1, 1), "Clean": (1, 1), "Dir": (1, 1),
+            "EvalSymlinks": (1, 1), "Ext": (1, 1), "FromSlash": (1, 1),
+            "Glob": (1, 1), "HasPrefix": (2, 2), "IsAbs": (1, 1),
+            "IsLocal": (1, 1), "Join": (0, None), "Match": (2, 2),
+            "Rel": (2, 2), "Split": (1, 1), "SplitList": (1, 1),
+            "ToSlash": (1, 1), "VolumeName": (1, 1), "Walk": (2, 2),
+            "WalkDir": (2, 2),
+        },
+        "types": {"WalkFunc": None},
+        "values": {
+            "Separator", "ListSeparator", "ErrBadPattern", "SkipDir",
+            "SkipAll",
+        },
+    },
+    "testing": {
+        "closed": True,
+        "funcs": {
+            "AllocsPerRun": (2, 2), "Benchmark": (1, 1),
+            "CoverMode": (0, 0), "Coverage": (0, 0), "Init": (0, 0),
+            "Main": (4, 4), "RegisterCover": (1, 1),
+            "RunBenchmarks": (2, 2), "RunExamples": (2, 2),
+            "RunTests": (2, 2), "Short": (0, 0), "Testing": (0, 0),
+            "Verbose": (0, 0),
+        },
+        "types": {
+            "B": None, "BenchmarkResult": None, "Cover": None,
+            "CoverBlock": None, "F": None, "InternalBenchmark": None,
+            "InternalExample": None, "InternalFuzzTarget": None,
+            "InternalTest": None, "M": None, "PB": None, "T": None,
+            "TB": None,
+        },
+        "values": set(),
+    },
+    "encoding/json": {
+        "closed": True,
+        "funcs": {
+            "Compact": (2, 2), "HTMLEscape": (2, 2), "Indent": (4, 4),
+            "Marshal": (1, 1), "MarshalIndent": (3, 3),
+            "NewDecoder": (1, 1), "NewEncoder": (1, 1),
+            "Unmarshal": (2, 2), "Valid": (1, 1),
+        },
+        "types": {
+            "Decoder": None, "Delim": None, "Encoder": None,
+            "InvalidUTF8Error": None, "InvalidUnmarshalError": None,
+            "Marshaler": None, "MarshalerError": None, "Number": None,
+            "RawMessage": None, "SyntaxError": None, "Token": None,
+            "UnmarshalFieldError": None, "UnmarshalTypeError": None,
+            "Unmarshaler": None, "UnsupportedTypeError": None,
+            "UnsupportedValueError": None,
+        },
+        "values": set(),
+    },
+}

@@ -60,8 +60,9 @@ def _declared_names(group: str) -> set[str]:
         if match is None:
             continue
         rest = item[match.end():].lstrip()
-        if rest.startswith("."):
-            continue  # qualified type, not a declared name
+        if rest.startswith(".") and not rest.startswith("..."):
+            continue  # qualified type, not a declared name (but a
+            # variadic `name ...T` IS a declared name)
         names.add(match.group(1))
     return names
 
@@ -128,8 +129,17 @@ def _shadowed_names(parser: _Parser, text: str) -> set[str]:
     return names
 
 
-def types_of(parser: _Parser, text: str, filename: str = "<go>") -> list[str]:
-    """Run the manifest checks over one parsed file."""
+def types_of(
+    parser: _Parser,
+    text: str,
+    filename: str = "<go>",
+    manifest: dict | None = None,
+) -> list[str]:
+    """Run the manifest checks over one parsed file.  ``manifest``
+    defaults to the pinned-dependency surface; project-tree checks pass
+    it merged with the project's own indexed packages."""
+    if manifest is None:
+        manifest = MANIFEST
     imports: dict[str, str] = {}
     for alias, path in parse_imports(text):
         if alias not in ("_", "."):
@@ -137,9 +147,9 @@ def types_of(parser: _Parser, text: str, filename: str = "<go>") -> list[str]:
 
     # only aliases that resolve into the manifest matter
     checked = {
-        alias: MANIFEST[path]
+        alias: manifest[path]
         for alias, path in imports.items()
-        if path in MANIFEST
+        if path in manifest
     }
     if not checked:
         return []
@@ -171,7 +181,9 @@ def types_of(parser: _Parser, text: str, filename: str = "<go>") -> list[str]:
         path = imports[alias]
         if name in pkg["funcs"]:
             lo, hi = pkg["funcs"][name]
-            if nargs < lo and not spread:
+            if nargs < 0:
+                pass  # f(g()): effective count unknown (multi-value)
+            elif nargs < lo and not spread:
                 problems.append(
                     f"{where(name_i)}: {alias}.{name} expects at least "
                     f"{lo} argument(s), got {nargs}"
@@ -182,7 +194,7 @@ def types_of(parser: _Parser, text: str, filename: str = "<go>") -> list[str]:
                     f"{hi} argument(s), got {nargs}"
                 )
         elif name in pkg["types"]:
-            if nargs != 1:
+            if nargs >= 0 and nargs != 1:
                 problems.append(
                     f"{where(name_i)}: conversion to {alias}.{name} "
                     f"takes exactly 1 argument, got {nargs}"

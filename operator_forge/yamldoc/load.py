@@ -180,7 +180,13 @@ class _TreeBuilder:
                 # (int vs str), `1` and `0x1` collide; dict insertion
                 # keeps first position, the overwrite keeps last value
                 ident = _resolve_key(key_node)
-                own[ident] = (key_node, value_node)
+                if ident in own:
+                    # last value wins, but the FIRST key spelling is
+                    # kept — as a Python dict (and yaml.safe_load)
+                    # keeps the first-inserted key object
+                    own[ident] = (own[ident][0], value_node)
+                else:
+                    own[ident] = (key_node, value_node)
             for ident, pair in own.items():
                 if ident in seen:
                     continue

@@ -602,6 +602,226 @@ class TestTypecheck:
         )
         assert any("no symbol 'Whatever'" in e for e in self.types(src))
 
+    def test_stdlib_wrong_arity_caught(self):
+        # VERDICT round-3 weak item 4: os.Exit() with no argument and
+        # fmt.Errorf() with no format must both fail the gate
+        src = (
+            "package main\n\n"
+            'import (\n\t"fmt"\n\t"os"\n)\n\n'
+            "func main() {\n"
+            "\tos.Exit()\n"
+            "\t_ = fmt.Errorf()\n"
+            "}\n"
+        )
+        errs = self.types(src)
+        assert any("os.Exit expects at least 1" in e for e in errs)
+        assert any("fmt.Errorf expects at least 1" in e for e in errs)
+
+    def test_stdlib_unknown_symbol_caught(self):
+        src = (
+            "package main\n\n"
+            'import "strings"\n\n'
+            "func f() string {\n"
+            '\treturn strings.Uppercase("x")\n'
+            "}\n"
+        )
+        assert any("no symbol 'Uppercase'" in e for e in self.types(src))
+
+    def test_stdlib_valid_usage_passes(self):
+        src = (
+            "package main\n\n"
+            'import (\n'
+            '\t"context"\n\t"errors"\n\t"fmt"\n\t"hash/fnv"\n'
+            '\t"os"\n\t"strings"\n\t"time"\n'
+            ")\n\n"
+            "func f(ctx context.Context) error {\n"
+            "\th := fnv.New32a()\n"
+            "\t_ = h\n"
+            "\t_, cancel := context.WithTimeout(ctx, 5*time.Second)\n"
+            "\tdefer cancel()\n"
+            '\t_ = strings.ToUpper(os.Getenv("HOME"))\n'
+            '\treturn fmt.Errorf("wrap: %w", errors.New("boom"))\n'
+            "}\n"
+        )
+        assert self.types(src) == []
+
+
+def _write_project(tmp_path, files: dict) -> str:
+    (tmp_path / "go.mod").write_text("module example.com/proj\n\ngo 1.19\n")
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+    return str(tmp_path)
+
+
+_ENGINE = (
+    "package engine\n\n"
+    "type Registry struct {\n"
+    "\tphases []string\n"
+    "}\n\n"
+    "func (r *Registry) Register(name string) {\n"
+    "\tr.phases = append(r.phases, name)\n"
+    "}\n\n"
+    "func (r *Registry) Run(a int, b int) error {\n"
+    "\treturn nil\n"
+    "}\n\n"
+    "func NewRegistry() *Registry {\n"
+    "\treturn &Registry{}\n"
+    "}\n"
+)
+
+
+class TestLocalIndex:
+    """Project-local type/method index: intra-project calls validated
+    without a toolchain (VERDICT round-3 next-round item 3)."""
+
+    def check(self, tmp_path, main_body: str):
+        from operator_forge.gocheck.localindex import check_local_calls
+        root = _write_project(tmp_path, {
+            "pkg/engine/engine.go": _ENGINE,
+            "main.go": (
+                "package main\n\n"
+                'import "example.com/proj/pkg/engine"\n\n'
+                "type App struct {\n"
+                "\tPhases *engine.Registry\n"
+                "}\n\n" + main_body
+            ),
+        })
+        return check_local_calls(root)
+
+    def test_field_chain_method_ok(self, tmp_path):
+        errs = self.check(tmp_path, (
+            "func (a *App) Go() error {\n"
+            '\ta.Phases.Register("one")\n'
+            "\treturn a.Phases.Run(1, 2)\n"
+            "}\n"
+        ))
+        assert errs == []
+
+    def test_misspelled_method_caught(self, tmp_path):
+        errs = self.check(tmp_path, (
+            "func (a *App) Go() {\n"
+            '\ta.Phases.Registerr("one")\n'
+            "}\n"
+        ))
+        assert any("no method 'Registerr'" in e for e in errs)
+
+    def test_wrong_arity_method_caught(self, tmp_path):
+        errs = self.check(tmp_path, (
+            "func (a *App) Go() error {\n"
+            "\treturn a.Phases.Run(1)\n"
+            "}\n"
+        ))
+        assert any("Run expects at least 2" in e for e in errs)
+
+    def test_multivalue_expansion_not_flagged(self, tmp_path):
+        # f(g()) fills params from g's results; arity is unknowable
+        errs = self.check(tmp_path, (
+            "func pair() (int, int) { return 1, 2 }\n\n"
+            "func (a *App) Go() error {\n"
+            "\treturn a.Phases.Run(pair())\n"
+            "}\n"
+        ))
+        assert errs == []
+
+    def test_shadowed_name_not_checked(self, tmp_path):
+        errs = self.check(tmp_path, (
+            "func (a *App) Go(other func() int) {\n"
+            "\ta := struct{ Phases func() int }{Phases: other}\n"
+            "\t_ = a.Phases()\n"
+            "}\n"
+        ))
+        assert errs == []
+
+    def test_same_package_func_arity(self, tmp_path):
+        from operator_forge.gocheck.localindex import check_local_calls
+        root = _write_project(tmp_path, {
+            "main.go": (
+                "package main\n\n"
+                "func helper(a int, b string) {}\n\n"
+                "func main() {\n"
+                "\thelper(1)\n"
+                "}\n"
+            ),
+        })
+        errs = check_local_calls(root)
+        assert any("helper expects at least 2" in e for e in errs)
+
+    def test_qualified_project_symbol_checked(self, tmp_path):
+        from operator_forge.gocheck import check_project
+        root = _write_project(tmp_path, {
+            "pkg/engine/engine.go": _ENGINE,
+            "main.go": (
+                "package main\n\n"
+                'import "example.com/proj/pkg/engine"\n\n'
+                "func main() {\n"
+                "\t_ = engine.NewRegistryy()\n"
+                "}\n"
+            ),
+        })
+        errs = check_project(root)
+        assert any("no symbol 'NewRegistryy'" in e for e in errs)
+
+    def test_external_embed_opens_method_set(self, tmp_path):
+        # a struct embedding an external type may have promoted methods
+        # we can't see — unknown method names must pass
+        from operator_forge.gocheck.localindex import check_local_calls
+        root = _write_project(tmp_path, {
+            "main.go": (
+                "package main\n\n"
+                'import "sigs.k8s.io/controller-runtime/pkg/client"\n\n'
+                "type App struct {\n"
+                "\tclient.Client\n"
+                "}\n\n"
+                "func (a *App) Go() {\n"
+                "\ta.SomePromotedMethod(1, 2, 3)\n"
+                "}\n"
+            ),
+        })
+        assert check_local_calls(root) == []
+
+    def test_broken_file_opens_package_surface(self, tmp_path):
+        # a package with an unscannable file has a PARTIAL index; its
+        # real symbols must not be flagged (only the real error is)
+        from operator_forge.gocheck import check_project
+        root = _write_project(tmp_path, {
+            "pkg/engine/a.go": "package engine\n\nfunc Extra() {}\n",
+            "pkg/engine/broken.go": 'package engine\n\nvar s = "oops\n',
+            "main.go": (
+                "package main\n\n"
+                'import "example.com/proj/pkg/engine"\n\n'
+                "func main() {\n"
+                "\tengine.Extra()\n"
+                "\tengine.Other()\n"
+                "}\n"
+            ),
+        })
+        errs = check_project(root)
+        assert not any("no symbol" in e for e in errs)
+        assert any("broken.go" in e for e in errs)
+
+    def test_variadic_param_shadows_alias(self, tmp_path):
+        from operator_forge.gocheck.typecheck import check_types
+        src = (
+            "package main\n\n"
+            'import ctrl "sigs.k8s.io/controller-runtime"\n\n'
+            "type opt struct{ N int }\n\n"
+            "func setup(ctrl ...opt) int {\n"
+            "\treturn ctrl[0].N\n"
+            "}\n\n"
+            "var _ = ctrl.NewManager\n"
+        )
+        assert check_types(src) == []
+
+    def test_reference_corpus_clean(self):
+        from operator_forge.gocheck.localindex import (
+            ProjectIndex, check_local_calls,
+        )
+        idx = ProjectIndex(REFERENCE)
+        assert len(idx.packages) > 20  # the index sees the real module
+        assert check_local_calls(REFERENCE, idx) == []
+
 
 class TestCheckProject:
     def test_prunes_vendor_and_reports_unreadable(self, tmp_path):
@@ -692,6 +912,22 @@ class TestReferenceCorpus:
         from operator_forge.gocheck import check_structure
 
         assert check_structure(REFERENCE) == []
+
+    def test_reference_corpus_typechecks_clean(self):
+        """The reference compiles, so the manifest/stdlib type layer must
+        produce ZERO findings over its 120 files — the strongest
+        false-positive oracle for the closed stdlib surfaces."""
+        from operator_forge.gocheck.typecheck import check_types
+
+        findings = []
+        for dirpath, _, files in os.walk(REFERENCE):
+            for name in sorted(files):
+                if not name.endswith(".go"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as fh:
+                    findings.extend(check_types(fh.read(), path))
+        assert findings == []
 
     def test_reference_corpus_semantically_clean(self):
         """The reference compiles, so the conservative unused-local pass

@@ -339,6 +339,14 @@ class TestAnchorsAliasesAndMerges:
         assert to_python(docs[0].root) == {1: "c"}
         assert pyyaml.safe_load(emit_documents(docs)) == {1: "c"}
 
+    def test_cross_type_equal_keys_keep_first_key_type(self):
+        # True == 1 in Python; like a dict built by safe_load, the FIRST
+        # key object survives while the later value wins
+        d = to_python(load_documents("yes: 8\n0x1: 9\n")[0].root)
+        w = pyyaml.safe_load("yes: 8\n0x1: 9\n")
+        assert d == w
+        assert [type(k) for k in d] == [type(k) for k in w]
+
     def test_yaml11_numeric_spellings_resolve_like_pyyaml(self):
         src = "k: .inf\nn: -.inf\no: 0755\ns: 190:20:30\n"
         assert to_python(load_documents(src)[0].root) == pyyaml.safe_load(src)
