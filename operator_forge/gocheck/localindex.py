@@ -96,8 +96,15 @@ class _FileScan:
         # the index resolves them against this file's imports
         self.funcs: list[dict] = []      # {name, arity, recv, generic, body}
         self.typedecls: list[dict] = []  # {name, kind, ...}
-        self.values: list[tuple[str, list[Token] | None]] = []
+        # (name, type_span, init_span) — init spans feed the interpreter
+        self.value_inits: list[tuple] = []
         self._scan()
+
+    @property
+    def values(self):
+        """(name, type_span) pairs, derived so the two views of the
+        package's values can never drift apart."""
+        return [(n, ts) for n, ts, _ in self.value_inits]
 
     # -- token helpers ----------------------------------------------------
 
@@ -471,7 +478,10 @@ class _FileScan:
             return
         # explicit type: tokens between the last name and `=` (or EOL)
         type_span: list[Token] | None = None
-        if k < hi and not (toks[k].kind == OP and toks[k].value == "="):
+        eq = None
+        if k < hi and toks[k].kind == OP and toks[k].value == "=":
+            eq = k
+        elif k < hi:
             end = k
             depth = 0
             while end < hi:
@@ -485,8 +495,32 @@ class _FileScan:
                         break
                 end += 1
             type_span = toks[k:end]
-        for nm in names:
-            self.values.append((nm, type_span))
+            if end < hi:
+                eq = end
+        init_spans: list = [None] * len(names)
+        if eq is not None:
+            # split the initializer list at top-level commas, one per name
+            depth = 0
+            start = eq + 1
+            spans = []
+            for j in range(eq + 1, hi):
+                t = toks[j]
+                if t.kind == OP:
+                    if t.value in "([{":
+                        depth += 1
+                    elif t.value in ")]}":
+                        depth -= 1
+                    elif t.value == "," and depth == 0:
+                        spans.append(toks[start:j])
+                        start = j + 1
+            spans.append(toks[start:hi])
+            if len(spans) == len(names):
+                init_spans = spans
+            # else: `var a, b = f()` — a multi-value initializer can't
+            # be split per name here; leave every init None so a use
+            # fails loudly instead of binding the wrong value
+        for idx, nm in enumerate(names):
+            self.value_inits.append((nm, type_span, init_spans[idx]))
 
 
 class ProjectIndex:

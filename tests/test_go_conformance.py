@@ -1,0 +1,558 @@
+"""Conformance tests that EXECUTE the emitted pkg/orchestrate Go code.
+
+The generated project ships Go tests nothing here can run (no Go
+toolchain; the reference relies on CI — test.yaml:55-141).  These tests
+interpret the emitted sources directly (operator_forge/gocheck/interp)
+and drive the same scenarios the emitted ``ready_test.go`` and
+``orchestrate_test.go`` assert: readiness gating per child kind, phase
+ordering and event filtering, requeue-on-pending, failure recording,
+and owner-identity finalizer keys.  A seeded logic mutation in the
+template output changes interpreted behavior and fails HERE, today —
+see TestSeededMutationsDetected, which proves that property holds.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from operator_forge.gocheck.interp import (
+    GoError,
+    GoStruct,
+    Interp,
+    _UnstructuredModule,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def orchestrate_dir(tmp_path_factory):
+    """Generate the standalone project once; return pkg/orchestrate."""
+    root = tmp_path_factory.mktemp("conformance")
+    config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+    for cmd in ("init", "create"):
+        args = [sys.executable, "-m", "operator_forge"]
+        if cmd == "init":
+            args += [
+                "init", "--workload-config", config,
+                "--repo", "github.com/acme/bookstore-operator",
+                "--output-dir", str(root / "proj"),
+            ]
+        else:
+            args += [
+                "create", "api", "--workload-config", config,
+                "--output-dir", str(root / "proj"),
+            ]
+        subprocess.run(
+            args, check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+    return str(root / "proj" / "pkg" / "orchestrate")
+
+
+@pytest.fixture(scope="module")
+def interp(orchestrate_dir):
+    it = Interp()
+    it.load_dir(orchestrate_dir)
+    return it
+
+
+# -- fakes: same roles the emitted Go tests' fakes play ---------------------
+
+
+class FakeTime:
+    def __init__(self, zero):
+        self.zero = zero
+
+    def IsZero(self):
+        return self.zero
+
+
+class FakeWorkload:
+    def __init__(self, deleting=False, created=False):
+        self.ts = FakeTime(not deleting)
+        self.created = created
+        self.conditions = []
+
+    def GetDeletionTimestamp(self):
+        return self.ts
+
+    def GetCreatedStatus(self):
+        return self.created
+
+    def SetPhaseCondition(self, cond):
+        self.conditions.append((
+            cond.fields.get("Phase"),
+            cond.fields.get("State"),
+            cond.fields.get("Message"),
+        ))
+
+
+class FakeStatus:
+    def __init__(self, fail=None):
+        self.fail = fail
+        self.updates = 0
+
+    def Update(self, ctx, workload):
+        self.updates += 1
+        return self.fail
+
+
+class FakeLogger:
+    def __init__(self):
+        self.errors = []
+
+    def Error(self, err, msg, *kv):
+        self.errors.append(msg)
+
+
+class FakeReconciler:
+    def __init__(self, store=None, fail_status=None):
+        self.store = store or {}
+        self.status = FakeStatus(fail_status)
+        self.log = FakeLogger()
+
+    def Get(self, ctx, nn, live):
+        key = (nn.fields.get("Namespace"), nn.fields.get("Name"))
+        obj = self.store.get(key)
+        if obj is None:
+            return GoError("not found", not_found=True)
+        live.Object = obj
+        return None
+
+    def Status(self):
+        return self.status
+
+    def GetLogger(self):
+        return self.log
+
+
+class FakeResource:
+    def __init__(self, kind, ns, name):
+        self.kind, self.ns, self.name = kind, ns, name
+
+    def GetObjectKind(self):
+        return self
+
+    def GroupVersionKind(self):
+        return GoStruct("GroupVersionKind", {"Kind": self.kind})
+
+    def GetName(self):
+        return self.name
+
+    def GetNamespace(self):
+        return self.ns
+
+
+def _ready(interp, kind, obj):
+    store = {("ns", "x"): dict(obj, kind=kind)}
+    req = GoStruct("Request", {"Context": None})
+    return interp.call(
+        "ResourceIsReady", FakeReconciler(store), req,
+        FakeResource(kind, "ns", "x"),
+    )
+
+
+# the same scenario table the emitted ready_test.go asserts
+READY_CASES = [
+    ("deployment short", "Deployment",
+     {"spec": {"replicas": 3}, "status": {"readyReplicas": 2}}, False),
+    ("deployment full", "Deployment",
+     {"spec": {"replicas": 3}, "status": {"readyReplicas": 3}}, True),
+    ("deployment default replicas", "Deployment",
+     {"status": {"readyReplicas": 1}}, True),
+    ("statefulset short", "StatefulSet",
+     {"spec": {"replicas": 2}, "status": {"readyReplicas": 1}}, False),
+    ("replicaset full", "ReplicaSet",
+     {"spec": {"replicas": 1}, "status": {"readyReplicas": 1}}, True),
+    ("daemonset full", "DaemonSet",
+     {"status": {"desiredNumberScheduled": 2, "numberReady": 2}}, True),
+    ("daemonset short", "DaemonSet",
+     {"status": {"desiredNumberScheduled": 2, "numberReady": 1}}, False),
+    ("job succeeded", "Job", {"status": {"succeeded": 1}}, True),
+    ("job pending", "Job", {"status": {}}, False),
+    ("pod running ready", "Pod",
+     {"status": {"phase": "Running",
+                 "conditions": [{"type": "Ready", "status": "True"}]}},
+     True),
+    ("pod running unready", "Pod",
+     {"status": {"phase": "Running",
+                 "conditions": [{"type": "Ready", "status": "False"}]}},
+     False),
+    ("pod succeeded", "Pod", {"status": {"phase": "Succeeded"}}, True),
+    ("pod pending", "Pod", {"status": {"phase": "Pending"}}, False),
+    ("namespace active", "Namespace",
+     {"status": {"phase": "Active"}}, True),
+    ("namespace terminating", "Namespace",
+     {"status": {"phase": "Terminating"}}, False),
+    ("pvc bound", "PersistentVolumeClaim",
+     {"status": {"phase": "Bound"}}, True),
+    ("pvc pending", "PersistentVolumeClaim",
+     {"status": {"phase": "Pending"}}, False),
+    ("crd established", "CustomResourceDefinition",
+     {"status": {"conditions": [{"type": "Established",
+                                 "status": "True"}]}}, True),
+    ("crd not established", "CustomResourceDefinition",
+     {"status": {"conditions": []}}, False),
+    ("ingress no class ready", "Ingress", {"spec": {}}, True),
+    ("ingress class waiting", "Ingress",
+     {"spec": {"ingressClassName": "nginx"}, "status": {}}, False),
+    ("ingress class lb", "Ingress",
+     {"spec": {"ingressClassName": "nginx"},
+      "status": {"loadBalancer": {"ingress": [{"ip": "10.0.0.1"}]}}},
+     True),
+    ("unknown kind exists", "ConfigMap", {}, True),
+]
+
+
+class TestInterpretedReadiness:
+    """ResourceIsReady, executed from the emitted source."""
+
+    @pytest.mark.parametrize(
+        "name,kind,obj,want", READY_CASES, ids=[c[0] for c in READY_CASES]
+    )
+    def test_readiness(self, interp, name, kind, obj, want):
+        got, err = _ready(interp, kind, obj)
+        assert err is None
+        assert got is want
+
+    def test_absent_object_not_ready(self, interp):
+        req = GoStruct("Request", {"Context": None})
+        got, err = interp.call(
+            "ResourceIsReady", FakeReconciler({}), req,
+            FakeResource("Deployment", "ns", "x"),
+        )
+        assert (got, err) == (False, None)
+
+
+def _registry(interp):
+    registry = GoStruct("Registry", {"phases": []})
+    interp.call("RegisterDefaultPhases", registry)
+    return registry
+
+
+def _stub_phases(registry):
+    order = []
+
+    def stub(name, proceed=True, err=None):
+        def do(r, req):
+            order.append(name)
+            return (proceed, err)
+        return do
+
+    for phase in registry.fields["phases"]:
+        phase.fields["Do"] = stub(phase.fields["Name"])
+    return order
+
+
+class TestInterpretedPhases:
+    """Registry.HandleExecution + RegisterDefaultPhases, executed from
+    the emitted source with recording stub handlers."""
+
+    def test_default_phase_order(self, interp):
+        names = [
+            p.fields["Name"] for p in _registry(interp).fields["phases"]
+        ]
+        assert names == [
+            "Register-Finalizer", "Dependency", "Create-Resources",
+            "Check-Ready", "Complete", "Teardown-Children",
+            "Deletion-Complete",
+        ]
+
+    def test_update_pass_runs_create_update_phases_in_order(self, interp):
+        registry = _registry(interp)
+        order = _stub_phases(registry)
+        workload = FakeWorkload(created=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        result, err = interp.call_method(
+            registry, "HandleExecution", FakeReconciler(), req
+        )
+        assert err is None
+        assert order == [
+            "Register-Finalizer", "Dependency", "Create-Resources",
+            "Check-Ready", "Complete",
+        ]
+        assert all(state == "Complete" for _, state, _ in workload.conditions)
+
+    def test_delete_pass_runs_teardown_phases_only(self, interp):
+        registry = _registry(interp)
+        order = _stub_phases(registry)
+        workload = FakeWorkload(deleting=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        _result, err = interp.call_method(
+            registry, "HandleExecution", FakeReconciler(), req
+        )
+        assert err is None
+        assert order == ["Teardown-Children", "Deletion-Complete"]
+
+    def test_pending_phase_requeues_with_its_interval(self, interp):
+        registry = _registry(interp)
+        order = _stub_phases(registry)
+        # make Dependency report not-ready
+        dep = registry.fields["phases"][1]
+        name = dep.fields["Name"]
+
+        def do(r, req):
+            order.append(name)
+            return (False, None)
+        dep.fields["Do"] = do
+
+        workload = FakeWorkload(created=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        result, err = interp.call_method(
+            registry, "HandleExecution", FakeReconciler(), req
+        )
+        assert err is None
+        assert order == ["Register-Finalizer", "Dependency"]
+        assert result.fields["RequeueAfter"] == 5 * 10**9  # 5s
+        assert workload.conditions[-1] == (
+            "Dependency", "Running", "phase is waiting to complete"
+        )
+
+    def test_failing_phase_records_failed_and_wraps_error(self, interp):
+        registry = _registry(interp)
+        order = _stub_phases(registry)
+        dep = registry.fields["phases"][1]
+
+        def do(r, req):
+            order.append("Dependency")
+            return (None, GoError("boom"))
+        dep.fields["Do"] = do
+
+        workload = FakeWorkload(created=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        _result, err = interp.call_method(
+            registry, "HandleExecution", FakeReconciler(), req
+        )
+        assert err is not None
+        assert err.msg == "error executing phase Dependency: boom"
+        assert workload.conditions[-1] == ("Dependency", "Failed", "boom")
+
+    def test_delete_pass_tolerates_pruned_parent_on_status_write(
+        self, interp
+    ):
+        # once the finalizer is stripped the parent may be gone before
+        # the trailing status write: NotFound is success on delete
+        registry = _registry(interp)
+        _stub_phases(registry)
+        workload = FakeWorkload(deleting=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        reconciler = FakeReconciler(
+            fail_status=GoError("gone", not_found=True)
+        )
+        _result, err = interp.call_method(
+            registry, "HandleExecution", reconciler, req
+        )
+        assert err is None
+
+    def test_event_classification(self, interp):
+        for deleting, created, want in [
+            (True, True, "Delete"),
+            (False, True, "Update"),
+            (False, False, "Create"),
+        ]:
+            req = GoStruct("Request", {
+                "Context": None,
+                "Workload": FakeWorkload(deleting=deleting, created=created),
+            })
+            assert interp.call_method(req, "Event") == want
+
+
+class _OwnerWorkload:
+    def __init__(self, kind="BookStore", group="shop.example.io",
+                 ns="default", name="store"):
+        self.kind, self.group, self.ns, self.name = kind, group, ns, name
+
+    def GetWorkloadGVK(self):
+        return GoStruct("GroupVersionKind", {
+            "Group": self.group, "Version": "v1alpha1", "Kind": self.kind,
+        })
+
+    def GetNamespace(self):
+        return self.ns
+
+    def GetName(self):
+        return self.name
+
+
+def _fnv32a(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class TestInterpretedFinalizers:
+    """Owner-identity helpers, executed from the emitted source (same
+    ground the emitted orchestrate_test.go covers)."""
+
+    def test_finalizer_key(self, interp):
+        assert interp.call("Finalizer", _OwnerWorkload()) == (
+            "shop.example.io/finalizer"
+        )
+
+    def test_finalizer_key_groupless_fallback(self, interp):
+        assert interp.call("Finalizer", _OwnerWorkload(group="")) == (
+            "orchestrate.workload/finalizer"
+        )
+
+    def test_owner_annotation_identity(self, interp):
+        key, value = interp.call("OwnerAnnotation", _OwnerWorkload())
+        assert key == "shop.example.io/owner"
+        assert value == "BookStore:default:store"
+
+    def test_owner_label_is_fnv1a_of_identity(self, interp):
+        key, value = interp.call("OwnerLabel", _OwnerWorkload())
+        assert key == "shop.example.io/owner-hash"
+        assert value == "%08x" % _fnv32a(b"BookStore:default:store")
+
+    def test_mark_owned_then_owned_by(self, interp):
+        resource = _UnstructuredModule.Unstructured()
+        workload = _OwnerWorkload()
+        interp.call("MarkOwned", workload, resource)
+        assert resource.GetAnnotations() == {
+            "shop.example.io/owner": "BookStore:default:store",
+        }
+        assert set(resource.GetLabels()) == {"shop.example.io/owner-hash"}
+        assert interp.call("OwnedBy", workload, resource) is True
+
+    def test_not_owned_by_other_workload(self, interp):
+        resource = _UnstructuredModule.Unstructured()
+        interp.call("MarkOwned", _OwnerWorkload(name="store"), resource)
+        other = _OwnerWorkload(name="other")
+        assert interp.call("OwnedBy", other, resource) is False
+
+    def test_unannotated_resource_not_owned(self, interp):
+        resource = _UnstructuredModule.Unstructured()
+        assert interp.call("OwnedBy", _OwnerWorkload(), resource) is False
+
+
+class TestInterpreterSemantics:
+    """Spot checks of Go semantics the interpreter must model, on tiny
+    hand-written sources (the emitted code exercises them indirectly)."""
+
+    def test_if_init_scope_covers_else(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func pick(m map[string]string, k string) string {\n"
+            "\tif v, ok := m[k]; ok {\n"
+            "\t\treturn v\n"
+            "\t} else {\n"
+            '\t\treturn v + "!"\n'
+            "\t}\n"
+            "}\n"
+        )
+        assert it.call("pick", {"a": "x"}, "a") == "x"
+        assert it.call("pick", {}, "a") == "!"
+
+    def test_single_form_type_assertion(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func f(x interface{}) int {\n"
+            "\ts := x.(string)\n"
+            "\treturn len(s)\n"
+            "}\n"
+        )
+        assert it.call("f", "abc") == 3
+
+    def test_missing_map_key_is_zero_value(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func f(m map[string]string) bool {\n"
+            '\treturn m["absent"] == ""\n'
+            "}\n"
+        )
+        assert it.call("f", {"other": "x"}) is True
+
+    def test_fnv_matches_go(self):
+        # FNV-1a 32-bit reference value for "hello" is 0x4f9f2cab
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            'import "hash/fnv"\n\n'
+            "func f(s string) uint32 {\n"
+            "\th := fnv.New32a()\n"
+            "\t_, _ = h.Write([]byte(s))\n"
+            "\treturn h.Sum32()\n"
+            "}\n"
+        )
+        assert it.call("f", "hello") == 0x4F9F2CAB
+
+
+MUTATIONS = [
+    # (file, original, mutated, scenario name that must flip)
+    ("ready.go", "readyReplicas >= specReplicas",
+     "readyReplicas > specReplicas", "deployment-threshold"),
+    ("ready.go", 'case "StatefulSet":', 'case "StatefulSett":',
+     "statefulset-case-dropped"),
+    ("phases.go", "if !phase.handles(event) {",
+     "if phase.handles(event) {", "event-filter-inverted"),
+    ("handlers.go", 'Events:       []Event{DeleteEvent},',
+     'Events:       []Event{CreateEvent},', "teardown-events"),
+]
+
+
+class TestSeededMutationsDetected:
+    """The point of interpreting the EMITTED text: a logic mutation in
+    the generated output changes observed behavior here, in Python,
+    without any Go toolchain."""
+
+    @pytest.mark.parametrize(
+        "fname,orig,mutated,label", MUTATIONS,
+        ids=[m[3] for m in MUTATIONS],
+    )
+    def test_mutation_changes_behavior(
+        self, orchestrate_dir, tmp_path, fname, orig, mutated, label
+    ):
+        mutated_dir = str(tmp_path / "orchestrate")
+        shutil.copytree(orchestrate_dir, mutated_dir)
+        path = os.path.join(mutated_dir, fname)
+        with open(path) as fh:
+            text = fh.read()
+        assert orig in text, f"mutation anchor missing: {orig!r}"
+        with open(path, "w") as fh:
+            fh.write(text.replace(orig, mutated))
+
+        it = Interp()
+        it.load_dir(mutated_dir)
+
+        if label == "deployment-threshold":
+            got, _err = _ready(it, "Deployment", {
+                "spec": {"replicas": 3}, "status": {"readyReplicas": 3},
+            })
+            assert got is False  # healthy baseline says True
+        elif label == "statefulset-case-dropped":
+            got, _err = _ready(it, "StatefulSet", {
+                "spec": {"replicas": 2}, "status": {"readyReplicas": 1},
+            })
+            assert got is True  # falls to ready-on-existence default
+        elif label == "event-filter-inverted":
+            registry = GoStruct("Registry", {"phases": []})
+            it.call("RegisterDefaultPhases", registry)
+            order = _stub_phases(registry)
+            workload = FakeWorkload(created=True)
+            req = GoStruct(
+                "Request", {"Context": None, "Workload": workload}
+            )
+            it.call_method(
+                registry, "HandleExecution", FakeReconciler(), req
+            )
+            assert order == ["Teardown-Children", "Deletion-Complete"]
+        elif label == "teardown-events":
+            registry = GoStruct("Registry", {"phases": []})
+            it.call("RegisterDefaultPhases", registry)
+            order = _stub_phases(registry)
+            workload = FakeWorkload(deleting=True)
+            req = GoStruct(
+                "Request", {"Context": None, "Workload": workload}
+            )
+            it.call_method(
+                registry, "HandleExecution", FakeReconciler(), req
+            )
+            assert "Teardown-Children" not in order
