@@ -25,7 +25,7 @@ from operator_forge.utils import yamlcompat as pyyaml
 
 from .. import __version__
 from .. import licensing
-from ..scaffold.api import scaffold_api
+from ..scaffold.api import scaffold_api, scaffold_webhook
 from ..scaffold.context import ProjectConfig
 from ..scaffold.machinery import ScaffoldError
 from ..scaffold.project import scaffold_init
@@ -109,6 +109,105 @@ def cmd_init(args: argparse.Namespace) -> int:
     return 0
 
 
+def _report_dry_run(scaffold, project_changed: bool) -> None:
+    """Print the dry-run change list + summary (shared by `create api`
+    and `create webhook`)."""
+    if project_changed:
+        scaffold.changes.append(("overwrite", "PROJECT"))
+    counts: dict[str, int] = {}
+    for action, path in scaffold.changes:
+        counts[action] = counts.get(action, 0) + 1
+        print(f"{action:9s} {path}")
+    summary = ", ".join(
+        f"{counts[a]} {a}"
+        for a in ("create", "overwrite", "fragment", "unchanged", "preserve")
+        if a in counts
+    )
+    print(f"dry run: {summary or 'no changes'}; nothing written")
+
+
+def _persist_project(config: ProjectConfig, output_dir: str) -> None:
+    with open(
+        os.path.join(output_dir, "PROJECT"), "w", encoding="utf-8"
+    ) as handle:
+        handle.write(config.to_yaml())
+
+
+def cmd_create_webhook(args: argparse.Namespace) -> int:
+    """`create webhook`: admission-webhook scaffolding (the reference
+    CLI inherits kubebuilder's command via the golangv3 bundle,
+    reference pkg/cli/init.go:27-41)."""
+    if not args.defaulting and not args.programmatic_validation:
+        raise CLIError(
+            "nothing to scaffold: pass --defaulting and/or "
+            "--programmatic-validation"
+        )
+    config = _load_project(args.output_dir)
+    workload_config = args.workload_config or os.path.join(
+        args.output_dir, config.workload_config_path
+    )
+    if not workload_config or not os.path.exists(workload_config):
+        raise CLIError(
+            f"workload config not found at {workload_config!r}; pass "
+            "--workload-config"
+        )
+    if not os.path.exists(os.path.join(args.output_dir, "main.go")):
+        raise CLIError(
+            "main.go not found: run `create api` before `create webhook`"
+        )
+
+    processor = wconfig.parse(workload_config)
+    init_workloads(processor)
+    run_create_api(processor)
+
+    changed = (
+        (args.defaulting and not config.webhook_defaulting)
+        or (args.programmatic_validation and not config.webhook_validation)
+    )
+    config.webhook_defaulting = (
+        config.webhook_defaulting or args.defaulting
+    )
+    config.webhook_validation = (
+        config.webhook_validation or args.programmatic_validation
+    )
+
+    # the stub is user-owned (SKIP): a pre-existing stub missing a
+    # newly requested interface can't be upgraded in place, and
+    # emitting manifests for an unserved path would reject every write
+    # in-cluster (failurePolicy: Fail) — refuse, like kubebuilder does
+    from ..scaffold.context import views_for
+    from ..scaffold.templates import admission as admission_tpl
+
+    stale = admission_tpl.stale_stubs(
+        views_for(processor.get_workloads(), config),
+        args.output_dir,
+        config.webhook_defaulting,
+        config.webhook_validation,
+    )
+    if stale:
+        raise CLIError("\n".join(stale))
+
+    scaffold = scaffold_webhook(
+        args.output_dir,
+        processor,
+        config,
+        boilerplate_text=_boilerplate_text(args.output_dir),
+        dry_run=args.dry_run,
+    )
+
+    if args.dry_run:
+        _report_dry_run(scaffold, changed)
+        return 0
+
+    if changed:
+        _persist_project(config, args.output_dir)
+    print(
+        f"webhook scaffolded at {args.output_dir} "
+        f"({len(scaffold.written)} files, {len(scaffold.skipped)} preserved)"
+    )
+    return 0
+
+
 def cmd_create_api(args: argparse.Namespace) -> int:
     if not args.resource and not args.controller:
         raise CLIError(
@@ -144,29 +243,15 @@ def cmd_create_api(args: argparse.Namespace) -> int:
     )
 
     if args.dry_run:
-        if newly_enabled:
-            # the real run records the conversion opt-in in PROJECT
-            scaffold.changes.append(("overwrite", "PROJECT"))
-        counts: dict[str, int] = {}
-        for action, path in scaffold.changes:
-            counts[action] = counts.get(action, 0) + 1
-            print(f"{action:9s} {path}")
-        summary = ", ".join(
-            f"{counts[a]} {a}"
-            for a in ("create", "overwrite", "fragment", "unchanged", "preserve")
-            if a in counts
-        )
-        print(f"dry run: {summary or 'no changes'}; nothing written")
+        # the real run records the conversion opt-in in PROJECT
+        _report_dry_run(scaffold, newly_enabled)
         return 0
 
     # persist the opt-in only after a successful scaffold: recording it
     # first would make every later plain `create api` re-enter a failing
     # conversion path
     if newly_enabled:
-        with open(
-            os.path.join(args.output_dir, "PROJECT"), "w", encoding="utf-8"
-        ) as handle:
-            handle.write(config.to_yaml())
+        _persist_project(config, args.output_dir)
     print(
         f"api scaffolded at {args.output_dir} "
         f"({len(scaffold.written)} files, {len(scaffold.skipped)} preserved)"
@@ -206,7 +291,7 @@ _operator_forge() {
         operator-forge)
             COMPREPLY=($(compgen -W "init create init-config update completion version preview validate vet" -- "$cur"));;
         create)
-            COMPREPLY=($(compgen -W "api" -- "$cur"));;
+            COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
             COMPREPLY=($(compgen -W "standalone collection component" -- "$cur"));;
         update)
@@ -402,6 +487,30 @@ def build_parser() -> argparse.ArgumentParser:
         "kinds with multiple API versions; persisted in the PROJECT file",
     )
     p_api.set_defaults(func=cmd_create_api)
+
+    p_webhook = create_sub.add_parser(
+        "webhook",
+        help="scaffold defaulting/validating admission webhooks "
+        "(kubebuilder-compatible; run after `create api`)",
+    )
+    p_webhook.add_argument("--workload-config", default="")
+    p_webhook.add_argument("--output-dir", default=".")
+    p_webhook.add_argument(
+        "--defaulting", action="store_true",
+        help="scaffold a webhook.Defaulter (mutating webhook)",
+    )
+    p_webhook.add_argument(
+        "--programmatic-validation", action="store_true",
+        help="scaffold a webhook.Validator (validating webhook)",
+    )
+    p_webhook.add_argument("--force", action="store_true")
+    p_webhook.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report what would be created/overwritten/preserved "
+        "without writing anything",
+    )
+    p_webhook.set_defaults(func=cmd_create_webhook)
 
     p_cfg = sub.add_parser(
         "init-config", help="emit a sample workload config"

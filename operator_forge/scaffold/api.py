@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..workload.config import Processor
 from .context import ProjectConfig, WorkloadView, views_for
 from .machinery import FileSpec, Fragment, Scaffold
+from .templates import admission as admission_tpl
 from .templates import api as api_tpl
 from .templates import companion_cli as cli_tpl
 from .templates import controller as controller_tpl
@@ -164,6 +165,12 @@ def scaffold_api(
         views, output_dir, with_resources, with_controllers, enable_conversion
     )
 
+    # admission webhooks recorded in PROJECT: keep their manifests and
+    # wiring in sync on every re-scaffold
+    admission = (
+        config.webhook_defaulting or config.webhook_validation
+    ) and with_resources
+
     multi_version = []
     if enable_conversion and with_resources:
         # infra is only scaffolded once a kind actually has 2+ versions
@@ -171,16 +178,30 @@ def scaffold_api(
             v for v in views if webhook_tpl.other_versions(v, output_dir)
         ]
         if multi_version:
-            specs.extend(webhook_tpl.webhook_config_tree(config))
+            specs.extend(
+                spec for spec in webhook_tpl.webhook_config_tree(config)
+                # with admission on, _admission_specs supplies the
+                # webhook kustomization (manifests + service)
+                if not admission
+                or spec.path != "config/webhook/kustomization.yaml"
+            )
             for view in multi_version:
                 fragments.append(
                     webhook_tpl.main_go_webhook_fragment(
                         view, webhook_tpl.hub_version(view, output_dir)
                     )
                 )
+    if admission:
+        specs.extend(
+            _admission_specs(views, config, include_tree=not multi_version)
+        )
+        for view in views:
+            fragments.extend(
+                admission_tpl.main_go_admission_fragments(view)
+            )
 
     scaffold.execute(specs, fragments)
-    if multi_version:
+    if multi_version or admission:
         changed = webhook_tpl.update_default_kustomization(
             output_dir, dry_run=dry_run
         )
@@ -188,4 +209,66 @@ def scaffold_api(
             scaffold.changes.append(
                 ("fragment", "config/default/kustomization.yaml")
             )
+    return scaffold
+
+
+def _admission_specs(
+    views: list[WorkloadView],
+    config: ProjectConfig,
+    include_tree: bool = True,
+) -> list[FileSpec]:
+    # the shared tree, minus its conversion-only webhook kustomization —
+    # the admission variant below replaces it, and emitting both would
+    # double-write the file and contradict the dry-run report.  The
+    # caller passes include_tree=False when the conversion path already
+    # emitted the tree this run.
+    specs: list[FileSpec] = []
+    if include_tree:
+        specs.extend(
+            spec for spec in webhook_tpl.webhook_config_tree(config)
+            if spec.path != "config/webhook/kustomization.yaml"
+        )
+    for view in views:
+        specs.append(
+            admission_tpl.webhook_stub_file(
+                view, config.webhook_defaulting, config.webhook_validation
+            )
+        )
+    specs.append(
+        admission_tpl.webhook_manifests_file(
+            config, views, config.webhook_defaulting,
+            config.webhook_validation,
+        )
+    )
+    specs.append(admission_tpl.webhook_kustomization_file())
+    return specs
+
+
+def scaffold_webhook(
+    output_dir: str,
+    processor: Processor,
+    config: ProjectConfig,
+    boilerplate_text: str = "",
+    dry_run: bool = False,
+) -> Scaffold:
+    """The `create webhook` scaffolder: admission stubs, registration
+    objects, cert-manager wiring, and main.go registration for every
+    workload kind.  ``config.webhook_defaulting`` / ``webhook_validation``
+    select the interfaces scaffolded."""
+    views = views_for(processor.get_workloads(), config)
+    scaffold = Scaffold(
+        output_dir=output_dir, boilerplate=boilerplate_text, dry_run=dry_run
+    )
+    specs = _admission_specs(views, config)
+    fragments: list[Fragment] = []
+    for view in views:
+        fragments.extend(admission_tpl.main_go_admission_fragments(view))
+    scaffold.execute(specs, fragments)
+    changed = webhook_tpl.update_default_kustomization(
+        output_dir, dry_run=dry_run
+    )
+    if dry_run and changed:
+        scaffold.changes.append(
+            ("fragment", "config/default/kustomization.yaml")
+        )
     return scaffold

@@ -1,0 +1,282 @@
+"""Admission-webhook scaffolding: ``create webhook``.
+
+The reference binary inherits kubebuilder's ``create webhook``
+(defaulting and validating admission webhooks) through the golangv3
+bundle it registers (reference pkg/cli/init.go:27-41); the workload
+plugin itself never scaffolds them, but the CLI surface exists and the
+kubebuilder docs it points users at describe exactly this output.  This
+module produces the same end state for operator-forge projects:
+
+- a user-owned ``<kind>_webhook.go`` beside the API types implementing
+  ``webhook.Defaulter`` and/or ``webhook.Validator`` (SKIP on
+  re-scaffold, like the mutate/dependencies hooks),
+- ``config/webhook/manifests.yaml`` with the Mutating/Validating
+  WebhookConfiguration objects (kubebuilder derives these from
+  ``//+kubebuilder:webhook`` markers via controller-gen at build time;
+  operator-forge generates config directly, as it does for CRDs),
+- the shared webhook Service / cert-manager tree and manager patch
+  (reused from the conversion-webhook scaffolding),
+- a ``main.go`` registration fragment per kind.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..context import ProjectConfig, WorkloadView
+from ..machinery import FileSpec, Fragment, IfExists
+from ...utils.names import to_file_name
+
+
+def webhook_path(view: WorkloadView, kind_of: str) -> str:
+    """kubebuilder's serving path: /mutate-<group-dashed>-<version>-<kind>."""
+    dashed = view.full_group.replace(".", "-")
+    return f"/{kind_of}-{dashed}-{view.version}-{view.kind_lower}"
+
+
+def webhook_stub_file(
+    view: WorkloadView, defaulting: bool, validation: bool
+) -> FileSpec:
+    """The user-owned webhook implementation beside the API types
+    (kubebuilder: api/<version>/<kind>_webhook.go)."""
+    kind = view.kind
+    logger = f"{view.kind_lower}log"
+    imports = ['\tctrl "sigs.k8s.io/controller-runtime"']
+    if validation:
+        imports.insert(0, '\t"k8s.io/apimachinery/pkg/runtime"')
+    imports.append('\tlogf "sigs.k8s.io/controller-runtime/pkg/log"')
+    imports.append('\t"sigs.k8s.io/controller-runtime/pkg/webhook"')
+
+    parts = [
+        f"package {view.version}\n",
+        "import (\n" + "\n".join(imports) + "\n)\n",
+        f'// log is for logging in this package.\n'
+        f'var {logger} = logf.Log.WithName("{view.kind_lower}-resource")\n',
+        f"// SetupWebhookWithManager registers the webhook for {kind}\n"
+        f"// with the manager.\n"
+        f"func (r *{kind}) SetupWebhookWithManager(mgr ctrl.Manager) error {{\n"
+        f"\treturn ctrl.NewWebhookManagedBy(mgr).\n"
+        f"\t\tFor(r).\n"
+        f"\t\tComplete()\n"
+        f"}}\n",
+    ]
+    if defaulting:
+        parts.append(
+            f"//+kubebuilder:webhook:path={webhook_path(view, 'mutate')},"
+            f"mutating=true,failurePolicy=fail,sideEffects=None,"
+            f"groups={view.full_group},resources={view.plural.lower()},"
+            f"verbs=create;update,versions={view.version},"
+            f"name=m{view.kind_lower}.kb.io,admissionReviewVersions=v1\n\n"
+            f"var _ webhook.Defaulter = &{kind}{{}}\n",
+        )
+        parts.append(
+            f"// Default implements webhook.Defaulter so a webhook will be\n"
+            f"// registered for the type.\n"
+            f"func (r *{kind}) Default() {{\n"
+            f'\t{logger}.Info("default", "name", r.Name)\n\n'
+            f"\t// TODO: fill in defaulting logic.\n"
+            f"}}\n",
+        )
+    if validation:
+        parts.append(
+            f"//+kubebuilder:webhook:path={webhook_path(view, 'validate')},"
+            f"mutating=false,failurePolicy=fail,sideEffects=None,"
+            f"groups={view.full_group},resources={view.plural.lower()},"
+            f"verbs=create;update,versions={view.version},"
+            f"name=v{view.kind_lower}.kb.io,admissionReviewVersions=v1\n\n"
+            f"var _ webhook.Validator = &{kind}{{}}\n",
+        )
+        parts.append(
+            f"// ValidateCreate implements webhook.Validator so a webhook\n"
+            f"// will be registered for the type.\n"
+            f"func (r *{kind}) ValidateCreate() error {{\n"
+            f'\t{logger}.Info("validate create", "name", r.Name)\n\n'
+            f"\t// TODO: fill in create validation logic.\n"
+            f"\treturn nil\n"
+            f"}}\n",
+        )
+        parts.append(
+            f"// ValidateUpdate implements webhook.Validator so a webhook\n"
+            f"// will be registered for the type.\n"
+            f"func (r *{kind}) ValidateUpdate(old runtime.Object) error {{\n"
+            f'\t{logger}.Info("validate update", "name", r.Name)\n\n'
+            f"\t// TODO: fill in update validation logic.\n"
+            f"\treturn nil\n"
+            f"}}\n",
+        )
+        parts.append(
+            f"// ValidateDelete implements webhook.Validator so a webhook\n"
+            f"// will be registered for the type.\n"
+            f"func (r *{kind}) ValidateDelete() error {{\n"
+            f'\t{logger}.Info("validate delete", "name", r.Name)\n\n'
+            f"\t// TODO: fill in delete validation logic.\n"
+            f"\treturn nil\n"
+            f"}}\n",
+        )
+    content = "\n".join(parts)
+    path = (
+        f"apis/{view.group}/{view.version}/"
+        f"{to_file_name(view.kind_lower)}_webhook.go"
+    )
+    # user-owned: preserved on re-scaffold, like mutate/dependencies hooks
+    return FileSpec(path=path, content=content, if_exists=IfExists.SKIP)
+
+
+def stale_stubs(
+    views: list[WorkloadView],
+    output_dir: str,
+    defaulting: bool,
+    validation: bool,
+) -> list[str]:
+    """Existing user-owned stubs missing a requested interface.  The
+    stub is SKIP-preserved, so scaffolding over it can't add the
+    methods; silently emitting manifests for an unserved path would
+    reject every write in-cluster (failurePolicy: Fail).  kubebuilder
+    errors on the existing file; so do we."""
+    problems = []
+    for view in views:
+        path = (
+            f"apis/{view.group}/{view.version}/"
+            f"{to_file_name(view.kind_lower)}_webhook.go"
+        )
+        full = os.path.join(output_dir, path)
+        if not os.path.exists(full):
+            continue
+        with open(full, encoding="utf-8") as fh:
+            text = fh.read()
+        if defaulting and "webhook.Defaulter" not in text:
+            problems.append(
+                f"{path}: exists without webhook.Defaulter — add the "
+                f"Default() method yourself or delete the file to "
+                f"re-scaffold it"
+            )
+        if validation and "webhook.Validator" not in text:
+            problems.append(
+                f"{path}: exists without webhook.Validator — add the "
+                f"Validate* methods yourself or delete the file to "
+                f"re-scaffold it"
+            )
+    return problems
+
+
+def _webhook_entry(
+    config: ProjectConfig, view: WorkloadView, kind_of: str
+) -> str:
+    """One entry of a WebhookConfiguration's ``webhooks`` list."""
+    project = config.project_name
+    prefix = "m" if kind_of == "mutate" else "v"
+    return f"""- admissionReviewVersions:
+  - v1
+  clientConfig:
+    service:
+      name: {project}-webhook-service
+      namespace: {project}-system
+      path: {webhook_path(view, kind_of)}
+  failurePolicy: Fail
+  name: {prefix}{view.kind_lower}.kb.io
+  rules:
+  - apiGroups:
+    - {view.full_group}
+    apiVersions:
+    - {view.version}
+    operations:
+    - CREATE
+    - UPDATE
+    resources:
+    - {view.plural.lower()}
+  sideEffects: None
+"""
+
+
+def webhook_manifests_file(
+    config: ProjectConfig,
+    views: list[WorkloadView],
+    defaulting: bool,
+    validation: bool,
+) -> FileSpec:
+    """config/webhook/manifests.yaml: the admission registration objects
+    (kubebuilder emits these from controller-gen; generated directly
+    here, with the cert-manager CA injection annotation inlined since no
+    kustomize patch pipeline runs afterwards)."""
+    project = config.project_name
+    ca_annotation = (
+        f"    cert-manager.io/inject-ca-from: "
+        f"{project}-system/{project}-serving-cert"
+    )
+    docs = []
+    if defaulting:
+        entries = "".join(
+            _webhook_entry(config, view, "mutate") for view in views
+        )
+        # metadata.name stays unprefixed: the kustomize namePrefix in
+        # config/default adds the project prefix (inlined service/CA
+        # names are NOT rewritten by kustomize, so those stay full)
+        docs.append(
+            f"""apiVersion: admissionregistration.k8s.io/v1
+kind: MutatingWebhookConfiguration
+metadata:
+  name: mutating-webhook-configuration
+  annotations:
+{ca_annotation}
+webhooks:
+{entries}"""
+        )
+    if validation:
+        entries = "".join(
+            _webhook_entry(config, view, "validate") for view in views
+        )
+        docs.append(
+            f"""apiVersion: admissionregistration.k8s.io/v1
+kind: ValidatingWebhookConfiguration
+metadata:
+  name: validating-webhook-configuration
+  annotations:
+{ca_annotation}
+webhooks:
+{entries}"""
+        )
+    return FileSpec(
+        path="config/webhook/manifests.yaml",
+        content="---\n".join(docs),
+        add_boilerplate=False,
+    )
+
+
+def webhook_kustomization_file() -> FileSpec:
+    """config/webhook/kustomization.yaml listing the admission manifests
+    next to the service (overwrites the conversion-only variant)."""
+    return FileSpec(
+        path="config/webhook/kustomization.yaml",
+        content="""resources:
+- manifests.yaml
+- service.yaml
+""",
+        add_boilerplate=False,
+    )
+
+
+def main_go_admission_fragments(view: WorkloadView) -> list[Fragment]:
+    """Register the kind's webhook with the manager.  The api-types
+    import fragment is repeated defensively (fragment insertion is
+    idempotent) so `create webhook` works even on a main.go scaffolded
+    without this kind."""
+    alias = view.api_import_alias
+    return [
+        Fragment(
+            path="main.go",
+            marker="imports",
+            code=f'{alias} "{view.api_types_import}"',
+        ),
+        Fragment(
+            path="main.go",
+            marker="reconcilers",
+            code=(
+                f"if err := (&{alias}.{view.kind}{{}})."
+                f"SetupWebhookWithManager(mgr); err != nil {{\n"
+                f'\tsetupLog.Error(err, "unable to create webhook", '
+                f'"webhook", "{view.kind}")\n'
+                f"\tos.Exit(1)\n"
+                f"}}\n"
+            ),
+        ),
+    ]

@@ -497,6 +497,122 @@ class TestGoSyntax:
         errors = check_project(project)
         assert not errors, "\n".join(errors)
 
+    def test_create_webhook_scaffolds_vet_clean_project(self, tmp_path):
+        """`create webhook --defaulting --programmatic-validation` on
+        the standalone fixture: new files exist and the project still
+        passes the vet gate (VERDICT round-3 next-round item 5)."""
+        from operator_forge.gocheck import check_project
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        rc = cli_main([
+            "create", "webhook",
+            "--workload-config", config,
+            "--output-dir", project,
+            "--defaulting", "--programmatic-validation",
+        ])
+        assert rc == 0
+
+        stub = os.path.join(
+            project, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        stub_text = _read(project, stub[len(project) + 1:])
+        assert "webhook.Defaulter" in stub_text
+        assert "webhook.Validator" in stub_text
+        assert "func (r *BookStore) Default()" in stub_text
+        assert "func (r *BookStore) ValidateCreate()" in stub_text
+        assert "SetupWebhookWithManager" in stub_text
+        assert "+kubebuilder:webhook:path=/mutate-shop-example-io-v1alpha1-bookstore" in stub_text
+
+        manifests = _read(project, "config/webhook/manifests.yaml")
+        assert "MutatingWebhookConfiguration" in manifests
+        assert "ValidatingWebhookConfiguration" in manifests
+        assert "/validate-shop-example-io-v1alpha1-bookstore" in manifests
+        assert "cert-manager.io/inject-ca-from" in manifests
+
+        main_go = _read(project, "main.go")
+        assert "SetupWebhookWithManager(mgr)" in main_go
+
+        default_kustomize = _read(project, "config/default/kustomization.yaml")
+        assert "../webhook" in default_kustomize
+        assert "../certmanager" in default_kustomize
+
+        assert check_project(project) == []
+
+    def test_create_webhook_requires_an_interface_flag(self, tmp_path):
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        rc = cli_main([
+            "create", "webhook",
+            "--workload-config", config,
+            "--output-dir", project,
+        ])
+        assert rc != 0
+
+    def test_create_webhook_refuses_stale_stub(self, tmp_path):
+        """Adding --programmatic-validation later can't upgrade the
+        user-owned stub in place; emitting manifests for an unserved
+        path would reject every write in-cluster, so the command must
+        refuse (kubebuilder errors on the existing file too)."""
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        assert cli_main([
+            "create", "webhook", "--workload-config", config,
+            "--output-dir", project, "--defaulting",
+        ]) == 0
+        rc = cli_main([
+            "create", "webhook", "--workload-config", config,
+            "--output-dir", project, "--programmatic-validation",
+        ])
+        assert rc != 0
+        # the refused opt-in must not be persisted
+        assert "webhookValidation" not in _read(project, "PROJECT")
+        # same-flag re-run still succeeds, preserving the stub
+        assert cli_main([
+            "create", "webhook", "--workload-config", config,
+            "--output-dir", project, "--defaulting",
+        ]) == 0
+
+    def test_webhook_stub_preserved_and_rewired_on_recreate(self, tmp_path):
+        """The stub is user-owned (SKIP), and a later plain `create api`
+        keeps the admission wiring via the PROJECT record."""
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        project = _generate(
+            tmp_path, "standalone", "github.com/acme/bookstore-operator"
+        )
+        rc = cli_main([
+            "create", "webhook",
+            "--workload-config", config,
+            "--output-dir", project,
+            "--defaulting",
+        ])
+        assert rc == 0
+        assert "webhookDefaulting: true" in _read(project, "PROJECT")
+
+        stub_rel = "apis/shop/v1alpha1/bookstore_webhook.go"
+        stub_path = os.path.join(project, stub_rel)
+        custom = _read(project, stub_rel).replace(
+            "// TODO: fill in defaulting logic.", "// custom-user-logic",
+        )
+        with open(stub_path, "w") as fh:
+            fh.write(custom)
+
+        rc = cli_main([
+            "create", "api",
+            "--workload-config", config,
+            "--output-dir", project,
+        ])
+        assert rc == 0
+        assert "custom-user-logic" in _read(project, stub_rel)
+        assert os.path.exists(
+            os.path.join(project, "config", "webhook", "manifests.yaml")
+        )
+
     def test_seeded_method_misspelling_fails_vet(self, tmp_path):
         """VERDICT round-3 weak item 4: the vet gate must catch a
         misspelled call into the generated pkg/orchestrate API."""
