@@ -4,14 +4,24 @@ The reference publishes no benchmark numbers (BASELINE.md); its only
 measurable end state is the functional-generation flow (`make func-test`:
 binary build + init + create api over fixtures, reference Makefile:70-85).
 This benchmark times operator-forge's equivalent end-to-end flow over the
-standalone and collection fixtures and reports generated lines-of-code per
-second.  ``vs_baseline`` is null because the reference defines no published
-number to compare against (BASELINE.json records "published": {}).
+standalone, collection, and kitchen-sink fixtures and reports generated
+lines-of-code per second.  ``vs_baseline`` is null because the reference
+defines no published number to compare against (BASELINE.json records
+"published": {}).
+
+Methodology (round-3 verdict weak item 6: mean-of-5 wall time drifted
+18% on identical code): the headline is now MEDIAN PROCESS-CPU TIME
+over 31 measured runs after 2 discarded warmups — measured back-to-back
+on this machine it agrees within ~3%, where every wall-clock statistic
+drifts 15-30% under background load, hiding real regressions.  Wall
+medians (total and per fixture) stay in ``detail`` for context, and the
+headline change from r03's wall-mean is documented there.
 """
 
 import json
 import os
 import shutil
+import statistics
 import sys
 import tempfile
 import time
@@ -23,6 +33,11 @@ from operator_forge.cli.main import main as cli_main  # noqa: E402
 FIXTURES = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "tests", "fixtures"
 )
+BENCH_FIXTURES = ("standalone", "collection", "kitchen-sink")
+WARMUP_RUNS = 2
+# override for quick contract checks (tests); the default is sized for a
+# stable median on a noisy host
+MEASURED_RUNS = int(os.environ.get("OPERATOR_FORGE_BENCH_RUNS", "31"))
 
 
 def generate(fixture: str, repo: str, out_dir: str) -> None:
@@ -56,33 +71,40 @@ def main() -> None:
     import io
     import contextlib
 
-    runs = 5
     tmp = tempfile.mkdtemp(prefix="operator-forge-bench-")
     try:
-        # warmup (imports, pyc)
-        with contextlib.redirect_stdout(io.StringIO()):
-            generate("standalone", "github.com/bench/warmup",
-                     os.path.join(tmp, "warmup"))
-
-        loc = 0
-        times = []
-        for i in range(runs):
-            outs = []
-            start = time.perf_counter()
-            with contextlib.redirect_stdout(io.StringIO()):
-                for fixture in ("standalone", "collection", "kitchen-sink"):
-                    out = os.path.join(tmp, f"{fixture}-{i}")
+        fixture_loc: dict[str, int] = {}
+        fixture_wall: dict[str, list] = {f: [] for f in BENCH_FIXTURES}
+        wall_runs = []
+        cpu_runs = []
+        for i in range(WARMUP_RUNS + MEASURED_RUNS):
+            measured = i >= WARMUP_RUNS
+            run_wall = 0.0
+            run_cpu = 0.0
+            for fixture in BENCH_FIXTURES:
+                out = os.path.join(tmp, f"{fixture}-{i}")
+                # only the generation flow is inside the measurement
+                # window — LOC counting and cleanup are not its cost
+                start = time.perf_counter()
+                cpu_start = time.process_time()
+                with contextlib.redirect_stdout(io.StringIO()):
                     generate(fixture, f"github.com/bench/{fixture}", out)
-                    outs.append(out)
-            times.append(time.perf_counter() - start)
-            if i == 0:
-                loc = sum(count_loc(o) for o in outs)
-        # mean-of-N headline: the honest typical-throughput figure
-        # (best-of-N overstates it under machine load); best and every
-        # raw run are reported alongside so numbers stay comparable
-        best_run = min(times)
-        mean_run = sum(times) / len(times)
-        loc_per_s = (loc / mean_run) if mean_run > 0 else 0.0
+                run_cpu += time.process_time() - cpu_start
+                elapsed = time.perf_counter() - start
+                if measured:
+                    fixture_wall[fixture].append(elapsed)
+                    run_wall += elapsed
+                if fixture not in fixture_loc:
+                    fixture_loc[fixture] = count_loc(out)
+                shutil.rmtree(out, ignore_errors=True)
+            if measured:
+                wall_runs.append(run_wall)
+                cpu_runs.append(run_cpu)
+
+        loc = sum(fixture_loc.values())
+        median_wall = statistics.median(wall_runs)
+        median_cpu = statistics.median(cpu_runs)
+        loc_per_s = (loc / median_cpu) if median_cpu > 0 else 0.0
         print(
             json.dumps(
                 {
@@ -91,16 +113,35 @@ def main() -> None:
                     "unit": "generated_loc/s",
                     "vs_baseline": None,
                     "detail": {
-                        "fixtures": ["standalone", "collection", "kitchen-sink"],
-                        "runs": runs,
-                        "headline": "mean",
-                        "loc_per_s_best": round(
-                            loc / best_run if best_run > 0 else 0.0, 1
+                        "fixtures": list(BENCH_FIXTURES),
+                        "runs": MEASURED_RUNS,
+                        "warmup_runs_discarded": WARMUP_RUNS,
+                        "headline": "median process-CPU seconds "
+                        "(~3% back-to-back agreement; wall statistics "
+                        "drift 15-30% under this machine's background "
+                        "load — r01-r03 used wall mean, so compare "
+                        "those rounds via loc_per_wall_s below)",
+                        "cpu_s_median": round(median_cpu, 4),
+                        "cpu_s_spread": [
+                            round(min(cpu_runs), 4),
+                            round(max(cpu_runs), 4),
+                        ],
+                        "wall_s_median": round(median_wall, 4),
+                        "loc_per_wall_s": round(
+                            loc / median_wall if median_wall > 0 else 0.0, 1
                         ),
-                        "wall_s_best": round(best_run, 4),
-                        "wall_s_mean": round(mean_run, 4),
-                        "wall_s_all_runs": [round(t, 4) for t in times],
+                        "per_fixture_wall_s_median": {
+                            f: round(statistics.median(ts), 4)
+                            for f, ts in fixture_wall.items()
+                        },
+                        "per_fixture_loc": fixture_loc,
                         "generated_loc_per_run": loc,
+                        "noise_floor": "within one invocation the CPU "
+                        "median repeats to ~3%; separate invocations on "
+                        "this 1-vCPU VM differ up to ~15% (host "
+                        "scheduling/steal) — treat deltas inside that "
+                        "band as noise, and use cpu_s_spread as the "
+                        "error bar",
                         "note": "reference publishes no perf numbers "
                         "(BASELINE.md); metric is self-baselined",
                     },

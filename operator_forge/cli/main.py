@@ -178,14 +178,15 @@ def cmd_create_webhook(args: argparse.Namespace) -> int:
     from ..scaffold.context import views_for
     from ..scaffold.templates import admission as admission_tpl
 
-    stale = admission_tpl.stale_stubs(
-        views_for(processor.get_workloads(), config),
-        args.output_dir,
-        config.webhook_defaulting,
-        config.webhook_validation,
-    )
-    if stale:
-        raise CLIError("\n".join(stale))
+    if not args.force:
+        stale = admission_tpl.stale_stubs(
+            views_for(processor.get_workloads(), config),
+            args.output_dir,
+            config.webhook_defaulting,
+            config.webhook_validation,
+        )
+        if stale:
+            raise CLIError("\n".join(stale))
 
     scaffold = scaffold_webhook(
         args.output_dir,
@@ -193,6 +194,7 @@ def cmd_create_webhook(args: argparse.Namespace) -> int:
         config,
         boilerplate_text=_boilerplate_text(args.output_dir),
         dry_run=args.dry_run,
+        force=args.force,
     )
 
     if args.dry_run:
@@ -503,7 +505,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--programmatic-validation", action="store_true",
         help="scaffold a webhook.Validator (validating webhook)",
     )
-    p_webhook.add_argument("--force", action="store_true")
+    p_webhook.add_argument(
+        "--force", action="store_true",
+        help="regenerate the user-owned webhook stub instead of "
+        "preserving it (discards edits; kubebuilder semantics)",
+    )
     p_webhook.add_argument(
         "--dry-run",
         action="store_true",

@@ -186,10 +186,16 @@ def scaffold_api(
                 or spec.path != "config/webhook/kustomization.yaml"
             )
             for view in multi_version:
+                hub = webhook_tpl.hub_version(view, output_dir)
+                # when admission webhooks are on, SetupWebhookWithManager
+                # already routes the CURRENT version's type through
+                # NewWebhookManagedBy (serving /convert too); registering
+                # the same type again would panic the webhook server on
+                # a duplicate path at manager startup
+                if admission and hub == view.version:
+                    continue
                 fragments.append(
-                    webhook_tpl.main_go_webhook_fragment(
-                        view, webhook_tpl.hub_version(view, output_dir)
-                    )
+                    webhook_tpl.main_go_webhook_fragment(view, hub)
                 )
     if admission:
         specs.extend(
@@ -216,6 +222,7 @@ def _admission_specs(
     views: list[WorkloadView],
     config: ProjectConfig,
     include_tree: bool = True,
+    force: bool = False,
 ) -> list[FileSpec]:
     # the shared tree, minus its conversion-only webhook kustomization —
     # the admission variant below replaces it, and emitting both would
@@ -231,7 +238,8 @@ def _admission_specs(
     for view in views:
         specs.append(
             admission_tpl.webhook_stub_file(
-                view, config.webhook_defaulting, config.webhook_validation
+                view, config.webhook_defaulting,
+                config.webhook_validation, force=force,
             )
         )
     specs.append(
@@ -250,16 +258,18 @@ def scaffold_webhook(
     config: ProjectConfig,
     boilerplate_text: str = "",
     dry_run: bool = False,
+    force: bool = False,
 ) -> Scaffold:
     """The `create webhook` scaffolder: admission stubs, registration
     objects, cert-manager wiring, and main.go registration for every
     workload kind.  ``config.webhook_defaulting`` / ``webhook_validation``
-    select the interfaces scaffolded."""
+    select the interfaces scaffolded; ``force`` regenerates user-owned
+    stubs instead of preserving them (kubebuilder --force)."""
     views = views_for(processor.get_workloads(), config)
     scaffold = Scaffold(
         output_dir=output_dir, boilerplate=boilerplate_text, dry_run=dry_run
     )
-    specs = _admission_specs(views, config)
+    specs = _admission_specs(views, config, force=force)
     fragments: list[Fragment] = []
     for view in views:
         fragments.extend(admission_tpl.main_go_admission_fragments(view))

@@ -34,8 +34,19 @@ def webhook_path(view: WorkloadView, kind_of: str) -> str:
     return f"/{kind_of}-{dashed}-{view.version}-{view.kind_lower}"
 
 
+def webhook_file_path(view: WorkloadView) -> str:
+    """The one place the stub's location is computed — the writer and
+    the stale-stub check must agree on it."""
+    return os.path.join(
+        view.api_types_dir, f"{to_file_name(view.kind_lower)}_webhook.go"
+    )
+
+
 def webhook_stub_file(
-    view: WorkloadView, defaulting: bool, validation: bool
+    view: WorkloadView,
+    defaulting: bool,
+    validation: bool,
+    force: bool = False,
 ) -> FileSpec:
     """The user-owned webhook implementation beside the API types
     (kubebuilder: api/<version>/<kind>_webhook.go)."""
@@ -114,12 +125,14 @@ def webhook_stub_file(
             f"}}\n",
         )
     content = "\n".join(parts)
-    path = (
-        f"apis/{view.group}/{view.version}/"
-        f"{to_file_name(view.kind_lower)}_webhook.go"
+    # user-owned: preserved on re-scaffold, like mutate/dependencies
+    # hooks — unless --force asks for regeneration (kubebuilder
+    # semantics)
+    return FileSpec(
+        path=webhook_file_path(view),
+        content=content,
+        if_exists=IfExists.OVERWRITE if force else IfExists.SKIP,
     )
-    # user-owned: preserved on re-scaffold, like mutate/dependencies hooks
-    return FileSpec(path=path, content=content, if_exists=IfExists.SKIP)
 
 
 def stale_stubs(
@@ -135,10 +148,7 @@ def stale_stubs(
     errors on the existing file; so do we."""
     problems = []
     for view in views:
-        path = (
-            f"apis/{view.group}/{view.version}/"
-            f"{to_file_name(view.kind_lower)}_webhook.go"
-        )
+        path = webhook_file_path(view)
         full = os.path.join(output_dir, path)
         if not os.path.exists(full):
             continue
@@ -147,14 +157,14 @@ def stale_stubs(
         if defaulting and "webhook.Defaulter" not in text:
             problems.append(
                 f"{path}: exists without webhook.Defaulter — add the "
-                f"Default() method yourself or delete the file to "
-                f"re-scaffold it"
+                f"Default() method yourself, or re-run with --force to "
+                f"regenerate the file (discards your edits)"
             )
         if validation and "webhook.Validator" not in text:
             problems.append(
                 f"{path}: exists without webhook.Validator — add the "
-                f"Validate* methods yourself or delete the file to "
-                f"re-scaffold it"
+                f"Validate* methods yourself, or re-run with --force to "
+                f"regenerate the file (discards your edits)"
             )
     return problems
 

@@ -1,0 +1,96 @@
+"""Regenerate the golden conformance snapshots under tests/golden/.
+
+For each of the reference's four functional-test cases (test/cases/*),
+snapshot the three derivation outputs whose regressions would otherwise
+only surface as "vet clean" (round-3 verdict next-round item 7):
+
+- the derived RBAC rule set (config/rbac/role.yaml),
+- every generated CRD schema (config/crd/bases/*.yaml),
+- the APIFields-derived Go spec of every workload
+  (``APIFields.generate_api_spec``, the canonical tree rendering).
+
+Run after an INTENTIONAL derivation change:
+
+    PYTHONPATH=. python scripts/update_goldens.py
+
+then review the diff like any other code change.
+"""
+
+import contextlib
+import io
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from operator_forge.cli.main import main as cli_main  # noqa: E402
+from operator_forge.workload import config as wconfig  # noqa: E402
+from operator_forge.workload.create_api import (  # noqa: E402
+    create_api as run_create_api,
+    init_workloads,
+)
+
+REFERENCE = "/root/reference"
+CASES = ("standalone", "edge-standalone", "collection", "edge-collection")
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def case_outputs(case: str) -> dict[str, str]:
+    """relative-golden-path -> content for one reference case."""
+    config = os.path.join(
+        REFERENCE, "test", "cases", case, ".workloadConfig", "workload.yaml"
+    )
+    out = tempfile.mkdtemp(prefix="goldens-")
+    outputs: dict[str, str] = {}
+    try:
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert cli_main(
+                ["init", "--workload-config", config,
+                 "--repo", "github.com/acme/acme-cnp-mgr",
+                 "--output-dir", out]
+            ) == 0
+            assert cli_main(
+                ["create", "api", "--workload-config", config,
+                 "--output-dir", out]
+            ) == 0
+
+        with open(os.path.join(out, "config", "rbac", "role.yaml")) as fh:
+            outputs["role.yaml"] = fh.read()
+
+        bases = os.path.join(out, "config", "crd", "bases")
+        for name in sorted(os.listdir(bases)):
+            with open(os.path.join(bases, name)) as fh:
+                outputs[f"crd_{name}"] = fh.read()
+
+        processor = wconfig.parse(config)
+        init_workloads(processor)
+        run_create_api(processor)
+        for workload in processor.get_workloads():
+            fields = workload.get_api_spec_fields()
+            if fields is None:
+                continue
+            kind = workload.api_kind
+            outputs[f"api_spec_{kind.lower()}.go.txt"] = (
+                fields.generate_api_spec(kind)
+            )
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    return outputs
+
+
+def main() -> None:
+    for case in CASES:
+        case_dir = os.path.join(GOLDEN, case)
+        shutil.rmtree(case_dir, ignore_errors=True)
+        os.makedirs(case_dir)
+        for rel, content in case_outputs(case).items():
+            with open(os.path.join(case_dir, rel), "w") as fh:
+                fh.write(content)
+        print(f"updated {case_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -403,3 +403,33 @@ class TestMainGoVariants:
                         "--output-dir", out]) == 0
         from operator_forge.gocheck import check_project
         assert check_project(out) == []
+
+
+class TestBench:
+    def test_bench_emits_one_json_line_with_contract_keys(self):
+        """The driver consumes exactly one JSON line; keep the contract
+        (metric/value/unit/vs_baseline) and the stability detail."""
+        import json
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, OPERATOR_FORGE_BENCH_RUNS="3")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py")],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+        lines = [l for l in proc.stdout.strip().split("\n") if l]
+        assert len(lines) == 1
+        data = json.loads(lines[0])
+        assert data["metric"] == "codegen_loc_per_s"
+        assert data["value"] > 0
+        assert data["unit"] == "generated_loc/s"
+        assert "vs_baseline" in data
+        detail = data["detail"]
+        assert detail["runs"] == 3  # the env knob took effect
+        assert set(detail["per_fixture_wall_s_median"]) == {
+            "standalone", "collection", "kitchen-sink",
+        }
+        assert detail["cpu_s_median"] > 0

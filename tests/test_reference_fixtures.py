@@ -166,3 +166,51 @@ class TestFunctionalCases:
         rendered = preview(config, sample)
         docs = [d for d in pyyaml.safe_load_all(rendered) if d]
         assert docs and all(d.get("kind") for d in docs)
+
+
+class TestGoldenConformance:
+    """Golden snapshots of the derivation outputs for the reference's
+    four CI cases: the RBAC rule set, every CRD schema, and the
+    APIFields-derived Go spec (round-3 verdict next-round item 7).
+    A derivation regression surfaces as a diff here, not as a silently
+    different 'vet clean' project.  To update after an INTENTIONAL
+    change: PYTHONPATH=. python scripts/update_goldens.py
+    """
+
+    @pytest.mark.parametrize(
+        "case",
+        ["standalone", "edge-standalone", "collection", "edge-collection"],
+    )
+    def test_matches_golden(self, case):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "update_goldens",
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "scripts", "update_goldens.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        golden_dir = os.path.join(os.path.dirname(__file__), "golden", case)
+        assert os.path.isdir(golden_dir), (
+            f"golden dir missing — run scripts/update_goldens.py"
+        )
+        fresh = mod.case_outputs(case)
+        recorded = {
+            name: open(os.path.join(golden_dir, name)).read()
+            for name in sorted(os.listdir(golden_dir))
+        }
+        assert set(fresh) == set(recorded), (
+            f"output set changed: only-fresh="
+            f"{sorted(set(fresh) - set(recorded))} only-golden="
+            f"{sorted(set(recorded) - set(fresh))}"
+        )
+        for name in sorted(fresh):
+            assert fresh[name] == recorded[name], (
+                f"{case}/{name} diverged from golden — if the change is "
+                f"intentional, re-run scripts/update_goldens.py and "
+                f"review the diff"
+            )
