@@ -82,6 +82,12 @@ class TypeRef:
 
 
 @dataclass
+class MapTypeRef(TypeRef):
+    """A named map type (client.MatchingLabels): composite literals over
+    it evaluate their keys as EXPRESSIONS, not field names."""
+
+
+@dataclass
 class Closure:
     fn: dict  # a _FileScan func record (or literal equivalent)
     scan: object
@@ -151,6 +157,16 @@ def _nested(obj, *path):
 
 
 class _UnstructuredModule:
+    class UnstructuredList:
+        def __init__(self):
+            self.Items = []
+
+        def SetGroupVersionKind(self, gvk):
+            self._gvk = gvk
+
+        def GroupVersionKind(self):
+            return getattr(self, "_gvk", None)
+
     class Unstructured:
         def __init__(self):
             self.Object = {}
@@ -303,6 +319,38 @@ class _ApiErrorsModule:
         return isinstance(err, GoError) and err.not_found
 
 
+class _ControllerUtilModule:
+    """Finalizer helpers over any fake exposing Get/SetFinalizers."""
+
+    @staticmethod
+    def ContainsFinalizer(obj, finalizer):
+        return finalizer in (obj.GetFinalizers() or [])
+
+    @staticmethod
+    def AddFinalizer(obj, finalizer):
+        finalizers = list(obj.GetFinalizers() or [])
+        if finalizer in finalizers:
+            return False
+        finalizers.append(finalizer)
+        obj.SetFinalizers(finalizers)
+        return True
+
+    @staticmethod
+    def RemoveFinalizer(obj, finalizer):
+        finalizers = list(obj.GetFinalizers() or [])
+        if finalizer not in finalizers:
+            return False
+        finalizers.remove(finalizer)
+        obj.SetFinalizers(finalizers)
+        return True
+
+
+class _MetaModule:
+    @staticmethod
+    def IsNoMatchError(err):
+        return isinstance(err, GoError) and getattr(err, "no_match", False)
+
+
 class _TimeModule:
     Nanosecond = 1
     Microsecond = 1000
@@ -321,6 +369,13 @@ class _StructModule:
             setattr(self, name, TypeRef(name))
 
 
+class _ClientModule:
+    MatchingLabels = MapTypeRef("MatchingLabels")
+    MatchingFields = MapTypeRef("MatchingFields")
+    InNamespace = TypeRef("InNamespace")
+    Object = TypeRef("Object")
+
+
 def default_natives() -> dict:
     """Native modules keyed by import path."""
     return {
@@ -333,7 +388,16 @@ def default_natives() -> dict:
         "k8s.io/apimachinery/pkg/types": _StructModule("NamespacedName"),
         "k8s.io/apimachinery/pkg/runtime/schema":
             _StructModule("GroupVersionKind", "GroupKind"),
+        "k8s.io/apimachinery/pkg/api/meta": _MetaModule,
         "sigs.k8s.io/controller-runtime": _StructModule("Result"),
+        "sigs.k8s.io/controller-runtime/pkg/client": _ClientModule,
+        "sigs.k8s.io/controller-runtime/pkg/controller/controllerutil":
+            _ControllerUtilModule,
+        "sigs.k8s.io/controller-runtime/pkg/predicate":
+            _StructModule("Funcs"),
+        "sigs.k8s.io/controller-runtime/pkg/event": _StructModule(
+            "CreateEvent", "UpdateEvent", "DeleteEvent", "GenericEvent",
+        ),
     }
 
 
@@ -410,21 +474,32 @@ class Interp:
         fn, scan = self.methods[key]
         return self._invoke(fn, scan, recv, list(args))
 
+    def call_value(self, value, *args):
+        """Invoke any callable interpreter value (e.g. a func-literal
+        closure pulled out of a composite like predicate.Funcs)."""
+        scan = value.scan if isinstance(value, Closure) else None
+        ev = _Eval(self, scan, Env())
+        return ev._call_value(value, list(args))
+
     def _invoke(self, fn, scan, recv_value, args):
         env = Env()
         if fn["recv"] is not None and fn["recv"][0]:
             env.define(fn["recv"][0], recv_value)
-        names = [n for n, _span in fn["params"] if n]
-        if len(names) == len(fn["params"]):
-            for name, value in zip(names, args):
-                env.define(name, value)
-        else:
-            # unnamed params: positional discard
-            idx = 0
-            for name, _span in fn["params"]:
-                if name:
-                    env.define(name, args[idx])
-                idx += 1
+        params = fn["params"]
+        names = _param_binding_names(params)
+        # a variadic TYPE starts with `...` (a `...` deeper in the span
+        # would belong to a func-typed param's own signature)
+        variadic = bool(params) and bool(params[-1][1]) and (
+            params[-1][1][0].kind == OP and params[-1][1][0].value == "..."
+        )
+        fixed = names[:-1] if variadic else names
+        idx = 0
+        for name in fixed:
+            if name and idx < len(args):
+                env.define(name, args[idx])
+            idx += 1
+        if variadic and names[-1]:
+            env.define(names[-1], list(args[idx:]))
         ev = _Eval(self, scan, env)
         lo, hi = fn["body"]
         try:
@@ -432,6 +507,55 @@ class Interp:
         except _Return as ret:
             return ret.values
         return None
+
+
+def _split_commas(toks, lo, hi) -> list:
+    """Top-level comma spans in toks[lo:hi]: the one comma-splitting
+    routine for expression lists, call args, composites, and params.
+    Empty spans (trailing commas) are dropped and ASI semicolons from
+    multi-line formatting are stripped off both ends."""
+    spans = []
+    depth = 0
+    start = lo
+    for j in range(lo, hi):
+        t = toks[j]
+        if t.kind == OP:
+            if t.value in "([{":
+                depth += 1
+            elif t.value in ")]}":
+                depth -= 1
+            elif t.value == "," and depth == 0:
+                spans.append((start, j))
+                start = j + 1
+    spans.append((start, hi))
+    out = []
+    for slo, shi in spans:
+        while shi > slo and toks[shi - 1].kind == OP and \
+                toks[shi - 1].value == ";":
+            shi -= 1
+        while slo < shi and toks[slo].kind == OP and \
+                toks[slo].value == ";":
+            slo += 1
+        if shi > slo:
+            out.append((slo, shi))
+    return out
+
+
+def _param_binding_names(params) -> list:
+    """One binding name (or None) per parameter.  Go forbids mixing
+    named and unnamed params, so when any item carries a name, a
+    single-identifier item like the ``a`` in ``(a, b map[string]string)``
+    is a NAME sharing the later type — not a type-only parameter."""
+    has_named = any(name for name, _span in params)
+    names = []
+    for name, span in params:
+        if name:
+            names.append(name)
+        elif has_named and len(span) == 1 and span[0].kind == IDENT:
+            names.append(span[0].value)
+        else:
+            names.append(None)
+    return names
 
 
 def _recv_base(span) -> str | None:
@@ -946,24 +1070,10 @@ class _Eval:
     # assignment targets: ("name", n) | ("sel", obj, name) |
     # ("index", obj, key) | ("star", obj)
     def _parse_targets(self, toks, lo, hi, env) -> list:
-        targets = []
-        depth = 0
-        start = lo
-        spans = []
-        for j in range(lo, hi):
-            t = toks[j]
-            if t.kind == OP:
-                if t.value in "([{":
-                    depth += 1
-                elif t.value in ")]}":
-                    depth -= 1
-                elif t.value == "," and depth == 0:
-                    spans.append((start, j))
-                    start = j + 1
-        spans.append((start, hi))
-        for slo, shi in spans:
-            targets.append(self._parse_target(toks, slo, shi, env))
-        return targets
+        return [
+            self._parse_target(toks, slo, shi, env)
+            for slo, shi in _split_commas(toks, lo, hi)
+        ]
 
     def _parse_target(self, toks, lo, hi, env):
         if hi - lo == 1 and toks[lo].kind == IDENT:
@@ -1050,25 +1160,28 @@ class _Eval:
             self.env = saved
 
     def _expr_list(self, toks, lo, hi, env) -> list:
-        values = []
-        depth = 0
-        start = lo
-        spans = []
-        for j in range(lo, hi):
-            t = toks[j]
-            if t.kind == OP:
-                if t.value in "([{":
-                    depth += 1
-                elif t.value in ")]}":
-                    depth -= 1
-                elif t.value == "," and depth == 0:
-                    spans.append((start, j))
-                    start = j + 1
-        spans.append((start, hi))
-        for slo, shi in spans:
-            if shi > slo:
-                values.append(self._eval_range(toks, slo, shi, env))
-        return values
+        return [
+            self._eval_range(toks, slo, shi, env)
+            for slo, shi in _split_commas(toks, lo, hi)
+        ]
+
+    def _call_args(self, toks, lo, hi, env) -> list:
+        """Evaluate call arguments: top-level comma split, trailing
+        ``xs...`` spreads splatted, f(g()) multi-returns expanded."""
+        args: list = []
+        for slo, shi in _split_commas(toks, lo, hi):
+            spread = (
+                toks[shi - 1].kind == OP and toks[shi - 1].value == "..."
+            )
+            end = shi - 1 if spread else shi
+            value = self._eval_range(toks, slo, end, env)
+            if spread:
+                args.extend(value or [])
+            else:
+                args.append(value)
+        if len(args) == 1 and isinstance(args[0], tuple):
+            return list(args[0])
+        return args
 
     def expression(self, toks, pos, min_prec=1):
         value, pos = self.unary(toks, pos)
@@ -1155,8 +1268,7 @@ class _Eval:
                 continue
             if t.kind == OP and t.value == "(":
                 lo, hi = _group_span(toks, pos)
-                args = self._expr_list(toks, lo, hi, self.env)
-                args = _expand_call_args(args)
+                args = self._call_args(toks, lo, hi, self.env)
                 value = self._call_value(value, args)
                 pos = hi + 1
                 continue
@@ -1167,6 +1279,13 @@ class _Eval:
                 pos = hi + 1
                 continue
             if t.kind == OP and t.value == "{":
+                if isinstance(value, MapTypeRef):
+                    lo, hi = _group_span(toks, pos)
+                    value = self._composite(
+                        "map", toks, lo, hi, expr_keys=True
+                    )
+                    pos = hi + 1
+                    continue
                 if isinstance(value, TypeRef):
                     lo, hi = _group_span(toks, pos)
                     value = self._composite(value.name, toks, lo, hi)
@@ -1188,26 +1307,10 @@ class _Eval:
             break
         return value, pos
 
-    def _composite(self, tname, toks, lo, hi):
+    def _composite(self, tname, toks, lo, hi, expr_keys=False):
         fields = {}
         elems = []
-        depth = 0
-        start = lo
-        spans = []
-        for j in range(lo, hi):
-            t = toks[j]
-            if t.kind == OP:
-                if t.value in "([{":
-                    depth += 1
-                elif t.value in ")]}":
-                    depth -= 1
-                elif t.value == "," and depth == 0:
-                    spans.append((start, j))
-                    start = j + 1
-        spans.append((start, hi))
-        for slo, shi in spans:
-            if shi <= slo:
-                continue
+        for slo, shi in _split_commas(toks, lo, hi):
             colon = None
             d = 0
             for j in range(slo, shi):
@@ -1220,7 +1323,12 @@ class _Eval:
                     elif t.value == ":" and d == 0:
                         colon = j
                         break
-            if colon is not None and toks[slo].kind == IDENT and colon == slo + 1:
+            if (
+                colon is not None
+                and not expr_keys
+                and toks[slo].kind == IDENT
+                and colon == slo + 1
+            ):
                 fields[toks[slo].value] = self._eval_range(
                     toks, colon + 1, shi, self.env
                 )
@@ -1254,7 +1362,8 @@ class _Eval:
                 return (0 if arg is None else len(arg)), hi + 1
             if name == "append" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
-                args = self._expr_list(toks, lo, hi, self.env)
+                # _call_args so `append(a, b...)` splats b's elements
+                args = self._call_args(toks, lo, hi, self.env)
                 base = list(args[0]) if args[0] else []
                 base.extend(args[1:])
                 return base, hi + 1
@@ -1354,24 +1463,10 @@ class _Eval:
         """One entry per parameter, None for type-only (unnamed) items,
         so closure argument positions stay aligned."""
         names = []
-        depth = 0
-        start = lo
-        spans = []
-        for j in range(lo, hi):
-            t = toks[j]
-            if t.kind == OP:
-                if t.value in "([{":
-                    depth += 1
-                elif t.value in ")]}":
-                    depth -= 1
-                elif t.value == "," and depth == 0:
-                    spans.append((start, j))
-                    start = j + 1
-        spans.append((start, hi))
-        for slo, shi in spans:
+        for slo, shi in _split_commas(toks, lo, hi):
             if shi - slo >= 2 and toks[slo].kind == IDENT:
                 names.append(toks[slo].value)
-            elif shi > slo:
+            else:
                 names.append(None)  # `func(string)`: unnamed param
         return names
 
@@ -1506,12 +1601,6 @@ def _expand(values, n):
     if len(values) == 1 and isinstance(values[0], _AssertResult) and n == 1:
         return [values[0][0]]
     return values
-
-
-def _expand_call_args(args):
-    if len(args) == 1 and isinstance(args[0], tuple):
-        return list(args[0])
-    return args
 
 
 def _next_is(toks, pos, val) -> bool:

@@ -104,9 +104,13 @@ class FakeStatus:
 class FakeLogger:
     def __init__(self):
         self.errors = []
+        self.infos = []
 
     def Error(self, err, msg, *kv):
         self.errors.append(msg)
+
+    def Info(self, msg, *kv):
+        self.infos.append(msg)
 
 
 class FakeReconciler:
@@ -430,6 +434,306 @@ class TestInterpretedFinalizers:
         assert interp.call("OwnedBy", _OwnerWorkload(), resource) is False
 
 
+class FakeGVK:
+    def __init__(self, group, version, kind):
+        self.Group, self.Version, self.Kind = group, version, kind
+
+    def GroupVersion(self):
+        return self
+
+    def WithKind(self, kind):
+        # a list, not a tuple: tuples are the interpreter's multi-return
+        # representation and would be splatted at call sites
+        return [self.Group, self.Version, kind]
+
+
+class FakeChild:
+    """A live child object, as the fake client returns it."""
+
+    def __init__(self, kind, ns, name, annotations=None, labels=None,
+                 deleting=False):
+        self.kind, self.ns, self.name = kind, ns, name
+        self.annotations = annotations
+        self.labels = labels or {}
+        self.deleting = deleting
+
+    def GetKind(self):
+        return self.kind
+
+    def GetName(self):
+        return self.name
+
+    def GetNamespace(self):
+        return self.ns
+
+    def GetAnnotations(self):
+        return self.annotations
+
+    def GetLabels(self):
+        return self.labels
+
+    def GetDeletionTimestamp(self):
+        return FakeTime(not self.deleting)
+
+
+class TeardownReconciler(FakeReconciler):
+    """Fake client with List/Delete over a per-kind child store, the
+    role the emitted orchestrate_test.go's fake client plays."""
+
+    def __init__(self, gvks, children):
+        super().__init__()
+        self.gvks = gvks
+        self.children = list(children)
+        self.deleted = []
+        self.list_calls = []
+
+    def GetChildGVKs(self):
+        return self.gvks
+
+    def List(self, ctx, list_obj, *opts):
+        gvk = list_obj.GroupVersionKind()
+        kind = gvk[2][: -len("List")] if gvk else ""
+        self.list_calls.append((kind, len(opts)))
+        items = [c for c in self.children if c.kind == kind]
+        for opt in opts:
+            if isinstance(opt, dict):  # client.MatchingLabels
+                items = [
+                    c for c in items
+                    if all(c.labels.get(k) == v for k, v in opt.items())
+                ]
+        list_obj.Items = items
+        return None
+
+    def Delete(self, ctx, obj):
+        self.deleted.append(obj)
+        self.children.remove(obj)
+        return None
+
+    def Update(self, ctx, obj):
+        return None
+
+
+class TeardownWorkload(_OwnerWorkload):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.finalizers = []
+
+    def GetFinalizers(self):
+        return self.finalizers
+
+    def SetFinalizers(self, finalizers):
+        self.finalizers = finalizers
+
+
+def _owned_markers(interp, workload):
+    akey, avalue = interp.call("OwnerAnnotation", workload)
+    lkey, lvalue = interp.call("OwnerLabel", workload)
+    return {akey: avalue}, {lkey: lvalue}
+
+
+class TestInterpretedTeardown:
+    """TeardownChildrenHandler / DeletionCompleteHandler / ownable,
+    executed from the emitted source — the scenarios the emitted
+    TestTeardown* / TestFinalizerLifecycle / TestOwnable cover."""
+
+    GVKS = [FakeGVK("apps", "v1", "Deployment")]
+
+    def _req(self, workload):
+        return GoStruct("Request", {"Context": None, "Workload": workload})
+
+    def test_ownable_scoping(self, interp):
+        cluster = _OwnerWorkload(ns="")
+        namespaced = _OwnerWorkload(ns="default")
+        same = FakeChild("Deployment", "default", "x")
+        cross = FakeChild("Deployment", "other", "x")
+        assert interp.call("ownable", cluster, cross) is True
+        assert interp.call("ownable", namespaced, same) is True
+        assert interp.call("ownable", namespaced, cross) is False
+
+    def test_finalizer_lifecycle(self, interp):
+        workload = TeardownWorkload()
+        r = TeardownReconciler(self.GVKS, [])
+        req = self._req(workload)
+        proceed, err = interp.call("RegisterFinalizerHandler", r, req)
+        assert (proceed, err) == (True, None)
+        assert workload.finalizers == ["shop.example.io/finalizer"]
+        # idempotent: second pass adds nothing
+        proceed, err = interp.call("RegisterFinalizerHandler", r, req)
+        assert (proceed, err) == (True, None)
+        assert workload.finalizers == ["shop.example.io/finalizer"]
+        proceed, err = interp.call("DeletionCompleteHandler", r, req)
+        assert (proceed, err) == (True, None)
+        assert workload.finalizers == []
+
+    def test_cross_namespace_child_swept(self, interp):
+        workload = TeardownWorkload(ns="default")
+        annotations, labels = _owned_markers(interp, workload)
+        child = FakeChild(
+            "Deployment", "other-ns", "x",
+            annotations=annotations, labels=labels,
+        )
+        r = TeardownReconciler(self.GVKS, [child])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert err is None
+        assert proceed is False  # still existed this pass
+        assert r.deleted == [child]
+        # next pass: gone, teardown completes
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert (proceed, err) == (True, None)
+
+    def test_unowned_lookalike_child_skipped(self, interp):
+        workload = TeardownWorkload(ns="default")
+        other = TeardownWorkload(ns="default", name="other")
+        annotations, labels = _owned_markers(interp, other)
+        lookalike = FakeChild(
+            "Deployment", "default", "x",
+            annotations=annotations, labels=labels,
+        )
+        r = TeardownReconciler(self.GVKS, [lookalike])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert (proceed, err) == (True, None)
+        assert r.deleted == []
+        assert lookalike in r.children
+
+    def test_legacy_annotated_child_found_by_fallback(self, interp):
+        # a child stamped before the owner label existed: the filtered
+        # list returns nothing, the unfiltered fallback must catch it
+        workload = TeardownWorkload(ns="default")
+        annotations, _labels = _owned_markers(interp, workload)
+        legacy = FakeChild(
+            "Deployment", "default", "x", annotations=annotations,
+        )
+        r = TeardownReconciler(self.GVKS, [legacy])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert err is None
+        assert proceed is False
+        assert r.deleted == [legacy]
+        # both the filtered and the fallback pass listed the kind
+        assert r.list_calls[0] == ("Deployment", 1)
+        assert r.list_calls[1] == ("Deployment", 0)
+
+    def test_cluster_scoped_parent_skips_sweep(self, interp):
+        workload = TeardownWorkload(ns="")
+        r = TeardownReconciler(self.GVKS, [])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert (proceed, err) == (True, None)
+        assert r.list_calls == []  # owner references cover everything
+
+    def test_absent_crd_does_not_block_deletion(self, interp):
+        workload = TeardownWorkload(ns="default")
+
+        class NoMatchReconciler(TeardownReconciler):
+            def List(self, ctx, list_obj, *opts):
+                err = GoError("no matches for kind")
+                err.no_match = True
+                return err
+
+        r = NoMatchReconciler(self.GVKS, [])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert (proceed, err) == (True, None)
+
+    def test_already_deleting_child_not_re_deleted(self, interp):
+        workload = TeardownWorkload(ns="default")
+        annotations, labels = _owned_markers(interp, workload)
+        child = FakeChild(
+            "Deployment", "default", "x",
+            annotations=annotations, labels=labels, deleting=True,
+        )
+        r = TeardownReconciler(self.GVKS, [child])
+        proceed, err = interp.call(
+            "TeardownChildrenHandler", r, self._req(workload)
+        )
+        assert err is None
+        assert proceed is False  # still exists, so not complete
+        assert r.deleted == []  # but no second delete is issued
+
+
+class PredicateObject:
+    def __init__(self, generation=1, labels=None, annotations=None,
+                 finalizers=None, deleting=False):
+        self.generation = generation
+        self.labels = labels or {}
+        self.annotations = annotations or {}
+        self.finalizers = finalizers or []
+        self.deleting = deleting
+
+    def GetGeneration(self):
+        return self.generation
+
+    def GetLabels(self):
+        return self.labels
+
+    def GetAnnotations(self):
+        return self.annotations
+
+    def GetFinalizers(self):
+        return self.finalizers
+
+    def GetDeletionTimestamp(self):
+        return FakeTime(not self.deleting)
+
+
+class TestInterpretedPredicates:
+    """WorkloadPredicates / CollectionPredicates update filters, executed
+    from the emitted source (emitted TestWorkloadPredicates /
+    TestCollectionPredicates ground)."""
+
+    def _update(self, interp, which, old, new):
+        funcs = interp.call(which)
+        event = GoStruct("UpdateEvent", {"ObjectOld": old, "ObjectNew": new})
+        return interp.call_value(funcs.fields["UpdateFunc"], event)
+
+    def test_status_only_update_filtered(self, interp):
+        old = PredicateObject(generation=3)
+        new = PredicateObject(generation=3)
+        assert self._update(interp, "WorkloadPredicates", old, new) is False
+
+    def test_spec_change_reconciles(self, interp):
+        old = PredicateObject(generation=3)
+        new = PredicateObject(generation=4)
+        assert self._update(interp, "WorkloadPredicates", old, new) is True
+
+    def test_label_change_reconciles(self, interp):
+        old = PredicateObject(labels={"a": "1"})
+        new = PredicateObject(labels={"a": "2"})
+        assert self._update(interp, "WorkloadPredicates", old, new) is True
+
+    def test_finalizer_change_reconciles(self, interp):
+        old = PredicateObject(finalizers=[])
+        new = PredicateObject(finalizers=["x/finalizer"])
+        assert self._update(interp, "WorkloadPredicates", old, new) is True
+
+    def test_deletion_timestamp_reconciles(self, interp):
+        old = PredicateObject()
+        new = PredicateObject(deleting=True)
+        assert self._update(interp, "WorkloadPredicates", old, new) is True
+
+    def test_nil_objects_reconcile(self, interp):
+        assert self._update(interp, "WorkloadPredicates", None, None) is True
+
+    def test_collection_status_write_does_not_fan_out(self, interp):
+        old = PredicateObject(generation=2, labels={"a": "1"})
+        new = PredicateObject(generation=2, labels={"a": "2"})
+        assert self._update(interp, "CollectionPredicates", old, new) is False
+
+    def test_collection_spec_change_fans_out(self, interp):
+        old = PredicateObject(generation=2)
+        new = PredicateObject(generation=3)
+        assert self._update(interp, "CollectionPredicates", old, new) is True
+
+
 class TestInterpreterSemantics:
     """Spot checks of Go semantics the interpreter must model, on tiny
     hand-written sources (the emitted code exercises them indirectly)."""
@@ -470,6 +774,38 @@ class TestInterpreterSemantics:
         )
         assert it.call("f", {"other": "x"}) is True
 
+    def test_append_with_spread_concatenates(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func concat(a []string, b []string) []string {\n"
+            "\treturn append(a, b...)\n"
+            "}\n"
+        )
+        assert it.call("concat", ["a"], ["b", "c"]) == ["a", "b", "c"]
+
+    def test_func_typed_last_param_is_not_variadic(self):
+        # the `...` inside a func-typed param's own signature must not
+        # make the OUTER function variadic
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func apply(n int, cb func(xs ...int) int) int {\n"
+            "\treturn cb(n, n+1)\n"
+            "}\n\n"
+            "func sum(xs ...int) int {\n"
+            "\ttotal := 0\n"
+            "\tfor _, x := range xs {\n"
+            "\t\ttotal += x\n"
+            "\t}\n"
+            "\treturn total\n"
+            "}\n\n"
+            "func run() int {\n"
+            "\treturn apply(3, sum)\n"
+            "}\n"
+        )
+        assert it.call("run") == 7
+
     def test_fnv_matches_go(self):
         # FNV-1a 32-bit reference value for "hello" is 0x4f9f2cab
         it = Interp()
@@ -495,6 +831,13 @@ MUTATIONS = [
      "if phase.handles(event) {", "event-filter-inverted"),
     ("handlers.go", 'Events:       []Event{DeleteEvent},',
      'Events:       []Event{CreateEvent},', "teardown-events"),
+    ("handlers.go", "if swept == 0 {", "if swept != 0 {",
+     "legacy-fallback-dropped"),
+    ("finalizers.go", "return annotations[key] == value",
+     "return annotations[key] != value", "ownedby-inverted"),
+    ("predicates.go",
+     "!slicesEqual(e.ObjectNew.GetFinalizers(), e.ObjectOld.GetFinalizers())",
+     "false", "finalizer-clause-dropped"),
 ]
 
 
@@ -556,3 +899,33 @@ class TestSeededMutationsDetected:
                 registry, "HandleExecution", FakeReconciler(), req
             )
             assert "Teardown-Children" not in order
+        elif label == "legacy-fallback-dropped":
+            workload = TeardownWorkload(ns="default")
+            annotations, _labels = _owned_markers(it, workload)
+            legacy = FakeChild(
+                "Deployment", "default", "x", annotations=annotations,
+            )
+            r = TeardownReconciler(
+                [FakeGVK("apps", "v1", "Deployment")], [legacy]
+            )
+            req = GoStruct(
+                "Request", {"Context": None, "Workload": workload}
+            )
+            proceed, err = it.call("TeardownChildrenHandler", r, req)
+            # healthy code sweeps the legacy child; mutated code
+            # skips the fallback and calls teardown complete
+            assert (proceed, err) == (True, None)
+            assert r.deleted == []
+        elif label == "ownedby-inverted":
+            resource = _UnstructuredModule.Unstructured()
+            workload = _OwnerWorkload()
+            it.call("MarkOwned", workload, resource)
+            assert it.call("OwnedBy", workload, resource) is False
+        elif label == "finalizer-clause-dropped":
+            funcs = it.call("WorkloadPredicates")
+            event = GoStruct("UpdateEvent", {
+                "ObjectOld": PredicateObject(finalizers=[]),
+                "ObjectNew": PredicateObject(finalizers=["x/fin"]),
+            })
+            got = it.call_value(funcs.fields["UpdateFunc"], event)
+            assert got is False  # healthy code reconciles on this
