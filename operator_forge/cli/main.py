@@ -133,6 +133,34 @@ def _persist_project(config: ProjectConfig, output_dir: str) -> None:
         handle.write(config.to_yaml())
 
 
+def cmd_edit(args: argparse.Namespace) -> int:
+    """`edit`: update project attributes recorded in the PROJECT file
+    (kubebuilder's `edit` from the golangv3 bundle the reference
+    registers, pkg/cli/init.go:27-41; its only real knob is
+    --multigroup)."""
+    config = _load_project(args.output_dir)
+    if args.multigroup is None:
+        print("nothing to edit: pass --multigroup=true|false")
+        return 0
+    if not args.multigroup and config.multigroup:
+        raise CLIError(
+            "cannot disable multigroup: operator-forge projects lay out "
+            "APIs as apis/<group>/<version> from the start, and existing "
+            "groups are not collapsible"
+        )
+    changed = config.multigroup != args.multigroup
+    config.multigroup = args.multigroup
+    if changed:
+        _persist_project(config, args.output_dir)
+    # the layout is already group-scoped, so enabling multigroup
+    # changes bookkeeping only
+    print(
+        f"multigroup={'true' if config.multigroup else 'false'} "
+        f"(layout is apis/<group>/<version> either way)"
+    )
+    return 0
+
+
 def cmd_create_webhook(args: argparse.Namespace) -> int:
     """`create webhook`: admission-webhook scaffolding (the reference
     CLI inherits kubebuilder's command via the golangv3 bundle,
@@ -291,7 +319,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create init-config update completion version preview validate vet" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -308,7 +336,7 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create init-config update completion version preview validate vet)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet)' '*: :_files'
 """
 
 
@@ -517,6 +545,20 @@ def build_parser() -> argparse.ArgumentParser:
         "without writing anything",
     )
     p_webhook.set_defaults(func=cmd_create_webhook)
+
+    p_edit = sub.add_parser(
+        "edit",
+        help="update project attributes recorded in the PROJECT file "
+        "(kubebuilder-compatible)",
+    )
+    p_edit.add_argument("--output-dir", default=".")
+    p_edit.add_argument(
+        "--multigroup", nargs="?", const="true", default=None,
+        type=_parse_bool,
+        help="record multi-group intent; the generated layout is "
+        "apis/<group>/<version> regardless",
+    )
+    p_edit.set_defaults(func=cmd_edit)
 
     p_cfg = sub.add_parser(
         "init-config", help="emit a sample workload config"

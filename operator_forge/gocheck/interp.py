@@ -485,21 +485,7 @@ class Interp:
         env = Env()
         if fn["recv"] is not None and fn["recv"][0]:
             env.define(fn["recv"][0], recv_value)
-        params = fn["params"]
-        names = _param_binding_names(params)
-        # a variadic TYPE starts with `...` (a `...` deeper in the span
-        # would belong to a func-typed param's own signature)
-        variadic = bool(params) and bool(params[-1][1]) and (
-            params[-1][1][0].kind == OP and params[-1][1][0].value == "..."
-        )
-        fixed = names[:-1] if variadic else names
-        idx = 0
-        for name in fixed:
-            if name and idx < len(args):
-                env.define(name, args[idx])
-            idx += 1
-        if variadic and names[-1]:
-            env.define(names[-1], list(args[idx:]))
+        _bind_params(env, fn["params"], args)
         ev = _Eval(self, scan, env)
         lo, hi = fn["body"]
         try:
@@ -539,6 +525,25 @@ def _split_commas(toks, lo, hi) -> list:
         if shi > slo:
             out.append((slo, shi))
     return out
+
+
+def _bind_params(env: Env, params, args) -> None:
+    """Bind call arguments to parameters: shared-type names, and a
+    trailing variadic collecting the rest.  A variadic TYPE starts with
+    `...` (a `...` deeper in the span belongs to a func-typed param's
+    own signature).  Shared by top-level funcs, methods, and literals."""
+    names = _param_binding_names(params)
+    variadic = bool(params) and bool(params[-1][1]) and (
+        params[-1][1][0].kind == OP and params[-1][1][0].value == "..."
+    )
+    fixed = names[:-1] if variadic else names
+    idx = 0
+    for name in fixed:
+        if name and idx < len(args):
+            env.define(name, args[idx])
+        idx += 1
+    if variadic and names[-1]:
+        env.define(names[-1], list(args[idx:]))
 
 
 def _param_binding_names(params) -> list:
@@ -1420,7 +1425,11 @@ class _Eval:
                 ):
                     j += 1
                 lo, hi = _group_span(toks, j)
-                return self._composite("map", toks, lo, hi), hi + 1
+                # map-literal keys are EXPRESSIONS (`{k: v}` reads the
+                # variable k), unlike struct-literal field names
+                return self._composite(
+                    "map", toks, lo, hi, expr_keys=True
+                ), hi + 1
             if t.value == "func":
                 return self._func_literal(toks, pos)
             if t.value in ("string",):
@@ -1433,7 +1442,7 @@ class _Eval:
         if not _next_is(toks, j, "("):
             raise GoInterpError("unsupported func literal")
         plo, phi = _group_span(toks, j)
-        params = self._param_names(toks, plo, phi)
+        params = self._param_items(toks, plo, phi)
         j = phi + 1
         depth = 0
         while j < len(toks):
@@ -1452,23 +1461,30 @@ class _Eval:
         blo, bhi = _group_span(toks, j)
         fn = {
             "name": "<literal>", "recv": None,
-            "params": [(n, []) for n in params],
+            "params": params,
             "body": (blo, bhi), "generic": False, "arity": None,
         }
         closure = Closure(fn, self.scan, self.env)
         closure.toks = toks
         return closure, bhi + 1
 
-    def _param_names(self, toks, lo, hi) -> list:
-        """One entry per parameter, None for type-only (unnamed) items,
-        so closure argument positions stay aligned."""
-        names = []
+    def _param_items(self, toks, lo, hi) -> list:
+        """(name-or-None, type-span) per parameter, the same shape
+        _FileScan._parse_params produces, so closures bind through
+        _bind_params exactly like top-level funcs (shared-type names,
+        variadics and all)."""
+        items = []
         for slo, shi in _split_commas(toks, lo, hi):
-            if shi - slo >= 2 and toks[slo].kind == IDENT:
-                names.append(toks[slo].value)
+            span = toks[slo:shi]
+            if (
+                len(span) >= 2
+                and span[0].kind == IDENT
+                and not (span[1].kind == OP and span[1].value == ".")
+            ):
+                items.append((span[0].value, span[1:]))
             else:
-                names.append(None)  # `func(string)`: unnamed param
-        return names
+                items.append((None, span))
+        return items
 
     def _call_value(self, callee, args):
         if isinstance(callee, Closure):
@@ -1480,9 +1496,7 @@ class _Eval:
                 )
             # literal closure: execute its body in the captured env
             env = Env(callee.env)
-            for (name, _span), value in zip(fn["params"], args):
-                if name:
-                    env.define(name, value)
+            _bind_params(env, fn["params"], args)
             ev = _Eval(self.interp, callee.scan, env)
             lo, hi = fn["body"]
             try:

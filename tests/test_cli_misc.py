@@ -433,3 +433,41 @@ class TestBench:
             "standalone", "collection", "kitchen-sink",
         }
         assert detail["cpu_s_median"] > 0
+
+
+class TestEdit:
+    """`edit` — kubebuilder's PROJECT-attribute command (the reference
+    CLI inherits it via the golangv3 bundle, pkg/cli/init.go:27-41)."""
+
+    def _init(self, tmp_path):
+        config = os.path.join(
+            os.path.dirname(__file__), "fixtures", "standalone",
+            "workload.yaml",
+        )
+        out = str(tmp_path / "proj")
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--repo", "github.com/acme/bookstore-operator",
+            "--output-dir", out,
+        ]) == 0
+        return out
+
+    def test_multigroup_recorded_in_project(self, tmp_path):
+        out = self._init(tmp_path)
+        assert cli_main(["edit", "--output-dir", out, "--multigroup"]) == 0
+        with open(os.path.join(out, "PROJECT")) as fh:
+            assert "multigroup: true" in fh.read()
+
+    def test_multigroup_cannot_be_disabled(self, tmp_path):
+        out = self._init(tmp_path)
+        assert cli_main(["edit", "--output-dir", out, "--multigroup"]) == 0
+        rc = cli_main(["edit", "--output-dir", out, "--multigroup=false"])
+        assert rc != 0
+
+    def test_no_flags_is_a_noop(self, tmp_path):
+        out = self._init(tmp_path)
+        with open(os.path.join(out, "PROJECT")) as fh:
+            before = fh.read()
+        assert cli_main(["edit", "--output-dir", out]) == 0
+        with open(os.path.join(out, "PROJECT")) as fh:
+            assert fh.read() == before

@@ -774,6 +774,34 @@ class TestInterpreterSemantics:
         )
         assert it.call("f", {"other": "x"}) is True
 
+    def test_map_literal_keys_are_expressions(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func f(k, v string) map[string]string {\n"
+            "\treturn map[string]string{k: v}\n"
+            "}\n"
+        )
+        assert it.call("f", "realkey", "val") == {"realkey": "val"}
+
+    def test_closure_shared_and_variadic_params(self):
+        it = Interp()
+        it.load_source(
+            "package p\n\n"
+            "func run() int {\n"
+            "\tadd := func(a, b int) int { return a + b }\n"
+            "\tsum := func(xs ...int) int {\n"
+            "\t\ttotal := 0\n"
+            "\t\tfor _, x := range xs {\n"
+            "\t\t\ttotal += x\n"
+            "\t\t}\n"
+            "\t\treturn total\n"
+            "\t}\n"
+            "\treturn add(2, 3) + sum(1, 2, 3)\n"
+            "}\n"
+        )
+        assert it.call("run") == 11
+
     def test_append_with_spread_concatenates(self):
         it = Interp()
         it.load_source(
