@@ -1,0 +1,17 @@
+# Container image for the operator-forge CLI itself (distribution
+# parity with the reference's CLI image, /root/reference/Dockerfile:1).
+# The reference ships a prebuilt Go binary on alpine; operator-forge is
+# pure-Python, so the slim Python base plays the same role.  Many CI
+# tools expect an interactive shell inside the container, which both
+# bases provide.
+FROM python:3.11-slim AS production
+
+WORKDIR /opt/operator-forge
+COPY pyproject.toml README.md ./
+COPY operator_forge ./operator_forge
+RUN pip install --no-cache-dir .
+
+WORKDIR /workdir
+
+ENTRYPOINT ["operator-forge"]
+CMD ["--help"]

@@ -327,7 +327,7 @@ _operator_forge() {
         update)
             COMPREPLY=($(compgen -W "license" -- "$cur"));;
         completion)
-            COMPREPLY=($(compgen -W "bash zsh" -- "$cur"));;
+            COMPREPLY=($(compgen -W "bash zsh fish" -- "$cur"));;
         *)
             COMPREPLY=($(compgen -f -- "$cur"));;
     esac
@@ -339,12 +339,24 @@ _ZSH_COMPLETION = """#compdef operator-forge
 _arguments '1: :(init create edit init-config update completion version preview validate vet)' '*: :_files'
 """
 
+_FISH_COMPLETION = """# fish completion for operator-forge
+complete -c operator-forge -f -n __fish_use_subcommand \
+    -a 'init create edit init-config update completion version preview validate vet'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
+    -a 'standalone collection component'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from update' -a 'license'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from completion' -a 'bash zsh fish'
+"""
+
 
 def cmd_completion(args: argparse.Namespace) -> int:
     if args.shell == "bash":
         sys.stdout.write(_BASH_COMPLETION)
     elif args.shell == "zsh":
         sys.stdout.write(_ZSH_COMPLETION)
+    elif args.shell == "fish":
+        sys.stdout.write(_FISH_COMPLETION)
     else:
         raise CLIError(f"unsupported shell {args.shell!r}")
     return 0
@@ -579,7 +591,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_license.set_defaults(func=cmd_update_license)
 
     p_completion = sub.add_parser("completion", help="shell completion")
-    p_completion.add_argument("shell", choices=["bash", "zsh"])
+    p_completion.add_argument("shell", choices=["bash", "zsh", "fish"])
     p_completion.set_defaults(func=cmd_completion)
 
     p_version = sub.add_parser("version", help="print the version")

@@ -25,6 +25,7 @@ def check_project(root: str) -> list[str]:
     not raised.
     """
     errors: list[str] = []
+    checked = 0
     # index the project's own packages so qualified references between
     # them are checked closed, like the dependency manifest
     index = ProjectIndex(root)
@@ -38,6 +39,7 @@ def check_project(root: str) -> list[str]:
             if not name.endswith(".go") or name.startswith(("_", ".")):
                 continue
             path = os.path.join(dirpath, name)
+            checked += 1
             try:
                 with open(path, encoding="utf-8") as fh:
                     text = fh.read()
@@ -60,4 +62,8 @@ def check_project(root: str) -> list[str]:
     errors.extend(check_structure(root))
     # intra-project method chains and same-package call arity
     errors.extend(check_local_calls(root, index))
+    if checked == 0:
+        # an empty match is a wrong path, not a clean project — `go vet`
+        # likewise errors on a package pattern matching no files
+        errors.append(f"{root}: no Go files found")
     return errors

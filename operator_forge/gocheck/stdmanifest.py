@@ -204,7 +204,7 @@ STD_MANIFEST: dict[str, dict] = {
         "closed": True,
         "funcs": {
             "Arg": (1, 1), "Args": (0, 0), "Bool": (3, 3),
-            "BoolFunc": (2, 2), "BoolVar": (4, 4), "Duration": (3, 3),
+            "BoolFunc": (3, 3), "BoolVar": (4, 4), "Duration": (3, 3),
             "DurationVar": (4, 4), "Float64": (3, 3), "Float64Var": (4, 4),
             "Func": (3, 3), "Int": (3, 3), "Int64": (3, 3),
             "Int64Var": (4, 4), "IntVar": (4, 4), "Lookup": (1, 1),

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Generate shell completion files for release packaging (distribution
+# parity with the reference's build/completions.sh:1).
+set -e
+cd "$(dirname "$0")/.."
+rm -rf completions
+mkdir completions
+for sh in bash zsh fish; do
+	"${PYTHON:-python3}" -m operator_forge completion "$sh" >"completions/operator-forge.$sh"
+done

@@ -95,7 +95,7 @@ class TestMiscCommands:
         assert cli_main(["version"]) == 0
         assert "operator-forge version" in capsys.readouterr().out
 
-    @pytest.mark.parametrize("shell", ["bash", "zsh"])
+    @pytest.mark.parametrize("shell", ["bash", "zsh", "fish"])
     def test_completion(self, shell, capsys):
         assert cli_main(["completion", shell]) == 0
         assert "operator-forge" in capsys.readouterr().out
@@ -183,6 +183,37 @@ class TestMiscCommands:
     def test_vet_missing_dir(self, tmp_path, capsys):
         assert cli_main(["vet", str(tmp_path / "nope")]) == 1
         assert "not a directory" in capsys.readouterr().err
+
+    def test_vet_no_go_files_is_an_error(self, tmp_path, capsys):
+        """A directory matching zero .go files is a wrong path, not a
+        clean project — vet must not print a green light."""
+        (tmp_path / "notes.txt").write_text("nothing Go here\n")
+        assert cli_main(["vet", str(tmp_path)]) == 1
+        captured = capsys.readouterr()
+        assert "no Go files found" in captured.err
+        assert "check cleanly" not in captured.out
+
+    def test_completions_script_generates_all_shells(self, tmp_path):
+        import shutil
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(__file__))
+        work = tmp_path / "repo"
+        (work / "scripts").mkdir(parents=True)
+        shutil.copy(os.path.join(repo, "scripts", "completions.sh"),
+                    work / "scripts" / "completions.sh")
+        env = dict(os.environ, PYTHONPATH=repo, PYTHON=sys.executable)
+        subprocess.run(
+            ["sh", str(work / "scripts" / "completions.sh")],
+            check=True, env=env, cwd=str(work),
+        )
+        generated = sorted(os.listdir(work / "completions"))
+        assert generated == [
+            "operator-forge.bash", "operator-forge.fish", "operator-forge.zsh",
+        ]
+        for name in generated:
+            assert (work / "completions" / name).read_text().strip()
 
 
 class TestCreateAPIFlags:

@@ -617,6 +617,29 @@ class TestTypecheck:
         assert any("os.Exit expects at least 1" in e for e in errs)
         assert any("fmt.Errorf expects at least 1" in e for e in errs)
 
+    def test_flag_boolfunc_real_arity_accepted(self):
+        # ADVICE round-4: the real signature is BoolFunc(name, usage
+        # string, fn func(string) error) — 3 args must pass on the
+        # closed flag surface, and the old 2-arg recording must not
+        # reject valid code
+        src = (
+            "package main\n\n"
+            'import "flag"\n\n'
+            "func main() {\n"
+            '\tflag.BoolFunc("debug", "enable debug", '
+            "func(s string) error { return nil })\n"
+            "}\n"
+        )
+        assert self.types(src) == []
+        short = (
+            "package main\n\n"
+            'import "flag"\n\n'
+            "func main() {\n"
+            '\tflag.BoolFunc("debug", "enable debug")\n'
+            "}\n"
+        )
+        assert any("flag.BoolFunc expects" in e for e in self.types(short))
+
     def test_stdlib_unknown_symbol_caught(self):
         src = (
             "package main\n\n"
