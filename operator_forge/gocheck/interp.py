@@ -76,6 +76,72 @@ class GoStruct:
         return f"GoStruct({self.tname}, {self.fields!r})"
 
 
+class _Timestamp:
+    """A metav1.Time stand-in: only IsZero() is consulted by the
+    emitted code (deletion-timestamp checks)."""
+
+    def __init__(self, zero: bool = True):
+        self.zero = zero
+
+    def IsZero(self):
+        return self.zero
+
+
+class GoObject(GoStruct):
+    """A struct value for kinds embedding metav1.ObjectMeta/TypeMeta:
+    the promoted accessor methods Go provides through the embed are
+    supplied here as Python callables over the same promoted fields
+    (Name, Namespace, Labels, ... live directly in ``fields``, which is
+    also how the pointer-transparent interpreter reads ``parent.Name``).
+    Emitted Go methods on the same type still win: the method registry
+    is consulted before these fallbacks."""
+
+    def GetName(self):
+        return self.fields.get("Name") or ""
+
+    def SetName(self, name):
+        self.fields["Name"] = name
+
+    def GetNamespace(self):
+        return self.fields.get("Namespace") or ""
+
+    def SetNamespace(self, namespace):
+        self.fields["Namespace"] = namespace
+
+    def GetLabels(self):
+        return self.fields.get("Labels")
+
+    def SetLabels(self, labels):
+        self.fields["Labels"] = labels
+
+    def GetAnnotations(self):
+        return self.fields.get("Annotations")
+
+    def SetAnnotations(self, annotations):
+        self.fields["Annotations"] = annotations
+
+    def GetFinalizers(self):
+        return self.fields.get("Finalizers") or []
+
+    def SetFinalizers(self, finalizers):
+        self.fields["Finalizers"] = finalizers
+
+    def GetGeneration(self):
+        return self.fields.get("Generation") or 0
+
+    def GetDeletionTimestamp(self):
+        return self.fields.get("DeletionTimestamp") or _Timestamp()
+
+    def SetDeletionTimestamp(self, ts):
+        self.fields["DeletionTimestamp"] = ts
+
+    def GetOwnerReferences(self):
+        return self.fields.get("OwnerReferences") or []
+
+    def SetOwnerReferences(self, refs):
+        self.fields["OwnerReferences"] = refs
+
+
 @dataclass
 class TypeRef:
     name: str
@@ -85,6 +151,16 @@ class TypeRef:
 class MapTypeRef(TypeRef):
     """A named map type (client.MatchingLabels): composite literals over
     it evaluate their keys as EXPRESSIONS, not field names."""
+
+
+@dataclass
+class TypeFactory(TypeRef):
+    """A struct type whose composite literals / zero values are built by
+    a callable (fields dict -> value).  Cross-package loaders use this to
+    make ``shopv1alpha1.BookStore{}`` come out as a GoObject with the
+    metav1-promoted accessors instead of a bare GoStruct."""
+
+    make: object = None
 
 
 @dataclass
@@ -204,6 +280,43 @@ class _UnstructuredModule:
         def SetLabels(self, labels):
             self.Object.setdefault("metadata", {})["labels"] = labels
 
+        def GetAPIVersion(self):
+            return self.Object.get("apiVersion", "")
+
+        def SetAPIVersion(self, version):
+            self.Object["apiVersion"] = version
+
+        def SetName(self, name):
+            # apimachinery removes the nested field on empty string
+            # (unstructured.go SetName/SetNamespace)
+            if not name:
+                self.Object.get("metadata", {}).pop("name", None)
+                return
+            self.Object.setdefault("metadata", {})["name"] = name
+
+        def SetNamespace(self, namespace):
+            if not namespace:
+                self.Object.get("metadata", {}).pop("namespace", None)
+                return
+            self.Object.setdefault("metadata", {})["namespace"] = namespace
+
+        def GetDeletionTimestamp(self):
+            ts = _nested(self.Object, "metadata", "deletionTimestamp")[0]
+            return _Timestamp(zero=not ts)
+
+        def GetOwnerReferences(self):
+            return _nested(self.Object, "metadata", "ownerReferences")[0] or []
+
+        def SetOwnerReferences(self, refs):
+            self.Object.setdefault("metadata", {})["ownerReferences"] = refs
+
+        def DeepCopy(self):
+            import copy
+
+            dup = type(self)()
+            dup.Object = copy.deepcopy(self.Object)
+            return dup
+
     @staticmethod
     def NestedInt64(obj, *path):
         value, found, _ = _nested(obj, *path)
@@ -285,10 +398,12 @@ class _FmtModule:
     @staticmethod
     def Errorf(fmt, *args):
         err = GoError(_go_format(fmt, list(args)))
-        # %w wrapping: preserve NotFound-ness of the wrapped error
-        err.not_found = any(
-            isinstance(a, GoError) and a.not_found for a in args
-        )
+        # %w wrapping: record the wrapped error for errors.Is/Unwrap and
+        # preserve its NotFound-ness
+        for a in args:
+            if isinstance(a, GoError):
+                err.wrapped = a
+                err.not_found = err.not_found or a.not_found
         return err
 
 
@@ -351,6 +466,74 @@ class _MetaModule:
         return isinstance(err, GoError) and getattr(err, "no_match", False)
 
 
+class _SchemaModule:
+    """k8s.io/apimachinery/pkg/runtime/schema: GroupVersionKind and
+    GroupVersion as native classes (not bare TypeRefs) because the
+    emitted code calls methods on their composite-literal values —
+    ``gvk.GroupVersion().WithKind(gvk.Kind + "List")`` in the teardown
+    sweep and dependency check."""
+
+    GroupKind = TypeRef("GroupKind")
+
+    class GroupVersion:
+        Group = ""
+        Version = ""
+
+        def WithKind(self, kind):
+            gvk = _SchemaModule.GroupVersionKind()
+            gvk.Group = self.Group
+            gvk.Version = self.Version
+            gvk.Kind = kind
+            return gvk
+
+        def String(self):
+            if self.Group == "":
+                return self.Version
+            return f"{self.Group}/{self.Version}"
+
+        def Identifier(self):
+            return self.String()
+
+    class GroupVersionKind:
+        Group = ""
+        Version = ""
+        Kind = ""
+
+        def GroupVersion(self):
+            gv = _SchemaModule.GroupVersion()
+            gv.Group = self.Group
+            gv.Version = self.Version
+            return gv
+
+        def String(self):
+            return f"{self.Group}/{self.Version}, Kind={self.Kind}"
+
+        def Empty(self):
+            return not (self.Group or self.Version or self.Kind)
+
+
+class _ErrorsModule:
+    """The stdlib errors package surface the emitted code touches."""
+
+    @staticmethod
+    def New(msg):
+        return GoError(msg)
+
+    @staticmethod
+    def Is(err, target):
+        # Go semantics: walk the %w chain comparing identity; two
+        # distinct errors.New values are never Is-equal
+        while err is not None:
+            if err is target:
+                return True
+            err = getattr(err, "wrapped", None)
+        return False
+
+    @staticmethod
+    def Unwrap(err):
+        return getattr(err, "wrapped", None)
+
+
 class _TimeModule:
     Nanosecond = 1
     Microsecond = 1000
@@ -382,12 +565,12 @@ def default_natives() -> dict:
         "k8s.io/apimachinery/pkg/apis/meta/v1/unstructured":
             _UnstructuredModule,
         "k8s.io/apimachinery/pkg/api/errors": _ApiErrorsModule,
+        "errors": _ErrorsModule,
         "fmt": _FmtModule,
         "hash/fnv": _FnvModule,
         "time": _TimeModule,
         "k8s.io/apimachinery/pkg/types": _StructModule("NamespacedName"),
-        "k8s.io/apimachinery/pkg/runtime/schema":
-            _StructModule("GroupVersionKind", "GroupKind"),
+        "k8s.io/apimachinery/pkg/runtime/schema": _SchemaModule,
         "k8s.io/apimachinery/pkg/api/meta": _MetaModule,
         "sigs.k8s.io/controller-runtime": _StructModule("Result"),
         "sigs.k8s.io/controller-runtime/pkg/client": _ClientModule,
@@ -411,16 +594,26 @@ _UNIVERSE_CONSTS = {"true": True, "false": False, "nil": None, "iota": 0}
 class Interp:
     """Loads a package directory of generated Go and executes calls."""
 
-    def __init__(self, natives: dict | None = None):
+    def __init__(self, natives: dict | None = None,
+                 methods: dict | None = None):
         self.natives = natives if natives is not None else default_natives()
         self.funcs: dict[str, tuple] = {}     # name -> (fn, scan)
-        self.methods: dict[tuple, tuple] = {}  # (tname, name) -> (fn, scan)
+        # (tname, name) -> (fn, scan); pass a shared dict to link the
+        # per-package interpreters of one project, so a method declared
+        # in the apis package dispatches from the controllers package
+        # (type names are unique across one generated project)
+        self.methods: dict[tuple, tuple] = (
+            methods if methods is not None else {}
+        )
         self.consts: dict[str, object] = {}
         self.types: set[str] = set()
+        self.scans: list = []
+        self._pending_values: list = []
 
     # -- loading ----------------------------------------------------------
 
-    def load_source(self, text: str, path: str = "<go>") -> None:
+    def load_source(self, text: str, path: str = "<go>",
+                    defer_values: bool = False) -> None:
         scan = _FileScan(path, text)
         for fn in scan.funcs:
             if fn["body"] is None:
@@ -433,15 +626,33 @@ class Interp:
                     self.methods[(base, fn["name"])] = (fn, scan)
         for td in scan.typedecls:
             self.types.add(td["name"])
+        self.scans.append(scan)
         # package-level consts/vars with initializers
         for name, type_span, init_span in scan.value_inits:
             if init_span is None:
                 continue
-            try:
-                value = self._eval_span(scan, init_span)
-            except (GoInterpError, KeyError):
-                continue  # values the subset can't build; fine unless used
-            self.consts[name] = value
+            self._pending_values.append((scan, name, init_span))
+        if not defer_values:
+            self.eval_pending_values()
+
+    def eval_pending_values(self) -> None:
+        """Evaluate deferred package-level initializers to a fixpoint:
+        a var may reference funcs or vars from files loaded after its
+        own, so failures are retried while any pass makes progress and
+        dropped only when none does (unused unevaluable values are
+        fine; a used one raises at lookup)."""
+        pending = self._pending_values
+        while pending:
+            remaining = []
+            for scan, name, init_span in pending:
+                try:
+                    self.consts[name] = self._eval_span(scan, init_span)
+                except (GoInterpError, KeyError):
+                    remaining.append((scan, name, init_span))
+            if len(remaining) == len(pending):
+                break
+            pending = remaining
+        self._pending_values = []
 
     def load_dir(self, pkg_dir: str) -> None:
         import os
@@ -450,7 +661,11 @@ class Interp:
             if not name.endswith(".go") or name.endswith("_test.go"):
                 continue
             with open(os.path.join(pkg_dir, name), encoding="utf-8") as fh:
-                self.load_source(fh.read(), os.path.join(pkg_dir, name))
+                self.load_source(
+                    fh.read(), os.path.join(pkg_dir, name),
+                    defer_values=True,
+                )
+        self.eval_pending_values()
 
     def _eval_span(self, scan, span) -> object:
         ev = _Eval(self, scan, Env())
@@ -991,6 +1206,17 @@ class _Eval:
             return lambda: []
         if toks and toks[0].kind == KEYWORD and toks[0].value == "map":
             return lambda: {}
+        # a qualified struct type (shopv1alpha1.BookStore) or a native
+        # class: construct its zero value through the resolved type
+        resolved = self._resolve_type_value(type_span)
+        if isinstance(resolved, TypeFactory):
+            return lambda: resolved.make({})
+        if isinstance(resolved, MapTypeRef):
+            return lambda: {}
+        if isinstance(resolved, TypeRef):
+            return lambda: GoStruct(resolved.name)
+        if isinstance(resolved, type):
+            return resolved
         return None
 
     def _simple_stmt(self, toks, i, hi, env) -> int:
@@ -1284,35 +1510,114 @@ class _Eval:
                 pos = hi + 1
                 continue
             if t.kind == OP and t.value == "{":
-                if isinstance(value, MapTypeRef):
+                if isinstance(value, (TypeRef, type)):
                     lo, hi = _group_span(toks, pos)
-                    value = self._composite(
-                        "map", toks, lo, hi, expr_keys=True
-                    )
-                    pos = hi + 1
-                    continue
-                if isinstance(value, TypeRef):
-                    lo, hi = _group_span(toks, pos)
-                    value = self._composite(value.name, toks, lo, hi)
-                    pos = hi + 1
-                    continue
-                if isinstance(value, type):
-                    # a native class used as a composite literal:
-                    # instantiate and set the fields as attributes
-                    lo, hi = _group_span(toks, pos)
-                    built = self._composite("<native>", toks, lo, hi)
-                    inst = value()
-                    if isinstance(built, GoStruct):
-                        for fname, fval in built.fields.items():
-                            setattr(inst, fname, fval)
-                    value = inst
+                    value = self._build_composite(value, toks, lo, hi)
                     pos = hi + 1
                     continue
                 break
             break
         return value, pos
 
-    def _composite(self, tname, toks, lo, hi, expr_keys=False):
+    def _build_composite(self, typeval, toks, lo, hi):
+        """Build a composite-literal value for a RESOLVED type: a named
+        map type, a named struct type (TypeRef -> GoStruct, TypeFactory
+        -> its own construction), or a native Python class."""
+        if isinstance(typeval, MapTypeRef):
+            return self._composite("map", toks, lo, hi, expr_keys=True)
+        if isinstance(typeval, TypeFactory):
+            built = self._composite(typeval.name, toks, lo, hi)
+            fields = built.fields if isinstance(built, GoStruct) else {}
+            return typeval.make(fields)
+        if isinstance(typeval, TypeRef):
+            return self._composite(typeval.name, toks, lo, hi)
+        # a native class: instantiate and set fields as attributes
+        built = self._composite("<native>", toks, lo, hi)
+        inst = typeval()
+        if isinstance(built, GoStruct):
+            for fname, fval in built.fields.items():
+                setattr(inst, fname, fval)
+        return inst
+
+    def _resolve_type_value(self, span):
+        """Resolve a type expression span (``Name``, ``pkg.Name``,
+        optionally pointered) to a TypeRef / native class, or None when
+        the span is not a resolvable named type."""
+        toks = [t for t in span if not (t.kind == OP and t.value == "*")]
+        try:
+            if len(toks) == 1 and toks[0].kind == IDENT:
+                value = self.lookup(toks[0].value, self.env)
+            elif (
+                len(toks) == 3
+                and toks[0].kind == IDENT
+                and toks[1].kind == OP
+                and toks[1].value == "."
+                and toks[2].kind == IDENT
+            ):
+                value = _get_attr(
+                    self.lookup(toks[0].value, self.env), toks[2].value
+                )
+            else:
+                return None
+        except GoInterpError:
+            return None
+        if isinstance(value, TypeRef) or isinstance(value, type):
+            return value
+        return None
+
+    def _type_end(self, toks, j):
+        """Index just past the type expression starting at toks[j]:
+        handles pointers, slices/arrays, maps, interface{}/struct{},
+        func signatures (with result), and qualified identifiers.  Used
+        to find where a composite literal's BODY brace begins, so type
+        braces (interface{}, func(...) bodies of func types) are not
+        mistaken for it."""
+        n = len(toks)
+        while j < n:
+            t = toks[j]
+            if t.kind == OP and t.value == "*":
+                j += 1
+                continue
+            if t.kind == OP and t.value == "[":
+                j = _skip_group_from(toks, j)
+                continue  # element type follows
+            if t.kind == KEYWORD and t.value == "map":
+                j = _skip_group_from(toks, j + 1)
+                continue  # value type follows
+            if t.kind == KEYWORD and t.value in ("interface", "struct"):
+                j += 1
+                if j < n and toks[j].kind == OP and toks[j].value == "{":
+                    j = _skip_group_from(toks, j)
+                return j
+            if t.kind == KEYWORD and t.value == "func":
+                j += 1
+                if j < n and toks[j].kind == OP and toks[j].value == "(":
+                    j = _skip_group_from(toks, j)  # params
+                if j < n and toks[j].kind == OP and toks[j].value == "(":
+                    return _skip_group_from(toks, j)  # (results)
+                if j < n and (
+                    toks[j].kind in (IDENT,)
+                    or (toks[j].kind == OP and toks[j].value in ("*", "["))
+                    or (toks[j].kind == KEYWORD
+                        and toks[j].value in ("map", "interface", "struct"))
+                ):
+                    return self._type_end(toks, j)  # single bare result
+                return j
+            if t.kind == IDENT:
+                j += 1
+                while (
+                    j + 1 < n
+                    and toks[j].kind == OP
+                    and toks[j].value == "."
+                    and toks[j + 1].kind == IDENT
+                ):
+                    j += 2
+                return j
+            return j
+        return j
+
+    def _composite(self, tname, toks, lo, hi, expr_keys=False,
+                   elem_type=None):
         fields = {}
         elems = []
         for slo, shi in _split_commas(toks, lo, hi):
@@ -1340,6 +1645,16 @@ class _Eval:
             elif colon is not None:
                 key = self._eval_range(toks, slo, colon, self.env)
                 fields[key] = self._eval_range(toks, colon + 1, shi, self.env)
+            elif (
+                elem_type is not None
+                and toks[slo].kind == OP
+                and toks[slo].value == "{"
+            ):
+                # elided element type: []schema.GroupVersionKind{{...}}
+                glo, ghi = _group_span(toks, slo)
+                elems.append(
+                    self._build_composite(elem_type, toks, glo, ghi)
+                )
             else:
                 elems.append(self._eval_range(toks, slo, shi, self.env))
         if tname in ("slice", "map"):
@@ -1393,16 +1708,18 @@ class _Eval:
                 # slice type literal: []T{...} or conversion []byte(x)
                 close = _skip_group_from(toks, pos) - 1
                 j = close + 1
-                # element type tokens
-                k = j
-                while k < len(toks) and not (
-                    toks[k].kind == OP and toks[k].value in ("{", "(")
-                ):
-                    k += 1
-                if k < len(toks) and toks[k].value == "{":
+                # element type tokens (type-aware: interface{} braces and
+                # func-type signatures are part of the TYPE, not the body)
+                k = self._type_end(toks, j)
+                if k < len(toks) and toks[k].kind == OP and \
+                        toks[k].value == "{":
                     lo, hi = _group_span(toks, k)
-                    return self._composite("slice", toks, lo, hi), hi + 1
-                if k < len(toks) and toks[k].value == "(":
+                    elem_type = self._resolve_type_value(toks[j:k])
+                    return self._composite(
+                        "slice", toks, lo, hi, elem_type=elem_type
+                    ), hi + 1
+                if k < len(toks) and toks[k].kind == OP and \
+                        toks[k].value == "(":
                     lo, hi = _group_span(toks, k)
                     arg = self._eval_range(toks, lo, hi, self.env)
                     type_text = "".join(
@@ -1420,10 +1737,7 @@ class _Eval:
                 # map[K]V{...}
                 j = pos + 1
                 j = _skip_group_from(toks, j)  # [K]
-                while j < len(toks) and not (
-                    toks[j].kind == OP and toks[j].value == "{"
-                ):
-                    j += 1
+                j = self._type_end(toks, j)  # V (may be interface{})
                 lo, hi = _group_span(toks, j)
                 # map-literal keys are EXPRESSIONS (`{k: v}` reads the
                 # variable k), unlike struct-literal field names
@@ -1569,7 +1883,16 @@ def _apply_binop(op, a, b):
 
 def _get_attr(obj, name):
     if isinstance(obj, GoStruct):
-        return obj.fields.get(name)
+        if name in obj.fields:
+            return obj.fields[name]
+        # GoObject supplies metav1-promoted accessors as Python
+        # callables; a field miss falls through to them (the method
+        # registry was already consulted by postfix, so emitted Go
+        # methods still shadow these)
+        attr = getattr(obj, name, None)
+        if callable(attr) and not isinstance(attr, type):
+            return attr
+        return None
     if obj is None:
         raise GoInterpError(f"field {name!r} on nil")
     attr = getattr(obj, name, None)
@@ -1601,6 +1924,11 @@ def _type_assert(value, type_text: str) -> bool:
         return isinstance(value, bool)
     if type_text.startswith("[]"):
         return isinstance(value, list)
+    if isinstance(value, GoStruct):
+        # named struct assertion: match the (possibly qualified,
+        # possibly pointered) type's base name against the value's
+        base = type_text.lstrip("*").split(".")[-1]
+        return value.tname == base
     return value is not None
 
 

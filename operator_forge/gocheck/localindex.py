@@ -295,6 +295,7 @@ class _FileScan:
             self.typedecls.append({
                 "name": name, "kind": "struct", "fields": fields,
                 "embeds": embeds, "generic": generic,
+                "tags": self.last_tags, "embed_tags": self.last_embed_tags,
             })
             return self._skip_group(j + 1, "{", "}")
         if (
@@ -332,10 +333,19 @@ class _FileScan:
         return j
 
     def _parse_struct_fields(self, lo: int, hi: int):
-        """Split a struct body into named fields and embeds (lines)."""
+        """Split a struct body into named fields and embeds (lines).
+
+        Also records each line's struct tag (the trailing backquoted
+        string, e.g. `json:"replicas,omitempty"`) in ``self.last_tags``
+        / ``self.last_embed_tags`` so callers that need serialization
+        metadata (the interpreter's yaml decode) can read it; the
+        (name, type_span) shape every existing caller consumes is
+        unchanged."""
         toks = self.toks
         fields: list[tuple[str, list[Token]]] = []
         embeds: list[list[Token]] = []
+        tags: dict[str, str] = {}
+        embed_tags: list[str] = []
         j = lo
         line_start = lo
         depth = 0
@@ -353,8 +363,10 @@ class _FileScan:
             span = toks[line_start:j]
             j += 1
             line_start = j
-            # drop a trailing tag string
+            # drop a trailing tag string (kept aside for tags/embed_tags)
+            tag = ""
             if span and span[-1].kind == STRING:
+                tag = span[-1].value
                 span = span[:-1]
             if not span:
                 continue
@@ -377,8 +389,13 @@ class _FileScan:
                 type_span = span[k + 1:]
                 for nm in names:
                     fields.append((nm, type_span))
+                    if tag:
+                        tags[nm] = tag
             else:
                 embeds.append(span)
+                embed_tags.append(tag)
+        self.last_tags = tags
+        self.last_embed_tags = embed_tags
         return fields, embeds
 
     def _parse_interface_specs(self, lo: int, hi: int):

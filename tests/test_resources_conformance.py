@@ -1,0 +1,373 @@
+"""Differential conformance for the EMITTED resources packages.
+
+Two independent implementations of the marker-substitution semantics
+exist: the generated Go create funcs (reference
+internal/plugins/workload/v1/scaffolds/templates/api/resources/
+{resources,definition}.go — the heart of the code generator, compiled
+and exercised by the reference's CI, .github/workflows/test.yaml:55-141)
+and ``operator_forge.workload.preview``, a native renderer sharing no
+code with the emitted Go.  Nothing checked that they agree — until
+here: these tests EXECUTE the emitted create funcs, ``Generate`` and
+``GenerateForCLI`` under the Go interpreter (gocheck/gopkg) and assert
+the constructed unstructured objects equal preview's output
+document-for-document, across standalone, collection, and kitchen-sink
+fixtures, including resource-marker include/exclude guards and
+namespace defaulting.  Seeded mutations in the emitted substitution
+code prove the differential actually discriminates.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from operator_forge.gocheck.gopkg import ProjectRuntime
+from operator_forge.gocheck.interp import GoError, GoStruct
+from operator_forge.workload.preview import preview
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _scaffold(root: str, fixture: str) -> str:
+    """Generate a project from *fixture* into root/proj; returns the
+    project dir.  The config (and its manifests) are copied next to the
+    project so PROJECT-recorded paths stay valid."""
+    proj = os.path.join(root, "proj")
+    os.makedirs(proj, exist_ok=True)
+    for name in os.listdir(os.path.join(FIXTURES, fixture)):
+        shutil.copy(os.path.join(FIXTURES, fixture, name), proj)
+    config = os.path.join(proj, "workload.yaml")
+    base = [sys.executable, "-m", "operator_forge"]
+    for sub in (["init"], ["create", "api"]):
+        subprocess.run(
+            base + sub + [
+                "--workload-config", config,
+                "--output-dir", proj,
+            ] + (["--repo", f"github.com/acme/{fixture}"]
+                 if sub == ["init"] else []),
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+    return proj
+
+
+@pytest.fixture(scope="module")
+def standalone(tmp_path_factory):
+    return _scaffold(str(tmp_path_factory.mktemp("diff-standalone")),
+                     "standalone")
+
+
+@pytest.fixture(scope="module")
+def collection(tmp_path_factory):
+    return _scaffold(str(tmp_path_factory.mktemp("diff-collection")),
+                     "collection")
+
+
+@pytest.fixture(scope="module")
+def kitchen_sink(tmp_path_factory):
+    return _scaffold(str(tmp_path_factory.mktemp("diff-sink")),
+                     "kitchen-sink")
+
+
+def _kind_packages(runtime: ProjectRuntime) -> list[str]:
+    return [p for p in runtime.packages
+            if p.startswith("apis/") and p.count("/") >= 3]
+
+
+def _emitted_docs(objs) -> list[dict]:
+    return [o.Object for o in objs]
+
+
+def _preview_docs(config: str, cr_path: str,
+                  collection_path: str | None = None) -> list[dict]:
+    out = preview(config, cr_path, collection_path)
+    return [d for d in yaml.safe_load_all(out) if d is not None]
+
+
+def _write_cr(tmp_path, cr: dict, name: str = "cr.yaml") -> str:
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "w", encoding="utf-8") as fh:
+        yaml.safe_dump(cr, fh, sort_keys=False)
+    return path
+
+
+class TestStandaloneDifferential:
+    """Emitted bookstore package vs preview, document for document."""
+
+    def _generate(self, proj, cr: dict):
+        runtime = ProjectRuntime(proj)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        objs, err = pkg.Generate(runtime.decode_cr(cr))
+        assert err is None
+        return _emitted_docs(objs)
+
+    def test_sample_cr_matches_preview(self, standalone, tmp_path):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        emitted = self._generate(standalone, cr)
+        wanted = _preview_docs(
+            os.path.join(standalone, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        assert emitted == wanted
+        assert len(emitted) == 3  # Deployment, Service, Role (guard off)
+
+    def test_non_default_values_flow_through_both(
+        self, standalone, tmp_path
+    ):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        cr["spec"]["deployment"]["replicas"] = 7
+        cr["spec"]["deployment"]["image"] = "registry.local/store:2"
+        cr["spec"]["app"]["label"] = "shopfront"
+        cr["spec"]["service"]["name"] = "front"
+        cr["spec"]["service"]["port"] = 8443
+        emitted = self._generate(standalone, cr)
+        wanted = _preview_docs(
+            os.path.join(standalone, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        assert emitted == wanted
+        deploy = emitted[0]
+        assert deploy["spec"]["replicas"] == 7
+        assert (deploy["spec"]["template"]["spec"]["containers"][0]["image"]
+                == "registry.local/store:2")
+        svc = emitted[1]
+        assert svc["metadata"]["name"] == "front-svc"
+        assert svc["spec"]["ports"][0]["port"] == 8443
+
+    def test_include_guard_flips_with_marker_field(
+        self, standalone, tmp_path
+    ):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        cr["spec"]["deployment"]["debug"] = True
+        emitted = self._generate(standalone, cr)
+        wanted = _preview_docs(
+            os.path.join(standalone, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        assert emitted == wanted
+        assert [d["kind"] for d in emitted] == [
+            "Deployment", "Service", "ConfigMap", "Role",
+        ]
+
+    def test_namespaced_cr_defaults_child_namespaces(
+        self, standalone, tmp_path
+    ):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = "team-a"
+        emitted = self._generate(standalone, cr)
+        wanted = _preview_docs(
+            os.path.join(standalone, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        assert emitted == wanted
+        assert all(d["metadata"]["namespace"] == "team-a" for d in emitted)
+
+    def test_generate_for_cli_agrees_with_generate(self, standalone):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        sample = pkg.Sample(False)
+        via_cli, err = pkg.GenerateForCLI(sample.encode())
+        assert err is None
+        direct, err = pkg.Generate(
+            runtime.decode_cr(yaml.safe_load(sample))
+        )
+        assert err is None
+        assert _emitted_docs(via_cli) == _emitted_docs(direct)
+
+    def test_generate_for_cli_rejects_nameless_workload(self, standalone):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        objs, err = pkg.GenerateForCLI(
+            b"apiVersion: shop.example.io/v1alpha1\nkind: BookStore\n"
+        )
+        assert objs is None
+        assert isinstance(err, GoError)
+        assert "error validating workload yaml" in err.msg
+
+    def test_each_create_func_union_equals_generate(self, standalone):
+        runtime = ProjectRuntime(standalone)
+        interp = runtime.interp("apis/shop/v1alpha1/bookstore")
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        parent = runtime.decode_cr(yaml.safe_load(pkg.Sample(False)))
+        union = []
+        for name in sorted(interp.funcs):
+            if not name.startswith("Create"):
+                continue
+            objs, err = interp.call(name, parent)
+            assert err is None, name
+            union.extend(_emitted_docs(objs))
+        direct, err = pkg.Generate(parent)
+        assert err is None
+        # CreateFuncs order is manifest order; sort both for set equality
+        keyed = sorted(union, key=lambda d: (d["kind"], str(d["metadata"])))
+        wanted = sorted(_emitted_docs(direct),
+                        key=lambda d: (d["kind"], str(d["metadata"])))
+        assert keyed == wanted
+
+    def test_child_resource_gvks_fixed_at_generation(self, standalone):
+        # the static teardown kind set (elided-composite evaluation)
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        gvks = [(g.Group, g.Version, g.Kind) for g in pkg.ChildResourceGVKs]
+        assert gvks == [
+            ("apps", "v1", "Deployment"),
+            ("", "v1", "Service"),
+            ("", "v1", "ConfigMap"),
+            ("rbac.authorization.k8s.io", "v1", "Role"),
+        ]
+
+    def test_convert_workload_discriminates_types(self, standalone):
+        runtime = ProjectRuntime(standalone)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        parent = runtime.universe.make("BookStore")
+        converted, err = pkg.ConvertWorkload(parent)
+        assert err is None and converted is parent
+        wrong, err = pkg.ConvertWorkload(GoStruct("SomethingElse"))
+        assert wrong is None
+        assert isinstance(err, GoError)
+        assert "unable to convert" in err.msg
+
+
+class TestCollectionDifferential:
+    """Component packages thread the collection's values; the collection
+    package renders its own resources."""
+
+    def test_component_matches_preview_with_collection(
+        self, collection, tmp_path
+    ):
+        runtime = ProjectRuntime(collection)
+        cache = runtime.package("apis/platform/v1alpha1/cache")
+        platform = runtime.package("apis/platform/v1alpha1/platform")
+        com_cr = yaml.safe_load(cache.Sample(False))
+        col_cr = yaml.safe_load(platform.Sample(False))
+        objs, err = cache.Generate(
+            runtime.decode_cr(com_cr), runtime.decode_cr(col_cr)
+        )
+        assert err is None
+        wanted = _preview_docs(
+            os.path.join(collection, "workload.yaml"),
+            _write_cr(tmp_path, com_cr, "component.yaml"),
+            _write_cr(tmp_path, col_cr, "collection.yaml"),
+        )
+        emitted = _emitted_docs(objs)
+        assert emitted == wanted
+        # the collection-marker substitution took the collection's values
+        deploy = emitted[0]
+        assert deploy["metadata"]["namespace"] == (
+            col_cr["spec"]["platformNamespace"]
+        )
+        assert (deploy["spec"]["template"]["spec"]["containers"][0]["image"]
+                == col_cr["spec"]["cacheImage"])
+
+    def test_collection_own_resources_match_preview(
+        self, collection, tmp_path
+    ):
+        runtime = ProjectRuntime(collection)
+        platform = runtime.package("apis/platform/v1alpha1/platform")
+        col_cr = yaml.safe_load(platform.Sample(False))
+        objs, err = platform.Generate(runtime.decode_cr(col_cr))
+        assert err is None
+        wanted = _preview_docs(
+            os.path.join(collection, "workload.yaml"),
+            _write_cr(tmp_path, col_cr, "collection.yaml"),
+        )
+        assert _emitted_docs(objs) == wanted
+
+    def test_component_cli_requires_valid_collection(self, collection):
+        runtime = ProjectRuntime(collection)
+        cache = runtime.package("apis/platform/v1alpha1/cache")
+        platform = runtime.package("apis/platform/v1alpha1/platform")
+        good, err = cache.GenerateForCLI(
+            cache.Sample(False).encode(), platform.Sample(False).encode()
+        )
+        assert err is None and len(good) >= 1
+        _objs, err = cache.GenerateForCLI(
+            cache.Sample(False).encode(),
+            b"apiVersion: platform.acme.io/v1alpha1\nkind: Platform\n",
+        )
+        assert isinstance(err, GoError)
+        assert "collection yaml" in err.msg
+
+
+class TestKitchenSinkDifferential:
+    """The widest marker surface: every child kind the kitchen-sink
+    fixture renders must agree between emitted Go and preview."""
+
+    def test_all_children_match_preview(self, kitchen_sink, tmp_path):
+        runtime = ProjectRuntime(kitchen_sink)
+        (kind_pkg,) = _kind_packages(runtime)
+        pkg = runtime.package(kind_pkg)
+        cr = yaml.safe_load(pkg.Sample(False))
+        objs, err = pkg.Generate(runtime.decode_cr(cr))
+        assert err is None
+        wanted = _preview_docs(
+            os.path.join(kitchen_sink, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        emitted = _emitted_docs(objs)
+        assert [d["kind"] for d in emitted] == [d["kind"] for d in wanted]
+        assert emitted == wanted
+
+
+# seeded mutations in the EMITTED substitution code: each must make the
+# differential disagree, proving it guards the create-func semantics
+# (resources-package counterpart of the orchestrate mutation suite)
+RESOURCE_MUTATIONS = [
+    ("app.go",
+     "if parent.Spec.Deployment.Debug != true {",
+     "if parent.Spec.Deployment.Debug == true {",
+     "include-guard-inverted"),
+    ("app.go",
+     '"replicas": parent.Spec.Deployment.Replicas,',
+     '"replicas": 2,',
+     "substitution-dropped"),
+    ("app.go",
+     'if resourceObj.GetNamespace() == "" {',
+     'if resourceObj.GetNamespace() != "" {',
+     "namespace-default-dropped"),
+]
+
+
+class TestSeededResourceMutationsDetected:
+    @pytest.mark.parametrize(
+        "fname,orig,mutated,label", RESOURCE_MUTATIONS,
+        ids=[m[3] for m in RESOURCE_MUTATIONS],
+    )
+    def test_mutation_breaks_differential(
+        self, standalone, tmp_path, fname, orig, mutated, label
+    ):
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        path = os.path.join(proj, "apis", "shop", "v1alpha1", "bookstore",
+                            fname)
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        assert orig in text, f"mutation anchor missing: {orig!r}"
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(orig, mutated))
+
+        runtime = ProjectRuntime(proj)
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        if label == "substitution-dropped":
+            cr["spec"]["deployment"]["replicas"] = 7
+        if label == "namespace-default-dropped":
+            cr["metadata"]["namespace"] = "team-a"
+        objs, err = pkg.Generate(runtime.decode_cr(cr))
+        assert err is None
+        wanted = _preview_docs(
+            os.path.join(proj, "workload.yaml"),
+            _write_cr(tmp_path, cr),
+        )
+        assert _emitted_docs(objs) != wanted
