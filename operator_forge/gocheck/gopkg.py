@@ -107,12 +107,12 @@ class TypeUniverse:
                 tags = td.get("tags", {})
                 for fname, span in td["fields"]:
                     jkey = _parse_tag(tags.get(fname, ""))
-                    if jkey is None:
-                        # no/blank json tag: Go's yaml path (sigs yaml ->
-                        # json) falls back to the field name; "-" opts out
-                        jkey = fname
                     if jkey == "-":
                         continue
+                    if not jkey:
+                        # no tag, or an empty tag name (`json:",omitempty"`):
+                        # encoding/json falls back to the field name
+                        jkey = fname
                     info.fields.append((fname, jkey, _type_text(span)))
                 embed_tags = td.get("embed_tags", [])
                 for idx, span in enumerate(td.get("embeds", [])):
@@ -140,13 +140,19 @@ class TypeUniverse:
             return self.decode(base, {})
         if base in ("string",):
             return ""
-        if base.startswith(("int", "uint", "float")):
+        if base in ("interface{}", "any"):
+            return None
+        if base.startswith(("int", "uint", "float", "byte", "rune")):
             return 0
         if base == "bool":
             return False
         return None
 
     def decode_value(self, type_text: str, data):
+        if data is None:
+            # an explicit YAML null (`spec:` with no body): Go's json
+            # decoder leaves a non-pointer field at its zero value
+            return self.zero(type_text)
         t = type_text.lstrip("*")
         if t.startswith("[]") and isinstance(data, list):
             return [self.decode_value(t[2:], item) for item in data]
@@ -299,6 +305,7 @@ class ProjectRuntime:
         if extra_natives:
             self.natives.update(extra_natives)
         self.methods: dict = {}
+        self.embeds: dict = {}
         self.packages: dict[str, Interp] = {}  # relpath -> Interp
         for rel in self._package_dirs():
             self._load_package(rel)
@@ -331,7 +338,8 @@ class ProjectRuntime:
         return rels
 
     def _load_package(self, rel: str) -> None:
-        interp = Interp(natives=self.natives, methods=self.methods)
+        interp = Interp(natives=self.natives, methods=self.methods,
+                        embeds=self.embeds)
         interp.load_dir(os.path.join(self.root, rel))
         self.packages[rel] = interp
         self.universe.add_interp(interp)

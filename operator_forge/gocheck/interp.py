@@ -250,14 +250,26 @@ class _UnstructuredModule:
         # metadata accessors the emitted code touches
         def SetGroupVersionKind(self, gvk):
             self._gvk = gvk
-            if isinstance(gvk, GoStruct):
-                self.Object.setdefault("kind", gvk.fields.get("Kind"))
+            kind = (gvk.fields.get("Kind") if isinstance(gvk, GoStruct)
+                    else getattr(gvk, "Kind", None))
+            if kind:
+                self.Object.setdefault("kind", kind)
 
         def GetObjectKind(self):
             return self
 
         def GroupVersionKind(self):
-            return getattr(self, "_gvk", None)
+            explicit = getattr(self, "_gvk", None)
+            if explicit is not None:
+                return explicit
+            # like apimachinery: derive the GVK from the object content
+            api_version = self.Object.get("apiVersion", "")
+            group, _, version = api_version.rpartition("/")
+            gvk = _SchemaModule.GroupVersionKind()
+            gvk.Group = group
+            gvk.Version = version
+            gvk.Kind = self.Object.get("kind", "")
+            return gvk
 
         def GetKind(self):
             return self.Object.get("kind", "")
@@ -390,6 +402,31 @@ def _go_format(fmt: str, args: list) -> str:
     return out and "".join(out) or ""
 
 
+def _wrap_args(fmt: str, args: list) -> list:
+    """The arguments consumed by %w verbs, in order."""
+    wrapped = []
+    ai = 0
+    i = 0
+    while i < len(fmt):
+        if fmt[i] != "%":
+            i += 1
+            continue
+        j = i + 1
+        while j < len(fmt) and fmt[j] in "0123456789.+-# ":
+            j += 1
+        if j >= len(fmt):
+            break
+        verb = fmt[j]
+        if verb == "%":
+            i = j + 1
+            continue
+        if verb == "w" and ai < len(args):
+            wrapped.append(args[ai])
+        ai += 1
+        i = j + 1
+    return wrapped
+
+
 class _FmtModule:
     @staticmethod
     def Sprintf(fmt, *args):
@@ -398,12 +435,13 @@ class _FmtModule:
     @staticmethod
     def Errorf(fmt, *args):
         err = GoError(_go_format(fmt, list(args)))
-        # %w wrapping: record the wrapped error for errors.Is/Unwrap and
-        # preserve its NotFound-ness
-        for a in args:
-            if isinstance(a, GoError):
-                err.wrapped = a
-                err.not_found = err.not_found or a.not_found
+        # only %w-verb arguments wrap (errors.Is walks them and their
+        # NotFound-ness propagates); %v/%s formatting does NOT wrap,
+        # exactly the missing-%w bug class conformance must preserve
+        for arg in _wrap_args(fmt, list(args)):
+            if isinstance(arg, GoError):
+                err.wrapped = arg
+                err.not_found = err.not_found or arg.not_found
         return err
 
 
@@ -534,6 +572,16 @@ class _ErrorsModule:
         return getattr(err, "wrapped", None)
 
 
+class _ContextModule:
+    @staticmethod
+    def Background():
+        return None
+
+    @staticmethod
+    def TODO():
+        return None
+
+
 class _TimeModule:
     Nanosecond = 1
     Microsecond = 1000
@@ -557,6 +605,137 @@ class _ClientModule:
     MatchingFields = MapTypeRef("MatchingFields")
     InNamespace = TypeRef("InNamespace")
     Object = TypeRef("Object")
+    # server-side-apply options: opaque markers the fake client receives
+    Apply = "client.Apply"
+    ForceOwnership = "client.ForceOwnership"
+    FieldOwner = TypeRef("FieldOwner")  # conversion: FieldOwner(name)
+    Client = TypeRef("Client")
+
+    @staticmethod
+    def IgnoreNotFound(err):
+        if isinstance(err, GoError) and err.not_found:
+            return None
+        return err
+
+
+class _FakeLogger:
+    """Chainable no-op logr.Logger: the emitted code only builds and
+    threads loggers; messages are recorded for assertions."""
+
+    def __init__(self):
+        self.infos: list = []
+        self.errors: list = []
+
+    def WithName(self, name):
+        return self
+
+    def WithValues(self, *kv):
+        return self
+
+    def V(self, level):
+        return self
+
+    def Info(self, msg, *kv):
+        self.infos.append(msg)
+
+    def Error(self, err, msg, *kv):
+        self.errors.append(msg)
+
+
+class _FakeBuilder:
+    """ctrl.NewControllerManagedBy(...) fluent chain; Build returns a
+    minimal controller whose Watch records what was watched."""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def WithEventFilter(self, predicates):
+        self.predicates = predicates
+        return self
+
+    def For(self, obj):
+        self.forObject = obj
+        return self
+
+    def Owns(self, obj):
+        return self
+
+    def Build(self, reconciler):
+        controller = _FakeController()
+        return (controller, None)
+
+    def Complete(self, reconciler):
+        return None
+
+
+class _FakeController:
+    def __init__(self):
+        self.watched: list = []
+
+    def Watch(self, src, handler, *predicates):
+        self.watched.append((src, handler))
+        return None
+
+
+class _HandlerModule:
+    EnqueueRequestForOwner = TypeRef("EnqueueRequestForOwner")
+
+    @staticmethod
+    def EnqueueRequestsFromMapFunc(fn):
+        return fn
+
+
+class _CtrlModule:
+    """sigs.k8s.io/controller-runtime surface the emitted code uses at
+    runtime: Result composites, the package logger, the controller
+    builder, and SetControllerReference.  Instantiate per natives dict
+    (Log state must not leak across runtimes)."""
+
+    Result = TypeRef("Result")
+    Request = TypeRef("Request")
+
+    def __init__(self):
+        self.Log = _FakeLogger()
+
+    @staticmethod
+    def NewControllerManagedBy(mgr):
+        return _FakeBuilder(mgr)
+
+    @staticmethod
+    def SetControllerReference(owner, resource, scheme):
+        kind = owner.tname if isinstance(owner, GoStruct) else (
+            type(owner).__name__)
+        name = ""
+        getter = getattr(owner, "GetName", None)
+        if callable(getter):
+            name = getter()
+        elif isinstance(owner, GoStruct):
+            name = owner.fields.get("Name", "")
+        api_version = ""
+        if isinstance(owner, GoStruct):
+            api_version = owner.fields.get("APIVersion", "") or ""
+        # controllerutil semantics: refuse a second controller, upsert
+        # our own reference, keep any non-controller references
+        refs = list(resource.GetOwnerReferences() or [])
+        for ref in refs:
+            if ref.get("controller") and not (
+                ref.get("kind") == kind and ref.get("name") == name
+            ):
+                return GoError(
+                    f"Object {resource.GetName()} is already owned by "
+                    f"another {ref.get('kind')} controller "
+                    f"{ref.get('name')}"
+                )
+        refs = [r for r in refs if not r.get("controller")]
+        refs.append({
+            "apiVersion": api_version,
+            "kind": kind,
+            "name": name,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        })
+        resource.SetOwnerReferences(refs)
+        return None
 
 
 def default_natives() -> dict:
@@ -572,8 +751,13 @@ def default_natives() -> dict:
         "k8s.io/apimachinery/pkg/types": _StructModule("NamespacedName"),
         "k8s.io/apimachinery/pkg/runtime/schema": _SchemaModule,
         "k8s.io/apimachinery/pkg/api/meta": _MetaModule,
-        "sigs.k8s.io/controller-runtime": _StructModule("Result"),
+        "sigs.k8s.io/controller-runtime": _CtrlModule(),
         "sigs.k8s.io/controller-runtime/pkg/client": _ClientModule,
+        "sigs.k8s.io/controller-runtime/pkg/handler": _HandlerModule,
+        "sigs.k8s.io/controller-runtime/pkg/reconcile":
+            _StructModule("Request"),
+        "context": _ContextModule,
+        "sigs.k8s.io/controller-runtime/pkg/source": _StructModule("Kind"),
         "sigs.k8s.io/controller-runtime/pkg/controller/controllerutil":
             _ControllerUtilModule,
         "sigs.k8s.io/controller-runtime/pkg/predicate":
@@ -595,7 +779,8 @@ class Interp:
     """Loads a package directory of generated Go and executes calls."""
 
     def __init__(self, natives: dict | None = None,
-                 methods: dict | None = None):
+                 methods: dict | None = None,
+                 embeds: dict | None = None):
         self.natives = natives if natives is not None else default_natives()
         self.funcs: dict[str, tuple] = {}     # name -> (fn, scan)
         # (tname, name) -> (fn, scan); pass a shared dict to link the
@@ -607,6 +792,12 @@ class Interp:
         )
         self.consts: dict[str, object] = {}
         self.types: set[str] = set()
+        # struct tname -> its embedded-field NAMES (the base ident of
+        # each embed spec): Go promotes methods only through these.
+        # Shared across linked interpreters like the method registry.
+        self.embeds: dict[str, list[str]] = (
+            embeds if embeds is not None else {}
+        )
         self.scans: list = []
         self._pending_values: list = []
 
@@ -615,6 +806,10 @@ class Interp:
     def load_source(self, text: str, path: str = "<go>",
                     defer_values: bool = False) -> None:
         scan = _FileScan(path, text)
+        # backref for cross-package dispatch: a method reached through
+        # the shared registry must execute under ITS package's funcs,
+        # consts and imports, not the caller's
+        scan.interp = self
         for fn in scan.funcs:
             if fn["body"] is None:
                 continue
@@ -626,6 +821,13 @@ class Interp:
                     self.methods[(base, fn["name"])] = (fn, scan)
         for td in scan.typedecls:
             self.types.add(td["name"])
+            if td.get("kind") == "struct" and td.get("embeds"):
+                names = []
+                for span in td["embeds"]:
+                    idents = [t.value for t in span if t.kind == IDENT]
+                    if idents:
+                        names.append(idents[-1])
+                self.embeds[td["name"]] = names
         self.scans.append(scan)
         # package-level consts/vars with initializers
         for name, type_span, init_span in scan.value_inits:
@@ -1494,6 +1696,11 @@ class _Eval:
                         value = Closure(fn, scan, Env(), recv_value=value)
                         pos += 2
                         continue
+                    promoted = self._promoted(value, nxt.value)
+                    if promoted is not None:
+                        value = promoted
+                        pos += 2
+                        continue
                 value = _get_attr(value, nxt.value)
                 pos += 2
                 continue
@@ -1518,6 +1725,32 @@ class _Eval:
                 break
             break
         return value, pos
+
+    def _promoted(self, struct: GoStruct, name: str):
+        """Go field promotion through EMBEDDED fields only (like the
+        compiler): the emitted reconciler embeds client.Client (a
+        native fake at runtime), so ``r.Get``/``r.Patch`` dispatch to
+        the embed's value — an embedded GoStruct's registered method,
+        or a callable attribute of an embedded native object.  Named
+        fields never promote; the declaring struct's typedecl says
+        which fields are embeds."""
+        embed_names = self.interp.embeds.get(struct.tname)
+        if not embed_names:
+            return None
+        for fname in embed_names:
+            v = struct.fields.get(fname)
+            if isinstance(v, GoStruct):
+                entry = self.interp.methods.get((v.tname, name))
+                if entry is not None:
+                    fn, scan = entry
+                    return Closure(fn, scan, Env(), recv_value=v)
+            elif v is not None and not isinstance(
+                v, (str, bytes, bool, int, float, list, dict, tuple)
+            ):
+                attr = getattr(v, name, None)
+                if callable(attr):
+                    return attr
+        return None
 
     def _build_composite(self, typeval, toks, lo, hi):
         """Build a composite-literal value for a RESOLVED type: a named
@@ -1803,15 +2036,16 @@ class _Eval:
     def _call_value(self, callee, args):
         if isinstance(callee, Closure):
             fn = callee.fn
+            owner = getattr(callee.scan, "interp", None) or self.interp
             toks = getattr(callee, "toks", None)
             if toks is None:
-                return self.interp._invoke(
+                return owner._invoke(
                     fn, callee.scan, callee.recv_value, args
                 )
             # literal closure: execute its body in the captured env
             env = Env(callee.env)
             _bind_params(env, fn["params"], args)
-            ev = _Eval(self.interp, callee.scan, env)
+            ev = _Eval(owner, callee.scan, env)
             lo, hi = fn["body"]
             try:
                 ev.exec_block(toks, lo, hi, env)

@@ -1,0 +1,157 @@
+"""Fake cluster-side collaborators for controller-level conformance.
+
+These play the role the reference's envtest apiserver plays in its CI
+(reference .github/workflows/test.yaml:106-141): a stateful client the
+emitted reconciler reads and writes through, plus the manager surface
+``New<Kind>Reconciler``/``SetupWithManager`` touch.  The store keeps
+workloads as live typed objects (aliased on Get, like apiserver state)
+and children as plain dicts; Patch models server-side apply — the
+status subresource survives a re-apply, matching a real apiserver where
+spec-apply and status-writes use different paths.
+"""
+
+import copy
+
+from operator_forge.gocheck.interp import (
+    GoError,
+    GoStruct,
+    _UnstructuredModule,
+)
+
+
+class FakeStatusWriter:
+    def __init__(self, fail=None):
+        self.fail = fail
+        self.updates = 0
+
+    def Update(self, ctx, workload):
+        self.updates += 1
+        return self.fail
+
+
+class FakeClusterClient:
+    """client.Client over an in-memory store, keyed (kind, ns, name)."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.workloads: dict = {}   # key -> GoObject (live, aliased)
+        self.children: dict = {}    # key -> dict (unstructured content)
+        self.applied: list = []
+        self.deleted: list = []
+        self.status = FakeStatusWriter()
+
+    # -- store helpers (test-side) ----------------------------------------
+
+    def add_workload(self, cr: dict):
+        obj = self.runtime.decode_cr(cr)
+        key = (obj.tname, obj.GetNamespace(), obj.GetName())
+        self.workloads[key] = obj
+        return obj
+
+    def remove_workloads(self, kind: str) -> None:
+        self.workloads = {
+            key: obj for key, obj in self.workloads.items()
+            if key[0] != kind
+        }
+
+    def child(self, kind: str, namespace: str, name: str):
+        return self.children.get((kind, namespace, name))
+
+    # -- client.Client surface the emitted code calls ----------------------
+
+    def Get(self, ctx, nn, target):
+        namespace = nn.fields.get("Namespace") or ""
+        name = nn.fields.get("Name") or ""
+        if isinstance(target, GoStruct):
+            stored = self.workloads.get((target.tname, namespace, name))
+            if stored is None:
+                return GoError(f"{target.tname} not found", not_found=True)
+            # alias, like apiserver state: mutations through the request
+            # are visible to later passes
+            target.fields = stored.fields
+            return None
+        gvk = target.GroupVersionKind()
+        data = self.children.get((gvk.Kind, namespace, name))
+        if data is None:
+            return GoError("child not found", not_found=True)
+        target.Object = data
+        return None
+
+    def List(self, ctx, target, *opts):
+        wanted_labels: dict = {}
+        for opt in opts:
+            if isinstance(opt, dict):  # client.MatchingLabels
+                wanted_labels.update(opt)
+        if isinstance(target, GoStruct):
+            kind = target.tname
+            if kind.endswith("List"):
+                kind = kind[:-4]
+            target.fields["Items"] = [
+                obj for (k, _, _), obj in self.workloads.items() if k == kind
+            ]
+            return None
+        gvk = target.GroupVersionKind()
+        kind = gvk.Kind[:-4] if gvk.Kind.endswith("List") else gvk.Kind
+        items = []
+        for (k, _, _), data in self.children.items():
+            if k != kind:
+                continue
+            labels = data.get("metadata", {}).get("labels") or {}
+            if wanted_labels and not all(
+                labels.get(lk) == lv for lk, lv in wanted_labels.items()
+            ):
+                continue
+            live = _UnstructuredModule.Unstructured()
+            live.Object = data
+            items.append(live)
+        target.Items = items
+        return None
+
+    def Patch(self, ctx, resource, *opts):
+        key = (resource.Object.get("kind"), resource.GetNamespace(),
+               resource.GetName())
+        merged = copy.deepcopy(resource.Object)
+        prior = self.children.get(key)
+        if prior and "status" in prior:
+            merged["status"] = prior["status"]
+        self.children[key] = merged
+        self.applied.append(key)
+        return None
+
+    def Update(self, ctx, obj):
+        return None  # workloads are aliased; nothing to write back
+
+    def Delete(self, ctx, obj):
+        if hasattr(obj, "Object"):
+            key = (obj.Object.get("kind"), obj.GetNamespace(), obj.GetName())
+            self.children.pop(key, None)
+            self.deleted.append(key)
+        return None
+
+    def Status(self):
+        return self.status
+
+
+class FakeEventRecorder:
+    def __init__(self):
+        self.events: list = []
+
+    def Event(self, obj, etype, reason, message):
+        self.events.append((etype, reason, message))
+
+
+class FakeManager:
+    """The ctrl.Manager surface New<Kind>Reconciler consumes."""
+
+    def __init__(self, client: FakeClusterClient):
+        self.client = client
+        self.recorder = FakeEventRecorder()
+
+    def GetClient(self):
+        return self.client
+
+    def GetEventRecorderFor(self, name):
+        return self.recorder
+
+    def GetScheme(self):
+        return "scheme"
