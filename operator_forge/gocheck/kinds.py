@@ -1,0 +1,146 @@
+"""Literal-kind checking for call arguments on closed surfaces.
+
+Arity alone let ``os.Exit("one")`` pass vet; the reference's bar is the
+Go compiler (reference CI .github/workflows/test.yaml:55-105), where a
+string literal for an int parameter is a compile error.  This module
+adds the literal half of that check: classify syntactically-obvious
+argument literals (string/int/bool/func) and compare them against
+recorded parameter kinds.  Deliberately conservative — only literals
+whose kind is certain from the tokens are classified, and only
+kind pairs Go can never convert implicitly are conflicts — because a
+false error on valid code is not recoverable (the reference corpus must
+stay at zero findings).
+
+Parameter-kind vocabulary:
+- ``'string'``/``'int'``/``'bool'``/``'func'``: the parameter takes
+  that kind; a literal of a conflicting kind can never compile.
+- ``'duration'``: time.Duration — int literals are valid (untyped
+  constants convert), string/bool/func literals are not.
+- ``'bytes'``: []byte — no literal kind is assignable without a
+  conversion (``[]byte("x")``), so string/int/bool/func all conflict.
+- ``'error'``: no literal is ever an error.
+- ``None``: unchecked.
+"""
+
+from __future__ import annotations
+
+from .tokens import IDENT, INT, KEYWORD, OP, STRING, Token
+
+# expected kind -> literal kinds that can NEVER satisfy it
+_CONFLICTS: dict[str, frozenset] = {
+    "string": frozenset({"int", "bool", "func"}),
+    "int": frozenset({"string", "bool", "func"}),
+    "bool": frozenset({"string", "int", "func"}),
+    "func": frozenset({"string", "int", "bool"}),
+    "duration": frozenset({"string", "bool", "func"}),
+    "bytes": frozenset({"string", "int", "bool", "func"}),
+    "error": frozenset({"string", "int", "bool", "func"}),
+}
+
+
+def literal_kind(toks: list[Token], lo: int, hi: int) -> str | None:
+    """The certain literal kind of the argument span toks[lo:hi], or
+    None when the argument is not a bare literal (identifiers,
+    expressions, conversions are all None — unknown, never flagged)."""
+    span = toks[lo:hi]
+    if not span:
+        return None
+    if len(span) == 1:
+        t = span[0]
+        if t.kind == STRING:
+            return "string"
+        if t.kind == INT:
+            return "int"
+        if t.kind == IDENT and t.value in ("true", "false"):
+            return "bool"
+        return None
+    if (
+        len(span) == 2
+        and span[0].kind == OP
+        and span[0].value in ("-", "+")
+        and span[1].kind == INT
+    ):
+        return "int"
+    if span[0].kind == KEYWORD and span[0].value == "func":
+        return "func"
+    return None
+
+
+def kind_conflicts(expected: str | None, got: str | None) -> bool:
+    if expected is None or got is None:
+        return False
+    return got in _CONFLICTS.get(expected, frozenset())
+
+
+def arg_spans(toks: list[Token], open_paren: int) -> list[tuple[int, int]]:
+    """Top-level comma-separated argument spans of the paren group
+    opening at toks[open_paren]; trailing commas dropped."""
+    depth = 0
+    spans: list[tuple[int, int]] = []
+    start = open_paren + 1
+    j = open_paren
+    n = len(toks)
+    while j < n:
+        t = toks[j]
+        if t.kind == OP:
+            if t.value in "([{":
+                depth += 1
+            elif t.value in ")]}":
+                depth -= 1
+                if depth == 0:
+                    if j > start:
+                        spans.append((start, j))
+                    return spans
+            elif t.value == "," and depth == 1:
+                spans.append((start, j))
+                start = j + 1
+        j += 1
+    return spans
+
+
+def check_call_kinds(
+    toks: list[Token],
+    open_paren: int,
+    kinds: tuple,
+    label: str,
+    where,
+) -> list[str]:
+    """Compare the call's literal arguments against recorded parameter
+    kinds; ``where(tok)`` renders a location for the message."""
+    problems: list[str] = []
+    for index, (lo, hi) in enumerate(arg_spans(toks, open_paren)):
+        if index >= len(kinds):
+            break
+        got = literal_kind(toks, lo, hi)
+        expected = kinds[index]
+        if kind_conflicts(expected, got):
+            problems.append(
+                f"{where(toks[lo])}: {label} argument {index + 1} wants "
+                f"{expected}, got {got} literal"
+            )
+    return problems
+
+
+def param_kind_of(type_text: str) -> str | None:
+    """Kind for a parameter TYPE's normalized text (project-indexed
+    funcs derive their kinds from their own signatures)."""
+    t = type_text.lstrip("*")
+    if t == "string":
+        return "string"
+    if t == "bool":
+        return "bool"
+    if t in ("error",):
+        return "error"
+    if t in ("[]byte",):
+        return "bytes"
+    if t in ("time.Duration",):
+        return "duration"
+    if t == "func" or t.startswith("func("):
+        return "func"
+    # EXACT names only: a project-defined type named `interval` or
+    # `funcOption` must never be classified (its underlying type is
+    # unknown, and untyped constants convert to named basics anyway)
+    if t in ("byte", "rune", "int", "int8", "int16", "int32", "int64",
+             "uint", "uint8", "uint16", "uint32", "uint64", "uintptr"):
+        return "int"
+    return None

@@ -69,6 +69,9 @@ class Package:
     name: str
     import_path: str | None
     funcs: dict = field(default_factory=dict)  # name -> (min, max)
+    # name -> leading-parameter kind tuple (see kinds.py), derived from
+    # the func's own signature; powers literal-kind call checking
+    func_kinds: dict = field(default_factory=dict)
     types: dict = field(default_factory=dict)  # name -> TypeInfo
     values: dict = field(default_factory=dict)  # name -> type-ref or None
     # False when a file in this dir failed to scan: the surface is then
@@ -617,6 +620,7 @@ class ProjectIndex:
         for fn in scan.funcs:
             if fn["recv"] is None:
                 pkg.funcs[fn["name"]] = fn["arity"]
+                pkg.func_kinds[fn["name"]] = _signature_kinds(fn["params"])
         for td in scan.typedecls:
             if td["kind"] == "struct":
                 info = TypeInfo(kind="struct", generic=td["generic"])
@@ -799,6 +803,13 @@ class ProjectIndex:
                 "funcs": funcs,
                 "types": types,
                 "values": values,
+                # signature-derived kinds, so cross-package project
+                # calls get the same literal-kind check as same-package
+                "param_kinds": {
+                    n: pkg.func_kinds[n]
+                    for n in funcs
+                    if any(pkg.func_kinds.get(n) or ())
+                },
             }
         return out
 
@@ -886,6 +897,41 @@ def _body_env(idx: ProjectIndex, scan: _FileScan, fn: dict) -> dict:
                         env[span[0].value] = _UNRESOLVED
         j += 1
     return env
+
+
+def _signature_kinds(params) -> tuple:
+    """Per-parameter kind tuple from a func's own signature (see
+    kinds.py).  Shared-type parameter groups (``a, b string``) resolve
+    right-to-left: an item that is just a name takes the next item's
+    type.  Variadics and unclassifiable types map to None (unchecked)."""
+    from .kinds import param_kind_of
+
+    has_named = any(name for name, _span in params)
+    resolved: list = []
+    next_type = None
+    for name, span in reversed(params):
+        if name:
+            next_type = span
+            resolved.append(span)
+        elif (
+            has_named
+            and len(span) == 1
+            and span[0].kind == IDENT
+            and next_type is not None
+        ):
+            resolved.append(next_type)  # a name sharing a later type
+        else:
+            next_type = span
+            resolved.append(span)
+    resolved.reverse()
+    kinds = []
+    for span in resolved:
+        text = "".join(t.value for t in span)
+        if text.startswith("..."):
+            kinds.append(None)
+        else:
+            kinds.append(param_kind_of(text))
+    return tuple(kinds)
 
 
 def _count_args(toks: list[Token], lo: int, hi: int) -> tuple[int, bool]:
@@ -980,13 +1026,15 @@ def _check_body(idx, scan, own, fn, env) -> list[str]:
         glo, ghi = scan._group_span(k + 1)
         nargs, spread = _count_args(toks, glo, ghi)
         errors.extend(
-            _check_call(idx, scan, own, env, parts, nargs, spread)
+            _check_call(idx, scan, own, env, parts, nargs, spread,
+                        open_paren=k + 1)
         )
         j = k + 1  # the args group is scanned for its own chains
     return errors
 
 
-def _check_call(idx, scan, own, env, parts, nargs, spread) -> list[str]:
+def _check_call(idx, scan, own, env, parts, nargs, spread,
+                open_paren=None) -> list[str]:
     toks = scan.toks
     head = toks[parts[0]]
 
@@ -1020,7 +1068,15 @@ def _check_call(idx, scan, own, env, parts, nargs, spread) -> list[str]:
         ):
             return []
         if name in own.funcs:
-            return arity_errors(name, head, own.funcs[name])
+            errors = arity_errors(name, head, own.funcs[name])
+            kinds = own.func_kinds.get(name)
+            if kinds and open_paren is not None and nargs > 0:
+                from .kinds import check_call_kinds
+
+                errors.extend(check_call_kinds(
+                    toks, open_paren, kinds, name, where,
+                ))
+            return errors
         return []
 
     # chain: resolve the head

@@ -362,6 +362,14 @@ MANIFEST: dict[str, dict] = {
             "UnexpectedObjectError": None,
         },
         "values": set(),
+        "param_kinds": {
+            "IsNotFound": ("error",), "IsAlreadyExists": ("error",),
+            "IsConflict": ("error",), "IsInvalid": ("error",),
+            "IsForbidden": ("error",), "IsUnauthorized": ("error",),
+            "IsBadRequest": ("error",), "IsGone": ("error",),
+            "IsTimeout": ("error",), "IsInternalError": ("error",),
+            "ReasonForError": ("error",),
+        },
     },
     "k8s.io/apimachinery/pkg/api/meta": {
         "closed": False,

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import re
 
+from .kinds import check_call_kinds
 from .manifest import MANIFEST
 from .parser import _Parser
 from .structural import parse_imports, strip_strings_and_comments
@@ -193,6 +194,17 @@ def types_of(
                     f"{where(name_i)}: {alias}.{name} expects at most "
                     f"{hi} argument(s), got {nargs}"
                 )
+            kinds = pkg.get("param_kinds", {}).get(name)
+            if kinds and nargs > 0:
+                open_paren = name_i + 1
+                if (
+                    open_paren < len(toks)
+                    and toks[open_paren].value == "("
+                ):
+                    problems.extend(check_call_kinds(
+                        toks, open_paren, kinds, f"{alias}.{name}",
+                        lambda tok: f"{filename}:{tok.line}:{tok.col}",
+                    ))
         elif name in pkg["types"]:
             if nargs >= 0 and nargs != 1:
                 problems.append(
