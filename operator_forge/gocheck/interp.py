@@ -356,6 +356,28 @@ class _UnstructuredModule:
             return [], False, GoError(f"{'.'.join(path)}: not a slice")
         return value, True, None
 
+    @staticmethod
+    def NestedBool(obj, *path):
+        value, found, _ = _nested(obj, *path)
+        if not found:
+            return False, False, None
+        if not isinstance(value, bool):
+            return False, False, GoError(f"{'.'.join(path)}: not a bool")
+        return value, True, None
+
+    @staticmethod
+    def NestedMap(obj, *path):
+        import copy
+
+        value, found, _ = _nested(obj, *path)
+        if not found:
+            return None, False, None
+        if not isinstance(value, dict):
+            return None, False, GoError(f"{'.'.join(path)}: not a map")
+        # apimachinery's NestedMap deep-copies; mutations must not
+        # write through to the source object
+        return copy.deepcopy(value), True, None
+
 
 def _go_format(fmt: str, args: list) -> str:
     out = []
@@ -685,11 +707,40 @@ class _HandlerModule:
         return fn
 
 
+class _FakeWebhookBuilder:
+    """ctrl.NewWebhookManagedBy(...) fluent chain."""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def For(self, obj):
+        self.forObject = obj
+        return self
+
+    def Complete(self):
+        register = getattr(self.mgr, "RegisterWebhookFor", None)
+        if callable(register):
+            register(self.forObject)
+        return None
+
+
+class _LogfModule:
+    """sigs.k8s.io/controller-runtime/pkg/log: the package logger the
+    emitted webhook stubs build their named loggers from."""
+
+    def __init__(self):
+        self.Log = _FakeLogger()
+
+    @staticmethod
+    def FromContext(ctx):
+        return _FakeLogger()
+
+
 class _CtrlModule:
     """sigs.k8s.io/controller-runtime surface the emitted code uses at
-    runtime: Result composites, the package logger, the controller
-    builder, and SetControllerReference.  Instantiate per natives dict
-    (Log state must not leak across runtimes)."""
+    runtime: Result composites, the package logger, the controller and
+    webhook builders, and SetControllerReference.  Instantiate per
+    natives dict (Log state must not leak across runtimes)."""
 
     Result = TypeRef("Result")
     Request = TypeRef("Request")
@@ -700,6 +751,10 @@ class _CtrlModule:
     @staticmethod
     def NewControllerManagedBy(mgr):
         return _FakeBuilder(mgr)
+
+    @staticmethod
+    def NewWebhookManagedBy(mgr):
+        return _FakeWebhookBuilder(mgr)
 
     @staticmethod
     def SetControllerReference(owner, resource, scheme):
@@ -756,6 +811,9 @@ def default_natives() -> dict:
         "sigs.k8s.io/controller-runtime/pkg/handler": _HandlerModule,
         "sigs.k8s.io/controller-runtime/pkg/reconcile":
             _StructModule("Request"),
+        "sigs.k8s.io/controller-runtime/pkg/log": _LogfModule(),
+        "sigs.k8s.io/controller-runtime/pkg/webhook":
+            _StructModule("Defaulter", "Validator", "AdmissionRequest"),
         "context": _ContextModule,
         "sigs.k8s.io/controller-runtime/pkg/source": _StructModule("Kind"),
         "sigs.k8s.io/controller-runtime/pkg/controller/controllerutil":

@@ -1,0 +1,164 @@
+"""Mutant generation for emitted-Go mutation testing.
+
+Round-4 proved seven hand-seeded template mutations are caught by the
+conformance suites; this module turns that from an anecdote into a
+measured property: enumerate the behavior-bearing tokens of an emitted
+file (function bodies only — comments, imports, type decls and struct
+tags never produce mutants) and apply classic mutation operators:
+
+- comparison flips      (``==`` <-> ``!=``, ``<`` -> ``>=``, ...)
+- boolean-operator swap (``&&`` <-> ``||``) and negation drop (``!``)
+- boolean literal flip  (``true`` <-> ``false``)
+- arithmetic flip       (``+`` <-> ``-``)
+- integer perturbation  (``0`` -> ``1``, n -> n+1)
+- branch-statement drop (``continue``/``break`` removed)
+- adjacent-argument swap (``f(a, b)`` -> ``f(b, a)`` for single-token
+  arguments)
+
+Each mutant is a full replacement file text, spliced from token
+positions, so the runner can drop it into a copy of the package and
+execute the kill oracle.  The reference's equivalent property comes
+free from compiling + running the generated project's tests in CI
+(reference .github/workflows/test.yaml:55-141); here the interpreter
+conformance fingerprints are the oracle (tests/mutation_oracle.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .localindex import _FileScan
+from .tokens import IDENT, INT, KEYWORD, OP, STRING, Token
+
+_CMP_FLIPS = {
+    "==": "!=", "!=": "==",
+    "<": ">=", ">": "<=", "<=": ">", ">=": "<",
+}
+_BOOL_FLIPS = {"&&": "||", "||": "&&"}
+_ARITH_FLIPS = {"+": "-", "-": "+"}
+
+
+@dataclass
+class Mutant:
+    path: str
+    line: int
+    col: int
+    op: str          # operator label, e.g. "cmp-flip"
+    detail: str      # human-readable, e.g. "`==` -> `!=`"
+    text: str        # full mutated file content
+
+
+def _line_starts(text: str) -> list[int]:
+    starts = [0]
+    for i, ch in enumerate(text):
+        if ch == "\n":
+            starts.append(i + 1)
+    return starts
+
+
+def _offset(starts: list[int], tok: Token) -> int:
+    return starts[tok.line - 1] + (tok.col - 1)
+
+
+def _splice(text: str, start: int, end: int, repl: str) -> str:
+    return text[:start] + repl + text[end:]
+
+
+def _body_ranges(scan: _FileScan) -> list[tuple[int, int]]:
+    return [fn["body"] for fn in scan.funcs if fn["body"] is not None]
+
+
+def _in_bodies(ranges: list[tuple[int, int]], index: int) -> bool:
+    return any(lo <= index < hi for lo, hi in ranges)
+
+
+def mutants_of(text: str, path: str = "<go>") -> list[Mutant]:
+    """Every single-point mutant of one file's function bodies."""
+    scan = _FileScan(path, text)
+    toks = scan.toks
+    starts = _line_starts(text)
+    ranges = _body_ranges(scan)
+    out: list[Mutant] = []
+
+    def add(tok: Token, op: str, detail: str, start: int, end: int,
+            repl: str) -> None:
+        out.append(Mutant(
+            path=path, line=tok.line, col=tok.col, op=op, detail=detail,
+            text=_splice(text, start, end, repl),
+        ))
+
+    for i, tok in enumerate(toks):
+        if not _in_bodies(ranges, i):
+            continue
+        start = _offset(starts, tok)
+        end = start + len(tok.value)
+        if tok.kind == OP and tok.value in _CMP_FLIPS:
+            repl = _CMP_FLIPS[tok.value]
+            add(tok, "cmp-flip", f"`{tok.value}` -> `{repl}`",
+                start, end, repl)
+        elif tok.kind == OP and tok.value in _BOOL_FLIPS:
+            repl = _BOOL_FLIPS[tok.value]
+            add(tok, "bool-op-swap", f"`{tok.value}` -> `{repl}`",
+                start, end, repl)
+        elif tok.kind == OP and tok.value in _ARITH_FLIPS:
+            # unary +/- and pointer-ish contexts excluded: require the
+            # previous token to end an operand; string concatenation
+            # excluded too — `s - "x"` does not compile, so its mutant
+            # would be a zero-information kill inflating the rate
+            prev = toks[i - 1] if i > 0 else None
+            nxt = toks[i + 1] if i + 1 < len(toks) else None
+            adjacent_string = (
+                (prev is not None and prev.kind == STRING)
+                or (nxt is not None and nxt.kind == STRING)
+            )
+            if not adjacent_string and prev is not None and (
+                prev.kind in (IDENT, INT)
+                or (prev.kind == OP and prev.value in (")", "]", "}"))
+            ):
+                repl = _ARITH_FLIPS[tok.value]
+                add(tok, "arith-flip", f"`{tok.value}` -> `{repl}`",
+                    start, end, repl)
+        elif tok.kind == OP and tok.value == "!":
+            # `!=` lexes as one token, so a bare `!` is always negation
+            add(tok, "negation-drop", "`!` removed", start, end, "")
+        elif tok.kind == IDENT and tok.value in ("true", "false"):
+            repl = "false" if tok.value == "true" else "true"
+            add(tok, "bool-literal-flip", f"`{tok.value}` -> `{repl}`",
+                start, end, repl)
+        elif tok.kind == INT:
+            try:
+                value = int(tok.value, 0)
+            except ValueError:
+                continue
+            repl = str(value + 1)
+            add(tok, "int-perturb", f"`{tok.value}` -> `{repl}`",
+                start, end, repl)
+        elif tok.kind == KEYWORD and tok.value in ("continue", "break"):
+            add(tok, "branch-drop", f"`{tok.value}` removed",
+                start, end, "")
+        elif (
+            tok.kind == OP and tok.value == "("
+            and i >= 1 and toks[i - 1].kind == IDENT
+            and i + 4 < len(toks)
+            and toks[i + 1].kind in (IDENT, INT)
+            and toks[i + 2].kind == OP and toks[i + 2].value == ","
+            and toks[i + 3].kind in (IDENT, INT)
+            and toks[i + 4].kind == OP and toks[i + 4].value == ")"
+            and toks[i + 1].value != toks[i + 3].value
+        ):
+            a, b = toks[i + 1], toks[i + 3]
+            a_start = _offset(starts, a)
+            a_end = a_start + len(a.value)
+            b_start = _offset(starts, b)
+            b_end = b_start + len(b.value)
+            swapped = (
+                text[:a_start] + b.value + text[a_end:b_start]
+                + a.value + text[b_end:]
+            )
+            out.append(Mutant(
+                path=path, line=a.line, col=a.col, op="arg-swap",
+                detail=f"`{a.value}, {b.value}` -> "
+                       f"`{b.value}, {a.value}`",
+                text=swapped,
+            ))
+    return out

@@ -1,0 +1,853 @@
+"""Kill oracles for emitted-Go mutation testing.
+
+A mutant is KILLED when a behavior fingerprint of the emitted project
+differs from the unmutated baseline, or execution raises.  Two
+fingerprints cover the mutated surfaces:
+
+- :func:`orchestrate_fingerprint` — the pkg/orchestrate scenarios the
+  conformance suite asserts (readiness table, phase machine, finalizer
+  identity, teardown sweeps, predicates), condensed into one
+  comparable structure;
+- :func:`project_fingerprint` — controller-level reconcile passes
+  through the full emitted pipeline (create/ready/delete/fan-out),
+  capturing applied children (content included), conditions, events,
+  finalizers and results, which covers the handlers, the resources
+  package and the controller file.
+
+Shared by tests/test_mutation_harness.py (asserts the kill rate) and
+scripts/mutation_report.py (writes MUTATION.md).
+"""
+
+from __future__ import annotations
+
+import os
+
+from operator_forge.gocheck.gopkg import ProjectRuntime
+from operator_forge.gocheck.interp import GoError, GoStruct, Interp
+from operator_forge.gocheck.mutate import Mutant, mutants_of
+
+import gofakes
+import test_go_conformance as conformance
+
+
+# the single source of truth for triaged-equivalent survivors, keyed
+# (file basename, operator, detail) so a NEW survivor of the same
+# operator class — e.g. an int-perturb on a different literal — is
+# still reported untriaged.  The test asserts keys; the report prints
+# the prose.
+EQUIVALENT_SURVIVORS = {
+    ("handlers.go", "bool-literal-flip", "`false` -> `true`"):
+        "equivalent: a `return false, err` proceed value is unreachable "
+        "— HandleExecution and the sweep callers branch on err first",
+    ("handlers.go", "int-perturb", "`0` -> `1`"):
+        "equivalent: a `return 0, err` swept count is unreachable — the "
+        "caller branches on err first",
+    ("ready.go", "branch-drop", "`continue` removed"):
+        "equivalent in Go too: without the `continue`, the failed "
+        "type-assertion leaves a nil map whose \"type\" read yields a "
+        "zero value that never equals a non-empty condition type",
+    ("bookstore_controller.go", "arg-swap", "`r, req` -> `req, r`"):
+        "equivalent for the scaffolded hook: the user-owned "
+        "CheckReady(r, req) pass-through ignores both arguments",
+}
+
+
+def survivor_key(mutant) -> tuple:
+    return (os.path.basename(mutant.path), mutant.op, mutant.detail)
+
+
+def scaffold_standalone(root: str) -> str:
+    """init + create api the standalone fixture into root/proj; the one
+    scaffold recipe shared by the harness test and the report script."""
+    import shutil
+    import subprocess
+    import sys
+
+    fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+    proj = os.path.join(root, "proj")
+    os.makedirs(proj, exist_ok=True)
+    for name in os.listdir(os.path.join(fixtures, "standalone")):
+        shutil.copy(os.path.join(fixtures, "standalone", name), proj)
+    config = os.path.join(proj, "workload.yaml")
+    base = [sys.executable, "-m", "operator_forge"]
+    for sub in (["init", "--repo", "github.com/acme/bookstore"],
+                ["create", "api"]):
+        subprocess.run(
+            base + sub + ["--workload-config", config,
+                          "--output-dir", proj],
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+    return proj
+
+
+def _freeze(value, depth=0):
+    """Deterministic, comparable rendering of scenario output.  Object
+    identity must never leak in (a repr with an address would kill
+    every mutant and make the harness vacuous) — arbitrary objects
+    freeze as their type name plus frozen instance attributes."""
+    if depth > 24:  # child-manifest dicts nest ~10 deep; cycles do not
+        return type(value).__name__
+    if isinstance(value, GoStruct):
+        return (value.tname, _freeze(dict(value.fields), depth + 1))
+    if isinstance(value, GoError):
+        return ("error", value.msg, value.not_found)
+    if isinstance(value, dict):
+        return tuple(sorted(
+            (str(k), _freeze(v, depth + 1)) for k, v in value.items()
+        ))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v, depth + 1) for v in value)
+    if isinstance(value, (str, bytes, bool, int, float, type(None))):
+        return value
+    if callable(value) and not hasattr(value, "__dict__"):
+        return "<callable>"
+    attrs = {
+        k: v for k, v in vars(value).items() if not k.startswith("_")
+    } if hasattr(value, "__dict__") else {}
+    return (type(value).__name__, _freeze(attrs, depth + 1))
+
+
+def _scenarios(run) -> list:
+    """Run scenario callables, recording results or exception types."""
+    fingerprint = []
+    for label, fn in run:
+        try:
+            fingerprint.append((label, _freeze(fn())))
+        except Exception as exc:  # any breakage kills the mutant
+            fingerprint.append((label, f"!{type(exc).__name__}"))
+    return fingerprint
+
+
+def _nil_predicate(interp, which, old_nil):
+    funcs = interp.call(which)
+    obj = conformance.PredicateObject()
+    event = GoStruct("UpdateEvent", {
+        "ObjectOld": None if old_nil else obj,
+        "ObjectNew": obj if old_nil else None,
+    })
+    return interp.call_value(funcs.fields["UpdateFunc"], event)
+
+
+def orchestrate_fingerprint(pkg_dir: str) -> list:
+    interp = Interp()
+    interp.load_dir(pkg_dir)
+
+    def registry():
+        reg = GoStruct("Registry", {"phases": []})
+        interp.call("RegisterDefaultPhases", reg)
+        return reg
+
+    def phase_order():
+        return [p.fields["Name"] for p in registry().fields["phases"]]
+
+    def pass_run(deleting: bool, created: bool, fail_phase=None,
+                 pending_phase=None):
+        reg = registry()
+        order = conformance._stub_phases(reg)
+        if fail_phase is not None:
+            target = reg.fields["phases"][fail_phase]
+            target.fields["Do"] = (
+                lambda r, req: (None, GoError("boom"))
+            )
+        if pending_phase is not None:
+            target = reg.fields["phases"][pending_phase]
+
+            def pend(r, req):
+                order.append(target.fields["Name"])
+                return (False, None)
+            target.fields["Do"] = pend
+        workload = conformance.FakeWorkload(
+            deleting=deleting, created=created
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        result, err = interp.call_method(
+            reg, "HandleExecution", conformance.FakeReconciler(), req
+        )
+        return (order, workload.conditions,
+                result.fields if isinstance(result, GoStruct) else result,
+                err.msg if isinstance(err, GoError) else err)
+
+    def teardown(children, ns="default"):
+        workload = conformance.TeardownWorkload(ns=ns)
+        annotations, labels = conformance._owned_markers(interp, workload)
+        live = [
+            conformance.FakeChild(
+                "Deployment", child_ns, name,
+                annotations=annotations if owned else None,
+                labels=labels if owned and labeled else {},
+            )
+            for child_ns, name, owned, labeled in children
+        ]
+        rec = conformance.TeardownReconciler(
+            [conformance.FakeGVK("apps", "v1", "Deployment")], live
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        first = interp.call("TeardownChildrenHandler", rec, req)
+        second = interp.call("TeardownChildrenHandler", rec, req)
+        return (first, second,
+                [c.name for c in rec.deleted], rec.list_calls)
+
+    def predicates(which, old_kw, new_kw):
+        funcs = interp.call(which)
+        event = GoStruct("UpdateEvent", {
+            "ObjectOld": conformance.PredicateObject(**old_kw),
+            "ObjectNew": conformance.PredicateObject(**new_kw),
+        })
+        return interp.call_value(funcs.fields["UpdateFunc"], event)
+
+    def finalizer_lifecycle():
+        workload = conformance.TeardownWorkload()
+        rec = conformance.TeardownReconciler([], [])
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        a = interp.call("RegisterFinalizerHandler", rec, req)
+        snapshot = list(workload.finalizers)
+        b = interp.call("RegisterFinalizerHandler", rec, req)
+        again = list(workload.finalizers)
+        c = interp.call("DeletionCompleteHandler", rec, req)
+        return (a, snapshot, b, again, c, workload.finalizers)
+
+    def mark_and_check():
+        resource = conformance._UnstructuredModule.Unstructured()
+        workload = conformance._OwnerWorkload()
+        interp.call("MarkOwned", workload, resource)
+        other = conformance._OwnerWorkload(name="other")
+        return (resource.GetAnnotations(), resource.GetLabels(),
+                interp.call("OwnedBy", workload, resource),
+                interp.call("OwnedBy", other, resource))
+
+    def status_fail_pass(deleting, not_found, plain=False):
+        reg = registry()
+        conformance._stub_phases(reg)
+        workload = conformance.FakeWorkload(
+            deleting=deleting, created=True
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        fail = GoError("gone", not_found=not_found)
+        if plain:
+            fail = GoError("boom")
+        rec = conformance.FakeReconciler(fail_status=fail)
+        result, err = interp.call_method(
+            reg, "HandleExecution", rec, req
+        )
+        return (result.fields if isinstance(result, GoStruct) else result,
+                err, rec.log.errors)
+
+    def logged_status_failure(fail_phase):
+        # a failing/pending phase whose trailing status write ALSO
+        # fails must log, not mask (phases.go statusErr branches)
+        reg = registry()
+        order = conformance._stub_phases(reg)
+        target = reg.fields["phases"][1]
+        if fail_phase:
+            target.fields["Do"] = lambda r, req: (None, GoError("boom"))
+        else:
+            target.fields["Do"] = lambda r, req: (False, None)
+        workload = conformance.FakeWorkload(created=True)
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        rec = conformance.FakeReconciler(fail_status=GoError("nope"))
+        result, err = interp.call_method(
+            reg, "HandleExecution", rec, req
+        )
+        return (order, err, rec.log.errors, rec.status.updates)
+
+    class _DepWorkload(conformance.FakeWorkload):
+        def __init__(self, deps):
+            super().__init__(created=True)
+            self.deps = deps
+            self.dep_status = []
+
+        def GetDependencyWorkloads(self):
+            return self.deps
+
+        def SetDependencyStatus(self, satisfied):
+            self.dep_status.append(satisfied)
+
+    class _NativeGVKWorkload(conformance._OwnerWorkload):
+        """GetWorkloadGVK as a REAL schema.GroupVersionKind so the
+        emitted ``gvk.GroupVersion().WithKind(...)`` chain executes."""
+
+        def GetWorkloadGVK(self):
+            from operator_forge.gocheck.interp import _SchemaModule
+
+            gvk = _SchemaModule.GroupVersionKind()
+            gvk.Group = self.group
+            gvk.Version = "v1alpha1"
+            gvk.Kind = self.kind
+            return gvk
+
+    class _DepReconciler(conformance.FakeReconciler):
+        def __init__(self, lists, fail=None):
+            super().__init__()
+            self.lists = lists  # list-kind -> list of item dicts
+            self.fail = fail
+            self.listed = []
+
+        def List(self, ctx, list_obj):
+            gvk = list_obj.GroupVersionKind()
+            kind = getattr(gvk, "Kind", None) or (
+                gvk[2] if isinstance(gvk, list) else str(gvk)
+            )
+            self.listed.append(kind)
+            if self.fail is not None:
+                return self.fail
+            items = []
+            for obj in self.lists.get(kind, []):
+                live = conformance._UnstructuredModule.Unstructured()
+                live.Object = obj
+                items.append(live)
+            list_obj.Items = items
+            return None
+
+        def CheckDependencies(self, req):
+            return (True, None)
+
+    def dependency(items, fail=None, hook=None):
+        dep = _NativeGVKWorkload(kind="Database")
+        workload = _DepWorkload([dep])
+        rec = _DepReconciler({"DatabaseList": items}, fail=fail)
+        if hook is not None:
+            rec.CheckDependencies = hook
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        out = interp.call("DependencyHandler", rec, req)
+        return (out, workload.dep_status, rec.listed)
+
+    def validate(named):
+        if named is None:
+            return interp.call("Validate", None)
+        return interp.call(
+            "Validate", conformance._OwnerWorkload(name=named)
+        )
+
+    def deepcopy(tname, nil):
+        fn, scan = interp.methods[(tname, "DeepCopy")]
+        recv = None if nil else GoStruct(tname, {"Phase": "x"})
+        return interp._invoke(fn, scan, recv, [])
+
+    def teardown_delete_error(not_found):
+        workload = conformance.TeardownWorkload(ns="default")
+        annotations, labels = conformance._owned_markers(interp, workload)
+        child = conformance.FakeChild(
+            "Deployment", "other-ns", "x",
+            annotations=annotations, labels=labels,
+        )
+
+        class FailingDelete(conformance.TeardownReconciler):
+            def Delete(self, ctx, obj):
+                return GoError("denied", not_found=not_found)
+
+        rec = FailingDelete(
+            [conformance.FakeGVK("apps", "v1", "Deployment")], [child]
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        return interp.call("TeardownChildrenHandler", rec, req)
+
+    def teardown_no_match():
+        workload = conformance.TeardownWorkload(ns="default")
+
+        class NoMatch(conformance.TeardownReconciler):
+            def List(self, ctx, list_obj, *opts):
+                err = GoError("no matches for kind")
+                err.no_match = True
+                return err
+
+        rec = NoMatch(
+            [conformance.FakeGVK("apps", "v1", "Deployment")], []
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        return interp.call("TeardownChildrenHandler", rec, req)
+
+    def teardown_already_deleting():
+        workload = conformance.TeardownWorkload(ns="default")
+        annotations, labels = conformance._owned_markers(interp, workload)
+        child = conformance.FakeChild(
+            "Deployment", "default", "x",
+            annotations=annotations, labels=labels, deleting=True,
+        )
+        rec = conformance.TeardownReconciler(
+            [conformance.FakeGVK("apps", "v1", "Deployment")], [child]
+        )
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        out = interp.call("TeardownChildrenHandler", rec, req)
+        return (out, [c.name for c in rec.deleted])
+
+    def event_funcs(which, event_field):
+        funcs = interp.call(which)
+        fn = funcs.fields.get(event_field)
+        if fn is None:
+            return "absent"
+        return interp.call_value(fn, GoStruct("Event", {}))
+
+    run = []
+    for name, kind, obj, _want in conformance.READY_CASES:
+        run.append((
+            f"ready:{name}",
+            lambda k=kind, o=obj: conformance._ready(interp, k, o),
+        ))
+    # error-shaped live objects: wrong field types must surface errors,
+    # not silent readiness (ready.go NestedX error branches)
+    bad_type_cases = [
+        ("deployment-bad-replicas", "Deployment",
+         {"spec": {"replicas": "three"}}),
+        ("deployment-bad-ready", "Deployment",
+         {"spec": {"replicas": 1}, "status": {"readyReplicas": "one"}}),
+        ("statefulset-bad", "StatefulSet",
+         {"spec": {"replicas": "x"}}),
+        ("daemonset-bad-desired", "DaemonSet",
+         {"status": {"desiredNumberScheduled": "x"}}),
+        ("daemonset-bad-ready", "DaemonSet",
+         {"status": {"desiredNumberScheduled": 1, "numberReady": "x"}}),
+        ("job-bad", "Job", {"status": {"succeeded": "x"}}),
+        ("pod-bad-phase", "Pod", {"status": {"phase": 3}}),
+        ("pod-bad-conditions", "Pod",
+         {"status": {"phase": "Running", "conditions": "x"}}),
+        ("pod-mixed-conditions", "Pod",
+         {"status": {"phase": "Running", "conditions": [
+             {"type": "Other", "status": "True"},
+             {"type": "Ready", "status": "False"},
+         ]}}),
+        ("namespace-bad", "Namespace", {"status": {"phase": 5}}),
+        ("pvc-bad", "PersistentVolumeClaim", {"status": {"phase": 5}}),
+        ("crd-bad-conditions", "CustomResourceDefinition",
+         {"status": {"conditions": "x"}}),
+        ("crd-mixed-conditions", "CustomResourceDefinition",
+         {"status": {"conditions": [
+             {"type": "Other", "status": "True"},
+             {"type": "Established", "status": "False"},
+         ]}}),
+        ("ingress-bad-class", "Ingress",
+         {"spec": {"ingressClassName": 5}}),
+        ("ingress-bad-lb", "Ingress",
+         {"spec": {"ingressClassName": "nginx"},
+          "status": {"loadBalancer": {"ingress": "x"}}}),
+        ("pod-nonmap-condition", "Pod",
+         {"status": {"phase": "Running", "conditions": [
+             123,
+             {"type": "Ready", "status": "True"},
+         ]}}),
+        ("crd-nonmap-condition", "CustomResourceDefinition",
+         {"status": {"conditions": [
+             "stray",
+             {"type": "Established", "status": "True"},
+         ]}}),
+    ]
+    for name, kind, obj in bad_type_cases:
+        run.append((
+            f"ready-err:{name}",
+            lambda k=kind, o=obj: conformance._ready(interp, k, o),
+        ))
+
+    def ready_get_error():
+        class FailingGet(conformance.FakeReconciler):
+            def Get(self, ctx, nn, live):
+                return GoError("boom")
+
+        req = GoStruct("Request", {"Context": None})
+        return interp.call(
+            "ResourceIsReady", FailingGet(), req,
+            conformance.FakeResource("Deployment", "ns", "x"),
+        )
+
+    run += [
+        ("ready-absent",
+         lambda: interp.call(
+             "ResourceIsReady", conformance.FakeReconciler({}),
+             GoStruct("Request", {"Context": None}),
+             conformance.FakeResource("Deployment", "ns", "x"),
+         )),
+        ("ready-get-error", ready_get_error),
+        ("phase-order", phase_order),
+        ("update-pass", lambda: pass_run(False, True)),
+        ("create-pass", lambda: pass_run(False, False)),
+        ("delete-pass", lambda: pass_run(True, True)),
+        ("pending-pass", lambda: pass_run(False, True, pending_phase=1)),
+        ("failing-pass", lambda: pass_run(False, True, fail_phase=1)),
+        ("status-fail-update", lambda: status_fail_pass(False, True)),
+        ("status-fail-delete", lambda: status_fail_pass(True, True)),
+        ("status-fail-delete-plain",
+         lambda: status_fail_pass(True, False, plain=True)),
+        ("status-fail-logged-failing",
+         lambda: logged_status_failure(True)),
+        ("status-fail-logged-pending",
+         lambda: logged_status_failure(False)),
+        ("dep-satisfied",
+         lambda: dependency([{"status": {"created": True}}])),
+        ("dep-unsatisfied",
+         lambda: dependency([{"status": {"created": False}}])),
+        ("dep-empty", lambda: dependency([])),
+        ("dep-break-shortcircuits",
+         lambda: dependency([
+             {"status": {"created": True}},
+             {"status": {"created": "bad-type"}},
+         ])),
+        ("dep-bad-then-created",
+         lambda: dependency([
+             {"status": {"created": "bad-type"}},
+             {"status": {"created": True}},
+         ])),
+        ("dep-list-error",
+         lambda: dependency([], fail=GoError("down"))),
+        ("dep-hook-error",
+         lambda: dependency(
+             [{"status": {"created": True}}],
+             hook=lambda req: (None, GoError("hook boom")),
+         )),
+        ("dep-hook-unready",
+         lambda: dependency(
+             [{"status": {"created": True}}],
+             hook=lambda req: (False, None),
+         )),
+        ("validate-nil", lambda: validate(None)),
+        ("validate-unnamed", lambda: validate("")),
+        ("validate-named", lambda: validate("ok")),
+        ("deepcopy-phase-nil", lambda: deepcopy("PhaseCondition", True)),
+        ("deepcopy-phase", lambda: deepcopy("PhaseCondition", False)),
+        ("deepcopy-child-nil",
+         lambda: deepcopy("ChildResourceCondition", True)),
+        ("deepcopy-child",
+         lambda: deepcopy("ChildResourceCondition", False)),
+        ("teardown-delete-notfound",
+         lambda: teardown_delete_error(True)),
+        ("teardown-delete-denied",
+         lambda: teardown_delete_error(False)),
+        ("teardown-no-match", teardown_no_match),
+        ("teardown-already-deleting", teardown_already_deleting),
+        ("finalizer-key",
+         lambda: interp.call("Finalizer", conformance._OwnerWorkload())),
+        ("finalizer-groupless",
+         lambda: interp.call(
+             "Finalizer", conformance._OwnerWorkload(group=""))),
+        ("owner-annotation",
+         lambda: interp.call(
+             "OwnerAnnotation", conformance._OwnerWorkload())),
+        ("owner-label",
+         lambda: interp.call("OwnerLabel", conformance._OwnerWorkload())),
+        ("mark-owned", mark_and_check),
+        ("finalizer-lifecycle", finalizer_lifecycle),
+        ("teardown-cross-ns",
+         lambda: teardown([("other-ns", "x", True, True)])),
+        ("teardown-lookalike",
+         lambda: teardown([("default", "x", False, False)])),
+        ("teardown-legacy",
+         lambda: teardown([("default", "x", True, False)])),
+        ("teardown-cluster-scoped",
+         lambda: teardown([("any", "x", True, True)], ns="")),
+        ("ownable",
+         lambda: (
+             interp.call("ownable", conformance._OwnerWorkload(ns=""),
+                         conformance.FakeChild("D", "other", "x")),
+             interp.call("ownable",
+                         conformance._OwnerWorkload(ns="default"),
+                         conformance.FakeChild("D", "default", "x")),
+             interp.call("ownable",
+                         conformance._OwnerWorkload(ns="default"),
+                         conformance.FakeChild("D", "other", "x")),
+         )),
+        ("pred-nil-old",
+         lambda: _nil_predicate(interp, "WorkloadPredicates",
+                                old_nil=True)),
+        ("pred-nil-new",
+         lambda: _nil_predicate(interp, "WorkloadPredicates",
+                                old_nil=False)),
+        ("pred-collection-nil",
+         lambda: _nil_predicate(interp, "CollectionPredicates",
+                                old_nil=True)),
+        ("pred-create-event",
+         lambda: event_funcs("WorkloadPredicates", "CreateFunc")),
+        ("pred-delete-event",
+         lambda: event_funcs("WorkloadPredicates", "DeleteFunc")),
+        ("pred-annotations",
+         lambda: predicates("WorkloadPredicates",
+                            {"annotations": {"a": "1"}},
+                            {"annotations": {"a": "2"}})),
+        ("pred-labels-key-diff",
+         lambda: predicates("WorkloadPredicates",
+                            {"labels": {"a": "1"}},
+                            {"labels": {"b": "1"}})),
+        ("pred-labels-len-diff",
+         lambda: predicates("WorkloadPredicates",
+                            {"labels": {"a": "1"}},
+                            {"labels": {"a": "1", "b": "2"}})),
+        ("pred-finalizers-content",
+         lambda: predicates("WorkloadPredicates",
+                            {"finalizers": ["a/f"]},
+                            {"finalizers": ["b/f"]})),
+        ("pred-unchanged-full",
+         lambda: predicates("WorkloadPredicates",
+                            {"generation": 2, "labels": {"a": "1"},
+                             "annotations": {"x": "y"},
+                             "finalizers": ["a/f"]},
+                            {"generation": 2, "labels": {"a": "1"},
+                             "annotations": {"x": "y"},
+                             "finalizers": ["a/f"]})),
+        ("pred-status-only",
+         lambda: predicates("WorkloadPredicates",
+                            {"generation": 3}, {"generation": 3})),
+        ("pred-spec-change",
+         lambda: predicates("WorkloadPredicates",
+                            {"generation": 3}, {"generation": 4})),
+        ("pred-labels",
+         lambda: predicates("WorkloadPredicates",
+                            {"labels": {"a": "1"}},
+                            {"labels": {"a": "2"}})),
+        ("pred-finalizers",
+         lambda: predicates("WorkloadPredicates",
+                            {"finalizers": []},
+                            {"finalizers": ["x/f"]})),
+        ("pred-deleting",
+         lambda: predicates("WorkloadPredicates",
+                            {}, {"deleting": True})),
+        ("pred-collection-labels",
+         lambda: predicates("CollectionPredicates",
+                            {"generation": 2, "labels": {"a": "1"}},
+                            {"generation": 2, "labels": {"a": "2"}})),
+        ("pred-collection-spec",
+         lambda: predicates("CollectionPredicates",
+                            {"generation": 2}, {"generation": 3})),
+    ]
+    return _scenarios(run)
+
+
+def resources_fingerprint(proj: str) -> list:
+    """The emitted resources package: every Generate/GenerateForCLI
+    path across spec variants (guards, namespaces, bad inputs)."""
+    import yaml
+
+    runtime = ProjectRuntime(proj)
+    pkg = runtime.package(
+        RESOURCES_DIR.replace(os.sep, "/")
+    )
+
+    def generate(mutate_cr=None):
+        cr = yaml.safe_load(pkg.Sample(False))
+        if mutate_cr is not None:
+            mutate_cr(cr)
+        objs, err = pkg.Generate(runtime.decode_cr(cr))
+        return ([o.Object for o in objs] if objs is not None else None,
+                err)
+
+    def debug_on(cr):
+        cr["spec"]["deployment"]["debug"] = True
+
+    def namespaced(cr):
+        cr["metadata"]["namespace"] = "team-a"
+
+    def debug_namespaced(cr):
+        debug_on(cr)
+        namespaced(cr)
+
+    def distinct_values(cr):
+        cr["spec"]["deployment"]["replicas"] = 9
+        cr["spec"]["service"]["port"] = 81
+        cr["spec"]["service"]["name"] = "front"
+        cr["spec"]["app"]["label"] = "lbl"
+
+    def cli(data):
+        objs, err = pkg.GenerateForCLI(data)
+        return ([o.Object for o in objs] if objs is not None else None,
+                err)
+
+    return _scenarios([
+        ("sample-full", lambda: pkg.Sample(False)),
+        ("sample-required", lambda: pkg.Sample(True)),
+        ("generate-default", generate),
+        ("generate-debug", lambda: generate(debug_on)),
+        ("generate-namespaced", lambda: generate(namespaced)),
+        ("generate-debug-namespaced",
+         lambda: generate(debug_namespaced)),
+        ("generate-distinct", lambda: generate(distinct_values)),
+        ("gvks", lambda: pkg.ChildResourceGVKs),
+        ("cli-good", lambda: cli(pkg.Sample(False).encode())),
+        ("cli-bad-yaml", lambda: cli(b"}{not yaml")),
+        ("cli-nameless",
+         lambda: cli(b"apiVersion: v1\nkind: BookStore\n")),
+        ("convert-ok",
+         lambda: pkg.ConvertWorkload(runtime.universe.make("BookStore"))),
+        ("convert-wrong",
+         lambda: pkg.ConvertWorkload(GoStruct("Other"))),
+    ])
+
+
+def project_fingerprint(proj: str) -> list:
+    """Controller-level passes through the full emitted pipeline."""
+    import yaml
+
+    def fresh():
+        runtime = ProjectRuntime(proj)
+        client = gofakes.FakeClusterClient(runtime)
+        manager = gofakes.FakeManager(client)
+        controllers = runtime.package("controllers/shop")
+        reconciler = controllers.NewBookStoreReconciler(manager)
+        interp = runtime.interp("controllers/shop")
+        interp.call_method(reconciler, "SetupWithManager", manager)
+        return runtime, client, manager, reconciler, interp
+
+    def request(namespace, name):
+        return GoStruct("Request", {
+            "NamespacedName": GoStruct("NamespacedName", {
+                "Namespace": namespace, "Name": name,
+            }),
+        })
+
+    def seed(runtime, client, namespace="default"):
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = yaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = namespace
+        cr["spec"]["deployment"]["replicas"] = 2
+        return client.add_workload(cr)
+
+    def create_and_ready():
+        runtime, client, manager, reconciler, interp = fresh()
+        workload = seed(runtime, client)
+        req = request("default", "bookstore-sample")
+        r1, e1 = interp.call_method(reconciler, "Reconcile", None, req)
+        deployment = client.child("Deployment", "default", "bookstore-app")
+        if deployment is not None:
+            deployment.setdefault("status", {})["readyReplicas"] = (
+                deployment.get("spec", {}).get("replicas", 0)
+            )
+        r2, e2 = interp.call_method(reconciler, "Reconcile", None, req)
+        status = workload.fields.get("Status")
+        controller = reconciler.fields.get("Controller")
+        return (
+            client.applied, sorted(client.children),
+            {k: v for k, v in sorted(client.children.items())},
+            r1.fields if isinstance(r1, GoStruct) else r1, e1,
+            r2.fields if isinstance(r2, GoStruct) else r2, e2,
+            status.fields.get("Created")
+            if isinstance(status, GoStruct) else None,
+            [
+                (c.fields["Phase"], c.fields["State"])
+                for c in (status.fields.get("Conditions") or [])
+            ] if isinstance(status, GoStruct) else None,
+            [
+                (c.fields["Kind"], c.fields["Name"],
+                 c.fields["Namespace"], c.fields["Created"])
+                for c in (status.fields.get("Resources") or [])
+            ] if isinstance(status, GoStruct) else None,
+            manager.recorder.events,
+            workload.GetFinalizers(),
+            # watch registration, dedup across both passes included:
+            # the (source, handler) structs expose owner wiring
+            getattr(controller, "watched", None),
+        )
+
+    def absent_cr():
+        _runtime, _client, _manager, reconciler, interp = fresh()
+        result, err = interp.call_method(
+            reconciler, "Reconcile", None, request("default", "missing")
+        )
+        return (result.fields if isinstance(result, GoStruct) else result,
+                err)
+
+    def delete_pass():
+        from operator_forge.gocheck.interp import (
+            _Timestamp,
+            _UnstructuredModule,
+        )
+        runtime, client, _manager, reconciler, interp = fresh()
+        workload = seed(runtime, client)
+        req = request("default", "bookstore-sample")
+        interp.call_method(reconciler, "Reconcile", None, req)
+        orchestrate = runtime.interp("pkg/orchestrate")
+        deployment = client.children.pop(
+            ("Deployment", "default", "bookstore-app"), None
+        )
+        if deployment is not None:
+            deployment["metadata"]["namespace"] = "other-ns"
+            live = _UnstructuredModule.Unstructured()
+            live.Object = deployment
+            orchestrate.call("MarkOwned", workload, live)
+            client.children[
+                ("Deployment", "other-ns", "bookstore-app")
+            ] = deployment
+        workload.fields["DeletionTimestamp"] = _Timestamp(zero=False)
+        workload.SetFinalizers(["shop.example.io/finalizer"])
+        r1, e1 = interp.call_method(reconciler, "Reconcile", None, req)
+        r2, e2 = interp.call_method(reconciler, "Reconcile", None, req)
+        return (client.deleted,
+                r1.fields if isinstance(r1, GoStruct) else r1, e1,
+                r2.fields if isinstance(r2, GoStruct) else r2, e2,
+                workload.GetFinalizers())
+
+    return _scenarios([
+        ("create-and-ready", create_and_ready),
+        ("absent-cr", absent_cr),
+        ("delete-pass", delete_pass),
+    ])
+
+
+# -- the battery ------------------------------------------------------------
+
+ORCHESTRATE_DIR = os.path.join("pkg", "orchestrate")
+RESOURCES_DIR = os.path.join("apis", "shop", "v1alpha1", "bookstore")
+CONTROLLER_DIR = os.path.join("controllers", "shop")
+
+TARGETS = (ORCHESTRATE_DIR, RESOURCES_DIR, CONTROLLER_DIR)
+
+
+def _target_files(proj: str, rel: str) -> list[str]:
+    directory = os.path.join(proj, rel)
+    return [
+        os.path.join(rel, name)
+        for name in sorted(os.listdir(directory))
+        if name.endswith(".go") and not name.endswith("_test.go")
+    ]
+
+
+def run_battery(proj: str):
+    """Mutate every target file of the scaffolded project at *proj*
+    (in place, restoring after each mutant); returns a dict mapping
+    target-rel-dir to a list of (mutant, killed_by or None)."""
+    baselines = {
+        "orchestrate": orchestrate_fingerprint(
+            os.path.join(proj, ORCHESTRATE_DIR)),
+        "resources": resources_fingerprint(proj),
+        "project": project_fingerprint(proj),
+    }
+    results: dict[str, list] = {t: [] for t in TARGETS}
+    for target in TARGETS:
+        for rel in _target_files(proj, target):
+            path = os.path.join(proj, rel)
+            with open(path, encoding="utf-8") as fh:
+                original = fh.read()
+            for mutant in mutants_of(original, rel):
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write(mutant.text)
+                try:
+                    killed_by = _verdict(proj, target, baselines)
+                finally:
+                    with open(path, "w", encoding="utf-8") as fh:
+                        fh.write(original)
+                results[target].append((mutant, killed_by))
+    return results
+
+
+def _verdict(proj: str, target: str, baselines) -> str | None:
+    """The oracle that killed the mutant, or None if it survived."""
+    if target == ORCHESTRATE_DIR:
+        try:
+            if orchestrate_fingerprint(
+                os.path.join(proj, ORCHESTRATE_DIR)
+            ) != baselines["orchestrate"]:
+                return "orchestrate-fingerprint"
+        except Exception:
+            return "orchestrate-fingerprint"
+    if target == RESOURCES_DIR:
+        try:
+            if resources_fingerprint(proj) != baselines["resources"]:
+                return "resources-fingerprint"
+        except Exception:
+            return "resources-fingerprint"
+    try:
+        if project_fingerprint(proj) != baselines["project"]:
+            return "project-fingerprint"
+    except Exception:
+        return "project-fingerprint"
+    return None
+
+
+def kill_stats(entries) -> tuple[int, int, float]:
+    killed = sum(1 for _m, verdict in entries if verdict is not None)
+    total = len(entries)
+    return killed, total, (killed / total if total else 1.0)

@@ -1,0 +1,104 @@
+"""Systematic mutation testing of the emitted Go (VERDICT r4 item 4).
+
+Round 4 proved 7 hand-seeded mutations are caught; this converts that
+into a measured property: every function-body mutant of the emitted
+orchestrate / resources / controller sources (gocheck/mutate.py) runs
+under the conformance fingerprints (mutation_oracle.py), and the kill
+rate is asserted ≥80% on pkg/orchestrate — the reference's equivalent
+guarantee is CI compiling and running the generated project's tests
+(reference .github/workflows/test.yaml:55-141).
+
+Surviving mutants are TRIAGED below: each must match an allowlisted
+equivalence pattern, so a template change that creates a new
+un-triaged survivor fails this suite rather than silently lowering the
+kill rate.  The checked-in MUTATION.md (scripts/mutation_report.py)
+carries the same data for the repo's readers.
+"""
+
+import os
+
+import pytest
+
+import mutation_oracle as oracle
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    return oracle.scaffold_standalone(
+        str(tmp_path_factory.mktemp("mutation"))
+    )
+
+
+@pytest.fixture(scope="module")
+def battery(project):
+    return oracle.run_battery(project)
+
+
+class TestMutationKillRates:
+    def test_orchestrate_kill_rate_at_least_80_percent(self, battery):
+        killed, total, rate = oracle.kill_stats(
+            battery[oracle.ORCHESTRATE_DIR]
+        )
+        assert total > 150, "mutant generation collapsed"
+        assert rate >= 0.80, f"kill rate {rate:.0%} ({killed}/{total})"
+
+    def test_resources_kill_rate_at_least_80_percent(self, battery):
+        _killed, total, rate = oracle.kill_stats(
+            battery[oracle.RESOURCES_DIR]
+        )
+        assert total >= 10
+        assert rate >= 0.80
+
+    def test_controller_kill_rate_at_least_80_percent(self, battery):
+        _killed, total, rate = oracle.kill_stats(
+            battery[oracle.CONTROLLER_DIR]
+        )
+        assert total >= 10
+        assert rate >= 0.80
+
+    def test_every_survivor_is_triaged(self, battery):
+        untriaged = []
+        for entries in battery.values():
+            for mutant, verdict in entries:
+                if verdict is not None:
+                    continue
+                if oracle.survivor_key(mutant) not in (
+                    oracle.EQUIVALENT_SURVIVORS
+                ):
+                    untriaged.append(
+                        f"{mutant.path}:{mutant.line} {mutant.op} "
+                        f"{mutant.detail}"
+                    )
+        assert untriaged == [], (
+            "new surviving mutants need a kill scenario or a triage "
+            f"entry in mutation_oracle.EQUIVALENT_SURVIVORS: "
+            f"{untriaged}"
+        )
+
+    def test_fingerprints_are_deterministic(self, project):
+        # the harness is vacuous if the oracle is noisy: the UNMUTATED
+        # sources must fingerprint identically across runs (a leaked
+        # object identity or ordering would "kill" every mutant)
+        orchestrate = os.path.join(project, oracle.ORCHESTRATE_DIR)
+        assert oracle.orchestrate_fingerprint(orchestrate) == (
+            oracle.orchestrate_fingerprint(orchestrate)
+        )
+        assert oracle.resources_fingerprint(project) == (
+            oracle.resources_fingerprint(project)
+        )
+        assert oracle.project_fingerprint(project) == (
+            oracle.project_fingerprint(project)
+        )
+
+    def test_no_baseline_scenario_errors(self, project):
+        # a scenario that errors on HEALTHY sources checks nothing
+        orchestrate = os.path.join(project, oracle.ORCHESTRATE_DIR)
+        for fingerprint in (
+            oracle.orchestrate_fingerprint(orchestrate),
+            oracle.resources_fingerprint(project),
+            oracle.project_fingerprint(project),
+        ):
+            broken = [
+                label for label, value in fingerprint
+                if isinstance(value, str) and value.startswith("!")
+            ]
+            assert broken == []
