@@ -1,0 +1,248 @@
+"""Guard on the interpreter's value-semantics boundary.
+
+The conformance interpreter is pointer-transparent: struct assignment
+ALIASES where Go COPIES (interp.py module docstring).  That is safe for
+the pointer-heavy emitted code — until a template starts emitting code
+that relies on copy semantics, at which point the interpreter would
+silently mis-execute it and the conformance suites would assert the
+wrong behavior.  This scan makes that drift loud: it flags the three
+copy-reliant patterns the interpreter aliases, so a template change
+that exits the supported subset fails a test instead of being
+mis-executed (VERDICT r4 item 5).
+
+Patterns flagged, per function body:
+
+1. value-copy-then-mutate — ``x := y`` (or ``var x = y`` / ``x = y``)
+   where ``y`` is a plausibly struct-valued local (composite literal
+   without ``&``, ``var y T`` of a named struct-ish type, or a
+   non-pointer named-type parameter), followed by a field WRITE through
+   ``x`` or ``y``;
+2. value-receiver mutation — a method with a non-pointer receiver
+   assigning to a receiver field (a Go no-op the interpreter would
+   make visible);
+3. range-value mutation — ``for _, v := range ...`` followed by a
+   field write through ``v`` (Go mutates a copy; the interpreter
+   mutates the element).
+
+The heuristics are deliberately conservative about what counts as a
+struct value: pointers (``&T{...}``, ``*T``), slices, maps and known
+basic types never trigger, so the emitted corpus stays at zero
+findings (asserted by tests/test_value_semantics_guard.py).
+"""
+
+from __future__ import annotations
+
+from .localindex import _FileScan
+from .tokens import IDENT, KEYWORD, OP
+
+_BASIC = {
+    "string", "bool", "byte", "rune", "error", "any",
+    "int", "int8", "int16", "int32", "int64",
+    "uint", "uint8", "uint16", "uint32", "uint64", "uintptr",
+    "float32", "float64", "complex64", "complex128",
+}
+
+
+def _struct_valued_params(fn) -> set[str]:
+    """Parameter names declared with a non-pointer named (struct-ish)
+    type: ``w Workload``/``w pkg.Kind`` yes; ``w *T``, ``w []T``,
+    ``w string`` no."""
+    names: set[str] = set()
+    for name, span in fn["params"]:
+        if not name or not span:
+            continue
+        first = span[0]
+        if first.kind == OP:  # *T, []T, ...T
+            continue
+        if first.kind == KEYWORD:  # map/func/chan/interface/struct
+            continue
+        if first.kind == IDENT and first.value in _BASIC:
+            continue
+        if first.kind == IDENT:
+            names.add(name)
+    return names
+
+
+def _stmt_spans(toks, lo, hi):
+    """Top-level statement spans of a body (split on `;` and braces)."""
+    spans = []
+    depth = 0
+    start = lo
+    j = lo
+    while j < hi:
+        t = toks[j]
+        if t.kind == OP:
+            if t.value in "([{":
+                depth += 1
+            elif t.value in ")]}":
+                depth -= 1
+            elif t.value == ";" and depth == 0:
+                if j > start:
+                    spans.append((start, j))
+                start = j + 1
+        j += 1
+    if hi > start:
+        spans.append((start, hi))
+    return spans
+
+
+def check_value_semantics(text: str, path: str = "<go>") -> list[str]:
+    scan = _FileScan(path, text)
+    toks = scan.toks
+    struct_types = {
+        td["name"] for td in scan.typedecls if td.get("kind") == "struct"
+    }
+    problems: list[str] = []
+
+    for fn in scan.funcs:
+        if fn["body"] is None:
+            continue
+        lo, hi = fn["body"]
+        struct_vars = _struct_valued_params(fn)
+        # a non-pointer receiver is itself a struct value
+        value_receiver = None
+        if fn["recv"] is not None and fn["recv"][0]:
+            recv_span = fn["recv"][1]
+            if not any(t.kind == OP and t.value == "*" for t in recv_span):
+                value_receiver = fn["recv"][0]
+        copies: dict[str, str] = {}  # copy name -> source name
+        # after `x := y`, mutating EITHER side diverges (Go: two
+        # values; interpreter: one aliased value)
+        copy_sources: dict[str, str] = {}  # source name -> copy name
+        range_values: set[str] = set()
+
+        j = lo
+        while j < hi:
+            t = toks[j]
+            # track `y := T{...}` / `var y T` struct-valued locals,
+            # `x := y` copies, and `for _, v := range` loop values
+            if t.kind == KEYWORD and t.value == "for":
+                # for [i], v := range ...
+                k = j + 1
+                names = []
+                while k < hi and toks[k].kind in (IDENT,):
+                    names.append(toks[k].value)
+                    if toks[k + 1].kind == OP and toks[k + 1].value == ",":
+                        k += 2
+                    else:
+                        k += 1
+                        break
+                if (
+                    k + 1 < hi
+                    and toks[k].kind == OP and toks[k].value == ":="
+                    and toks[k + 1].kind == KEYWORD
+                    and toks[k + 1].value == "range"
+                    and names
+                ):
+                    value_name = names[-1]
+                    if value_name != "_":
+                        range_values.add(value_name)
+                j = k + 1
+                continue
+            if (
+                t.kind == IDENT
+                and j + 1 < hi
+                and toks[j + 1].kind == OP
+                and toks[j + 1].value in (":=", "=")
+                and (j == lo or (
+                    toks[j - 1].kind == OP
+                    and toks[j - 1].value in (";", "{", "}")
+                ) or toks[j - 1].kind == KEYWORD)
+            ):
+                target = t.value
+                k = j + 2
+                # RHS single identifier -> potential struct copy
+                rhs_end = k
+                depth = 0
+                while rhs_end < hi:
+                    tr = toks[rhs_end]
+                    if tr.kind == OP:
+                        if tr.value in "([{":
+                            depth += 1
+                        elif tr.value in ")]}":
+                            if depth == 0:
+                                break
+                            depth -= 1
+                        elif tr.value == ";" and depth == 0:
+                            break
+                    rhs_end += 1
+                rhs = toks[k:rhs_end]
+                if (
+                    len(rhs) == 1
+                    and rhs[0].kind == IDENT
+                    and (
+                        rhs[0].value in struct_vars
+                        or rhs[0].value in copies
+                        or rhs[0].value == value_receiver
+                    )
+                ):
+                    copies[target] = rhs[0].value
+                    copy_sources[rhs[0].value] = target
+                elif (
+                    len(rhs) >= 2
+                    and rhs[0].kind == IDENT
+                    and rhs[0].value in struct_types
+                    and rhs[1].kind == OP and rhs[1].value == "{"
+                ):
+                    struct_vars.add(target)  # y := T{...} by value
+                j = rhs_end
+                continue
+            # field WRITE through a tracked name: name.Field [.=|=|++]
+            if (
+                t.kind == IDENT
+                and (t.value in copies
+                     or t.value in copy_sources
+                     or t.value in range_values
+                     or t.value == value_receiver)
+                and j + 3 < hi
+                and toks[j + 1].kind == OP and toks[j + 1].value == "."
+                and toks[j + 2].kind == IDENT
+                and toks[j + 3].kind == OP
+                and toks[j + 3].value in (
+                    "=", "+=", "-=", "*=", "/=", "++", "--",
+                )
+                and not (j > lo and toks[j - 1].kind == OP
+                         and toks[j - 1].value == ".")
+            ):
+                name = t.value
+                if name in copies:
+                    kind = (
+                        f"struct value copied from {copies[name]!r} "
+                        "then mutated"
+                    )
+                elif name in copy_sources:
+                    kind = (
+                        f"struct value copied from {name!r} "
+                        "then mutated"
+                    )
+                elif name in range_values:
+                    kind = "range-value variable mutated"
+                else:
+                    kind = "value-receiver field mutated"
+                problems.append(
+                    f"{path}:{t.line}:{t.col}: {kind} — Go copies here "
+                    "but the conformance interpreter aliases; this "
+                    "pattern exits the interpreter's supported subset"
+                )
+            j += 1
+    return problems
+
+
+def check_project_value_semantics(root: str) -> list[str]:
+    import os
+
+    problems: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if not d.startswith((".", "_")) and d != "vendor"
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".go") or name.endswith("_test.go"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                problems.extend(
+                    check_value_semantics(fh.read(), path)
+                )
+    return problems
