@@ -74,7 +74,13 @@ def kind_conflicts(expected: str | None, got: str | None) -> bool:
 
 def arg_spans(toks: list[Token], open_paren: int) -> list[tuple[int, int]]:
     """Top-level comma-separated argument spans of the paren group
-    opening at toks[open_paren]; trailing commas dropped."""
+    opening at toks[open_paren]; trailing commas dropped.
+
+    Related scanners with different contracts exist in
+    localindex._count_args (inner-span input, spread/multi-value
+    sentinels) and the parser's qual_calls counter (syntax-layer,
+    no token spans) — a comma-handling fix here likely applies there.
+    """
     depth = 0
     spans: list[tuple[int, int]] = []
     start = open_paren + 1

@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from .kinds import check_call_kinds, param_kind_of
 from .structural import parse_imports, prune_go_dirs
 from .tokens import IDENT, KEYWORD, OP, STRING, GoTokenError, Token, tokenize
 
@@ -904,8 +905,6 @@ def _signature_kinds(params) -> tuple:
     kinds.py).  Shared-type parameter groups (``a, b string``) resolve
     right-to-left: an item that is just a name takes the next item's
     type.  Variadics and unclassifiable types map to None (unchecked)."""
-    from .kinds import param_kind_of
-
     has_named = any(name for name, _span in params)
     resolved: list = []
     next_type = None
@@ -1071,8 +1070,6 @@ def _check_call(idx, scan, own, env, parts, nargs, spread,
             errors = arity_errors(name, head, own.funcs[name])
             kinds = own.func_kinds.get(name)
             if kinds and open_paren is not None and nargs > 0:
-                from .kinds import check_call_kinds
-
                 errors.extend(check_call_kinds(
                     toks, open_paren, kinds, name, where,
                 ))

@@ -7,6 +7,8 @@ Reference: internal/plugins/workload/v1/scaffolds/api.go:64-282
 
 from __future__ import annotations
 
+import os
+
 from ..workload.config import Processor
 from .context import ProjectConfig, WorkloadView, views_for
 from .machinery import FileSpec, Fragment, Scaffold
@@ -191,8 +193,14 @@ def scaffold_api(
                 # already routes the CURRENT version's type through
                 # NewWebhookManagedBy (serving /convert too); registering
                 # the same type again would panic the webhook server on
-                # a duplicate path at manager startup
+                # a duplicate path at manager startup — and a conversion
+                # fragment left behind by an earlier non-admission
+                # scaffold is equally stale, so strip it
                 if admission and hub == view.version:
+                    if _strip_conversion_registration(
+                        output_dir, view, hub, dry_run=dry_run
+                    ):
+                        scaffold.changes.append(("fragment", "main.go"))
                     continue
                 fragments.append(
                     webhook_tpl.main_go_webhook_fragment(view, hub)
@@ -216,6 +224,45 @@ def scaffold_api(
                 ("fragment", "config/default/kustomization.yaml")
             )
     return scaffold
+
+
+def _strip_conversion_registration(
+    output_dir: str,
+    view: WorkloadView,
+    hub: str,
+    dry_run: bool = False,
+) -> bool:
+    """Remove the stale ``NewWebhookManagedBy(...).For(&hub.Kind{})``
+    block from main.go (emitted by the conversion path before admission
+    webhooks existed for the kind).  Returns True when a block was
+    removed (or would be, under dry_run)."""
+    main_path = os.path.join(output_dir, "main.go")
+    if not os.path.isfile(main_path):
+        return False
+    with open(main_path, encoding="utf-8") as fh:
+        lines = fh.readlines()
+    anchor = (
+        f"ctrl.NewWebhookManagedBy(mgr).For(&{view.group}{hub}"
+        f".{view.kind}{{}}).Complete()"
+    )
+    start = next(
+        (i for i, line in enumerate(lines) if anchor in line), None
+    )
+    if start is None:
+        return False
+    # the fragment is a brace-balanced if-block: drop through its close
+    depth = 0
+    end = start
+    for i in range(start, len(lines)):
+        depth += lines[i].count("{") - lines[i].count("}")
+        if depth <= 0:
+            end = i
+            break
+    if not dry_run:
+        del lines[start:end + 1]
+        with open(main_path, "w", encoding="utf-8") as fh:
+            fh.writelines(lines)
+    return True
 
 
 def _admission_specs(
@@ -273,6 +320,16 @@ def scaffold_webhook(
     fragments: list[Fragment] = []
     for view in views:
         fragments.extend(admission_tpl.main_go_admission_fragments(view))
+        # a project previously scaffolded with --enable-conversion
+        # registered the hub type through NewWebhookManagedBy; the
+        # SetupWebhookWithManager registration added here serves
+        # /convert for that type too, so the old fragment is stale —
+        # strip it rather than rely on the builder's path dedup
+        hub = webhook_tpl.hub_version(view, output_dir)
+        if hub == view.version and _strip_conversion_registration(
+            output_dir, view, hub, dry_run=dry_run
+        ):
+            scaffold.changes.append(("fragment", "main.go"))
     scaffold.execute(specs, fragments)
     changed = webhook_tpl.update_default_kustomization(
         output_dir, dry_run=dry_run

@@ -1,0 +1,306 @@
+"""Webhook conformance (VERDICT r4 item 7): the `create webhook`
+output was vet-clean but behavior-unchecked.  These tests EXECUTE the
+emitted defaulting/validating admission stubs under the Go interpreter
+— including user-edited hook bodies, since the stubs are scaffolded
+once and owned by the user — and assert the admission WIRING: the
+webhook manifests reference the marker-declared service paths, and the
+main.go registration fragment stays single under re-scaffold and
+``--force`` (reference bar: kubebuilder's webhook scaffolding compiled
++ exercised by envtest in the reference's CI, test.yaml:106-141).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from operator_forge.gocheck.gopkg import ProjectRuntime
+from operator_forge.gocheck.interp import GoError
+
+import mutation_oracle as oracle
+
+
+def _create_webhook(proj: str, *extra: str) -> None:
+    subprocess.run(
+        [sys.executable, "-m", "operator_forge", "create", "webhook",
+         "--workload-config", os.path.join(proj, "workload.yaml"),
+         "--defaulting", "--programmatic-validation",
+         "--output-dir", proj, *extra],
+        check=True, capture_output=True,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    proj = oracle.scaffold_standalone(
+        str(tmp_path_factory.mktemp("webhook"))
+    )
+    _create_webhook(proj)
+    return proj
+
+
+class _Manager:
+    def __init__(self):
+        self.registered = []
+
+    def RegisterWebhookFor(self, obj):
+        self.registered.append(obj)
+
+
+class TestEmittedAdmissionStubsExecute:
+    def test_scaffolded_stubs_are_admission_noops(self, project):
+        runtime = ProjectRuntime(project)
+        api = runtime.interp("apis/shop/v1alpha1")
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+        workload = runtime.decode_cr(yaml.safe_load(pkg.Sample(False)))
+        assert api.call_method(workload, "Default") is None
+        assert api.call_method(workload, "ValidateCreate") is None
+        assert api.call_method(workload, "ValidateUpdate", None) is None
+        assert api.call_method(workload, "ValidateDelete") is None
+
+    def test_setup_registers_type_with_webhook_builder(self, project):
+        runtime = ProjectRuntime(project)
+        api = runtime.interp("apis/shop/v1alpha1")
+        manager = _Manager()
+        workload = runtime.universe.make("BookStore")
+        err = api.call_method(
+            workload, "SetupWebhookWithManager", manager
+        )
+        assert err is None
+        assert manager.registered == [workload]
+
+    def test_user_edited_hooks_execute(self, project, tmp_path):
+        # the stubs are SCAFFOLDING FOR YOU TO OWN: fill them in the
+        # way a user would and the interpreted admission path must
+        # apply the defaulting and enforce the validation
+        import shutil
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(project, proj)
+        path = os.path.join(
+            proj, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        text = text.replace(
+            '\tbookstorelog.Info("default", "name", r.Name)\n\n'
+            "\t// TODO: fill in defaulting logic.\n",
+            '\tbookstorelog.Info("default", "name", r.Name)\n\n'
+            "\tif r.Spec.Deployment.Replicas == 0 {\n"
+            "\t\tr.Spec.Deployment.Replicas = 3\n"
+            "\t}\n",
+        )
+        text = text.replace(
+            '\tbookstorelog.Info("validate create", "name", r.Name)\n\n'
+            "\t// TODO: fill in create validation logic.\n"
+            "\treturn nil\n",
+            '\tbookstorelog.Info("validate create", "name", r.Name)\n\n'
+            "\tif r.Spec.Service.Port <= 0 {\n"
+            '\t\treturn fmt.Errorf("service port must be positive, '
+            'got %d", r.Spec.Service.Port)\n'
+            "\t}\n"
+            "\treturn nil\n",
+        )
+        text = text.replace(
+            'import (\n\t"k8s.io/apimachinery/pkg/runtime"\n',
+            'import (\n\t"fmt"\n\n\t"k8s.io/apimachinery/pkg/runtime"\n',
+        )
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+
+        runtime = ProjectRuntime(proj)
+        api = runtime.interp("apis/shop/v1alpha1")
+        pkg = runtime.package("apis/shop/v1alpha1/bookstore")
+
+        # defaulting: zero replicas filled in, explicit value untouched
+        cr = yaml.safe_load(pkg.Sample(True))  # required-only sample
+        workload = runtime.decode_cr(cr)
+        assert workload.fields["Spec"].fields["Deployment"].fields[
+            "Replicas"] == 0
+        api.call_method(workload, "Default")
+        assert workload.fields["Spec"].fields["Deployment"].fields[
+            "Replicas"] == 3
+
+        explicit = runtime.decode_cr(yaml.safe_load(pkg.Sample(False)))
+        explicit.fields["Spec"].fields["Deployment"].fields[
+            "Replicas"] = 7
+        api.call_method(explicit, "Default")
+        assert explicit.fields["Spec"].fields["Deployment"].fields[
+            "Replicas"] == 7
+
+        # validation: bad port rejected, good port accepted
+        bad = runtime.decode_cr(yaml.safe_load(pkg.Sample(False)))
+        bad.fields["Spec"].fields["Service"].fields["Port"] = 0
+        err = api.call_method(bad, "ValidateCreate")
+        assert isinstance(err, GoError)
+        assert "service port must be positive, got 0" == err.msg
+        good = runtime.decode_cr(yaml.safe_load(pkg.Sample(False)))
+        assert api.call_method(good, "ValidateCreate") is None
+
+        # the defaulted workload flows into the same generate pipeline
+        objs, err = pkg.Generate(workload)
+        assert err is None
+        assert objs[0].Object["spec"]["replicas"] == 3
+
+
+class TestAdmissionWiring:
+    def _marker_paths(self, project):
+        path = os.path.join(
+            project, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        paths = []
+        for line in text.splitlines():
+            if "kubebuilder:webhook:" in line:
+                for field in line.split(","):
+                    if field.startswith(
+                        "//+kubebuilder:webhook:path="
+                    ):
+                        paths.append(field.split("=", 1)[1])
+        return paths
+
+    def test_manifests_reference_marker_paths(self, project):
+        marker_paths = self._marker_paths(project)
+        assert len(marker_paths) == 2
+        with open(os.path.join(
+            project, "config", "webhook", "manifests.yaml",
+        ), encoding="utf-8") as fh:
+            docs = list(yaml.safe_load_all(fh))
+        service_paths = []
+        for doc in docs:
+            for hook in doc.get("webhooks", []):
+                service = hook["clientConfig"]["service"]
+                service_paths.append(service["path"])
+                assert service["name"].endswith("-webhook-service")
+        assert sorted(service_paths) == sorted(marker_paths)
+        kinds = sorted(d["kind"] for d in docs)
+        assert kinds == [
+            "MutatingWebhookConfiguration",
+            "ValidatingWebhookConfiguration",
+        ]
+
+    def test_webhook_service_targets_webhook_port(self, project):
+        with open(os.path.join(
+            project, "config", "webhook", "service.yaml",
+        ), encoding="utf-8") as fh:
+            service = yaml.safe_load(fh)
+        (port,) = service["spec"]["ports"]
+        assert port["port"] == 443
+        assert port["targetPort"] == 9443
+
+    def test_main_registration_idempotent_under_force(
+        self, project, tmp_path
+    ):
+        import shutil
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(project, proj)
+        for _ in range(2):
+            _create_webhook(proj, "--force")
+        with open(os.path.join(proj, "main.go"), encoding="utf-8") as fh:
+            main_go = fh.read()
+        assert main_go.count("SetupWebhookWithManager") == 1
+        runtime = ProjectRuntime(proj)
+        api = runtime.interp("apis/shop/v1alpha1")
+        workload = runtime.universe.make("BookStore")
+        manager = _Manager()
+        assert api.call_method(
+            workload, "SetupWebhookWithManager", manager
+        ) is None
+
+    def test_stale_conversion_registration_stripped(self, tmp_path):
+        """ADVICE r4: a project scaffolded with --enable-conversion
+        keeps its NewWebhookManagedBy fragment until `create webhook`
+        adds SetupWebhookWithManager for the same (hub) type — the
+        stale fragment must be removed, not left to the builder's
+        path-dedup behavior."""
+        import shutil
+
+        work = str(tmp_path / "w")
+        proj = oracle.scaffold_standalone(work)
+        config = os.path.join(proj, "workload.yaml")
+        base = [sys.executable, "-m", "operator_forge"]
+        cwd = os.path.dirname(os.path.dirname(__file__))
+        with open(config, encoding="utf-8") as fh:
+            text = fh.read()
+        # conversion infra needs 2+ versions of the kind
+        subprocess.run(
+            base + ["create", "api", "--workload-config", config,
+                    "--enable-conversion", "--output-dir", proj],
+            check=True, capture_output=True, cwd=cwd,
+        )
+        with open(config, "w", encoding="utf-8") as fh:
+            fh.write(text.replace("version: v1alpha1",
+                                  "version: v1beta1"))
+        subprocess.run(
+            base + ["create", "api", "--workload-config", config,
+                    "--enable-conversion", "--output-dir", proj],
+            check=True, capture_output=True, cwd=cwd,
+        )
+        with open(os.path.join(proj, "main.go"), encoding="utf-8") as fh:
+            before = fh.read()
+        assert "NewWebhookManagedBy" in before
+
+        _create_webhook(proj)
+        with open(os.path.join(proj, "main.go"), encoding="utf-8") as fh:
+            after = fh.read()
+        assert "NewWebhookManagedBy" not in after
+        assert after.count("SetupWebhookWithManager") == 1
+
+    def test_create_api_resync_strips_stale_conversion_fragment(
+        self, tmp_path
+    ):
+        """The other route to the same staleness: webhooks recorded in
+        PROJECT re-sync through `create api` — a hub-version re-scaffold
+        must strip the old conversion registration too."""
+        import shutil
+
+        work = str(tmp_path / "w")
+        proj = oracle.scaffold_standalone(work)
+        config = os.path.join(proj, "workload.yaml")
+        base = [sys.executable, "-m", "operator_forge"]
+        cwd = os.path.dirname(os.path.dirname(__file__))
+        with open(config, encoding="utf-8") as fh:
+            v1_text = fh.read()
+        subprocess.run(
+            base + ["create", "api", "--workload-config", config,
+                    "--enable-conversion", "--output-dir", proj],
+            check=True, capture_output=True, cwd=cwd,
+        )
+        with open(config, "w", encoding="utf-8") as fh:
+            fh.write(v1_text.replace("version: v1alpha1",
+                                     "version: v1beta1"))
+        subprocess.run(
+            base + ["create", "api", "--workload-config", config,
+                    "--enable-conversion", "--output-dir", proj],
+            check=True, capture_output=True, cwd=cwd,
+        )
+        # webhook created while the config points at the OLD version:
+        # the v1beta1 conversion fragment must SURVIVE (it still serves
+        # /convert for the hub, which has no admission registration)
+        with open(config, "w", encoding="utf-8") as fh:
+            fh.write(v1_text)
+        _create_webhook(proj)
+        with open(os.path.join(proj, "main.go"), encoding="utf-8") as fh:
+            mid = fh.read()
+        assert "NewWebhookManagedBy" in mid
+        # re-scaffold the hub version: PROJECT-recorded admission now
+        # covers it, so the conversion fragment is stale and stripped
+        with open(config, "w", encoding="utf-8") as fh:
+            fh.write(v1_text.replace("version: v1alpha1",
+                                     "version: v1beta1"))
+        subprocess.run(
+            base + ["create", "api", "--workload-config", config,
+                    "--enable-conversion", "--output-dir", proj],
+            check=True, capture_output=True, cwd=cwd,
+        )
+        with open(os.path.join(proj, "main.go"), encoding="utf-8") as fh:
+            final = fh.read()
+        assert "NewWebhookManagedBy" not in final
+        assert final.count(
+            "(&shopv1beta1.BookStore{}).SetupWebhookWithManager"
+        ) == 1
