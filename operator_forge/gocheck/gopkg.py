@@ -37,6 +37,7 @@ from .interp import (
     GoObject,
     GoStruct,
     Interp,
+    Scheduler,
     TypeFactory,
     TypeRef,
     _Timestamp,
@@ -300,7 +301,8 @@ class ProjectRuntime:
         self.root = root
         self.module = self._module_path(root)
         self.universe = TypeUniverse()
-        self.natives = default_natives()
+        self.sched = Scheduler()
+        self.natives = default_natives(self.sched)
         self.natives["sigs.k8s.io/yaml"] = YamlPackage(self.universe)
         if extra_natives:
             self.natives.update(extra_natives)
@@ -339,7 +341,7 @@ class ProjectRuntime:
 
     def _load_package(self, rel: str) -> None:
         interp = Interp(natives=self.natives, methods=self.methods,
-                        embeds=self.embeds)
+                        embeds=self.embeds, sched=self.sched)
         interp.load_dir(os.path.join(self.root, rel))
         self.packages[rel] = interp
         self.universe.add_interp(interp)

@@ -51,12 +51,31 @@ class GoInterpError(Exception):
     """Interpreter failure: unsupported syntax or a runtime fault."""
 
 
+class GoPanic(GoInterpError):
+    """A Go ``panic(v)``: carries the panic value."""
+
+    def __init__(self, value):
+        super().__init__(f"panic: {value}")
+        self.value = value
+
+
+class GoExit(Exception):
+    """``os.Exit(code)``: unwinds the whole interpreted program (defers
+    do NOT run, matching Go)."""
+
+    def __init__(self, code):
+        super().__init__(f"os.Exit({code})")
+        self.code = code
+
+
 class GoError:
     """A Go ``error`` value."""
 
-    def __init__(self, msg: str, not_found: bool = False):
+    def __init__(self, msg: str, not_found: bool = False,
+                 already_exists: bool = False):
         self.msg = msg
         self.not_found = not_found
+        self.already_exists = already_exists
 
     def Error(self):
         return self.msg
@@ -493,6 +512,12 @@ class _ApiErrorsModule:
     def IsNotFound(err):
         return isinstance(err, GoError) and err.not_found
 
+    @staticmethod
+    def IsAlreadyExists(err):
+        return isinstance(err, GoError) and getattr(
+            err, "already_exists", False
+        )
+
 
 class _ControllerUtilModule:
     """Finalizer helpers over any fake exposing Get/SetFinalizers."""
@@ -594,6 +619,20 @@ class _ErrorsModule:
         return getattr(err, "wrapped", None)
 
 
+class _GoContext:
+    """A cancellable context value (context.WithCancel's first result).
+    The fake manager consults ``cancelled`` to stop dispatching."""
+
+    def __init__(self):
+        self.cancelled = False
+
+    def Done(self):
+        return None
+
+    def Err(self):
+        return GoError("context canceled") if self.cancelled else None
+
+
 class _ContextModule:
     @staticmethod
     def Background():
@@ -603,14 +642,170 @@ class _ContextModule:
     def TODO():
         return None
 
+    @staticmethod
+    def WithCancel(parent):
+        ctx = _GoContext()
+
+        def cancel():
+            ctx.cancelled = True
+
+        return (ctx, cancel)
+
+
+class Scheduler:
+    """Cooperative concurrency for one interpreted program: a fake
+    monotonic clock plus a run queue for ``go`` statements.  Goroutines
+    run when the current flow yields (``time.Sleep``); after the queue
+    drains, registered hooks fire — the envtest-world fake uses one to
+    pump reconcile requests, playing the role controller-runtime's
+    workqueue threads play under a real ``mgr.Start``."""
+
+    def __init__(self):
+        self.now_ns = 0
+        self.queue: list = []   # (interp, callee, args)
+        self.hooks: list = []   # callables(scheduler)
+
+    def spawn(self, interp, callee, args):
+        self.queue.append((interp, callee, args))
+
+    def yield_point(self):
+        while self.queue:
+            interp, callee, args = self.queue.pop(0)
+            interp.call_value(callee, *args)
+        for hook in list(self.hooks):
+            hook(self)
+
+    def sleep(self, duration_ns):
+        self.now_ns += max(int(duration_ns), 0)
+        self.yield_point()
+
+
+class _GoTime:
+    """A time.Time over the scheduler's fake clock."""
+
+    def __init__(self, ns: int):
+        self.ns = ns
+
+    def Add(self, d):
+        return _GoTime(self.ns + d)
+
+    def Sub(self, other):
+        return self.ns - other.ns
+
+    def After(self, other):
+        return self.ns > other.ns
+
+    def Before(self, other):
+        return self.ns < other.ns
+
+    def IsZero(self):
+        return self.ns == 0
+
+    def Unix(self):
+        return self.ns // (1000 * 1000 * 1000)
+
 
 class _TimeModule:
+    """Constants plus a fake clock: Now/Sleep run against the
+    scheduler, so emitted polling loops (deadline := time.Now().Add(...)
+    ... time.Sleep(...)) terminate deterministically."""
+
     Nanosecond = 1
     Microsecond = 1000
     Millisecond = 1000 * 1000
     Second = 1000 * 1000 * 1000
     Minute = 60 * 1000 * 1000 * 1000
     Hour = 3600 * 1000 * 1000 * 1000
+    Duration = TypeRef("Duration")
+
+    def __init__(self, sched: "Scheduler | None" = None):
+        self.sched = sched or Scheduler()
+
+    def Now(self):
+        return _GoTime(self.sched.now_ns)
+
+    def Sleep(self, d):
+        self.sched.sleep(d)
+
+    def Since(self, t):
+        return self.sched.now_ns - t.ns
+
+
+class _OsModule:
+    """The os surface the emitted tests touch: Exit unwinds without
+    running defers (Go semantics)."""
+
+    @staticmethod
+    def Exit(code):
+        raise GoExit(code)
+
+    @staticmethod
+    def Getenv(name):
+        return ""
+
+
+class _FilepathModule:
+    @staticmethod
+    def Join(*parts):
+        import os as _os
+
+        return _os.path.join(*parts)
+
+
+class _ZapModule:
+    """sigs.k8s.io/controller-runtime/pkg/log/zap."""
+
+    @staticmethod
+    def New(*opts):
+        return _FakeLogger()
+
+    @staticmethod
+    def UseDevMode(enabled):
+        return ("devmode", enabled)
+
+
+class _FakeScheme:
+    """A runtime.Scheme stand-in: kinds arrive via the emitted
+    AddToScheme funcs (scheme.Builder values), so a suite that forgets
+    registration leaves ``registered`` empty — and the fake apiserver
+    then refuses its objects, like a real client would."""
+
+    def __init__(self):
+        self.registered: set = set()
+
+
+class _ClientGoSchemeModule:
+    """k8s.io/client-go/kubernetes/scheme: the process-global Scheme
+    the emitted suite registers its group-versions into."""
+
+    def __init__(self):
+        self.Scheme = _FakeScheme()
+
+
+class _SchemeBuilderCls:
+    """sigs.k8s.io/controller-runtime/pkg/scheme Builder: collects the
+    kinds Register is given; AddToScheme publishes them into the target
+    scheme.  Built as a native class so the emitted groupversion_info
+    package values (SchemeBuilder, AddToScheme) evaluate for real."""
+
+    def __init__(self):
+        self.GroupVersion = None
+        self.kinds: list = []
+
+    def Register(self, *objs):
+        for obj in objs:
+            if isinstance(obj, GoStruct):
+                self.kinds.append(obj.tname)
+        return self
+
+    def AddToScheme(self, scheme):
+        if isinstance(scheme, _FakeScheme):
+            scheme.registered.update(self.kinds)
+        return None
+
+
+class _SchemeBuilderModule:
+    Builder = _SchemeBuilderCls
 
 
 class _StructModule:
@@ -632,12 +827,20 @@ class _ClientModule:
     ForceOwnership = "client.ForceOwnership"
     FieldOwner = TypeRef("FieldOwner")  # conversion: FieldOwner(name)
     Client = TypeRef("Client")
+    Options = TypeRef("Options")
 
     @staticmethod
     def IgnoreNotFound(err):
         if isinstance(err, GoError) and err.not_found:
             return None
         return err
+
+    @staticmethod
+    def ObjectKeyFromObject(obj):
+        return GoStruct("NamespacedName", {
+            "Namespace": obj.GetNamespace(),
+            "Name": obj.GetName(),
+        })
 
 
 class _FakeLogger:
@@ -684,9 +887,15 @@ class _FakeBuilder:
 
     def Build(self, reconciler):
         controller = _FakeController()
+        register = getattr(self.mgr, "RegisterController", None)
+        if callable(register):
+            register(getattr(self, "forObject", None), reconciler)
         return (controller, None)
 
     def Complete(self, reconciler):
+        register = getattr(self.mgr, "RegisterController", None)
+        if callable(register):
+            register(getattr(self, "forObject", None), reconciler)
         return None
 
 
@@ -735,6 +944,10 @@ class _LogfModule:
     def FromContext(ctx):
         return _FakeLogger()
 
+    @staticmethod
+    def SetLogger(logger):
+        return None
+
 
 class _CtrlModule:
     """sigs.k8s.io/controller-runtime surface the emitted code uses at
@@ -744,6 +957,7 @@ class _CtrlModule:
 
     Result = TypeRef("Result")
     Request = TypeRef("Request")
+    Options = TypeRef("Options")
 
     def __init__(self):
         self.Log = _FakeLogger()
@@ -793,16 +1007,24 @@ class _CtrlModule:
         return None
 
 
-def default_natives() -> dict:
+def default_natives(sched: "Scheduler | None" = None) -> dict:
     """Native modules keyed by import path."""
+    if sched is None:
+        sched = Scheduler()
     return {
+        "os": _OsModule,
+        "path/filepath": _FilepathModule,
+        "k8s.io/client-go/rest": _StructModule("Config"),
+        "k8s.io/client-go/kubernetes/scheme": _ClientGoSchemeModule(),
+        "sigs.k8s.io/controller-runtime/pkg/scheme": _SchemeBuilderModule,
+        "sigs.k8s.io/controller-runtime/pkg/log/zap": _ZapModule,
         "k8s.io/apimachinery/pkg/apis/meta/v1/unstructured":
             _UnstructuredModule,
         "k8s.io/apimachinery/pkg/api/errors": _ApiErrorsModule,
         "errors": _ErrorsModule,
         "fmt": _FmtModule,
         "hash/fnv": _FnvModule,
-        "time": _TimeModule,
+        "time": _TimeModule(sched),
         "k8s.io/apimachinery/pkg/types": _StructModule("NamespacedName"),
         "k8s.io/apimachinery/pkg/runtime/schema": _SchemaModule,
         "k8s.io/apimachinery/pkg/api/meta": _MetaModule,
@@ -838,8 +1060,12 @@ class Interp:
 
     def __init__(self, natives: dict | None = None,
                  methods: dict | None = None,
-                 embeds: dict | None = None):
-        self.natives = natives if natives is not None else default_natives()
+                 embeds: dict | None = None,
+                 sched: "Scheduler | None" = None):
+        self.sched = sched if sched is not None else Scheduler()
+        self.natives = (
+            natives if natives is not None else default_natives(self.sched)
+        )
         self.funcs: dict[str, tuple] = {}     # name -> (fn, scan)
         # (tname, name) -> (fn, scan); pass a shared dict to link the
         # per-package interpreters of one project, so a method declared
@@ -858,6 +1084,8 @@ class Interp:
         )
         self.scans: list = []
         self._pending_values: list = []
+        self.inits: list = []       # package init funcs, in load order
+        self.init_errors: list = []
 
     # -- loading ----------------------------------------------------------
 
@@ -872,6 +1100,12 @@ class Interp:
             if fn["body"] is None:
                 continue
             if fn["recv"] is None:
+                if fn["name"] == "init":
+                    # Go allows any number of init funcs per package and
+                    # runs them all at import; keep them out of the
+                    # name-keyed registry (they would collide there)
+                    self.inits.append((fn, scan))
+                    continue
                 self.funcs[fn["name"]] = (fn, scan)
             else:
                 base = _recv_base(fn["recv"][1])
@@ -887,9 +1121,14 @@ class Interp:
                         names.append(idents[-1])
                 self.embeds[td["name"]] = names
         self.scans.append(scan)
-        # package-level consts/vars with initializers
+        # package-level consts/vars with initializers; uninitialized
+        # package vars (var cfg *rest.Config) get their zero value so
+        # cross-function assignments through them work (see
+        # _write_target's package-var branch)
         for name, type_span, init_span in scan.value_inits:
             if init_span is None:
+                if name != "_":
+                    self.consts.setdefault(name, None)
                 continue
             self._pending_values.append((scan, name, init_span))
         if not defer_values:
@@ -907,6 +1146,8 @@ class Interp:
             for scan, name, init_span in pending:
                 try:
                     self.consts[name] = self._eval_span(scan, init_span)
+                except GoPanic:
+                    raise  # a real panic, not an unresolved-name retry
                 except (GoInterpError, KeyError):
                     remaining.append((scan, name, init_span))
             if len(remaining) == len(pending):
@@ -926,6 +1167,21 @@ class Interp:
                     defer_values=True,
                 )
         self.eval_pending_values()
+        self.run_inits()
+
+    def run_inits(self) -> None:
+        """Run package init funcs (Go import semantics).  An init whose
+        body leaves the interpreter subset is skipped, like an
+        unevaluable package value — the scheme registrations the
+        emitted suites depend on are well inside the subset."""
+        inits, self.inits = self.inits, []
+        for fn, scan in inits:
+            try:
+                self._invoke(fn, scan, None, [])
+            except GoPanic:
+                raise  # Go crashes the program on an init panic
+            except GoInterpError as exc:
+                self.init_errors.append((scan.path, str(exc)))
 
     def _eval_span(self, scan, span) -> object:
         ev = _Eval(self, scan, Env())
@@ -966,7 +1222,14 @@ class Interp:
         try:
             ev.exec_block(scan.toks, lo, hi, env)
         except _Return as ret:
+            ev.run_defers()
             return ret.values
+        except GoExit:
+            raise  # os.Exit skips defers, matching Go
+        except BaseException:
+            ev.run_defers()
+            raise
+        ev.run_defers()
         return None
 
 
@@ -1061,6 +1324,12 @@ class _Eval:
         self.interp = interp
         self.scan = scan
         self.env = env
+        self.defers: list = []  # (callee, args), run LIFO at fn exit
+
+    def run_defers(self):
+        while self.defers:
+            callee, args = self.defers.pop()
+            self._call_value(callee, args)
 
     # -- name resolution --------------------------------------------------
 
@@ -1115,13 +1384,42 @@ class _Eval:
             if t.value == "var":
                 return self._stmt_var(toks, i, hi, env)
             if t.value == "defer" or t.value == "go":
-                raise GoInterpError(f"unsupported statement: {t.value}")
+                return self._stmt_defer_go(toks, i, hi, env,
+                                           is_go=(t.value == "go"))
             raise GoInterpError(f"unsupported keyword {t.value!r}")
         if t.kind == OP and t.value == "{":
             lo2, hi2 = _group_span(toks, i)
             self.exec_block(toks, lo2, hi2, Env(env))
             return hi2 + 1
         return self._simple_stmt(toks, i, hi, env)
+
+    def _stmt_defer_go(self, toks, i, hi, env, is_go: bool) -> int:
+        """``defer f(args)`` / ``go f(args)``: Go evaluates the callee
+        and arguments NOW; the call itself is suspended — onto the
+        function's defer stack (LIFO at exit) or the scheduler's run
+        queue (next yield point)."""
+        end = self._stmt_end(toks, i + 1, hi)
+        close = end - 1
+        if not (toks[close].kind == OP and toks[close].value == ")"):
+            raise GoInterpError(f"unsupported {'go' if is_go else 'defer'}")
+        depth = 0
+        j = close
+        while j > i:
+            t = toks[j]
+            if t.kind == OP and t.value in ")]}":
+                depth += 1
+            elif t.kind == OP and t.value in "([{":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        callee = self._eval_range(toks, i + 1, j, env)
+        args = self._call_args(toks, j + 1, close, env)
+        if is_go:
+            self.interp.sched.spawn(self.interp, callee, args)
+        else:
+            self.defers.append((callee, args))
+        return end
 
     def _stmt_end(self, toks, i, hi) -> int:
         """Index of the `;` (or hi) terminating the simple statement at
@@ -1618,8 +1916,16 @@ class _Eval:
     def _write_target(self, target, value, env):
         kind = target[0]
         if kind == "name":
-            if target[1] != "_":
-                env.assign(target[1], value)
+            name = target[1]
+            if name == "_":
+                return
+            # plain `=` to a name not in any local scope writes the
+            # package-level var (Go: TestMain assigning the suite's
+            # shared cfg/k8sClient/testEnv)
+            if not env.has(name) and name in self.interp.consts:
+                self.interp.consts[name] = value
+                return
+            env.assign(name, value)
             return
         if kind == "sel":
             obj, name = target[1], target[2]
@@ -1978,6 +2284,9 @@ class _Eval:
                 base = list(args[0]) if args[0] else []
                 base.extend(args[1:])
                 return base, hi + 1
+            if name == "panic" and _next_is(toks, pos + 1, "("):
+                lo, hi = _group_span(toks, pos + 1)
+                raise GoPanic(self._eval_range(toks, lo, hi, self.env))
             if name == "new" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 tname = toks[lo].value
@@ -2108,7 +2417,14 @@ class _Eval:
             try:
                 ev.exec_block(toks, lo, hi, env)
             except _Return as ret:
+                ev.run_defers()
                 return ret.values
+            except GoExit:
+                raise
+            except BaseException:
+                ev.run_defers()
+                raise
+            ev.run_defers()
             return None
         if isinstance(callee, TypeRef):
             if args:

@@ -418,9 +418,8 @@ def reconcile_test_file(view: WorkloadView) -> FileSpec:
 
     collection_setup = ""
     extra_imports = ""
-    apierrs_import = ""
+    apierrs_import = '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
     if is_component:
-        apierrs_import = '\tapierrs "k8s.io/apimachinery/pkg/api/errors"\n'
         if coll.api_types_import != view.api_types_import:
             extra_imports += (
                 f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
@@ -505,7 +504,10 @@ func Test{kind}Reconcile(t *testing.T) {{
 \t\tt.Fatalf("unable to decode sample: %v", err)
 \t}}
 {ns_default}
-\tif err := k8sClient.Create(ctx, workload); err != nil {{
+\t// tolerate an earlier test of this suite having created the same
+\t// object: a collection kind's sample is pre-created by its
+\t// components' tests (see the collection setup above)
+\tif err := k8sClient.Create(ctx, workload); err != nil && !apierrs.IsAlreadyExists(err) {{
 \t\tt.Fatalf("unable to create workload: %v", err)
 \t}}
 

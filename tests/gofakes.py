@@ -11,10 +11,19 @@ spec-apply and status-writes use different paths.
 """
 
 import copy
+import os
 
+import yaml
+
+from operator_forge.gocheck.gopkg import ProjectRuntime
 from operator_forge.gocheck.interp import (
     GoError,
+    GoExit,
     GoStruct,
+    _ClientModule,
+    _CtrlModule,
+    _FakeScheme,
+    _TimeModule,
     _UnstructuredModule,
 )
 
@@ -118,6 +127,33 @@ class FakeClusterClient:
         self.applied.append(key)
         return None
 
+    def Create(self, ctx, obj):
+        """client.Create: typed workloads join the store (the emitted
+        suite's TestMain path); unstructured children likewise.  When a
+        world is attached, creation is admission-checked (scheme + CRD,
+        like a real apiserver) and enqueues reconcile requests."""
+        world = getattr(self, "world", None)
+        if isinstance(obj, GoStruct) and not hasattr(obj, "Object"):
+            key = (obj.tname, obj.GetNamespace(), obj.GetName())
+            if key in self.workloads:
+                return GoError(
+                    f'{obj.tname.lower()} "{key[2]}" already exists',
+                    already_exists=True,
+                )
+            if world is not None:
+                err = world.admit(obj)
+                if err is not None:
+                    return err
+            self.workloads[key] = obj
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+            return None
+        key = (obj.Object.get("kind"), obj.GetNamespace(), obj.GetName())
+        if key in self.children:
+            return GoError("already exists", already_exists=True)
+        self.children[key] = copy.deepcopy(obj.Object)
+        return None
+
     def Update(self, ctx, obj):
         return None  # workloads are aliased; nothing to write back
 
@@ -155,3 +191,340 @@ class FakeManager:
 
     def GetScheme(self):
         return "scheme"
+
+
+# ---------------------------------------------------------------------------
+# the envtest world: enough of envtest + controller-runtime's manager to
+# run the EMITTED *_test.go files themselves under the interpreter
+
+
+class GoTestFailure(Exception):
+    """t.Fatalf: unwinds the interpreted test function (defers run,
+    like testing.T.FailNow's runtime.Goexit)."""
+
+
+class GoTestT:
+    """The *testing.T surface the emitted tests touch."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.failed = False
+        self.messages: list = []
+
+    def _format(self, fmt, args):
+        from operator_forge.gocheck.interp import _go_format
+
+        return _go_format(fmt, list(args))
+
+    def Fatalf(self, fmt, *args):
+        self.failed = True
+        self.messages.append(self._format(fmt, args))
+        raise GoTestFailure(self.messages[-1])
+
+    def Fatal(self, *args):
+        self.failed = True
+        self.messages.append(" ".join(str(a) for a in args))
+        raise GoTestFailure(self.messages[-1])
+
+    def Errorf(self, fmt, *args):
+        self.failed = True
+        self.messages.append(self._format(fmt, args))
+
+    def Logf(self, fmt, *args):
+        self.messages.append(self._format(fmt, args))
+
+    def Log(self, *args):
+        self.messages.append(" ".join(str(a) for a in args))
+
+    def Helper(self):
+        return None
+
+    def Name(self):
+        return self.name
+
+
+class GoTestM:
+    """The *testing.M TestMain receives: Run executes every emitted
+    Test* function (source order, like go test) and reports the worst
+    exit code."""
+
+    def __init__(self, suite: "EmittedSuite"):
+        self.suite = suite
+        self.ran: list = []
+        self.failures: list = []
+
+    def Run(self):
+        code = 0
+        for name in self.suite.test_names:
+            t = GoTestT(name)
+            try:
+                self.suite.interp.call(name, t)
+            except GoTestFailure:
+                pass
+            self.ran.append(name)
+            if t.failed:
+                code = 1
+                self.failures.append((name, list(t.messages)))
+        return code
+
+
+class FakeRestConfig:
+    """envtest.Start's *rest.Config: only its non-nil-ness matters."""
+
+
+class FakeEnvironment:
+    """envtest.Environment: Start validates CRDDirectoryPaths against
+    the scaffolded project ON DISK (the emitted config/crd/bases must
+    exist and parse) and installs the CRDs' kinds into the world — the
+    fake apiserver then refuses kinds without a CRD, exactly the
+    failure a real envtest run would produce."""
+
+    world: "EnvtestWorld" = None  # bound per world via subclassing
+
+    def __init__(self):
+        self.CRDDirectoryPaths: list = []
+        self.ErrorIfCRDPathMissing = False
+
+    def Start(self):
+        crds = []
+        for rel in self.CRDDirectoryPaths or []:
+            path = rel if os.path.isabs(rel) else os.path.join(
+                self.world.pkg_dir, rel
+            )
+            if not os.path.isdir(path):
+                if self.ErrorIfCRDPathMissing:
+                    return (None, GoError(
+                        f"unable to read CRD directory {rel}"
+                    ))
+                continue
+            for fname in sorted(os.listdir(path)):
+                if not fname.endswith((".yaml", ".yml")):
+                    continue
+                with open(os.path.join(path, fname),
+                          encoding="utf-8") as fh:
+                    for doc in yaml.safe_load_all(fh.read()):
+                        if isinstance(doc, dict) and doc.get("kind") == (
+                            "CustomResourceDefinition"
+                        ):
+                            crds.append(doc)
+        for crd in crds:
+            names = (crd.get("spec") or {}).get("names") or {}
+            kind = names.get("kind")
+            if kind:
+                self.world.installed_kinds.add(kind)
+        self.world.env_started = True
+        return (FakeRestConfig(), None)
+
+    def Stop(self):
+        self.world.env_stopped = True
+        return None
+
+
+class WorldManager(FakeManager):
+    """A ctrl.Manager whose Start performs the informer initial sync
+    (existing objects of watched kinds are enqueued) and whose context
+    gates dispatch — cancelled managers stop reconciling."""
+
+    def __init__(self, world: "EnvtestWorld"):
+        super().__init__(world.client)
+        self.world = world
+        self.registered: list = []  # (kind, reconciler)
+        self.started = False
+        self.start_ctx = None
+
+    def RegisterController(self, for_obj, reconciler):
+        kind = for_obj.tname if isinstance(for_obj, GoStruct) else None
+        self.registered.append((kind, reconciler))
+
+    def Start(self, ctx):
+        self.started = True
+        self.start_ctx = ctx
+        for kind, _reconciler in self.registered:
+            for (k, ns, name) in list(self.world.client.workloads):
+                if k == kind:
+                    self.world.enqueue(kind, ns, name)
+        return None
+
+    @property
+    def active(self) -> bool:
+        ctx = self.start_ctx
+        cancelled = ctx is not None and getattr(ctx, "cancelled", False)
+        return self.started and not cancelled
+
+
+class _WorldCtrlModule(_CtrlModule):
+    def __init__(self, world: "EnvtestWorld"):
+        super().__init__()
+        self.world = world
+
+    def NewManager(self, cfg, opts):
+        if cfg is None:
+            return (None, GoError("must specify Config"))
+        mgr = WorldManager(self.world)
+        self.world.managers.append(mgr)
+        return (mgr, None)
+
+
+class _WorldClientModule(_ClientModule):
+    def __init__(self, world: "EnvtestWorld"):
+        self.world = world
+
+    def New(self, cfg, opts):
+        if cfg is None:
+            return (None, GoError("must provide non-nil rest.Config"))
+        if isinstance(opts, GoStruct):
+            scheme = opts.fields.get("Scheme")
+            if scheme is not None:
+                self.world.client_scheme = scheme
+        return (self.world.client, None)
+
+
+class _WorldEnvtestModule:
+    def __init__(self, world: "EnvtestWorld"):
+        self.Environment = type(
+            "Environment", (FakeEnvironment,), {"world": world}
+        )
+
+
+class EnvtestWorld:
+    """One fake cluster + scheduler wiring for one emitted project:
+    plays the role envtest + controller-runtime play when the
+    reference's CI runs the generated project's tests
+    (reference .github/workflows/test.yaml:106-141)."""
+
+    REQUEUE_ERROR_NS = _TimeModule.Second
+    REQUEUE_IMMEDIATE_NS = _TimeModule.Millisecond
+
+    def __init__(self, proj: str):
+        self.proj = proj
+        self.pkg_dir = proj  # suite under test re-points this
+        self.managers: list = []
+        self.installed_kinds: set = set()
+        self.client_scheme = None
+        self.env_started = False
+        self.env_stopped = False
+        self.pending: list = []  # {due, kind, ns, name}
+        self.reconcile_log: list = []  # (kind, ns, name, result, err)
+        self.runtime = ProjectRuntime(proj, extra_natives={})
+        # override AFTER construction so the world modules see the world
+        self.runtime.natives["sigs.k8s.io/controller-runtime"] = (
+            _WorldCtrlModule(self)
+        )
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/client"
+        ] = _WorldClientModule(self)
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/envtest"
+        ] = _WorldEnvtestModule(self)
+        self.client = FakeClusterClient(self.runtime)
+        self.client.world = self
+        self.call_interp = next(iter(self.runtime.packages.values()))
+        self.runtime.sched.hooks.append(self._pump)
+
+    # -- apiserver admission ----------------------------------------------
+
+    def admit(self, obj: GoStruct):
+        if not self.env_started:
+            return GoError("connection refused: test environment not started")
+        scheme = self.client_scheme
+        if isinstance(scheme, _FakeScheme) and obj.tname not in (
+            scheme.registered
+        ):
+            return GoError(
+                f"no kind is registered for the type {obj.tname}"
+            )
+        if obj.tname not in self.installed_kinds:
+            return GoError(
+                f'no matches for kind "{obj.tname}": CRD not installed'
+            )
+        return None
+
+    # -- the reconcile pump ------------------------------------------------
+
+    def enqueue(self, kind, ns, name, delay_ns: int = 0):
+        self.pending.append({
+            "due": self.runtime.sched.now_ns + delay_ns,
+            "kind": kind, "ns": ns, "name": name,
+        })
+
+    def _reconciler_for(self, kind):
+        for mgr in reversed(self.managers):
+            if not mgr.active:
+                continue
+            for k, reconciler in mgr.registered:
+                if k == kind:
+                    return reconciler
+        return None
+
+    def _pump(self, sched):
+        progressed = True
+        while progressed:
+            progressed = False
+            for item in list(self.pending):
+                if item["due"] > sched.now_ns:
+                    continue
+                if item not in self.pending:
+                    continue  # a reentrant pump already took it
+                reconciler = self._reconciler_for(item["kind"])
+                if reconciler is None:
+                    continue  # no active manager: stays queued
+                self.pending.remove(item)
+                progressed = True
+                req = GoStruct("Request", {
+                    "NamespacedName": GoStruct("NamespacedName", {
+                        "Namespace": item["ns"], "Name": item["name"],
+                    }),
+                })
+                out = self.call_interp.call_method(
+                    reconciler, "Reconcile", None, req
+                )
+                result, err = out if isinstance(out, tuple) else (out, None)
+                self.reconcile_log.append(
+                    (item["kind"], item["ns"], item["name"], result, err)
+                )
+                delay = None
+                if err is not None:
+                    delay = self.REQUEUE_ERROR_NS
+                elif isinstance(result, GoStruct):
+                    if result.fields.get("Requeue"):
+                        delay = self.REQUEUE_IMMEDIATE_NS
+                    elif result.fields.get("RequeueAfter"):
+                        delay = result.fields["RequeueAfter"]
+                if delay is not None:
+                    self.enqueue(
+                        item["kind"], item["ns"], item["name"], delay
+                    )
+
+
+class EmittedSuite:
+    """Loads one emitted package's *_test.go files into its package
+    interpreter and runs them through TestMain, the way ``go test``
+    would."""
+
+    def __init__(self, world: EnvtestWorld, rel: str):
+        self.world = world
+        self.rel = rel
+        world.pkg_dir = os.path.join(world.proj, rel)
+        self.interp = world.runtime.interp(rel)
+        for fname in sorted(os.listdir(world.pkg_dir)):
+            if not fname.endswith("_test.go"):
+                continue
+            path = os.path.join(world.pkg_dir, fname)
+            with open(path, encoding="utf-8") as fh:
+                self.interp.load_source(fh.read(), path)
+        self.interp.run_inits()  # test-file init funcs run at import too
+        self.test_names = [
+            name for name in self.interp.funcs
+            if name.startswith("Test") and name != "TestMain"
+        ]
+
+    def run(self) -> tuple:
+        """Execute TestMain; returns (exit_code, m)."""
+        m = GoTestM(self)
+        if "TestMain" not in self.interp.funcs:
+            return (m.Run(), m)
+        try:
+            self.interp.call("TestMain", m)
+        except GoExit as exc:
+            return (exc.code, m)
+        return (1 if m.failures else 0, m)

@@ -218,8 +218,6 @@ class TestAdmissionWiring:
         adds SetupWebhookWithManager for the same (hub) type — the
         stale fragment must be removed, not left to the builder's
         path-dedup behavior."""
-        import shutil
-
         work = str(tmp_path / "w")
         proj = oracle.scaffold_standalone(work)
         config = os.path.join(proj, "workload.yaml")
@@ -257,8 +255,6 @@ class TestAdmissionWiring:
         """The other route to the same staleness: webhooks recorded in
         PROJECT re-sync through `create api` — a hub-version re-scaffold
         must strip the old conversion registration too."""
-        import shutil
-
         work = str(tmp_path / "w")
         proj = oracle.scaffold_standalone(work)
         config = os.path.join(proj, "workload.yaml")
