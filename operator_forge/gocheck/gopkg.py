@@ -356,6 +356,24 @@ class ProjectRuntime:
             raise GoInterpError(f"package {rel!r} not loaded from {self.root}")
         return GoPackage(self.packages[rel], self.universe)
 
+    def ensure_package(self, rel: str) -> Interp:
+        """The linked interpreter for *rel*, creating an empty one for
+        directories the load pass skips (test-only packages such as
+        test/e2e, or the root main package): callers then load the
+        sources they want into it (load_dir skips _test.go; main.go is
+        loaded by path)."""
+        if rel not in self.packages:
+            interp = Interp(natives=self.natives, methods=self.methods,
+                            embeds=self.embeds, sched=self.sched)
+            self.packages[rel] = interp
+        return self.packages[rel]
+
+    def register_types(self, rel: str) -> None:
+        """Publish struct shapes loaded into *rel* AFTER ensure_package
+        (add_interp snapshots scans, so late load_source calls need a
+        re-registration for universe-backed decoding)."""
+        self.universe.add_interp(self.packages[rel])
+
     def interp(self, rel: str) -> Interp:
         if rel not in self.packages:
             raise GoInterpError(f"package {rel!r} not loaded from {self.root}")

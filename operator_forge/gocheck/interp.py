@@ -341,12 +341,18 @@ class _UnstructuredModule:
         def SetOwnerReferences(self, refs):
             self.Object.setdefault("metadata", {})["ownerReferences"] = refs
 
+        def SetKind(self, kind):
+            self.Object["kind"] = kind
+
         def DeepCopy(self):
             import copy
 
             dup = type(self)()
             dup.Object = copy.deepcopy(self.Object)
             return dup
+
+        def DeepCopyObject(self):
+            return self.DeepCopy()
 
     @staticmethod
     def NestedInt64(obj, *path):
@@ -472,6 +478,14 @@ class _FmtModule:
     @staticmethod
     def Sprintf(fmt, *args):
         return _go_format(fmt, list(args))
+
+    @staticmethod
+    def Println(*args):
+        return None
+
+    @staticmethod
+    def Printf(fmt, *args):
+        return None
 
     @staticmethod
     def Errorf(fmt, *args):
@@ -735,6 +749,9 @@ class _OsModule:
     """The os surface the emitted tests touch: Exit unwinds without
     running defers (Go semantics)."""
 
+    Stderr = object()
+    Stdout = object()
+
     @staticmethod
     def Exit(code):
         raise GoExit(code)
@@ -742,6 +759,91 @@ class _OsModule:
     @staticmethod
     def Getenv(name):
         return ""
+
+
+class _FlagModule:
+    """Command-line flag registration in interpreted main.go: pointers
+    are identity-transparent here, so Var-style registration cannot
+    write the declared default back through *p — bound variables KEEP
+    THEIR ZERO VALUES (Go would assign the default).  Emitted main.go
+    only threads these values into manager options the fake ignores;
+    code that branches on a flag default would take the zero-value
+    path."""
+
+    CommandLine = object()
+
+    @staticmethod
+    def StringVar(p, name, value, usage):
+        return None
+
+    @staticmethod
+    def BoolVar(p, name, value, usage):
+        return None
+
+    @staticmethod
+    def IntVar(p, name, value, usage):
+        return None
+
+    @staticmethod
+    def DurationVar(p, name, value, usage):
+        return None
+
+    @staticmethod
+    def Parse():
+        return None
+
+
+class _StringsModule:
+    @staticmethod
+    def Split(s, sep):
+        return s.split(sep)
+
+    @staticmethod
+    def Contains(s, substr):
+        return substr in s
+
+    @staticmethod
+    def HasPrefix(s, prefix):
+        return s.startswith(prefix)
+
+    @staticmethod
+    def HasSuffix(s, suffix):
+        return s.endswith(suffix)
+
+    @staticmethod
+    def Join(parts, sep):
+        return sep.join(parts)
+
+    @staticmethod
+    def ToLower(s):
+        return s.lower()
+
+    @staticmethod
+    def ToUpper(s):
+        return s.upper()
+
+    @staticmethod
+    def TrimSpace(s):
+        return s.strip()
+
+    @staticmethod
+    def ReplaceAll(s, old, new):
+        return s.replace(old, new)
+
+
+class _UtilRuntimeModule:
+    """k8s.io/apimachinery/pkg/util/runtime."""
+
+    @staticmethod
+    def Must(err):
+        if err is not None:
+            raise GoPanic(err)
+
+
+class _HealthzModule:
+    """sigs.k8s.io/controller-runtime/pkg/healthz."""
+
+    Ping = "healthz.Ping"
 
 
 class _FilepathModule:
@@ -752,8 +854,21 @@ class _FilepathModule:
         return _os.path.join(*parts)
 
 
+class _ZapOptions:
+    """zap.Options{} composite in main.go; BindFlags is a no-op (the
+    interpreted run takes the defaults)."""
+
+    def __init__(self):
+        self.Development = False
+
+    def BindFlags(self, flagset):
+        return None
+
+
 class _ZapModule:
     """sigs.k8s.io/controller-runtime/pkg/log/zap."""
+
+    Options = _ZapOptions
 
     @staticmethod
     def New(*opts):
@@ -762,6 +877,10 @@ class _ZapModule:
     @staticmethod
     def UseDevMode(enabled):
         return ("devmode", enabled)
+
+    @staticmethod
+    def UseFlagOptions(opts):
+        return opts
 
 
 class _FakeScheme:
@@ -774,12 +893,74 @@ class _FakeScheme:
         self.registered: set = set()
 
 
+# kinds client-go's scheme package registers at init (the builtin API
+# groups a real cluster serves without CRDs)
+BUILTIN_KINDS = frozenset({
+    "Namespace", "Pod", "Service", "ServiceAccount", "ConfigMap",
+    "Secret", "PersistentVolumeClaim", "PersistentVolume", "Node",
+    "Endpoints", "Event", "LimitRange", "ResourceQuota",
+    "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+    "Job", "CronJob", "Ingress", "IngressClass", "NetworkPolicy",
+    "Role", "RoleBinding", "ClusterRole", "ClusterRoleBinding",
+    "HorizontalPodAutoscaler", "PodDisruptionBudget",
+    "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
+    "StorageClass", "PriorityClass",
+})
+
+
 class _ClientGoSchemeModule:
     """k8s.io/client-go/kubernetes/scheme: the process-global Scheme
+    (builtins pre-registered by the package's init, like client-go)
     the emitted suite registers its group-versions into."""
 
     def __init__(self):
         self.Scheme = _FakeScheme()
+        self.Scheme.registered |= BUILTIN_KINDS
+
+    @staticmethod
+    def AddToScheme(target):
+        # main.go's clientgoscheme.AddToScheme(scheme): installs the
+        # builtin API groups into a fresh runtime.NewScheme()
+        if isinstance(target, _FakeScheme):
+            target.registered |= BUILTIN_KINDS
+        return None
+
+
+class _K8sRuntimeModule:
+    """k8s.io/apimachinery/pkg/runtime."""
+
+    Object = TypeRef("Object")
+
+    @staticmethod
+    def NewScheme():
+        return _FakeScheme()
+
+
+class _RestModule:
+    """k8s.io/client-go/rest: the config type plus the warning-writer
+    registration main.go performs."""
+
+    Config = TypeRef("Config")
+    WarningWriterOptions = TypeRef("WarningWriterOptions")
+
+    @staticmethod
+    def SetDefaultWarningHandler(handler):
+        return None
+
+    @staticmethod
+    def NewWarningWriter(writer, opts):
+        return GoStruct("WarningWriter", {"Options": opts})
+
+
+class _CoreV1Module:
+    """k8s.io/api/core/v1: typed kinds the emitted e2e suite builds
+    directly (Namespace gets the metav1 accessors via GoObject)."""
+
+    Namespace = TypeFactory(
+        "Namespace", make=lambda fields: GoObject("Namespace", fields)
+    )
+    PodLogOptions = TypeRef("PodLogOptions")
+    Container = TypeRef("Container")
 
 
 class _SchemeBuilderCls:
@@ -828,6 +1009,9 @@ class _ClientModule:
     FieldOwner = TypeRef("FieldOwner")  # conversion: FieldOwner(name)
     Client = TypeRef("Client")
     Options = TypeRef("Options")
+    # client.ObjectKey is an alias of types.NamespacedName; the same
+    # tname keeps the fake client's Get/List key handling uniform
+    ObjectKey = TypeRef("NamespacedName")
 
     @staticmethod
     def IgnoreNotFound(err):
@@ -971,6 +1155,14 @@ class _CtrlModule:
         return _FakeWebhookBuilder(mgr)
 
     @staticmethod
+    def SetLogger(logger):
+        return None
+
+    @staticmethod
+    def SetupSignalHandler():
+        return _GoContext()
+
+    @staticmethod
     def SetControllerReference(owner, resource, scheme):
         kind = owner.tname if isinstance(owner, GoStruct) else (
             type(owner).__name__)
@@ -1014,8 +1206,14 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
     return {
         "os": _OsModule,
         "path/filepath": _FilepathModule,
-        "k8s.io/client-go/rest": _StructModule("Config"),
+        "flag": _FlagModule,
+        "strings": _StringsModule,
+        "k8s.io/client-go/rest": _RestModule,
         "k8s.io/client-go/kubernetes/scheme": _ClientGoSchemeModule(),
+        "k8s.io/apimachinery/pkg/runtime": _K8sRuntimeModule,
+        "k8s.io/apimachinery/pkg/util/runtime": _UtilRuntimeModule,
+        "k8s.io/api/core/v1": _CoreV1Module,
+        "sigs.k8s.io/controller-runtime/pkg/healthz": _HealthzModule,
         "sigs.k8s.io/controller-runtime/pkg/scheme": _SchemeBuilderModule,
         "sigs.k8s.io/controller-runtime/pkg/log/zap": _ZapModule,
         "k8s.io/apimachinery/pkg/apis/meta/v1/unstructured":
@@ -1612,6 +1810,20 @@ class _Eval:
     def _stmt_switch(self, toks, i, hi, env) -> int:
         segments, brace = self._clause_parts(toks, i + 1)
         scope = Env(env)
+        # type switch: [init;] [name :=] expr.(type)
+        ts = self._type_switch_parts(
+            toks, segments[-1]
+        ) if segments else None
+        if ts is not None:
+            if len(segments) == 2:
+                self._simple_stmt(
+                    toks, segments[0][0], segments[0][1], scope
+                )
+            bind_name, expr_lo, expr_hi = ts
+            value = self._eval_range(toks, expr_lo, expr_hi, scope)
+            return self._exec_type_switch(
+                toks, brace, value, bind_name, scope
+            )
         subject = True
         if len(segments) == 2:
             init_lo, init_hi = segments[0]
@@ -1625,8 +1837,40 @@ class _Eval:
         else:
             tagless = True
         blo, bhi = _group_span(toks, brace)
-        # collect case clauses
-        clauses = []  # (exprs-span-list or None for default, stmts_lo, stmts_hi)
+        clauses = self._switch_clauses(toks, blo, bhi)
+        default_clause = None
+        for exprs, slo, shi in clauses:
+            if exprs is None:
+                default_clause = (slo, shi)
+                continue
+            values = self._expr_list(toks, exprs[0], exprs[1], scope)
+            matched = False
+            for value in values:
+                if tagless:
+                    matched = _truthy(value)
+                else:
+                    matched = _go_eq(subject, value)
+                if matched:
+                    break
+            if matched:
+                try:
+                    self.exec_block(toks, slo, shi, Env(scope))
+                except _Break:
+                    pass
+                return bhi + 1
+        if default_clause is not None:
+            try:
+                self.exec_block(
+                    toks, default_clause[0], default_clause[1], Env(scope)
+                )
+            except _Break:
+                pass
+        return bhi + 1
+
+    def _switch_clauses(self, toks, blo, bhi) -> list:
+        """Collect a switch body's case clauses as
+        (exprs-span or None for default, stmts_lo, stmts_hi)."""
+        clauses: list = []
         j = blo
         current = None
         while j <= bhi:
@@ -1655,30 +1899,71 @@ class _Eval:
                 j = _skip_group_from(toks, j)
                 continue
             j += 1
+        return clauses
+
+    @staticmethod
+    def _type_switch_parts(toks, segment):
+        """(bind_name, expr_lo, expr_hi) when the clause segment is a
+        type-switch guard ``[name :=] expr.(type)``, else None."""
+        lo, hi = segment
+        if hi - lo < 4:
+            return None
+        if not (
+            toks[hi - 1].kind == OP and toks[hi - 1].value == ")"
+            and toks[hi - 2].kind == KEYWORD and toks[hi - 2].value == "type"
+            and toks[hi - 3].kind == OP and toks[hi - 3].value == "("
+            and toks[hi - 4].kind == OP and toks[hi - 4].value == "."
+        ):
+            return None
+        bind_name = None
+        expr_lo = lo
+        if (
+            toks[lo].kind == IDENT
+            and lo + 1 < hi
+            and toks[lo + 1].kind == OP
+            and toks[lo + 1].value == ":="
+        ):
+            bind_name = toks[lo].value
+            expr_lo = lo + 2
+        return (bind_name, expr_lo, hi - 4)
+
+    def _exec_type_switch(self, toks, brace, value, bind_name, scope) -> int:
+        """Run a type switch: case lists are TYPES; the guard's binding
+        takes the subject value in the matching case's scope."""
+        blo, bhi = _group_span(toks, brace)
+        clauses = self._switch_clauses(toks, blo, bhi)
         default_clause = None
         for exprs, slo, shi in clauses:
             if exprs is None:
                 default_clause = (slo, shi)
                 continue
-            values = self._expr_list(toks, exprs[0], exprs[1], scope)
             matched = False
-            for value in values:
-                if tagless:
-                    matched = _truthy(value)
+            for tlo, thi in _split_commas(toks, exprs[0], exprs[1]):
+                type_text = "".join(t.value for t in toks[tlo:thi])
+                if type_text == "nil":
+                    matched = value is None
                 else:
-                    matched = _go_eq(subject, value)
+                    matched = value is not None and _type_assert(
+                        value, type_text
+                    )
                 if matched:
                     break
             if matched:
+                case_env = Env(scope)
+                if bind_name:
+                    case_env.define(bind_name, value)
                 try:
-                    self.exec_block(toks, slo, shi, Env(scope))
+                    self.exec_block(toks, slo, shi, case_env)
                 except _Break:
                     pass
                 return bhi + 1
         if default_clause is not None:
+            case_env = Env(scope)
+            if bind_name:
+                case_env.define(bind_name, value)
             try:
                 self.exec_block(
-                    toks, default_clause[0], default_clause[1], Env(scope)
+                    toks, default_clause[0], default_clause[1], case_env
                 )
             except _Break:
                 pass
@@ -2521,6 +2806,16 @@ def _go_index(obj, key):
     return obj[key]
 
 
+# interface types the emitted code asserts through: anything non-nil
+# satisfies them here (the vet gate, not the interpreter, checks method
+# sets)
+_INTERFACE_TYPES = frozenset({
+    "interface{}", "any", "error",
+    "client.Object", "client.ObjectList",
+    "runtime.Object", "metav1.Object", "schema.ObjectKind",
+})
+
+
 def _type_assert(value, type_text: str) -> bool:
     if type_text in ("map[string]interface{}", "map[string]any"):
         return isinstance(value, dict)
@@ -2537,7 +2832,14 @@ def _type_assert(value, type_text: str) -> bool:
         # possibly pointered) type's base name against the value's
         base = type_text.lstrip("*").split(".")[-1]
         return value.tname == base
-    return value is not None
+    if type_text in _INTERFACE_TYPES:
+        return value is not None
+    # a concrete named type on a native value (e.g.
+    # *unstructured.Unstructured): match the backing class name; a
+    # mismatched concrete assertion must FAIL, or type switches would
+    # dispatch the first named case for any opaque value
+    base = type_text.lstrip("*").split(".")[-1]
+    return value is not None and type(value).__name__ == base
 
 
 class _AssertResult(tuple):

@@ -17,6 +17,7 @@ import yaml
 
 from operator_forge.gocheck.gopkg import ProjectRuntime
 from operator_forge.gocheck.interp import (
+    BUILTIN_KINDS,
     GoError,
     GoExit,
     GoStruct,
@@ -24,6 +25,7 @@ from operator_forge.gocheck.interp import (
     _CtrlModule,
     _FakeScheme,
     _TimeModule,
+    _Timestamp,
     _UnstructuredModule,
 )
 
@@ -155,13 +157,48 @@ class FakeClusterClient:
         return None
 
     def Update(self, ctx, obj):
-        return None  # workloads are aliased; nothing to write back
+        # workloads are aliased, so field changes are already visible;
+        # what Update contributes is apiserver behavior: the update
+        # EVENT (enqueue) and finalizer-release removal of a
+        # deletion-marked object
+        world = getattr(self, "world", None)
+        if isinstance(obj, GoStruct) and not hasattr(obj, "Object"):
+            key = (obj.tname, obj.GetNamespace(), obj.GetName())
+            stored = self.workloads.get(key)
+            if stored is None:
+                return GoError(f"{obj.tname} not found", not_found=True)
+            ts = stored.fields.get("DeletionTimestamp")
+            deleting = ts is not None and not ts.IsZero()
+            if deleting and not stored.GetFinalizers():
+                del self.workloads[key]
+                return None
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+        return None
 
     def Delete(self, ctx, obj):
+        world = getattr(self, "world", None)
         if hasattr(obj, "Object"):
             key = (obj.Object.get("kind"), obj.GetNamespace(), obj.GetName())
-            self.children.pop(key, None)
+            data = self.children.pop(key, None)
+            if data is None:
+                return GoError("child not found", not_found=True)
             self.deleted.append(key)
+            if world is not None:
+                world.notify_child_deleted(data)
+            return None
+        key = (obj.tname, obj.GetNamespace(), obj.GetName())
+        stored = self.workloads.get(key)
+        if stored is None:
+            return GoError(f"{obj.tname} not found", not_found=True)
+        if stored.GetFinalizers():
+            # finalizers pin the object: mark deletion and notify, the
+            # way a real apiserver turns delete into an update event
+            stored.fields["DeletionTimestamp"] = _Timestamp(zero=False)
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+        else:
+            del self.workloads[key]
         return None
 
     def Status(self):
@@ -286,7 +323,6 @@ class FakeEnvironment:
         self.ErrorIfCRDPathMissing = False
 
     def Start(self):
-        crds = []
         for rel in self.CRDDirectoryPaths or []:
             path = rel if os.path.isabs(rel) else os.path.join(
                 self.world.pkg_dir, rel
@@ -297,21 +333,7 @@ class FakeEnvironment:
                         f"unable to read CRD directory {rel}"
                     ))
                 continue
-            for fname in sorted(os.listdir(path)):
-                if not fname.endswith((".yaml", ".yml")):
-                    continue
-                with open(os.path.join(path, fname),
-                          encoding="utf-8") as fh:
-                    for doc in yaml.safe_load_all(fh.read()):
-                        if isinstance(doc, dict) and doc.get("kind") == (
-                            "CustomResourceDefinition"
-                        ):
-                            crds.append(doc)
-        for crd in crds:
-            names = (crd.get("spec") or {}).get("names") or {}
-            kind = names.get("kind")
-            if kind:
-                self.world.installed_kinds.add(kind)
+            self.world.install_crds(path)
         self.world.env_started = True
         return (FakeRestConfig(), None)
 
@@ -345,6 +367,12 @@ class WorldManager(FakeManager):
                     self.world.enqueue(kind, ns, name)
         return None
 
+    def AddHealthzCheck(self, name, check):
+        return None
+
+    def AddReadyzCheck(self, name, check):
+        return None
+
     @property
     def active(self) -> bool:
         ctx = self.start_ctx
@@ -363,6 +391,14 @@ class _WorldCtrlModule(_CtrlModule):
         mgr = WorldManager(self.world)
         self.world.managers.append(mgr)
         return (mgr, None)
+
+    def GetConfig(self):
+        if not self.world.env_started:
+            return (None, GoError("unable to load in-cluster config"))
+        return (FakeRestConfig(), None)
+
+    def GetConfigOrDie(self):
+        return FakeRestConfig()
 
 
 class _WorldClientModule(_ClientModule):
@@ -403,6 +439,7 @@ class EnvtestWorld:
         self.client_scheme = None
         self.env_started = False
         self.env_stopped = False
+        self.simulate_cluster = False  # builtin controllers (e2e mode)
         self.pending: list = []  # {due, kind, ns, name}
         self.reconcile_log: list = []  # (kind, ns, name, result, err)
         self.runtime = ProjectRuntime(proj, extra_natives={})
@@ -419,7 +456,43 @@ class EnvtestWorld:
         self.client = FakeClusterClient(self.runtime)
         self.client.world = self
         self.call_interp = next(iter(self.runtime.packages.values()))
+        self.runtime.sched.hooks.append(self._simulate_builtins)
         self.runtime.sched.hooks.append(self._pump)
+
+    # -- cluster lifecycle -------------------------------------------------
+
+    def install_crds(self, path: str) -> int:
+        """Install every CRD under *path* (what `make install` or
+        envtest's CRDDirectoryPaths does); returns how many."""
+        count = 0
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith((".yaml", ".yml")):
+                continue
+            with open(os.path.join(path, fname), encoding="utf-8") as fh:
+                for doc in yaml.safe_load_all(fh.read()):
+                    if isinstance(doc, dict) and doc.get("kind") == (
+                        "CustomResourceDefinition"
+                    ):
+                        kind = ((doc.get("spec") or {}).get("names")
+                                or {}).get("kind")
+                        if kind:
+                            self.installed_kinds.add(kind)
+                            count += 1
+        return count
+
+    def start_operator(self):
+        """Interpret the emitted main.go — the `make run` flow the e2e
+        suite's no-deploy mode assumes: flag parsing, scheme assembly,
+        manager construction, reconciler registration, health checks,
+        and the (cooperative) manager start."""
+        interp = self.runtime.ensure_package("<main>")
+        path = os.path.join(self.proj, "main.go")
+        with open(path, encoding="utf-8") as fh:
+            interp.load_source(fh.read(), path)
+        self.runtime.register_types("<main>")
+        interp.run_inits()
+        interp.call("main")
+        return interp
 
     # -- apiserver admission ----------------------------------------------
 
@@ -433,11 +506,41 @@ class EnvtestWorld:
             return GoError(
                 f"no kind is registered for the type {obj.tname}"
             )
-        if obj.tname not in self.installed_kinds:
+        if obj.tname not in BUILTIN_KINDS and obj.tname not in (
+            self.installed_kinds
+        ):
             return GoError(
                 f'no matches for kind "{obj.tname}": CRD not installed'
             )
         return None
+
+    def notify_child_deleted(self, data: dict) -> None:
+        """The owner-watch: deleting an owned child enqueues its
+        controller owner, the way controller-runtime's Owns/Watch
+        wiring turns child events into parent reconciles."""
+        meta = data.get("metadata") or {}
+        ns = meta.get("namespace") or ""
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("controller"):
+                self.enqueue(ref.get("kind"), ns, ref.get("name"))
+
+    def _simulate_builtins(self, sched):
+        """The cluster-side controllers a real e2e run assumes (kubelet,
+        deployment controller...): applied children progress to ready,
+        per the same fields the emitted ready.go consults."""
+        if not self.simulate_cluster:
+            return
+        for (kind, _ns, _name), data in list(self.client.children.items()):
+            if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+                spec = data.get("spec") or {}
+                replicas = spec.get("replicas", 1)
+                data.setdefault("status", {})["readyReplicas"] = replicas
+            elif kind == "DaemonSet":
+                status = data.setdefault("status", {})
+                status["desiredNumberScheduled"] = 1
+                status["numberReady"] = 1
+            elif kind == "Job":
+                data.setdefault("status", {})["succeeded"] = 1
 
     # -- the reconcile pump ------------------------------------------------
 
@@ -505,13 +608,14 @@ class EmittedSuite:
         self.world = world
         self.rel = rel
         world.pkg_dir = os.path.join(world.proj, rel)
-        self.interp = world.runtime.interp(rel)
+        self.interp = world.runtime.ensure_package(rel)
         for fname in sorted(os.listdir(world.pkg_dir)):
             if not fname.endswith("_test.go"):
                 continue
             path = os.path.join(world.pkg_dir, fname)
             with open(path, encoding="utf-8") as fh:
                 self.interp.load_source(fh.read(), path)
+        world.runtime.register_types(rel)
         self.interp.run_inits()  # test-file init funcs run at import too
         self.test_names = [
             name for name in self.interp.funcs
