@@ -148,6 +148,9 @@ class GoObject(GoStruct):
     def GetGeneration(self):
         return self.fields.get("Generation") or 0
 
+    def SetGeneration(self, generation):
+        self.fields["Generation"] = generation
+
     def GetDeletionTimestamp(self):
         return self.fields.get("DeletionTimestamp") or _Timestamp()
 
@@ -341,6 +344,22 @@ class _UnstructuredModule:
         def SetOwnerReferences(self, refs):
             self.Object.setdefault("metadata", {})["ownerReferences"] = refs
 
+        def GetFinalizers(self):
+            return _nested(self.Object, "metadata", "finalizers")[0] or []
+
+        def SetFinalizers(self, finalizers):
+            self.Object.setdefault("metadata", {})["finalizers"] = (
+                finalizers
+            )
+
+        def GetGeneration(self):
+            return _nested(self.Object, "metadata", "generation")[0] or 0
+
+        def SetGeneration(self, generation):
+            self.Object.setdefault("metadata", {})["generation"] = (
+                generation
+            )
+
         def SetKind(self, kind):
             self.Object["kind"] = kind
 
@@ -533,15 +552,36 @@ class _ApiErrorsModule:
         )
 
 
+def _meta_carrier(obj):
+    """The value carrying an object's metav1 accessors: the object
+    itself, or — for a struct embedding a native metadata type (a test
+    workload embedding unstructured.Unstructured) — that embedded
+    value, matching Go's method promotion when a NATIVE (not
+    interpreted) caller invokes the accessor.  A zero-value struct has
+    not materialized its embed yet; create it (Go promotes through
+    zero-value embeds) — code reaching here with a type that embeds
+    nothing metav1-shaped would not compile under Go at all."""
+    if isinstance(obj, GoStruct) and not hasattr(obj, "GetFinalizers"):
+        for value in obj.fields.values():
+            if isinstance(value, _UnstructuredModule.Unstructured):
+                return value
+        carrier = _UnstructuredModule.Unstructured()
+        obj.fields["Unstructured"] = carrier
+        return carrier
+    return obj
+
+
 class _ControllerUtilModule:
     """Finalizer helpers over any fake exposing Get/SetFinalizers."""
 
     @staticmethod
     def ContainsFinalizer(obj, finalizer):
+        obj = _meta_carrier(obj)
         return finalizer in (obj.GetFinalizers() or [])
 
     @staticmethod
     def AddFinalizer(obj, finalizer):
+        obj = _meta_carrier(obj)
         finalizers = list(obj.GetFinalizers() or [])
         if finalizer in finalizers:
             return False
@@ -551,6 +591,7 @@ class _ControllerUtilModule:
 
     @staticmethod
     def RemoveFinalizer(obj, finalizer):
+        obj = _meta_carrier(obj)
         finalizers = list(obj.GetFinalizers() or [])
         if finalizer not in finalizers:
             return False
@@ -846,6 +887,37 @@ class _HealthzModule:
     Ping = "healthz.Ping"
 
 
+class _LogrModule:
+    """github.com/go-logr/logr."""
+
+    Logger = TypeRef("Logger")
+
+    @staticmethod
+    def Discard():
+        return _FakeLogger()
+
+
+class _NativeEventRecorder:
+    def __init__(self):
+        self.events: list = []
+
+    def Event(self, obj, etype, reason, message):
+        self.events.append((etype, reason, message))
+
+    def Eventf(self, obj, etype, reason, fmt, *args):
+        self.events.append((etype, reason, _go_format(fmt, list(args))))
+
+
+class _RecordModule:
+    """k8s.io/client-go/tools/record."""
+
+    EventRecorder = TypeRef("EventRecorder")
+
+    @staticmethod
+    def NewFakeRecorder(size):
+        return _NativeEventRecorder()
+
+
 class _FilepathModule:
     @staticmethod
     def Join(*parts):
@@ -891,6 +963,14 @@ class _FakeScheme:
 
     def __init__(self):
         self.registered: set = set()
+
+    def AddKnownTypeWithName(self, gvk, obj):
+        kind = getattr(gvk, "Kind", None) or (
+            gvk.fields.get("Kind") if isinstance(gvk, GoStruct) else None
+        )
+        if kind:
+            self.registered.add(kind)
+        return None
 
 
 # kinds client-go's scheme package registers at init (the builtin API
@@ -1092,6 +1172,46 @@ class _FakeController:
         return None
 
 
+class _PredicateFuncs(GoStruct):
+    """predicate.Funcs: a GoStruct (conformance tests reach the
+    composite's fields) that also carries the real type's dispatch
+    methods — Update/Create/Delete/Generic run the matching *Func
+    closure, defaulting to true when unset, like controller-runtime."""
+
+    def __init__(self, fields=None):
+        super().__init__("Funcs", fields)
+
+    def _dispatch(self, key, e):
+        fn = self.fields.get(key)
+        if fn is None:
+            return True
+        if isinstance(fn, Closure):
+            owner = getattr(fn.scan, "interp", None)
+            if owner is not None:
+                return owner.call_value(fn, e)
+        if callable(fn):
+            return fn(e)
+        return True
+
+    def Update(self, e):
+        return self._dispatch("UpdateFunc", e)
+
+    def Create(self, e):
+        return self._dispatch("CreateFunc", e)
+
+    def Delete(self, e):
+        return self._dispatch("DeleteFunc", e)
+
+    def Generic(self, e):
+        return self._dispatch("GenericFunc", e)
+
+
+class _PredicateModule:
+    Funcs = TypeFactory(
+        "Funcs", make=lambda fields: _PredicateFuncs(fields)
+    )
+
+
 class _HandlerModule:
     EnqueueRequestForOwner = TypeRef("EnqueueRequestForOwner")
 
@@ -1213,6 +1333,8 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
         "k8s.io/apimachinery/pkg/runtime": _K8sRuntimeModule,
         "k8s.io/apimachinery/pkg/util/runtime": _UtilRuntimeModule,
         "k8s.io/api/core/v1": _CoreV1Module,
+        "github.com/go-logr/logr": _LogrModule,
+        "k8s.io/client-go/tools/record": _RecordModule,
         "sigs.k8s.io/controller-runtime/pkg/healthz": _HealthzModule,
         "sigs.k8s.io/controller-runtime/pkg/scheme": _SchemeBuilderModule,
         "sigs.k8s.io/controller-runtime/pkg/log/zap": _ZapModule,
@@ -1238,8 +1360,7 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
         "sigs.k8s.io/controller-runtime/pkg/source": _StructModule("Kind"),
         "sigs.k8s.io/controller-runtime/pkg/controller/controllerutil":
             _ControllerUtilModule,
-        "sigs.k8s.io/controller-runtime/pkg/predicate":
-            _StructModule("Funcs"),
+        "sigs.k8s.io/controller-runtime/pkg/predicate": _PredicateModule,
         "sigs.k8s.io/controller-runtime/pkg/event": _StructModule(
             "CreateEvent", "UpdateEvent", "DeleteEvent", "GenericEvent",
         ),
@@ -1251,6 +1372,22 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
 
 
 _UNIVERSE_CONSTS = {"true": True, "false": False, "nil": None, "iota": 0}
+
+# native classes that back EMBEDDED fields of emitted/test types, keyed
+# by the embed's base ident (see _Eval._promoted's lazy zero-init)
+_NATIVE_EMBED_ZEROS = {
+    "Unstructured": _UnstructuredModule.Unstructured,
+}
+
+# Go numeric conversion builtins: T(x)
+_NUMERIC_CONVERSIONS = {
+    name: int for name in (
+        "int", "int8", "int16", "int32", "int64",
+        "uint", "uint8", "uint16", "uint32", "uint64", "uintptr",
+    )
+}
+_NUMERIC_CONVERSIONS["float32"] = float
+_NUMERIC_CONVERSIONS["float64"] = float
 
 
 class Interp:
@@ -2356,6 +2493,14 @@ class _Eval:
             if t.kind == OP and t.value == "(":
                 lo, hi = _group_span(toks, pos)
                 args = self._call_args(toks, lo, hi, self.env)
+                if value is None:
+                    callee_text = "".join(
+                        tok.value for tok in toks[max(0, pos - 3):pos]
+                    )
+                    raise GoInterpError(
+                        f"not callable: nil ({callee_text!r} at "
+                        f"{t.line}:{t.col})"
+                    )
                 value = self._call_value(value, args)
                 pos = hi + 1
                 continue
@@ -2388,6 +2533,14 @@ class _Eval:
             return None
         for fname in embed_names:
             v = struct.fields.get(fname)
+            if v is None:
+                # Go zero-initializes embedded values; native embeds
+                # (a test type embedding unstructured.Unstructured)
+                # materialize lazily on first promoted access
+                zero_cls = _NATIVE_EMBED_ZEROS.get(fname)
+                if zero_cls is not None:
+                    v = zero_cls()
+                    struct.fields[fname] = v
             if isinstance(v, GoStruct):
                 entry = self.interp.methods.get((v.tname, name))
                 if entry is not None:
@@ -2528,15 +2681,20 @@ class _Eval:
                 key = self._eval_range(toks, slo, colon, self.env)
                 fields[key] = self._eval_range(toks, colon + 1, shi, self.env)
             elif (
-                elem_type is not None
-                and toks[slo].kind == OP
+                toks[slo].kind == OP
                 and toks[slo].value == "{"
             ):
-                # elided element type: []schema.GroupVersionKind{{...}}
+                # elided element type: []schema.GroupVersionKind{{...}},
+                # or an anonymous-struct table row ([]struct{...}{{...}})
                 glo, ghi = _group_span(toks, slo)
-                elems.append(
-                    self._build_composite(elem_type, toks, glo, ghi)
-                )
+                if elem_type is not None:
+                    elems.append(
+                        self._build_composite(elem_type, toks, glo, ghi)
+                    )
+                else:
+                    elems.append(
+                        self._composite("<anon>", toks, glo, ghi)
+                    )
             else:
                 elems.append(self._eval_range(toks, slo, shi, self.env))
         if tname in ("slice", "map"):
@@ -2572,6 +2730,11 @@ class _Eval:
             if name == "panic" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 raise GoPanic(self._eval_range(toks, lo, hi, self.env))
+            if name in _NUMERIC_CONVERSIONS and _next_is(toks, pos + 1, "("):
+                lo, hi = _group_span(toks, pos + 1)
+                arg = self._eval_range(toks, lo, hi, self.env)
+                conv = _NUMERIC_CONVERSIONS[name]
+                return (conv(arg) if arg is not None else 0), hi + 1
             if name == "new" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 tname = toks[lo].value

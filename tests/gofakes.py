@@ -24,6 +24,7 @@ from operator_forge.gocheck.interp import (
     _ClientModule,
     _CtrlModule,
     _FakeScheme,
+    _NativeEventRecorder,
     _TimeModule,
     _Timestamp,
     _UnstructuredModule,
@@ -205,12 +206,10 @@ class FakeClusterClient:
         return self.status
 
 
-class FakeEventRecorder:
-    def __init__(self):
-        self.events: list = []
-
-    def Event(self, obj, etype, reason, message):
-        self.events.append((etype, reason, message))
+class FakeEventRecorder(_NativeEventRecorder):
+    """record.EventRecorder for the manager path; shares the native
+    recorder's surface (Event AND Eventf) so both hand-out paths
+    behave identically."""
 
 
 class FakeManager:
@@ -243,10 +242,27 @@ class GoTestFailure(Exception):
 class GoTestT:
     """The *testing.T surface the emitted tests touch."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, call_value=None):
         self.name = name
         self.failed = False
         self.messages: list = []
+        self.call_value = call_value  # closure invoker, for t.Run
+
+    def Parallel(self):
+        return None  # cooperative scheduler: tests already serialize
+
+    def Run(self, name, fn):
+        sub = GoTestT(f"{self.name}/{name}", call_value=self.call_value)
+        try:
+            self.call_value(fn, sub)
+        except GoTestFailure:
+            pass
+        if sub.failed:
+            self.failed = True
+            self.messages.extend(
+                f"{sub.name}: {msg}" for msg in sub.messages
+            )
+        return not sub.failed
 
     def _format(self, fmt, args):
         from operator_forge.gocheck.interp import _go_format
@@ -293,7 +309,7 @@ class GoTestM:
     def Run(self):
         code = 0
         for name in self.suite.test_names:
-            t = GoTestT(name)
+            t = GoTestT(name, call_value=self.suite.interp.call_value)
             try:
                 self.suite.interp.call(name, t)
             except GoTestFailure:
@@ -422,6 +438,45 @@ class _WorldEnvtestModule:
         )
 
 
+class _FakeClientBuilder:
+    """sigs.k8s.io/controller-runtime/pkg/client/fake: each Build gives
+    an isolated in-memory client, like the real fake package."""
+
+    def __init__(self):
+        self.objects: list = []
+
+    def WithScheme(self, scheme):
+        return self
+
+    def WithObjects(self, *objs):
+        self.objects.extend(objs)
+        return self
+
+    def WithStatusSubresource(self, *objs):
+        return self
+
+    def Build(self):
+        client = FakeClusterClient(runtime=None)
+        for obj in self.objects:
+            if hasattr(obj, "Object"):
+                key = (obj.Object.get("kind"), obj.GetNamespace(),
+                       obj.GetName())
+                # deep copy, like the real fake client: mutating a
+                # Get-returned object must not write back into the
+                # test's seed object
+                client.children[key] = copy.deepcopy(obj.Object)
+            else:
+                key = (obj.tname, obj.GetNamespace(), obj.GetName())
+                client.workloads[key] = obj
+        return client
+
+
+class _FakeClientModule:
+    @staticmethod
+    def NewClientBuilder():
+        return _FakeClientBuilder()
+
+
 class EnvtestWorld:
     """One fake cluster + scheduler wiring for one emitted project:
     plays the role envtest + controller-runtime play when the
@@ -453,6 +508,9 @@ class EnvtestWorld:
         self.runtime.natives[
             "sigs.k8s.io/controller-runtime/pkg/envtest"
         ] = _WorldEnvtestModule(self)
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/client/fake"
+        ] = _FakeClientModule
         self.client = FakeClusterClient(self.runtime)
         self.client.world = self
         self.call_interp = next(iter(self.runtime.packages.values()))

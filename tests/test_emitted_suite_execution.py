@@ -137,6 +137,46 @@ class TestStandaloneSuite:
         assert "no kind is registered" in m.failures[0][1][0]
 
 
+class TestEmittedUnitTests:
+    """The emitted pkg/orchestrate unit tests (orchestrate_test.go,
+    ready_test.go) run too — table-driven subtests, fake clients,
+    anonymous-struct cases and all — completing the `go test ./...`
+    story for the generated project."""
+
+    def test_orchestrate_unit_tests_pass(self, standalone):
+        _world, suite, code, m = _run_suite(standalone, "pkg/orchestrate")
+        assert code == 0, m.failures
+        assert len(m.ran) >= 10
+        assert "TestResourceIsReady" in m.ran
+        assert "TestFinalizerLifecycle" in m.ran
+
+    def test_collection_orchestrate_unit_tests_pass(self, collection):
+        _world, suite, code, m = _run_suite(
+            collection, "pkg/orchestrate"
+        )
+        assert code == 0, m.failures
+
+    def test_readiness_regression_fails_emitted_unit_tests(
+        self, standalone, tmp_path
+    ):
+        # the emitted tests guard their own runtime: flipping the
+        # replica-readiness comparison fails TestResourceIsReady
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        path = os.path.join(proj, "pkg", "orchestrate", "ready.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        anchor = "return readyReplicas >= specReplicas, nil"
+        assert anchor in text
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                anchor, "return readyReplicas > specReplicas, nil"
+            ))
+        _world, _suite, code, m = _run_suite(proj, "pkg/orchestrate")
+        assert code == 1
+        assert any("TestResourceIsReady" == name for name, _ in m.failures)
+
+
 class TestCollectionSuite:
     def test_both_group_suites_pass(self, collection):
         # the platform group carries BOTH the collection and its
