@@ -319,7 +319,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -336,12 +336,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet'
+    -a 'init create edit init-config update completion version preview validate vet test'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -468,6 +468,49 @@ def cmd_vet(args: argparse.Namespace) -> int:
         print(f"vet: {len(errors)} problem(s)", file=sys.stderr)
         return 1
     print("vet: all Go files check cleanly")
+    return 0
+
+
+def cmd_test(args: argparse.Namespace) -> int:
+    """Run the generated project's OWN Go test suite — unit, envtest,
+    and (with --e2e) the e2e lifecycle tests — under the bundled Go
+    interpreter against a fake cluster, with no Go toolchain and no
+    real cluster.  The reference gets this guarantee from CI running
+    `go test` / kind (.github/workflows/test.yaml:55-141); here it is
+    a local command."""
+    from operator_forge.gocheck.world import run_project_tests
+
+    root = args.path
+    if not os.path.isdir(root):
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 1
+    results = run_project_tests(
+        root, include_e2e=args.e2e,
+        progress=lambda rel: print(f"--- {rel}"),
+    )
+    if not results:
+        print("test: no *_test.go packages found", file=sys.stderr)
+        return 1
+    failed = 0
+    for res in results:
+        if res.skipped:
+            print(f"skip  {res.rel}  (e2e; pass --e2e to run)")
+            continue
+        if res.error:
+            failed += 1
+            print(f"FAIL  {res.rel}  interpreter: {res.error}")
+            continue
+        status = "ok  " if res.ok else "FAIL"
+        print(f"{status}  {res.rel}  ({len(res.ran)} tests)")
+        for name, messages in res.failures:
+            failed += 1
+            print(f"  --- FAIL: {name}")
+            for msg in messages:
+                print(f"      {msg}")
+    if failed or any(not res.ok and not res.skipped for res in results):
+        print("test: FAIL", file=sys.stderr)
+        return 1
+    print("test: ok")
     return 0
 
 
@@ -602,6 +645,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_vet.add_argument("path", help="root of the generated project")
     p_vet.set_defaults(func=cmd_vet)
+
+    p_test = sub.add_parser(
+        "test",
+        help="run the generated project's Go test suite (no toolchain "
+             "or cluster needed)",
+    )
+    p_test.add_argument("path", help="generated project directory")
+    p_test.add_argument(
+        "--e2e", action="store_true",
+        help="also run the e2e lifecycle suite (interprets main.go and "
+             "simulates the cluster's builtin controllers)",
+    )
+    p_test.set_defaults(func=cmd_test)
 
     p_preview = sub.add_parser(
         "preview",

@@ -1,0 +1,787 @@
+"""A fake cluster + go-test harness that runs a generated project's
+OWN test suite without a Go toolchain.
+
+The reference's contract is "the generated project compiles and its
+tests pass", enforced by CI running `go test` (unit + envtest) and the
+e2e suite against a kind cluster (reference
+.github/workflows/test.yaml:55-141).  This module restores the whole
+contract interpreter-side:
+
+- :class:`FakeClusterClient` — the stateful client the emitted
+  reconciler reads and writes through.  Workloads are live typed
+  objects (aliased on Get, like apiserver state); children are plain
+  dicts; Patch models server-side apply (the status subresource
+  survives a re-apply); Delete/Update carry real apiserver semantics
+  (finalizer pinning, deletion timestamps, finalizer-release removal).
+- :class:`EnvtestWorld` — one fake cluster per project: CRD install,
+  scheme admission, managers with an informer initial sync, a
+  cooperative reconcile pump, owner-watches, and (for e2e) simulated
+  builtin controllers that progress Deployments to ready.
+- :class:`EmittedSuite` — loads one package's ``*_test.go`` files and
+  runs them through TestMain, the way ``go test`` would; and
+  :func:`run_project_tests`, the ``go test ./...`` driver the CLI's
+  ``test`` command exposes.
+"""
+
+import copy
+import os
+
+import yaml
+
+from .gopkg import ProjectRuntime
+from .interp import (
+    BUILTIN_KINDS,
+    GoError,
+    GoExit,
+    GoStruct,
+    _ClientModule,
+    _CtrlModule,
+    _FakeScheme,
+    _NativeEventRecorder,
+    _TimeModule,
+    _Timestamp,
+    _UnstructuredModule,
+)
+
+
+class FakeStatusWriter:
+    def __init__(self, fail=None):
+        self.fail = fail
+        self.updates = 0
+
+    def Update(self, ctx, workload):
+        self.updates += 1
+        return self.fail
+
+
+class FakeClusterClient:
+    """client.Client over an in-memory store, keyed (kind, ns, name)."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.workloads: dict = {}   # key -> GoObject (live, aliased)
+        self.children: dict = {}    # key -> dict (unstructured content)
+        self.applied: list = []
+        self.deleted: list = []
+        self.status = FakeStatusWriter()
+
+    # -- store helpers (test-side) ----------------------------------------
+
+    def add_workload(self, cr: dict):
+        obj = self.runtime.decode_cr(cr)
+        key = (obj.tname, obj.GetNamespace(), obj.GetName())
+        self.workloads[key] = obj
+        return obj
+
+    def remove_workloads(self, kind: str) -> None:
+        self.workloads = {
+            key: obj for key, obj in self.workloads.items()
+            if key[0] != kind
+        }
+
+    def child(self, kind: str, namespace: str, name: str):
+        return self.children.get((kind, namespace, name))
+
+    # -- client.Client surface the emitted code calls ----------------------
+
+    def Get(self, ctx, nn, target):
+        namespace = nn.fields.get("Namespace") or ""
+        name = nn.fields.get("Name") or ""
+        if isinstance(target, GoStruct):
+            stored = self.workloads.get((target.tname, namespace, name))
+            if stored is None:
+                return GoError(f"{target.tname} not found", not_found=True)
+            # alias, like apiserver state: mutations through the request
+            # are visible to later passes
+            target.fields = stored.fields
+            return None
+        gvk = target.GroupVersionKind()
+        data = self.children.get((gvk.Kind, namespace, name))
+        if data is None:
+            return GoError("child not found", not_found=True)
+        target.Object = data
+        return None
+
+    def List(self, ctx, target, *opts):
+        wanted_labels: dict = {}
+        for opt in opts:
+            if isinstance(opt, dict):  # client.MatchingLabels
+                wanted_labels.update(opt)
+        if isinstance(target, GoStruct):
+            kind = target.tname
+            if kind.endswith("List"):
+                kind = kind[:-4]
+            target.fields["Items"] = [
+                obj for (k, _, _), obj in self.workloads.items() if k == kind
+            ]
+            return None
+        gvk = target.GroupVersionKind()
+        kind = gvk.Kind[:-4] if gvk.Kind.endswith("List") else gvk.Kind
+        items = []
+        for (k, _, _), data in self.children.items():
+            if k != kind:
+                continue
+            labels = data.get("metadata", {}).get("labels") or {}
+            if wanted_labels and not all(
+                labels.get(lk) == lv for lk, lv in wanted_labels.items()
+            ):
+                continue
+            live = _UnstructuredModule.Unstructured()
+            live.Object = data
+            items.append(live)
+        target.Items = items
+        return None
+
+    def Patch(self, ctx, resource, *opts):
+        key = (resource.Object.get("kind"), resource.GetNamespace(),
+               resource.GetName())
+        merged = copy.deepcopy(resource.Object)
+        prior = self.children.get(key)
+        if prior and "status" in prior:
+            merged["status"] = prior["status"]
+        self.children[key] = merged
+        self.applied.append(key)
+        return None
+
+    def Create(self, ctx, obj):
+        """client.Create: typed workloads join the store (the emitted
+        suite's TestMain path); unstructured children likewise.  When a
+        world is attached, creation is admission-checked (scheme + CRD,
+        like a real apiserver) and enqueues reconcile requests."""
+        world = getattr(self, "world", None)
+        if isinstance(obj, GoStruct) and not hasattr(obj, "Object"):
+            key = (obj.tname, obj.GetNamespace(), obj.GetName())
+            if key in self.workloads:
+                return GoError(
+                    f'{obj.tname.lower()} "{key[2]}" already exists',
+                    already_exists=True,
+                )
+            if world is not None:
+                err = world.admit(obj)
+                if err is not None:
+                    return err
+            self.workloads[key] = obj
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+            return None
+        key = (obj.Object.get("kind"), obj.GetNamespace(), obj.GetName())
+        if key in self.children:
+            return GoError("already exists", already_exists=True)
+        self.children[key] = copy.deepcopy(obj.Object)
+        return None
+
+    def Update(self, ctx, obj):
+        # workloads are aliased, so field changes are already visible;
+        # what Update contributes is apiserver behavior: the update
+        # EVENT (enqueue) and finalizer-release removal of a
+        # deletion-marked object
+        world = getattr(self, "world", None)
+        if isinstance(obj, GoStruct) and not hasattr(obj, "Object"):
+            key = (obj.tname, obj.GetNamespace(), obj.GetName())
+            stored = self.workloads.get(key)
+            if stored is None:
+                return GoError(f"{obj.tname} not found", not_found=True)
+            ts = stored.fields.get("DeletionTimestamp")
+            deleting = ts is not None and not ts.IsZero()
+            if deleting and not stored.GetFinalizers():
+                del self.workloads[key]
+                return None
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+        return None
+
+    def Delete(self, ctx, obj):
+        world = getattr(self, "world", None)
+        if hasattr(obj, "Object"):
+            key = (obj.Object.get("kind"), obj.GetNamespace(), obj.GetName())
+            data = self.children.pop(key, None)
+            if data is None:
+                return GoError("child not found", not_found=True)
+            self.deleted.append(key)
+            if world is not None:
+                world.notify_child_deleted(data)
+            return None
+        key = (obj.tname, obj.GetNamespace(), obj.GetName())
+        stored = self.workloads.get(key)
+        if stored is None:
+            return GoError(f"{obj.tname} not found", not_found=True)
+        if stored.GetFinalizers():
+            # finalizers pin the object: mark deletion and notify, the
+            # way a real apiserver turns delete into an update event
+            stored.fields["DeletionTimestamp"] = _Timestamp(zero=False)
+            if world is not None:
+                world.enqueue(obj.tname, key[1], key[2])
+        else:
+            del self.workloads[key]
+        return None
+
+    def Status(self):
+        return self.status
+
+
+class FakeEventRecorder(_NativeEventRecorder):
+    """record.EventRecorder for the manager path; shares the native
+    recorder's surface (Event AND Eventf) so both hand-out paths
+    behave identically."""
+
+
+class FakeManager:
+    """The ctrl.Manager surface New<Kind>Reconciler consumes."""
+
+    def __init__(self, client: FakeClusterClient):
+        self.client = client
+        self.recorder = FakeEventRecorder()
+
+    def GetClient(self):
+        return self.client
+
+    def GetEventRecorderFor(self, name):
+        return self.recorder
+
+    def GetScheme(self):
+        return "scheme"
+
+
+# ---------------------------------------------------------------------------
+# the envtest world: enough of envtest + controller-runtime's manager to
+# run the EMITTED *_test.go files themselves under the interpreter
+
+
+class GoTestFailure(Exception):
+    """t.Fatalf: unwinds the interpreted test function (defers run,
+    like testing.T.FailNow's runtime.Goexit)."""
+
+
+class GoTestT:
+    """The *testing.T surface the emitted tests touch."""
+
+    def __init__(self, name: str, call_value=None):
+        self.name = name
+        self.failed = False
+        self.messages: list = []
+        self.call_value = call_value  # closure invoker, for t.Run
+
+    def Parallel(self):
+        return None  # cooperative scheduler: tests already serialize
+
+    def Run(self, name, fn):
+        sub = GoTestT(f"{self.name}/{name}", call_value=self.call_value)
+        try:
+            self.call_value(fn, sub)
+        except GoTestFailure:
+            pass
+        if sub.failed:
+            self.failed = True
+            self.messages.extend(
+                f"{sub.name}: {msg}" for msg in sub.messages
+            )
+        return not sub.failed
+
+    def _format(self, fmt, args):
+        from .interp import _go_format
+
+        return _go_format(fmt, list(args))
+
+    def Fatalf(self, fmt, *args):
+        self.failed = True
+        self.messages.append(self._format(fmt, args))
+        raise GoTestFailure(self.messages[-1])
+
+    def Fatal(self, *args):
+        self.failed = True
+        self.messages.append(" ".join(str(a) for a in args))
+        raise GoTestFailure(self.messages[-1])
+
+    def Errorf(self, fmt, *args):
+        self.failed = True
+        self.messages.append(self._format(fmt, args))
+
+    def Logf(self, fmt, *args):
+        self.messages.append(self._format(fmt, args))
+
+    def Log(self, *args):
+        self.messages.append(" ".join(str(a) for a in args))
+
+    def Helper(self):
+        return None
+
+    def Name(self):
+        return self.name
+
+
+class GoTestM:
+    """The *testing.M TestMain receives: Run executes every emitted
+    Test* function (source order, like go test) and reports the worst
+    exit code."""
+
+    def __init__(self, suite: "EmittedSuite"):
+        self.suite = suite
+        self.ran: list = []
+        self.failures: list = []
+
+    def Run(self):
+        code = 0
+        for name in self.suite.test_names:
+            t = GoTestT(name, call_value=self.suite.interp.call_value)
+            try:
+                self.suite.interp.call(name, t)
+            except GoTestFailure:
+                pass
+            self.ran.append(name)
+            if t.failed:
+                code = 1
+                self.failures.append((name, list(t.messages)))
+        return code
+
+
+class FakeRestConfig:
+    """envtest.Start's *rest.Config: only its non-nil-ness matters."""
+
+
+class FakeEnvironment:
+    """envtest.Environment: Start validates CRDDirectoryPaths against
+    the scaffolded project ON DISK (the emitted config/crd/bases must
+    exist and parse) and installs the CRDs' kinds into the world — the
+    fake apiserver then refuses kinds without a CRD, exactly the
+    failure a real envtest run would produce."""
+
+    world: "EnvtestWorld" = None  # bound per world via subclassing
+
+    def __init__(self):
+        self.CRDDirectoryPaths: list = []
+        self.ErrorIfCRDPathMissing = False
+
+    def Start(self):
+        for rel in self.CRDDirectoryPaths or []:
+            path = rel if os.path.isabs(rel) else os.path.join(
+                self.world.pkg_dir, rel
+            )
+            if not os.path.isdir(path):
+                if self.ErrorIfCRDPathMissing:
+                    return (None, GoError(
+                        f"unable to read CRD directory {rel}"
+                    ))
+                continue
+            self.world.install_crds(path)
+        self.world.env_started = True
+        return (FakeRestConfig(), None)
+
+    def Stop(self):
+        self.world.env_stopped = True
+        return None
+
+
+class WorldManager(FakeManager):
+    """A ctrl.Manager whose Start performs the informer initial sync
+    (existing objects of watched kinds are enqueued) and whose context
+    gates dispatch — cancelled managers stop reconciling."""
+
+    def __init__(self, world: "EnvtestWorld"):
+        super().__init__(world.client)
+        self.world = world
+        self.registered: list = []  # (kind, reconciler)
+        self.started = False
+        self.start_ctx = None
+
+    def RegisterController(self, for_obj, reconciler):
+        kind = for_obj.tname if isinstance(for_obj, GoStruct) else None
+        self.registered.append((kind, reconciler))
+
+    def Start(self, ctx):
+        self.started = True
+        self.start_ctx = ctx
+        for kind, _reconciler in self.registered:
+            for (k, ns, name) in list(self.world.client.workloads):
+                if k == kind:
+                    self.world.enqueue(kind, ns, name)
+        return None
+
+    def AddHealthzCheck(self, name, check):
+        return None
+
+    def AddReadyzCheck(self, name, check):
+        return None
+
+    @property
+    def active(self) -> bool:
+        ctx = self.start_ctx
+        cancelled = ctx is not None and getattr(ctx, "cancelled", False)
+        return self.started and not cancelled
+
+
+class _WorldCtrlModule(_CtrlModule):
+    def __init__(self, world: "EnvtestWorld"):
+        super().__init__()
+        self.world = world
+
+    def NewManager(self, cfg, opts):
+        if cfg is None:
+            return (None, GoError("must specify Config"))
+        mgr = WorldManager(self.world)
+        self.world.managers.append(mgr)
+        return (mgr, None)
+
+    def GetConfig(self):
+        if not self.world.env_started:
+            return (None, GoError("unable to load in-cluster config"))
+        return (FakeRestConfig(), None)
+
+    def GetConfigOrDie(self):
+        return FakeRestConfig()
+
+
+class _WorldClientModule(_ClientModule):
+    def __init__(self, world: "EnvtestWorld"):
+        self.world = world
+
+    def New(self, cfg, opts):
+        if cfg is None:
+            return (None, GoError("must provide non-nil rest.Config"))
+        if isinstance(opts, GoStruct):
+            scheme = opts.fields.get("Scheme")
+            if scheme is not None:
+                self.world.client_scheme = scheme
+        return (self.world.client, None)
+
+
+class _WorldEnvtestModule:
+    def __init__(self, world: "EnvtestWorld"):
+        self.Environment = type(
+            "Environment", (FakeEnvironment,), {"world": world}
+        )
+
+
+class _FakeClientBuilder:
+    """sigs.k8s.io/controller-runtime/pkg/client/fake: each Build gives
+    an isolated in-memory client, like the real fake package."""
+
+    def __init__(self):
+        self.objects: list = []
+
+    def WithScheme(self, scheme):
+        return self
+
+    def WithObjects(self, *objs):
+        self.objects.extend(objs)
+        return self
+
+    def WithStatusSubresource(self, *objs):
+        return self
+
+    def Build(self):
+        client = FakeClusterClient(runtime=None)
+        for obj in self.objects:
+            if hasattr(obj, "Object"):
+                key = (obj.Object.get("kind"), obj.GetNamespace(),
+                       obj.GetName())
+                # deep copy, like the real fake client: mutating a
+                # Get-returned object must not write back into the
+                # test's seed object
+                client.children[key] = copy.deepcopy(obj.Object)
+            else:
+                key = (obj.tname, obj.GetNamespace(), obj.GetName())
+                client.workloads[key] = obj
+        return client
+
+
+class _FakeClientModule:
+    @staticmethod
+    def NewClientBuilder():
+        return _FakeClientBuilder()
+
+
+class EnvtestWorld:
+    """One fake cluster + scheduler wiring for one emitted project:
+    plays the role envtest + controller-runtime play when the
+    reference's CI runs the generated project's tests
+    (reference .github/workflows/test.yaml:106-141)."""
+
+    REQUEUE_ERROR_NS = _TimeModule.Second
+    REQUEUE_IMMEDIATE_NS = _TimeModule.Millisecond
+
+    def __init__(self, proj: str):
+        self.proj = proj
+        self.pkg_dir = proj  # suite under test re-points this
+        self.managers: list = []
+        self.installed_kinds: set = set()
+        self.client_scheme = None
+        self.env_started = False
+        self.env_stopped = False
+        self.simulate_cluster = False  # builtin controllers (e2e mode)
+        self.pending: list = []  # {due, kind, ns, name}
+        self.reconcile_log: list = []  # (kind, ns, name, result, err)
+        self.runtime = ProjectRuntime(proj, extra_natives={})
+        # override AFTER construction so the world modules see the world
+        self.runtime.natives["sigs.k8s.io/controller-runtime"] = (
+            _WorldCtrlModule(self)
+        )
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/client"
+        ] = _WorldClientModule(self)
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/envtest"
+        ] = _WorldEnvtestModule(self)
+        self.runtime.natives[
+            "sigs.k8s.io/controller-runtime/pkg/client/fake"
+        ] = _FakeClientModule
+        self.client = FakeClusterClient(self.runtime)
+        self.client.world = self
+        self.call_interp = next(iter(self.runtime.packages.values()))
+        self.runtime.sched.hooks.append(self._simulate_builtins)
+        self.runtime.sched.hooks.append(self._pump)
+
+    # -- cluster lifecycle -------------------------------------------------
+
+    def install_crds(self, path: str) -> int:
+        """Install every CRD under *path* (what `make install` or
+        envtest's CRDDirectoryPaths does); returns how many."""
+        count = 0
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith((".yaml", ".yml")):
+                continue
+            with open(os.path.join(path, fname), encoding="utf-8") as fh:
+                for doc in yaml.safe_load_all(fh.read()):
+                    if isinstance(doc, dict) and doc.get("kind") == (
+                        "CustomResourceDefinition"
+                    ):
+                        kind = ((doc.get("spec") or {}).get("names")
+                                or {}).get("kind")
+                        if kind:
+                            self.installed_kinds.add(kind)
+                            count += 1
+        return count
+
+    def start_operator(self):
+        """Interpret the emitted main.go — the `make run` flow the e2e
+        suite's no-deploy mode assumes: flag parsing, scheme assembly,
+        manager construction, reconciler registration, health checks,
+        and the (cooperative) manager start."""
+        interp = self.runtime.ensure_package("<main>")
+        path = os.path.join(self.proj, "main.go")
+        with open(path, encoding="utf-8") as fh:
+            interp.load_source(fh.read(), path)
+        self.runtime.register_types("<main>")
+        interp.run_inits()
+        interp.call("main")
+        return interp
+
+    # -- apiserver admission ----------------------------------------------
+
+    def admit(self, obj: GoStruct):
+        if not self.env_started:
+            return GoError("connection refused: test environment not started")
+        scheme = self.client_scheme
+        if isinstance(scheme, _FakeScheme) and obj.tname not in (
+            scheme.registered
+        ):
+            return GoError(
+                f"no kind is registered for the type {obj.tname}"
+            )
+        if obj.tname not in BUILTIN_KINDS and obj.tname not in (
+            self.installed_kinds
+        ):
+            return GoError(
+                f'no matches for kind "{obj.tname}": CRD not installed'
+            )
+        return None
+
+    def notify_child_deleted(self, data: dict) -> None:
+        """The owner-watch: deleting an owned child enqueues its
+        controller owner, the way controller-runtime's Owns/Watch
+        wiring turns child events into parent reconciles."""
+        meta = data.get("metadata") or {}
+        ns = meta.get("namespace") or ""
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("controller"):
+                self.enqueue(ref.get("kind"), ns, ref.get("name"))
+
+    def _simulate_builtins(self, sched):
+        """The cluster-side controllers a real e2e run assumes (kubelet,
+        deployment controller...): applied children progress to ready,
+        per the same fields the emitted ready.go consults."""
+        if not self.simulate_cluster:
+            return
+        for (kind, _ns, _name), data in list(self.client.children.items()):
+            if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+                spec = data.get("spec") or {}
+                replicas = spec.get("replicas", 1)
+                data.setdefault("status", {})["readyReplicas"] = replicas
+            elif kind == "DaemonSet":
+                status = data.setdefault("status", {})
+                status["desiredNumberScheduled"] = 1
+                status["numberReady"] = 1
+            elif kind == "Job":
+                data.setdefault("status", {})["succeeded"] = 1
+
+    # -- the reconcile pump ------------------------------------------------
+
+    def enqueue(self, kind, ns, name, delay_ns: int = 0):
+        self.pending.append({
+            "due": self.runtime.sched.now_ns + delay_ns,
+            "kind": kind, "ns": ns, "name": name,
+        })
+
+    def _reconciler_for(self, kind):
+        for mgr in reversed(self.managers):
+            if not mgr.active:
+                continue
+            for k, reconciler in mgr.registered:
+                if k == kind:
+                    return reconciler
+        return None
+
+    def _pump(self, sched):
+        progressed = True
+        while progressed:
+            progressed = False
+            for item in list(self.pending):
+                if item["due"] > sched.now_ns:
+                    continue
+                if item not in self.pending:
+                    continue  # a reentrant pump already took it
+                reconciler = self._reconciler_for(item["kind"])
+                if reconciler is None:
+                    continue  # no active manager: stays queued
+                self.pending.remove(item)
+                progressed = True
+                req = GoStruct("Request", {
+                    "NamespacedName": GoStruct("NamespacedName", {
+                        "Namespace": item["ns"], "Name": item["name"],
+                    }),
+                })
+                out = self.call_interp.call_method(
+                    reconciler, "Reconcile", None, req
+                )
+                result, err = out if isinstance(out, tuple) else (out, None)
+                self.reconcile_log.append(
+                    (item["kind"], item["ns"], item["name"], result, err)
+                )
+                delay = None
+                if err is not None:
+                    delay = self.REQUEUE_ERROR_NS
+                elif isinstance(result, GoStruct):
+                    if result.fields.get("Requeue"):
+                        delay = self.REQUEUE_IMMEDIATE_NS
+                    elif result.fields.get("RequeueAfter"):
+                        delay = result.fields["RequeueAfter"]
+                if delay is not None:
+                    self.enqueue(
+                        item["kind"], item["ns"], item["name"], delay
+                    )
+
+
+class EmittedSuite:
+    """Loads one emitted package's *_test.go files into its package
+    interpreter and runs them through TestMain, the way ``go test``
+    would."""
+
+    def __init__(self, world: EnvtestWorld, rel: str):
+        self.world = world
+        self.rel = rel
+        world.pkg_dir = os.path.join(world.proj, rel)
+        self.interp = world.runtime.ensure_package(rel)
+        for fname in sorted(os.listdir(world.pkg_dir)):
+            if not fname.endswith("_test.go"):
+                continue
+            path = os.path.join(world.pkg_dir, fname)
+            with open(path, encoding="utf-8") as fh:
+                self.interp.load_source(fh.read(), path)
+        world.runtime.register_types(rel)
+        self.interp.run_inits()  # test-file init funcs run at import too
+        self.test_names = [
+            name for name in self.interp.funcs
+            if name.startswith("Test") and name != "TestMain"
+        ]
+
+    def run(self) -> tuple:
+        """Execute TestMain; returns (exit_code, m)."""
+        m = GoTestM(self)
+        if "TestMain" not in self.interp.funcs:
+            return (m.Run(), m)
+        try:
+            self.interp.call("TestMain", m)
+        except GoExit as exc:
+            return (exc.code, m)
+        return (1 if m.failures else 0, m)
+
+
+# ---------------------------------------------------------------------------
+# the `go test ./...` driver
+
+
+class SuiteResult:
+    """Outcome of one test package's run."""
+
+    def __init__(self, rel: str, code: int = 0, ran=None, failures=None,
+                 skipped: bool = False, error: str = ""):
+        self.rel = rel
+        self.code = code
+        self.ran = ran or []
+        self.failures = failures or []
+        self.skipped = skipped
+        self.error = error
+
+    @property
+    def ok(self) -> bool:
+        return not self.skipped and not self.error and self.code == 0
+
+
+def discover_test_packages(root: str) -> list:
+    """Package dirs (relative, slash-separated) containing *_test.go,
+    ordered unit -> controllers -> e2e, like the reference CI's
+    progression (unit, envtest, then the cluster suite)."""
+    rels = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if not d.startswith((".", "_")) and
+                       d not in ("vendor", "bin", "config", "testdata")]
+        if any(f.endswith("_test.go") for f in filenames):
+            rel = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            if rel != ".":
+                rels.append(rel)
+
+    def rank(rel: str) -> int:
+        if rel.startswith("test/"):
+            return 2
+        if rel.startswith("controllers/"):
+            return 1
+        return 0
+
+    rels.sort(key=lambda r: (rank(r), r))
+    return rels
+
+
+def run_project_tests(root: str, include_e2e: bool = False,
+                      progress=None) -> list:
+    """Run every emitted test package of the generated project at
+    *root* under the interpreter — the `go test ./...` the reference
+    gets from its CI toolchain.  Each package gets a FRESH world (test
+    isolation, like separate go-test binaries); e2e packages
+    additionally install the project's CRDs, simulate the cluster's
+    builtin controllers, and start the operator by interpreting the
+    emitted main.go.  Returns a list of :class:`SuiteResult`."""
+    results = []
+    for rel in discover_test_packages(root):
+        is_e2e = rel.startswith("test/")
+        if is_e2e and not include_e2e:
+            results.append(SuiteResult(rel, skipped=True))
+            continue
+        if progress is not None:
+            progress(rel)
+        try:
+            world = EnvtestWorld(root)
+            if is_e2e:
+                world.env_started = True
+                world.simulate_cluster = True
+                crd_dir = os.path.join(root, "config", "crd", "bases")
+                if os.path.isdir(crd_dir):
+                    world.install_crds(crd_dir)
+                world.start_operator()
+            suite = EmittedSuite(world, rel)
+            code, m = suite.run()
+            results.append(SuiteResult(
+                rel, code=code, ran=m.ran, failures=m.failures
+            ))
+        except Exception as exc:  # interpreter fault: report, don't die
+            results.append(SuiteResult(rel, code=1, error=str(exc)))
+    return results

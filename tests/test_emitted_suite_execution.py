@@ -177,6 +177,60 @@ class TestEmittedUnitTests:
         assert any("TestResourceIsReady" == name for name, _ in m.failures)
 
 
+class TestCLITestCommand:
+    """`operator-forge test <dir>` is the user-facing face of this
+    module: go test ./... with no toolchain."""
+
+    def test_runs_all_packages_and_reports(self, standalone, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", standalone, "--e2e"]) == 0
+        out = capsys.readouterr().out
+        assert "ok    pkg/orchestrate" in out
+        assert "ok    controllers/shop" in out
+        assert "ok    test/e2e" in out
+        assert "test: ok" in out
+
+    def test_e2e_skipped_by_default(self, standalone, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", standalone]) == 0
+        out = capsys.readouterr().out
+        assert "skip  test/e2e" in out
+
+    def test_failure_prints_messages_and_exits_1(
+        self, standalone, tmp_path, capsys
+    ):
+        from operator_forge.cli.main import main as cli_main
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        path = os.path.join(proj, "pkg", "orchestrate", "ready.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                "return readyReplicas >= specReplicas, nil",
+                "return readyReplicas > specReplicas, nil",
+            ))
+        assert cli_main(["test", proj]) == 1
+        out = capsys.readouterr().out
+        assert "--- FAIL: TestResourceIsReady" in out
+
+    def test_missing_dir_errors(self, tmp_path, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", str(tmp_path / "nope")]) == 1
+
+    def test_no_test_packages_errors(self, tmp_path, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert cli_main(["test", str(empty)]) == 1
+        assert "no *_test.go packages" in capsys.readouterr().err
+
+
 class TestCollectionSuite:
     def test_both_group_suites_pass(self, collection):
         # the platform group carries BOTH the collection and its
