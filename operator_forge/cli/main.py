@@ -26,7 +26,7 @@ from operator_forge.utils import yamlcompat as pyyaml
 from .. import __version__
 from .. import licensing
 from ..scaffold.api import scaffold_api, scaffold_webhook
-from ..scaffold.context import ProjectConfig
+from ..scaffold.context import DEFAULT_LAYOUT, ProjectConfig
 from ..scaffold.machinery import ScaffoldError
 from ..scaffold.project import scaffold_init
 from ..workload import config as wconfig
@@ -71,7 +71,92 @@ def _default_repo(workload_name: str) -> str:
     return f"github.com/example/{workload_name}"
 
 
+# Plugin registry: kubebuilder-style keys (`name/vN`, short names match
+# the first dot-segment) the reference CLI accepts (pkg/cli/init.go:
+# 27-53 registers the go/v3 bundle as default plus golangv2 and
+# declarative/v1 as selectable alternatives).  operator-forge's
+# generator IS the bundle; the kubebuilder-only alternative layouts are
+# recognized and refused with the reason.
+_PLUGIN_BUNDLE_KEY = DEFAULT_LAYOUT
+
+_PLUGINS: dict = {
+    # full name -> {version -> disposition}
+    "go.operator-forge.io": {"v3": "bundle"},
+    "workload.operator-forge.io": {"v1": "bundle"},
+    "license.operator-forge.io": {"v1": "bundle"},
+    "config.operator-forge.io": {"v1": "bundle"},
+    # reference-compatible spellings
+    "go.kubebuilder.io": {"v3": "bundle", "v2": "legacy"},
+    "kustomize.common.kubebuilder.io": {"v1": "bundle"},
+    "workload.operator-builder.io": {"v1": "bundle"},
+    "license.operator-builder.io": {"v1": "bundle"},
+    "config.operator-builder.io": {"v1": "bundle"},
+    "declarative.go.kubebuilder.io": {"v1": "declarative"},
+}
+
+_PLUGIN_REFUSALS = {
+    "legacy": (
+        "scaffolds the legacy kubebuilder go/v2 layout; operator-forge "
+        "generates only the go/v3 layout (omit --plugins, or pass go/v3)"
+    ),
+    "declarative": (
+        "is kubebuilder's declarative-pattern scaffold; operator-forge's "
+        "workload generator renders and reconciles your manifests "
+        "directly, subsuming it (omit --plugins, or pass go/v3)"
+    ),
+}
+
+
+def resolve_plugins(spec: str) -> str:
+    """Resolve a ``--plugins`` value (comma-separated kubebuilder-style
+    keys) to the bundle layout key, with kubebuilder's matching rules:
+    full name, or short name = first dot-segment, optional ``/vN``.
+    Raises CLIError for unknown keys and for recognized-but-unsupported
+    alternative layouts."""
+    for key in (k.strip() for k in spec.split(",") if k.strip()):
+        name, _sep, version = key.partition("/")
+        matches = [
+            full for full in _PLUGINS
+            if full == name or full.split(".", 1)[0] == name
+        ]
+        if not matches:
+            raise CLIError(
+                f"no plugin could be resolved with key {key!r}"
+            )
+        # among short-name matches, prefer one that has the requested
+        # version (so `go/v2` finds go.kubebuilder.io's v2 refusal, not
+        # a missing-version error on go.operator-forge.io)
+        full = next(
+            (c for c in matches if version and version in _PLUGINS[c]),
+            matches[0],
+        )
+        versions = _PLUGINS[full]
+        if version:
+            if version not in versions:
+                raise CLIError(
+                    f"no plugin {full!r} version {version!r}; known: "
+                    + ", ".join(sorted(versions))
+                )
+            disposition = versions[version]
+        else:
+            # unversioned: prefer the supported bundle version
+            disposition = (
+                "bundle" if "bundle" in versions.values()
+                else next(iter(versions.values()))
+            )
+        if disposition != "bundle":
+            raise CLIError(
+                f"plugin {key!r} {_PLUGIN_REFUSALS[disposition]}"
+            )
+    return _PLUGIN_BUNDLE_KEY
+
+
 def cmd_init(args: argparse.Namespace) -> int:
+    # resolve plugin keys FIRST: a bad --plugins value must fail before
+    # any config work, like the reference CLI's plugin resolution
+    layout = resolve_plugins(args.plugins) if args.plugins else (
+        _PLUGIN_BUNDLE_KEY
+    )
     processor = wconfig.parse(args.workload_config)
     init_workloads(processor)
     workload = processor.workload
@@ -80,6 +165,7 @@ def cmd_init(args: argparse.Namespace) -> int:
     config = ProjectConfig(
         repo=repo,
         domain=workload.domain,
+        layout=layout,
         workload_config_path=os.path.relpath(
             args.workload_config, args.output_dir
         ),
@@ -531,6 +617,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_init.add_argument("--workload-config", required=True)
     p_init.add_argument("--repo", default="", help="go module path")
     p_init.add_argument("--output-dir", default=".")
+    p_init.add_argument(
+        "--plugins", default="",
+        help="plugin keys to scaffold with (kubebuilder-style, e.g. "
+             "go/v3 or workload.operator-forge.io/v1); the workload "
+             "bundle is the default and only generator",
+    )
     p_init.add_argument("--project-license", default="")
     p_init.add_argument("--source-header-license", default="")
     p_init.add_argument(

@@ -680,6 +680,11 @@ class EmittedSuite:
         self.rel = rel
         world.pkg_dir = os.path.join(world.proj, rel)
         self.interp = world.runtime.ensure_package(rel)
+        if not self.interp.scans:
+            # a package the project walk skipped (the root main
+            # package, or a test-only dir): its non-test sources are
+            # part of the test build, like `go test` compiles them
+            self.interp.load_dir(world.pkg_dir)
         for fname in sorted(os.listdir(world.pkg_dir)):
             if not fname.endswith("_test.go"):
                 continue
@@ -729,16 +734,24 @@ class SuiteResult:
 def discover_test_packages(root: str) -> list:
     """Package dirs (relative, slash-separated) containing *_test.go,
     ordered unit -> controllers -> e2e, like the reference CI's
-    progression (unit, envtest, then the cluster suite)."""
+    progression (unit, envtest, then the cluster suite).  Pruning
+    matches go tooling: vendor/, testdata/, dot- and _-prefixed dirs
+    anywhere; the scaffold's non-Go config/ and bin/ only at the
+    project root.  The root package itself ('.') is included when it
+    carries tests."""
     rels = []
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if not d.startswith((".", "_")) and
-                       d not in ("vendor", "bin", "config", "testdata")]
+        at_root = dirpath == root
+        dirnames[:] = [
+            d for d in dirnames
+            if not d.startswith((".", "_"))
+            and d not in ("vendor", "testdata")
+            and not (at_root and d in ("config", "bin"))
+        ]
         if any(f.endswith("_test.go") for f in filenames):
-            rel = os.path.relpath(dirpath, root).replace(os.sep, "/")
-            if rel != ".":
-                rels.append(rel)
+            rels.append(
+                os.path.relpath(dirpath, root).replace(os.sep, "/")
+            )
 
     def rank(rel: str) -> int:
         if rel.startswith("test/"):

@@ -90,6 +90,70 @@ class TestLicense:
         ) == 1
 
 
+class TestPluginSelection:
+    """`--plugins` key resolution (reference pkg/cli/init.go:27-53
+    registers the go/v3 bundle as default plus golangv2 and
+    declarative/v1 alternatives; operator-forge resolves the same key
+    grammar, refusing the kubebuilder-only layouts with the reason)."""
+
+    def _fixture(self, tmp_path):
+        import shutil
+
+        fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+        cfg = tmp_path / "cfg"
+        shutil.copytree(os.path.join(fixtures, "standalone"), str(cfg))
+        return str(cfg / "workload.yaml")
+
+    @pytest.mark.parametrize("key", [
+        "go/v3", "go.kubebuilder.io/v3", "go.operator-forge.io/v3",
+        "workload/v1", "workload.operator-builder.io/v1", "go",
+    ])
+    def test_bundle_keys_resolve(self, tmp_path, key):
+        config = self._fixture(tmp_path)
+        out = str(tmp_path / "proj")
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--plugins", key, "--output-dir", out,
+        ]) == 0
+        text = open(os.path.join(out, "PROJECT")).read()
+        assert "- go.operator-forge.io/v3" in text
+
+    def test_layout_round_trips_through_project(self, tmp_path):
+        from operator_forge.scaffold.context import ProjectConfig
+
+        config = self._fixture(tmp_path)
+        out = str(tmp_path / "proj")
+        cli_main(["init", "--workload-config", config,
+                  "--output-dir", out])
+        import yaml as pyyaml
+
+        data = pyyaml.safe_load(open(os.path.join(out, "PROJECT")).read())
+        loaded = ProjectConfig.from_dict(data)
+        assert loaded.layout == "go.operator-forge.io/v3"
+
+    @pytest.mark.parametrize("key,fragment", [
+        ("go/v2", "legacy kubebuilder go/v2 layout"),
+        ("declarative/v1", "declarative-pattern scaffold"),
+    ])
+    def test_alternative_layouts_refused_with_reason(
+        self, tmp_path, capsys, key, fragment
+    ):
+        config = self._fixture(tmp_path)
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--plugins", key, "--output-dir", str(tmp_path / "p"),
+        ]) == 1
+        assert fragment in capsys.readouterr().err
+
+    def test_unknown_key_errors(self, tmp_path, capsys):
+        config = self._fixture(tmp_path)
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--plugins", "bogus/v9", "--output-dir", str(tmp_path / "p"),
+        ]) == 1
+        assert "no plugin could be resolved" in capsys.readouterr().err
+
+
 class TestMiscCommands:
     def test_version(self, capsys):
         assert cli_main(["version"]) == 0

@@ -217,6 +217,27 @@ class TestCLITestCommand:
         out = capsys.readouterr().out
         assert "--- FAIL: TestResourceIsReady" in out
 
+    def test_root_package_tests_run_too(self, standalone, tmp_path, capsys):
+        # go test ./... includes the root main package; a user-added
+        # main_test.go must run (and its sources load beside it)
+        from operator_forge.cli.main import main as cli_main
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        with open(os.path.join(proj, "main_test.go"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(
+                'package main\n\nimport "testing"\n\n'
+                "func TestSmoke(t *testing.T) {\n"
+                "\tif 1+1 != 2 {\n"
+                '\t\tt.Fatal("arithmetic broke")\n'
+                "\t}\n"
+                "}\n"
+            )
+        assert cli_main(["test", proj]) == 0
+        out = capsys.readouterr().out
+        assert "ok    .  (1 tests)" in out
+
     def test_missing_dir_errors(self, tmp_path, capsys):
         from operator_forge.cli.main import main as cli_main
 
