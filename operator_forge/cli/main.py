@@ -113,7 +113,10 @@ def resolve_plugins(spec: str) -> str:
     full name, or short name = first dot-segment, optional ``/vN``.
     Raises CLIError for unknown keys and for recognized-but-unsupported
     alternative layouts."""
-    for key in (k.strip() for k in spec.split(",") if k.strip()):
+    keys = [k.strip() for k in spec.split(",") if k.strip()]
+    if not keys:
+        raise CLIError(f"invalid --plugins value {spec!r}: no plugin keys")
+    for key in keys:
         name, _sep, version = key.partition("/")
         matches = [
             full for full in _PLUGINS

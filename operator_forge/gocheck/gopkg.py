@@ -162,6 +162,67 @@ class TypeUniverse:
             return self.decode(base, data)
         return data
 
+    def encode(self, obj: GoStruct) -> dict:
+        """The CR-shaped mapping for a typed value — decode's inverse,
+        the way apimachinery converts typed objects to unstructured
+        (DefaultUnstructuredConverter.ToUnstructured): json keys from
+        tags, metav1 embeds back to metadata/TypeMeta, zero-ish values
+        included only where set (omitempty approximation)."""
+        info = self.structs.get(obj.tname)
+        if info is None:
+            return {}
+        out: dict = {}
+        for embed_type, jkey in info.embeds:
+            base = embed_type.lstrip("*").split(".")[-1]
+            if base == "ObjectMeta":
+                meta: dict = {}
+                for go_name, json_name in (
+                    ("Name", "name"), ("Namespace", "namespace"),
+                    ("Labels", "labels"), ("Annotations", "annotations"),
+                    ("Finalizers", "finalizers"),
+                    ("Generation", "generation"),
+                ):
+                    value = obj.fields.get(go_name)
+                    if value:
+                        meta[json_name] = value
+                out[jkey or "metadata"] = meta
+            elif base == "TypeMeta":
+                api_version = obj.fields.get("APIVersion")
+                if api_version:
+                    out["apiVersion"] = api_version
+                out["kind"] = obj.fields.get("Kind") or obj.tname
+        out.update(self._encode_shape(obj.tname, obj))
+        return out
+
+    def _encode_shape(self, tname: str, obj: GoStruct) -> dict:
+        """Tagged fields plus promoted project-struct embeds of
+        *tname*, read off the flat value — recursing through embeds of
+        embeds, mirroring decode's promotion depth."""
+        info = self.structs.get(tname)
+        out: dict = {}
+        if info is None:
+            return out
+        for embed_type, jkey in info.embeds:
+            base = embed_type.lstrip("*").split(".")[-1]
+            if base in ("ObjectMeta", "TypeMeta"):
+                continue  # handled by encode() on the root object
+            if base in self.structs:
+                nested = self._encode_shape(base, obj)
+                if jkey:
+                    out[jkey] = nested
+                else:
+                    out.update(nested)
+        for fname, jkey, _type_text in info.fields:
+            out[jkey] = self.encode_value(obj.fields.get(fname))
+        return out
+
+    def encode_value(self, value):
+        if isinstance(value, GoStruct):
+            return self.encode(value)
+        if isinstance(value, list):
+            return [self.encode_value(item) for item in value]
+        return value
+
     def decode(self, tname: str, data: dict,
                into: GoStruct | None = None) -> GoStruct:
         """Build the typed value for *tname* from a CR-shaped mapping,

@@ -82,6 +82,15 @@ class FakeClusterClient:
     def child(self, kind: str, namespace: str, name: str):
         return self.children.get((kind, namespace, name))
 
+    def _encode_workload(self, stored) -> dict | None:
+        """Unstructured content for a stored typed workload (the fake
+        apiserver serves every object in both representations; emitted
+        code like DependenciesSatisfied lists CR kinds unstructured)."""
+        if self.runtime is None:
+            return None
+        data = self.runtime.universe.encode(stored)
+        return data or None
+
     # -- client.Client surface the emitted code calls ----------------------
 
     def Get(self, ctx, nn, target):
@@ -97,6 +106,10 @@ class FakeClusterClient:
             return None
         gvk = target.GroupVersionKind()
         data = self.children.get((gvk.Kind, namespace, name))
+        if data is None:
+            stored = self.workloads.get((gvk.Kind, namespace, name))
+            if stored is not None:
+                data = self._encode_workload(stored)
         if data is None:
             return GoError("child not found", not_found=True)
         target.Object = data
@@ -118,9 +131,16 @@ class FakeClusterClient:
         gvk = target.GroupVersionKind()
         kind = gvk.Kind[:-4] if gvk.Kind.endswith("List") else gvk.Kind
         items = []
-        for (k, _, _), data in self.children.items():
+        candidates = [
+            data for (k, _, _), data in self.children.items() if k == kind
+        ]
+        for (k, _, _), stored in self.workloads.items():
             if k != kind:
                 continue
+            data = self._encode_workload(stored)
+            if data is not None:
+                candidates.append(data)
+        for data in candidates:
             labels = data.get("metadata", {}).get("labels") or {}
             if wanted_labels and not all(
                 labels.get(lk) == lv for lk, lv in wanted_labels.items()
@@ -612,6 +632,33 @@ class EnvtestWorld:
                 status["numberReady"] = 1
             elif kind == "Job":
                 data.setdefault("status", {})["succeeded"] = 1
+            elif kind == "Pod":
+                status = data.setdefault("status", {})
+                status["phase"] = "Running"
+                if not any(
+                    c.get("type") == "Ready"
+                    for c in status.get("conditions", [])
+                ):
+                    status.setdefault("conditions", []).append(
+                        {"type": "Ready", "status": "True"}
+                    )
+            elif kind == "Namespace":
+                data.setdefault("status", {})["phase"] = "Active"
+            elif kind == "PersistentVolumeClaim":
+                data.setdefault("status", {})["phase"] = "Bound"
+            elif kind == "CustomResourceDefinition":
+                status = data.setdefault("status", {})
+                if not any(
+                    c.get("type") == "Established"
+                    for c in status.get("conditions", [])
+                ):
+                    status.setdefault("conditions", []).append(
+                        {"type": "Established", "status": "True"}
+                    )
+            elif kind == "Ingress":
+                data.setdefault("status", {})["loadBalancer"] = {
+                    "ingress": [{"ip": "192.0.2.10"}]
+                }
 
     # -- the reconcile pump ------------------------------------------------
 

@@ -28,8 +28,26 @@ def e2e_files(
     views: list[WorkloadView], config: ProjectConfig
 ) -> list[FileSpec]:
     specs = [_common(views, config)]
+    by_workload = {id(v.workload): v for v in views}
+
+    def transitive_deps(workload, seen: set) -> list:
+        """Dependency views in creation order (prerequisites first) —
+        the TRANSITIVE closure: a dependency's own dependencies must
+        also exist, or its DependencyHandler blocks and the chain
+        deadlocks one level deeper."""
+        ordered = []
+        for dep in workload.get_dependencies():
+            if id(dep) in seen or id(dep) not in by_workload:
+                continue
+            seen.add(id(dep))
+            ordered.extend(transitive_deps(dep, seen))
+            ordered.append(by_workload[id(dep)])
+        return ordered
+
     for view in views:
-        specs.append(_workload_test(view))
+        specs.append(
+            _workload_test(view, transitive_deps(view.workload, set()))
+        )
     return specs
 
 
@@ -489,7 +507,9 @@ func apiVersionFor(group, version string) string {{
     )
 
 
-def _workload_test(view: WorkloadView) -> FileSpec:
+def _workload_test(
+    view: WorkloadView, dep_views: list[WorkloadView] | None = None
+) -> FileSpec:
     kind = view.kind
     alias = view.api_import_alias
     pkg = view.package_name
@@ -498,6 +518,7 @@ def _workload_test(view: WorkloadView) -> FileSpec:
     cluster_scoped = view.workload.is_cluster_scoped()
     namespace = tester_namespace(view)
     log_syntax = f"controllers.{view.group}.{kind}"
+    dep_views = dep_views or []
 
     if is_component:
         coll_ns = tester_namespace(coll)
@@ -529,13 +550,58 @@ def _workload_test(view: WorkloadView) -> FileSpec:
         generate_children = f"children, err := {pkg}.Generate(*workload)"
         generate_updated = f"{pkg}.Generate(*updated)"
 
-    extra_imports = ""
+    # dependencies gate the reconciler's Dependency phase on another
+    # workload kind reporting status.created (apis <kind>_types.go
+    # GetDependencyWorkloads + orchestrate DependenciesSatisfied), and
+    # each lifecycle test deletes its own workload at the end — so a
+    # dependent kind's test must create its dependencies itself, in
+    # each dependency's own tester namespace, tolerating earlier tests
+    # having done so.  Without this the suite deadlocks on real
+    # clusters whenever a dependency's test ran (and tore down) first.
+    dependency_setup = ""
+    for dep_view in dep_views:
+        dep_kind = dep_view.kind
+        dep_ns = tester_namespace(dep_view)
+        ns_lines = ""
+        if not dep_view.workload.is_cluster_scoped():
+            ns_lines = f'''\tensureNamespace(t, ctx, "{dep_ns}")
+
+\tif dependency{dep_kind}.GetNamespace() == "" {{
+\t\tdependency{dep_kind}.SetNamespace("{dep_ns}")
+\t}}
+
+'''
+        dependency_setup += f'''\t// {kind} depends on {dep_kind}: create it so the dependency
+\t// phase can observe one reporting created
+\tdependency{dep_kind} := &{dep_view.api_import_alias}.{dep_kind}{{}}
+\tif err := fromSampleYAML({dep_view.package_name}.Sample(false), dependency{dep_kind}); err != nil {{
+\t\tt.Fatalf("unable to decode {dep_kind} dependency sample: %v", err)
+\t}}
+
+{ns_lines}\tif err := k8sClient.Create(ctx, dependency{dep_kind}); err != nil && !errors.IsAlreadyExists(err) {{
+\t\tt.Fatalf("unable to create {dep_kind} dependency: %v", err)
+\t}}
+
+'''
+
+    # imports beyond the workload's own (dedup by alias: a dependency
+    # may share the collection's version package)
+    import_lines: dict = {}
     if is_component:
         if coll.api_types_import != view.api_types_import:
-            extra_imports += (
-                f'\t{coll.api_import_alias} "{coll.api_types_import}"\n'
+            import_lines[coll.api_import_alias] = coll.api_types_import
+        import_lines[coll.package_name] = coll.resources_import
+    for dep_view in dep_views:
+        if dep_view.api_types_import != view.api_types_import:
+            import_lines.setdefault(
+                dep_view.api_import_alias, dep_view.api_types_import
             )
-        extra_imports += f'\t{coll.package_name} "{coll.resources_import}"\n'
+        import_lines.setdefault(
+            dep_view.package_name, dep_view.resources_import
+        )
+    extra_imports = "".join(
+        f'\t{alias_} "{path}"\n' for alias_, path in import_lines.items()
+    )
 
     ns_setup = ""
     if not cluster_scoped:
@@ -655,7 +721,7 @@ func run{kind}Lifecycle(t *testing.T, namespace string) {{
 \t}}
 
 {ns_setup}
-{collection_setup}{create_block}
+{collection_setup}{dependency_setup}{create_block}
 
 \tdefer func() {{
 \t\t_ = k8sClient.Delete(ctx, workload)

@@ -116,6 +116,37 @@ class TestStandaloneE2E:
         )
 
 
+class TestHardFixturesE2E:
+    """The gnarliest fixtures run the whole `test --e2e` flow: these
+    caught two real template/world bugs (CRD children need the
+    cluster's Established condition; a dependent workload's e2e test
+    must create its dependencies or the suite deadlocks once the
+    dependency's own test tears down)."""
+
+    @pytest.mark.parametrize("fixture", ["deps-collection",
+                                         "edge-standalone"])
+    def test_full_project_suite_passes(self, tmp_path, fixture):
+        from operator_forge.gocheck.world import run_project_tests
+
+        proj = _scaffold(str(tmp_path), fixture)
+        results = run_project_tests(proj, include_e2e=True)
+        for res in results:
+            assert res.ok, (res.rel, res.error, res.failures)
+        assert any(res.rel == "test/e2e" for res in results)
+
+    def test_dependency_setup_emitted_for_dependent_kinds(self, tmp_path):
+        proj = _scaffold(str(tmp_path), "deps-collection")
+        path = os.path.join(proj, "test", "e2e", "stack_webapp_test.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        assert "WebApp depends on Database" in text
+        assert "dependencyDatabase" in text
+        # the non-dependent kind carries no dependency setup
+        path = os.path.join(proj, "test", "e2e", "stack_database_test.go")
+        with open(path, encoding="utf-8") as fh:
+            assert "depends on" not in fh.read()
+
+
 class TestCollectionE2E:
     def test_component_and_collection_lifecycles_pass(self, collection):
         world, suite, code, m = _run_e2e(collection)
