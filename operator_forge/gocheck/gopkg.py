@@ -213,7 +213,10 @@ class TypeUniverse:
                 else:
                     out.update(nested)
         for fname, jkey, _type_text in info.fields:
-            out[jkey] = self.encode_value(obj.fields.get(fname))
+            value = self.encode_value(obj.fields.get(fname))
+            if value is None:
+                continue  # omitempty approximation: absent stays absent
+            out[jkey] = value
         return out
 
     def encode_value(self, value):

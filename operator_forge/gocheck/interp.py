@@ -193,6 +193,22 @@ class Closure:
     recv_value: object = None
 
 
+class VarRef:
+    """``&x`` on a bare scalar local: a real reference, so natives that
+    write through pointers (flag registration) update the variable the
+    closure captured.  All other ``&`` stay pointer-transparent."""
+
+    def __init__(self, env: "Env", name: str):
+        self.env = env
+        self.name = name
+
+    def get(self):
+        return self.env.get(self.name)
+
+    def set(self, value):
+        self.env.assign(self.name, value)
+
+
 class _Return(Exception):
     def __init__(self, values):
         self.values = values
@@ -494,17 +510,30 @@ def _wrap_args(fmt: str, args: list) -> list:
 
 
 class _FmtModule:
+    """fmt: Sprintf/Errorf are pure; the printing funcs write to the
+    instance's ``out`` buffer so harnesses can read what an interpreted
+    program printed (the companion CLI's whole contract is stdout)."""
+
+    def __init__(self):
+        self.out: list = []
+
     @staticmethod
     def Sprintf(fmt, *args):
         return _go_format(fmt, list(args))
 
-    @staticmethod
-    def Println(*args):
-        return None
+    def Println(self, *args):
+        self.out.append(
+            " ".join(_go_format("%v", [a]) for a in args) + "\n"
+        )
 
-    @staticmethod
-    def Printf(fmt, *args):
-        return None
+    def Printf(self, fmt, *args):
+        self.out.append(_go_format(fmt, list(args)))
+
+    def Print(self, *args):
+        self.out.append("".join(_go_format("%v", [a]) for a in args))
+
+    def captured(self) -> str:
+        return "".join(self.out)
 
     @staticmethod
     def Errorf(fmt, *args):
@@ -801,37 +830,130 @@ class _OsModule:
     def Getenv(name):
         return ""
 
+    @staticmethod
+    def ReadFile(path):
+        import os as _os
+
+        try:
+            with open(path, "rb") as fh:
+                return (fh.read(), None)
+        except OSError as exc:
+            return (None, GoError(
+                f"open {path}: {_os.strerror(exc.errno) if exc.errno else exc}"
+            ))
+
 
 class _FlagModule:
-    """Command-line flag registration in interpreted main.go: pointers
-    are identity-transparent here, so Var-style registration cannot
-    write the declared default back through *p — bound variables KEEP
-    THEIR ZERO VALUES (Go would assign the default).  Emitted main.go
-    only threads these values into manager options the fake ignores;
-    code that branches on a flag default would take the zero-value
-    path."""
+    """Command-line flag registration in interpreted main.go: ``&x`` on
+    a scalar local yields a VarRef, so Var-style registration assigns
+    the declared default through it, like Go; the interpreted run then
+    proceeds with defaults (no real argv)."""
 
     CommandLine = object()
 
     @staticmethod
-    def StringVar(p, name, value, usage):
+    def _bind(p, value):
+        if isinstance(p, VarRef):
+            p.set(value)
         return None
 
-    @staticmethod
-    def BoolVar(p, name, value, usage):
-        return None
+    @classmethod
+    def StringVar(cls, p, name, value, usage):
+        return cls._bind(p, value)
 
-    @staticmethod
-    def IntVar(p, name, value, usage):
-        return None
+    @classmethod
+    def BoolVar(cls, p, name, value, usage):
+        return cls._bind(p, value)
 
-    @staticmethod
-    def DurationVar(p, name, value, usage):
-        return None
+    @classmethod
+    def IntVar(cls, p, name, value, usage):
+        return cls._bind(p, value)
+
+    @classmethod
+    def DurationVar(cls, p, name, value, usage):
+        return cls._bind(p, value)
 
     @staticmethod
     def Parse():
         return None
+
+
+class _CobraFlagSet:
+    """The cobra FlagSet surface the emitted companion CLI touches:
+    registration records (ref, default, shorthand) per flag so a
+    harness can set values the way cobra's arg parsing would."""
+
+    def __init__(self):
+        self.flags: dict = {}   # name -> {"ref", "default", "short"}
+
+    def _register(self, ref, name, short, value, usage):
+        self.flags[name] = {"ref": ref, "default": value, "short": short}
+        if isinstance(ref, VarRef):
+            ref.set(value)
+        return None
+
+    def StringVar(self, ref, name, value, usage):
+        return self._register(ref, name, "", value, usage)
+
+    def StringVarP(self, ref, name, short, value, usage):
+        return self._register(ref, name, short, value, usage)
+
+    def BoolVar(self, ref, name, value, usage):
+        return self._register(ref, name, "", value, usage)
+
+    def BoolVarP(self, ref, name, short, value, usage):
+        return self._register(ref, name, short, value, usage)
+
+    def by_name_or_short(self, key: str):
+        if key in self.flags:
+            return key, self.flags[key]
+        for name, rec in self.flags.items():
+            if rec["short"] and rec["short"] == key:
+                return name, rec
+        return None, None
+
+
+class _CobraCommand:
+    """github.com/spf13/cobra Command: enough structure (Use tree,
+    flags, required marks, RunE) for a harness to dispatch argv the
+    way cobra's Execute would."""
+
+    def __init__(self):
+        self.Use = ""
+        self.Short = ""
+        self.Long = ""
+        self.Run = None
+        self.RunE = None
+        self.children: list = []
+        self._flags = _CobraFlagSet()
+        self.required: set = set()
+
+    def AddCommand(self, *cmds):
+        self.children.extend(cmds)
+        return None
+
+    def Flags(self):
+        return self._flags
+
+    def PersistentFlags(self):
+        return self._flags
+
+    def MarkFlagRequired(self, name):
+        self.required.add(name)
+        return None
+
+    def name(self) -> str:
+        return (self.Use or "").split()[0] if self.Use else ""
+
+    def find(self, name: str):
+        for child in self.children:
+            if child.name() == name:
+                return child
+        return None
+
+
+class _CobraModule:
+    Command = _CobraCommand
 
 
 class _StringsModule:
@@ -1328,6 +1450,7 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
         "path/filepath": _FilepathModule,
         "flag": _FlagModule,
         "strings": _StringsModule,
+        "github.com/spf13/cobra": _CobraModule,
         "k8s.io/client-go/rest": _RestModule,
         "k8s.io/client-go/kubernetes/scheme": _ClientGoSchemeModule(),
         "k8s.io/apimachinery/pkg/runtime": _K8sRuntimeModule,
@@ -1342,7 +1465,7 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
             _UnstructuredModule,
         "k8s.io/apimachinery/pkg/api/errors": _ApiErrorsModule,
         "errors": _ErrorsModule,
-        "fmt": _FmtModule,
+        "fmt": _FmtModule(),
         "hash/fnv": _FnvModule,
         "time": _TimeModule(sched),
         "k8s.io/apimachinery/pkg/types": _StructModule("NamespacedName"),
@@ -2361,6 +2484,9 @@ class _Eval:
             return
         if kind == "star":
             obj = target[1]
+            if isinstance(obj, VarRef):
+                obj.set(value)
+                return
             if isinstance(obj, GoStruct) and isinstance(value, GoStruct):
                 obj.fields = dict(value.fields)
                 return
@@ -2457,9 +2583,34 @@ class _Eval:
             if t.value == "-":
                 value, pos = self.unary(toks, pos + 1)
                 return -value, pos
-            if t.value in ("*", "&"):
+            if t.value == "&":
+                ref = self._scalar_ref(toks, pos + 1)
+                if ref is not None:
+                    return ref, pos + 2
                 return self.unary(toks, pos + 1)  # pointers transparent
+            if t.value == "*":
+                value, pos = self.unary(toks, pos + 1)
+                if isinstance(value, VarRef):
+                    value = value.get()
+                return value, pos
         return self.postfix(toks, pos)
+
+    def _scalar_ref(self, toks, pos):
+        """A VarRef when toks[pos] is a bare local ident holding a
+        scalar (the flag-binding shape `&probeAddr`); None otherwise."""
+        if toks[pos].kind != IDENT:
+            return None
+        if pos + 1 < len(toks):
+            nxt = toks[pos + 1]
+            if nxt.kind == OP and nxt.value in ".[{(":
+                return None
+        name = toks[pos].value
+        env = self.env
+        if not env.has(name):
+            return None
+        if isinstance(env.get(name), (str, int, float, bool)):
+            return VarRef(env, name)
+        return None
 
     def postfix(self, toks, pos):
         value, pos = self.operand(toks, pos)
@@ -2735,6 +2886,14 @@ class _Eval:
                 arg = self._eval_range(toks, lo, hi, self.env)
                 conv = _NUMERIC_CONVERSIONS[name]
                 return (conv(arg) if arg is not None else 0), hi + 1
+            if name == "string" and _next_is(toks, pos + 1, "("):
+                lo, hi = _group_span(toks, pos + 1)
+                arg = self._eval_range(toks, lo, hi, self.env)
+                if isinstance(arg, (bytes, bytearray)):
+                    return arg.decode(), hi + 1
+                if isinstance(arg, int) and not isinstance(arg, bool):
+                    return chr(arg), hi + 1  # rune conversion
+                return ("" if arg is None else str(arg)), hi + 1
             if name == "new" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 tname = toks[lo].value

@@ -34,6 +34,7 @@ from .interp import (
     GoError,
     GoExit,
     GoStruct,
+    VarRef,
     _ClientModule,
     _CtrlModule,
     _FakeScheme,
@@ -845,3 +846,111 @@ def run_project_tests(root: str, include_e2e: bool = False,
         except Exception as exc:  # interpreter fault: report, don't die
             results.append(SuiteResult(rel, code=1, error=str(exc)))
     return results
+
+
+# ---------------------------------------------------------------------------
+# the emitted companion CLI, executed
+
+
+class CompanionCLI:
+    """Drives the generated companion CLI (cmd/<name>ctl) under the
+    interpreter: NewRootCommand builds the cobra command tree (the
+    per-workload init() registrations already ran at package load),
+    and :meth:`run` dispatches an argv the way cobra's Execute would —
+    subcommand walk, --flag/-f parsing with required-flag enforcement,
+    then the command's RunE.  Reference contract:
+    templates/cli/cmd_{init,generate,version}_sub.go compiled by
+    `make build-cli`."""
+
+    def __init__(self, world: EnvtestWorld, name: str | None = None):
+        self.world = world
+        cmd_dir = os.path.join(world.proj, "cmd")
+        if name is None:
+            candidates = sorted(
+                d for d in os.listdir(cmd_dir)
+                if os.path.isdir(os.path.join(cmd_dir, d))
+            )
+            if not candidates:
+                raise ValueError(f"no companion CLI under {cmd_dir}")
+            name = candidates[0]
+        self.name = name
+        self.commands = world.runtime.package(f"cmd/{name}/commands")
+        self.fmt = world.runtime.natives["fmt"]
+
+    def run(self, argv: list) -> tuple:
+        """(exit_code, stdout, error_message) for one invocation."""
+        root = self.commands.NewRootCommand()
+        cmd = root
+        args = list(argv)
+        while args and not args[0].startswith("-"):
+            child = cmd.find(args[0])
+            if child is None:
+                return (1, "", f"unknown command {args[0]!r} for "
+                               f"{cmd.name() or self.name!r}")
+            cmd = child
+            args.pop(0)
+
+        flags = cmd.Flags()
+        positional: list = []
+        seen: set = set()
+        i = 0
+        while i < len(args):
+            arg = args[i]
+            if arg == "--":
+                # flag terminator, like cobra: the rest is positional
+                positional.extend(args[i + 1:])
+                break
+            if arg.startswith("-") and arg != "-":
+                key, _eq, inline = arg.lstrip("-").partition("=")
+                name, rec = flags.by_name_or_short(key)
+                if rec is None:
+                    return (1, "", f"unknown flag: {arg}")
+                if _eq:
+                    raw = inline
+                elif isinstance(rec["default"], bool):
+                    raw = "true"
+                else:
+                    i += 1
+                    if i >= len(args):
+                        return (1, "", f"flag needs an argument: {arg}")
+                    raw = args[i]
+                if isinstance(rec["default"], bool):
+                    # strconv.ParseBool spellings; anything else is the
+                    # 'invalid argument' error cobra produces
+                    if raw in ("1", "t", "T", "true", "TRUE", "True"):
+                        value = True
+                    elif raw in ("0", "f", "F", "false", "FALSE", "False"):
+                        value = False
+                    else:
+                        return (1, "", f'invalid argument "{raw}" for '
+                                       f'"--{name}" flag')
+                else:
+                    value = raw
+                ref = rec["ref"]
+                if not isinstance(ref, VarRef):
+                    # the bound target was not an addressable scalar
+                    # local (e.g. an options-struct field the
+                    # interpreter keeps pointer-transparent)
+                    return (1, "", f"flag --{name} is bound to a "
+                                   "target the interpreter cannot "
+                                   "write through")
+                ref.set(value)
+                seen.add(name)
+            else:
+                positional.append(arg)
+            i += 1
+
+        missing = sorted(cmd.required - seen)
+        if missing:
+            return (1, "", 'required flag(s) "'
+                    + '", "'.join(missing) + '" not set')
+
+        runner = cmd.RunE if cmd.RunE is not None else cmd.Run
+        if runner is None:
+            return (0, f"usage: {cmd.Use}\n", "")
+        start = len(self.fmt.out)
+        err = self.world.call_interp.call_value(runner, cmd, positional)
+        out = "".join(self.fmt.out[start:])
+        if cmd.RunE is not None and err is not None:
+            return (1, out, err.Error())
+        return (0, out, "")
