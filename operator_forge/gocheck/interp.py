@@ -439,6 +439,26 @@ class _UnstructuredModule:
         return copy.deepcopy(value), True, None
 
 
+def _go_repr(value) -> str:
+    """Go's %v rendering for the composite shapes the emitted code
+    prints: slices as [a b c], maps as map[k:v] with sorted keys."""
+    if value is None:
+        return "<nil>"
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, GoError):
+        return value.msg
+    if isinstance(value, (list, tuple)):
+        return "[" + " ".join(_go_repr(v) for v in value) + "]"
+    if isinstance(value, dict):
+        inner = " ".join(
+            f"{_go_repr(k)}:{_go_repr(v)}"
+            for k, v in sorted(value.items(), key=lambda kv: str(kv[0]))
+        )
+        return f"map[{inner}]"
+    return str(value)
+
+
 def _go_format(fmt: str, args: list) -> str:
     out = []
     ai = 0
@@ -464,14 +484,7 @@ def _go_format(fmt: str, args: list) -> str:
         arg = args[ai] if ai < len(args) else ""
         ai += 1
         if verb in ("s", "v", "w"):
-            if isinstance(arg, GoError):
-                out.append(arg.msg)
-            elif arg is None:
-                out.append("<nil>")
-            elif isinstance(arg, bool):
-                out.append("true" if arg else "false")
-            else:
-                out.append(str(arg))
+            out.append(_go_repr(arg))
         elif verb == "q":
             out.append('"%s"' % arg)
         elif verb == "d":
@@ -940,6 +953,17 @@ class _CobraCommand:
 
     def MarkFlagRequired(self, name):
         self.required.add(name)
+        return None
+
+    # harness-installed dispatcher (argv parsing lives with the
+    # harness, see world.CompanionCLI); Execute consults it so an
+    # interpreted main() is drivable end to end
+    execute_impl = None
+
+    def Execute(self):
+        impl = _CobraCommand.execute_impl
+        if impl is not None:
+            return impl(self)
         return None
 
     def name(self) -> str:

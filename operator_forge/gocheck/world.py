@@ -342,7 +342,10 @@ class GoTestM:
 
     def Run(self):
         code = 0
+        fmt_native = self.suite.world.runtime.natives.get("fmt")
         for name in self.suite.test_names:
+            if fmt_native is not None:
+                fmt_native.out.clear()  # bound print accumulation
             t = GoTestT(name, call_value=self.suite.interp.call_value)
             try:
                 self.suite.interp.call(name, t)
@@ -879,14 +882,42 @@ class CompanionCLI:
 
     def run(self, argv: list) -> tuple:
         """(exit_code, stdout, error_message) for one invocation."""
-        root = self.commands.NewRootCommand()
+        return self.dispatch(self.commands.NewRootCommand(), argv)
+
+    def run_main(self, argv: list) -> int:
+        """Interpret the companion's main.go end to end: main() calls
+        Execute(), which dispatches *argv* through this harness (the
+        cobra os.Args path), and os.Exit unwinds with the code."""
+        from .interp import GoError, GoExit, _CobraCommand
+
+        # the project walk already loaded cmd/<name> (main.go included)
+        interp = self.world.runtime.interp(f"cmd/{self.name}")
+
+        def execute(root):
+            code, _out, err = self.dispatch(root, argv)
+            return GoError(err or "error") if code != 0 else None
+
+        _CobraCommand.execute_impl = execute
+        try:
+            interp.call("main")
+            return 0
+        except GoExit as exc:
+            return exc.code
+        finally:
+            _CobraCommand.execute_impl = None
+
+    def dispatch(self, root, argv: list) -> tuple:
         cmd = root
         args = list(argv)
         while args and not args[0].startswith("-"):
             child = cmd.find(args[0])
             if child is None:
-                return (1, "", f"unknown command {args[0]!r} for "
-                               f"{cmd.name() or self.name!r}")
+                if cmd.children:
+                    # a parent command: an unmatched word is an unknown
+                    # subcommand (cobra errors here)
+                    return (1, "", f"unknown command {args[0]!r} for "
+                                   f"{cmd.name() or self.name!r}")
+                break  # a leaf: remaining words are positional args
             cmd = child
             args.pop(0)
 
@@ -951,6 +982,7 @@ class CompanionCLI:
         start = len(self.fmt.out)
         err = self.world.call_interp.call_value(runner, cmd, positional)
         out = "".join(self.fmt.out[start:])
+        del self.fmt.out[start:]  # captured: keep the buffer bounded
         if cmd.RunE is not None and err is not None:
             return (1, out, err.Error())
         return (0, out, "")

@@ -668,6 +668,60 @@ def resources_fingerprint(proj: str) -> list:
     ])
 
 
+def companion_fingerprint(proj: str) -> list:
+    """The emitted companion CLI, driven end to end: the command tree's
+    shape (Use/Short per node), init in both modes, version, and
+    generate against the emitted sample — plus the required-flag and
+    bad-file error paths."""
+    import tempfile
+
+    from operator_forge.gocheck.world import CompanionCLI, EnvtestWorld
+
+    world = EnvtestWorld(proj)
+    ctl = CompanionCLI(world)
+
+    def tree():
+        root = ctl.commands.NewRootCommand()
+        out = []
+
+        def walk(cmd, depth):
+            out.append((depth, cmd.Use, cmd.Short,
+                        sorted(cmd.Flags().flags), sorted(cmd.required)))
+            for child in cmd.children:
+                walk(child, depth + 1)
+
+        walk(root, 0)
+        return out
+
+    def generate_with_manifest():
+        _code, sample, _err = ctl.run(["init", "bookstore"])
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", delete=False
+        ) as fh:
+            fh.write(sample)
+            path = fh.name
+        try:
+            code, out, err = ctl.run(["generate", "bookstore", "-w", path])
+        finally:
+            os.unlink(path)
+        return (code, out, err.replace(path, "<manifest>"))
+
+    return _scenarios([
+        ("tree", tree),
+        ("init", lambda: ctl.run(["init", "bookstore"])),
+        ("init-required", lambda: ctl.run(["init", "bookstore", "-r"])),
+        ("version", lambda: ctl.run(["version", "bookstore"])),
+        ("generate", generate_with_manifest),
+        ("generate-no-flag",
+         lambda: ctl.run(["generate", "bookstore"])),
+        ("generate-bad-file",
+         lambda: ctl.run(["generate", "bookstore", "-w", "/no/such"])),
+        # main()'s Execute wrapper: exit codes on success and failure
+        ("main-ok", lambda: ctl.run_main(["version", "bookstore"])),
+        ("main-err", lambda: ctl.run_main(["generate", "bookstore"])),
+    ])
+
+
 def project_fingerprint(proj: str) -> list:
     """Controller-level passes through the full emitted pipeline."""
     import yaml
@@ -782,12 +836,23 @@ def project_fingerprint(proj: str) -> list:
 ORCHESTRATE_DIR = os.path.join("pkg", "orchestrate")
 RESOURCES_DIR = os.path.join("apis", "shop", "v1alpha1", "bookstore")
 CONTROLLER_DIR = os.path.join("controllers", "shop")
+CMD_DIR = "cmd"
 
-TARGETS = (ORCHESTRATE_DIR, RESOURCES_DIR, CONTROLLER_DIR)
+TARGETS = (ORCHESTRATE_DIR, RESOURCES_DIR, CONTROLLER_DIR, CMD_DIR)
 
 
 def _target_files(proj: str, rel: str) -> list[str]:
     directory = os.path.join(proj, rel)
+    if rel == CMD_DIR:
+        # the companion CLI is a small tree of packages
+        found = []
+        for dirpath, _dirnames, filenames in os.walk(directory):
+            for name in sorted(filenames):
+                if name.endswith(".go") and not name.endswith("_test.go"):
+                    found.append(os.path.relpath(
+                        os.path.join(dirpath, name), proj
+                    ))
+        return sorted(found)
     return [
         os.path.join(rel, name)
         for name in sorted(os.listdir(directory))
@@ -804,6 +869,7 @@ def run_battery(proj: str):
             os.path.join(proj, ORCHESTRATE_DIR)),
         "resources": resources_fingerprint(proj),
         "project": project_fingerprint(proj),
+        "companion": companion_fingerprint(proj),
     }
     results: dict[str, list] = {t: [] for t in TARGETS}
     for target in TARGETS:
@@ -825,6 +891,13 @@ def run_battery(proj: str):
 
 def _verdict(proj: str, target: str, baselines) -> str | None:
     """The oracle that killed the mutant, or None if it survived."""
+    if target == CMD_DIR:
+        try:
+            if companion_fingerprint(proj) != baselines["companion"]:
+                return "companion-fingerprint"
+        except Exception:
+            return "companion-fingerprint"
+        return None
     if target == ORCHESTRATE_DIR:
         try:
             if orchestrate_fingerprint(

@@ -55,6 +55,13 @@ class TestMutationKillRates:
         assert total >= 10
         assert rate >= 0.80
 
+    def test_companion_kill_rate_at_least_80_percent(self, battery):
+        _killed, total, rate = oracle.kill_stats(
+            battery[oracle.CMD_DIR]
+        )
+        assert total >= 5
+        assert rate >= 0.80
+
     def test_every_survivor_is_triaged(self, battery):
         untriaged = []
         for entries in battery.values():
