@@ -451,9 +451,17 @@ def _go_repr(value) -> str:
     if isinstance(value, (list, tuple)):
         return "[" + " ".join(_go_repr(v) for v in value) + "]"
     if isinstance(value, dict):
+        # fmt orders int keys numerically, everything else textually
+        numeric = all(
+            isinstance(k, int) and not isinstance(k, bool) for k in value
+        )
+        items = sorted(
+            value.items(),
+            key=(lambda kv: kv[0]) if numeric
+            else (lambda kv: str(kv[0])),
+        )
         inner = " ".join(
-            f"{_go_repr(k)}:{_go_repr(v)}"
-            for k, v in sorted(value.items(), key=lambda kv: str(kv[0]))
+            f"{_go_repr(k)}:{_go_repr(v)}" for k, v in items
         )
         return f"map[{inner}]"
     return str(value)
@@ -1685,7 +1693,12 @@ class Interp:
         if key not in self.methods:
             raise GoInterpError(f"no method {tname}.{name} loaded")
         fn, scan = self.methods[key]
-        return self._invoke(fn, scan, recv, list(args))
+        # the registry is shared across a project's linked packages:
+        # execute under the method's OWN package interpreter, so its
+        # package-level names and imports resolve (same rule as
+        # _call_value's closure dispatch)
+        owner = getattr(scan, "interp", None) or self
+        return owner._invoke(fn, scan, recv, list(args))
 
     def call_value(self, value, *args):
         """Invoke any callable interpreter value (e.g. a func-literal

@@ -208,6 +208,10 @@ class FakeClusterClient:
                 del self.workloads[key]
                 return None
             if world is not None:
+                if not deleting:
+                    err = world._admission(stored, "ValidateUpdate")
+                    if err is not None:
+                        return err
                 world.enqueue(obj.tname, key[1], key[2])
         return None
 
@@ -411,6 +415,14 @@ class WorldManager(FakeManager):
         kind = for_obj.tname if isinstance(for_obj, GoStruct) else None
         self.registered.append((kind, reconciler))
 
+    def RegisterWebhookFor(self, for_obj):
+        # ctrl.NewWebhookManagedBy(mgr).For(&Kind{}).Complete() lands
+        # here: the world's admission path then runs the kind's
+        # Default/ValidateCreate methods on create, like a cluster
+        # with the webhook server deployed
+        if isinstance(for_obj, GoStruct):
+            self.world.webhook_kinds.add(for_obj.tname)
+
     def Start(self, ctx):
         self.started = True
         self.start_ctx = ctx
@@ -532,6 +544,7 @@ class EnvtestWorld:
         self.env_started = False
         self.env_stopped = False
         self.simulate_cluster = False  # builtin controllers (e2e mode)
+        self.webhook_kinds: set = set()  # kinds with admission webhooks
         self.pending: list = []  # {due, kind, ns, name}
         self.reconcile_log: list = []  # (kind, ns, name, result, err)
         self.runtime = ProjectRuntime(proj, extra_natives={})
@@ -606,6 +619,39 @@ class EnvtestWorld:
         ):
             return GoError(
                 f'no matches for kind "{obj.tname}": CRD not installed'
+            )
+        return self._admission(obj, "ValidateCreate")
+
+    def _admission(self, obj: GoStruct, validate_method: str):
+        """Mutating then validating admission, in the apiserver's call
+        order — running only the hooks the project actually scaffolds
+        (a defaulting-only project has no Validate* methods, and a real
+        cluster simply doesn't call the absent webhook)."""
+        if obj.tname not in self.webhook_kinds:
+            return None
+        methods = self.runtime.methods
+        try:
+            if (obj.tname, "Default") in methods:
+                self.call_interp.call_method(obj, "Default")
+            err = None
+            if (obj.tname, validate_method) in methods:
+                if validate_method == "ValidateUpdate":
+                    # the aliased store holds no pre-update snapshot;
+                    # the live object stands in for `old` (validations
+                    # inspecting the NEW state — the common shape —
+                    # behave exactly as on a cluster)
+                    err = self.call_interp.call_method(
+                        obj, validate_method, obj
+                    )
+                else:
+                    err = self.call_interp.call_method(
+                        obj, validate_method
+                    )
+        except Exception as exc:
+            return GoError(f"admission webhook failed: {exc}")
+        if err is not None:
+            return GoError(
+                f"admission webhook denied the request: {err.Error()}"
             )
         return None
 
@@ -897,6 +943,7 @@ class CompanionCLI:
             code, _out, err = self.dispatch(root, argv)
             return GoError(err or "error") if code != 0 else None
 
+        prior = _CobraCommand.execute_impl
         _CobraCommand.execute_impl = execute
         try:
             interp.call("main")
@@ -904,7 +951,7 @@ class CompanionCLI:
         except GoExit as exc:
             return exc.code
         finally:
-            _CobraCommand.execute_impl = None
+            _CobraCommand.execute_impl = prior
 
     def dispatch(self, root, argv: list) -> tuple:
         cmd = root

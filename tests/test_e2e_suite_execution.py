@@ -147,6 +147,156 @@ class TestHardFixturesE2E:
             assert "depends on" not in fh.read()
 
 
+class TestWebhookAdmissionInWorld:
+    """Admission webhooks run in the e2e world the way a cluster with
+    the webhook server deployed runs them: the interpreted main.go's
+    SetupWebhookWithManager registers the kind, and the fake apiserver
+    then defaults and validates every typed create."""
+
+    def _webhook_project(self, standalone, tmp_path) -> str:
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        subprocess.run(
+            [sys.executable, "-m", "operator_forge", "create", "webhook",
+             "--workload-config", os.path.join(proj, "workload.yaml"),
+             "--output-dir", proj, "--defaulting",
+             "--programmatic-validation"],
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        # fill the user-owned stubs the way a user would: default the
+        # replica count, reject non-positive service ports
+        path = os.path.join(
+            proj, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        text = text.replace(
+            "\t// TODO: fill in defaulting logic.\n",
+            "\tif r.Spec.Deployment.Replicas == 0 {\n"
+            "\t\tr.Spec.Deployment.Replicas = 3\n"
+            "\t}\n",
+        )
+        text = text.replace(
+            "\t// TODO: fill in create validation logic.\n",
+            "\tif r.Spec.Service.Port <= 0 {\n"
+            '\t\treturn fmt.Errorf("service port must be positive")\n'
+            "\t}\n",
+        )
+        text = text.replace(
+            'import (\n\t"k8s.io/apimachinery/pkg/runtime"\n',
+            'import (\n\t"fmt"\n\n\t"k8s.io/apimachinery/pkg/runtime"\n',
+        )
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        return proj
+
+    def test_admission_defaults_and_denies(self, standalone, tmp_path):
+        import yaml as pyyaml
+
+        proj = self._webhook_project(standalone, tmp_path)
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.simulate_cluster = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        world.start_operator()
+        assert "BookStore" in world.webhook_kinds
+
+        pkg = world.runtime.package("apis/shop/v1alpha1/bookstore")
+        # required-only sample: replicas 0 -> defaulted to 3 on create
+        cr = pyyaml.safe_load(pkg.Sample(True))
+        cr["metadata"]["namespace"] = "default"
+        workload = world.runtime.decode_cr(cr)
+        err = world.client.Create(None, workload)
+        assert err is None
+        spec = workload.fields["Spec"]
+        assert spec.fields["Deployment"].fields["Replicas"] == 3
+
+        # an invalid CR is denied, like a real admission response
+        bad = world.runtime.decode_cr(pyyaml.safe_load(pkg.Sample(False)))
+        bad.SetName("bad-store")
+        bad.SetNamespace("default")
+        bad.fields["Spec"].fields["Service"].fields["Port"] = -1
+        err = world.client.Create(None, bad)
+        assert err is not None
+        assert "admission webhook denied" in err.Error()
+        assert ("BookStore", "default", "bad-store") not in (
+            world.client.workloads
+        )
+
+    def test_defaulting_only_project_admits_creates(
+        self, standalone, tmp_path
+    ):
+        # a project scaffolded with --defaulting alone has no
+        # Validate* methods; the absent validating webhook simply is
+        # not called (a real cluster behaves the same)
+        import yaml as pyyaml
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        subprocess.run(
+            [sys.executable, "-m", "operator_forge", "create", "webhook",
+             "--workload-config", os.path.join(proj, "workload.yaml"),
+             "--output-dir", proj, "--defaulting"],
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        world.start_operator()
+        assert "BookStore" in world.webhook_kinds
+        pkg = world.runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = pyyaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = "default"
+        err = world.client.Create(None, world.runtime.decode_cr(cr))
+        assert err is None
+
+    def test_update_admission_denies_invalid_mutation(
+        self, standalone, tmp_path
+    ):
+        import yaml as pyyaml
+
+        proj = self._webhook_project(standalone, tmp_path)
+        # extend the user validation to updates (the scaffolded
+        # ValidateUpdate is a stub): reject non-positive ports there too
+        path = os.path.join(
+            proj, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                "\t// TODO: fill in update validation logic.\n",
+                "\tif r.Spec.Service.Port <= 0 {\n"
+                '\t\treturn fmt.Errorf("service port must be positive")\n'
+                "\t}\n",
+            ))
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        world.start_operator()
+        pkg = world.runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = pyyaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = "default"
+        workload = world.runtime.decode_cr(cr)
+        assert world.client.Create(None, workload) is None
+        workload.fields["Spec"].fields["Service"].fields["Port"] = -5
+        err = world.client.Update(None, workload)
+        assert err is not None
+        assert "admission webhook denied" in err.Error()
+
+    def test_webhook_project_full_suite_still_passes(
+        self, standalone, tmp_path
+    ):
+        from operator_forge.gocheck.world import run_project_tests
+
+        proj = self._webhook_project(standalone, tmp_path)
+        results = run_project_tests(proj, include_e2e=True)
+        for res in results:
+            assert res.ok, (res.rel, res.error, res.failures)
+
+
 class TestCollectionE2E:
     def test_component_and_collection_lifecycles_pass(self, collection):
         world, suite, code, m = _run_e2e(collection)
