@@ -308,6 +308,51 @@ class YamlPackage:
         return pyyaml.safe_dump(value, sort_keys=False).encode(), None
 
 
+class JsonPackage:
+    """Native encoding/json over the project's TypeUniverse: the
+    emitted conversion stubs round-trip typed values through
+    Marshal/Unmarshal (templates/webhook.py ConvertTo/ConvertFrom),
+    which maps to encode/decode here exactly like sigs.k8s.io/yaml."""
+
+    def __init__(self, universe: TypeUniverse):
+        self.universe = universe
+
+    def Marshal(self, obj):
+        import json as pyjson
+
+        if isinstance(obj, GoStruct):
+            data = self.universe.encode(obj)
+        elif hasattr(obj, "Object"):
+            data = obj.Object
+        else:
+            data = obj
+        try:
+            return (pyjson.dumps(data).encode(), None)
+        except (TypeError, ValueError) as exc:
+            return (None, GoError(f"json: {exc}"))
+
+    def Unmarshal(self, data, obj):
+        import json as pyjson
+
+        text = data.decode() if isinstance(data, (bytes, bytearray)) else data
+        try:
+            parsed = pyjson.loads(text)
+        except ValueError as exc:
+            return GoError(f"invalid character: {exc}")
+        if isinstance(obj, GoStruct):
+            if not isinstance(parsed, dict):
+                return GoError(
+                    f"json: cannot unmarshal into Go value of type "
+                    f"{obj.tname}"
+                )
+            self.universe.decode(obj.tname, parsed, into=obj)
+            return None
+        if hasattr(obj, "Object"):
+            obj.Object = parsed
+            return None
+        return GoError(f"unsupported unmarshal target: {obj!r}")
+
+
 class GoPackage:
     """A loaded package exposed as a native module: funcs become Python
     callables, package vars/consts resolve directly, and struct types
@@ -368,6 +413,7 @@ class ProjectRuntime:
         self.sched = Scheduler()
         self.natives = default_natives(self.sched)
         self.natives["sigs.k8s.io/yaml"] = YamlPackage(self.universe)
+        self.natives["encoding/json"] = JsonPackage(self.universe)
         if extra_natives:
             self.natives.update(extra_natives)
         self.methods: dict = {}

@@ -164,6 +164,24 @@ class GoObject(GoStruct):
         self.fields["OwnerReferences"] = refs
 
 
+class _TypeMetaView:
+    """``obj.TypeMeta`` on a root kind: Go reaches the embedded
+    metav1.TypeMeta by name; here APIVersion/Kind live promoted in the
+    object's fields, so the view reads and writes through them (the
+    emitted conversion stubs assign dst.TypeMeta.APIVersion)."""
+
+    def __init__(self, obj: "GoStruct"):
+        object.__setattr__(self, "_obj", obj)
+
+    def __getattr__(self, name):
+        if name in ("APIVersion", "Kind"):
+            return self._obj.fields.get(name, "")
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        self._obj.fields[name] = value
+
+
 @dataclass
 class TypeRef:
     name: str
@@ -1491,6 +1509,8 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
         "github.com/go-logr/logr": _LogrModule,
         "k8s.io/client-go/tools/record": _RecordModule,
         "sigs.k8s.io/controller-runtime/pkg/healthz": _HealthzModule,
+        "sigs.k8s.io/controller-runtime/pkg/conversion":
+            _StructModule("Hub"),
         "sigs.k8s.io/controller-runtime/pkg/scheme": _SchemeBuilderModule,
         "sigs.k8s.io/controller-runtime/pkg/log/zap": _ZapModule,
         "k8s.io/apimachinery/pkg/apis/meta/v1/unstructured":
@@ -1576,6 +1596,11 @@ class Interp:
         self._pending_values: list = []
         self.inits: list = []       # package init funcs, in load order
         self.init_errors: list = []
+        # methods THIS package declares: preferred over the shared
+        # registry, so same-named kinds across API versions (two
+        # spokes both declaring BookStore.ConvertTo) dispatch to the
+        # version the caller's package actually declares
+        self.own_methods: dict[tuple, tuple] = {}
 
     # -- loading ----------------------------------------------------------
 
@@ -1601,6 +1626,7 @@ class Interp:
                 base = _recv_base(fn["recv"][1])
                 if base:
                     self.methods[(base, fn["name"])] = (fn, scan)
+                    self.own_methods[(base, fn["name"])] = (fn, scan)
         for td in scan.typedecls:
             self.types.add(td["name"])
             if td.get("kind") == "struct" and td.get("embeds"):
@@ -1690,10 +1716,12 @@ class Interp:
     def call_method(self, recv, name: str, *args):
         tname = recv.tname if isinstance(recv, GoStruct) else None
         key = (tname, name)
-        if key not in self.methods:
+        # prefer a method THIS package declares (API versions reuse
+        # kind names; the shared registry is last-load-wins for those)
+        entry = self.own_methods.get(key) or self.methods.get(key)
+        if entry is None:
             raise GoInterpError(f"no method {tname}.{name} loaded")
-        fn, scan = self.methods[key]
-        # the registry is shared across a project's linked packages:
+        fn, scan = entry
         # execute under the method's OWN package interpreter, so its
         # package-level names and imports resolve (same rule as
         # _call_value's closure dispatch)
@@ -2512,6 +2540,12 @@ class _Eval:
         if kind == "sel":
             obj, name = target[1], target[2]
             if isinstance(obj, GoStruct):
+                if name == "TypeMeta" and isinstance(value, _TypeMetaView):
+                    # dst.TypeMeta = src.TypeMeta copies the VALUE in
+                    # Go; copy the promoted fields, don't store a view
+                    obj.fields["APIVersion"] = value.APIVersion
+                    obj.fields["Kind"] = value.Kind
+                    return
                 obj.fields[name] = value
             else:
                 setattr(obj, name, value)
@@ -2665,8 +2699,12 @@ class _Eval:
                     continue
                 if isinstance(value, GoStruct) and nxt.value not in value.fields:
                     key = (value.tname, nxt.value)
-                    if key in self.interp.methods:
-                        fn, scan = self.interp.methods[key]
+                    entry = (
+                        self.interp.own_methods.get(key)
+                        or self.interp.methods.get(key)
+                    )
+                    if entry is not None:
+                        fn, scan = entry
                         value = Closure(fn, scan, Env(), recv_value=value)
                         pos += 2
                         continue
@@ -2730,7 +2768,10 @@ class _Eval:
                     v = zero_cls()
                     struct.fields[fname] = v
             if isinstance(v, GoStruct):
-                entry = self.interp.methods.get((v.tname, name))
+                entry = (
+                    self.interp.own_methods.get((v.tname, name))
+                    or self.interp.methods.get((v.tname, name))
+                )
                 if entry is not None:
                     fn, scan = entry
                     return Closure(fn, scan, Env(), recv_value=v)
@@ -3137,6 +3178,8 @@ def _get_attr(obj, name):
     if isinstance(obj, GoStruct):
         if name in obj.fields:
             return obj.fields[name]
+        if name == "TypeMeta" and isinstance(obj, GoObject):
+            return _TypeMetaView(obj)
         # GoObject supplies metav1-promoted accessors as Python
         # callables; a field miss falls through to them (the method
         # registry was already consulted by postfix, so emitted Go

@@ -202,16 +202,27 @@ class FakeClusterClient:
             stored = self.workloads.get(key)
             if stored is None:
                 return GoError(f"{obj.tname} not found", not_found=True)
+            if world is not None:
+                # update webhooks run on every update — finalizer
+                # writes on deleting objects included, as a real
+                # apiserver calls them.  Validation sees the INCOMING
+                # object; under the aliased model a denial cannot
+                # roll back mutations the caller already made through
+                # the live reference (documented boundary).
+                err = world._admission(obj, "ValidateUpdate")
+                if err is not None:
+                    return err
             ts = stored.fields.get("DeletionTimestamp")
             deleting = ts is not None and not ts.IsZero()
             if deleting and not stored.GetFinalizers():
                 del self.workloads[key]
                 return None
+            if stored is not obj:
+                # a freshly-decoded object updates the stored content
+                # (apiserver PUT semantics); aliased callers already
+                # wrote through the live reference
+                stored.fields = obj.fields
             if world is not None:
-                if not deleting:
-                    err = world._admission(stored, "ValidateUpdate")
-                    if err is not None:
-                        return err
                 world.enqueue(obj.tname, key[1], key[2])
         return None
 

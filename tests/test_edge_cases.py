@@ -237,6 +237,73 @@ class TestConversionWebhooks:
         problems = lint_project(out)
         assert not problems, "\n".join(problems)
 
+    def test_emitted_conversion_round_trips(self, tmp_path):
+        """The spoke's ConvertTo/ConvertFrom EXECUTE: the JSON
+        round-trip the emitted stubs implement must carry the spec
+        across versions intact and restamp TypeMeta — what a real
+        conversion webhook does for a multi-version CRD."""
+        import yaml as pyyaml
+
+        from operator_forge.gocheck.gopkg import ProjectRuntime
+
+        out, _, _ = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
+        runtime = ProjectRuntime(out)
+        spoke_api = runtime.interp("apis/shop/v1alpha1")
+        pkg = runtime.package("apis/shop/v1beta1/bookstore")
+
+        src = runtime.decode_cr(pyyaml.safe_load(pkg.Sample(False)))
+        src.fields["Spec"].fields["Deployment"].fields["Replicas"] = 7
+
+        hub = runtime.universe.make("BookStore")
+        err = spoke_api.call_method(src, "ConvertTo", hub)
+        assert err is None
+        assert runtime.universe.encode(hub)["spec"] == (
+            runtime.universe.encode(src)["spec"]
+        )
+        assert hub.fields["APIVersion"] == "shop.example.io/v1beta1"
+        assert hub.fields["Kind"] == "BookStore"
+
+        back = runtime.universe.make("BookStore")
+        err = spoke_api.call_method(back, "ConvertFrom", hub)
+        assert err is None
+        assert runtime.universe.encode(back)["spec"] == (
+            runtime.universe.encode(src)["spec"]
+        )
+        assert back.fields["APIVersion"] == "shop.example.io/v1alpha1"
+
+        # the guard path: a non-hub value is refused, not mangled
+        err = spoke_api.call_method(
+            src, "ConvertTo", runtime.universe.make("Other")
+        )
+        assert err is not None and "unexpected conversion hub type" in (
+            err.Error()
+        )
+
+    def test_three_version_spokes_dispatch_their_own_conversion(
+        self, tmp_path
+    ):
+        """Two spokes declare the same (BookStore, ConvertFrom): each
+        package interpreter must run ITS OWN stub — the v1alpha1 spoke
+        stamps v1alpha1, the v1beta1 spoke stamps v1beta1 — not
+        whichever loaded last into the shared method registry."""
+        import yaml as pyyaml
+
+        from operator_forge.gocheck.gopkg import ProjectRuntime
+
+        out, _, _ = self._scaffold(tmp_path, ["v1alpha1", "v1beta1", "v1"])
+        runtime = ProjectRuntime(out)
+        pkg = runtime.package("apis/shop/v1/bookstore")
+        hub = runtime.decode_cr(pyyaml.safe_load(pkg.Sample(False)))
+
+        for spoke_version in ("v1alpha1", "v1beta1"):
+            spoke_api = runtime.interp(f"apis/shop/{spoke_version}")
+            dst = runtime.universe.make("BookStore")
+            err = spoke_api.call_method(dst, "ConvertFrom", hub)
+            assert err is None
+            assert dst.fields["APIVersion"] == (
+                f"shop.example.io/{spoke_version}"
+            ), spoke_version
+
     def test_hub_migration_and_user_spoke_preserved(self, tmp_path):
         out, work, config = self._scaffold(tmp_path, ["v1alpha1", "v1beta1"])
 
