@@ -212,16 +212,25 @@ class FakeClusterClient:
                 err = world._admission(obj, "ValidateUpdate")
                 if err is not None:
                     return err
+            if stored is not obj:
+                # a freshly-decoded object updates the stored content
+                # (apiserver PUT semantics) — except the fields the
+                # apiserver owns: deletionTimestamp is immutable and
+                # status writes take the status subresource path
+                preserved_ts = stored.fields.get("DeletionTimestamp")
+                preserved_status = stored.fields.get("Status")
+                stored.fields = obj.fields
+                if preserved_ts is not None:
+                    stored.fields["DeletionTimestamp"] = preserved_ts
+                if preserved_status is not None:
+                    stored.fields["Status"] = preserved_status
+            # deletion state AFTER the merge: removing the last
+            # finalizer from a deletion-marked object commits the delete
             ts = stored.fields.get("DeletionTimestamp")
             deleting = ts is not None and not ts.IsZero()
             if deleting and not stored.GetFinalizers():
                 del self.workloads[key]
                 return None
-            if stored is not obj:
-                # a freshly-decoded object updates the stored content
-                # (apiserver PUT semantics); aliased callers already
-                # wrote through the live reference
-                stored.fields = obj.fields
             if world is not None:
                 world.enqueue(obj.tname, key[1], key[2])
         return None

@@ -662,19 +662,16 @@ def _workload_test(
 \t// spec mutation whose effect on children can be asserted
 '''
 
-    if view.is_collection():
-        # a component test of this suite may have pre-created the
-        # collection (they share the sample); adopt it instead of failing
-        create_block = '''\t// create (adopting a collection a component test already created)
+    # adopt a pre-existing object instead of failing: another test of
+    # this suite may have created it already — components pre-create
+    # their collection AND their dependency workloads (see the
+    # dependency setup above), so any kind can exist by the time its
+    # own lifecycle test runs
+    create_block = '''\t// create (adopting an object another test already created)
 \tif err := k8sClient.Create(ctx, workload); err != nil {
 \t\tif !errors.IsAlreadyExists(err) {
 \t\t\tt.Fatalf("unable to create workload: %v", err)
 \t\t}
-\t}'''
-    else:
-        create_block = '''\t// create
-\tif err := k8sClient.Create(ctx, workload); err != nil {
-\t\tt.Fatalf("unable to create workload: %v", err)
 \t}'''
 
     multi_test = ""

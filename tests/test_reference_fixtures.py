@@ -140,6 +140,39 @@ class TestFunctionalCases:
             errs = validate_cr(out, sample)
             assert not errs, f"{path}: {errs}"
 
+    @pytest.mark.parametrize(
+        "case",
+        ["standalone", "edge-standalone", "collection", "edge-collection"],
+    )
+    def test_case_project_test_suite_passes(self, tmp_path, case):
+        """The reference CI's whole contract for these cases is that
+        the generated project compiles and its tests pass
+        (.github/workflows/test.yaml:55-141).  The interpreted
+        `go test ./...` equivalent — unit, envtest, and the e2e
+        lifecycle with the operator running via interpreted main.go —
+        must hold for the projects operator-forge generates from the
+        SAME verbatim configs."""
+        from operator_forge.gocheck.world import run_project_tests
+
+        config = os.path.join(CASES, case, ".workloadConfig", "workload.yaml")
+        out = str(tmp_path / "project")
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/acme-cnp-mgr",
+             "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--controller", "true", "--resource", "true",
+             "--output-dir", out]
+        ) == 0
+
+        results = run_project_tests(out, include_e2e=True)
+        assert results, "no test packages discovered"
+        for res in results:
+            assert res.ok, (case, res.rel, res.error, res.failures)
+        assert any(res.rel == "test/e2e" for res in results)
+
     @pytest.mark.parametrize("case", ["standalone", "edge-standalone"])
     def test_standalone_samples_preview(self, tmp_path, case):
         """The generated sample CR renders child manifests through
