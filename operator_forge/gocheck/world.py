@@ -21,6 +21,14 @@ contract interpreter-side:
   runs them through TestMain, the way ``go test`` would; and
   :func:`run_project_tests`, the ``go test ./...`` driver the CLI's
   ``test`` command exposes.
+- :class:`CompanionCLI` — drives the generated cobra command tree the
+  way a compiled companion binary would (argv dispatch, flag parsing,
+  required-flag enforcement, interpreted main()).
+
+Admission webhooks registered by the interpreted main.go run in the
+apiserver path (Default/ValidateCreate on create, Default/
+ValidateUpdate on update), and updates follow PUT semantics with the
+apiserver-owned fields (deletionTimestamp, status) preserved.
 """
 
 import copy
@@ -222,6 +230,10 @@ class FakeClusterClient:
                 stored.fields = obj.fields
                 if preserved_ts is not None:
                     stored.fields["DeletionTimestamp"] = preserved_ts
+                else:
+                    # a client cannot SET deletionTimestamp either: the
+                    # apiserver strips it from updates of live objects
+                    stored.fields.pop("DeletionTimestamp", None)
                 if preserved_status is not None:
                     stored.fields["Status"] = preserved_status
             # deletion state AFTER the merge: removing the last

@@ -32,7 +32,11 @@ def _scaffold(root: str, fixture: str) -> str:
     proj = os.path.join(root, "proj")
     os.makedirs(proj, exist_ok=True)
     for name in os.listdir(os.path.join(FIXTURES, fixture)):
-        shutil.copy(os.path.join(FIXTURES, fixture, name), proj)
+        src = os.path.join(FIXTURES, fixture, name)
+        if os.path.isdir(src):
+            shutil.copytree(src, os.path.join(proj, name))
+        else:
+            shutil.copy(src, proj)
     config = os.path.join(proj, "workload.yaml")
     base = [sys.executable, "-m", "operator_forge"]
     for sub in (["init"], ["create", "api"]):
@@ -124,7 +128,11 @@ class TestHardFixturesE2E:
     dependency's own test tears down)."""
 
     @pytest.mark.parametrize("fixture", ["deps-collection",
-                                         "edge-standalone"])
+                                         "edge-standalone",
+                                         "edge-collection",
+                                         "kitchen-sink",
+                                         "multigroup",
+                                         "tpu-workload"])
     def test_full_project_suite_passes(self, tmp_path, fixture):
         from operator_forge.gocheck.world import run_project_tests
 
