@@ -573,8 +573,16 @@ def cmd_test(args: argparse.Namespace) -> int:
     if not os.path.isdir(root):
         print(f"error: {root} is not a directory", file=sys.stderr)
         return 1
+    if args.run:
+        import re as _re
+
+        try:
+            _re.compile(args.run)
+        except _re.error as exc:
+            print(f"error: invalid --run pattern: {exc}", file=sys.stderr)
+            return 1
     results = run_project_tests(
-        root, include_e2e=args.e2e,
+        root, include_e2e=args.e2e, run_filter=args.run or None,
         progress=lambda rel: print(f"--- {rel}"),
     )
     if not results:
@@ -751,6 +759,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--e2e", action="store_true",
         help="also run the e2e lifecycle suite (interprets main.go and "
              "simulates the cluster's builtin controllers)",
+    )
+    p_test.add_argument(
+        "--run", default="", metavar="REGEX",
+        help="run only tests matching the pattern (go test -run)",
     )
     p_test.set_defaults(func=cmd_test)
 

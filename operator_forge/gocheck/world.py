@@ -72,6 +72,9 @@ class FakeClusterClient:
         self.children: dict = {}    # key -> dict (unstructured content)
         self.applied: list = []
         self.deleted: list = []
+        # keys the SERVER deletion-marked (via Delete): only these may
+        # carry a deletionTimestamp — a client cannot set one
+        self.deletion_marked: set = set()
         self.status = FakeStatusWriter()
 
     # -- store helpers (test-side) ----------------------------------------
@@ -230,18 +233,19 @@ class FakeClusterClient:
                 stored.fields = obj.fields
                 if preserved_ts is not None:
                     stored.fields["DeletionTimestamp"] = preserved_ts
-                else:
-                    # a client cannot SET deletionTimestamp either: the
-                    # apiserver strips it from updates of live objects
-                    stored.fields.pop("DeletionTimestamp", None)
                 if preserved_status is not None:
                     stored.fields["Status"] = preserved_status
+            if key not in self.deletion_marked:
+                # deletionTimestamp is server-owned: a client cannot
+                # set it (aliased writes included); only Delete marks
+                stored.fields.pop("DeletionTimestamp", None)
             # deletion state AFTER the merge: removing the last
             # finalizer from a deletion-marked object commits the delete
             ts = stored.fields.get("DeletionTimestamp")
             deleting = ts is not None and not ts.IsZero()
             if deleting and not stored.GetFinalizers():
                 del self.workloads[key]
+                self.deletion_marked.discard(key)
                 return None
             if world is not None:
                 world.enqueue(obj.tname, key[1], key[2])
@@ -266,10 +270,12 @@ class FakeClusterClient:
             # finalizers pin the object: mark deletion and notify, the
             # way a real apiserver turns delete into an update event
             stored.fields["DeletionTimestamp"] = _Timestamp(zero=False)
+            self.deletion_marked.add(key)
             if world is not None:
                 world.enqueue(obj.tname, key[1], key[2])
         else:
             del self.workloads[key]
+            self.deletion_marked.discard(key)
         return None
 
     def Status(self):
@@ -312,17 +318,28 @@ class GoTestFailure(Exception):
 class GoTestT:
     """The *testing.T surface the emitted tests touch."""
 
-    def __init__(self, name: str, call_value=None):
+    def __init__(self, name: str, call_value=None, sub_filters=None):
         self.name = name
         self.failed = False
         self.messages: list = []
         self.call_value = call_value  # closure invoker, for t.Run
+        self.sub_filters = sub_filters or []  # go test -run '/' tail
 
     def Parallel(self):
         return None  # cooperative scheduler: tests already serialize
 
     def Run(self, name, fn):
-        sub = GoTestT(f"{self.name}/{name}", call_value=self.call_value)
+        if self.sub_filters:
+            import re
+
+            if self.sub_filters[0] and not re.search(
+                self.sub_filters[0], name
+            ):
+                return True  # filtered out, like go test -run A/B
+        sub = GoTestT(
+            f"{self.name}/{name}", call_value=self.call_value,
+            sub_filters=self.sub_filters[1:],
+        )
         try:
             self.call_value(fn, sub)
         except GoTestFailure:
@@ -382,7 +399,8 @@ class GoTestM:
         for name in self.suite.test_names:
             if fmt_native is not None:
                 fmt_native.out.clear()  # bound print accumulation
-            t = GoTestT(name, call_value=self.suite.interp.call_value)
+            t = GoTestT(name, call_value=self.suite.interp.call_value,
+                        sub_filters=self.suite.sub_filters)
             try:
                 self.suite.interp.call(name, t)
             except GoTestFailure:
@@ -804,9 +822,11 @@ class EmittedSuite:
     interpreter and runs them through TestMain, the way ``go test``
     would."""
 
-    def __init__(self, world: EnvtestWorld, rel: str):
+    def __init__(self, world: EnvtestWorld, rel: str,
+                 run_filter: str | None = None):
         self.world = world
         self.rel = rel
+        self.run_filter = run_filter  # go test -run: regex over names
         world.pkg_dir = os.path.join(world.proj, rel)
         self.interp = world.runtime.ensure_package(rel)
         if not self.interp.scans:
@@ -826,6 +846,20 @@ class EmittedSuite:
             name for name in self.interp.funcs
             if name.startswith("Test") and name != "TestMain"
         ]
+        self.sub_filters: list = []
+        if run_filter:
+            import re
+
+            # go test -run: '/'-separated elements — the first selects
+            # top-level tests, the rest filter t.Run subtests per level
+            parts = run_filter.split("/")
+            pattern = re.compile(parts[0]) if parts[0] else None
+            self.sub_filters = parts[1:]
+            if pattern is not None:
+                self.test_names = [
+                    name for name in self.test_names
+                    if pattern.search(name)
+                ]
 
     def run(self) -> tuple:
         """Execute TestMain; returns (exit_code, m)."""
@@ -894,7 +928,7 @@ def discover_test_packages(root: str) -> list:
 
 
 def run_project_tests(root: str, include_e2e: bool = False,
-                      progress=None) -> list:
+                      progress=None, run_filter: str | None = None) -> list:
     """Run every emitted test package of the generated project at
     *root* under the interpreter — the `go test ./...` the reference
     gets from its CI toolchain.  Each package gets a FRESH world (test
@@ -919,7 +953,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
                 if os.path.isdir(crd_dir):
                     world.install_crds(crd_dir)
                 world.start_operator()
-            suite = EmittedSuite(world, rel)
+            suite = EmittedSuite(world, rel, run_filter=run_filter)
             code, m = suite.run()
             results.append(SuiteResult(
                 rel, code=code, ran=m.ran, failures=m.failures

@@ -817,6 +817,9 @@ def project_fingerprint(proj: str) -> list:
             ] = deployment
         workload.fields["DeletionTimestamp"] = _Timestamp(zero=False)
         workload.SetFinalizers(["shop.example.io/finalizer"])
+        client.deletion_marked.add(
+            (workload.tname, workload.GetNamespace(), workload.GetName())
+        )
         r1, e1 = interp.call_method(reconciler, "Reconcile", None, req)
         r2, e2 = interp.call_method(reconciler, "Reconcile", None, req)
         return (client.deleted,

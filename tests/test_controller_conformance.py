@@ -102,9 +102,14 @@ def _component_harness(proj: str) -> "_Harness":
     return _Harness(proj, "controllers/platform", "NewCacheReconciler")
 
 
-def _mark_deleting(workload, finalizer: str) -> None:
+def _mark_deleting(client, workload, finalizer: str) -> None:
+    # mark through the server's book-keeping too: the fake apiserver
+    # strips client-set deletionTimestamps on Update otherwise
     workload.fields["DeletionTimestamp"] = _Timestamp(zero=False)
     workload.SetFinalizers([finalizer])
+    client.deletion_marked.add(
+        (workload.tname, workload.GetNamespace(), workload.GetName())
+    )
 
 
 class TestStandaloneReconcile:
@@ -209,7 +214,7 @@ class TestStandaloneReconcile:
             ("Deployment", "other-ns", "bookstore-app")
         ] = deployment
 
-        _mark_deleting(workload, "shop.example.io/finalizer")
+        _mark_deleting(harness.client, workload, "shop.example.io/finalizer")
         result, err = harness.reconcile("default", "bookstore-sample")
         assert err is None
         # first delete pass swept the cross-namespace child and requeued
@@ -297,7 +302,7 @@ class TestComponentCollectionDiscovery:
         # must not block on a collection that is gone
         harness = _component_harness(collection)
         component = self._seed_component(harness)
-        _mark_deleting(component, "platform.example.io/finalizer")
+        _mark_deleting(harness.client, component, "platform.example.io/finalizer")
         result, err = harness.reconcile("default", "cache-sample")
         assert err is None
         assert component.GetFinalizers() == []

@@ -238,6 +238,22 @@ class TestCLITestCommand:
         out = capsys.readouterr().out
         assert "ok    .  (1 tests)" in out
 
+    def test_run_filter_selects_tests(self, standalone, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", standalone, "--run", "Finalizer"]) == 0
+        out = capsys.readouterr().out
+        # only the matching orchestrate test ran; other packages report
+        # zero selected tests, like go test -run with no matches
+        assert "ok    pkg/orchestrate  (1 tests)" in out
+        assert "ok    controllers/shop  (0 tests)" in out
+
+    def test_run_filter_invalid_regex_errors(self, standalone, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", standalone, "--run", "["]) == 1
+        assert "invalid --run pattern" in capsys.readouterr().err
+
     def test_missing_dir_errors(self, tmp_path, capsys):
         from operator_forge.cli.main import main as cli_main
 
