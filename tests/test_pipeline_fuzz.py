@@ -116,9 +116,9 @@ def build_standalone(rng, tmp_path, idx):
     return str(config), guard
 
 
-@pytest.mark.parametrize("seed", [7, 21, 99, 1234, 4242])
-def test_random_standalone_generates_valid_project(tmp_path, seed):
-    rng = random.Random(seed)
+def _scaffold_fuzz(rng, tmp_path, seed):
+    """Build a random config and scaffold it; shared by both fuzz
+    properties so the invocation cannot drift."""
     config, guard = build_standalone(rng, tmp_path, seed)
     out = str(tmp_path / "project")
     assert cli_main(
@@ -128,6 +128,13 @@ def test_random_standalone_generates_valid_project(tmp_path, seed):
     assert cli_main(
         ["create", "api", "--workload-config", config, "--output-dir", out]
     ) == 0
+    return config, guard, out
+
+
+@pytest.mark.parametrize("seed", [7, 21, 99, 1234, 4242])
+def test_random_standalone_generates_valid_project(tmp_path, seed):
+    rng = random.Random(seed)
+    config, guard, out = _scaffold_fuzz(rng, tmp_path, seed)
 
     errors = check_project(out)
     assert not errors, "\n".join(errors)
@@ -171,3 +178,21 @@ def test_random_standalone_generates_valid_project(tmp_path, seed):
         rendered_off = preview(config, str(flipped))
         docs_off = [d for d in pyyaml.safe_load_all(rendered_off) if d]
         assert not any(d.get("kind") == "Secret" for d in docs_off)
+
+
+@pytest.mark.parametrize("seed", [7, 4242])
+def test_random_standalone_generated_suite_passes(tmp_path, seed):
+    """The strongest generator property: a RANDOM valid config must
+    yield a project whose own generated test suite — unit, envtest,
+    and the e2e lifecycle with the operator running via interpreted
+    main.go — passes end to end.  Extends the vet-clean property to
+    full behavioral self-consistency."""
+    from operator_forge.gocheck.world import run_project_tests
+
+    rng = random.Random(seed)
+    _config, _guard, out = _scaffold_fuzz(rng, tmp_path, seed)
+
+    results = run_project_tests(out, include_e2e=True)
+    assert any(res.rel == "test/e2e" for res in results)
+    for res in results:
+        assert res.ok, (res.rel, res.error, res.failures)
