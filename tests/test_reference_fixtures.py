@@ -173,6 +173,19 @@ class TestFunctionalCases:
             assert res.ok, (case, res.rel, res.error, res.failures)
         assert any(res.rel == "test/e2e" for res in results)
 
+    def test_default_case_help(self, capsys):
+        """The reference's fifth CI case (test/cases/default/default.sh)
+        is literally `operator-builder help`: the bare help surface must
+        work and name every command."""
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["--help"])
+        assert exc.value.code == 0
+        out = capsys.readouterr().out
+        for command in ("init", "create", "init-config", "update",
+                        "completion", "version", "preview", "validate",
+                        "vet", "test"):
+            assert command in out
+
     @pytest.mark.parametrize("case", ["standalone", "edge-standalone"])
     def test_standalone_samples_preview(self, tmp_path, case):
         """The generated sample CR renders child manifests through
