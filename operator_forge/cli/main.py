@@ -581,9 +581,17 @@ def cmd_test(args: argparse.Namespace) -> int:
         except _re.error as exc:
             print(f"error: invalid --run pattern: {exc}", file=sys.stderr)
             return 1
+    def verbose_start(name):
+        print(f"=== RUN   {name}", flush=True)
+
+    def verbose_result(name, passed):
+        print(f"--- {'PASS' if passed else 'FAIL'}: {name}")
+
     results = run_project_tests(
         root, include_e2e=args.e2e, run_filter=args.run or None,
         progress=lambda rel: print(f"--- {rel}"),
+        on_test=verbose_result if args.v else None,
+        on_test_start=verbose_start if args.v else None,
     )
     if not results:
         print("test: no *_test.go packages found", file=sys.stderr)
@@ -764,6 +772,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--run", default="", metavar="REGEX",
         help="run only tests matching the pattern (go test -run)",
     )
+    p_test.add_argument(
+        "-v", action="store_true",
+        help="print each test as it runs (go test -v)",
+    )
     p_test.set_defaults(func=cmd_test)
 
     p_preview = sub.add_parser(
@@ -817,6 +829,14 @@ def main(argv: list[str] | None = None) -> int:
     ) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # the reader went away (operator-forge test ... | head): exit
+        # quietly with the conventional SIGPIPE status
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 141
 
 
 if __name__ == "__main__":

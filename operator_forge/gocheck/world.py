@@ -392,6 +392,8 @@ class GoTestM:
         self.suite = suite
         self.ran: list = []
         self.failures: list = []
+        self.on_test = None        # callable(name, passed): -v result
+        self.on_test_start = None  # callable(name): -v '=== RUN' line
 
     def Run(self):
         code = 0
@@ -399,6 +401,8 @@ class GoTestM:
         for name in self.suite.test_names:
             if fmt_native is not None:
                 fmt_native.out.clear()  # bound print accumulation
+            if self.on_test_start is not None:
+                self.on_test_start(name)
             t = GoTestT(name, call_value=self.suite.interp.call_value,
                         sub_filters=self.suite.sub_filters)
             try:
@@ -409,6 +413,8 @@ class GoTestM:
             if t.failed:
                 code = 1
                 self.failures.append((name, list(t.messages)))
+            if self.on_test is not None:
+                self.on_test(name, not t.failed)
         return code
 
 
@@ -861,9 +867,11 @@ class EmittedSuite:
                     if pattern.search(name)
                 ]
 
-    def run(self) -> tuple:
+    def run(self, on_test=None, on_test_start=None) -> tuple:
         """Execute TestMain; returns (exit_code, m)."""
         m = GoTestM(self)
+        m.on_test = on_test
+        m.on_test_start = on_test_start
         if "TestMain" not in self.interp.funcs:
             return (m.Run(), m)
         try:
@@ -928,7 +936,8 @@ def discover_test_packages(root: str) -> list:
 
 
 def run_project_tests(root: str, include_e2e: bool = False,
-                      progress=None, run_filter: str | None = None) -> list:
+                      progress=None, run_filter: str | None = None,
+                      on_test=None, on_test_start=None) -> list:
     """Run every emitted test package of the generated project at
     *root* under the interpreter — the `go test ./...` the reference
     gets from its CI toolchain.  Each package gets a FRESH world (test
@@ -954,10 +963,13 @@ def run_project_tests(root: str, include_e2e: bool = False,
                     world.install_crds(crd_dir)
                 world.start_operator()
             suite = EmittedSuite(world, rel, run_filter=run_filter)
-            code, m = suite.run()
+            code, m = suite.run(on_test=on_test,
+                                on_test_start=on_test_start)
             results.append(SuiteResult(
                 rel, code=code, ran=m.ran, failures=m.failures
             ))
+        except BrokenPipeError:
+            raise  # the -v reader went away; let the CLI exit quietly
         except Exception as exc:  # interpreter fault: report, don't die
             results.append(SuiteResult(rel, code=1, error=str(exc)))
     return results

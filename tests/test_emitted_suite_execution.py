@@ -248,6 +248,15 @@ class TestCLITestCommand:
         assert "ok    pkg/orchestrate  (1 tests)" in out
         assert "ok    controllers/shop  (0 tests)" in out
 
+    def test_verbose_streams_each_test(self, standalone, capsys):
+        from operator_forge.cli.main import main as cli_main
+
+        assert cli_main(["test", standalone, "--run", "Finalizer",
+                         "-v"]) == 0
+        out = capsys.readouterr().out
+        assert "=== RUN   TestFinalizerLifecycle" in out
+        assert "--- PASS: TestFinalizerLifecycle" in out
+
     def test_run_filter_invalid_regex_errors(self, standalone, capsys):
         from operator_forge.cli.main import main as cli_main
 
