@@ -104,6 +104,7 @@ def main() -> None:
         loc = sum(fixture_loc.values())
         median_wall = statistics.median(wall_runs)
         median_cpu = statistics.median(cpu_runs)
+        best_cpu = min(cpu_runs)
         loc_per_s = (loc / median_cpu) if median_cpu > 0 else 0.0
         print(
             json.dumps(
@@ -122,8 +123,14 @@ def main() -> None:
                         "load — r01-r03 used wall mean, so compare "
                         "those rounds via loc_per_wall_s below)",
                         "cpu_s_median": round(median_cpu, 4),
+                        # the timeit-style noise-robust anchor: host
+                        # contention only ever inflates CPU medians, so
+                        # compare rounds on the best-case run too
+                        "loc_per_s_best": round(
+                            loc / best_cpu if best_cpu > 0 else 0.0, 1
+                        ),
                         "cpu_s_spread": [
-                            round(min(cpu_runs), 4),
+                            round(best_cpu, 4),
                             round(max(cpu_runs), 4),
                         ],
                         "wall_s_median": round(median_wall, 4),

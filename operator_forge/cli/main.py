@@ -585,7 +585,7 @@ def cmd_test(args: argparse.Namespace) -> int:
         print(f"=== RUN   {name}", flush=True)
 
     def verbose_result(name, passed):
-        print(f"--- {'PASS' if passed else 'FAIL'}: {name}")
+        print(f"--- {'PASS' if passed else 'FAIL'}: {name}", flush=True)
 
     results = run_project_tests(
         root, include_e2e=args.e2e, run_filter=args.run or None,
