@@ -16,7 +16,7 @@ unit-test: ## Run unit tests only (skip functional project generation).
 		--ignore=tests/test_edge_cases.py --ignore=tests/test_consistency.py
 
 .PHONY: func-test
-func-test: ## Generate projects from every fixture into /tmp/operator-forge-func-test.
+func-test: ## Generate projects from every fixture and run their generated test suites.
 	rm -rf /tmp/operator-forge-func-test
 	for fixture in standalone collection edge-standalone edge-collection deps-collection; do \
 		$(PYTHON) -m operator_forge init \
@@ -25,9 +25,11 @@ func-test: ## Generate projects from every fixture into /tmp/operator-forge-func
 			--output-dir /tmp/operator-forge-func-test/$$fixture && \
 		$(PYTHON) -m operator_forge create api \
 			--workload-config tests/fixtures/$$fixture/workload.yaml \
-			--output-dir /tmp/operator-forge-func-test/$$fixture || exit 1; \
+			--output-dir /tmp/operator-forge-func-test/$$fixture && \
+		$(PYTHON) -m operator_forge test \
+			/tmp/operator-forge-func-test/$$fixture --e2e || exit 1; \
 	done
-	@echo "generated codebases in /tmp/operator-forge-func-test"
+	@echo "generated + self-tested codebases in /tmp/operator-forge-func-test"
 
 .PHONY: bench
 bench: ## Run the codegen benchmark.
