@@ -1044,6 +1044,200 @@ class _StringsModule:
         return s.replace(old, new)
 
 
+def _go_parse_int(func: str, text, base: int, bit_size: int):
+    """ParseInt with Go's strictness: no surrounding whitespace, no
+    underscores or prefixes at an explicit base (Go allows both only
+    at base 0), and bit_size range errors clamp like Go's ErrRange."""
+    if not isinstance(text, str) or text == "" or text != text.strip():
+        return (0, GoError(
+            f'strconv.{func}: parsing "{text}": invalid syntax'
+        ))
+    body = text[1:] if text[0] in "+-" else text
+    if base != 0 and ("_" in body or (
+        len(body) > 1 and body[0] == "0" and body[1] in "xXoObB"
+    )):
+        return (0, GoError(
+            f'strconv.{func}: parsing "{text}": invalid syntax'
+        ))
+    try:
+        value = int(text, base)
+    except (TypeError, ValueError):
+        return (0, GoError(
+            f'strconv.{func}: parsing "{text}": invalid syntax'
+        ))
+    if bit_size:
+        bound = 1 << (bit_size - 1)
+        if value >= bound or value < -bound:
+            clamped = bound - 1 if value >= bound else -bound
+            return (clamped, GoError(
+                f'strconv.{func}: parsing "{text}": value out of range'
+            ))
+    return (value, None)
+
+
+class _StrconvModule:
+    """strconv: the conversions user-owned hooks reach for, with Go's
+    parsing strictness (see _go_parse_int)."""
+
+    @staticmethod
+    def Itoa(value):
+        return str(int(value))
+
+    @staticmethod
+    def Atoi(text):
+        return _go_parse_int("Atoi", text, 10, 0)
+
+    @staticmethod
+    def ParseInt(text, base, bit_size):
+        return _go_parse_int("ParseInt", text, base, bit_size)
+
+    @staticmethod
+    def ParseBool(text):
+        if text in ("1", "t", "T", "true", "TRUE", "True"):
+            return (True, None)
+        if text in ("0", "f", "F", "false", "FALSE", "False"):
+            return (False, None)
+        return (False, GoError(
+            f'strconv.ParseBool: parsing "{text}": invalid syntax'
+        ))
+
+    @staticmethod
+    def FormatInt(value, base):
+        if base == 10:
+            return str(value)
+        if base == 16:
+            return format(value, "x")
+        if base == 8:
+            return format(value, "o")
+        if base == 2:
+            return format(value, "b")
+        return str(value)
+
+    @staticmethod
+    def Quote(text):
+        return '"' + text.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+class _SortModule:
+    """sort: in-place sorts over the interpreter's list values."""
+
+    @staticmethod
+    def Strings(values):
+        values.sort()
+
+    @staticmethod
+    def Ints(values):
+        values.sort()
+
+    @staticmethod
+    def Slice(values, less):
+        # less is a closure (i, j) -> bool; functools.cmp_to_key adapts
+        import functools
+
+        owner = getattr(getattr(less, "scan", None), "interp", None)
+
+        def call(i, j):
+            if owner is not None:
+                return owner.call_value(less, i, j)
+            return less(i, j)
+
+        # sort indices by the closure, then reorder in place
+        order = sorted(
+            range(len(values)),
+            key=functools.cmp_to_key(
+                lambda a, b: -1 if call(a, b) else (1 if call(b, a) else 0)
+            ),
+        )
+        values[:] = [values[i] for i in order]
+
+
+# POSIX character classes RE2 supports inside brackets; Python lacks them
+_POSIX_CLASSES = {
+    "alnum": "a-zA-Z0-9", "alpha": "a-zA-Z", "digit": "0-9",
+    "lower": "a-z", "upper": "A-Z", "space": r" \t\n\r\f\v",
+    "xdigit": "0-9a-fA-F", "word": r"\w", "punct": (
+        r"!-/:-@\[-`{-~"
+    ), "blank": r" \t", "cntrl": r"\x00-\x1f\x7f", "graph": r"!-~",
+    "print": r" -~",
+}
+
+
+def _re2_to_python(pattern: str) -> str:
+    """Translate the RE2 spellings hook code uses that Python lacks:
+    POSIX classes ([[:alnum:]])."""
+    import re as _pyre
+
+    return _pyre.sub(
+        r"\[:(\w+):\]",
+        lambda m: _POSIX_CLASSES.get(m.group(1), m.group(0)),
+        pattern,
+    )
+
+
+class _GoRegexp:
+    """A compiled regexp: Go's RE2 syntax maps onto Python's with the
+    POSIX classes translated and ASCII semantics for \\d/\\w/\\s (RE2's
+    Perl classes are ASCII-only; Python's default is Unicode)."""
+
+    def __init__(self, pattern: str):
+        import re
+
+        self._re = re.compile(_re2_to_python(pattern), re.ASCII)
+
+    def MatchString(self, text):
+        return self._re.search(text) is not None
+
+    def FindString(self, text):
+        found = self._re.search(text)
+        return found.group(0) if found else ""
+
+    def FindAllString(self, text, n):
+        out = [m.group(0) for m in self._re.finditer(text)]
+        return out if n < 0 else out[:n]
+
+    def ReplaceAllString(self, text, repl):
+        import re
+
+        # Go's replacement template: $N / ${N} are group refs, $$ is a
+        # literal dollar, backslashes are literal.  Python's template
+        # wants \N refs and escaped backslashes.
+        out = repl.replace("\\", "\\\\")
+        out = re.sub(r"\$\$", "\x00", out)
+        out = re.sub(r"\$\{(\w+)\}", r"\\\1", out)
+        out = re.sub(r"\$(\d+)", r"\\\1", out)
+        out = out.replace("\x00", "$")
+        return self._re.sub(out, text)
+
+
+class _RegexpModule:
+    @staticmethod
+    def MustCompile(pattern):
+        import re
+
+        try:
+            return _GoRegexp(pattern)
+        except re.error as exc:
+            raise GoPanic(f"regexp: Compile({pattern!r}): {exc}")
+
+    @staticmethod
+    def Compile(pattern):
+        import re
+
+        try:
+            return (_GoRegexp(pattern), None)
+        except re.error as exc:
+            return (None, GoError(f"error parsing regexp: {exc}"))
+
+    @staticmethod
+    def MatchString(pattern, text):
+        import re
+
+        try:
+            return (_GoRegexp(pattern).MatchString(text), None)
+        except re.error as exc:
+            return (False, GoError(f"error parsing regexp: {exc}"))
+
+
 class _UtilRuntimeModule:
     """k8s.io/apimachinery/pkg/util/runtime."""
 
@@ -1500,6 +1694,9 @@ def default_natives(sched: "Scheduler | None" = None) -> dict:
         "path/filepath": _FilepathModule,
         "flag": _FlagModule,
         "strings": _StringsModule,
+        "strconv": _StrconvModule,
+        "sort": _SortModule,
+        "regexp": _RegexpModule,
         "github.com/spf13/cobra": _CobraModule,
         "k8s.io/client-go/rest": _RestModule,
         "k8s.io/client-go/kubernetes/scheme": _ClientGoSchemeModule(),

@@ -294,6 +294,69 @@ class TestWebhookAdmissionInWorld:
         assert err is not None
         assert "admission webhook denied" in err.Error()
 
+    def test_user_hooks_can_use_common_stdlib(self, standalone, tmp_path):
+        """User-owned hook code leans on strconv/regexp/strings/sort;
+        a validation stub written with them must execute: names are
+        regexp-checked and port bounds reported via strconv."""
+        import yaml as pyyaml
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        subprocess.run(
+            [sys.executable, "-m", "operator_forge", "create", "webhook",
+             "--workload-config", os.path.join(proj, "workload.yaml"),
+             "--output-dir", proj, "--programmatic-validation"],
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        path = os.path.join(
+            proj, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        text = text.replace(
+            "\t// TODO: fill in create validation logic.\n",
+            '\tif !regexp.MustCompile("^[a-z][a-z0-9-]*$").MatchString(r.Name) {\n'
+            '\t\treturn fmt.Errorf("invalid name %q", r.Name)\n'
+            "\t}\n"
+            "\tif r.Spec.Service.Port > 65535 {\n"
+            '\t\treturn fmt.Errorf("port out of range: " + strconv.Itoa(r.Spec.Service.Port))\n'
+            "\t}\n",
+        )
+        text = text.replace(
+            'import (\n\t"k8s.io/apimachinery/pkg/runtime"\n',
+            'import (\n\t"fmt"\n\t"regexp"\n\t"strconv"\n\n'
+            '\t"k8s.io/apimachinery/pkg/runtime"\n',
+        )
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        world.start_operator()
+        pkg = world.runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = pyyaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = "default"
+        assert world.client.Create(
+            None, world.runtime.decode_cr(cr)
+        ) is None
+
+        bad = world.runtime.decode_cr(pyyaml.safe_load(pkg.Sample(False)))
+        bad.SetName("Bad_Name")
+        bad.SetNamespace("default")
+        err = world.client.Create(None, bad)
+        assert err is not None and 'invalid name "Bad_Name"' in err.Error()
+
+        oversized = world.runtime.decode_cr(
+            pyyaml.safe_load(pkg.Sample(False))
+        )
+        oversized.SetName("big-store")
+        oversized.SetNamespace("default")
+        oversized.fields["Spec"].fields["Service"].fields["Port"] = 70000
+        err = world.client.Create(None, oversized)
+        assert err is not None and "port out of range: 70000" in err.Error()
+
     def test_webhook_project_full_suite_still_passes(
         self, standalone, tmp_path
     ):
