@@ -1,0 +1,144 @@
+"""The interpreter's hook-facing stdlib surface, driven FROM GO SOURCE.
+
+User-owned hooks import strconv/sort/regexp/strings; these tests load
+small Go functions through the interpreter — the same path emitted and
+user-edited code takes — and pin the Go-strict semantics the natives
+implement (parsing strictness, ASCII regexp classes, $N replacement
+templates, closure-driven sort.Slice).
+"""
+
+from operator_forge.gocheck.interp import Interp
+
+
+def _load(src: str) -> Interp:
+    interp = Interp()
+    interp.load_source("package hooks\n" + src)
+    return interp
+
+
+class TestStrconvFromGo:
+    def test_atoi_round_trip_and_strictness(self):
+        interp = _load('''
+import "strconv"
+
+func Classify(values []string) []string {
+	out := []string{}
+	for _, v := range values {
+		n, err := strconv.Atoi(v)
+		if err != nil {
+			out = append(out, "bad:"+v)
+			continue
+		}
+		out = append(out, "ok:"+strconv.Itoa(n*2))
+	}
+	return out
+}
+''')
+        assert interp.call(
+            "Classify", ["21", " 7", "1_2", "x", "-3"]
+        ) == ["ok:42", "bad: 7", "bad:1_2", "bad:x", "ok:-6"]
+
+    def test_parse_int_range_error(self):
+        interp = _load('''
+import "strconv"
+
+func Fits32(v string) bool {
+	_, err := strconv.ParseInt(v, 10, 32)
+	return err == nil
+}
+''')
+        assert interp.call("Fits32", "2147483647") is True
+        assert interp.call("Fits32", "2147483648") is False
+
+
+class TestRegexpFromGo:
+    def test_validation_shape(self):
+        interp = _load('''
+import "regexp"
+
+var namePattern = regexp.MustCompile("^[a-z][a-z0-9-]*$")
+
+func ValidName(name string) bool {
+	return namePattern.MatchString(name)
+}
+''')
+        assert interp.call("ValidName", "web-store2") is True
+        assert interp.call("ValidName", "Bad_Name") is False
+
+    def test_replace_templates(self):
+        interp = _load('''
+import "regexp"
+
+func SwapPair(s string) string {
+	re := regexp.MustCompile("([a-z]+)-([a-z]+)")
+	return re.ReplaceAllString(s, "${2}-${1}")
+}
+''')
+        assert interp.call("SwapPair", "front-back") == "back-front"
+
+    def test_posix_class_and_ascii_digits(self):
+        interp = _load('''
+import "regexp"
+
+func Alnum(s string) bool {
+	return regexp.MustCompile("^[[:alnum:]]+$").MatchString(s)
+}
+
+func Digits(s string) bool {
+	ok, _ := regexp.MatchString("^\\\\d+$", s)
+	return ok
+}
+''')
+        assert interp.call("Alnum", "abc123") is True
+        assert interp.call("Alnum", "a-b") is False
+        assert interp.call("Digits", "42") is True
+        assert interp.call("Digits", "٤٢") is False  # RE2 \d is ASCII
+
+
+class TestSortFromGo:
+    def test_strings_and_slice_closure(self):
+        interp = _load('''
+import "sort"
+
+func Normalize(values []string) []string {
+	sort.Strings(values)
+	return values
+}
+
+func ByLength(values []string) []string {
+	sort.Slice(values, func(i, j int) bool {
+		return len(values[i]) < len(values[j])
+	})
+	return values
+}
+''')
+        assert interp.call(
+            "Normalize", ["c", "a", "b"]
+        ) == ["a", "b", "c"]
+        assert interp.call(
+            "ByLength", ["three", "a", "to"]
+        ) == ["a", "to", "three"]
+
+
+class TestStringsFromGo:
+    def test_common_helpers(self):
+        interp = _load('''
+import "strings"
+
+func Slug(s string) string {
+	return strings.ToLower(strings.ReplaceAll(strings.TrimSpace(s), " ", "-"))
+}
+
+func HasAnyPrefix(s string, prefixes []string) bool {
+	for _, p := range prefixes {
+		if strings.HasPrefix(s, p) {
+			return true
+		}
+	}
+	return false
+}
+''')
+        assert interp.call("Slug", "  Web Store  ") == "web-store"
+        assert interp.call(
+            "HasAnyPrefix", "kube-system", ["kube-", "openshift-"]
+        ) is True
