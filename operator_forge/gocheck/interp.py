@@ -729,17 +729,49 @@ class _ErrorsModule:
 
     @staticmethod
     def Is(err, target):
-        # Go semantics: walk the %w chain comparing identity; two
-        # distinct errors.New values are never Is-equal
+        # Go semantics: walk the %w chain comparing identity (two
+        # distinct errors.New values are never Is-equal), branching
+        # into errors.Join trees
         while err is not None:
             if err is target:
                 return True
+            for child in getattr(err, "joined", ()) or ():
+                if _ErrorsModule.Is(child, target):
+                    return True
             err = getattr(err, "wrapped", None)
         return False
 
     @staticmethod
     def Unwrap(err):
         return getattr(err, "wrapped", None)
+
+    @staticmethod
+    def Join(*errs):
+        real = [e for e in errs if e is not None]
+        if not real:
+            return None
+
+        def text(err):
+            # a native error carries msg; a user-defined Go error type
+            # (GoStruct with an Error method) renders best-effort —
+            # identity membership for Is still holds via `joined`
+            msg = getattr(err, "msg", None)
+            if isinstance(msg, str):
+                return msg
+            render = getattr(err, "Error", None)
+            if callable(render):
+                try:
+                    return str(render())
+                except Exception:
+                    pass
+            return "error"
+
+        joined = GoError("\n".join(text(e) for e in real))
+        joined.not_found = any(
+            getattr(e, "not_found", False) for e in real
+        )
+        joined.joined = list(real)  # Is() walks the whole tree
+        return joined
 
 
 class _GoContext:
@@ -1009,7 +1041,7 @@ class _CobraModule:
 class _StringsModule:
     @staticmethod
     def Split(s, sep):
-        return s.split(sep)
+        return list(s) if sep == "" else s.split(sep)
 
     @staticmethod
     def Contains(s, substr):
@@ -1040,8 +1072,78 @@ class _StringsModule:
         return s.strip()
 
     @staticmethod
+    def TrimPrefix(s, prefix):
+        return s[len(prefix):] if s.startswith(prefix) else s
+
+    @staticmethod
+    def TrimSuffix(s, suffix):
+        return s[:-len(suffix)] if suffix and s.endswith(suffix) else s
+
+    @staticmethod
     def ReplaceAll(s, old, new):
         return s.replace(old, new)
+
+    @staticmethod
+    def Replace(s, old, new, n):
+        return s.replace(old, new) if n < 0 else s.replace(old, new, n)
+
+    @staticmethod
+    def Index(s, substr):
+        return s.find(substr)
+
+    @staticmethod
+    def LastIndex(s, substr):
+        return s.rfind(substr)
+
+    @staticmethod
+    def Count(s, substr):
+        # Go counts len(s)+1 for the empty substring
+        return len(s) + 1 if substr == "" else s.count(substr)
+
+    @staticmethod
+    def Repeat(s, count):
+        if count < 0:
+            raise GoPanic("strings: negative Repeat count")
+        return s * count
+
+    @staticmethod
+    def Fields(s):
+        return s.split()
+
+    @staticmethod
+    def EqualFold(a, b):
+        return a.casefold() == b.casefold()
+
+    @staticmethod
+    def Title(s):
+        # Go's (deprecated) Title uppercases the letter FOLLOWING a
+        # non-letter and leaves the rest of each word untouched —
+        # unlike str.title(), which also lowercases the tail
+        out = []
+        prev_letter = False
+        for ch in s:
+            is_letter = ch.isalpha()
+            out.append(ch.upper() if is_letter and not prev_letter else ch)
+            prev_letter = is_letter
+        return "".join(out)
+
+    @staticmethod
+    def SplitN(s, sep, n):
+        if n == 0:
+            return None
+        if sep == "":
+            runes = list(s)
+            if n < 0 or n >= len(runes):
+                return runes
+            return runes[:n - 1] + ["".join(runes[n - 1:])]
+        if n < 0:
+            return s.split(sep)
+        return s.split(sep, n - 1)
+
+    @staticmethod
+    def Cut(s, sep):
+        before, found, after = s.partition(sep)
+        return (before, after, bool(found))
 
 
 def _go_parse_int(func: str, text, base: int, bit_size: int):

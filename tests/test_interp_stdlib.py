@@ -142,3 +142,66 @@ func HasAnyPrefix(s string, prefixes []string) bool {
         assert interp.call(
             "HasAnyPrefix", "kube-system", ["kube-", "openshift-"]
         ) is True
+
+
+class TestStringsExtendedFromGo:
+    def test_trim_cut_fields(self):
+        interp = _load('''
+import "strings"
+
+func ParseImage(ref string) (string, string) {
+	name, tag, found := strings.Cut(ref, ":")
+	if !found {
+		return ref, "latest"
+	}
+	return name, tag
+}
+
+func StripGroup(kind string) string {
+	return strings.TrimSuffix(strings.TrimPrefix(kind, "io."), ".List")
+}
+
+func Words(s string) int {
+	return len(strings.Fields(s))
+}
+''')
+        assert interp.call("ParseImage", "nginx:1.25") == ("nginx", "1.25")
+        assert interp.call("ParseImage", "nginx") == ("nginx", "latest")
+        assert interp.call("StripGroup", "io.Widget.List") == "Widget"
+        assert interp.call("Words", "  a  b   c ") == 3
+
+    def test_count_matches_go_empty_substring(self):
+        interp = _load('''
+import "strings"
+
+func C(s, sub string) int {
+	return strings.Count(s, sub)
+}
+''')
+        assert interp.call("C", "cheese", "e") == 3
+        assert interp.call("C", "five", "") == 5  # Go: len+1
+
+
+class TestErrorsJoinFromGo:
+    def test_join_aggregates_and_is_walks(self):
+        interp = _load('''
+import "errors"
+
+var ErrBase = errors.New("base failure")
+
+func Collect(fail bool) error {
+	var errs error
+	if fail {
+		errs = errors.Join(errs, ErrBase)
+	}
+	return errs
+}
+
+func IsBase(err error) bool {
+	return errors.Is(err, ErrBase)
+}
+''')
+        err = interp.call("Collect", True)
+        assert err is not None and "base failure" in err.Error()
+        assert interp.call("IsBase", err) is True
+        assert interp.call("Collect", False) is None
