@@ -770,6 +770,9 @@ class _ErrorsModule:
         joined.not_found = any(
             getattr(e, "not_found", False) for e in real
         )
+        joined.already_exists = any(
+            getattr(e, "already_exists", False) for e in real
+        )
         joined.joined = list(real)  # Is() walks the whole tree
         return joined
 
@@ -1112,19 +1115,22 @@ class _StringsModule:
 
     @staticmethod
     def EqualFold(a, b):
-        return a.casefold() == b.casefold()
+        # Go folds one rune to one rune (unicode.SimpleFold); lower()
+        # matches that for practical inputs where casefold() would
+        # expand multi-char folds Go does not (ss vs sharp s)
+        return a.lower() == b.lower()
 
     @staticmethod
     def Title(s):
-        # Go's (deprecated) Title uppercases the letter FOLLOWING a
-        # non-letter and leaves the rest of each word untouched —
-        # unlike str.title(), which also lowercases the tail
+        # Go's (deprecated) Title uppercases a letter only when the
+        # PREVIOUS rune is a separator — and Go's isSeparator treats
+        # letters, digits and '_' as non-separators (str.title() both
+        # lowercases tails and breaks on digits/underscores)
         out = []
-        prev_letter = False
+        prev_sep = True
         for ch in s:
-            is_letter = ch.isalpha()
-            out.append(ch.upper() if is_letter and not prev_letter else ch)
-            prev_letter = is_letter
+            out.append(ch.upper() if ch.isalpha() and prev_sep else ch)
+            prev_sep = not (ch.isalnum() or ch == "_")
         return "".join(out)
 
     @staticmethod
