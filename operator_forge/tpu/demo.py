@@ -220,6 +220,20 @@ def run_dryrun(n_devices: int, config: DemoConfig | None = None) -> float:
         )
         new_params, loss = step(params, tokens)
         jax.block_until_ready(loss)
+
+    # the long-context path: ring attention over the full device ring
+    # must agree with the dense reference on the same mesh
+    import numpy as np
+
+    ring_mesh = Mesh(mesh.devices.reshape(-1), ("seq",))
+    q = jax.random.normal(
+        jax.random.PRNGKey(2), (2, 2, 8 * n_devices, 16), jnp.float32
+    )
+    ringed = ring_attention(q, q, q, ring_mesh, axis="seq")
+    dense = dense_causal_attention(q, q, q)
+    np.testing.assert_allclose(
+        np.asarray(ringed), np.asarray(dense), rtol=3e-5, atol=3e-5
+    )
     return float(loss)
 
 
