@@ -606,7 +606,8 @@ def cmd_test(args: argparse.Namespace) -> int:
             print(f"FAIL  {res.rel}  interpreter: {res.error}")
             continue
         status = "ok  " if res.ok else "FAIL"
-        print(f"{status}  {res.rel}  ({len(res.ran)} tests)")
+        print(f"{status}  {res.rel}  ({len(res.ran)} tests, "
+              f"{res.seconds:.2f}s)")
         for name, messages in res.failures:
             failed += 1
             print(f"  --- FAIL: {name}")

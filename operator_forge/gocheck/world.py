@@ -889,13 +889,15 @@ class SuiteResult:
     """Outcome of one test package's run."""
 
     def __init__(self, rel: str, code: int = 0, ran=None, failures=None,
-                 skipped: bool = False, error: str = ""):
+                 skipped: bool = False, error: str = "",
+                 seconds: float = 0.0):
         self.rel = rel
         self.code = code
         self.ran = ran or []
         self.failures = failures or []
         self.skipped = skipped
         self.error = error
+        self.seconds = seconds
 
     @property
     def ok(self) -> bool:
@@ -953,6 +955,9 @@ def run_project_tests(root: str, include_e2e: bool = False,
             continue
         if progress is not None:
             progress(rel)
+        import time as _time
+
+        started = _time.perf_counter()
         try:
             world = EnvtestWorld(root)
             if is_e2e:
@@ -966,12 +971,16 @@ def run_project_tests(root: str, include_e2e: bool = False,
             code, m = suite.run(on_test=on_test,
                                 on_test_start=on_test_start)
             results.append(SuiteResult(
-                rel, code=code, ran=m.ran, failures=m.failures
+                rel, code=code, ran=m.ran, failures=m.failures,
+                seconds=_time.perf_counter() - started,
             ))
         except BrokenPipeError:
             raise  # the -v reader went away; let the CLI exit quietly
         except Exception as exc:  # interpreter fault: report, don't die
-            results.append(SuiteResult(rel, code=1, error=str(exc)))
+            results.append(SuiteResult(
+                rel, code=1, error=str(exc),
+                seconds=_time.perf_counter() - started,
+            ))
     return results
 
 
