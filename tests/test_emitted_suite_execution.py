@@ -236,7 +236,7 @@ class TestCLITestCommand:
             )
         assert cli_main(["test", proj]) == 0
         out = capsys.readouterr().out
-        assert "ok    .  (1 tests)" in out
+        assert "ok    .  (1 tests," in out
 
     def test_run_filter_selects_tests(self, standalone, capsys):
         from operator_forge.cli.main import main as cli_main
@@ -245,8 +245,8 @@ class TestCLITestCommand:
         out = capsys.readouterr().out
         # only the matching orchestrate test ran; other packages report
         # zero selected tests, like go test -run with no matches
-        assert "ok    pkg/orchestrate  (1 tests)" in out
-        assert "ok    controllers/shop  (0 tests)" in out
+        assert "ok    pkg/orchestrate  (1 tests," in out
+        assert "ok    controllers/shop  (0 tests," in out
 
     def test_verbose_streams_each_test(self, standalone, capsys):
         from operator_forge.cli.main import main as cli_main
