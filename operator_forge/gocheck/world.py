@@ -266,6 +266,14 @@ class FakeClusterClient:
         stored = self.workloads.get(key)
         if stored is None:
             return GoError(f"{obj.tname} not found", not_found=True)
+        if world is not None:
+            # validating webhooks also gate deletion (verbs=delete on
+            # the emitted markers); the mutating hook does NOT run
+            err = world._admission(
+                stored, "ValidateDelete", mutate=False
+            )
+            if err is not None:
+                return err
         if stored.GetFinalizers():
             # finalizers pin the object: mark deletion and notify, the
             # way a real apiserver turns delete into an update event
@@ -678,16 +686,18 @@ class EnvtestWorld:
             )
         return self._admission(obj, "ValidateCreate")
 
-    def _admission(self, obj: GoStruct, validate_method: str):
+    def _admission(self, obj: GoStruct, validate_method: str,
+                   mutate: bool = True):
         """Mutating then validating admission, in the apiserver's call
         order — running only the hooks the project actually scaffolds
         (a defaulting-only project has no Validate* methods, and a real
-        cluster simply doesn't call the absent webhook)."""
+        cluster simply doesn't call the absent webhook).  Deletion
+        skips the mutating hook (``mutate=False``)."""
         if obj.tname not in self.webhook_kinds:
             return None
         methods = self.runtime.methods
         try:
-            if (obj.tname, "Default") in methods:
+            if mutate and (obj.tname, "Default") in methods:
                 self.call_interp.call_method(obj, "Default")
             err = None
             if (obj.tname, validate_method) in methods:

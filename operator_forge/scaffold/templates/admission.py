@@ -90,10 +90,13 @@ def webhook_stub_file(
         )
     if validation:
         parts.append(
+            # delete is registered too: the scaffold emits a
+            # ValidateDelete stub, so the webhook must actually be
+            # CALLED on delete or a filled-in stub silently never runs
             f"//+kubebuilder:webhook:path={webhook_path(view, 'validate')},"
             f"mutating=false,failurePolicy=fail,sideEffects=None,"
             f"groups={view.full_group},resources={view.plural.lower()},"
-            f"verbs=create;update,versions={view.version},"
+            f"verbs=create;update;delete,versions={view.version},"
             f"name=v{view.kind_lower}.kb.io,admissionReviewVersions=v1\n\n"
             f"var _ webhook.Validator = &{kind}{{}}\n",
         )
@@ -172,9 +175,12 @@ def stale_stubs(
 def _webhook_entry(
     config: ProjectConfig, view: WorkloadView, kind_of: str
 ) -> str:
-    """One entry of a WebhookConfiguration's ``webhooks`` list."""
+    """One entry of a WebhookConfiguration's ``webhooks`` list.  The
+    validating entry also registers DELETE — the scaffold emits a
+    ValidateDelete stub, which must actually be called on delete."""
     project = config.project_name
     prefix = "m" if kind_of == "mutate" else "v"
+    delete_op = "" if kind_of == "mutate" else "\n    - DELETE"
     return f"""- admissionReviewVersions:
   - v1
   clientConfig:
@@ -191,7 +197,7 @@ def _webhook_entry(
     - {view.version}
     operations:
     - CREATE
-    - UPDATE
+    - UPDATE{delete_op}
     resources:
     - {view.plural.lower()}
   sideEffects: None

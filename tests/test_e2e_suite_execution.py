@@ -294,6 +294,45 @@ class TestWebhookAdmissionInWorld:
         assert err is not None
         assert "admission webhook denied" in err.Error()
 
+    def test_delete_admission_can_protect_objects(
+        self, standalone, tmp_path
+    ):
+        # verbs=delete on the emitted webhook markers: a user
+        # ValidateDelete gates deletion, and the mutating hook does
+        # not run on delete
+        import yaml as pyyaml
+
+        proj = self._webhook_project(standalone, tmp_path)
+        path = os.path.join(
+            proj, "apis", "shop", "v1alpha1", "bookstore_webhook.go"
+        )
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                "\t// TODO: fill in delete validation logic.\n",
+                '\tif r.GetLabels()["protected"] == "true" {\n'
+                '\t\treturn fmt.Errorf("bookstore is protected")\n'
+                "\t}\n",
+            ))
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        world.start_operator()
+        pkg = world.runtime.package("apis/shop/v1alpha1/bookstore")
+        cr = pyyaml.safe_load(pkg.Sample(False))
+        cr["metadata"]["namespace"] = "default"
+        cr["metadata"]["labels"] = {"protected": "true"}
+        workload = world.runtime.decode_cr(cr)
+        assert world.client.Create(None, workload) is None
+        err = world.client.Delete(None, workload)
+        assert err is not None and "bookstore is protected" in err.Error()
+        key = (workload.tname, "default", workload.GetName())
+        assert key in world.client.workloads
+        # unprotect: deletion proceeds
+        workload.SetLabels({})
+        assert world.client.Delete(None, workload) is None
+
     def test_user_hooks_can_use_common_stdlib(self, standalone, tmp_path):
         """User-owned hook code leans on strconv/regexp/strings/sort;
         a validation stub written with them must execute: names are
