@@ -1210,6 +1210,59 @@ class _StrconvModule:
         ))
 
     @staticmethod
+    def ParseUint(text, base, bit_size):
+        value, err = _go_parse_int("ParseUint", text, base, bit_size)
+        if err is None and value < 0:
+            return (0, GoError(
+                f'strconv.ParseUint: parsing "{text}": invalid syntax'
+            ))
+        return (value, err)
+
+    @staticmethod
+    def ParseFloat(text, bit_size):
+        if not isinstance(text, str) or text == "" or (
+            text != text.strip()
+        ):
+            return (0.0, GoError(
+                f'strconv.ParseFloat: parsing "{text}": invalid syntax'
+            ))
+        try:
+            return (float(text), None)
+        except ValueError:
+            return (0.0, GoError(
+                f'strconv.ParseFloat: parsing "{text}": invalid syntax'
+            ))
+
+    @staticmethod
+    def FormatBool(value):
+        return "true" if value else "false"
+
+    @staticmethod
+    def FormatFloat(value, fmt, prec, bit_size):
+        verb = chr(fmt) if isinstance(fmt, int) else str(fmt)
+        if prec < 0:
+            return repr(float(value))
+        return format(float(value), f".{prec}{verb}")
+
+    @staticmethod
+    def Unquote(text):
+        if (
+            len(text) >= 2
+            and text[0] == text[-1]
+            and text[0] in ('"', "`")
+        ):
+            body = text[1:-1]
+            if text[0] == "`":
+                return (body, None)
+            try:
+                return (
+                    body.encode().decode("unicode_escape"), None
+                )
+            except UnicodeDecodeError:
+                pass
+        return ("", GoError("invalid syntax"))
+
+    @staticmethod
     def FormatInt(value, base):
         if base == 10:
             return str(value)

@@ -205,3 +205,33 @@ func IsBase(err error) bool {
         assert err is not None and "base failure" in err.Error()
         assert interp.call("IsBase", err) is True
         assert interp.call("Collect", False) is None
+
+
+class TestStrconvExtendedFromGo:
+    def test_floats_bools_quotes(self):
+        interp = _load('''
+import "strconv"
+
+func Percent(v string) (float64, bool) {
+	f, err := strconv.ParseFloat(v, 64)
+	return f, err == nil
+}
+
+func Flag(b bool) string {
+	return strconv.FormatBool(b)
+}
+
+func Unquoted(s string) string {
+	u, err := strconv.Unquote(s)
+	if err != nil {
+		return "<bad>"
+	}
+	return u
+}
+''')
+        assert interp.call("Percent", "2.5") == (2.5, True)
+        assert interp.call("Percent", " 2.5")[1] is False
+        assert interp.call("Flag", True) == "true"
+        assert interp.call("Unquoted", '"a\\tb"') == "a\tb"
+        assert interp.call("Unquoted", "`raw`") == "raw"
+        assert interp.call("Unquoted", "nope") == "<bad>"
