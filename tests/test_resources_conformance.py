@@ -371,3 +371,18 @@ class TestSeededResourceMutationsDetected:
             _write_cr(tmp_path, cr),
         )
         assert _emitted_docs(objs) != wanted
+
+
+class TestKindRegistryExecution:
+    """The per-group kind registry (apis/<group>/<kind>.go +
+    <kind>_latest.go) executes: version objects enumerate newest-first
+    and the latest-version constant tracks the scaffolded versions."""
+
+    def test_registry_and_latest_execute(self, standalone):
+        runtime = ProjectRuntime(standalone)
+        registry = runtime.package("apis/shop")
+        objs = registry.BookStoreObjects()
+        assert [o.tname for o in objs] == ["BookStore"]
+        assert runtime.interp("apis/shop").consts[
+            "BookStoreLatestVersion"
+        ] == "v1alpha1"
