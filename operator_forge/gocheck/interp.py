@@ -511,6 +511,30 @@ def _go_format(fmt: str, args: list) -> str:
         ai += 1
         if verb in ("s", "v", "w"):
             out.append(_go_repr(arg))
+        elif verb == "T":
+            # Go type rendering: struct values print as *pkg-less
+            # names here (the interpreter's values are pointer-
+            # transparent, and emitted %T uses are pointer-typed)
+            if isinstance(arg, GoStruct):
+                out.append(f"*{arg.tname}")
+            elif arg is None:
+                out.append("<nil>")
+            elif isinstance(arg, bool):
+                out.append("bool")
+            elif isinstance(arg, int):
+                out.append("int")
+            elif isinstance(arg, float):
+                out.append("float64")
+            elif isinstance(arg, str):
+                out.append("string")
+            elif isinstance(arg, (bytes, bytearray)):
+                out.append("[]uint8")
+            elif isinstance(arg, list):
+                out.append("[]interface {}")
+            elif isinstance(arg, dict):
+                out.append("map[string]interface {}")
+            else:
+                out.append(f"*{type(arg).__name__}")
         elif verb == "q":
             out.append('"%s"' % arg)
         elif verb == "d":
