@@ -180,6 +180,126 @@ def test_random_standalone_generates_valid_project(tmp_path, seed):
         assert not any(d.get("kind") == "Secret" for d in docs_off)
 
 
+def build_collection(rng, tmp_path, idx):
+    """A random collection: 1-3 components with their own fields,
+    collection-field markers resolving against the collection CR, and
+    an optional dependency chain between components."""
+    n_components = rng.randint(1, 3)
+    coll_fields = []
+    used = set()
+    for _ in range(rng.randint(1, 3)):
+        name = rand_field(rng, used)
+        coll_fields.append((name, f"cv{rng.randint(0, 99)}"))
+
+    component_files = []
+    prev_name = None
+    for c in range(n_components):
+        comp = f"part{c}"
+        manifest = tmp_path / f"{comp}-res.yaml"
+        comp_used = set()
+        lines = [
+            "apiVersion: v1",
+            "kind: ConfigMap",
+            "metadata:",
+            f"  name: {comp}-cm",
+            "data:",
+        ]
+        for _ in range(rng.randint(1, 3)):
+            fname = rand_field(rng, comp_used)
+            lines.append(
+                f"  own{len(comp_used)}: v  "
+                f"# +operator-builder:field:name={fname},"
+                f"type=string,default=\"x\""
+            )
+        # every component consumes one collection field too
+        cname, cdefault = rng.choice(coll_fields)
+        lines.append(
+            f"  shared: {cdefault}  "
+            f"# +operator-builder:collection:field:name={cname},"
+            f"type=string,default=\"{cdefault}\""
+        )
+        manifest.write_text("\n".join(lines) + "\n")
+
+        deps = [prev_name] if prev_name and rng.random() < 0.7 else []
+        comp_cfg = tmp_path / f"{comp}.yaml"
+        comp_cfg.write_text(pyyaml.safe_dump({
+            "name": comp,
+            "kind": "ComponentWorkload",
+            "spec": {
+                "api": {
+                    "group": f"grp{idx}",
+                    "version": "v1alpha1",
+                    "kind": f"Part{c}Kind{idx}",
+                    "clusterScoped": False,
+                },
+                "companionCliSubcmd": {
+                    "name": comp,
+                    "description": f"manage {comp}",
+                },
+                "dependencies": deps,
+                "resources": [manifest.name],
+            },
+        }, sort_keys=False))
+        component_files.append(comp_cfg.name)
+        prev_name = comp
+
+    config = tmp_path / "workload.yaml"
+    config.write_text(pyyaml.safe_dump({
+        "name": f"fuzzcoll-{idx}",
+        "kind": "WorkloadCollection",
+        "spec": {
+            "api": {
+                "domain": "fuzz.io",
+                "group": f"grp{idx}",
+                "version": "v1alpha1",
+                "kind": f"FuzzColl{idx}",
+                "clusterScoped": True,
+            },
+            "companionCliRootcmd": {
+                "name": f"fuzzctl{idx}",
+                "description": "fuzz collection cli",
+            },
+            "componentFiles": component_files,
+            "resources": [],
+        },
+    }, sort_keys=False))
+    return str(config)
+
+
+def _scaffold_config(config: str, tmp_path, seed) -> str:
+    """init + create api for an already-built config (the collection
+    variant of _scaffold_fuzz's shared invocation)."""
+    out = str(tmp_path / "project")
+    assert cli_main(
+        ["init", "--workload-config", config,
+         "--repo", f"example.com/fuzz{seed}", "--output-dir", out]
+    ) == 0
+    assert cli_main(
+        ["create", "api", "--workload-config", config, "--output-dir", out]
+    ) == 0
+    return out
+
+
+def _assert_generated_suite_passes(out: str) -> None:
+    from operator_forge.gocheck.world import run_project_tests
+
+    results = run_project_tests(out, include_e2e=True)
+    assert any(res.rel == "test/e2e" for res in results)
+    for res in results:
+        assert res.ok, (res.rel, res.error, res.failures)
+
+
+@pytest.mark.parametrize("seed", [13, 9090])
+def test_random_collection_generated_suite_passes(tmp_path, seed):
+    """The collection shape of the same property: random components
+    with own and collection-resolved fields plus dependency chains
+    must yield a project whose generated suite passes — collection
+    discovery, dependency gating, e2e ordering and all."""
+    rng = random.Random(seed)
+    config = build_collection(rng, tmp_path, seed)
+    _assert_generated_suite_passes(_scaffold_config(config, tmp_path, seed))
+
+
 @pytest.mark.parametrize("seed", [7, 4242])
 def test_random_standalone_generated_suite_passes(tmp_path, seed):
     """The strongest generator property: a RANDOM valid config must
@@ -191,8 +311,4 @@ def test_random_standalone_generated_suite_passes(tmp_path, seed):
 
     rng = random.Random(seed)
     _config, _guard, out = _scaffold_fuzz(rng, tmp_path, seed)
-
-    results = run_project_tests(out, include_e2e=True)
-    assert any(res.rel == "test/e2e" for res in results)
-    for res in results:
-        assert res.ok, (res.rel, res.error, res.failures)
+    _assert_generated_suite_passes(out)
