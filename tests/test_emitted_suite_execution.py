@@ -268,6 +268,34 @@ class TestCLITestCommand:
 
         assert cli_main(["test", str(tmp_path / "nope")]) == 1
 
+    def test_interpreter_fault_reports_fail_not_traceback(
+        self, standalone, tmp_path, capsys
+    ):
+        # code outside the interpreter subset (or any internal fault)
+        # must surface as a per-package FAIL with exit 1 — never a
+        # Python traceback
+        from operator_forge.cli.main import main as cli_main
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        with open(os.path.join(proj, "pkg", "orchestrate",
+                               "zz_weird_test.go"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(
+                "package orchestrate\n\n"
+                'import "testing"\n\n'
+                "func TestUsesChannels(t *testing.T) {\n"
+                "\tch := make(chan int, 1)\n"
+                "\tch <- 1\n"
+                "\tif <-ch != 1 {\n"
+                '\t\tt.Fatal("channel")\n'
+                "\t}\n"
+                "}\n"
+            )
+        assert cli_main(["test", proj]) == 1
+        out = capsys.readouterr().out
+        assert "FAIL" in out
+
     def test_no_test_packages_errors(self, tmp_path, capsys):
         from operator_forge.cli.main import main as cli_main
 
