@@ -72,6 +72,12 @@ def build_standalone(rng, tmp_path, idx):
         marker = f"+operator-builder:field:name={name},type={ftype}"
         if has_default:
             marker += f",default={rendered}"
+        if ftype == "string" and has_default and rng.random() < 0.5:
+            # partial substitution: the marker replaces only the
+            # matched fragment inside a larger value
+            marker += f",replace={rendered}"
+            lines.append(f"  key{i}: prefix-{value}-suffix  # {marker}")
+            continue
         lines.append(f"  key{i}: {rendered}  # {marker}")
 
     # a second resource with an include guard tied to the first bool field
