@@ -43,6 +43,12 @@ run vet "${PROJ}"
 echo "==> the generated project's OWN test suite (interpreted go test ./...)"
 run test "${PROJ}" --e2e
 
+if [[ ! -d "${PROJ}/cmd" ]]; then
+  echo "==> no companion CLI scaffolded (config has no companionCliRootcmd)"
+  echo "smoke: ok (${FIXTURE})"
+  exit 0
+fi
+
 echo "==> interpreted companion CLI round-trip"
 PYTHONPATH="${REPO}" python - "${PROJ}" <<'EOF'
 import sys
