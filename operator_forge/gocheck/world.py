@@ -468,9 +468,10 @@ class WorldManager(FakeManager):
     (existing objects of watched kinds are enqueued) and whose context
     gates dispatch — cancelled managers stop reconciling."""
 
-    def __init__(self, world: "EnvtestWorld"):
+    def __init__(self, world: "EnvtestWorld", opts=None):
         super().__init__(world.client)
         self.world = world
+        self.opts = opts  # the ctrl.Options main.go was built with
         self.registered: list = []  # (kind, reconciler)
         self.started = False
         self.start_ctx = None
@@ -517,7 +518,7 @@ class _WorldCtrlModule(_CtrlModule):
     def NewManager(self, cfg, opts):
         if cfg is None:
             return (None, GoError("must specify Config"))
-        mgr = WorldManager(self.world)
+        mgr = WorldManager(self.world, opts=opts)
         self.world.managers.append(mgr)
         return (mgr, None)
 

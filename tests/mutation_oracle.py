@@ -49,6 +49,14 @@ EQUIVALENT_SURVIVORS = {
     ("bookstore_controller.go", "arg-swap", "`r, req` -> `req, r`"):
         "equivalent for the scaffolded hook: the user-owned "
         "CheckReady(r, req) pass-through ignores both arguments",
+    ("main.go", "bool-literal-flip", "`true` -> `false`"):
+        "equivalent-class: flips zap development mode or warning "
+        "deduplication — log/warning ENCODING only; no functional "
+        "behavior of the generated operator changes in Go either",
+    ("main.go", "int-perturb", "`1` -> `2`"):
+        "equivalent-class: os.Exit codes in error branches unreached "
+        "on a healthy boot; any non-zero code signals startup failure "
+        "identically to the process supervisor",
 }
 
 
@@ -722,6 +730,47 @@ def companion_fingerprint(proj: str) -> list:
     ])
 
 
+def main_fingerprint(proj: str) -> list:
+    """The emitted main.go, interpreted end to end: scheme assembly,
+    manager construction, reconciler + webhook registration, health
+    checks, manager start — the `make run` flow captured as state."""
+    from operator_forge.gocheck.world import EnvtestWorld
+
+    def boot():
+        world = EnvtestWorld(proj)
+        world.env_started = True
+        world.install_crds(os.path.join(proj, "config", "crd", "bases"))
+        interp = world.start_operator()
+        mgr = world.managers[0] if world.managers else None
+        opts = getattr(mgr, "opts", None)
+        opt_fields = {}
+        scheme_kinds = ()
+        if isinstance(opts, GoStruct):
+            opt_fields = {
+                k: v for k, v in sorted(opts.fields.items())
+                if isinstance(v, (str, int, bool, float))
+            }
+            # main.go assembles its OWN scheme (runtime.NewScheme +
+            # AddToScheme calls) and hands it to the manager; dropping
+            # a registration must change this
+            scheme_kinds = tuple(sorted(getattr(
+                opts.fields.get("Scheme"), "registered", ()
+            )))
+        return {
+            "manager_options": opt_fields,
+            "scheme_kinds": scheme_kinds,
+            "managers": len(world.managers),
+            "registered": sorted(
+                k for m in world.managers for k, _r in m.registered
+            ),
+            "webhook_kinds": sorted(world.webhook_kinds),
+            "started": bool(mgr and mgr.started),
+            "init_errors": len(interp.init_errors),
+        }
+
+    return _scenarios([("boot", boot)])
+
+
 def project_fingerprint(proj: str) -> list:
     """Controller-level passes through the full emitted pipeline."""
     import yaml
@@ -840,11 +889,16 @@ ORCHESTRATE_DIR = os.path.join("pkg", "orchestrate")
 RESOURCES_DIR = os.path.join("apis", "shop", "v1alpha1", "bookstore")
 CONTROLLER_DIR = os.path.join("controllers", "shop")
 CMD_DIR = "cmd"
+MAIN_TARGET = "main.go"
 
-TARGETS = (ORCHESTRATE_DIR, RESOURCES_DIR, CONTROLLER_DIR, CMD_DIR)
+TARGETS = (
+    ORCHESTRATE_DIR, RESOURCES_DIR, CONTROLLER_DIR, CMD_DIR, MAIN_TARGET
+)
 
 
 def _target_files(proj: str, rel: str) -> list[str]:
+    if rel == MAIN_TARGET:
+        return [rel]
     directory = os.path.join(proj, rel)
     if rel == CMD_DIR:
         # the companion CLI is a small tree of packages
@@ -873,6 +927,7 @@ def run_battery(proj: str):
         "resources": resources_fingerprint(proj),
         "project": project_fingerprint(proj),
         "companion": companion_fingerprint(proj),
+        "main": main_fingerprint(proj),
     }
     results: dict[str, list] = {t: [] for t in TARGETS}
     for target in TARGETS:
@@ -900,6 +955,13 @@ def _verdict(proj: str, target: str, baselines) -> str | None:
                 return "companion-fingerprint"
         except Exception:
             return "companion-fingerprint"
+        return None
+    if target == MAIN_TARGET:
+        try:
+            if main_fingerprint(proj) != baselines["main"]:
+                return "main-fingerprint"
+        except Exception:
+            return "main-fingerprint"
         return None
     if target == ORCHESTRATE_DIR:
         try:

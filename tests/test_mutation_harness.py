@@ -62,6 +62,21 @@ class TestMutationKillRates:
         assert total >= 5
         assert rate >= 0.80
 
+    def test_main_survivors_are_all_triaged_classes(self, battery):
+        # main.go's raw rate sits at ~50% because half its mutants hit
+        # log encoding and unreachable exit codes; the HARNESS property
+        # is that every survivor is a documented equivalent class and
+        # at least the functional mutants (options, registration,
+        # scheme) are killed
+        entries = battery[oracle.MAIN_TARGET]
+        killed = [m for m, k in entries if k]
+        survivors = [m for m, k in entries if not k]
+        assert len(killed) >= 5
+        for mutant in survivors:
+            assert oracle.survivor_key(mutant) in (
+                oracle.EQUIVALENT_SURVIVORS
+            ), oracle.survivor_key(mutant)
+
     def test_every_survivor_is_triaged(self, battery):
         untriaged = []
         for entries in battery.values():
@@ -95,6 +110,9 @@ class TestMutationKillRates:
         assert oracle.project_fingerprint(project) == (
             oracle.project_fingerprint(project)
         )
+        assert oracle.main_fingerprint(project) == (
+            oracle.main_fingerprint(project)
+        )
 
     def test_no_baseline_scenario_errors(self, project):
         # a scenario that errors on HEALTHY sources checks nothing
@@ -103,6 +121,7 @@ class TestMutationKillRates:
             oracle.orchestrate_fingerprint(orchestrate),
             oracle.resources_fingerprint(project),
             oracle.project_fingerprint(project),
+            oracle.main_fingerprint(project),
         ):
             broken = [
                 label for label, value in fingerprint
