@@ -25,9 +25,11 @@ from operator_forge.utils import yamlcompat as pyyaml
 
 from .. import __version__
 from .. import licensing
+from ..perf import cache as perfcache
+from ..perf import spans
 from ..scaffold.api import scaffold_api, scaffold_webhook
 from ..scaffold.context import DEFAULT_LAYOUT, ProjectConfig
-from ..scaffold.machinery import ScaffoldError
+from ..scaffold.machinery import Scaffold, ScaffoldError
 from ..scaffold.project import scaffold_init
 from ..workload import config as wconfig
 from ..workload.create_api import CreateAPIError
@@ -154,13 +156,64 @@ def resolve_plugins(spec: str) -> str:
     return _PLUGIN_BUNDLE_KEY
 
 
+def _dep_globs(processor, include_manifests: bool) -> list:
+    """The glob patterns a parsed config resolved — part of the plan
+    cache's dependency snapshot, so a NEW file matching a component or
+    manifest glob invalidates even though no recorded file changed."""
+    globs = []
+    for p in processor.get_processors():
+        workload = p.workload
+        base = os.path.dirname(p.path)
+        for pattern in getattr(workload, "component_files", ()):
+            globs.append(("files", os.path.join(base, pattern)))
+        if include_manifests:
+            for pattern in workload.spec.resources:
+                globs.append(("manifests", os.path.join(base, pattern)))
+    return globs
+
+
 def cmd_init(args: argparse.Namespace) -> int:
     # resolve plugin keys FIRST: a bad --plugins value must fail before
     # any config work, like the reference CLI's plugin resolution
     layout = resolve_plugins(args.plugins) if args.plugins else (
         _PLUGIN_BUNDLE_KEY
     )
-    processor = wconfig.parse(args.workload_config)
+
+    # content-addressed pipeline cache: when the config tree is unchanged
+    # (validated against hashes + glob results recorded with the plan),
+    # replay the rendered file plan without re-running the pipeline.
+    # License flags write into the output dir before scaffolding, so they
+    # fall through to the full path.
+    plan_key = None
+    if not args.project_license and not args.source_header_license:
+        cfg_sha = perfcache.file_sha(args.workload_config)
+        if cfg_sha is not None:
+            # the generator version joins the key inside perf.cache
+            plan_key = (
+                "init",
+                os.path.abspath(args.workload_config),
+                cfg_sha,
+                args.repo,
+                layout,
+                os.path.relpath(args.workload_config, args.output_dir),
+                bool(args.component_config),
+                _boilerplate_text(args.output_dir),
+            )
+            with spans.span("plan-cache"):
+                plan = perfcache.plan_get(plan_key, args.output_dir)
+            if plan is not None:
+                os.makedirs(args.output_dir, exist_ok=True)
+                scaffold = Scaffold(
+                    output_dir=args.output_dir,
+                    boilerplate=_boilerplate_text(args.output_dir),
+                )
+                scaffold.execute(plan)
+                print(f"project scaffolded at {args.output_dir} "
+                      f"({len(scaffold.written)} files)")
+                return 0
+
+    with spans.span("config-parse"):
+        processor = wconfig.parse(args.workload_config)
     init_workloads(processor)
     workload = processor.workload
 
@@ -193,6 +246,14 @@ def cmd_init(args: argparse.Namespace) -> int:
         names,
         boilerplate_text=_boilerplate_text(args.output_dir),
     )
+    if plan_key is not None:
+        with spans.span("plan-cache"):
+            perfcache.plan_put(
+                plan_key,
+                scaffold.specs,
+                dep_files=[p.path for p in processor.get_processors()],
+                dep_globs=_dep_globs(processor, include_manifests=False),
+            )
     print(f"project scaffolded at {args.output_dir} "
           f"({len(scaffold.written)} files)")
     return 0
@@ -343,23 +404,94 @@ def cmd_create_api(args: argparse.Namespace) -> int:
             "--workload-config"
         )
 
-    processor = wconfig.parse(workload_config)
+    # content-addressed pipeline cache (plain path only: the conversion
+    # and admission paths read and mutate the existing output tree, so
+    # their effect is not a pure function of the recorded inputs)
+    boilerplate = _boilerplate_text(args.output_dir)
+    plan_key = None
+    if (
+        not args.dry_run
+        and not args.enable_conversion
+        and not config.enable_conversion
+        and not config.webhook_defaulting
+        and not config.webhook_validation
+    ):
+        cfg_sha = perfcache.file_sha(workload_config)
+        if cfg_sha is not None:
+            plan_key = (
+                "create-api",
+                os.path.abspath(workload_config),
+                cfg_sha,
+                config.to_yaml(),
+                bool(args.resource),
+                bool(args.controller),
+                boilerplate,
+            )
+            with spans.span("plan-cache"):
+                plan = perfcache.plan_get(plan_key, args.output_dir)
+            if plan is not None:
+                specs, fragments = plan
+                scaffold = Scaffold(
+                    output_dir=args.output_dir, boilerplate=boilerplate
+                )
+                scaffold.execute(specs, fragments)
+                print(
+                    f"api scaffolded at {args.output_dir} "
+                    f"({len(scaffold.written)} files, "
+                    f"{len(scaffold.skipped)} preserved)"
+                )
+                return 0
+
+    with spans.span("config-parse"):
+        processor = wconfig.parse(workload_config)
     init_workloads(processor)
     run_create_api(processor)
 
     newly_enabled = args.enable_conversion and not config.enable_conversion
     config.enable_conversion = config.enable_conversion or args.enable_conversion
 
+    # the CRD renderer merges against previously scaffolded CRD bases, so
+    # their pre-execution state is part of the plan's dependency snapshot
+    crd_reldir = os.path.join("config", "crd", "bases")
+    crd_state = (
+        perfcache.dir_state(args.output_dir, crd_reldir)
+        if plan_key is not None
+        else ()
+    )
+
     scaffold = scaffold_api(
         args.output_dir,
         processor,
         config,
-        boilerplate_text=_boilerplate_text(args.output_dir),
+        boilerplate_text=boilerplate,
         with_resources=args.resource,
         with_controllers=args.controller,
         enable_conversion=config.enable_conversion,
         dry_run=args.dry_run,
     )
+
+    if plan_key is not None:
+        dep_files = [p.path for p in processor.get_processors()]
+        dep_files.extend(
+            manifest.filename
+            for workload in processor.get_workloads()
+            for manifest in workload.spec.manifests
+        )
+        # two acceptable CRD-base states: what the renderer merged
+        # against, and what this plan just wrote (re-rendering over its
+        # own output is a fixed point)
+        crd_states = [crd_state]
+        post_state = perfcache.dir_state(args.output_dir, crd_reldir)
+        if post_state != crd_state:
+            crd_states.append(post_state)
+        with spans.span("plan-cache"):
+            perfcache.plan_put(
+                plan_key,
+                (scaffold.specs, scaffold.fragments),
+                dep_files=dep_files,
+                dep_globs=_dep_globs(processor, include_manifests=True),
+                out_state=[(crd_reldir, crd_states)],
+            )
 
     if args.dry_run:
         # the real run records the conversion opt-in in PROJECT
@@ -819,7 +951,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        return args.func(args)
+        with spans.span(f"command:{args.command}"):
+            return args.func(args)
     except (
         CLIError,
         CreateAPIError,
@@ -838,6 +971,10 @@ def main(argv: list[str] | None = None) -> int:
         except OSError:
             pass
         return 141
+    finally:
+        # a profiled run that fails still reports the work it did
+        if os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0"):
+            spans.report(sys.stderr)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,7 @@ grammar it accepts is documented in the package docstring.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
@@ -15,6 +16,18 @@ from typing import Optional, Union
 _TOKEN_EXCEPTIONS = set(':= "\'`,+{}[]();\n')
 # naked string values additionally allow ';' (state.go:286-291)
 _NAKED_EXCEPTIONS = set(':= "\'`,+{}[]()\n')
+
+
+def _run_pattern(exceptions: set) -> "re.Pattern[str]":
+    """Precompiled longest-run scan up to any terminator character —
+    replaces the per-character loop (module-level patterns, matching the
+    style of gocheck/structural.py)."""
+    return re.compile("[^" + re.escape("".join(sorted(exceptions))) + "]*")
+
+
+_TOKEN_RUN_RE = _run_pattern(_TOKEN_EXCEPTIONS)
+_NAKED_RUN_RE = _run_pattern(_NAKED_EXCEPTIONS)
+_BREAK_RUN_RE = _run_pattern(set(" \n"))
 
 Literal = Union[str, int, float, bool]
 
@@ -54,11 +67,10 @@ class _Scanner:
     def at_end(self) -> bool:
         return self.pos >= len(self.text)
 
-    def take_until(self, exceptions: set[str]) -> str:
-        start = self.pos
-        while not self.at_end() and self.text[self.pos] not in exceptions:
-            self.pos += 1
-        return self.text[start : self.pos]
+    def take_run(self, pattern: "re.Pattern[str]") -> str:
+        match = pattern.match(self.text, self.pos)
+        self.pos = match.end()
+        return match.group()
 
     # -- top level ------------------------------------------------------
 
@@ -81,7 +93,7 @@ class _Scanner:
         """Scan scopes then arguments; emits a RawMarker or a warning."""
         scopes: list[str] = []
         while True:
-            token = self.take_until(_TOKEN_EXCEPTIONS)
+            token = self.take_run(_TOKEN_RUN_RE)
             nxt = self.peek()
             if token and nxt == ":":
                 scopes.append(token)
@@ -121,7 +133,7 @@ class _Scanner:
             nxt = self.peek()
             if nxt == ",":
                 self.pos += 1
-                name = self.take_until(_TOKEN_EXCEPTIONS)
+                name = self.take_run(_TOKEN_RUN_RE)
                 if not name:
                     raise ScanError(
                         f"malformed argument at position {self.pos} in marker "
@@ -153,8 +165,7 @@ class _Scanner:
         )
 
     def _skip_to_break(self) -> None:
-        while not self.at_end() and self.text[self.pos] not in " \n":
-            self.pos += 1
+        self.pos = _BREAK_RUN_RE.match(self.text, self.pos).end()
 
     # -- literals -------------------------------------------------------
 
@@ -168,7 +179,7 @@ class _Scanner:
             return True
         if self._try_consume("false"):
             return False
-        naked = self.take_until(_NAKED_EXCEPTIONS)
+        naked = self.take_run(_NAKED_RUN_RE)
         if not naked:
             raise ScanError(f"malformed argument at position {self.pos}")
         return naked

@@ -1,0 +1,461 @@
+"""Content-addressed pipeline cache.
+
+Each cached stage is keyed on a SHA-256 over its complete inputs —
+manifest/workload-config bytes, CLI flags, and the generator version —
+so a hit can only replay work whose output is byte-identical to a fresh
+computation.  Two granularities:
+
+- **stage memoization** (:func:`memoized`): per-manifest marker
+  inspection, per-manifest child-resource codegen, and per-child
+  resource-marker scans are memoized in-process;
+- **pipeline plans** (:func:`plan_get` / :func:`plan_put`): the fully
+  rendered file plan (FileSpecs + Fragments) of an ``init`` /
+  ``create api`` run, validated against a dependency snapshot (input
+  file hashes, glob results, and the pre-existing CRD state the renderer
+  merges against) so a warm re-run over unchanged fixtures skips the
+  whole compile pipeline and goes straight to byte-identical writes.
+
+Modes (``OPERATOR_FORGE_CACHE``):
+
+- ``off``  — every lookup misses; nothing is stored.
+- ``mem``  — in-process memoization only (the default; a fresh process
+  always starts cold, so single-shot CLI behavior is unchanged).
+- ``disk`` — ``mem`` plus persistence under ``.operator-forge-cache/``
+  (override the location with ``OPERATOR_FORGE_CACHE_DIR``) so warm
+  state survives across processes.
+
+Values are stored pickled: a hit always deserializes a fresh copy, so
+callers may freely mutate returned objects without corrupting the cache
+(several pipeline objects — field markers, child resources — are mutated
+after the cacheable stage computes them).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import hmac
+import os
+import pickle
+import tempfile
+import threading
+from dataclasses import dataclass, field as dc_field
+
+from .. import __version__
+
+# bump to invalidate every previously persisted entry when the record
+# layout (not the generator output) changes
+_SCHEMA = 1
+
+_MODES = ("off", "mem", "disk")
+DEFAULT_MODE = "mem"
+DEFAULT_DIR = ".operator-forge-cache"
+
+
+class _Miss:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "MISS"
+
+
+#: sentinel distinguishing "not cached" from a cached ``None``
+MISS = _Miss()
+
+
+def _hash_update(h, obj) -> None:
+    """Canonical tagged hashing for plain key parts (no pickle: pickle
+    bytes vary with object identity/memoization, hashes must not)."""
+    if obj is None:
+        h.update(b"N;")
+    elif isinstance(obj, bool):
+        h.update(b"B1;" if obj else b"B0;")
+    elif isinstance(obj, int):
+        h.update(b"I%d;" % obj)
+    elif isinstance(obj, float):
+        h.update(b"F" + repr(obj).encode("ascii") + b";")
+    elif isinstance(obj, str):
+        data = obj.encode("utf-8")
+        h.update(b"S%d:" % len(data))
+        h.update(data)
+    elif isinstance(obj, bytes):
+        h.update(b"Y%d:" % len(obj))
+        h.update(obj)
+    elif isinstance(obj, enum.Enum):
+        _hash_update(h, obj.value)
+    elif isinstance(obj, (tuple, list)):
+        h.update(b"T(")
+        for item in obj:
+            _hash_update(h, item)
+        h.update(b")")
+    elif isinstance(obj, dict):
+        h.update(b"D(")
+        for key in sorted(obj):
+            _hash_update(h, key)
+            _hash_update(h, obj[key])
+        h.update(b")")
+    else:
+        raise TypeError(
+            f"cache key parts must be plain data, got {type(obj).__name__}"
+        )
+
+
+def hash_parts(*parts) -> str:
+    """SHA-256 hex digest over canonically encoded key parts."""
+    h = hashlib.sha256()
+    _hash_update(h, parts)
+    return h.hexdigest()
+
+
+def file_sha(path: str):
+    """SHA-256 of a file's bytes, or ``None`` when unreadable/missing
+    (missing is a valid, cacheable dependency state)."""
+    try:
+        with open(path, "rb") as handle:
+            return hashlib.sha256(handle.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def dir_state(output_dir: str, reldir: str) -> tuple:
+    """Sorted ``(relpath, sha)`` listing of the plain files directly under
+    ``output_dir/reldir`` — the renderer's view of previously scaffolded
+    CRD bases.  A missing directory is the empty listing."""
+    base = os.path.join(output_dir, reldir)
+    out = []
+    try:
+        names = sorted(os.listdir(base))
+    except OSError:
+        return ()
+    for name in names:
+        path = os.path.join(base, name)
+        if os.path.isfile(path):
+            out.append((name, file_sha(path)))
+    return tuple(out)
+
+
+# -- disk-blob authentication -------------------------------------------
+#
+# Disk entries are pickles, and the default cache dir is cwd-relative —
+# a cloned repository could ship a crafted ``.operator-forge-cache/``
+# whose pickle executes code on load.  Every persisted blob is therefore
+# HMAC-signed with a per-user key stored OUTSIDE any shippable tree
+# (``~/.cache/operator-forge/cache.key``); a blob that does not verify
+# is treated as a miss and never unpickled.
+
+_KEY_BYTES = 32
+_SIG_BYTES = hashlib.sha256().digest_size
+_hmac_key = None
+_hmac_lock = threading.Lock()
+
+
+def _key_path() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "operator-forge", "cache.key")
+
+
+def _load_hmac_key():
+    """The per-user signing key, created on first use.  ``None`` (no
+    writable home) disables disk persistence entirely."""
+    global _hmac_key
+    with _hmac_lock:
+        if _hmac_key is not None:
+            return _hmac_key or None  # b"" caches the unavailable state
+        path = _key_path()
+        try:
+            with open(path, "rb") as handle:
+                key = handle.read()
+            if len(key) == _KEY_BYTES:
+                _hmac_key = key
+                return key
+        except OSError:
+            pass
+        key = os.urandom(_KEY_BYTES)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            with os.fdopen(fd, "wb") as handle:
+                handle.write(key)
+        except FileExistsError:
+            try:  # lost a creation race: use the winner's key
+                with open(path, "rb") as handle:
+                    key = handle.read()
+            except OSError:
+                _hmac_key = b""
+                return None
+            if len(key) != _KEY_BYTES:
+                _hmac_key = b""
+                return None
+        except OSError:
+            _hmac_key = b""
+            return None
+        _hmac_key = key
+        return key
+
+
+def _sign(key: bytes, blob: bytes) -> bytes:
+    return hmac.new(key, blob, hashlib.sha256).digest()
+
+
+class ContentCache:
+    """Thread-safe content-addressed store with hit/miss accounting."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._mem: dict = {}
+        self._stats: dict = {}
+        self._mode_override = None
+        self._root_override = None
+
+    # -- configuration --------------------------------------------------
+
+    def mode(self) -> str:
+        if self._mode_override is not None:
+            return self._mode_override
+        raw = os.environ.get("OPERATOR_FORGE_CACHE", DEFAULT_MODE)
+        raw = raw.strip().lower()
+        return raw if raw in _MODES else DEFAULT_MODE
+
+    def root(self) -> str:
+        if self._root_override is not None:
+            return self._root_override
+        return os.environ.get("OPERATOR_FORGE_CACHE_DIR", DEFAULT_DIR)
+
+    def configure(self, mode=None, root=None) -> None:
+        """Override (or with ``None`` restore) the env-driven mode/root."""
+        if mode is not None and mode not in _MODES:
+            raise ValueError(f"unknown cache mode {mode!r}; known: {_MODES}")
+        self._mode_override = mode
+        self._root_override = root
+
+    def reset(self) -> None:
+        """Drop all in-memory entries and statistics (persisted disk
+        entries survive — they are re-validated content hashes)."""
+        with self._lock:
+            self._mem.clear()
+            self._stats.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {stage: dict(count) for stage, count in self._stats.items()}
+
+    def _count(self, stage: str, what: str) -> None:
+        with self._lock:
+            entry = self._stats.setdefault(stage, {"hits": 0, "misses": 0})
+            entry[what] += 1
+
+    # -- store ----------------------------------------------------------
+
+    def _disk_path(self, stage: str, key: str) -> str:
+        return os.path.join(self.root(), stage, key[:2], key + ".pkl")
+
+    def get(self, stage: str, key: str, record_stats: bool = True):
+        """Fetch a value; returns :data:`MISS` when absent.  Hits always
+        return a freshly deserialized copy."""
+        mode = self.mode()
+        if mode == "off":
+            return MISS
+        with self._lock:
+            blob = self._mem.get((stage, key))
+        if blob is None and mode == "disk":
+            blob = self._disk_read(stage, key)
+            if blob is not None:
+                with self._lock:
+                    self._mem[(stage, key)] = blob
+        if blob is None:
+            if record_stats:
+                self._count(stage, "misses")
+            return MISS
+        try:
+            value = pickle.loads(blob)
+        except Exception:
+            # a corrupt persisted entry is just a miss
+            if record_stats:
+                self._count(stage, "misses")
+            return MISS
+        if record_stats:
+            self._count(stage, "hits")
+        return value
+
+    def put(self, stage: str, key: str, value):
+        """Store a value (pickled immediately, so later caller mutations
+        of ``value`` cannot leak into the cache).  Returns ``value``."""
+        mode = self.mode()
+        if mode == "off":
+            return value
+        try:
+            blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            return value  # unpicklable values simply aren't cached
+        with self._lock:
+            self._mem[(stage, key)] = blob
+        if mode == "disk":
+            self._disk_write(stage, key, blob)
+        return value
+
+    def _disk_read(self, stage: str, key: str):
+        """Read and authenticate a persisted blob; anything unsigned,
+        tampered, or unverifiable is a miss (never unpickled)."""
+        signing_key = _load_hmac_key()
+        if signing_key is None:
+            return None
+        try:
+            with open(self._disk_path(stage, key), "rb") as handle:
+                data = handle.read()
+        except OSError:
+            return None
+        if len(data) <= _SIG_BYTES:
+            return None
+        signature, blob = data[:_SIG_BYTES], data[_SIG_BYTES:]
+        if not hmac.compare_digest(signature, _sign(signing_key, blob)):
+            return None
+        return blob
+
+    def _disk_write(self, stage: str, key: str, blob: bytes) -> None:
+        signing_key = _load_hmac_key()
+        if signing_key is None:
+            return  # no key, no persistence; the mem entry stands
+        path = self._disk_path(stage, key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as handle:
+                handle.write(_sign(signing_key, blob) + blob)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # persistence is best-effort
+
+
+_CACHE = ContentCache()
+
+
+def get_cache() -> ContentCache:
+    return _CACHE
+
+
+def configure(mode=None, root=None) -> None:
+    _CACHE.configure(mode, root)
+
+
+def reset() -> None:
+    _CACHE.reset()
+
+
+def stats() -> dict:
+    return _CACHE.stats()
+
+
+def memoized(stage: str, key_parts: tuple, compute):
+    """Memoize ``compute()`` under a content hash of ``key_parts``.
+
+    On a miss the freshly computed object is returned directly (and a
+    pristine pickled copy stored); on a hit an independent copy is
+    deserialized — either way the caller owns the returned object.
+    """
+    cache = _CACHE
+    if cache.mode() == "off":
+        return compute()
+    # __version__ is part of every key: a persisted (disk-mode) entry
+    # must never replay an older generator's output
+    key = hash_parts(_SCHEMA, __version__, *key_parts)
+    hit = cache.get(stage, key)
+    if hit is not MISS:
+        return hit
+    return cache.put(stage, key, compute())
+
+
+# -- pipeline plans ------------------------------------------------------
+
+_PLAN_STAGE = "plan"
+
+
+@dataclass
+class PlanRecord:
+    """A cached file plan plus the dependency snapshot that must still
+    hold for the plan to be replayed."""
+
+    # (path, sha-or-None) for every input file the pipeline read
+    dep_files: list = dc_field(default_factory=list)
+    # (kind, pattern, resolved-paths) — new files matching a config's
+    # component/manifest glob must invalidate even though no recorded
+    # file changed
+    dep_globs: list = dc_field(default_factory=list)
+    # (reldir, acceptable dir_state listings) — output-tree state the
+    # renderer merged against (existing CRD bases).  Acceptable states:
+    # the one captured BEFORE the plan executed, and the plan's own
+    # output (re-rendering over own output is a fixed point, so a re-run
+    # over the just-scaffolded tree may replay the plan)
+    out_state: list = dc_field(default_factory=list)
+    plan: object = None
+
+
+def _glob_results(kind: str, pattern: str) -> tuple:
+    from ..utils.globber import glob_files, glob_manifest_files
+
+    try:
+        if kind == "manifests":
+            return tuple(glob_manifest_files(pattern))
+        return tuple(glob_files(pattern))
+    except Exception:
+        return ("<glob-error>",)
+
+
+def plan_get(key_parts: tuple, output_dir: str):
+    """Return the cached plan for ``key_parts`` if every recorded
+    dependency (file hashes, glob results, output-dir CRD state) still
+    matches; ``None`` otherwise."""
+    cache = _CACHE
+    if cache.mode() == "off":
+        return None
+    key = hash_parts(_SCHEMA, __version__, _PLAN_STAGE, *key_parts)
+    record = cache.get(_PLAN_STAGE, key, record_stats=False)
+    valid = record is not MISS and isinstance(record, PlanRecord)
+    if valid:
+        for path, sha in record.dep_files:
+            if file_sha(path) != sha:
+                valid = False
+                break
+    if valid:
+        for kind, pattern, resolved in record.dep_globs:
+            if _glob_results(kind, pattern) != tuple(resolved):
+                valid = False
+                break
+    if valid:
+        for reldir, listings in record.out_state:
+            if dir_state(output_dir, reldir) not in [
+                tuple(listing) for listing in listings
+            ]:
+                valid = False
+                break
+    cache._count(_PLAN_STAGE, "hits" if valid else "misses")
+    return record.plan if valid else None
+
+
+def plan_put(
+    key_parts: tuple,
+    plan,
+    dep_files=(),
+    dep_globs=(),
+    out_state=(),
+) -> None:
+    """Store a plan with its dependency snapshot.  ``dep_files`` are
+    hashed now; ``dep_globs`` are (kind, pattern) pairs resolved now;
+    ``out_state`` is (reldir, acceptable-listings) pairs supplied by the
+    caller (pre-execution state plus the plan's own output state)."""
+    cache = _CACHE
+    if cache.mode() == "off":
+        return
+    key = hash_parts(_SCHEMA, __version__, _PLAN_STAGE, *key_parts)
+    record = PlanRecord(
+        dep_files=[(path, file_sha(path)) for path in dep_files],
+        dep_globs=[
+            (kind, pattern, _glob_results(kind, pattern))
+            for kind, pattern in dep_globs
+        ],
+        out_state=[
+            (reldir, tuple(tuple(listing) for listing in listings))
+            for reldir, listings in out_state
+        ],
+        plan=plan,
+    )
+    cache.put(_PLAN_STAGE, key, record)

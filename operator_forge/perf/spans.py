@@ -1,0 +1,89 @@
+"""Lightweight span profiler for the generation pipeline.
+
+Env-gated (``OPERATOR_FORGE_PROFILE=1``) or enabled programmatically
+(bench.py).  Spans aggregate wall-clock durations per stage name into a
+process-global, thread-safe table; the CLI prints the table to stderr on
+exit when the env var is set, and bench.py surfaces it as the ``stages``
+breakdown in the BENCH JSON.
+
+Stages are *inclusive* and may nest or run on worker threads, so totals
+can overlap and, under ``OPERATOR_FORGE_JOBS>1``, sum to more than the
+elapsed wall time — read them as attribution, not as a partition.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_totals: dict = {}  # name -> [calls, seconds]
+_forced = None  # None: follow the env var; bool: programmatic override
+
+
+def enabled() -> bool:
+    if _forced is not None:
+        return _forced
+    return os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0")
+
+
+def enable(flag: bool = True) -> None:
+    """Programmatic on/off override (bench.py, tests)."""
+    global _forced
+    _forced = flag
+
+
+def use_env() -> None:
+    """Drop any programmatic override; follow ``OPERATOR_FORGE_PROFILE``."""
+    global _forced
+    _forced = None
+
+
+def reset() -> None:
+    with _lock:
+        _totals.clear()
+
+
+def record(name: str, seconds: float) -> None:
+    with _lock:
+        entry = _totals.setdefault(name, [0, 0.0])
+        entry[0] += 1
+        entry[1] += seconds
+
+
+@contextmanager
+def span(name: str):
+    """Time a stage; free (no clock reads) when profiling is disabled."""
+    if not enabled():
+        yield
+        return
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(name, time.perf_counter() - start)
+
+
+def snapshot() -> dict:
+    """``{stage: {"calls": n, "s": seconds}}``, sorted by stage name."""
+    with _lock:
+        return {
+            name: {"calls": calls, "s": round(seconds, 6)}
+            for name, (calls, seconds) in sorted(_totals.items())
+        }
+
+
+def report(stream) -> None:
+    """Print the aggregate table (slowest stage first)."""
+    snap = snapshot()
+    if not snap:
+        return
+    width = max(len(name) for name in snap)
+    print(f"{'stage'.ljust(width)}  {'calls':>7}  {'seconds':>10}", file=stream)
+    for name, data in sorted(snap.items(), key=lambda kv: -kv[1]["s"]):
+        print(
+            f"{name.ljust(width)}  {data['calls']:>7}  {data['s']:>10.4f}",
+            file=stream,
+        )

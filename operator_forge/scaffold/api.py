@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 
+from ..perf import spans
 from ..workload.config import Processor
 from .context import ProjectConfig, WorkloadView, views_for
 from .machinery import FileSpec, Fragment, Scaffold
@@ -144,6 +145,29 @@ def main_go_fragments(
     return fragments
 
 
+def api_plan(
+    views: list[WorkloadView],
+    output_dir: str = "",
+    with_resources: bool = True,
+    with_controllers: bool = True,
+    enable_conversion: bool = False,
+) -> tuple[list[FileSpec], list[Fragment]]:
+    """Render the create-api file plan (specs + main.go/kind-registry
+    fragments).  For the plain path — no conversion, no admission — this
+    is the complete effect of ``create api`` and therefore the unit the
+    content-addressed pipeline cache persists and replays."""
+    fragments = main_go_fragments(views, with_resources, with_controllers)
+    if with_resources:
+        for view in views:
+            fragments.extend(api_tpl.kind_registry_fragments(view))
+    with spans.span("render"):
+        specs = api_files(
+            views, output_dir, with_resources, with_controllers,
+            enable_conversion,
+        )
+    return specs, fragments
+
+
 def scaffold_api(
     output_dir: str,
     processor: Processor,
@@ -158,12 +182,7 @@ def scaffold_api(
     scaffold = Scaffold(
         output_dir=output_dir, boilerplate=boilerplate_text, dry_run=dry_run
     )
-    fragments = main_go_fragments(views, with_resources, with_controllers)
-    if with_resources:
-        for view in views:
-            fragments.extend(api_tpl.kind_registry_fragments(view))
-
-    specs = api_files(
+    specs, fragments = api_plan(
         views, output_dir, with_resources, with_controllers, enable_conversion
     )
 

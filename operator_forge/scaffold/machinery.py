@@ -19,6 +19,8 @@ import os
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
+from ..perf import parallel_map, spans
+
 MARKER_PREFIX = "+operator-builder:scaffold:"
 
 
@@ -73,28 +75,61 @@ class Scaffold:
     # (action, path) pairs: create / overwrite / unchanged / preserve /
     # fragment — populated in dry-run mode only
     changes: list = dc_field(default_factory=list)
+    # the last executed plan, retained so callers (the pipeline cache)
+    # can persist exactly what this scaffold would replay
+    specs: list = dc_field(default_factory=list)
+    fragments: list = dc_field(default_factory=list)
+    # directories already created this scaffold — os.makedirs walks and
+    # stats every path component, which dominates write time on slow
+    # filesystems when repeated per file
+    _made_dirs: set = dc_field(default_factory=set, repr=False)
 
     def execute(
         self,
         specs: list[FileSpec],
         fragments: Optional[list[Fragment]] = None,
     ) -> None:
-        for spec in specs:
-            self._write(spec)
-        for fragment in fragments or []:
-            self._insert(fragment)
+        specs = list(specs)
+        fragments = list(fragments or [])
+        self.specs = specs
+        self.fragments = fragments
+        with spans.span("write"):
+            paths = [spec.path for spec in specs]
+            if self.dry_run or len(set(paths)) < len(paths):
+                # duplicate paths are order-dependent (a later spec must
+                # observe the earlier write), and dry runs are pure
+                # bookkeeping — both take the serial path
+                outcomes = [self._write_one(spec) for spec in specs]
+            else:
+                # unique targets are independent: render+write in a
+                # thread pool, collect outcomes in spec order so the
+                # written/skipped/changes lists are deterministic
+                outcomes = parallel_map(self._write_one, specs)
+            for outcome in outcomes:
+                self._record(outcome)
+            for fragment in fragments:
+                self._insert(fragment)
 
     # -- files ----------------------------------------------------------
 
-    def _write(self, spec: FileSpec) -> None:
+    def _ensure_dir(self, directory: str) -> None:
+        if not directory or directory in self._made_dirs:
+            return
+        os.makedirs(directory, exist_ok=True)
+        # set mutation is atomic under the GIL and a duplicate makedirs
+        # (exist_ok) is harmless, so no lock is needed for worker threads
+        self._made_dirs.add(directory)
+
+    def _write_one(self, spec: FileSpec) -> tuple:
+        """Write (or classify, in dry-run) one spec; returns a
+        ``(status, path, change-or-None)`` outcome and touches no shared
+        state, so it is safe to run on a worker thread."""
         target = os.path.join(self.output_dir, spec.path)
         exists = os.path.exists(target)
         if exists:
             if spec.if_exists == IfExists.SKIP:
-                self.skipped.append(spec.path)
-                if self.dry_run:
-                    self.changes.append(("preserve", spec.path))
-                return
+                change = ("preserve", spec.path) if self.dry_run else None
+                return ("skipped", spec.path, change)
             if spec.if_exists == IfExists.ERROR:
                 raise ScaffoldError(f"file already exists: {spec.path}")
         content = spec.content
@@ -109,19 +144,41 @@ class Scaffold:
             content += "\n"
         if self.dry_run:
             if not exists:
-                self.changes.append(("create", spec.path))
+                change = ("create", spec.path)
             else:
                 with open(target, "r", encoding="utf-8") as handle:
                     current = handle.read()
-                self.changes.append(
-                    ("unchanged" if current == content else "overwrite", spec.path)
+                change = (
+                    "unchanged" if current == content else "overwrite",
+                    spec.path,
                 )
-            self.written.append(spec.path)
-            return
-        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+            return ("written", spec.path, change)
+        if exists:
+            # incremental re-scaffold: leave byte-identical targets
+            # untouched (a read costs less than a rewrite, and an
+            # unchanged tree is the common warm-cache case).  Compared
+            # as bytes: text mode would normalize CRLF and miss a
+            # mangled file that needs restoring.
+            try:
+                with open(target, "rb") as handle:
+                    if handle.read() == content.encode("utf-8"):
+                        return ("written", spec.path, None)
+            except OSError:
+                pass
+        else:
+            self._ensure_dir(os.path.dirname(target))
         with open(target, "w", encoding="utf-8") as handle:
             handle.write(content)
-        self.written.append(spec.path)
+        return ("written", spec.path, None)
+
+    def _record(self, outcome: tuple) -> None:
+        status, path, change = outcome
+        if status == "skipped":
+            self.skipped.append(path)
+        else:
+            self.written.append(path)
+        if change is not None:
+            self.changes.append(change)
 
     # -- fragments ------------------------------------------------------
 

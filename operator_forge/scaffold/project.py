@@ -7,6 +7,7 @@ produces before it runs).
 
 from __future__ import annotations
 
+from ..perf import spans
 from .context import ProjectConfig
 from .machinery import FileSpec, Scaffold
 from .templates import kustomize, orchestrate, project
@@ -39,5 +40,7 @@ def scaffold_init(
     boilerplate_text: str = "",
 ) -> Scaffold:
     scaffold = Scaffold(output_dir=output_dir, boilerplate=boilerplate_text)
-    scaffold.execute(init_files(config, workload_names))
+    with spans.span("render"):
+        specs = init_files(config, workload_names)
+    scaffold.execute(specs)
     return scaffold

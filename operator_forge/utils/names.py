@@ -11,7 +11,13 @@ Reference: internal/utils/names.go:12-43.  Behavioral contract:
 
 from __future__ import annotations
 
+import functools
 
+# every helper is memoized: they are pure string->string maps called once
+# per field per template, and real configs reuse a small set of names
+
+
+@functools.lru_cache(maxsize=None)
 def to_title(s: str) -> str:
     """Uppercase the first letter of each space/punctuation-separated word.
 
@@ -35,6 +41,7 @@ def to_title(s: str) -> str:
     return "".join(out)
 
 
+@functools.lru_cache(maxsize=None)
 def title_words(s: str, seps: str = ".-_ :") -> str:
     """Title-case ``s`` and drop the separator characters.
 
@@ -47,6 +54,7 @@ def title_words(s: str, seps: str = ".-_ :") -> str:
     return result
 
 
+@functools.lru_cache(maxsize=None)
 def to_pascal_case(name: str) -> str:
     """kebab-case -> PascalCase (reference internal/utils/names.go:12-31)."""
     out = []
@@ -62,11 +70,13 @@ def to_pascal_case(name: str) -> str:
     return "".join(out)
 
 
+@functools.lru_cache(maxsize=None)
 def to_file_name(name: str) -> str:
     """kebab-case -> snake_case (reference internal/utils/names.go:33-37)."""
     return name.replace("-", "_").lower()
 
 
+@functools.lru_cache(maxsize=None)
 def to_package_name(name: str) -> str:
     """kebab-case -> flat lowercase (reference internal/utils/names.go:39-43)."""
     return name.replace("-", "").lower()

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 import os
 
+from ..perf import spans
 from .config import Processor
 from .fieldmarkers import MarkerCollection
 from .kinds import ComponentWorkload, StandaloneWorkload, WorkloadCollection
@@ -80,10 +81,11 @@ def create_api(processor: Processor) -> None:
         )
 
     # resolve resource markers across all specs (create_api.go:113-119)
-    for spec in specs:
-        try:
-            spec.process_resource_markers(markers)
-        except Exception as exc:
-            raise CreateAPIError(
-                f"{exc}; error processing resource markers"
-            ) from exc
+    with spans.span("resource-markers"):
+        for spec in specs:
+            try:
+                spec.process_resource_markers(markers)
+            except Exception as exc:
+                raise CreateAPIError(
+                    f"{exc}; error processing resource markers"
+                ) from exc

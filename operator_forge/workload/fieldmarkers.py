@@ -19,6 +19,7 @@ manifests work unchanged):
 from __future__ import annotations
 
 import enum
+import functools
 import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional, Union
@@ -241,11 +242,10 @@ class ResourceMarker:
     def _set_source_code(self) -> None:
         var = f"{self.spec_prefix}.{to_title(self.marker_name)}"
         value = self.value
-        type_names = {str: "string", int: "int", bool: "bool"}
         if isinstance(value, bool):
             value_type = "bool"
-        elif type(value) in type_names:
-            value_type = type_names[type(value)]
+        elif type(value) in _GO_TYPE_NAMES:
+            value_type = _GO_TYPE_NAMES[type(value)]
         else:
             raise ResourceMarkerError(
                 f"resource marker 'value' is of unknown type; {self}"
@@ -277,6 +277,12 @@ class MarkerCollection:
     )
 
 
+# Go type names keyed by marker-value Python type (hoisted from
+# ResourceMarker._set_source_code; bool handled first there since
+# bool is an int subclass)
+_GO_TYPE_NAMES = {str: "string", int: "int", bool: "bool"}
+
+
 def _go_quote(value: str) -> str:
     out = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
     return f'"{out}"'
@@ -306,8 +312,19 @@ def source_code_field_variable(marker: _FieldMarkerBase) -> str:
     return f"!!start {marker.source_code_var} !!end"
 
 
+# title-cased reserved names, computed once instead of per lookup
+_RESERVED_TITLED = frozenset(to_title(r) for r in RESERVED_FIELD_NAMES)
+
+
 def _is_reserved(name: str) -> bool:
-    return to_title(name) in {to_title(r) for r in RESERVED_FIELD_NAMES}
+    return to_title(name) in _RESERVED_TITLED
+
+
+@functools.lru_cache(maxsize=256)
+def _compile_replace(pattern: str) -> "re.Pattern[str]":
+    """Replace-marker patterns recur across manifests and runs; compile
+    each distinct pattern once."""
+    return re.compile(pattern)
 
 
 # each dot-separated path segment must title-case into a valid Go identifier
@@ -433,7 +450,7 @@ def _set_value(marker: _FieldMarkerBase, result: InspectResult) -> None:
     if marker.replace_text:
         node.tag = STR_TAG
         try:
-            pattern = re.compile(marker.replace_text)
+            pattern = _compile_replace(marker.replace_text)
         except re.error as exc:
             raise MarkerError(
                 f"unable to convert {marker.replace_text!r} to regex: {exc}"

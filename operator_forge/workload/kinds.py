@@ -15,6 +15,8 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
 from .. import gocodegen
+from ..perf import cache as perfcache
+from ..perf import parallel_map, spans
 from ..utils import to_package_name
 from ..yamldoc.load import load_documents
 from ..yamldoc.emit import emit_documents
@@ -29,8 +31,87 @@ from .fieldmarkers import (
     FieldType,
     MarkerCollection,
     MarkerType,
+    _FieldMarkerBase,
     inspect_for_yaml,
 )
+
+
+def _transform_manifest(content: str, marker_types: tuple) -> tuple:
+    """The pure per-manifest marker pass: inspect ``content`` for the
+    requested marker types, rewrite values/comments, and return
+    ``(rewritten_content, field markers in inspection order)``.
+
+    Pure in its arguments, so it is memoized content-addressed (stage
+    ``manifest-transform``); a cache hit returns fresh marker copies the
+    caller may mutate.
+    """
+
+    def compute():
+        inspected = inspect_for_yaml(content, *marker_types)
+        new_content = emit_documents(inspected.documents)
+        # when processing a collection's own manifests, any surviving
+        # collection-variable references are references to self
+        # (reference workload.go:317-326)
+        if (
+            MarkerType.FIELD in marker_types
+            and MarkerType.COLLECTION in marker_types
+        ):
+            new_content = new_content.replace("!!var collection", "!!var parent")
+            new_content = new_content.replace(
+                "!!start collection", "!!start parent"
+            )
+        markers = [
+            r.obj
+            for r in inspected.results
+            if isinstance(r.obj, _FieldMarkerBase)
+        ]
+        return new_content, markers
+
+    with spans.span("marker-inspect"):
+        return perfcache.memoized(
+            "manifest-transform",
+            (content, tuple(mt.value for mt in marker_types)),
+            compute,
+        )
+
+
+def _build_children(content: str, filename: str) -> list:
+    """Child resources (with generated Go source) for REWRITTEN manifest
+    content.  Pure in ``content`` — ``filename`` only decorates error
+    messages, and errors are never cached — so it is memoized
+    content-addressed (stage ``manifest-children``)."""
+
+    def compute():
+        children: list[manifests_mod.ChildResource] = []
+        shell = manifests_mod.Manifest(filename=filename, content=content)
+        for extracted in shell.extract_manifests():
+            try:
+                docs = [
+                    d for d in load_documents(extracted) if d.root is not None
+                ]
+            except Exception as exc:
+                raise ManifestProcessingError(
+                    f"{exc}; unable to decode object in manifest file "
+                    f"{filename}"
+                ) from exc
+            if not docs:
+                continue
+            obj = to_python(docs[0].root)
+            if not isinstance(obj, dict) or not obj.get("kind"):
+                raise ManifestProcessingError(
+                    "manifest object missing 'kind' in manifest file "
+                    f"{filename}"
+                )
+            child = manifests_mod.ChildResource.from_object(obj)
+            with spans.span("child-codegen"):
+                child.source_code = gocodegen.generate_for_document(
+                    docs[0], "resourceObj"
+                )
+            child.static_content = extracted
+            children.append(child)
+        return children
+
+    return perfcache.memoized("manifest-children", (content,), compute)
 
 
 class WorkloadKind(enum.Enum):
@@ -158,84 +239,79 @@ class WorkloadSpec:
         self.api_spec_fields.children.append(collection_field)
 
     def process_manifests(self, *marker_types: MarkerType) -> None:
-        """Reference workload.go:218-291."""
+        """Reference workload.go:218-291.
+
+        The per-manifest work (marker transform + child codegen) is pure
+        and independent across manifests, so it runs through
+        :func:`operator_forge.perf.parallel_map`; results are absorbed
+        into spec state serially in manifest order, which keeps output
+        (and every error) identical to the ``OPERATOR_FORGE_JOBS=1`` run.
+        """
         self.init_spec()
+
+        def prepare(manifest: manifests_mod.Manifest):
+            # errors are carried, not raised: they must surface in
+            # manifest order relative to the serial absorb loop below
+            # (e.g. a duplicate-name error in an early manifest beats a
+            # decode error in a later one).  Ordering is per-manifest:
+            # within one multi-document manifest, all documents decode
+            # before the duplicate check runs, so a decode error in a
+            # later document wins over a duplicate in an earlier one
+            # (the serial reference interleaved those two per document)
+            try:
+                content, markers = self._transformed(manifest, marker_types)
+                return content, markers, _build_children(
+                    content, manifest.filename
+                )
+            except Exception as exc:  # re-raised at this manifest's turn
+                return exc
+
+        prepared = parallel_map(prepare, self.manifests)
+
         unique_names: set[str] = set()
-
-        for manifest in self.manifests:
-            self.process_markers(manifest, *marker_types)
-
-            child_resources: list[manifests_mod.ChildResource] = []
-            for extracted in manifest.extract_manifests():
-                try:
-                    docs = [
-                        d for d in load_documents(extracted) if d.root is not None
-                    ]
-                except Exception as exc:
-                    raise ManifestProcessingError(
-                        f"{exc}; unable to decode object in manifest file "
-                        f"{manifest.filename}"
-                    ) from exc
-                if not docs:
-                    continue
-                obj = to_python(docs[0].root)
-                if not isinstance(obj, dict) or not obj.get("kind"):
-                    raise ManifestProcessingError(
-                        "manifest object missing 'kind' in manifest file "
-                        f"{manifest.filename}"
-                    )
-
-                child = manifests_mod.ChildResource.from_object(obj)
+        for manifest, outcome in zip(self.manifests, prepared):
+            if isinstance(outcome, Exception):
+                raise outcome
+            content, markers, children = outcome
+            manifest.content = content
+            self.process_marker_results(markers)
+            for child in children:
                 if child.unique_name in unique_names:
                     raise ManifestProcessingError(
                         "child resource unique name error; error generating "
-                        f"resource definition for resource kind [{obj.get('kind')}] "
-                        f"with name [{(obj.get('metadata') or {}).get('name')}] "
+                        f"resource definition for resource kind [{child.kind}] "
+                        f"with name [{child.name}] "
                         f"[{manifest.filename}]"
                     )
                 unique_names.add(child.unique_name)
-
-                child.source_code = gocodegen.generate_for_document(
-                    docs[0], "resourceObj"
-                )
-                child.static_content = extracted
-                child_resources.append(child)
-
-            manifest.child_resources = child_resources
+            manifest.child_resources = children
 
         manifests_mod.deduplicate_file_names(self.manifests)
 
-    def process_markers(
-        self, manifest: manifests_mod.Manifest, *marker_types: MarkerType
-    ) -> None:
-        """Reference workload.go:293-329."""
+    def _transformed(
+        self, manifest: manifests_mod.Manifest, marker_types: tuple
+    ) -> tuple:
         try:
-            inspected = inspect_for_yaml(manifest.content, *marker_types)
+            return _transform_manifest(manifest.content, marker_types)
+        except ManifestProcessingError:
+            raise
         except Exception as exc:
             raise ManifestProcessingError(
                 f"{exc}; error processing manifest file {manifest.filename}"
             ) from exc
 
-        content = emit_documents(inspected.documents)
-
-        self.process_marker_results(inspected.results)
-
-        # when processing a collection's own manifests, any surviving
-        # collection-variable references are references to self
-        # (reference workload.go:317-326)
-        if (
-            MarkerType.FIELD in marker_types
-            and MarkerType.COLLECTION in marker_types
-        ):
-            content = content.replace("!!var collection", "!!var parent")
-            content = content.replace("!!start collection", "!!start parent")
-
+    def process_markers(
+        self, manifest: manifests_mod.Manifest, *marker_types: MarkerType
+    ) -> None:
+        """Reference workload.go:293-329."""
+        content, markers = self._transformed(manifest, marker_types)
+        self.process_marker_results(markers)
         manifest.content = content
 
-    def process_marker_results(self, results) -> None:
-        """Reference workload.go:331-381."""
-        for result in results:
-            marker = result.obj
+    def process_marker_results(self, markers) -> None:
+        """Absorb transformed field/collection markers into spec state
+        (reference workload.go:331-381)."""
+        for marker in markers:
             if isinstance(marker, CollectionFieldMarker):
                 self.collection_field_markers.append(marker)
             elif isinstance(marker, FieldMarker):

@@ -9,6 +9,7 @@ import os
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
+from ..perf import cache as perfcache
 from ..utils import to_file_name, to_title
 from ..utils.globber import glob_manifest_files
 from ..yamldoc.model import to_python
@@ -98,17 +99,29 @@ class ChildResource:
         """Inspect this resource's static content for a resource marker and
         compile its include/exclude guard
         (reference child_resource.go:69-106)."""
-        inspected = inspect_for_yaml(self.static_content, MarkerType.RESOURCE)
-        results = [
-            r for r in inspected.results if isinstance(r.obj, ResourceMarker)
-        ]
-        if not results:
+        marker = _scan_resource_marker(self.static_content)
+        if marker is None:
             return
-        marker = results[0].obj
         marker.process(collection)
         if marker.include_code:
             self.include_code = marker.include_code
             self.resource_marker = marker
+
+
+def _scan_resource_marker(content: str):
+    """First resource marker in a child's static content, before its
+    collection association (``.process``) binds run-specific state.  The
+    scan is pure in ``content``, so it is memoized content-addressed;
+    hits return a fresh copy safe to mutate."""
+
+    def compute():
+        inspected = inspect_for_yaml(content, MarkerType.RESOURCE)
+        for result in inspected.results:
+            if isinstance(result.obj, ResourceMarker):
+                return result.obj
+        return None
+
+    return perfcache.memoized("resource-marker-scan", (content,), compute)
 
 
 def _is_dynamic_name(name: str) -> bool:

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # Conventional-commit check for the latest commit (reference:
-# test/scripts/commit-check-latest.sh — same contract, fresh implementation).
+# test/scripts/commit-check-latest.sh — same contract, fresh implementation),
+# plus the perf contract of the incremental generation engine (PR 1).
 set -euo pipefail
 
-latest="$(git log -1 --pretty=format:%s)"
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+latest="$(git -C "$repo_root" log -1 --pretty=format:%s)"
 
 pattern='^(build|chore|ci|docs|feat|fix|perf|refactor|revert|style|test)(\([a-z0-9-]+\))?!?: .+'
 
@@ -13,3 +16,40 @@ else
     echo "commit message does not follow conventions: $latest" >&2
     exit 1
 fi
+
+# Perf contract: the benchmark must emit parseable JSON containing the
+# per-stage `stages` breakdown with separate cold/warm medians, and its
+# warm-cache determinism guard (cached output == cache-off recompute,
+# byte for byte) must pass.  5 quick runs keep this under a minute.
+echo "perf contract: OPERATOR_FORGE_BENCH_RUNS=5 ${PYTHON:-python3} bench.py"
+bench_out="$(mktemp)"
+trap 'rm -f "$bench_out"' EXIT
+if ! (cd "$repo_root" && OPERATOR_FORGE_BENCH_RUNS=5 "${PYTHON:-python3}" bench.py > "$bench_out"); then
+    echo "perf contract: bench.py exited nonzero (determinism guard?)" >&2
+    exit 1
+fi
+"${PYTHON:-python3}" - "$bench_out" <<'PYEOF'
+import json
+import sys
+
+with open(sys.argv[1], encoding="utf-8") as fh:
+    lines = [line for line in fh.read().strip().splitlines() if line]
+assert len(lines) == 1, f"bench.py must emit exactly one JSON line, got {len(lines)}"
+data = json.loads(lines[0])
+detail = data["detail"]
+assert data["value"] > 0, "no cold throughput reported"
+assert detail["cold"]["cpu_s_median"] > 0
+assert detail["warm"]["cpu_s_median"] > 0
+assert detail["stages"]["cold"], "missing cold stage breakdown"
+assert detail["stages"]["warm"], "missing warm stage breakdown"
+assert detail["warm_matches_cold"] is True, "warm-cache determinism guard failed"
+print(
+    "perf contract OK: cold=%.0f warm=%.0f loc/s (x%.2f), %d cold stages"
+    % (
+        data["value"],
+        detail["warm"]["loc_per_s"],
+        detail["warm_speedup_cpu"],
+        len(detail["stages"]["cold"]),
+    )
+)
+PYEOF

@@ -27,6 +27,28 @@ except Exception:
     pass
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_perf_state():
+    """Isolate the process-global perf state (content cache, spans)
+    between tests: correctness must never depend on what an earlier test
+    happened to cache, and perf tests configure modes explicitly."""
+    from operator_forge.perf import cache as perfcache
+    from operator_forge.perf import spans
+
+    perfcache.configure(None, None)
+    perfcache.reset()
+    spans.use_env()
+    spans.reset()
+    yield
+    perfcache.configure(None, None)
+    perfcache.reset()
+    spans.use_env()
+    spans.reset()
+
+
 def list_samples(project: str, full_only: bool = False) -> list[str]:
     """Sample CR manifests of a generated project (config/samples minus
     the kustomization); ``full_only`` drops required-only variants if a

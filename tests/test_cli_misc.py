@@ -524,10 +524,22 @@ class TestBench:
         assert "vs_baseline" in data
         detail = data["detail"]
         assert detail["runs"] == 3  # the env knob took effect
-        assert set(detail["per_fixture_wall_s_median"]) == {
-            "standalone", "collection", "kitchen-sink",
-        }
-        assert detail["cpu_s_median"] > 0
+        # separate cold and warm medians (PR 1: incremental engine) ...
+        assert detail["cold"]["cpu_s_median"] > 0
+        assert detail["warm"]["cpu_s_median"] > 0
+        for phase in ("cold", "prime", "warm"):
+            assert set(detail["per_fixture_cpu_s_median"][phase]) == {
+                "standalone", "collection", "kitchen-sink",
+            }
+        # ... a per-stage breakdown for each ...
+        assert detail["stages"]["cold"]
+        assert detail["stages"]["warm"]
+        for stage_table in detail["stages"].values():
+            for entry in stage_table.values():
+                assert entry["calls"] > 0 and entry["s"] >= 0
+        # ... and the warm-cache determinism guard (rc would be 1 on
+        # failure, but assert the reported field too)
+        assert detail["warm_matches_cold"] is True
 
 
 class TestEdit:

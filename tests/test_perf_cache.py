@@ -1,0 +1,232 @@
+"""Cache correctness: cold, warm, disk-persisted, and invalidated
+generations must all emit byte-identical trees (PR 1 acceptance).
+
+The content-addressed cache (operator_forge/perf/cache.py) may only ever
+change HOW output is produced, never WHAT is produced: every test here
+compares full output trees byte-for-byte across cache states.
+"""
+
+import hashlib
+import io
+import contextlib
+import os
+import shutil
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def generate(config: str, out: str, repo: str = "github.com/acme/app") -> None:
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(
+            ["init", "--workload-config", config, "--repo", repo,
+             "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+
+def tree_files(root: str) -> dict:
+    out = {}
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as handle:
+                out[os.path.relpath(path, root)] = hashlib.sha256(
+                    handle.read()
+                ).hexdigest()
+    return out
+
+
+def assert_identical_trees(a: str, b: str) -> None:
+    files_a, files_b = tree_files(a), tree_files(b)
+    assert set(files_a) == set(files_b)
+    different = [p for p in files_a if files_a[p] != files_b[p]]
+    assert different == [], f"trees differ at: {different}"
+
+
+class TestColdWarmByteIdentity:
+    @pytest.mark.parametrize(
+        "fixture", ["standalone", "collection", "kitchen-sink"]
+    )
+    def test_warm_rerun_is_byte_identical(self, fixture, tmp_path):
+        """Cold then warm generation of the same fixture: identical
+        trees, and the warm run actually exercised the plan cache."""
+        perfcache.configure(mode="mem")
+        config = os.path.join(FIXTURES, fixture, "workload.yaml")
+        cold = str(tmp_path / "cold")
+        warm = str(tmp_path / "warm")
+        generate(config, cold)
+        generate(config, warm)
+        assert_identical_trees(cold, warm)
+        plan_stats = perfcache.stats().get("plan", {})
+        assert plan_stats.get("hits", 0) >= 2  # init + create api replayed
+
+    def test_cache_off_matches_cache_mem(self, tmp_path):
+        perfcache.configure(mode="mem")
+        config = os.path.join(FIXTURES, "kitchen-sink", "workload.yaml")
+        cached = str(tmp_path / "cached")
+        generate(config, cached)
+        generate(config, str(tmp_path / "cached2"))  # force warm hits
+
+        perfcache.configure(mode="off")
+        stats_before = perfcache.stats()
+        uncached = str(tmp_path / "uncached")
+        generate(config, uncached)
+        assert_identical_trees(cached, uncached)
+        # off really is off: the uncached pass recorded no cache traffic
+        assert perfcache.stats() == stats_before
+
+
+class TestDiskPersistence:
+    def test_warm_across_processes_via_disk(self, tmp_path):
+        """disk mode survives a cache reset (a stand-in for a fresh
+        process) and still produces byte-identical output."""
+        cache_dir = str(tmp_path / "cache")
+        perfcache.configure(mode="disk", root=cache_dir)
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        first = str(tmp_path / "first")
+        generate(config, first)
+        assert os.path.isdir(cache_dir)  # entries were persisted
+
+        perfcache.reset()  # drop every in-memory entry and counter
+        second = str(tmp_path / "second")
+        generate(config, second)
+        assert_identical_trees(first, second)
+        plan_stats = perfcache.stats().get("plan", {})
+        assert plan_stats.get("hits", 0) >= 2  # served from disk
+
+    def test_tampered_disk_entry_is_a_miss(self, tmp_path):
+        """Disk blobs are HMAC-signed with a key outside the cache dir;
+        a modified (or foreign) entry must never be unpickled."""
+        cache_dir = str(tmp_path / "cache")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=cache_dir)
+        cache.put("stage", "aa" * 32, {"v": 1})
+        cache.reset()  # force the disk path
+        assert cache.get("stage", "aa" * 32) == {"v": 1}
+
+        # flip one byte of the persisted payload
+        [entry] = [
+            os.path.join(dirpath, name)
+            for dirpath, _, names in os.walk(cache_dir)
+            for name in names
+        ]
+        with open(entry, "rb") as handle:
+            blob = bytearray(handle.read())
+        blob[-1] ^= 0xFF
+        with open(entry, "wb") as handle:
+            handle.write(bytes(blob))
+
+        cache.reset()
+        assert cache.get("stage", "aa" * 32) is perfcache.MISS
+
+
+class TestInvalidation:
+    def _copy_fixture(self, name: str, dest) -> str:
+        src = os.path.join(FIXTURES, name)
+        shutil.copytree(src, str(dest))
+        return os.path.join(str(dest), "workload.yaml")
+
+    def test_manifest_edit_invalidates_and_reuses_untouched_stages(
+        self, tmp_path
+    ):
+        """Touch one manifest byte: the warm re-run must regenerate the
+        dependent outputs (matching a from-scratch cold run) while the
+        per-manifest stage cache still serves the untouched manifests."""
+        perfcache.configure(mode="mem")
+        config = self._copy_fixture("collection", tmp_path / "fixture")
+
+        before = str(tmp_path / "before")
+        generate(config, before)
+
+        # one-byte-ish edit to ONE manifest of several
+        ns_manifest = os.path.join(str(tmp_path / "fixture"), "ns.yaml")
+        with open(ns_manifest, encoding="utf-8") as handle:
+            content = handle.read()
+        assert "metadata:" in content
+        with open(ns_manifest, "w", encoding="utf-8") as handle:
+            handle.write(
+                content.replace("metadata:", "metadata:\n  labels:\n    edited: \"yes\"", 1)
+            )
+
+        edited_warm = str(tmp_path / "edited-warm")
+        generate(config, edited_warm)
+
+        # ground truth: a fully cold run over the edited fixture
+        perfcache.configure(mode="off")
+        edited_cold = str(tmp_path / "edited-cold")
+        generate(config, edited_cold)
+        assert_identical_trees(edited_warm, edited_cold)
+
+        # the edit propagated into the output
+        files_before = tree_files(before)
+        files_after = tree_files(edited_warm)
+        assert set(files_before) == set(files_after)
+        assert files_before != files_after
+
+        # untouched manifests were served from the stage cache during
+        # the warm re-run (the plan itself had to miss)
+        stats = perfcache.stats()
+        assert stats["manifest-transform"]["hits"] >= 1
+        assert stats["manifest-children"]["hits"] >= 1
+
+    def test_config_edit_invalidates_plan(self, tmp_path):
+        perfcache.configure(mode="mem")
+        config = self._copy_fixture("standalone", tmp_path / "fixture")
+        generate(config, str(tmp_path / "a"))
+
+        with open(config, encoding="utf-8") as handle:
+            raw = handle.read()
+        with open(config, "w", encoding="utf-8") as handle:
+            handle.write(raw.replace("v1alpha1", "v1beta1"))
+
+        edited = str(tmp_path / "b")
+        generate(config, edited)
+        perfcache.configure(mode="off")
+        reference = str(tmp_path / "c")
+        generate(config, reference)
+        assert_identical_trees(edited, reference)
+        # the new version reached the output (the stale plan was not
+        # replayed)
+        crd_dir = os.path.join(edited, "config", "crd", "bases")
+        crd = open(
+            os.path.join(crd_dir, sorted(os.listdir(crd_dir))[0]),
+            encoding="utf-8",
+        ).read()
+        assert "v1beta1" in crd
+
+
+class TestCacheStore:
+    def test_hit_returns_independent_copies(self):
+        cache = perfcache.ContentCache()
+        cache.configure(mode="mem")
+        value = {"nested": [1, 2, 3]}
+        cache.put("stage", "key", value)
+        value["nested"].append(4)  # caller mutation after put
+        first = cache.get("stage", "key")
+        assert first == {"nested": [1, 2, 3]}
+        first["nested"].append(99)  # caller mutation after get
+        assert cache.get("stage", "key") == {"nested": [1, 2, 3]}
+
+    def test_hash_parts_distinguishes_types_and_shapes(self):
+        assert perfcache.hash_parts("1") != perfcache.hash_parts(1)
+        assert perfcache.hash_parts(True) != perfcache.hash_parts(1)
+        assert perfcache.hash_parts("ab", "c") != perfcache.hash_parts(
+            "a", "bc"
+        )
+        assert perfcache.hash_parts(("a", "b")) == perfcache.hash_parts(
+            ["a", "b"]
+        )
+
+    def test_off_mode_never_stores(self):
+        cache = perfcache.ContentCache()
+        cache.configure(mode="off")
+        cache.put("stage", "key", "value")
+        assert cache.get("stage", "key") is perfcache.MISS

@@ -1,0 +1,106 @@
+"""Parallel-execution determinism and span-profiler coverage (PR 1).
+
+``OPERATOR_FORGE_JOBS=1`` and ``OPERATOR_FORGE_JOBS=8`` must produce
+byte-for-byte identical output trees; the span profiler must attribute
+time to the pipeline stages bench.py reports.
+"""
+
+import io
+import contextlib
+import os
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import n_jobs, parallel_map, spans
+from operator_forge.perf import cache as perfcache
+
+from test_perf_cache import FIXTURES, assert_identical_trees, generate
+
+
+class TestParallelDeterminism:
+    def test_jobs_1_vs_8_byte_identical_kitchen_sink(
+        self, tmp_path, monkeypatch
+    ):
+        perfcache.configure(mode="off")  # isolate parallelism from caching
+        config = os.path.join(FIXTURES, "kitchen-sink", "workload.yaml")
+
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        serial = str(tmp_path / "serial")
+        generate(config, serial)
+
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "8")
+        parallel = str(tmp_path / "parallel")
+        generate(config, parallel)
+
+        assert_identical_trees(serial, parallel)
+
+    def test_jobs_env_is_read_dynamically(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "7")
+        assert n_jobs() == 7
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "not-a-number")
+        assert n_jobs() == 1
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "0")
+        assert n_jobs() == 1
+        monkeypatch.delenv("OPERATOR_FORGE_JOBS")
+        assert n_jobs() == (os.cpu_count() or 1)
+
+    def test_parallel_map_preserves_order_and_first_error(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "4")
+        assert parallel_map(lambda x: x * 2, range(100)) == [
+            x * 2 for x in range(100)
+        ]
+
+        def boom(x):
+            if x >= 3:
+                raise ValueError(f"item {x}")
+            return x
+
+        with pytest.raises(ValueError, match="item 3"):
+            parallel_map(boom, range(100))
+
+
+class TestSpans:
+    def test_stages_are_attributed(self, tmp_path):
+        spans.enable(True)
+        spans.reset()
+        perfcache.configure(mode="mem")
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        generate(config, str(tmp_path / "proj"))
+        snap = spans.snapshot()
+        for stage in (
+            "config-parse",
+            "marker-inspect",
+            "render",
+            "write",
+            "plan-cache",
+            "command:init",
+            "command:create",
+        ):
+            assert stage in snap, f"missing stage {stage}: {sorted(snap)}"
+            assert snap[stage]["calls"] > 0
+            assert snap[stage]["s"] >= 0
+
+    def test_disabled_spans_record_nothing(self):
+        spans.enable(False)
+        spans.reset()
+        with spans.span("never"):
+            pass
+        assert spans.snapshot() == {}
+
+    def test_env_var_prints_report_to_stderr(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_PROFILE", "1")
+        spans.use_env()
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        out = str(tmp_path / "proj")
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert cli_main(
+                ["init", "--workload-config", config,
+                 "--repo", "github.com/acme/app", "--output-dir", out]
+            ) == 0
+        err = capsys.readouterr().err
+        assert "stage" in err and "command:init" in err
