@@ -63,6 +63,9 @@ WARMUP_RUNS = 2
 # override for quick contract checks (tests); the default is sized for a
 # stable median on a noisy host
 MEASURED_RUNS = int(os.environ.get("OPERATOR_FORGE_BENCH_RUNS", "31"))
+# the check section runs the whole kitchen-sink suite per sample (and
+# the identity guards re-run it 9 more times), so it uses its own count
+CHECK_RUNS = int(os.environ.get("OPERATOR_FORGE_BENCH_CHECK_RUNS", "5"))
 
 
 def generate(fixture: str, repo: str, out_dir: str) -> None:
@@ -136,6 +139,107 @@ def _phase_summary(cpu_runs, wall_runs, loc) -> dict:
         "loc_per_wall_s": round(
             loc / median_wall if median_wall > 0 else 0.0, 1
         ),
+    }
+
+
+def _result_signature(results) -> list:
+    """Comparable essence of a run_project_tests report (timings are
+    measurement noise, everything else must be identical)."""
+    return [
+        (r.rel, r.code, r.ran, r.failures, r.skipped, r.error)
+        for r in results
+    ]
+
+
+def check_section(tree: str) -> dict:
+    """The gocheck fast-path benchmark: ``run_project_tests`` over the
+    kitchen-sink steady tree, cold (caches empty: tokenize + scan +
+    closure-compile + execute) vs warm (content-validated replay of the
+    unchanged tree), plus the identity guards — compile-vs-walk and
+    serial-vs-parallel must report identically with the cache in every
+    mode (off, mem, disk)."""
+    from operator_forge.gocheck import compiler
+    from operator_forge.gocheck.world import run_project_tests
+
+    cold_cpu, warm_cpu = [], []
+    spans.reset()
+    try:
+        # pin the mode the headline documents: ambient
+        # OPERATOR_FORGE_GOCHECK must not silently change what the
+        # medians (and commit-check's 3x bar) measure
+        compiler.set_mode("compile")
+        for _ in range(CHECK_RUNS):
+            pf_cache.reset()
+            start = time.process_time()
+            cold_results = run_project_tests(tree, include_e2e=True)
+            cold_cpu.append(time.process_time() - start)
+        cold_stages = {
+            name: data for name, data in spans.snapshot().items()
+            if name.startswith("gocheck.")
+        }
+        for _ in range(CHECK_RUNS):
+            start = time.process_time()
+            warm_results = run_project_tests(tree, include_e2e=True)
+            warm_cpu.append(time.process_time() - start)
+    finally:
+        compiler.set_mode(None)
+    identical = _result_signature(cold_results) == _result_signature(
+        warm_results
+    )
+
+    # identity guards: LIVE execution must report identically across
+    # interpreter modes and job counts, with the cache machinery active
+    # in every mode — each leg gets cleared in-process state and (for
+    # disk) its own throwaway root, so no leg can replay another leg's
+    # report instead of executing
+    guards = {}
+    disk_root = tempfile.mkdtemp(prefix="operator-forge-checkcache-")
+    saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+    try:
+        for cache_mode in ("off", "mem", "disk"):
+            signatures = []
+            for leg, (gocheck_mode, jobs) in enumerate((
+                ("walk", "1"), ("compile", "1"), ("compile", "8"),
+            )):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(disk_root, f"leg{leg}")
+                    if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                compiler.set_mode(gocheck_mode)
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                signatures.append(_result_signature(
+                    run_project_tests(tree, include_e2e=True)
+                ))
+            guards[cache_mode] = all(
+                sig == signatures[0] for sig in signatures[1:]
+            )
+    finally:
+        compiler.set_mode(None)
+        if saved_jobs is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+        pf_cache.configure(mode="mem")
+        shutil.rmtree(disk_root, ignore_errors=True)
+
+    cold_med = statistics.median(cold_cpu)
+    warm_med = statistics.median(warm_cpu)
+    return {
+        "fixture": "kitchen-sink",
+        "runs": CHECK_RUNS,
+        "cold_cpu_s_median": round(cold_med, 4),
+        "warm_cpu_s_median": round(warm_med, 4),
+        "warm_speedup": round(
+            cold_med / warm_med if warm_med > 0 else 0.0, 2
+        ),
+        "warm_matches_cold": identical,
+        "identity_by_cache_mode": guards,
+        "stages_cold": cold_stages,
+        "headline": "cold = empty caches (tokenize + scan + "
+        "closure-compile + execute, OPERATOR_FORGE_GOCHECK=compile); "
+        "warm = content-validated replay of the unchanged tree",
     }
 
 
@@ -241,6 +345,10 @@ def main() -> None:
             if tree_digest(reference) != tree_digest(steady[fixture]):
                 warm_matches_cold = False
 
+        # the gocheck fast path: conformance checking over the emitted
+        # kitchen-sink tree, cold vs warm, plus identity guards
+        check = check_section(steady["kitchen-sink"])
+
         loc = sum(fixture_loc.values())
         summary = {
             phase: _phase_summary(cpu[phase], wall[phase], loc)
@@ -290,6 +398,7 @@ def main() -> None:
                 "generated_loc_per_run": loc,
                 "cache_mode": "mem",
                 "jobs": n_jobs(),
+                "check": check,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
                 "up to ~15% (host scheduling/steal), and the host itself "
@@ -305,6 +414,16 @@ def main() -> None:
             print(
                 "warm-cache determinism guard FAILED: cached regeneration "
                 "diverged from the cache-off recompute",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not check["warm_matches_cold"] or not all(
+            check["identity_by_cache_mode"].values()
+        ):
+            print(
+                "gocheck identity guard FAILED: compile/walk, "
+                "serial/parallel, or cached/uncached check reports "
+                "diverged",
                 file=sys.stderr,
             )
             sys.exit(1)

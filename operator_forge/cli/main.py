@@ -698,7 +698,15 @@ def cmd_test(args: argparse.Namespace) -> int:
     interpreter against a fake cluster, with no Go toolchain and no
     real cluster.  The reference gets this guarantee from CI running
     `go test` / kind (.github/workflows/test.yaml:55-141); here it is
-    a local command."""
+    a local command.
+
+    Packages fan out across OPERATOR_FORGE_JOBS threads (each package
+    gets an isolated world; the report is collected in input order, so
+    it is identical to a serial run), function bodies execute through
+    the closure-compiled interpreter (OPERATOR_FORGE_GOCHECK=compile),
+    and a re-run over a byte-identical tree replays the cached report
+    (OPERATOR_FORGE_CACHE).  `-v` streams per-test lines and therefore
+    runs packages serially."""
     from operator_forge.gocheck.world import run_project_tests
 
     root = args.path

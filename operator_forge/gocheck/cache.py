@@ -1,0 +1,269 @@
+"""Content-addressed caches for the gocheck fast path.
+
+The checking path re-did its expensive pure work on every call: every
+``check_project`` re-tokenized and re-parsed each emitted file, every
+:class:`~operator_forge.gocheck.world.EnvtestWorld` re-scanned the whole
+project tree (once per test package), and every call rebuilt the
+project's symbol index from scratch.  All of that work is a pure
+function of file bytes, so this module keys it on content hashes
+through :mod:`operator_forge.perf.cache` — the same content-addressed
+store the generation pipeline uses — under new ``gocheck.*``
+namespaces:
+
+- ``gocheck.parse`` — :func:`parse_cached` memoizes
+  ``parser.parse_source`` results per source hash;
+- ``gocheck.scan``  — :func:`scan_source` memoizes the interpreter's
+  per-file :class:`~operator_forge.gocheck.localindex._FileScan`;
+- ``gocheck.index`` — :func:`project_index` memoizes the cross-package
+  :class:`~operator_forge.gocheck.localindex.ProjectIndex`, keyed on
+  the project's file-hash set;
+- ``gocheck.check`` — :func:`check_get` / :func:`check_put` replay a
+  whole ``run_project_tests`` report for a byte-identical tree (the
+  interpreter is deterministic: virtual clock, no real env reads), the
+  checking-path analog of the generation pipeline's plan replay.
+
+Modes follow ``OPERATOR_FORGE_CACHE`` (off|mem|disk) exactly like the
+generation caches; disk entries go through the same HMAC-signed pickle
+format.  On top of the pickling store sits an in-process *identity*
+layer: scans, parsers, and indexes are immutable after construction
+(the one mutable field, a scan's ``interp`` backref, is reset on every
+shallow copy handed out), so within one process a hit is a dict lookup
+plus at most a ``copy.copy`` — no deserialization.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import os
+import threading
+
+from .. import __version__
+from ..perf import cache as pf_cache
+from ..perf import spans
+
+# bump to invalidate previously persisted gocheck entries when the
+# cached record shapes (not the checker's behavior) change
+_SCHEMA = 1
+
+_lock = threading.Lock()
+_scan_mem: dict = {}    # (sha, path) -> pristine _FileScan
+_parse_mem: dict = {}   # (sha, filename) -> _Parser (read-only, shared)
+_index_mem: dict = {}   # key -> ProjectIndex (read-only, shared)
+
+
+def _reset_identity() -> None:
+    with _lock:
+        _scan_mem.clear()
+        _parse_mem.clear()
+        _index_mem.clear()
+    from . import compiler
+
+    compiler.reset()
+
+
+pf_cache.get_cache().reset_hooks.append(_reset_identity)
+
+
+def source_sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _mode() -> str:
+    return pf_cache.get_cache().mode()
+
+
+def replay_enabled() -> bool:
+    """Whether whole-report replay can possibly hit — callers guard the
+    (tree-hashing) key computation on this so ``off`` mode pays zero
+    cache overhead."""
+    return _mode() != "off"
+
+
+def _key(stage: str, *parts) -> str:
+    return pf_cache.hash_parts(_SCHEMA, __version__, stage, *parts)
+
+
+def _memoized_build(stage: str, mem: dict, ident, key: str,
+                    span_name: str, build):
+    """One identity-layer + pickling-store memoization pass, shared by
+    the scan/parse/index caches: off-mode builds fresh every time; mem
+    shares the in-process instance; disk additionally persists through
+    the signed ContentCache.  Returns the pristine shared object (all
+    three cached shapes are immutable after construction)."""
+    mode = _mode()
+    if mode == "off":
+        with spans.span(span_name):
+            return build()
+    with _lock:
+        value = mem.get(ident)
+    cache = pf_cache.get_cache()
+    if value is None and mode == "disk":
+        hit = cache.get(stage, key, record_stats=False)
+        if hit is not pf_cache.MISS:
+            with _lock:
+                value = mem.setdefault(ident, hit)
+    if value is None:
+        cache._count(stage, "misses")
+        with spans.span(span_name):
+            value = build()
+        with _lock:
+            value = mem.setdefault(ident, value)
+        if mode == "disk":
+            cache.put(stage, key, value)
+    else:
+        cache._count(stage, "hits")
+    return value
+
+
+# -- per-file scans (the interpreter/index's parse) ----------------------
+
+
+def scan_source(path: str, text: str):
+    """A :class:`_FileScan` for *text*, content-cached.
+
+    Every caller gets its own shallow copy (token and declaration
+    lists shared — they are immutable after construction) with the
+    ``interp`` backref unset, so linked interpreters of different
+    worlds can never dispatch into each other through a shared scan.
+    The returned scan carries ``sha``, which also keys the closure
+    compiler's cross-world compiled-body registry.
+    """
+    from .localindex import _FileScan
+
+    sha = source_sha(text)
+
+    def build():
+        scan = _FileScan(path, text)
+        scan.sha = sha
+        # never hand out (or pickle) a scan carrying an interp backref
+        scan.interp = None
+        return scan
+
+    pristine = _memoized_build(
+        "gocheck.scan", _scan_mem, (sha, path),
+        _key("scan", sha, path), "gocheck.parse", build,
+    )
+    out = copy.copy(pristine)
+    out.interp = None
+    return out
+
+
+# -- parse_source results (the syntax gate's parse) ----------------------
+
+
+def parse_cached(text: str, filename: str, build):
+    """Memoize a successful ``parse_source`` run per content hash.
+
+    Parsers are consumed read-only (lint/typecheck iterate recorded
+    events), so in-process hits share one instance.  Parse *failures*
+    raise and are never cached — an error re-parses every time, which
+    keeps this a pure fast path.
+    """
+    sha = source_sha(text)
+    return _memoized_build(
+        "gocheck.parse", _parse_mem, (sha, filename),
+        _key("parse", sha, filename), "gocheck.parse", build,
+    )
+
+
+# -- the project file-hash set -------------------------------------------
+
+
+def tree_state(root: str) -> tuple:
+    """Sorted ``(relpath, sha)`` for every regular file under *root*,
+    skipping dot-directories (``.git``, ``.operator-forge-cache``) and
+    dot-files.  This is the dependency snapshot of the whole checking
+    path: the interpreter reads Go sources, CRD YAML, and go.mod, all
+    of which live under the project tree."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        for name in sorted(filenames):
+            if name.startswith("."):
+                continue
+            path = os.path.join(dirpath, name)
+            if not os.path.isfile(path):
+                continue
+            sha = pf_cache.file_sha(path)
+            out.append((os.path.relpath(path, root).replace(os.sep, "/"),
+                        sha))
+    return tuple(out)
+
+
+def go_file_state(root: str) -> tuple:
+    """Sorted ``(relpath, sha)`` of the files a :class:`ProjectIndex`
+    reads: every ``.go`` file under the go-tooling pruning rules, plus
+    ``go.mod`` (the module path)."""
+    from .structural import prune_go_dirs
+
+    out = []
+    gomod = os.path.join(root, "go.mod")
+    if os.path.isfile(gomod):
+        out.append(("go.mod", pf_cache.file_sha(gomod)))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = prune_go_dirs(dirnames)
+        for name in sorted(filenames):
+            if not name.endswith(".go") or name.startswith(("_", ".")):
+                continue
+            path = os.path.join(dirpath, name)
+            out.append((os.path.relpath(path, root).replace(os.sep, "/"),
+                        pf_cache.file_sha(path)))
+    return tuple(sorted(out))
+
+
+# -- the cross-package project index -------------------------------------
+
+
+def project_index(root: str):
+    """A :class:`ProjectIndex` for *root*, keyed on its file-hash set
+    instead of rebuilt per ``check_project`` call.  Indexes are
+    consumed read-only, so in-process hits share one instance."""
+    from .localindex import ProjectIndex
+
+    if _mode() == "off":
+        with spans.span("gocheck.index"):
+            return ProjectIndex(root)
+    # the root — as spelled AND resolved — is part of the key: indexed
+    # scans embed caller-spelled paths (error locations), so identical
+    # trees at different roots, or the same root spelled differently
+    # ('./proj' vs 'proj'), must not share an index
+    key = _key("index", root, os.path.abspath(root), go_file_state(root))
+    return _memoized_build(
+        "gocheck.index", _index_mem, key, key, "gocheck.index",
+        lambda: ProjectIndex(root),
+    )
+
+
+# -- whole-suite check results -------------------------------------------
+
+
+def check_key(root: str, files=None, **flags) -> str:
+    """Cache key for one checking-path invocation: the tree's location
+    and file-hash set plus every behavior-affecting flag (including
+    the interpreter mode, so compile-vs-walk identity tests exercise
+    both paths instead of replaying one into the other).  The root —
+    as spelled and as resolved — is part of the key because report
+    messages embed caller-spelled paths.  ``files`` narrows the
+    dependency snapshot when the caller reads a known subset (vet
+    reads only the Go surface); the default is the whole tree (the
+    test driver reads CRDs, go.mod, samples...)."""
+    if files is None:
+        files = tree_state(root)
+    return _key("check", root, os.path.abspath(root), files,
+                sorted(flags.items()))
+
+
+def check_get(key: str):
+    """Cached SuiteResult list for *key*, or None.  Hits deserialize a
+    fresh copy, so callers may mutate the returned results."""
+    if _mode() == "off":
+        return None
+    hit = pf_cache.get_cache().get("gocheck.check", key)
+    return None if hit is pf_cache.MISS else hit
+
+
+def check_put(key: str, results) -> None:
+    if _mode() == "off":
+        return
+    pf_cache.get_cache().put("gocheck.check", key, results)

@@ -1,0 +1,1527 @@
+"""Closure compilation for the gocheck interpreter.
+
+The walk-mode interpreter (:class:`~operator_forge.gocheck.interp._Eval`)
+re-derives all structure from the token stream on every execution:
+statement boundaries, control-clause splits, comma spans, group spans,
+literal decoding.  A reconcile loop that runs a function body fifty
+times re-scans its tokens fifty times.
+
+This module lowers each function body to nested Python closures ONCE —
+the classic compile-once/trace-cache shape: all structural decisions
+(where statements end, how clauses split, which operand form a token
+starts, what a literal's value is) are made at compile time, and the
+residual closures perform only the dynamic work (name lookup, calls,
+field access) when executed.  Compiled bodies are cached per source
+content hash, so every linked interpreter of every
+:class:`~operator_forge.gocheck.world.EnvtestWorld` over the same
+emitted tree shares one compilation.
+
+Behavior identity is the hard contract (tests assert walk and compile
+produce byte-identical suite reports):
+
+- closures mirror the walk evaluator's code paths branch for branch,
+  including its documented junk-tolerance (trailing tokens after a
+  parsed expression are ignored) and evaluation order;
+- nothing is resolved early: names, methods, and types bind at
+  execution time through the running interpreter, exactly like walk;
+- any construct this compiler does not recognize degrades to a closure
+  that walk-executes the enclosing block's token span, so unsupported
+  shapes raise the same errors at the same execution points walk
+  would, and never at compile time.
+
+Mode selection: ``OPERATOR_FORGE_GOCHECK=walk|compile`` (default
+``compile``), overridable programmatically via :func:`set_mode` for
+tests and the bench identity guards.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..perf import spans
+from . import interp as I
+from .tokens import FLOAT, IDENT, IMAG, INT, KEYWORD, OP, RUNE, STRING
+
+_MODES = ("walk", "compile")
+DEFAULT_MODE = "compile"
+
+_forced = None
+
+
+def mode() -> str:
+    if _forced is not None:
+        return _forced
+    raw = os.environ.get("OPERATOR_FORGE_GOCHECK", DEFAULT_MODE)
+    raw = raw.strip().lower()
+    return raw if raw in _MODES else DEFAULT_MODE
+
+
+def set_mode(value=None) -> None:
+    """Programmatic override (``None`` restores env-driven selection)."""
+    global _forced
+    if value is not None and value not in _MODES:
+        raise ValueError(f"unknown gocheck mode {value!r}; known: {_MODES}")
+    _forced = value
+
+
+# -- compiled-body registry ----------------------------------------------
+#
+# Keyed on (source sha, body span): token streams are a pure function of
+# source bytes, so compiled closures transfer across the scan copies
+# different worlds hold.  Closures capture only tokens and other
+# compiled closures — every interpreter-bound object (registries,
+# natives, scans) is reached through the runtime _Eval — so sharing a
+# runner between worlds is safe.
+
+_registry: dict = {}
+_registry_lock = threading.Lock()
+
+
+def reset() -> None:
+    with _registry_lock:
+        _registry.clear()
+
+
+def compiled_block(scan, lo: int, hi: int):
+    """The compiled runner for ``scan.toks[lo:hi]``, or None when the
+    body cannot be compiled at all (pathological nesting)."""
+    sha = getattr(scan, "sha", None)
+    if sha is not None:
+        key = (sha, lo, hi)
+        runner = _registry.get(key)
+        if runner is not None:
+            return runner
+    else:
+        local = scan.__dict__.setdefault("_compiled_bodies", {})
+        runner = local.get((lo, hi))
+        if runner is not None:
+            return runner
+    try:
+        with spans.span("gocheck.compile"):
+            runner = _Compiler(scan).block(lo, hi)
+    except RecursionError:
+        return None
+    if sha is not None:
+        with _registry_lock:
+            _registry[key] = runner
+    else:
+        local[(lo, hi)] = runner
+    return runner
+
+
+class _CompileError(Exception):
+    """Internal: this shape is outside the compiled subset — the
+    enclosing block degrades to a walk-executing closure."""
+
+
+class _StopExpr(Exception):
+    """Mirrors walk's postfix break on a composite brace over a
+    non-type value: pending binops up the spine apply (see the binop
+    closures), everything textually after is ignored, and the root
+    returns the carried value."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+# statically shareable empty-env factory aliases (hot path)
+_Env = I.Env
+_truthy = I._truthy
+_apply_binop = I._apply_binop
+_go_eq = I._go_eq
+_get_attr = I._get_attr
+_go_index = I._go_index
+_type_assert = I._type_assert
+_GoStruct = I.GoStruct
+_Closure = I.Closure
+_VarRef = I.VarRef
+_Return = I._Return
+_Break = I._Break
+_Continue = I._Continue
+_AssertResult = I._AssertResult
+_expand = I._expand
+
+
+def _const_or_defer(convert, raw):
+    """Decode a literal at compile time; a malformed literal defers the
+    conversion (and its error) to execution time, exactly where walk
+    raises it — dead code with a bad literal must stay inert."""
+    try:
+        const = convert(raw)
+    except Exception:
+        def run_deferred(ev, env):
+            return convert(raw)
+        return run_deferred
+
+    def run_const(ev, env):
+        return const
+    return run_const
+
+
+def _bounded_group_end(toks, i: int, hi: int) -> int:
+    """One past the closer of the group opening at ``i``, never past
+    ``hi`` — the walk evaluator works on slices, so an unbalanced group
+    ends at the slice boundary; absolute spans must behave the same."""
+    pairs = {"(": ")", "[": "]", "{": "}"}
+    open_ch = toks[i].value
+    close_ch = pairs[open_ch]
+    depth = 0
+    while i < hi:
+        t = toks[i]
+        if t.kind == OP:
+            if t.value == open_ch:
+                depth += 1
+            elif t.value == close_ch:
+                depth -= 1
+                if depth == 0:
+                    return i + 1
+        i += 1
+    return hi
+
+
+class _Compiler:
+    """Compiles token spans of one scan into closure trees.
+
+    Statement spans are absolute indices into ``scan.toks`` (walk's
+    statement layer works the same way); expression compilation is also
+    absolute but bounds every scan by the expression's span end,
+    mirroring the slice boundary the walk evaluator sees.
+    """
+
+    def __init__(self, scan):
+        self.scan = scan
+        self.toks = scan.toks
+        # walk reports the nil-callee context relative to the current
+        # _eval_range slice; track each expression root for parity
+        self._root_lo = 0
+
+    # == blocks and statements ===========================================
+
+    def block(self, lo: int, hi: int):
+        """Runner for the statements in toks[lo:hi].  Any statement this
+        compiler cannot lower degrades the WHOLE block to a walk
+        closure — errors then surface at the same execution points."""
+        toks = self.toks
+        try:
+            steps = self._stmts(lo, hi)
+        except _CompileError:
+            def run_walk(ev, env):
+                ev.exec_block(toks, lo, hi, env)
+            return run_walk
+        if len(steps) == 1:
+            return steps[0]
+
+        def run(ev, env):
+            for step in steps:
+                step(ev, env)
+        return run
+
+    def _stmts(self, lo: int, hi: int) -> list:
+        toks = self.toks
+        steps = []
+        i = lo
+        while i < hi:
+            t = toks[i]
+            if t.kind == OP and t.value == ";":
+                i += 1
+                continue
+            step, i = self._stmt(i, hi)
+            steps.append(step)
+        return steps
+
+    def _stmt(self, i: int, hi: int):
+        toks = self.toks
+        t = toks[i]
+        if t.kind == KEYWORD:
+            v = t.value
+            if v == "return":
+                return self._stmt_return(i, hi)
+            if v == "if":
+                return self._stmt_if(i, hi)
+            if v == "for":
+                return self._stmt_for(i, hi)
+            if v == "switch":
+                return self._stmt_switch(i, hi)
+            if v == "continue":
+                def s_continue(ev, env):
+                    raise _Continue()
+                return s_continue, i + 1
+            if v == "break":
+                def s_break(ev, env):
+                    raise _Break()
+                return s_break, i + 1
+            if v == "var":
+                return self._stmt_var(i, hi)
+            if v in ("defer", "go"):
+                return self._stmt_defer_go(i, hi, is_go=(v == "go"))
+            raise _CompileError(v)
+        if t.kind == OP and t.value == "{":
+            lo2, hi2 = I._group_span(toks, i)
+            inner = self.block(lo2, hi2)
+
+            def s_block(ev, env):
+                inner(ev, _Env(env))
+            return s_block, hi2 + 1
+        return self._simple_stmt(i, hi)
+
+    # -- return / defer / go ---------------------------------------------
+
+    def _stmt_end(self, i: int, hi: int) -> int:
+        toks = self.toks
+        depth = 0
+        while i < hi:
+            t = toks[i]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    if depth == 0:
+                        return i
+                    depth -= 1
+                elif t.value == ";" and depth == 0:
+                    return i
+            i += 1
+        return hi
+
+    def _stmt_return(self, i: int, hi: int):
+        end = self._stmt_end(i + 1, hi)
+        if end == i + 1:
+            def s_return_none(ev, env):
+                raise _Return(None)
+            return s_return_none, end
+        fns = [
+            self.expr(slo, shi)
+            for slo, shi in I._split_commas(self.toks, i + 1, end)
+        ]
+        if len(fns) == 1:
+            fn0 = fns[0]
+
+            def s_return_one(ev, env):
+                raise _Return(fn0(ev, env))
+            return s_return_one, end
+
+        def s_return(ev, env):
+            raise _Return(tuple(fn(ev, env) for fn in fns))
+        return s_return, end
+
+    def _stmt_defer_go(self, i: int, hi: int, is_go: bool):
+        toks = self.toks
+        end = self._stmt_end(i + 1, hi)
+        close = end - 1
+        if not (toks[close].kind == OP and toks[close].value == ")"):
+            raise _CompileError("defer/go")
+        depth = 0
+        j = close
+        while j > i:
+            t = toks[j]
+            if t.kind == OP and t.value in ")]}":
+                depth += 1
+            elif t.kind == OP and t.value in "([{":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        callee_fn = self.expr(i + 1, j)
+        args_fn = self._call_args(j + 1, close)
+        if is_go:
+            def s_go(ev, env):
+                callee = callee_fn(ev, env)
+                args = args_fn(ev, env)
+                ev.interp.sched.spawn(ev.interp, callee, args)
+            return s_go, end
+
+        def s_defer(ev, env):
+            callee = callee_fn(ev, env)
+            args = args_fn(ev, env)
+            ev.defers.append((callee, args))
+        return s_defer, end
+
+    # -- control clauses --------------------------------------------------
+
+    def _clause_parts(self, i: int):
+        """Mirror of walk's _clause_parts; an overrun (malformed
+        clause) becomes a compile failure — the walk fallback then
+        raises the identical IndexError at execution time."""
+        toks = self.toks
+        segments = []
+        depth = 0
+        start = i
+        j = i
+        try:
+            while True:
+                t = toks[j]
+                if t.kind == OP:
+                    if t.value in "([":
+                        depth += 1
+                    elif t.value in ")]":
+                        depth -= 1
+                    elif t.value == "{" and depth == 0:
+                        segments.append((start, j))
+                        return segments, j
+                    elif t.value == "{":
+                        depth += 1
+                    elif t.value == "}":
+                        depth -= 1
+                    elif t.value == ";" and depth == 0:
+                        segments.append((start, j))
+                        start = j + 1
+                j += 1
+        except IndexError:
+            raise _CompileError("unterminated clause") from None
+
+    def _stmt_if(self, i: int, hi: int):
+        toks = self.toks
+        segments, brace = self._clause_parts(i + 1)
+        init_step = None
+        if len(segments) == 2:
+            init_step, _end = self._simple_stmt(segments[0][0], segments[0][1])
+            cond_lo, cond_hi = segments[1]
+        elif len(segments) == 1:
+            cond_lo, cond_hi = segments[0]
+        else:
+            raise _CompileError("if clause")
+        cond_fn = self.expr(cond_lo, cond_hi)
+        blo, bhi = I._group_span(toks, brace)
+        then_run = self.block(blo, bhi)
+        after = bhi + 1
+        else_step = None
+        chain_end = after
+        if (
+            after < hi
+            and toks[after].kind == KEYWORD
+            and toks[after].value == "else"
+        ):
+            j = after + 1
+            if toks[j].kind == KEYWORD and toks[j].value == "if":
+                else_step, chain_end = self._stmt_if(j, hi)
+            else:
+                elo, ehi = I._group_span(toks, j)
+                else_run = self.block(elo, ehi)
+                chain_end = ehi + 1
+
+                def else_step(ev, scope):
+                    else_run(ev, _Env(scope))
+
+        def s_if(ev, env):
+            scope = _Env(env)
+            if init_step is not None:
+                init_step(ev, scope)
+            if _truthy(cond_fn(ev, scope)):
+                then_run(ev, _Env(scope))
+            elif else_step is not None:
+                else_step(ev, scope)
+        return s_if, chain_end
+
+    def _stmt_for(self, i: int, hi: int):
+        toks = self.toks
+        segments, brace = self._clause_parts(i + 1)
+        blo, bhi = I._group_span(toks, brace)
+        after = bhi + 1
+        body = self.block(blo, bhi)
+        # range form?  (walk scans the single segment without depth
+        # tracking; mirror that exactly)
+        flat = None
+        if len(segments) == 1:
+            lo_s, hi_s = segments[0]
+            for j in range(lo_s, hi_s):
+                if toks[j].kind == KEYWORD and toks[j].value == "range":
+                    flat = j
+                    break
+        if flat is not None:
+            lo_s, hi_s = segments[0]
+            names = []
+            k = lo_s
+            while k < flat and toks[k].kind == IDENT:
+                names.append(toks[k].value)
+                if toks[k + 1].kind == OP and toks[k + 1].value == ",":
+                    k += 2
+                else:
+                    k += 1
+                    break
+            iter_fn = self.expr(flat + 1, hi_s)
+            name0 = names[0] if names else None
+            name1 = names[1] if len(names) > 1 else None
+
+            def s_range(ev, env):
+                iterable = iter_fn(ev, env)
+                if iterable is None:
+                    iterable = []
+                seq = (
+                    list(iterable.items()) if isinstance(iterable, dict)
+                    else list(enumerate(iterable))
+                )
+                for key, value in seq:
+                    scope = _Env(env)
+                    if name0 is not None:
+                        scope.define(name0, key)
+                    if name1 is not None:
+                        scope.define(name1, value)
+                    try:
+                        body(ev, scope)
+                    except _Break:
+                        break
+                    except _Continue:
+                        continue
+            return s_range, after
+        if len(segments) == 1 and segments[0][0] == segments[0][1]:
+            segments = []  # bare `for {`
+        if len(segments) == 3:
+            init_lo, init_hi = segments[0]
+            init_step = (
+                self._simple_stmt(init_lo, init_hi)[0]
+                if init_hi > init_lo else None
+            )
+            cond_lo, cond_hi = segments[1]
+            cond_fn = (
+                self.expr(cond_lo, cond_hi) if cond_hi > cond_lo else None
+            )
+            post_lo, post_hi = segments[2]
+            post_step = (
+                self._simple_stmt(post_lo, post_hi)[0]
+                if post_hi > post_lo else None
+            )
+
+            def s_for3(ev, env):
+                scope = _Env(env)
+                if init_step is not None:
+                    init_step(ev, scope)
+                while True:
+                    if cond_fn is not None and not _truthy(cond_fn(ev, scope)):
+                        break
+                    try:
+                        body(ev, _Env(scope))
+                    except _Break:
+                        break
+                    except _Continue:
+                        pass
+                    if post_step is not None:
+                        post_step(ev, scope)
+            return s_for3, after
+        if len(segments) <= 1:
+            cond_fn = self.expr(*segments[0]) if segments else None
+
+            def s_while(ev, env):
+                while True:
+                    if cond_fn is not None and not _truthy(cond_fn(ev, env)):
+                        break
+                    try:
+                        body(ev, _Env(env))
+                    except _Break:
+                        break
+                    except _Continue:
+                        continue
+            return s_while, after
+        raise _CompileError("for clause")
+
+    # -- switch -----------------------------------------------------------
+
+    def _find_colon(self, i: int, hi: int) -> int:
+        toks = self.toks
+        depth = 0
+        while i < hi:
+            t = toks[i]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif t.value == ":" and depth == 0:
+                    return i
+            i += 1
+        raise _CompileError("case clause without ':'")
+
+    def _switch_clauses(self, blo: int, bhi: int) -> list:
+        """Mirror of walk's _switch_clauses: (exprs-span or None,
+        stmts_lo, stmts_hi) per case, in source order."""
+        toks = self.toks
+        clauses = []
+        j = blo
+        current = None
+        depth = 0
+        while j <= bhi:
+            t = toks[j] if j < bhi else None
+            at_case = (
+                t is not None
+                and t.kind == KEYWORD
+                and t.value in ("case", "default")
+                and depth == 0
+            )
+            if j == bhi or at_case:
+                if current is not None:
+                    current[2] = j
+                    clauses.append(current)
+                if j == bhi:
+                    break
+                colon = self._find_colon(j + 1, bhi)
+                if t.value == "default":
+                    current = [None, colon + 1, bhi]
+                else:
+                    current = [(j + 1, colon), colon + 1, bhi]
+                j = colon + 1
+                continue
+            if toks[j].kind == OP and toks[j].value in "([{":
+                j = I._skip_group_from(toks, j)
+                continue
+            j += 1
+        return clauses
+
+    def _stmt_switch(self, i: int, hi: int):
+        toks = self.toks
+        segments, brace = self._clause_parts(i + 1)
+        ts = (
+            I._Eval._type_switch_parts(toks, segments[-1])
+            if segments else None
+        )
+        if ts is not None:
+            return self._compile_type_switch(segments, brace, ts)
+        init_step = None
+        if len(segments) == 2:
+            init_step, _ = self._simple_stmt(segments[0][0], segments[0][1])
+            segments = segments[1:]
+        subject_fn = None
+        tagless = True
+        if len(segments) == 1 and segments[0][1] > segments[0][0]:
+            subject_fn = self.expr(segments[0][0], segments[0][1])
+            tagless = False
+        blo, bhi = I._group_span(toks, brace)
+        compiled = []
+        default_run = None
+        for exprs, slo, shi in self._switch_clauses(blo, bhi):
+            if exprs is None:
+                default_run = self.block(slo, shi)
+                continue
+            value_fns = [
+                self.expr(vlo, vhi)
+                for vlo, vhi in I._split_commas(toks, exprs[0], exprs[1])
+            ]
+            compiled.append((value_fns, self.block(slo, shi)))
+
+        def s_switch(ev, env):
+            scope = _Env(env)
+            if init_step is not None:
+                init_step(ev, scope)
+            subject = True if subject_fn is None else subject_fn(ev, scope)
+            for value_fns, run in compiled:
+                values = [fn(ev, scope) for fn in value_fns]
+                matched = False
+                for value in values:
+                    matched = (
+                        _truthy(value) if tagless else _go_eq(subject, value)
+                    )
+                    if matched:
+                        break
+                if matched:
+                    try:
+                        run(ev, _Env(scope))
+                    except _Break:
+                        pass
+                    return
+            if default_run is not None:
+                try:
+                    default_run(ev, _Env(scope))
+                except _Break:
+                    pass
+        return s_switch, bhi + 1
+
+    def _compile_type_switch(self, segments, brace, ts):
+        toks = self.toks
+        init_step = None
+        if len(segments) == 2:
+            init_step, _ = self._simple_stmt(segments[0][0], segments[0][1])
+        bind_name, expr_lo, expr_hi = ts
+        subject_fn = self.expr(expr_lo, expr_hi)
+        blo, bhi = I._group_span(toks, brace)
+        compiled = []
+        default_run = None
+        for exprs, slo, shi in self._switch_clauses(blo, bhi):
+            if exprs is None:
+                default_run = self.block(slo, shi)
+                continue
+            type_texts = [
+                "".join(t.value for t in toks[tlo:thi])
+                for tlo, thi in I._split_commas(toks, exprs[0], exprs[1])
+            ]
+            compiled.append((type_texts, self.block(slo, shi)))
+
+        def s_type_switch(ev, env):
+            scope = _Env(env)
+            if init_step is not None:
+                init_step(ev, scope)
+            value = subject_fn(ev, scope)
+            for type_texts, run in compiled:
+                matched = False
+                for type_text in type_texts:
+                    if type_text == "nil":
+                        matched = value is None
+                    else:
+                        matched = value is not None and _type_assert(
+                            value, type_text
+                        )
+                    if matched:
+                        break
+                if matched:
+                    case_env = _Env(scope)
+                    if bind_name:
+                        case_env.define(bind_name, value)
+                    try:
+                        run(ev, case_env)
+                    except _Break:
+                        pass
+                    return
+            if default_run is not None:
+                case_env = _Env(scope)
+                if bind_name:
+                    case_env.define(bind_name, value)
+                try:
+                    default_run(ev, case_env)
+                except _Break:
+                    pass
+        return s_type_switch, bhi + 1
+
+    # -- var --------------------------------------------------------------
+
+    def _stmt_var(self, i: int, hi: int):
+        toks = self.toks
+        end = self._stmt_end(i + 1, hi)
+        j = i + 1
+        names = []
+        while j < end and toks[j].kind == IDENT:
+            names.append(toks[j].value)
+            if (
+                j + 1 < end
+                and toks[j + 1].kind == OP
+                and toks[j + 1].value == ","
+            ):
+                j += 2
+            else:
+                j += 1
+                break
+        eq = None
+        depth = 0
+        for k in range(j, end):
+            t = toks[k]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif t.value == "=" and depth == 0:
+                    eq = k
+                    break
+        if eq is not None:
+            fns = [
+                self.expr(slo, shi)
+                for slo, shi in I._split_commas(toks, eq + 1, end)
+            ]
+
+            def s_var_init(ev, env):
+                values = _expand([fn(ev, env) for fn in fns], len(names))
+                for name, value in zip(names, values):
+                    env.define(name, value)
+            return s_var_init, end
+        type_span = toks[j:end]
+
+        def s_var_zero(ev, env):
+            ev.env = env  # _zero_value resolves type names through ev.env
+            zero = ev._zero_value(type_span)
+            for name in names:
+                env.define(name, zero() if callable(zero) else zero)
+        return s_var_zero, end
+
+    # -- simple statements ------------------------------------------------
+
+    def _simple_stmt(self, i: int, hi: int):
+        toks = self.toks
+        end = self._stmt_end(i, hi)
+        depth = 0
+        op_at = None
+        op_val = None
+        for j in range(i, end):
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif depth == 0 and t.value in (
+                    ":=", "=", "+=", "-=", "*=", "/=", "|=", "&=", "%=",
+                ):
+                    op_at = j
+                    op_val = t.value
+                    break
+        if op_at is None:
+            if (
+                end - 2 >= i
+                and toks[end - 1].kind == OP
+                and toks[end - 1].value in ("++", "--")
+            ):
+                target_c = self._compile_target(i, end - 1)
+                delta = 1 if toks[end - 1].value == "++" else -1
+
+                def s_incdec(ev, env):
+                    target = target_c(ev, env)
+                    old = ev._read_target(target, env)
+                    ev._write_target(target, old + delta, env)
+                return s_incdec, end
+            fn = self.expr(i, end)
+
+            def s_expr(ev, env):
+                fn(ev, env)
+            return s_expr, end
+        rhs_fns = [
+            self.expr(slo, shi)
+            for slo, shi in I._split_commas(toks, op_at + 1, end)
+        ]
+        target_cs = [
+            self._compile_target(slo, shi)
+            for slo, shi in I._split_commas(toks, i, op_at)
+        ]
+        comma_ok = (
+            self._compile_comma_ok(op_at + 1, end)
+            if len(target_cs) == 2 else None
+        )
+        n_targets = len(target_cs)
+
+        def eval_values(ev, env):
+            values = [fn(ev, env) for fn in rhs_fns]
+            if (
+                n_targets == 2
+                and len(values) == 1
+                and not isinstance(values[0], tuple)
+                and comma_ok is not None
+            ):
+                pair = comma_ok(ev, env)
+                if pair is not None:
+                    values = list(pair)
+            return _expand(values, n_targets)
+
+        if op_val == ":=":
+            def s_define(ev, env):
+                values = eval_values(ev, env)
+                targets = [c(ev, env) for c in target_cs]
+                for target, value in zip(targets, values):
+                    if target[0] != "name":
+                        raise I.GoInterpError(":= target must be a name")
+                    env.define(target[1], value)
+            return s_define, end
+        if op_val != "=":
+            bin_op = op_val[:-1]
+            target_c0 = target_cs[0]
+
+            def s_aug(ev, env):
+                values = eval_values(ev, env)
+                target = target_c0(ev, env)
+                old = ev._read_target(target, env)
+                ev._write_target(
+                    target, _apply_binop(bin_op, old, values[0]), env
+                )
+            return s_aug, end
+
+        def s_assign(ev, env):
+            values = eval_values(ev, env)
+            targets = [c(ev, env) for c in target_cs]
+            for target, value in zip(targets, values):
+                ev._write_target(target, value, env)
+        return s_assign, end
+
+    def _compile_comma_ok(self, lo: int, hi: int):
+        """Static mirror of walk's _comma_ok scan: a trailing top-level
+        ``container[key]`` shape, compiled; None when the span has no
+        such shape (the runtime pair is then never produced)."""
+        toks = self.toks
+        j = lo
+        while j < hi:
+            t = toks[j]
+            if t.kind == OP and t.value in "([{":
+                g_end = I._skip_group_from(toks, j)
+                if t.value == "[" and g_end == hi and j > lo:
+                    container_fn = self.expr(lo, j)
+                    key_fn = self.expr(j + 1, g_end - 1)
+
+                    def comma_ok(ev, env):
+                        container = container_fn(ev, env)
+                        key = key_fn(ev, env)
+                        if container is None:
+                            return ("", False)
+                        if isinstance(container, dict):
+                            return (container.get(key, ""), key in container)
+                        return None
+                    return comma_ok
+                j = g_end
+                continue
+            j += 1
+        return None
+
+    def _compile_target(self, lo: int, hi: int):
+        """Assignment-target compiler; returns a closure producing the
+        same ("name"|"sel"|"index"|"star", ...) tuples walk's
+        _parse_target builds, with identical evaluation order."""
+        toks = self.toks
+        if hi - lo == 1 and toks[lo].kind == IDENT:
+            target = ("name", toks[lo].value)
+
+            def t_name(ev, env):
+                return target
+            return t_name
+        if toks[lo].kind == OP and toks[lo].value == "*":
+            obj_fn = self.expr(lo + 1, hi)
+
+            def t_star(ev, env):
+                return ("star", obj_fn(ev, env))
+            return t_star
+        depth = 0
+        last_dot = None
+        last_idx = None
+        j = lo
+        while j < hi:
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([":
+                    if t.value == "[" and depth == 0:
+                        last_idx = j
+                        last_dot = None
+                    depth += 1
+                    j = I._skip_group_from(toks, j)
+                    depth -= 1
+                    continue
+                if t.value == "." and depth == 0:
+                    last_dot = j
+            j += 1
+        if last_dot is not None:
+            obj_fn = self.expr(lo, last_dot)
+            name = toks[last_dot + 1].value
+
+            def t_sel(ev, env):
+                return ("sel", obj_fn(ev, env), name)
+            return t_sel
+        if last_idx is not None:
+            obj_fn = self.expr(lo, last_idx)
+            ilo, ihi = I._group_span(toks, last_idx)
+            key_fn = self.expr(ilo, ihi)
+
+            def t_index(ev, env):
+                obj = obj_fn(ev, env)
+                return ("index", obj, key_fn(ev, env))
+            return t_index
+        raise _CompileError("assignment target")
+
+    # == expressions =====================================================
+
+    def expr(self, lo: int, hi: int):
+        """Rooted expression over toks[lo:hi]: parses the longest valid
+        prefix at compile time and ignores trailing tokens, exactly as
+        each walk ``_eval_range`` call does."""
+        saved_root = self._root_lo
+        self._root_lo = lo
+        try:
+            fn, _pos = self.expression(lo, hi, 1)
+        finally:
+            self._root_lo = saved_root
+
+        def run(ev, env):
+            try:
+                return fn(ev, env)
+            except _StopExpr as stop:
+                return stop.value
+        return run
+
+    def expression(self, lo: int, hi: int, min_prec: int):
+        toks = self.toks
+        fn, pos = self.unary(lo, hi)
+        while pos < hi:
+            t = toks[pos]
+            if t.kind != OP or t.value not in I._BIN_PRECEDENCE:
+                break
+            prec = I._BIN_PRECEDENCE[t.value]
+            if prec < min_prec:
+                break
+            op = t.value
+            rhs_fn, pos = self.expression(pos + 1, hi, prec + 1)
+            fn = self._binop(op, fn, rhs_fn)
+        return fn, pos
+
+    @staticmethod
+    def _binop(op, lfn, rfn):
+        # &&/|| mirror walk's short-circuit (rhs untouched, result is a
+        # bool either way); the _StopExpr re-raise paths mirror walk's
+        # pending-binop application when a postfix chain breaks on a
+        # composite brace over a non-type value
+        if op == "&&":
+            def run_and(ev, env):
+                left = _truthy(lfn(ev, env))
+                if not left:
+                    return False
+                try:
+                    return _truthy(rfn(ev, env))
+                except _StopExpr as stop:
+                    stop.value = left and _truthy(stop.value)
+                    raise
+            return run_and
+        if op == "||":
+            def run_or(ev, env):
+                left = _truthy(lfn(ev, env))
+                if left:
+                    return True
+                try:
+                    return _truthy(rfn(ev, env))
+                except _StopExpr as stop:
+                    stop.value = left or _truthy(stop.value)
+                    raise
+            return run_or
+
+        def run_binop(ev, env):
+            left = lfn(ev, env)
+            try:
+                right = rfn(ev, env)
+            except _StopExpr as stop:
+                stop.value = _apply_binop(op, left, stop.value)
+                raise
+            return _apply_binop(op, left, right)
+        return run_binop
+
+    def unary(self, lo: int, hi: int):
+        toks = self.toks
+        t = toks[lo]
+        if t.kind == OP:
+            if t.value == "!":
+                sub_fn, pos = self.unary(lo + 1, hi)
+
+                def run_not(ev, env):
+                    return not _truthy(sub_fn(ev, env))
+                return run_not, pos
+            if t.value == "-":
+                sub_fn, pos = self.unary(lo + 1, hi)
+
+                def run_neg(ev, env):
+                    return -sub_fn(ev, env)
+                return run_neg, pos
+            if t.value == "&":
+                sub_fn, pos = self.unary(lo + 1, hi)
+                # the scalar-ref shape (&x on a bare ident) is a static
+                # property; whether x currently holds a scalar is not
+                if (
+                    lo + 1 < hi
+                    and toks[lo + 1].kind == IDENT
+                    and not (
+                        lo + 2 < hi
+                        and toks[lo + 2].kind == OP
+                        and toks[lo + 2].value in ".[{("
+                    )
+                ):
+                    name = toks[lo + 1].value
+
+                    def run_addr(ev, env):
+                        if env.has(name) and isinstance(
+                            env.get(name), (str, int, float, bool)
+                        ):
+                            return _VarRef(env, name)
+                        return sub_fn(ev, env)
+                    return run_addr, pos
+                return sub_fn, pos  # pointers transparent
+            if t.value == "*":
+                sub_fn, pos = self.unary(lo + 1, hi)
+
+                def run_deref(ev, env):
+                    value = sub_fn(ev, env)
+                    if isinstance(value, _VarRef):
+                        value = value.get()
+                    return value
+                return run_deref, pos
+        return self.postfix(lo, hi)
+
+    def postfix(self, lo: int, hi: int):
+        toks = self.toks
+        fn, pos = self.operand(lo, hi)
+        steps = []
+        while pos < hi:
+            t = toks[pos]
+            if t.kind == OP and t.value == ".":
+                if pos + 1 >= hi:
+                    # a trailing `.` crashes the walk evaluator at this
+                    # point; degrade so the fallback crashes identically
+                    raise _CompileError("dangling selector")
+                nxt = toks[pos + 1]
+                if nxt.kind == OP and nxt.value == "(":
+                    glo = pos + 2
+                    ghi = _bounded_group_end(toks, pos + 1, hi) - 1
+                    type_text = "".join(tok.value for tok in toks[glo:ghi])
+                    steps.append(self._assert_step(type_text))
+                    pos = ghi + 1
+                    continue
+                steps.append(self._sel_step(nxt.value))
+                pos += 2
+                continue
+            if t.kind == OP and t.value == "(":
+                end = _bounded_group_end(toks, pos, hi)
+                args_fn = self._call_args(pos + 1, end - 1)
+                callee_text = "".join(
+                    tok.value
+                    for tok in toks[max(self._root_lo, pos - 3):pos]
+                )
+                steps.append(
+                    self._call_step(args_fn, callee_text, t.line, t.col)
+                )
+                pos = end
+                continue
+            if t.kind == OP and t.value == "[":
+                end = _bounded_group_end(toks, pos, hi)
+                key_fn = self.expr(pos + 1, end - 1)
+                steps.append(self._index_step(key_fn))
+                pos = end
+                continue
+            if t.kind == OP and t.value == "{":
+                end = _bounded_group_end(toks, pos, hi)
+                comp = self._composite_body(pos + 1, end - 1)
+                steps.append(self._composite_step(comp))
+                pos = end
+                continue
+            break
+        if not steps:
+            return fn, pos
+        if len(steps) == 1:
+            step0 = steps[0]
+            base_fn = fn
+
+            def run_one(ev, env):
+                return step0(ev, env, base_fn(ev, env))
+            return run_one, pos
+        base_fn = fn
+
+        def run_chain(ev, env):
+            value = base_fn(ev, env)
+            for step in steps:
+                value = step(ev, env, value)
+            return value
+        return run_chain, pos
+
+    @staticmethod
+    def _sel_step(name):
+        def step(ev, env, value):
+            if isinstance(value, _GoStruct) and name not in value.fields:
+                interp = ev.interp
+                key = (value.tname, name)
+                entry = (
+                    interp.own_methods.get(key) or interp.methods.get(key)
+                )
+                if entry is not None:
+                    fn, scan = entry
+                    return _Closure(fn, scan, _Env(), recv_value=value)
+                promoted = ev._promoted(value, name)
+                if promoted is not None:
+                    return promoted
+            return _get_attr(value, name)
+        return step
+
+    @staticmethod
+    def _assert_step(type_text):
+        def step(ev, env, value):
+            ok = _type_assert(value, type_text)
+            return _AssertResult((value if ok else None, ok))
+        return step
+
+    @staticmethod
+    def _call_step(args_fn, callee_text, line, col):
+        def step(ev, env, value):
+            args = args_fn(ev, env)
+            if value is None:
+                raise I.GoInterpError(
+                    f"not callable: nil ({callee_text!r} at {line}:{col})"
+                )
+            return ev._call_value(value, args)
+        return step
+
+    @staticmethod
+    def _index_step(key_fn):
+        def step(ev, env, value):
+            return _go_index(value, key_fn(ev, env))
+        return step
+
+    @staticmethod
+    def _composite_step(comp):
+        def step(ev, env, value):
+            if isinstance(value, (I.TypeRef, type)):
+                return _build_composite(ev, env, value, comp)
+            # walk breaks its postfix loop here and the expression root
+            # returns the value with the rest of the span ignored
+            raise _StopExpr(value)
+        return step
+
+    def _call_args(self, lo: int, hi: int):
+        toks = self.toks
+        parts = []
+        for slo, shi in I._split_commas(toks, lo, hi):
+            spread = (
+                toks[shi - 1].kind == OP and toks[shi - 1].value == "..."
+            )
+            end = shi - 1 if spread else shi
+            parts.append((self.expr(slo, end), spread))
+
+        def run(ev, env):
+            args = []
+            for fn, spread in parts:
+                value = fn(ev, env)
+                if spread:
+                    args.extend(value or [])
+                else:
+                    args.append(value)
+            if len(args) == 1 and isinstance(args[0], tuple):
+                return list(args[0])
+            return args
+        return run
+
+    # -- operands ---------------------------------------------------------
+
+    def operand(self, lo: int, hi: int):
+        toks = self.toks
+        if lo >= hi:
+            raise _CompileError("empty operand")
+        t = toks[lo]
+        if t.kind == STRING:
+            return _const_or_defer(I._unquote, t.value), lo + 1
+        if t.kind == INT:
+            return _const_or_defer(lambda raw: int(raw, 0), t.value), lo + 1
+        if t.kind == FLOAT:
+            return _const_or_defer(float, t.value), lo + 1
+        if t.kind in (RUNE, IMAG):
+            const = t.value
+
+            def run_raw(ev, env):
+                return const
+            return run_raw, lo + 1
+        if t.kind == IDENT:
+            return self._operand_ident(lo, hi)
+        if t.kind == OP:
+            if t.value == "(":
+                end = _bounded_group_end(toks, lo, hi)
+                inner = self.expr(lo + 1, end - 1)
+                return inner, end
+            if t.value == "[":
+                return self._operand_slice_type(lo, hi)
+        if t.kind == KEYWORD:
+            if t.value == "map":
+                j = _bounded_group_end(toks, lo + 1, hi)  # [K]
+                j = self._type_end(j, hi)  # V
+                if not (
+                    j < hi and toks[j].kind == OP and toks[j].value == "{"
+                ):
+                    raise _CompileError("map literal")
+                end = _bounded_group_end(toks, j, hi)
+                comp = self._composite_body(j + 1, end - 1)
+
+                def run_map(ev, env):
+                    return comp(ev, env, "map", True, None)
+                return run_map, end
+            if t.value == "func":
+                return self._operand_func_literal(lo, hi)
+        raise _CompileError(f"operand {t.value!r}")
+
+    def _operand_ident(self, lo: int, hi: int):
+        toks = self.toks
+        name = toks[lo].value
+        has_call = (
+            lo + 1 < hi
+            and toks[lo + 1].kind == OP
+            and toks[lo + 1].value == "("
+        )
+        if has_call and name in (
+            "len", "cap", "append", "panic", "string", "new", "make",
+        ) or (has_call and name in I._NUMERIC_CONVERSIONS):
+            end = _bounded_group_end(toks, lo + 1, hi)
+            glo, ghi = lo + 2, end - 1
+            if name in ("len", "cap"):
+                arg_fn = self.expr(glo, ghi)
+
+                def run_len(ev, env):
+                    arg = arg_fn(ev, env)
+                    return 0 if arg is None else len(arg)
+                return run_len, end
+            if name == "append":
+                args_fn = self._call_args(glo, ghi)
+
+                def run_append(ev, env):
+                    args = args_fn(ev, env)
+                    base = list(args[0]) if args[0] else []
+                    base.extend(args[1:])
+                    return base
+                return run_append, end
+            if name == "panic":
+                arg_fn = self.expr(glo, ghi)
+
+                def run_panic(ev, env):
+                    raise I.GoPanic(arg_fn(ev, env))
+                return run_panic, end
+            if name in I._NUMERIC_CONVERSIONS:
+                conv = I._NUMERIC_CONVERSIONS[name]
+                arg_fn = self.expr(glo, ghi)
+
+                def run_conv(ev, env):
+                    arg = arg_fn(ev, env)
+                    return conv(arg) if arg is not None else 0
+                return run_conv, end
+            if name == "string":
+                arg_fn = self.expr(glo, ghi)
+
+                def run_string(ev, env):
+                    arg = arg_fn(ev, env)
+                    if isinstance(arg, (bytes, bytearray)):
+                        return arg.decode()
+                    if isinstance(arg, int) and not isinstance(arg, bool):
+                        return chr(arg)
+                    return "" if arg is None else str(arg)
+                return run_string, end
+            if name == "new":
+                tname = toks[glo].value
+
+                def run_new(ev, env):
+                    return _GoStruct(tname)
+                return run_new, end
+            # make
+            is_map = (
+                glo < ghi
+                and toks[glo].kind == KEYWORD
+                and toks[glo].value == "map"
+            )
+            if is_map:
+                def run_make_map(ev, env):
+                    return {}
+                return run_make_map, end
+
+            def run_make_slice(ev, env):
+                return []
+            return run_make_slice, end
+
+        def run_lookup(ev, env):
+            return ev.lookup(name, env)
+        return run_lookup, lo + 1
+
+    def _operand_slice_type(self, lo: int, hi: int):
+        toks = self.toks
+        close = _bounded_group_end(toks, lo, hi) - 1
+        j = close + 1
+        k = self._type_end(j, hi)
+        if k < hi and toks[k].kind == OP and toks[k].value == "{":
+            end = _bounded_group_end(toks, k, hi)
+            elem_span = toks[j:k]
+            comp = self._composite_body(k + 1, end - 1)
+
+            def run_slice_lit(ev, env):
+                ev.env = env  # _resolve_type_value reads ev.env
+                elem_type = ev._resolve_type_value(elem_span)
+                return comp(ev, env, "slice", False, elem_type)
+            return run_slice_lit, end
+        if k < hi and toks[k].kind == OP and toks[k].value == "(":
+            end = _bounded_group_end(toks, k, hi)
+            arg_fn = self.expr(k + 1, end - 1)
+            type_text = "".join(tok.value for tok in toks[j:k])
+            if type_text == "byte":
+                def run_bytes(ev, env):
+                    arg = arg_fn(ev, env)
+                    return arg.encode() if isinstance(arg, str) else arg
+                return run_bytes, end
+
+            def run_slice_conv(ev, env):
+                return arg_fn(ev, env)
+            return run_slice_conv, end
+        raise _CompileError("slice type")
+
+    def _type_end(self, j: int, hi: int) -> int:
+        """Bounded mirror of walk's _type_end."""
+        toks = self.toks
+        while j < hi:
+            t = toks[j]
+            if t.kind == OP and t.value == "*":
+                j += 1
+                continue
+            if t.kind == OP and t.value == "[":
+                j = _bounded_group_end(toks, j, hi)
+                continue
+            if t.kind == KEYWORD and t.value == "map":
+                if j + 1 < hi:
+                    j = _bounded_group_end(toks, j + 1, hi)
+                else:
+                    j += 1
+                continue
+            if t.kind == KEYWORD and t.value in ("interface", "struct"):
+                j += 1
+                if j < hi and toks[j].kind == OP and toks[j].value == "{":
+                    j = _bounded_group_end(toks, j, hi)
+                return j
+            if t.kind == KEYWORD and t.value == "func":
+                j += 1
+                if j < hi and toks[j].kind == OP and toks[j].value == "(":
+                    j = _bounded_group_end(toks, j, hi)
+                if j < hi and toks[j].kind == OP and toks[j].value == "(":
+                    return _bounded_group_end(toks, j, hi)
+                if j < hi and (
+                    toks[j].kind == IDENT
+                    or (toks[j].kind == OP and toks[j].value in ("*", "["))
+                    or (toks[j].kind == KEYWORD
+                        and toks[j].value in ("map", "interface", "struct"))
+                ):
+                    return self._type_end(j, hi)
+                return j
+            if t.kind == IDENT:
+                j += 1
+                while (
+                    j + 1 < hi
+                    and toks[j].kind == OP
+                    and toks[j].value == "."
+                    and toks[j + 1].kind == IDENT
+                ):
+                    j += 2
+                return j
+            return j
+        return j
+
+    def _operand_func_literal(self, lo: int, hi: int):
+        toks = self.toks
+        j = lo + 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "("):
+            raise _CompileError("func literal")
+        pend = _bounded_group_end(toks, j, hi)
+        params = self._param_items(j + 1, pend - 1)
+        j = pend
+        while j < hi:
+            t = toks[j]
+            if t.kind == KEYWORD and t.value in ("struct", "interface"):
+                j += 1
+                if j < hi and toks[j].value == "{":
+                    j = _bounded_group_end(toks, j, hi)
+                continue
+            if t.kind == OP and t.value == "{":
+                break
+            if t.kind == OP and t.value in "([":
+                j = _bounded_group_end(toks, j, hi)
+                continue
+            j += 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "{"):
+            raise _CompileError("func literal body")
+        end = _bounded_group_end(toks, j, hi)
+        blo, bhi = j + 1, end - 1
+        body_run = self.block(blo, bhi)
+        fn_record = {
+            "name": "<literal>", "recv": None,
+            "params": params,
+            "body": (blo, bhi), "generic": False, "arity": None,
+        }
+
+        def run_literal(ev, env):
+            closure = _Closure(fn_record, ev.scan, env)
+            # absolute spans: the runtime scan's tokens are
+            # content-identical to the compile-time ones
+            closure.toks = ev.scan.toks
+            closure.compiled = body_run
+            return closure
+        return run_literal, end
+
+    def _param_items(self, lo: int, hi: int) -> list:
+        toks = self.toks
+        items = []
+        for slo, shi in I._split_commas(toks, lo, hi):
+            span = toks[slo:shi]
+            if (
+                len(span) >= 2
+                and span[0].kind == IDENT
+                and not (span[1].kind == OP and span[1].value == ".")
+            ):
+                items.append((span[0].value, span[1:]))
+            else:
+                items.append((None, span))
+        return items
+
+    # -- composite literals ----------------------------------------------
+
+    def _composite_body(self, lo: int, hi: int):
+        """Compile a composite-literal body into a builder closure
+        ``build(ev, env, tname, expr_keys, elem_type)`` mirroring walk's
+        _composite (both key interpretations are compiled, because which
+        one applies depends on the runtime type)."""
+        toks = self.toks
+        elements = []
+        for slo, shi in I._split_commas(toks, lo, hi):
+            colon = None
+            depth = 0
+            for j in range(slo, shi):
+                t = toks[j]
+                if t.kind == OP:
+                    if t.value in "([{":
+                        depth += 1
+                    elif t.value in ")]}":
+                        depth -= 1
+                    elif t.value == ":" and depth == 0:
+                        colon = j
+                        break
+            if (
+                colon is not None
+                and toks[slo].kind == IDENT
+                and colon == slo + 1
+            ):
+                # `Name: value` — a field key for struct literals, an
+                # expression key for map literals; compile both reads
+                elements.append((
+                    "dualkey", toks[slo].value,
+                    self.expr(slo, colon), self.expr(colon + 1, shi),
+                ))
+            elif colon is not None:
+                elements.append((
+                    "kv", None,
+                    self.expr(slo, colon), self.expr(colon + 1, shi),
+                ))
+            elif toks[slo].kind == OP and toks[slo].value == "{":
+                g_end = _bounded_group_end(toks, slo, shi)
+                elements.append((
+                    "elided", None,
+                    self._composite_body(slo + 1, g_end - 1), None,
+                ))
+            else:
+                elements.append(("elem", None, self.expr(slo, shi), None))
+
+        def build(ev, env, tname, expr_keys, elem_type):
+            fields = {}
+            elems = []
+            for kind, name, first, second in elements:
+                if kind == "dualkey":
+                    if expr_keys:
+                        key = first(ev, env)  # key before value, like walk
+                        fields[key] = second(ev, env)
+                    else:
+                        fields[name] = second(ev, env)
+                elif kind == "kv":
+                    key = first(ev, env)
+                    fields[key] = second(ev, env)
+                elif kind == "elided":
+                    if elem_type is not None:
+                        elems.append(
+                            _build_composite(ev, env, elem_type, first)
+                        )
+                    else:
+                        elems.append(first(ev, env, "<anon>", False, None))
+                else:
+                    elems.append(first(ev, env))
+            if tname == "slice":
+                return elems
+            if tname == "map":
+                return fields
+            if elems and not fields:
+                return elems  # e.g. []Event{...} routed through slice
+            return _GoStruct(tname, fields)
+        return build
+
+
+def _build_composite(ev, env, typeval, comp):
+    """Runtime mirror of walk's _build_composite over a compiled body."""
+    if isinstance(typeval, I.MapTypeRef):
+        return comp(ev, env, "map", True, None)
+    if isinstance(typeval, I.TypeFactory):
+        built = comp(ev, env, typeval.name, False, None)
+        fields = built.fields if isinstance(built, _GoStruct) else {}
+        return typeval.make(fields)
+    if isinstance(typeval, I.TypeRef):
+        return comp(ev, env, typeval.name, False, None)
+    built = comp(ev, env, "<native>", False, None)
+    inst = typeval()
+    if isinstance(built, _GoStruct):
+        for fname, fval in built.fields.items():
+            setattr(inst, fname, fval)
+    return inst

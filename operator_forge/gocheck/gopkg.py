@@ -30,6 +30,7 @@ workload values the way sigs.k8s.io/yaml + apimachinery would.
 from __future__ import annotations
 
 import os
+from functools import lru_cache
 
 from .interp import (
     GoError,
@@ -402,11 +403,25 @@ def _category(rel: str) -> int:
     return 5
 
 
+@lru_cache(maxsize=64)
+def _module_path_cached(gomod: str, _mtime_ns: int, _size: int) -> str:
+    try:
+        with open(gomod, encoding="utf-8") as fh:
+            for line in fh:
+                if line.startswith("module "):
+                    return line.split()[1].strip()
+    except OSError:
+        pass
+    return "example.com/project"
+
+
 class ProjectRuntime:
     """Loads every package of one emitted project into linked
     interpreters; entry point for cross-package conformance tests."""
 
     def __init__(self, root: str, extra_natives: dict | None = None):
+        from ..perf import spans
+
         self.root = root
         self.module = self._module_path(root)
         self.universe = TypeUniverse()
@@ -419,20 +434,20 @@ class ProjectRuntime:
         self.methods: dict = {}
         self.embeds: dict = {}
         self.packages: dict[str, Interp] = {}  # relpath -> Interp
-        for rel in self._package_dirs():
-            self._load_package(rel)
+        with spans.span("gocheck.index"):
+            for rel in self._package_dirs():
+                self._load_package(rel)
 
     @staticmethod
     def _module_path(root: str) -> str:
         gomod = os.path.join(root, "go.mod")
         try:
-            with open(gomod, encoding="utf-8") as fh:
-                for line in fh:
-                    if line.startswith("module "):
-                        return line.split()[1].strip()
+            stat = os.stat(gomod)
         except OSError:
-            pass
-        return "example.com/project"
+            return "example.com/project"
+        # re-read only when the file changes: every world of every
+        # run_project_tests call resolves the same go.mod
+        return _module_path_cached(gomod, stat.st_mtime_ns, stat.st_size)
 
     def _package_dirs(self) -> list[str]:
         rels = []

@@ -1988,7 +1988,13 @@ class Interp:
 
     def load_source(self, text: str, path: str = "<go>",
                     defer_values: bool = False) -> None:
-        scan = _FileScan(path, text)
+        from .cache import scan_source
+
+        # content-cached: re-loading an unchanged file (each test
+        # package's world re-loads the whole project) reuses the
+        # tokenize+scan work; every interpreter gets its own shallow
+        # copy of the pristine scan
+        scan = scan_source(path, text)
         # backref for cross-package dispatch: a method reached through
         # the shared registry must execute under ITS package's funcs,
         # consts and imports, not the caller's
@@ -2124,8 +2130,16 @@ class Interp:
         _bind_params(env, fn["params"], args)
         ev = _Eval(self, scan, env)
         lo, hi = fn["body"]
+        # compile mode lowers the body to closures once per content
+        # hash; walk mode (and a failed compile) re-walks the tokens
+        runner = None
+        if compiler.mode() == "compile":
+            runner = compiler.compiled_block(scan, lo, hi)
         try:
-            ev.exec_block(scan.toks, lo, hi, env)
+            if runner is not None:
+                runner(ev, env)
+            else:
+                ev.exec_block(scan.toks, lo, hi, env)
         except _Return as ret:
             ev.run_defers()
             return ret.values
@@ -3481,8 +3495,14 @@ class _Eval:
             _bind_params(env, fn["params"], args)
             ev = _Eval(owner, callee.scan, env)
             lo, hi = fn["body"]
+            runner = getattr(callee, "compiled", None)
+            if runner is not None and compiler.mode() != "compile":
+                runner = None
             try:
-                ev.exec_block(toks, lo, hi, env)
+                if runner is not None:
+                    runner(ev, env)
+                else:
+                    ev.exec_block(toks, lo, hi, env)
             except _Return as ret:
                 ev.run_defers()
                 return ret.values
@@ -3693,3 +3713,10 @@ def _unquote(raw: str) -> str:
         out.append(ch)
         i += 1
     return "".join(out)
+
+
+# imported last: the closure compiler mirrors this module's evaluator
+# (it imports the names above), while _invoke/_call_value dispatch into
+# it on the hot path — a bottom-of-module import resolves the cycle
+# without per-call import machinery
+from . import compiler  # noqa: E402

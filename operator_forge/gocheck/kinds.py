@@ -24,6 +24,8 @@ Parameter-kind vocabulary:
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 from .tokens import IDENT, INT, KEYWORD, OP, STRING, Token
 
 # expected kind -> literal kinds that can NEVER satisfy it
@@ -127,9 +129,12 @@ def check_call_kinds(
     return problems
 
 
+@lru_cache(maxsize=4096)
 def param_kind_of(type_text: str) -> str | None:
     """Kind for a parameter TYPE's normalized text (project-indexed
-    funcs derive their kinds from their own signatures)."""
+    funcs derive their kinds from their own signatures).  Pure string
+    classification re-run for every indexed signature of every check —
+    cached per text."""
     t = type_text.lstrip("*")
     if t == "string":
         return "string"

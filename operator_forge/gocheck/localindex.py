@@ -776,7 +776,12 @@ class ProjectIndex:
 
     def as_manifest(self) -> dict:
         """Exported surface of every project package, in the shape
-        typecheck.MANIFEST uses, all packages closed."""
+        typecheck.MANIFEST uses, all packages closed.  Memoized on the
+        instance: the index is immutable once built, and cached indexes
+        are consulted once per ``check_project`` call."""
+        cached = getattr(self, "_manifest_memo", None)
+        if cached is not None:
+            return cached
         out: dict[str, dict] = {}
         for imp, pkg in self.packages.items():
             funcs = {
@@ -812,7 +817,21 @@ class ProjectIndex:
                     if any(pkg.func_kinds.get(n) or ())
                 },
             }
+        self._manifest_memo = out
         return out
+
+    def merged_manifest(self, base: dict) -> dict:
+        """``base`` (the stdlib+dependency manifest) merged with this
+        project's surface — memoized like :meth:`as_manifest`, since
+        the merge used to be rebuilt per check call and indexes are
+        cached across calls.  Keyed on the base's identity: a cached
+        index outlives any single caller, so a different base must not
+        replay the first caller's merge."""
+        cached = getattr(self, "_merged_memo", None)
+        if cached is None or cached[0] is not base:
+            cached = (base, {**base, **self.as_manifest()})
+            self._merged_memo = cached
+        return cached[1]
 
 
 class _UNRESOLVED:

@@ -1045,8 +1045,17 @@ def parse_source(text: str, filename: str = "<go>") -> _Parser:
     """Parse a Go source file; raises GoTokenError/GoSyntaxError on failure.
 
     Returns the parser, whose recorded ``func_spans``/``local_decls``/
-    ``labels`` feed the semantic pass (lint.py).
+    ``labels`` feed the semantic pass (lint.py).  Successful parses are
+    memoized on the source's content hash (``gocheck.parse`` namespace,
+    honoring ``OPERATOR_FORGE_CACHE``), so re-checking an unchanged
+    emitted tree skips tokenize+parse entirely.
     """
+    from .cache import parse_cached
+
+    return parse_cached(text, filename, lambda: _parse_source(text, filename))
+
+
+def _parse_source(text: str, filename: str) -> _Parser:
     toks = tokenize(text, filename)
     parser = _Parser(toks, filename)
     parser.parse_file()

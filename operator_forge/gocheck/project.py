@@ -4,8 +4,10 @@ from __future__ import annotations
 
 import os
 
+from . import cache
+from .cache import project_index
 from .lint import semantics_of
-from .localindex import ProjectIndex, check_local_calls
+from .localindex import check_local_calls
 from .manifest import MANIFEST
 from .parser import GoSyntaxError, parse_source
 from .structural import check_structure, prune_go_dirs
@@ -24,14 +26,26 @@ def check_project(root: str) -> list[str]:
     not model.  Unreadable or non-UTF-8 files are reported as errors,
     not raised.
     """
+    # the whole report is a pure function of the Go surface's bytes
+    # (vet reads only pruned .go files plus go.mod), so an unchanged
+    # surface replays the previous report; off mode skips the hashing
+    key = None
+    if cache.replay_enabled():
+        key = cache.check_key(root, files=cache.go_file_state(root),
+                              op="vet")
+        cached = cache.check_get(key)
+        if cached is not None:
+            return cached
     errors: list[str] = []
     checked = 0
     # index the project's own packages so qualified references between
-    # them are checked closed, like the dependency manifest
-    index = ProjectIndex(root)
+    # them are checked closed, like the dependency manifest; the index
+    # is content-cached on the project's file-hash set, so re-checking
+    # an unchanged tree reuses it instead of re-scanning every file
+    index = project_index(root)
     manifest = MANIFEST
     if index.module is not None:
-        manifest = {**MANIFEST, **index.as_manifest()}
+        manifest = index.merged_manifest(MANIFEST)
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
@@ -66,4 +80,6 @@ def check_project(root: str) -> list[str]:
         # an empty match is a wrong path, not a clean project — `go vet`
         # likewise errors on a package pattern matching no files
         errors.append(f"{root}: no Go files found")
+    if key is not None:
+        cache.check_put(key, errors)
     return errors

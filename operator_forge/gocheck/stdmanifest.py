@@ -26,6 +26,8 @@ kinds.py) powers the literal-kind check: ``os.Exit("one")`` and
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 STD_MANIFEST: dict[str, dict] = {
     "fmt": {
         "closed": True,
@@ -497,3 +499,20 @@ STD_MANIFEST: dict[str, dict] = {
         },
     },
 }
+
+
+@lru_cache(maxsize=None)
+def symbol_surface(path: str) -> frozenset | None:
+    """``funcs ∪ types ∪ values`` of a stdlib package, built once per
+    process.  The type layer's existence check used to re-derive this
+    membership three dict-probes at a time for every qualified
+    reference of every check call; None for non-stdlib paths (their
+    surfaces come from the project index and stay per-dict)."""
+    pkg = STD_MANIFEST.get(path)
+    if pkg is None:
+        return None
+    return (
+        frozenset(pkg["funcs"])
+        | frozenset(pkg["types"])
+        | frozenset(pkg["values"])
+    )

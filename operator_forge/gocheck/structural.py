@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 import re
 from collections import defaultdict
+from functools import lru_cache
 
 from .tokens import KEYWORDS as _GO_KEYWORDS
 
@@ -43,7 +44,10 @@ _FUNC_SIG_RE = re.compile(
 _RANGE_RE = re.compile(r"for\s+([\w\s,]+?)\s*:=\s*range\b")
 
 
+@lru_cache(maxsize=256)
 def strip_strings_and_comments(text: str) -> str:
+    # pure text -> text, called for the same file by the import check,
+    # the shadow-name scan, and the range-clause scan — cached per text
     out = []
     i = 0
     n = len(text)
@@ -81,7 +85,16 @@ def strip_strings_and_comments(text: str) -> str:
 
 
 def parse_imports(text: str) -> list[tuple[str, str]]:
-    """Return (effective_name, path) for every import."""
+    """Return (effective_name, path) for every import.
+
+    Cached per text (every file's imports are parsed by the file scan,
+    the structural pass, and the type layer); callers get a fresh list,
+    the cached tuple stays immutable."""
+    return list(_parse_imports_cached(text))
+
+
+@lru_cache(maxsize=256)
+def _parse_imports_cached(text: str) -> tuple[tuple[str, str], ...]:
     imports: list[tuple[str, str]] = []
     block = _IMPORT_BLOCK_RE.search(text)
     lines = block.group(1).split("\n") if block else []
@@ -98,7 +111,7 @@ def parse_imports(text: str) -> list[tuple[str, str]]:
         if m:
             name = m.group(1)
         imports.append((name, path))
-    return imports
+    return tuple(imports)
 
 
 def check_imports(text: str) -> list[str]:

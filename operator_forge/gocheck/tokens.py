@@ -12,6 +12,7 @@ semicolons there.)
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass
 
 
@@ -42,6 +43,25 @@ OPERATORS = sorted(
     key=len,
     reverse=True,
 )
+
+# Length-bucketed operator sets, hoisted to module level: greedy
+# matching becomes three O(1) membership tests instead of a linear
+# startswith() sweep over the whole table per operator token.
+_OPS_BY_LEN = (
+    frozenset(op for op in OPERATORS if len(op) == 3),
+    frozenset(op for op in OPERATORS if len(op) == 2),
+    frozenset(op for op in OPERATORS if len(op) == 1),
+)
+# the bucket matcher probes exactly lengths 3,2,1 — a longer operator
+# would be silently unmatchable
+assert max(len(op) for op in OPERATORS) == 3
+
+# Every token value the scanner can emit more than once is interned:
+# keywords, operators, and identifiers repeat heavily across the files
+# of one generated project, and interning makes each a shared object
+# (cheaper `==` via identity hit, one copy in memory, faster dict keys
+# in the parser/interpreter layers downstream).
+_INTERN = sys.intern
 
 # Tokens after which a newline triggers semicolon insertion (spec rule 1).
 _ASI_AFTER_OPS = frozenset({")", "]", "}", "++", "--"})
@@ -164,7 +184,7 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
             j = i + 1
             while j < n and _is_ident_char(text[j]):
                 j += 1
-            word = text[i:j]
+            word = _INTERN(text[i:j])
             kind = KEYWORD if word in KEYWORDS else IDENT
             tokens.append(Token(kind, word, start_line, start_col))
             col += j - i
@@ -268,13 +288,21 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
             i = j + 1
             continue
 
-        # Operators / punctuation.
-        for op in OPERATORS:
-            if text.startswith(op, i):
-                tokens.append(Token(OP, op, start_line, start_col))
-                i += len(op)
-                col += len(op)
-                break
+        # Operators / punctuation: longest-first via the length buckets.
+        op = None
+        three = text[i : i + 3]
+        if three in _OPS_BY_LEN[0]:
+            op = three
+        else:
+            two = three[:2]
+            if two in _OPS_BY_LEN[1]:
+                op = two
+            elif ch in _OPS_BY_LEN[2]:
+                op = ch
+        if op is not None:
+            tokens.append(Token(OP, _INTERN(op), start_line, start_col))
+            i += len(op)
+            col += len(op)
         else:
             err(f"unexpected character {ch!r}")
 

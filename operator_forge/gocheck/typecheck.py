@@ -28,6 +28,7 @@ import re
 from .kinds import check_call_kinds
 from .manifest import MANIFEST
 from .parser import _Parser
+from .stdmanifest import symbol_surface
 from .structural import parse_imports, strip_strings_and_comments
 
 # header of a func declaration/literal: a cheap superset of the names
@@ -163,7 +164,10 @@ def types_of(
         tok = toks[tok_index]
         return f"{filename}:{tok.line}:{tok.col}"
 
-    def known(pkg: dict, name: str) -> bool:
+    def known(pkg: dict, path: str, name: str) -> bool:
+        surface = symbol_surface(path)
+        if surface is not None:  # stdlib package: one cached frozenset
+            return name in surface
         return (
             name in pkg["funcs"]
             or name in pkg["types"]
@@ -235,7 +239,7 @@ def types_of(
                             f"{where(name_i)}: {alias}.{name} has no "
                             f"field {key!r}"
                         )
-        elif pkg["closed"] and not known(pkg, name):
+        elif pkg["closed"] and not known(pkg, path, name):
             problems.append(
                 f"{where(name_i)}: {path} has no symbol {name!r}"
             )
@@ -247,7 +251,8 @@ def types_of(
         pkg = checked.get(alias)
         if pkg is None or alias in shadowed:
             continue
-        if pkg["closed"] and not known(pkg, toks[name_i].value):
+        name = toks[name_i].value
+        if pkg["closed"] and not known(pkg, imports[alias], name):
             problems.append(
                 f"{where(name_i)}: {imports[alias]} has no symbol "
                 f"{toks[name_i].value!r}"

@@ -206,6 +206,10 @@ class ContentCache:
         self._stats: dict = {}
         self._mode_override = None
         self._root_override = None
+        # callbacks run by reset(): sibling in-process caches (the
+        # gocheck scan/index identity layers) register here so one
+        # reset() call returns the whole process to a cold state
+        self.reset_hooks: list = []
 
     # -- configuration --------------------------------------------------
 
@@ -234,6 +238,8 @@ class ContentCache:
         with self._lock:
             self._mem.clear()
             self._stats.clear()
+        for hook in list(self.reset_hooks):
+            hook()
 
     def stats(self) -> dict:
         with self._lock:

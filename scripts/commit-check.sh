@@ -24,7 +24,7 @@ fi
 echo "perf contract: OPERATOR_FORGE_BENCH_RUNS=5 ${PYTHON:-python3} bench.py"
 bench_out="$(mktemp)"
 trap 'rm -f "$bench_out"' EXIT
-if ! (cd "$repo_root" && OPERATOR_FORGE_BENCH_RUNS=5 "${PYTHON:-python3}" bench.py > "$bench_out"); then
+if ! (cd "$repo_root" && OPERATOR_FORGE_BENCH_RUNS=5 OPERATOR_FORGE_BENCH_CHECK_RUNS=3 "${PYTHON:-python3}" bench.py > "$bench_out"); then
     echo "perf contract: bench.py exited nonzero (determinism guard?)" >&2
     exit 1
 fi
@@ -52,4 +52,40 @@ print(
         len(detail["stages"]["cold"]),
     )
 )
+
+# gocheck determinism (PR 2): compile-vs-walk and serial-vs-parallel
+# conformance reports over the kitchen-sink tree must be identical with
+# the cache off, mem, and disk, warm replay must match the cold run,
+# and the warm re-check must clear the 3x acceptance bar.
+check = detail["check"]
+assert check["warm_matches_cold"] is True, "gocheck warm replay diverged"
+for cache_mode, ok in check["identity_by_cache_mode"].items():
+    assert ok is True, f"gocheck identity guard failed (cache={cache_mode})"
+assert check["warm_speedup"] >= 3, (
+    "gocheck warm re-check below the 3x bar: %.2f" % check["warm_speedup"]
+)
+print(
+    "gocheck contract OK: cold=%.3fs warm=%.3fs (x%.1f), identity "
+    "guards clean in %d cache modes"
+    % (
+        check["cold_cpu_s_median"],
+        check["warm_cpu_s_median"],
+        check["warm_speedup"],
+        len(check["identity_by_cache_mode"]),
+    )
+)
 PYEOF
+
+# Archive the slowest tests so future perf PRs can target them.
+# Heavy (full tier-1 run): skip with SKIP_DURATIONS=1 when iterating.
+if [[ "${SKIP_DURATIONS:-0}" != "1" ]]; then
+    echo "durations archive: pytest --durations=15 -> DURATIONS.txt"
+    (
+        cd "$repo_root" &&
+        JAX_PLATFORMS=cpu "${PYTHON:-python3}" -m pytest tests/ -q \
+            -m 'not slow' --durations=15 -p no:cacheprovider \
+            --continue-on-collection-errors 2>&1 |
+        awk '/slowest .*durations/{f=1} f' > DURATIONS.txt
+    ) || true
+    tail -n +1 "$repo_root/DURATIONS.txt" | head -20
+fi

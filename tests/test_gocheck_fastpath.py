@@ -1,0 +1,278 @@
+"""gocheck fast-path contract (PR 2 acceptance).
+
+The fast path — content-cached scans/parses/indexes, the closure
+compiler, the parallel suite driver, and whole-report replay — may only
+ever change HOW a conformance report is produced, never WHAT it says.
+Every test here compares full reports (codes, test names, failure
+messages) across interpreter modes, job counts, and cache modes.
+"""
+
+import contextlib
+import io
+import os
+import shutil
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import check_project, compiler
+from operator_forge.gocheck import cache as gcache
+from operator_forge.gocheck.world import run_project_tests
+from operator_forge.perf import cache as perfcache
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def standalone(tmp_path_factory) -> str:
+    """One generated standalone project (orchestrate + controller +
+    e2e suites) shared by the module's read-only tests."""
+    out = str(tmp_path_factory.mktemp("fastpath") / "proj")
+    config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/fastpath", "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _restore_interp_mode():
+    yield
+    compiler.set_mode(None)
+
+
+def signature(results) -> list:
+    """Everything report-relevant except wall-clock seconds."""
+    return [
+        (r.rel, r.code, r.ran, r.failures, r.skipped, r.error)
+        for r in results
+    ]
+
+
+class TestCompileWalkIdentity:
+    def test_reports_identical_in_every_cache_mode(
+        self, standalone, tmp_path
+    ):
+        """OPERATOR_FORGE_GOCHECK=compile must produce the same
+        pass/fail results and diagnostics as walk, with the cache off,
+        mem, and disk."""
+        reference = None
+        for cache_mode in ("off", "mem", "disk"):
+            perfcache.configure(
+                mode=cache_mode,
+                root=str(tmp_path / "cache") if cache_mode == "disk"
+                else None,
+            )
+            perfcache.reset()
+            for interp_mode in ("walk", "compile"):
+                compiler.set_mode(interp_mode)
+                got = signature(
+                    run_project_tests(standalone, include_e2e=True)
+                )
+                assert got, "no packages discovered"
+                if reference is None:
+                    reference = got
+                assert got == reference, (
+                    f"report diverged under mode={interp_mode} "
+                    f"cache={cache_mode}"
+                )
+
+    def test_identical_diagnostics_on_failing_suite(
+        self, standalone, tmp_path
+    ):
+        """A seeded logic break must fail identically — same failing
+        test, same formatted message — under walk and compile."""
+        proj = str(tmp_path / "broken")
+        shutil.copytree(standalone, proj)
+        path = os.path.join(proj, "pkg", "orchestrate", "ready.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                "return readyReplicas >= specReplicas, nil",
+                "return readyReplicas > specReplicas, nil",
+            ))
+        perfcache.configure(mode="off")
+        reports = {}
+        for interp_mode in ("walk", "compile"):
+            compiler.set_mode(interp_mode)
+            reports[interp_mode] = signature(run_project_tests(proj))
+        assert reports["walk"] == reports["compile"]
+        assert any(code == 1 for _rel, code, *_rest in reports["walk"])
+
+    def test_unsupported_construct_fails_identically(self, tmp_path):
+        """Code outside the interpreter subset (channels) must surface
+        the same per-package error in both modes — the compiler's walk
+        fallback owns this guarantee."""
+        pkg = tmp_path / "chanproj" / "pkg" / "thing"
+        pkg.mkdir(parents=True)
+        (tmp_path / "chanproj" / "go.mod").write_text(
+            "module example.com/chanproj\n\ngo 1.19\n"
+        )
+        (pkg / "thing.go").write_text(
+            "package thing\n\n"
+            "func Pump() int {\n"
+            "\tch := make(chan int, 1)\n"
+            "\tch <- 1\n"
+            "\treturn <-ch\n"
+            "}\n"
+        )
+        (pkg / "thing_test.go").write_text(
+            "package thing\n\n"
+            'import "testing"\n\n'
+            "func TestPump(t *testing.T) {\n"
+            "\tif Pump() != 1 {\n"
+            '\t\tt.Fatal("pump")\n'
+            "\t}\n"
+            "}\n"
+        )
+        perfcache.configure(mode="off")
+        reports = {}
+        for interp_mode in ("walk", "compile"):
+            compiler.set_mode(interp_mode)
+            reports[interp_mode] = signature(
+                run_project_tests(str(tmp_path / "chanproj"))
+            )
+        assert reports["walk"] == reports["compile"]
+
+
+class TestParallelIdentity:
+    def test_jobs_8_equals_jobs_1(self, standalone, monkeypatch):
+        """The parallel driver collects per-package results in input
+        order: a JOBS=8 report equals the JOBS=1 report byte for
+        byte."""
+        perfcache.configure(mode="off")  # force real execution twice
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        serial = signature(run_project_tests(standalone, include_e2e=True))
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "8")
+        parallel = signature(
+            run_project_tests(standalone, include_e2e=True)
+        )
+        assert serial == parallel
+
+
+class TestCheckReplay:
+    def test_warm_rerun_replays_and_matches(self, standalone):
+        perfcache.configure(mode="mem")
+        cold = run_project_tests(standalone, include_e2e=True)
+        warm = run_project_tests(standalone, include_e2e=True)
+        assert signature(cold) == signature(warm)
+        stats = perfcache.stats().get("gocheck.check", {})
+        assert stats.get("hits", 0) >= 1
+
+    def test_replay_reemits_callback_stream(self, standalone):
+        perfcache.configure(mode="mem")
+        run_project_tests(standalone, include_e2e=True)
+        live = {"packages": [], "tests": []}
+        results = run_project_tests(
+            standalone, include_e2e=True,
+            progress=live["packages"].append,
+            on_test=lambda name, passed: live["tests"].append(
+                (name, passed)
+            ),
+        )
+        assert live["packages"] == [r.rel for r in results if not r.skipped]
+        assert len(live["tests"]) == sum(len(r.ran) for r in results)
+        assert all(passed for _name, passed in live["tests"])
+
+    def test_touched_file_invalidates_replay(self, standalone, tmp_path):
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        perfcache.configure(mode="mem")
+        first = run_project_tests(proj)
+        path = os.path.join(proj, "pkg", "orchestrate", "ready.go")
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write("\n// touched\n")
+        second = run_project_tests(proj)
+        # a comment-only touch recomputes (content key changed) but the
+        # verdicts are unchanged
+        assert signature(first) == signature(second)
+        stats = perfcache.stats().get("gocheck.check", {})
+        assert stats.get("hits", 0) == 0
+
+    def test_check_project_replays_for_unchanged_tree(self, standalone):
+        perfcache.configure(mode="mem")
+        first = check_project(standalone)
+        second = check_project(standalone)
+        assert first == second == []
+        stats = perfcache.stats().get("gocheck.check", {})
+        assert stats.get("hits", 0) >= 1
+
+
+class TestScanParseCaches:
+    SOURCE = (
+        "package demo\n\n"
+        "func Add(a, b int) int {\n"
+        "\treturn a + b\n"
+        "}\n"
+    )
+
+    def test_parse_cache_hits_on_same_content(self):
+        from operator_forge.gocheck.parser import parse_source
+
+        perfcache.configure(mode="mem")
+        first = parse_source(self.SOURCE, "demo.go")
+        second = parse_source(self.SOURCE, "demo.go")
+        assert second.func_spans == first.func_spans
+        stats = perfcache.stats().get("gocheck.parse", {})
+        assert stats.get("hits", 0) == 1
+
+    def test_scan_copies_keep_private_interp_backrefs(self):
+        """Two interpreters loading identical sources must get scans
+        whose ``interp`` backrefs stay distinct — a shared backref
+        would dispatch methods into the wrong world."""
+        from operator_forge.gocheck.interp import Interp
+
+        perfcache.configure(mode="mem")
+        a, b = Interp(), Interp()
+        a.load_source(self.SOURCE, "demo.go")
+        b.load_source(self.SOURCE, "demo.go")
+        assert a.scans[0].interp is a
+        assert b.scans[0].interp is b
+        assert a.scans[0] is not b.scans[0]
+        assert a.call("Add", 2, 3) == b.call("Add", 2, 3) == 5
+        stats = perfcache.stats().get("gocheck.scan", {})
+        assert stats.get("hits", 0) >= 1
+
+    def test_compiled_bodies_shared_across_worlds(self):
+        """Compiled runners are keyed on content hash, so two
+        interpreters over the same bytes compile once."""
+        from operator_forge.gocheck.interp import Interp
+
+        perfcache.configure(mode="mem")
+        compiler.set_mode("compile")
+        a, b = Interp(), Interp()
+        a.load_source(self.SOURCE, "demo.go")
+        b.load_source(self.SOURCE, "demo.go")
+        assert a.call("Add", 1, 1) == 2
+        size_after_first = len(compiler._registry)
+        assert size_after_first >= 1
+        assert b.call("Add", 2, 2) == 4
+        assert len(compiler._registry) == size_after_first
+
+    def test_index_cache_reuses_project_index(self, standalone):
+        perfcache.configure(mode="mem")
+        first = gcache.project_index(standalone)
+        second = gcache.project_index(standalone)
+        assert second is first
+        stats = perfcache.stats().get("gocheck.index", {})
+        assert stats.get("hits", 0) == 1
+
+    def test_disk_cache_survives_identity_reset(self, standalone, tmp_path):
+        """Disk mode persists scans/parses/indexes/reports across the
+        in-process identity layer's lifetime (a stand-in for a fresh
+        process)."""
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cold = signature(run_project_tests(standalone, include_e2e=True))
+        perfcache.reset()  # drops every in-process layer; disk remains
+        warm = signature(run_project_tests(standalone, include_e2e=True))
+        assert cold == warm
+        stats = perfcache.stats().get("gocheck.check", {})
+        assert stats.get("hits", 0) >= 1
