@@ -59,13 +59,29 @@ FIXTURES = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "tests", "fixtures"
 )
 BENCH_FIXTURES = ("standalone", "collection", "kitchen-sink")
-WARMUP_RUNS = 2
+# fast-iteration mode (OPERATOR_FORGE_BENCH_FAST=1): single samples, no
+# warmups, identity guards in mem mode only, and a standalone-only batch
+# workload — every contract key is still emitted, but nothing runs at
+# median-stable scale.  The contract test (tests/test_cli_misc.py) and
+# quick local iteration use it; commit-check runs the full settings.
+FAST = os.environ.get("OPERATOR_FORGE_BENCH_FAST", "") not in ("", "0")
+WARMUP_RUNS = 0 if FAST else 2
 # override for quick contract checks (tests); the default is sized for a
 # stable median on a noisy host
-MEASURED_RUNS = int(os.environ.get("OPERATOR_FORGE_BENCH_RUNS", "31"))
+MEASURED_RUNS = int(
+    os.environ.get("OPERATOR_FORGE_BENCH_RUNS", "1" if FAST else "31")
+)
 # the check section runs the whole kitchen-sink suite per sample (and
 # the identity guards re-run it 9 more times), so it uses its own count
-CHECK_RUNS = int(os.environ.get("OPERATOR_FORGE_BENCH_CHECK_RUNS", "5"))
+CHECK_RUNS = int(
+    os.environ.get("OPERATOR_FORGE_BENCH_CHECK_RUNS", "1" if FAST else "5")
+)
+# the batch section times whole 8-job batches; identity legs re-run the
+# batch 3x per cache mode
+BATCH_RUNS = int(
+    os.environ.get("OPERATOR_FORGE_BENCH_BATCH_RUNS", "1" if FAST else "3")
+)
+GUARD_MODES = ("mem",) if FAST else ("off", "mem", "disk")
 
 
 def generate(fixture: str, repo: str, out_dir: str) -> None:
@@ -196,7 +212,7 @@ def check_section(tree: str) -> dict:
     disk_root = tempfile.mkdtemp(prefix="operator-forge-checkcache-")
     saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
     try:
-        for cache_mode in ("off", "mem", "disk"):
+        for cache_mode in GUARD_MODES:
             signatures = []
             for leg, (gocheck_mode, jobs) in enumerate((
                 ("walk", "1"), ("compile", "1"), ("compile", "8"),
@@ -240,6 +256,183 @@ def check_section(tree: str) -> dict:
         "headline": "cold = empty caches (tokenize + scan + "
         "closure-compile + execute, OPERATOR_FORGE_GOCHECK=compile); "
         "warm = content-validated replay of the unchanged tree",
+    }
+
+
+def _batch_specs(base: str, suffix: str) -> list:
+    """The 8-job kitchen-sink batch workload: three init + create-api
+    chains over distinct output dirs, plus a vet and a test of the
+    heaviest tree.  FAST mode substitutes the standalone fixture for
+    every generation so quick iterations stay quick."""
+    fixtures = BENCH_FIXTURES if not FAST else (
+        "standalone", "standalone", "standalone"
+    )
+    specs = []
+    dirs = []
+    for i, fixture in enumerate(fixtures):
+        config = os.path.join(FIXTURES, fixture, "workload.yaml")
+        out = os.path.join(base, f"batch-{suffix}-{i}-{fixture}")
+        dirs.append(out)
+        specs.append({
+            "command": "init", "workload_config": config,
+            "output_dir": out, "repo": f"github.com/bench/{fixture}",
+        })
+        specs.append({
+            "command": "create-api", "workload_config": config,
+            "output_dir": out,
+        })
+    specs.append({"command": "vet", "path": dirs[-1]})
+    specs.append({"command": "test", "path": dirs[-1]})
+    return specs
+
+
+def _batch_signature(results, dirs, base: str) -> list:
+    """Comparable essence of a batch run: output-tree digests plus the
+    results with run-local noise (durations, the per-leg output paths)
+    normalized out."""
+    import re
+
+    dirs = sorted(dirs)
+
+    def norm(text: str) -> str:
+        for i, d in enumerate(dirs):
+            text = text.replace(d, f"<out{i}>")
+        text = text.replace(base, "<base>")
+        return re.sub(r"\d+\.\d+s", "<t>", text)
+
+    sig = [(i, tree_digest(d)) for i, d in enumerate(dirs)]
+    sig.extend(
+        (r.id, r.command, r.rc, norm(r.stdout), norm(r.stderr))
+        for r in results
+    )
+    return sig
+
+
+def batch_section(tmp: str) -> dict:
+    """The serving-layer benchmark (PR 3): an 8-job batch, cold-serial
+    (fresh dirs, empty caches, one thread) vs warm-batch (steady dirs,
+    primed caches, parallel workers) throughput in jobs/sec, plus the
+    serial == thread-parallel == process-pool byte-identity guard in
+    every cache mode."""
+    from operator_forge.perf import workers
+    from operator_forge.serve.batch import run_batch
+    from operator_forge.serve.jobs import jobs_from_specs
+
+    saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+
+    def set_jobs(value):
+        os.environ["OPERATOR_FORGE_JOBS"] = value
+
+    def run(specs):
+        results = run_batch(jobs_from_specs(specs, tmp))
+        bad = [(r.id, r.stderr) for r in results if not r.ok]
+        assert not bad, f"batch job failed: {bad}"
+        return results
+
+    cold_wall, warm_wall = [], []
+    n_batch_jobs = len(_batch_specs(tmp, "probe"))
+    try:
+        # cold-serial: fresh output dirs, empty caches, one worker —
+        # the one-shot-CLI-in-a-loop baseline the serve layer replaces
+        workers.set_backend("thread")
+        set_jobs("1")
+        spans.reset()
+        for i in range(BATCH_RUNS):
+            specs = _batch_specs(tmp, f"cold{i}")
+            pf_cache.reset()
+            start = time.perf_counter()
+            run(specs)
+            cold_wall.append(time.perf_counter() - start)
+        cold_stages = {
+            name: data for name, data in spans.snapshot().items()
+            if name.startswith("serve.")
+        }
+
+        # warm-batch: steady dirs primed to their fixed point, groups
+        # fanned out across the process pool with the DISK cache so
+        # every persistent worker shares the primed state (mem entries
+        # are per-process and would depend on scheduling)
+        warm_specs = _batch_specs(tmp, "warm")
+        workers.set_backend("process")
+        set_jobs("8")
+        pf_cache.configure(
+            mode="disk", root=os.path.join(tmp, "warmcache")
+        )
+        pf_cache.reset()
+        try:
+            for _ in range(3):  # reach the scaffold fixed point + record
+                run(warm_specs)
+            for _ in range(BATCH_RUNS):
+                start = time.perf_counter()
+                warm_results = run(warm_specs)
+                warm_wall.append(time.perf_counter() - start)
+        finally:
+            pf_cache.configure(mode="mem")
+        warm_cached = sum(1 for r in warm_results if r.cached)
+
+        # identity guard: serial, thread-parallel, and process-pool
+        # batches over fresh dirs must produce byte-identical output
+        # trees and normalized reports, with the cache in every mode
+        guards = {}
+        disk_root = tempfile.mkdtemp(prefix="operator-forge-batchcache-")
+        try:
+            for cache_mode in GUARD_MODES:
+                signatures = []
+                for leg, (backend, jobs) in enumerate((
+                    ("thread", "1"), ("thread", "8"), ("process", "8"),
+                )):
+                    pf_cache.configure(
+                        mode=cache_mode,
+                        root=os.path.join(
+                            disk_root, f"leg{leg}"
+                        ) if cache_mode == "disk" else None,
+                    )
+                    pf_cache.reset()
+                    workers.set_backend(backend)
+                    set_jobs(jobs)
+                    specs = _batch_specs(tmp, f"{cache_mode}-leg{leg}")
+                    dirs = sorted({
+                        s["output_dir"] for s in specs if "output_dir" in s
+                    })
+                    signatures.append(
+                        _batch_signature(run(specs), dirs, tmp)
+                    )
+                guards[cache_mode] = all(
+                    sig == signatures[0] for sig in signatures[1:]
+                )
+        finally:
+            pf_cache.configure(mode="mem")
+            shutil.rmtree(disk_root, ignore_errors=True)
+    finally:
+        workers.set_backend(None)
+        if saved_jobs is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+
+    cold_med = statistics.median(cold_wall)
+    warm_med = statistics.median(warm_wall)
+    return {
+        "jobs": n_batch_jobs,
+        "runs": BATCH_RUNS,
+        "fixtures": "standalone-only (FAST)" if FAST else "kitchen-sink",
+        "cold_serial_wall_s_median": round(cold_med, 4),
+        "warm_batch_wall_s_median": round(warm_med, 4),
+        "cold_serial_jobs_per_s": round(
+            n_batch_jobs / cold_med if cold_med > 0 else 0.0, 2
+        ),
+        "warm_batch_jobs_per_s": round(
+            n_batch_jobs / warm_med if warm_med > 0 else 0.0, 2
+        ),
+        "warm_speedup": round(
+            cold_med / warm_med if warm_med > 0 else 0.0, 2
+        ),
+        "warm_cached_jobs": warm_cached,
+        "identity_by_cache_mode": guards,
+        "stages_cold_serial": cold_stages,
+        "headline": "cold-serial = fresh dirs, empty caches, one "
+        "worker; warm-batch = steady dirs replayed through the shared "
+        "content cache on the OPERATOR_FORGE_WORKERS=process pool",
     }
 
 
@@ -349,6 +542,10 @@ def main() -> None:
         # kitchen-sink tree, cold vs warm, plus identity guards
         check = check_section(steady["kitchen-sink"])
 
+        # the serving layer: batch throughput cold-serial vs warm-batch,
+        # plus the serial/thread/process byte-identity guard
+        batch = batch_section(tmp)
+
         loc = sum(fixture_loc.values())
         summary = {
             phase: _phase_summary(cpu[phase], wall[phase], loc)
@@ -398,7 +595,9 @@ def main() -> None:
                 "generated_loc_per_run": loc,
                 "cache_mode": "mem",
                 "jobs": n_jobs(),
+                "fast_mode": FAST,
                 "check": check,
+                "batch": batch,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
                 "up to ~15% (host scheduling/steal), and the host itself "
@@ -424,6 +623,13 @@ def main() -> None:
                 "gocheck identity guard FAILED: compile/walk, "
                 "serial/parallel, or cached/uncached check reports "
                 "diverged",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not all(batch["identity_by_cache_mode"].values()):
+            print(
+                "batch identity guard FAILED: serial, thread-parallel, "
+                "and process-pool batches diverged",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -540,7 +540,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -557,12 +557,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -760,6 +760,27 @@ def cmd_test(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_batch(args: argparse.Namespace) -> int:
+    """`batch`: run a manifest of init/create-api/vet/test jobs through
+    the batch orchestrator (PR 3) — jobs over distinct directories fan
+    out across the OPERATOR_FORGE_WORKERS=thread|process backend, jobs
+    over one directory chain in manifest order, unchanged jobs replay
+    from the content cache, and results report in manifest order."""
+    from ..serve.batch import cmd_batch as run
+
+    return run(args.manifest, json_lines=args.json)
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """`serve`: keep one resident process hot and answer JSON-lines
+    requests on stdin (ping/job/batch/stats/shutdown), one JSON
+    response line each — warm caches and compiled interpreter bodies
+    persist across requests."""
+    from ..serve.server import serve_loop
+
+    return serve_loop()
+
+
 @functools.cache
 def build_parser() -> argparse.ArgumentParser:
     # cached: construction is ~4ms and the parser is safely reusable
@@ -953,10 +974,36 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_validate.set_defaults(func=cmd_validate)
 
+    p_batch = sub.add_parser(
+        "batch",
+        help="run a manifest of init/create-api/vet/test jobs "
+             "concurrently with cached-result replay",
+    )
+    p_batch.add_argument(
+        "--manifest", required=True,
+        help="YAML/JSON job manifest (see docs/no-toolchain-tools.md); "
+             "relative paths resolve against the manifest's directory",
+    )
+    p_batch.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON line per job result plus a summary line",
+    )
+    p_batch.set_defaults(func=cmd_batch)
+
+    p_serve = sub.add_parser(
+        "serve",
+        help="persistent JSON-lines request loop on stdin (warm caches "
+             "across requests)",
+    )
+    p_serve.set_defaults(func=cmd_serve)
+
     return parser
 
 
 def main(argv: list[str] | None = None) -> int:
+    # BatchManifestError never reaches here: cmd_batch and the serve
+    # loop both catch it at their own boundary, keeping the serve
+    # package out of the startup import path
     args = build_parser().parse_args(argv)
     try:
         with spans.span(f"command:{args.command}"):

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import os
 
+from ..perf import parallel_map
 from . import cache
 from .cache import project_index
 from .lint import semantics_of
@@ -37,7 +38,6 @@ def check_project(root: str) -> list[str]:
         if cached is not None:
             return cached
     errors: list[str] = []
-    checked = 0
     # index the project's own packages so qualified references between
     # them are checked closed, like the dependency manifest; the index
     # is content-cached on the project's file-hash set, so re-checking
@@ -46,30 +46,38 @@ def check_project(root: str) -> list[str]:
     manifest = MANIFEST
     if index.module is not None:
         manifest = index.merged_manifest(MANIFEST)
+    files: list[str] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
             # like Go tooling: only .go files not prefixed with '_' or '.'
             if not name.endswith(".go") or name.startswith(("_", ".")):
                 continue
-            path = os.path.join(dirpath, name)
-            checked += 1
-            try:
-                with open(path, encoding="utf-8") as fh:
-                    text = fh.read()
-            except (OSError, UnicodeDecodeError) as exc:
-                errors.append(f"{path}: unreadable: {exc}")
-                continue
-            try:
-                parsed = parse_source(text, path)
-            except (GoSyntaxError, GoTokenError) as exc:
-                errors.append(str(exc))
-                continue
-            except RecursionError:
-                errors.append(f"{path}: nesting too deep to parse")
-                continue
-            errors.extend(semantics_of(parsed, path))
-            errors.extend(types_of(parsed, text, path, manifest))
+            files.append(os.path.join(dirpath, name))
+    checked = len(files)
+
+    def check_file(path: str) -> list[str]:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            return [f"{path}: unreadable: {exc}"]
+        try:
+            parsed = parse_source(text, path)
+        except (GoSyntaxError, GoTokenError) as exc:
+            return [str(exc)]
+        except RecursionError:
+            return [f"{path}: nesting too deep to parse"]
+        out = list(semantics_of(parsed, path))
+        out.extend(types_of(parsed, text, path, manifest))
+        return out
+
+    # files are independent pure checks: fan them out across
+    # OPERATOR_FORGE_JOBS, collecting per-file error lists in input
+    # order so the report is identical to the serial loop (and to any
+    # process-pool batch leg wrapping this vet)
+    for file_errors in parallel_map(check_file, files):
+        errors.extend(file_errors)
     # package-level structural checks (imports, duplicate funcs,
     # unresolved qualifiers) — these tolerate unreadable files, so an
     # error in one package doesn't suppress findings in another

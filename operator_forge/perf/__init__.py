@@ -24,25 +24,48 @@ import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
-_pool = None
-_pool_size = 0
+_pools: dict = {}  # max_workers -> shared ThreadPoolExecutor
 _pool_lock = threading.Lock()
 
 
-def _executor(jobs: int) -> ThreadPoolExecutor:
-    """Process-shared worker pool, recreated only when the configured job
-    count changes — per-call pool construction costs more than the small
-    pipeline tasks it would run."""
-    global _pool, _pool_size
+def _forget_pools_after_fork() -> None:
+    # a forked child (perf.workers process backend) inherits the
+    # executor objects but not their threads; reusing one would hang
+    _pools.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_forget_pools_after_fork)
+
+
+def _shutdown_pools() -> None:
     with _pool_lock:
-        if _pool is None or _pool_size != jobs:
-            if _pool is not None:
-                _pool.shutdown(wait=False)
-            _pool = ThreadPoolExecutor(
+        for pool in _pools.values():
+            pool.shutdown(wait=False)
+        _pools.clear()
+
+
+import atexit  # noqa: E402
+
+atexit.register(_shutdown_pools)
+
+
+def _executor(jobs: int) -> ThreadPoolExecutor:
+    """Process-shared worker pool, one per worker count — per-call pool
+    construction costs more than the small pipeline tasks it would run.
+    Pools are never shut down mid-run: concurrent parallel_map callers
+    with different job counts (batch groups fanning out vet/test work
+    at once) must not tear down each other's executor, so each size
+    keeps its own pool until process exit.  The distinct sizes in play
+    are a handful (CPU count plus explicit OPERATOR_FORGE_JOBS values),
+    and idle threads are near-free."""
+    with _pool_lock:
+        pool = _pools.get(jobs)
+        if pool is None:
+            pool = _pools[jobs] = ThreadPoolExecutor(
                 max_workers=jobs, thread_name_prefix="operator-forge"
             )
-            _pool_size = jobs
-        return _pool
+        return pool
 
 
 def n_jobs() -> int:

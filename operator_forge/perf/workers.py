@@ -1,0 +1,367 @@
+"""Execution backends for batch/serve fan-out (PR 3).
+
+``OPERATOR_FORGE_WORKERS`` selects how independent job groups execute:
+
+- ``thread`` (default) — a dedicated fan-out thread pool.  Deliberately
+  NOT :data:`operator_forge.perf._pool`: group tasks themselves call
+  :func:`~operator_forge.perf.parallel_map` (per-manifest inspection,
+  per-file writes, per-package test runs), and submitting to the pool a
+  task is already running on can starve it.  Two pools keep the waits
+  acyclic.
+- ``process`` — a persistent ``ProcessPoolExecutor`` forked from this
+  process, so CPU-bound gocheck checking scales across cores instead of
+  serializing on the GIL.  The parent pre-warms the gocheck stdlib
+  manifest, symbol surfaces, and interpreter/compiler modules
+  immediately before forking, so every worker inherits the warm state
+  by copy-on-write; workers persist across calls, keeping their own
+  content-addressed caches hot for the lifetime of the pool.
+
+Results always collect in input order, so a successful ``process`` run
+is observably equivalent to ``thread`` and to the serial loop — batch
+byte-identity is proven by tests/test_serve_batch.py and enforced by
+bench.py's ``batch.identity_by_cache_mode`` guard.
+
+Worker coordination details:
+
+- **signed-blob results** — worker return values round-trip through the
+  same HMAC-signed pickle serialization the disk cache uses
+  (:mod:`operator_forge.perf.cache`): the worker seals
+  ``sign(key, pickle(value)) + pickle(value)`` and the parent verifies
+  before unpickling, so a corrupted or substituted result surfaces as
+  an authentication error instead of deserializing.
+- **config shipping** — forked workers snapshot the parent's state at
+  fork time only, so each task carries the parent's *current* cache
+  mode/root overrides, gocheck interpreter mode, relevant env knobs,
+  and cache-reset generation; the worker applies them before running.
+  A parent-side ``perf.cache.reset()`` therefore takes effect in every
+  worker at its next task.
+- **fork hygiene** — executors do not survive ``fork()`` (the child
+  inherits the object but not its threads), so an ``at_fork`` hook
+  drops all pool singletons in the child; in-worker fan-out is forced
+  back to ``thread`` to keep process trees flat.
+
+Infrastructure failures (fork unavailable, broken pool, unpicklable
+task) fall back to the thread backend; since every batch job is
+deterministic and idempotent this changes wall-clock, never output.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+from . import n_jobs
+from . import cache as pf_cache
+
+_BACKENDS = ("thread", "process")
+DEFAULT_BACKEND = "thread"
+
+_forced = None
+
+
+def backend() -> str:
+    """The selected backend: programmatic override, else
+    ``OPERATOR_FORGE_WORKERS``, else ``thread``."""
+    if _forced is not None:
+        return _forced
+    raw = os.environ.get("OPERATOR_FORGE_WORKERS", DEFAULT_BACKEND)
+    raw = raw.strip().lower()
+    return raw if raw in _BACKENDS else DEFAULT_BACKEND
+
+
+def set_backend(value=None) -> None:
+    """Programmatic override (``None`` restores env-driven selection)."""
+    global _forced
+    if value is not None and value not in _BACKENDS:
+        raise ValueError(
+            f"unknown workers backend {value!r}; known: {_BACKENDS}"
+        )
+    _forced = value
+
+
+# -- cache-reset propagation ---------------------------------------------
+#
+# Persistent workers keep their forked mem caches; a parent-side
+# pf_cache.reset() must reach them or identity legs could replay stale
+# state.  The parent bumps a generation on every reset and ships it with
+# each task; a worker seeing a new generation resets its own caches.
+
+_reset_gen = [0]
+
+
+def _bump_reset_gen() -> None:
+    _reset_gen[0] += 1
+
+
+pf_cache.get_cache().reset_hooks.append(_bump_reset_gen)
+
+_worker_seen_gen = [0]
+
+# env knobs a task's behavior may read; shipped per task because workers
+# fork once and would otherwise see stale values
+_SHIPPED_ENV = (
+    "OPERATOR_FORGE_CACHE",
+    "OPERATOR_FORGE_CACHE_DIR",
+    "OPERATOR_FORGE_JOBS",
+    "OPERATOR_FORGE_GOCHECK",
+    "OPERATOR_FORGE_PROFILE",
+)
+
+
+def _task_config() -> dict:
+    from ..gocheck import compiler
+
+    cache = pf_cache.get_cache()
+    return {
+        "cache_mode": cache._mode_override,
+        "cache_root": cache._root_override,
+        "gocheck_mode": compiler._forced,
+        "env": {k: os.environ.get(k) for k in _SHIPPED_ENV},
+        "gen": _reset_gen[0],
+    }
+
+
+def _apply_config(cfg: dict) -> None:
+    from ..gocheck import compiler
+
+    for key, value in cfg["env"].items():
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+    # in-worker fan-out must not fork grandchildren: pin the env knob
+    # AND drop any inherited set_backend() override (which would
+    # otherwise shadow the env)
+    os.environ["OPERATOR_FORGE_WORKERS"] = "thread"
+    set_backend("thread")
+    pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
+    compiler.set_mode(cfg["gocheck_mode"])
+    if cfg["gen"] != _worker_seen_gen[0]:
+        _worker_seen_gen[0] = cfg["gen"]
+        pf_cache.reset()
+
+
+# -- signed-blob result round trip ---------------------------------------
+
+
+def _seal(value) -> tuple:
+    blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+    key = pf_cache._load_hmac_key()
+    if key is None:  # no writable home: unauthenticated, flagged as such
+        return ("raw", blob)
+    return ("sealed", pf_cache._sign(key, blob) + blob)
+
+
+def _unseal(wrapped: tuple):
+    import hmac
+
+    kind, data = wrapped
+    if kind == "sealed":
+        key = pf_cache._load_hmac_key()
+        if key is None or len(data) <= pf_cache._SIG_BYTES:
+            raise RuntimeError("worker result failed authentication")
+        signature = data[: pf_cache._SIG_BYTES]
+        data = data[pf_cache._SIG_BYTES:]
+        if not hmac.compare_digest(signature, pf_cache._sign(key, data)):
+            raise RuntimeError("worker result failed authentication")
+    return pickle.loads(data)
+
+
+def _sealed_call(cfg: dict, fn, item) -> tuple:
+    """Worker-side task wrapper: apply the parent's shipped config,
+    run, seal the outcome.  Task exceptions are sealed as values (not
+    raised through the executor), so anything that DOES raise out of a
+    future is, by construction, an infrastructure failure."""
+    _apply_config(cfg)
+    try:
+        return _seal(("ok", fn(item)))
+    except BaseException as exc:
+        try:
+            return _seal(("err", exc))
+        except Exception:  # the exception itself didn't pickle
+            return _seal(("err", RuntimeError(
+                f"{type(exc).__name__}: {exc}"
+            )))
+
+
+class _TaskFailure(Exception):
+    """Parent-side wrapper distinguishing a task's own exception from
+    pool infrastructure errors; map_ordered unwraps and re-raises the
+    cause instead of falling back to threads."""
+
+    def __init__(self, cause):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+# -- pre-warm -------------------------------------------------------------
+
+
+def warm_gocheck() -> None:
+    """Load the gocheck surfaces every checking job needs — the stdlib
+    dependency manifest, the symbol surfaces the type layer consults,
+    and the parser/interpreter/compiler modules.  Called in the parent
+    immediately before the process pool forks, so workers inherit the
+    warm state by copy-on-write instead of each paying it again."""
+    from ..gocheck import compiler, interp, parser, world  # noqa: F401
+    from ..gocheck.manifest import MANIFEST  # noqa: F401  (assembles it)
+    from ..gocheck.stdmanifest import symbol_surface
+
+    for path in (
+        "fmt", "strings", "context", "errors", "time", "os",
+        "sigs.k8s.io/controller-runtime",
+        "k8s.io/apimachinery/pkg/apis/meta/v1/unstructured",
+    ):
+        symbol_surface(path)
+
+
+# -- the pools ------------------------------------------------------------
+
+_pool_lock = threading.Lock()
+_fan_pools: dict = {}  # max_workers -> shared fan-out ThreadPoolExecutor
+_proc_pool = None
+_proc_size = 0
+
+
+def _forget_pools_after_fork() -> None:
+    # a forked child inherits the executor objects but not their
+    # threads/processes; using one would hang forever
+    global _proc_pool, _proc_size
+    _fan_pools.clear()
+    _proc_pool = None
+    _proc_size = 0
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_forget_pools_after_fork)
+
+
+def _shutdown_pools() -> None:
+    # orderly teardown; letting interpreter finalization collect a live
+    # ProcessPoolExecutor prints spurious weakref tracebacks
+    global _proc_pool
+    with _pool_lock:
+        for pool in _fan_pools.values():
+            pool.shutdown(wait=False)
+        _fan_pools.clear()
+        if _proc_pool is not None:
+            _proc_pool.shutdown(wait=True)
+            _proc_pool = None
+
+
+import atexit  # noqa: E402
+
+atexit.register(_shutdown_pools)
+
+
+def _thread_pool(jobs: int):
+    """One fan-out pool per width, never shut down mid-run — like
+    perf._executor, concurrent callers with different widths must not
+    tear down each other's executor."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with _pool_lock:
+        pool = _fan_pools.get(jobs)
+        if pool is None:
+            pool = _fan_pools[jobs] = ThreadPoolExecutor(
+                max_workers=jobs, thread_name_prefix="operator-forge-fan"
+            )
+        return pool
+
+
+def _process_pool():
+    """The persistent worker-process pool, sized by ``n_jobs()`` (not
+    by any one call's item count, so varying batch shapes keep reusing
+    the same warm workers)."""
+    from concurrent.futures import ProcessPoolExecutor
+    import multiprocessing
+
+    global _proc_pool, _proc_size
+    jobs = n_jobs()
+    with _pool_lock:
+        if _proc_pool is None or _proc_size != jobs:
+            if _proc_pool is not None:
+                _proc_pool.shutdown(wait=False)
+            # fork (not spawn): workers inherit warm module/caches state
+            # and the loaded sys.modules task functions pickle against
+            ctx = multiprocessing.get_context("fork")
+            warm_gocheck()
+            _proc_pool = ProcessPoolExecutor(
+                max_workers=jobs, mp_context=ctx
+            )
+            _proc_size = jobs
+        return _proc_pool
+
+
+def _discard_process_pool() -> None:
+    global _proc_pool, _proc_size
+    with _pool_lock:
+        if _proc_pool is not None:
+            _proc_pool.shutdown(wait=False)
+        _proc_pool = None
+        _proc_size = 0
+
+
+def _infra_errors() -> tuple:
+    from concurrent.futures.process import BrokenProcessPool
+
+    # _sealed_call seals task exceptions as values, so anything raised
+    # out of a future is infrastructure: a dead pool, or a task/result
+    # that could not cross the pickle boundary at all.  Task-level
+    # exceptions surface as _TaskFailure and re-raise as themselves.
+    return (
+        BrokenProcessPool, pickle.PicklingError, AttributeError,
+        ImportError, EOFError, BrokenPipeError,
+    )
+
+
+def _thread_map(fn, items, jobs: int) -> list:
+    pool = _thread_pool(jobs)
+    futures = [pool.submit(fn, item) for item in items]
+    return [future.result() for future in futures]
+
+
+def _process_map(pool, fn, items) -> list:
+    cfg = _task_config()
+    futures = [pool.submit(_sealed_call, cfg, fn, item) for item in items]
+    out = []
+    for future in futures:
+        kind, payload = _unseal(future.result())
+        if kind == "err":
+            raise _TaskFailure(payload)
+        out.append(payload)
+    return out
+
+
+def map_ordered(fn, items) -> list:
+    """Ordered map over ``items`` through the selected backend.
+
+    ``fn`` must be a module-level callable and ``items`` picklable when
+    the ``process`` backend is active (they cross the fork boundary);
+    the ``thread``/serial paths have no such requirement.  One job (or
+    one item) short-circuits to the plain serial loop.
+    """
+    items = list(items)
+    jobs = min(n_jobs(), len(items))
+    if jobs <= 1 or len(items) <= 1:
+        return [fn(item) for item in items]
+    if backend() == "process":
+        try:
+            pool = _process_pool()
+        except Exception:
+            # fork unsupported or worker startup failed; nothing ran
+            # yet, so threads take the whole map
+            return _thread_map(fn, items, jobs)
+        try:
+            return _process_map(pool, fn, items)
+        except _TaskFailure as failure:
+            raise failure.cause  # the task's own error, verbatim
+        except _infra_errors():
+            # the pool died or the task didn't pickle: jobs are
+            # deterministic and idempotent, so re-running on threads
+            # yields the identical result, just without multicore
+            # scaling
+            _discard_process_pool()
+            return _thread_map(fn, items, jobs)
+    return _thread_map(fn, items, jobs)

@@ -1,0 +1,33 @@
+"""Batch orchestration and persistent serving (PR 3 tentpole).
+
+operator-forge was strictly one-shot: every ``init`` / ``create api`` /
+``vet`` / ``test`` invocation paid interpreter startup, re-primed the
+content-addressed caches from zero (or disk), and ran one project at a
+time on a GIL-bound thread pool.  This package amortizes the warmth PR 1
+(generation cache) and PR 2 (gocheck fast path) built across *many*
+requests and *many* cores:
+
+- :mod:`operator_forge.serve.jobs` — the job model: a manifest of N
+  init/create-api/vet/test requests over distinct output directories,
+  normalized to CLI argv vectors with deterministic ids;
+- :mod:`operator_forge.serve.runner` — executes one job in-process with
+  file-hash dirty-tracking through the shared
+  :class:`~operator_forge.perf.cache.ContentCache`: a job whose input
+  tree and output tree are unchanged replays its recorded result
+  without recomputing;
+- :mod:`operator_forge.serve.batch` — the orchestrator: groups jobs by
+  the directory they touch (chains like init → create-api → vet → test
+  over one project stay ordered), fans groups out through the
+  ``OPERATOR_FORGE_WORKERS=thread|process`` backend
+  (:mod:`operator_forge.perf.workers`), and reports results in
+  deterministic input order;
+- :mod:`operator_forge.serve.server` — ``operator-forge serve``: a
+  resident process reading JSON-lines requests from stdin, answering
+  one JSON line per request, with per-request spans feeding the
+  profiler and bench.py's ``batch`` section.
+
+Serial, thread-parallel, and process-pool execution produce
+byte-identical output trees in every cache mode
+(tests/test_serve_batch.py; bench.py's ``batch.identity_by_cache_mode``
+guard, enforced by scripts/commit-check.sh).
+"""

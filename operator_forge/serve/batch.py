@@ -1,0 +1,140 @@
+"""The batch orchestrator behind ``operator-forge batch``.
+
+Scheduling: jobs are grouped by read/write conflict over the
+directories they touch (nested dirs count as overlapping, so an init
+into ``out/`` can never race a vet of ``out/sub``, and a job reading a
+tree another job writes always serializes after it; shared read-only
+configs do NOT serialize).  Groups preserve manifest order internally
+— an ``init -> create-api -> vet -> test`` chain over one project runs
+in sequence — and independent groups fan out concurrently through the
+``OPERATOR_FORGE_WORKERS=thread|process`` backend
+(:mod:`operator_forge.perf.workers`).  Results are always reported in
+manifest order with deterministic content, so serial, thread, and
+process-pool batches are interchangeable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from ..perf import n_jobs, spans, workers
+from .jobs import BatchManifestError, Job, load_manifest  # noqa: F401
+from .runner import run_group
+
+
+def _overlaps(a: str, b: str) -> bool:
+    return a == b or a.startswith(b + os.sep) or b.startswith(a + os.sep)
+
+
+def _any_overlap(roots_a, roots_b) -> bool:
+    return any(_overlaps(a, b) for a in roots_a for b in roots_b)
+
+
+def plan_groups(jobs) -> list:
+    """Partition jobs into ordered execution groups by read/write
+    conflict: a job joins (and, bridging, merges) every group whose
+    WRITES overlap anything it touches, or whose reads overlap its own
+    writes — nested directories count as overlapping.  Jobs that merely
+    read a common tree (N projects generated from one config) stay in
+    independent groups and fan out; each group's jobs keep manifest
+    order."""
+    groups: list = []  # each: {"reads": [...], "writes": [...], "jobs": [...]}
+    for job in jobs:
+        reads, writes = job.reads(), job.writes()
+        touches = reads + writes
+        matches = [
+            g for g in groups
+            if _any_overlap(g["writes"], touches)
+            or _any_overlap(g["reads"], writes)
+        ]
+        if not matches:
+            groups.append({
+                "reads": list(reads), "writes": list(writes),
+                "jobs": [job],
+            })
+            continue
+        primary = matches[0]
+        for other in matches[1:]:
+            primary["jobs"].extend(other["jobs"])
+            primary["reads"].extend(other["reads"])
+            primary["writes"].extend(other["writes"])
+            groups.remove(other)
+        primary["jobs"].append(job)
+        primary["jobs"].sort(key=lambda j: j.index)
+        primary["reads"].extend(
+            r for r in reads if r not in primary["reads"]
+        )
+        primary["writes"].extend(
+            w for w in writes if w not in primary["writes"]
+        )
+    return [g["jobs"] for g in groups]
+
+
+def _run_group(group) -> list:
+    # module-level so the process backend can pickle it by reference
+    return run_group(group)
+
+
+def run_batch(jobs) -> list:
+    """Run every job; returns :class:`JobResult` objects in input
+    order regardless of how groups were scheduled."""
+    groups = plan_groups(jobs)
+    with spans.span("serve.batch"):
+        per_group = workers.map_ordered(_run_group, groups)
+    by_index = {
+        result.index: result
+        for results in per_group
+        for result in results
+    }
+    return [by_index[job.index] for job in jobs]
+
+
+def cmd_batch(manifest_path: str, json_lines: bool = False,
+              out=None) -> int:
+    out = out if out is not None else sys.stdout
+    try:
+        jobs = load_manifest(manifest_path)
+    except BatchManifestError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    started = time.perf_counter()
+    results = run_batch(jobs)
+    elapsed = time.perf_counter() - started
+    ok = sum(1 for r in results if r.ok)
+    cached = sum(1 for r in results if r.cached)
+    failed = len(results) - ok
+    summary = {
+        "jobs": len(results),
+        "ok": ok,
+        "cached": cached,
+        "failed": failed,
+        "seconds": round(elapsed, 4),
+        "backend": workers.backend(),
+        "parallelism": n_jobs(),
+    }
+    if json_lines:
+        for result in results:
+            print(json.dumps(result.to_dict()), file=out)
+        print(json.dumps({"summary": summary}), file=out)
+    else:
+        for result in results:
+            status = "ok  " if result.ok else "FAIL"
+            suffix = " (cached)" if result.cached else (
+                f" ({result.seconds:.2f}s)"
+            )
+            print(f"{status}  {result.id}  {result.command}{suffix}",
+                  file=out)
+            if not result.ok:
+                for line in result.stderr.rstrip().splitlines():
+                    print(f"      {line}", file=out)
+        print(
+            f"batch: {summary['jobs']} jobs, {ok} ok, {cached} cached, "
+            f"{failed} failed in {elapsed:.2f}s "
+            f"(backend={summary['backend']}, "
+            f"jobs={summary['parallelism']})",
+            file=out,
+        )
+    return 1 if failed else 0

@@ -1,0 +1,224 @@
+"""Job model for ``operator-forge batch`` and ``serve``.
+
+A *job* is one CLI-equivalent request — ``init``, ``create-api``,
+``vet``, or ``test`` — normalized from a manifest entry (or a serve
+request) into the argv vector :func:`operator_forge.cli.main.main`
+accepts.  Manifests are YAML (or JSON — a JSON document is valid YAML):
+
+.. code-block:: yaml
+
+    jobs:
+      - command: init
+        workload_config: configs/store/workload.yaml
+        output_dir: out/store
+        repo: github.com/acme/store
+      - command: create-api
+        workload_config: configs/store/workload.yaml
+        output_dir: out/store
+      - command: vet
+        path: out/store
+      - command: test
+        path: out/store
+        e2e: false
+
+Relative paths resolve against the manifest's directory (for serve
+requests: the server's working directory).  Job ids default to
+``job-<n>`` in input order and must be unique — results are reported
+by id, in input order, regardless of execution backend.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..utils import yamlcompat as pyyaml
+
+
+class BatchManifestError(Exception):
+    """Raised for a malformed batch manifest or job spec."""
+
+
+#: command name -> the spec keys it accepts beyond `command`/`id`
+COMMANDS = {
+    "init": ("workload_config", "output_dir", "repo"),
+    "create-api": ("workload_config", "output_dir"),
+    "vet": ("path",),
+    "test": ("path", "e2e", "run"),
+}
+
+_ALIASES = {"create api": "create-api", "create_api": "create-api"}
+
+
+@dataclass
+class Job:
+    """One normalized batch/serve job."""
+
+    index: int
+    id: str
+    command: str
+    workload_config: str = ""
+    output_dir: str = ""
+    path: str = ""
+    repo: str = ""
+    e2e: bool = False
+    run: str = ""
+
+    def target(self) -> str:
+        """The directory this job is 'about' — its output dir for
+        generation commands, its project path for checking commands."""
+        root = self.output_dir if self.command in (
+            "init", "create-api"
+        ) else self.path
+        return os.path.abspath(root)
+
+    def reads(self) -> tuple:
+        """Directories whose bytes this job's outcome depends on: the
+        whole config directory (manifests live beside the workload
+        config, referenced by globs) for generation, the project tree
+        for checking."""
+        if self.command in ("init", "create-api"):
+            return (
+                os.path.dirname(os.path.abspath(self.workload_config)),
+            )
+        return (os.path.abspath(self.path),)
+
+    def writes(self) -> tuple:
+        """Directories this job mutates (checking commands write
+        nothing)."""
+        if self.command in ("init", "create-api"):
+            return (os.path.abspath(self.output_dir),)
+        return ()
+
+    def argv(self) -> list:
+        if self.command == "init":
+            out = ["init", "--workload-config", self.workload_config,
+                   "--output-dir", self.output_dir]
+            if self.repo:
+                out += ["--repo", self.repo]
+            return out
+        if self.command == "create-api":
+            return ["create", "api", "--workload-config",
+                    self.workload_config, "--output-dir", self.output_dir]
+        if self.command == "vet":
+            return ["vet", self.path]
+        out = ["test", self.path]
+        if self.e2e:
+            out.append("--e2e")
+        if self.run:
+            out += ["--run", self.run]
+        return out
+
+
+@dataclass
+class JobResult:
+    """Outcome of one executed (or replayed) job."""
+
+    id: str
+    command: str
+    rc: int
+    stdout: str
+    stderr: str
+    seconds: float
+    cached: bool = False
+    index: int = field(default=-1, compare=False)
+
+    @property
+    def ok(self) -> bool:
+        return self.rc == 0
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "command": self.command,
+            "ok": self.ok,
+            "rc": self.rc,
+            "stdout": self.stdout,
+            "stderr": self.stderr,
+            "seconds": round(self.seconds, 4),
+            "cached": self.cached,
+        }
+
+
+def _resolve(base_dir: str, value: str) -> str:
+    if not value or os.path.isabs(value):
+        return value
+    return os.path.normpath(os.path.join(base_dir, value))
+
+
+def jobs_from_specs(specs, base_dir: str) -> list:
+    """Normalize a list of spec mappings into :class:`Job` objects,
+    validating commands, required fields, and id uniqueness."""
+    if not isinstance(specs, (list, tuple)) or not specs:
+        raise BatchManifestError("manifest contains no jobs")
+    jobs = []
+    seen_ids: set = set()
+    for i, spec in enumerate(specs):
+        label = f"job {i + 1}"
+        if not isinstance(spec, dict):
+            raise BatchManifestError(f"{label}: expected a mapping")
+        raw_cmd = str(spec.get("command", "")).strip()
+        command = _ALIASES.get(raw_cmd, raw_cmd)
+        if command not in COMMANDS:
+            raise BatchManifestError(
+                f"{label}: unknown command {raw_cmd!r}; known: "
+                + ", ".join(sorted(COMMANDS))
+            )
+        allowed = COMMANDS[command] + ("command", "id")
+        unknown = sorted(set(spec) - set(allowed))
+        if unknown:
+            raise BatchManifestError(
+                f"{label} ({command}): unknown keys {unknown}; "
+                f"allowed: {sorted(allowed)}"
+            )
+        job_id = str(spec.get("id") or f"job-{i + 1}")
+        if job_id in seen_ids:
+            raise BatchManifestError(f"duplicate job id {job_id!r}")
+        seen_ids.add(job_id)
+        job = Job(
+            index=i,
+            id=job_id,
+            command=command,
+            workload_config=_resolve(
+                base_dir, str(spec.get("workload_config", ""))
+            ),
+            output_dir=_resolve(base_dir, str(spec.get("output_dir", ""))),
+            path=_resolve(base_dir, str(spec.get("path", ""))),
+            repo=str(spec.get("repo", "")),
+            e2e=bool(spec.get("e2e", False)),
+            run=str(spec.get("run", "")),
+        )
+        if command in ("init", "create-api"):
+            if not job.workload_config or not job.output_dir:
+                raise BatchManifestError(
+                    f"{label} ({command}): workload_config and "
+                    "output_dir are required"
+                )
+        elif not job.path:
+            raise BatchManifestError(
+                f"{label} ({command}): path is required"
+            )
+        jobs.append(job)
+    return jobs
+
+
+def load_manifest(path: str) -> list:
+    """Parse a manifest file into validated jobs (paths resolved
+    against the manifest's directory)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            data = pyyaml.safe_load(handle.read())
+    except OSError as exc:
+        raise BatchManifestError(f"cannot read manifest: {exc}") from exc
+    except pyyaml.YAMLError as exc:
+        raise BatchManifestError(f"invalid manifest YAML: {exc}") from exc
+    if isinstance(data, dict):
+        specs = data.get("jobs")
+    else:
+        specs = data
+    if not isinstance(specs, list):
+        raise BatchManifestError(
+            "manifest must be a list of jobs or a mapping with a "
+            "'jobs' list"
+        )
+    return jobs_from_specs(specs, os.path.dirname(os.path.abspath(path)))

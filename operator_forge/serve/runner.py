@@ -1,0 +1,265 @@
+"""Execute one batch/serve job in-process, with dirty-tracked replay.
+
+A job's observable effect — its output tree mutation plus its
+stdout/stderr/exit code — is a deterministic function of (a) the input
+tree it reads (the workload-config directory for generation commands,
+the project tree for checking commands) and (b) the output tree it
+writes into.  Both are snapshotted as file-hash sets through the shared
+:class:`~operator_forge.perf.cache.ContentCache` and folded into the
+job's content key, so an unchanged re-submission replays the recorded
+result without executing anything; any drifted byte produces a
+different key and falls back to a live run.
+
+Replay is only ever recorded for *fixed-point* executions — runs that
+left the output tree exactly as they found it (checking commands
+trivially; generation commands once the project has converged, which
+takes the usual two generations while the scaffold picks up its own
+boilerplate).  Skipping a fixed-point job is indistinguishable from
+re-running it, so cached and live batches stay byte-identical — the
+property tests/test_serve_batch.py and bench.py's batch identity guard
+enforce.
+
+Two granularities, because an ``init`` re-run over a *finished* project
+is deliberately not idempotent (it restores init's minimal ``main.go``,
+which the following ``create api`` overwrites with the full one):
+
+- :func:`run_job` — per-job replay, engages for vet/test always and for
+  generation jobs whose project has converged under that command alone;
+- :func:`run_group` — whole-chain replay for a scheduling group: the
+  ``init -> create api -> vet -> test`` cycle maps a steady tree onto
+  itself even though its members individually do not, so an unchanged
+  group replays as a unit and a dirty-tracked re-batch recomputes only
+  the touched group.
+
+Modes follow ``OPERATOR_FORGE_CACHE``: ``off`` always executes, ``mem``
+replays within one process (the serve loop's warm path), ``disk``
+replays across processes through the HMAC-signed store (how persistent
+process-pool workers share a primed batch).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import threading
+import time
+
+from .. import __version__
+from ..perf import cache as pf_cache
+from ..perf import spans
+from .jobs import Job, JobResult
+
+_STAGE = "serve.job"
+_SCHEMA = 1
+
+
+class _ThreadRouter(io.TextIOBase):
+    """A stdout/stderr stand-in that routes writes to the calling
+    thread's capture buffer, falling back to the real stream.
+    ``contextlib.redirect_stdout`` swaps the *process-wide*
+    ``sys.stdout``, so concurrent group threads would capture each
+    other's output; this keeps captures per-thread."""
+
+    def __init__(self, fallback):
+        self.fallback = fallback
+        self.local = threading.local()
+
+    def _target(self):
+        return getattr(self.local, "target", None) or self.fallback
+
+    def write(self, s) -> int:
+        return self._target().write(s)
+
+    def flush(self) -> None:
+        self._target().flush()
+
+    def writable(self) -> bool:
+        return True
+
+
+_capture_lock = threading.Lock()
+_capture_depth = 0
+_router_out = None
+_router_err = None
+
+
+@contextlib.contextmanager
+def _captured():
+    """Capture this thread's stdout/stderr into fresh buffers.  The
+    first active capture installs the routers; the last restores the
+    original streams, so the process looks untouched outside a batch."""
+    global _capture_depth, _router_out, _router_err
+    with _capture_lock:
+        if _capture_depth == 0:
+            _router_out = _ThreadRouter(sys.stdout)
+            _router_err = _ThreadRouter(sys.stderr)
+            sys.stdout, sys.stderr = _router_out, _router_err
+        _capture_depth += 1
+        router_out, router_err = _router_out, _router_err
+    out_buf, err_buf = io.StringIO(), io.StringIO()
+    router_out.local.target = out_buf
+    router_err.local.target = err_buf
+    try:
+        yield out_buf, err_buf
+    finally:
+        router_out.local.target = None
+        router_err.local.target = None
+        with _capture_lock:
+            _capture_depth -= 1
+            if _capture_depth == 0:
+                sys.stdout = router_out.fallback
+                sys.stderr = router_err.fallback
+
+
+def _dep_roots(job: Job) -> tuple:
+    return job.reads()
+
+
+def _out_root(job: Job):
+    writes = job.writes()
+    return writes[0] if writes else None
+
+
+def _tree_state(root: str) -> tuple:
+    from ..gocheck.cache import tree_state
+
+    if not os.path.isdir(root):
+        return ("<missing>",)
+    return tree_state(root)
+
+
+def _job_key(job: Job, pre_deps: tuple, pre_out: tuple) -> str:
+    from ..gocheck import compiler
+
+    return pf_cache.hash_parts(
+        _SCHEMA, __version__, _STAGE, tuple(job.argv()),
+        compiler.mode() if job.command == "test" else "",
+        pre_deps, pre_out,
+    )
+
+
+def run_job(job: Job) -> JobResult:
+    """Run (or replay) one job; never raises — failures come back as a
+    nonzero-rc :class:`~operator_forge.serve.jobs.JobResult`."""
+    from ..cli.main import main as cli_main
+
+    cache = pf_cache.get_cache()
+    key = None
+    pre_out: tuple = ()
+    if cache.mode() != "off":
+        with spans.span("serve.state"):
+            pre_deps = tuple(
+                (root, _tree_state(root)) for root in _dep_roots(job)
+            )
+            out_root = _out_root(job)
+            pre_out = _tree_state(out_root) if out_root else ()
+            key = _job_key(job, pre_deps, pre_out)
+        hit = cache.get(_STAGE, key)
+        if hit is not pf_cache.MISS:
+            rc, stdout, stderr = hit
+            return JobResult(
+                id=job.id, command=job.command, rc=rc, stdout=stdout,
+                stderr=stderr, seconds=0.0, cached=True, index=job.index,
+            )
+
+    started = time.perf_counter()
+    with spans.span(f"serve.job:{job.command}"), _captured() as (
+        out_buf, err_buf
+    ):
+        try:
+            rc = cli_main(job.argv())
+        except SystemExit as exc:  # argparse rejection of a bad spec
+            code = exc.code
+            rc = code if isinstance(code, int) else (0 if code is None else 1)
+        except Exception as exc:  # one job must never take down a batch
+            err_buf.write(f"internal error: {exc}\n")
+            rc = 1
+    result = JobResult(
+        id=job.id, command=job.command, rc=rc,
+        stdout=out_buf.getvalue(), stderr=err_buf.getvalue(),
+        seconds=time.perf_counter() - started, index=job.index,
+    )
+    if key is not None and rc == 0:
+        out_root = _out_root(job)
+        post_out = _tree_state(out_root) if out_root else ()
+        if post_out == pre_out:
+            # fixed point: replaying (skipping) this job later is
+            # indistinguishable from re-running it on the same bytes
+            cache.put(_STAGE, key, (rc, result.stdout, result.stderr))
+    return result
+
+
+_GROUP_STAGE = "serve.group"
+
+
+def _group_roots(group) -> tuple:
+    """(input roots, written roots) of a whole group; a vet/test path
+    lands among the inputs, a generated dir among the outputs (both,
+    when a chain vets its own output — the duplicate snapshot is
+    harmless)."""
+    dep_roots: list = []
+    out_roots: list = []
+    for job in group:
+        for root in _dep_roots(job):
+            if root not in dep_roots:
+                dep_roots.append(root)
+        out_root = _out_root(job)
+        if out_root is not None and out_root not in out_roots:
+            out_roots.append(out_root)
+    return tuple(dep_roots), tuple(out_roots)
+
+
+def run_group(group) -> list:
+    """Run one scheduling group (jobs over one directory, in manifest
+    order), replaying the whole chain when nothing it reads or writes
+    has changed since a recorded fixed-point run."""
+    cache = pf_cache.get_cache()
+    key = None
+    pre_out: tuple = ()
+    if len(group) > 1 and cache.mode() != "off":
+        from ..gocheck import compiler
+
+        dep_roots, out_roots = _group_roots(group)
+        with spans.span("serve.state"):
+            pre_deps = tuple(
+                (root, _tree_state(root)) for root in dep_roots
+            )
+            pre_out = tuple(
+                (root, _tree_state(root)) for root in out_roots
+            )
+            key = pf_cache.hash_parts(
+                _SCHEMA, __version__, _GROUP_STAGE,
+                tuple(tuple(job.argv()) for job in group),
+                compiler.mode()
+                if any(job.command == "test" for job in group) else "",
+                pre_deps, pre_out,
+            )
+        hit = cache.get(_GROUP_STAGE, key)
+        if hit is not pf_cache.MISS:
+            return [
+                JobResult(
+                    id=job.id, command=job.command, rc=rc,
+                    stdout=stdout, stderr=stderr, seconds=0.0,
+                    cached=True, index=job.index,
+                )
+                for job, (rc, stdout, stderr) in zip(group, hit)
+            ]
+
+    results = [run_job(job) for job in group]
+
+    if key is not None and all(result.rc == 0 for result in results):
+        _, out_roots = _group_roots(group)
+        post_out = tuple(
+            (root, _tree_state(root)) for root in out_roots
+        )
+        if post_out == pre_out:
+            # the chain is at its collective fixed point (e.g. init
+            # restored the minimal main.go and create-api re-completed
+            # it): skipping the whole group later reproduces this state
+            cache.put(
+                _GROUP_STAGE, key,
+                [(r.rc, r.stdout, r.stderr) for r in results],
+            )
+    return results

@@ -1,0 +1,140 @@
+"""``operator-forge serve`` — a persistent request loop.
+
+Keeps one resident process hot: the argument parser, the gocheck stdlib
+manifest, the closure-compiled interpreter bodies, and every
+content-addressed cache survive across requests, so request N+1 starts
+where a one-shot CLI invocation would have to re-prime from zero.
+
+Protocol: one JSON object per stdin line, one JSON response per stdout
+line (always exactly one, flushed; job/batch stdout is captured into
+the response, never interleaved with the protocol stream):
+
+- ``{"op": "ping"}`` — liveness + version;
+- ``{"op": "job", "job": {<job spec>}}`` (or the spec inlined with a
+  ``command`` key) — run one init/create-api/vet/test job;
+- ``{"op": "batch", "jobs": [<specs...>]}`` — run a batch through the
+  orchestrator (grouped, fanned out, input-order results);
+- ``{"op": "stats"}`` — cache hit/miss counters and the span table the
+  per-request ``serve:*`` spans feed;
+- ``{"op": "shutdown"}`` — acknowledge and exit 0 (EOF does the same).
+
+Malformed lines answer ``{"ok": false, "error": ...}`` and the loop
+continues; a request's ``id`` is echoed in its response so pipelined
+clients can correlate.  Relative job paths resolve against the server's
+working directory.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from .. import __version__
+from ..perf import cache as pf_cache
+from ..perf import spans
+from .batch import run_batch
+from .jobs import BatchManifestError, jobs_from_specs
+from .runner import run_job
+
+
+def _error(message: str, req_id=None) -> dict:
+    out = {"ok": False, "error": message}
+    if req_id is not None:
+        out["id"] = req_id
+    return out
+
+
+def _handle(req: dict, base_dir: str) -> tuple:
+    """Dispatch one request; returns (response dict, keep_going)."""
+    op = req.get("op") or ("job" if "command" in req else None)
+    req_id = req.get("id")
+    if op == "ping":
+        return ({"ok": True, "op": "ping", "version": __version__}, True)
+    if op == "shutdown":
+        return ({"ok": True, "op": "shutdown"}, False)
+    if op == "stats":
+        return (
+            {"ok": True, "op": "stats", "cache": pf_cache.stats(),
+             "spans": spans.snapshot()},
+            True,
+        )
+    if op == "job":
+        spec = req.get("job") if "job" in req else {
+            k: v for k, v in req.items() if k not in ("op",)
+        }
+        jobs = jobs_from_specs([spec], base_dir)
+        result = run_job(jobs[0]).to_dict()
+        result["op"] = "job"
+        return (result, True)
+    if op == "batch":
+        specs = req.get("jobs")
+        jobs = jobs_from_specs(specs, base_dir)
+        started = time.perf_counter()
+        results = run_batch(jobs)
+        return (
+            {
+                "ok": all(r.ok for r in results),
+                "op": "batch",
+                "results": [r.to_dict() for r in results],
+                "cached": sum(1 for r in results if r.cached),
+                "seconds": round(time.perf_counter() - started, 4),
+            },
+            True,
+        )
+    return (_error(f"unknown op {op!r}", req_id), True)
+
+
+def serve_loop(in_stream=None, out_stream=None) -> int:
+    """Serve requests until shutdown/EOF.  Streams default to
+    stdin/stdout (the ``operator-forge serve`` entry point)."""
+    import os
+
+    in_stream = in_stream if in_stream is not None else sys.stdin
+    out_stream = out_stream if out_stream is not None else sys.stdout
+    base_dir = os.getcwd()
+    # per-request spans are part of the protocol (the `stats` op reports
+    # them), so collection is on for the loop's lifetime regardless of
+    # OPERATOR_FORGE_PROFILE
+    spans.enable(True)
+
+    def respond(payload: dict) -> None:
+        out_stream.write(json.dumps(payload) + "\n")
+        out_stream.flush()
+
+    try:
+        for line in in_stream:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as exc:
+                respond(_error(f"invalid JSON: {exc}"))
+                continue
+            if not isinstance(req, dict):
+                respond(_error("request must be a JSON object"))
+                continue
+            op = req.get("op") or ("job" if "command" in req else "?")
+            started = time.perf_counter()
+            try:
+                with spans.span(f"serve:{op}"):
+                    response, keep_going = _handle(req, base_dir)
+            except BatchManifestError as exc:
+                respond(_error(str(exc), req.get("id")))
+                continue
+            except Exception as exc:  # bad request must not kill the loop
+                respond(_error(f"internal error: {exc}", req.get("id")))
+                continue
+            if req.get("id") is not None:
+                # the request id wins over a job spec's defaulted id
+                response["id"] = req.get("id")
+            response.setdefault(
+                "seconds", round(time.perf_counter() - started, 4)
+            )
+            respond(response)
+            if not keep_going:
+                return 0
+        return 0
+    finally:
+        spans.use_env()

@@ -18,7 +18,9 @@ carries the expanded form; the data is identical.
 
 from __future__ import annotations
 
+import pickle
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional
 
 import yaml
@@ -244,9 +246,30 @@ class _TreeBuilder:
 
 
 def load_documents(text: str) -> list[Document]:
-    """Parse ``text`` (possibly multi-document) into :class:`Document` trees
-    with comments attached."""
+    """Parse ``text`` (possibly multi-document) into :class:`Document`
+    trees with comments attached.
+
+    Content-cached: a batch re-parses the same manifest text once per
+    project, and this was the last uncached parse hot-spot — the parsed
+    tree is memoized per source content (LRU) as a pickled blob, and
+    every call deserializes a fresh copy, so callers may freely mutate
+    the returned documents (the marker transform does) without
+    corrupting the cache.  Parse failures raise and are never cached."""
     text = text.replace("\r\n", "\n")
+    return pickle.loads(_parsed_blob(text))
+
+
+@lru_cache(maxsize=256)
+def _parsed_blob(text: str) -> bytes:
+    """Pickled parse result keyed on the (normalized) source content —
+    the key IS the content, so this is content-hash addressing with the
+    hashing delegated to the cache's own key lookup."""
+    return pickle.dumps(
+        _load_documents_uncached(text), protocol=pickle.HIGHEST_PROTOCOL
+    )
+
+
+def _load_documents_uncached(text: str) -> list[Document]:
     builder = _TreeBuilder()
 
     # libyaml's C parser emits the same events/marks ~10x faster; the

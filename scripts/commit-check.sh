@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Conventional-commit check for the latest commit (reference:
 # test/scripts/commit-check-latest.sh — same contract, fresh implementation),
-# plus the perf contract of the incremental generation engine (PR 1).
+# plus the perf contract of the incremental generation engine (PR 1),
+# the gocheck fast-path determinism bar (PR 2), and the batch/serve
+# determinism + throughput bar (PR 3).
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -72,6 +74,31 @@ print(
         check["warm_cpu_s_median"],
         check["warm_speedup"],
         len(check["identity_by_cache_mode"]),
+    )
+)
+
+# batch determinism (PR 3): serial, thread-parallel, and process-pool
+# batches must produce byte-identical output trees (and normalized
+# reports) in every cache mode, and the warm batch must clear the 3x
+# throughput bar over the cold-serial baseline.
+batch = detail["batch"]
+assert batch["jobs"] == 8, "batch workload is not the 8-job contract"
+for cache_mode, ok in batch["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"batch serial/thread/process tree diff non-empty "
+        f"(cache={cache_mode})"
+    )
+assert batch["warm_speedup"] >= 3, (
+    "warm batch below the 3x throughput bar: %.2f" % batch["warm_speedup"]
+)
+print(
+    "batch contract OK: cold-serial=%.2f warm-batch=%.2f jobs/s "
+    "(x%.1f), process-pool identity clean in %d cache modes"
+    % (
+        batch["cold_serial_jobs_per_s"],
+        batch["warm_batch_jobs_per_s"],
+        batch["warm_speedup"],
+        len(batch["identity_by_cache_mode"]),
     )
 )
 PYEOF

@@ -36,17 +36,19 @@ def _fresh_perf_state():
     between tests: correctness must never depend on what an earlier test
     happened to cache, and perf tests configure modes explicitly."""
     from operator_forge.perf import cache as perfcache
-    from operator_forge.perf import spans
+    from operator_forge.perf import spans, workers
 
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
     spans.reset()
+    workers.set_backend(None)
     yield
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
     spans.reset()
+    workers.set_backend(None)
 
 
 def list_samples(project: str, full_only: bool = False) -> list[str]:

@@ -66,10 +66,14 @@ def survivor_key(mutant) -> tuple:
 
 def scaffold_standalone(root: str) -> str:
     """init + create api the standalone fixture into root/proj; the one
-    scaffold recipe shared by the harness test and the report script."""
+    scaffold recipe shared by the harness test and the report script.
+    Runs in-process (PR 3): two subprocess interpreter startups were a
+    measurable slice of the fixture's 15s setup."""
+    import contextlib
+    import io
     import shutil
-    import subprocess
-    import sys
+
+    from operator_forge.cli.main import main as cli_main
 
     fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
     proj = os.path.join(root, "proj")
@@ -77,15 +81,13 @@ def scaffold_standalone(root: str) -> str:
     for name in os.listdir(os.path.join(fixtures, "standalone")):
         shutil.copy(os.path.join(fixtures, "standalone", name), proj)
     config = os.path.join(proj, "workload.yaml")
-    base = [sys.executable, "-m", "operator_forge"]
     for sub in (["init", "--repo", "github.com/acme/bookstore"],
                 ["create", "api"]):
-        subprocess.run(
-            base + sub + ["--workload-config", config,
-                          "--output-dir", proj],
-            check=True, capture_output=True,
-            cwd=os.path.dirname(os.path.dirname(__file__)),
-        )
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = cli_main(
+                sub + ["--workload-config", config, "--output-dir", proj]
+            )
+        assert rc == 0, f"scaffold step {sub[0]} failed"
     return proj
 
 
@@ -917,34 +919,130 @@ def _target_files(proj: str, rel: str) -> list[str]:
     ]
 
 
+_BASELINE_FNS = {
+    "orchestrate": lambda proj: orchestrate_fingerprint(
+        os.path.join(proj, ORCHESTRATE_DIR)
+    ),
+    "resources": resources_fingerprint,
+    "project": project_fingerprint,
+    "companion": companion_fingerprint,
+    "main": main_fingerprint,
+}
+
+#: the baselines each target's verdict consults (_verdict's fall-through)
+_BASELINES_NEEDED = {
+    ORCHESTRATE_DIR: ("orchestrate", "project"),
+    RESOURCES_DIR: ("resources", "project"),
+    CONTROLLER_DIR: ("project",),
+    CMD_DIR: ("companion",),
+    MAIN_TARGET: ("main",),
+}
+
+
+def _baselines_for(proj: str, names) -> dict:
+    return {name: _BASELINE_FNS[name](proj) for name in names}
+
+
+# mutants per parallel work unit: pkg/orchestrate alone carries ~170
+# mutants (two thirds of the battery's wall time), so the unit must be
+# a mutant slice, not a target, for the fan-out to balance
+_CHUNK = 24
+
+# per-thread (and, under the process backend, per-worker) battery
+# state: one private tree copy per battery root plus the baselines
+# computed against it — fingerprints embed paths, so mutant runs must
+# compare against the same root they execute in.  Copies live under a
+# PARENT-owned scratch root (forked pool workers exit via os._exit,
+# which skips their atexit handlers, so worker-side cleanup would leak
+# a project tree per worker per run); run_battery removes the root
+# once the fan-out returns.
+import threading
+
+_battery_local = threading.local()
+
+
+def _chunk_state(root: str, src: str, target: str) -> tuple:
+    import shutil
+    import tempfile
+
+    cache = getattr(_battery_local, "state", None)
+    if cache is None:
+        cache = _battery_local.state = {"projects": {}, "baselines": {}}
+    proj = cache["projects"].get(root)
+    if proj is None:
+        workdir = tempfile.mkdtemp(dir=root)
+        proj = os.path.join(workdir, "proj")
+        shutil.copytree(src, proj)
+        cache["projects"][root] = proj
+    baselines = cache["baselines"].get((root, target))
+    if baselines is None:
+        baselines = _baselines_for(proj, _BASELINES_NEEDED[target])
+        cache["baselines"][(root, target)] = baselines
+    return proj, baselines
+
+
+def _battery_chunk(args) -> list:
+    """One slice of one file's mutants, against this worker's private
+    tree copy — the parallel unit of :func:`run_battery`.  The slice
+    re-derives its mutants from the copy (mutants_of is deterministic
+    tokenization), so only indices cross the worker boundary."""
+    root, src, target, rel, start, stop = args
+    proj, baselines = _chunk_state(root, src, target)
+    path = os.path.join(proj, rel)
+    with open(path, encoding="utf-8") as fh:
+        original = fh.read()
+    entries = []
+    for mutant in mutants_of(original, rel)[start:stop]:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(mutant.text)
+        try:
+            killed_by = _verdict(proj, target, baselines)
+        finally:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(original)
+        entries.append((mutant, killed_by))
+    return entries
+
+
 def run_battery(proj: str):
-    """Mutate every target file of the scaffolded project at *proj*
-    (in place, restoring after each mutant); returns a dict mapping
-    target-rel-dir to a list of (mutant, killed_by or None)."""
-    baselines = {
-        "orchestrate": orchestrate_fingerprint(
-            os.path.join(proj, ORCHESTRATE_DIR)),
-        "resources": resources_fingerprint(proj),
-        "project": project_fingerprint(proj),
-        "companion": companion_fingerprint(proj),
-        "main": main_fingerprint(proj),
-    }
-    results: dict[str, list] = {t: [] for t in TARGETS}
-    for target in TARGETS:
-        for rel in _target_files(proj, target):
-            path = os.path.join(proj, rel)
-            with open(path, encoding="utf-8") as fh:
-                original = fh.read()
-            for mutant in mutants_of(original, rel):
-                with open(path, "w", encoding="utf-8") as fh:
-                    fh.write(mutant.text)
-                try:
-                    killed_by = _verdict(proj, target, baselines)
-                finally:
-                    with open(path, "w", encoding="utf-8") as fh:
-                        fh.write(original)
-                results[target].append((mutant, killed_by))
-    return results
+    """Mutate every target file of the scaffolded project (each worker
+    against its private tree copy, restoring after each mutant);
+    returns a dict mapping target-rel-dir to a list of (mutant,
+    killed_by or None).
+
+    Mutant slices fan out through the ``OPERATOR_FORGE_WORKERS``
+    backend; gocheck interpretation is CPU-bound pure Python, so the
+    ``process`` backend is what actually buys multicore scaling.
+    ``map_ordered`` degrades to a plain serial loop under
+    ``OPERATOR_FORGE_JOBS=1``, and entry order per target is the same
+    at any width."""
+    import shutil
+    import tempfile
+
+    from operator_forge.perf import workers
+
+    root = tempfile.mkdtemp(prefix="operator-forge-mutants-")
+    try:
+        units = []
+        for target in TARGETS:
+            for rel in _target_files(proj, target):
+                with open(os.path.join(proj, rel),
+                          encoding="utf-8") as fh:
+                    total = len(mutants_of(fh.read(), rel))
+                for start in range(0, total, _CHUNK):
+                    units.append(
+                        (root, proj, target, rel, start,
+                         min(start + _CHUNK, total))
+                    )
+        per_unit = workers.map_ordered(_battery_chunk, units)
+        results: dict[str, list] = {t: [] for t in TARGETS}
+        for (_root, _src, target, _rel, _start, _stop), entries in zip(
+            units, per_unit
+        ):
+            results[target].extend(entries)
+        return results
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _verdict(proj: str, target: str, baselines) -> str | None:

@@ -966,6 +966,10 @@ class TestLocalIndex:
         )
         assert check_types(src) == []
 
+    @pytest.mark.skipif(
+        not os.path.isdir(REFERENCE),
+        reason="reference checkout not mounted",
+    )
     def test_reference_corpus_clean(self):
         from operator_forge.gocheck.localindex import (
             ProjectIndex, check_local_calls,

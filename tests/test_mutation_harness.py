@@ -21,16 +21,26 @@ import pytest
 
 import mutation_oracle as oracle
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="session")
 def project(tmp_path_factory):
+    # session-scoped (PR 3): the scaffold + battery are the suite's
+    # second-slowest setup; one computation serves every consumer
     return oracle.scaffold_standalone(
         str(tmp_path_factory.mktemp("mutation"))
     )
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="session")
 def battery(project):
-    return oracle.run_battery(project)
+    from operator_forge.perf import workers
+
+    # the battery is CPU-bound pure Python: fan targets across the
+    # process pool so the GIL stops serializing the fingerprints
+    workers.set_backend("process")
+    try:
+        return oracle.run_battery(project)
+    finally:
+        workers.set_backend(None)
 
 
 class TestMutationKillRates:

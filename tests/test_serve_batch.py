@@ -1,0 +1,358 @@
+"""Batch orchestrator + serve loop determinism (PR 3 acceptance).
+
+The serving layer may only ever change HOW jobs execute, never WHAT
+they produce: batches must emit byte-identical output trees across
+``OPERATOR_FORGE_WORKERS=thread|process``, ``OPERATOR_FORGE_JOBS=1``
+vs ``8``, and every ``OPERATOR_FORGE_CACHE`` mode; a dirty-tracked
+re-batch must recompute only the touched group.
+"""
+
+import io
+import json
+import os
+import shutil
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import workers
+from operator_forge.serve.batch import plan_groups, run_batch
+from operator_forge.serve.jobs import (
+    BatchManifestError,
+    jobs_from_specs,
+    load_manifest,
+)
+from operator_forge.serve.server import serve_loop
+
+from test_perf_cache import FIXTURES, assert_identical_trees
+
+
+def _config_copy(base: str, name: str) -> str:
+    """A private copy of the standalone fixture (config + manifests),
+    so one batch group's inputs can be dirtied without touching
+    another's."""
+    dst = os.path.join(base, f"cfg-{name}")
+    if not os.path.isdir(dst):
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), dst)
+    return os.path.join(dst, "workload.yaml")
+
+
+def _specs(base: str, suffix: str, cfg_suffix: str = None) -> tuple:
+    """A two-group batch: an init -> create-api -> vet chain over one
+    project plus an independent init, each group with its own config.
+
+    ``cfg_suffix`` defaults to ``suffix``; identity tests pin it so
+    every leg reads the SAME config paths (PROJECT records the config's
+    relative path, so per-leg copies would legitimately differ)."""
+    cfg_suffix = suffix if cfg_suffix is None else cfg_suffix
+    config_a = _config_copy(base, f"a-{cfg_suffix}")
+    config_b = _config_copy(base, f"b-{cfg_suffix}")
+    dir_a = os.path.join(base, f"out-a-{suffix}")
+    dir_b = os.path.join(base, f"out-b-{suffix}")
+    return [
+        {"command": "init", "workload_config": config_a,
+         "output_dir": dir_a, "repo": "github.com/acme/app"},
+        {"command": "create-api", "workload_config": config_a,
+         "output_dir": dir_a},
+        {"command": "vet", "path": dir_a},
+        {"command": "init", "workload_config": config_b,
+         "output_dir": dir_b, "repo": "github.com/acme/app"},
+    ], (dir_a, dir_b)
+
+
+def _run(base: str, suffix: str, cfg_suffix: str = None):
+    specs, dirs = _specs(base, suffix, cfg_suffix)
+    results = run_batch(jobs_from_specs(specs, base))
+    assert all(r.ok for r in results), [
+        (r.id, r.rc, r.stderr) for r in results
+    ]
+    return results, dirs
+
+
+class TestBatchByteIdentity:
+    def test_thread_vs_process_vs_serial(self, tmp_path, monkeypatch):
+        """Serial, thread-parallel, and process-pool batches over fresh
+        dirs must write byte-identical trees."""
+        perfcache.configure(mode="off")  # isolate scheduling from caching
+        base = str(tmp_path)
+        legs = {}
+        for name, backend, jobs in (
+            ("serial", "thread", "1"),
+            ("threads", "thread", "8"),
+            ("procs", "process", "8"),
+        ):
+            monkeypatch.setenv("OPERATOR_FORGE_JOBS", jobs)
+            workers.set_backend(backend)
+            try:
+                _results, dirs = _run(base, name, cfg_suffix="shared")
+            finally:
+                workers.set_backend(None)
+            legs[name] = dirs
+        for other in ("threads", "procs"):
+            for reference_dir, other_dir in zip(legs["serial"], legs[other]):
+                assert_identical_trees(reference_dir, other_dir)
+
+    @pytest.mark.parametrize("mode", ["off", "mem", "disk"])
+    def test_cache_modes_byte_identical(self, mode, tmp_path, monkeypatch):
+        """Every cache mode produces the tree `off` mode does."""
+        base = str(tmp_path)
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "4")
+        perfcache.configure(mode="off")
+        _results, reference_dirs = _run(base, "reference", cfg_suffix="shared")
+        perfcache.configure(
+            mode=mode,
+            root=str(tmp_path / "cache") if mode == "disk" else None,
+        )
+        perfcache.reset()
+        _results, mode_dirs = _run(base, mode, cfg_suffix="shared")
+        for reference_dir, mode_dir in zip(reference_dirs, mode_dirs):
+            assert_identical_trees(reference_dir, mode_dir)
+
+    def test_repeat_batches_stay_byte_identical(self, tmp_path):
+        """Re-batching over the same dirs (live runs, then group
+        replays) never changes the trees once they converge."""
+        import hashlib
+
+        base = str(tmp_path)
+        perfcache.configure(mode="mem")
+
+        def digest(root):
+            h = hashlib.sha256()
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames.sort()
+                for name in sorted(filenames):
+                    path = os.path.join(dirpath, name)
+                    h.update(os.path.relpath(path, root).encode())
+                    with open(path, "rb") as fh:
+                        h.update(fh.read())
+            return h.hexdigest()
+
+        _results, dirs = _run(base, "steady")
+        _run(base, "steady")
+        converged = [digest(d) for d in dirs]
+        results, _dirs = _run(base, "steady")  # records the fixed point
+        results, _dirs = _run(base, "steady")  # replays it
+        assert all(r.cached for r in results)
+        assert [digest(d) for d in dirs] == converged
+
+
+class TestDirtyTracking:
+    def test_rebatch_recomputes_only_touched_group(self, tmp_path):
+        base = str(tmp_path)
+        perfcache.configure(mode="mem")
+        specs, dirs = _specs(base, "dirty")
+        jobs = jobs_from_specs(specs, base)
+        for _ in range(4):  # converge both groups to replayed batches
+            results = run_batch(jobs)
+        assert all(r.cached for r in results)
+
+        # dirty group B's input: only its job recomputes
+        config_b = _config_copy(base, "b-dirty")
+        with open(config_b, "a", encoding="utf-8") as fh:
+            fh.write("# dirty\n")
+        results = run_batch(jobs)
+        assert [r.cached for r in results] == [True, True, True, False]
+        assert all(r.ok for r in results)
+
+        # dirty group A's OUTPUT tree: its generation chain recomputes
+        # (restoring the tree — the vet at the chain's end then replays
+        # against the restored bytes) while group B replays untouched
+        for _ in range(3):  # converge B again under its new config
+            results = run_batch(jobs)
+        assert all(r.cached for r in results)
+        with open(os.path.join(dirs[0], "PROJECT"), "a",
+                  encoding="utf-8") as fh:
+            fh.write("# drift\n")
+        results = run_batch(jobs)
+        assert [r.cached for r in results] == [False, False, True, True]
+        # the recompute healed the drift: the next batch replays whole
+        results = run_batch(jobs)
+        assert all(r.cached for r in results)
+
+    def test_off_mode_never_replays(self, tmp_path):
+        perfcache.configure(mode="off")
+        specs, _dirs = _specs(str(tmp_path), "nocache")
+        jobs = jobs_from_specs(specs, str(tmp_path))
+        for _ in range(3):
+            results = run_batch(jobs)
+        assert not any(r.cached for r in results)
+
+
+class TestScheduling:
+    def test_groups_by_directory_preserve_order(self, tmp_path):
+        specs, (dir_a, dir_b) = _specs(str(tmp_path), "groups")
+        jobs = jobs_from_specs(specs, str(tmp_path))
+        groups = plan_groups(jobs)
+        assert [[j.id for j in g] for g in groups] == [
+            ["job-1", "job-2", "job-3"], ["job-4"],
+        ]
+
+    def test_nested_directories_share_a_group(self, tmp_path):
+        config = _config_copy(str(tmp_path), "nest")
+        outer = str(tmp_path / "out")
+        inner = os.path.join(outer, "sub")
+        jobs = jobs_from_specs([
+            {"command": "init", "workload_config": config,
+             "output_dir": inner},
+            {"command": "init", "workload_config": config,
+             "output_dir": str(tmp_path / "other")},
+            {"command": "vet", "path": outer},
+        ], str(tmp_path))
+        groups = plan_groups(jobs)
+        assert [[j.id for j in g] for g in groups] == [
+            ["job-1", "job-3"], ["job-2"],
+        ]
+
+    def test_bridging_job_merges_groups(self, tmp_path):
+        config = _config_copy(str(tmp_path), "bridge")
+        jobs = jobs_from_specs([
+            {"command": "init", "workload_config": config,
+             "output_dir": str(tmp_path / "out" / "a")},
+            {"command": "init", "workload_config": config,
+             "output_dir": str(tmp_path / "out" / "b")},
+            {"command": "vet", "path": str(tmp_path / "out")},
+        ], str(tmp_path))
+        groups = plan_groups(jobs)
+        assert [[j.id for j in g] for g in groups] == [
+            ["job-1", "job-2", "job-3"],
+        ]
+
+
+class TestManifest:
+    def test_manifest_paths_resolve_against_its_directory(self, tmp_path):
+        _config_copy(str(tmp_path), "m")
+        manifest = tmp_path / "jobs.yaml"
+        manifest.write_text(
+            "jobs:\n"
+            "  - command: init\n"
+            "    workload_config: cfg-m/workload.yaml\n"
+            "    output_dir: out-m\n"
+            "    repo: github.com/acme/app\n"
+            "  - command: vet\n"
+            "    path: out-m\n"
+        )
+        jobs = load_manifest(str(manifest))
+        assert jobs[0].workload_config == str(
+            tmp_path / "cfg-m" / "workload.yaml"
+        )
+        assert jobs[1].path == str(tmp_path / "out-m")
+
+    @pytest.mark.parametrize("bad, match", [
+        ("jobs: {}\n", "list of jobs"),
+        ("jobs:\n  - command: frobnicate\n", "unknown command"),
+        ("jobs:\n  - command: init\n", "required"),
+        ("jobs:\n  - command: vet\n    path: x\n    e2e: true\n",
+         "unknown keys"),
+        ("jobs:\n  - {command: vet, path: x, id: dup}\n"
+         "  - {command: vet, path: y, id: dup}\n", "duplicate job id"),
+    ])
+    def test_invalid_manifests_are_rejected(self, bad, match, tmp_path):
+        manifest = tmp_path / "jobs.yaml"
+        manifest.write_text(bad)
+        with pytest.raises(BatchManifestError, match=match):
+            load_manifest(str(manifest))
+
+    def test_batch_cli_runs_manifest_and_reports(self, tmp_path, capsys):
+        _config_copy(str(tmp_path), "cli")
+        manifest = tmp_path / "jobs.yaml"
+        manifest.write_text(
+            "jobs:\n"
+            "  - command: init\n"
+            "    workload_config: cfg-cli/workload.yaml\n"
+            "    output_dir: out-cli\n"
+            "    repo: github.com/acme/app\n"
+            "  - command: create-api\n"
+            "    workload_config: cfg-cli/workload.yaml\n"
+            "    output_dir: out-cli\n"
+            "  - command: vet\n"
+            "    path: out-cli\n"
+        )
+        assert cli_main(["batch", "--manifest", str(manifest)]) == 0
+        out = capsys.readouterr().out
+        assert "batch: 3 jobs, 3 ok" in out
+        assert os.path.exists(str(tmp_path / "out-cli" / "PROJECT"))
+
+        assert cli_main(
+            ["batch", "--manifest", str(manifest), "--json"]
+        ) == 0
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert len(lines) == 4  # 3 job lines + summary
+        assert all(line["ok"] for line in lines[:3])
+        assert lines[3]["summary"]["failed"] == 0
+
+    def test_batch_cli_reports_failing_job(self, tmp_path, capsys):
+        manifest = tmp_path / "jobs.yaml"
+        manifest.write_text(
+            "jobs:\n  - command: vet\n    path: no-such-dir\n"
+        )
+        assert cli_main(["batch", "--manifest", str(manifest)]) == 1
+        out = capsys.readouterr().out
+        assert "FAIL" in out and "1 failed" in out
+
+
+class TestServeLoop:
+    def test_protocol_end_to_end(self, tmp_path):
+        config = _config_copy(str(tmp_path), "serve")
+        out_dir = str(tmp_path / "served")
+        requests = [
+            {"op": "ping"},
+            {"id": "r1", "command": "init", "workload_config": config,
+             "output_dir": out_dir, "repo": "github.com/acme/app"},
+            {"op": "batch", "jobs": [
+                {"command": "create-api", "workload_config": config,
+                 "output_dir": out_dir},
+                {"command": "vet", "path": out_dir},
+            ]},
+            "this is not JSON",
+            {"op": "stats"},
+            {"op": "warp-core-breach"},
+            {"op": "shutdown"},
+            {"op": "ping"},  # after shutdown: never read
+        ]
+        in_stream = io.StringIO("\n".join(
+            r if isinstance(r, str) else json.dumps(r) for r in requests
+        ) + "\n")
+        out_stream = io.StringIO()
+        assert serve_loop(in_stream, out_stream) == 0
+        responses = [
+            json.loads(line)
+            for line in out_stream.getvalue().splitlines()
+        ]
+        assert len(responses) == 7  # everything up to shutdown, inclusive
+        ping, job, batch, bad, stats, unknown, shutdown_resp = responses
+        assert ping["ok"] and ping["op"] == "ping" and ping["version"]
+        assert job["ok"] and job["id"] == "r1" and job["rc"] == 0
+        assert batch["ok"] and [
+            r["command"] for r in batch["results"]
+        ] == ["create-api", "vet"]
+        assert not bad["ok"] and "invalid JSON" in bad["error"]
+        assert stats["ok"] and "serve:job" in stats["spans"]
+        assert not unknown["ok"] and "unknown op" in unknown["error"]
+        assert shutdown_resp["ok"] and shutdown_resp["op"] == "shutdown"
+        assert os.path.exists(os.path.join(out_dir, "PROJECT"))
+
+    def test_warm_serve_requests_replay(self, tmp_path):
+        perfcache.configure(mode="mem")
+        config = _config_copy(str(tmp_path), "warm")
+        out_dir = str(tmp_path / "warm-served")
+        job = {"command": "init", "workload_config": config,
+               "output_dir": out_dir, "repo": "github.com/acme/app"}
+        # three live runs to converge (fresh tree, boilerplate pickup,
+        # fixed-point recording), then the resident process replays
+        requests = [job, job, job, job, {"op": "shutdown"}]
+        in_stream = io.StringIO(
+            "\n".join(json.dumps(r) for r in requests) + "\n"
+        )
+        out_stream = io.StringIO()
+        assert serve_loop(in_stream, out_stream) == 0
+        responses = [
+            json.loads(line)
+            for line in out_stream.getvalue().splitlines()
+        ]
+        assert [r.get("cached") for r in responses[:4]] == [
+            False, False, False, True,
+        ]
