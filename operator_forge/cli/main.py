@@ -670,23 +670,44 @@ def cmd_validate(args: argparse.Namespace) -> int:
 
 
 def cmd_vet(args: argparse.Namespace) -> int:
-    """Syntax-check every .go file of a generated project.
+    """Check every .go file of a generated project through the
+    analyzer framework (gocheck/analysis/): the syntax/type/structural
+    gate plus the data-flow analyzers (shadow, ineffassign,
+    unreachable, errcheck, loopclosure, copylocks, structtag) — the
+    no-toolchain stand-in for CI's `go build ./... && go vet ./...`
+    (reference .github/workflows/test.yaml:53-105).
 
-    Provides the syntax half of `go build` in environments without a Go
-    toolchain (the reference relies on CI compilation for this,
-    .github/workflows/test.yaml:55-105).
+    ``--analyzers a,b`` selects a subset (run order is fixed);
+    ``--json`` emits one JSON object per diagnostic with stable key
+    order, for batch/serve clients.
     """
-    from operator_forge.gocheck import check_project
+    import json as _json
+
+    from operator_forge.gocheck.analysis import (
+        AnalysisError,
+        analyze_project,
+    )
 
     root = args.path
     if not os.path.isdir(root):
         print(f"error: {root} is not a directory", file=sys.stderr)
         return 1
-    errors = check_project(root)
-    for err in errors:
-        print(err, file=sys.stderr)
-    if errors:
-        print(f"vet: {len(errors)} problem(s)", file=sys.stderr)
+    names = None
+    if args.analyzers:
+        names = [n.strip() for n in args.analyzers.split(",") if n.strip()]
+    try:
+        diagnostics = analyze_project(root, analyzers=names)
+    except AnalysisError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        for diag in diagnostics:
+            print(_json.dumps(diag.to_dict()))
+        return 1 if diagnostics else 0
+    for diag in diagnostics:
+        print(diag.text(), file=sys.stderr)
+    if diagnostics:
+        print(f"vet: {len(diagnostics)} problem(s)", file=sys.stderr)
         return 1
     print("vet: all Go files check cleanly")
     return 0
@@ -761,7 +782,8 @@ def cmd_test(args: argparse.Namespace) -> int:
 
 
 def cmd_batch(args: argparse.Namespace) -> int:
-    """`batch`: run a manifest of init/create-api/vet/test jobs through
+    """`batch`: run a manifest of init/create-api/vet/lint/test jobs
+    through
     the batch orchestrator (PR 3) — jobs over distinct directories fan
     out across the OPERATOR_FORGE_WORKERS=thread|process backend, jobs
     over one directory chain in manifest order, unchanged jobs replay
@@ -914,9 +936,21 @@ def build_parser() -> argparse.ArgumentParser:
     p_version.set_defaults(func=cmd_version)
 
     p_vet = sub.add_parser(
-        "vet", help="syntax-check the Go files of a generated project"
+        "vet",
+        help="run the analyzer framework over the Go files of a "
+             "generated project (syntax, types, structure, data flow)",
     )
     p_vet.add_argument("path", help="root of the generated project")
+    p_vet.add_argument(
+        "--analyzers", default="", metavar="A,B",
+        help="comma-separated analyzer subset (default: all; see "
+             "docs/no-toolchain-tools.md for the registry)",
+    )
+    p_vet.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON object per diagnostic (stable key order) "
+             "instead of human text",
+    )
     p_vet.set_defaults(func=cmd_vet)
 
     p_test = sub.add_parser(
@@ -976,7 +1010,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_batch = sub.add_parser(
         "batch",
-        help="run a manifest of init/create-api/vet/test jobs "
+        help="run a manifest of init/create-api/vet/lint/test jobs "
              "concurrently with cached-result replay",
     )
     p_batch.add_argument(

@@ -15,6 +15,9 @@ syntax-level half of that check natively so it runs in any environment.
 Public API:
     check_source(text, filename) -> list[str]   # syntax errors, [] if OK
     check_project(root)          -> list[str]   # every .go file under root
+    analysis.analyze_project(root, analyzers)   # structured Diagnostics
+                                                # from the multi-pass
+                                                # vet driver (analysis/)
 """
 
 from .tokens import GoTokenError, Token, tokenize

@@ -1,0 +1,71 @@
+"""Registry-driven analyzer framework for the no-toolchain vet gate.
+
+Modeled on golang.org/x/tools ``go/analysis`` (the modular vet driver):
+analyzers declare a name, requirements and a scope, emit structured
+:class:`Diagnostic` values, and run through a shared driver that
+computes facts once per file/package, fans files across
+``OPERATOR_FORGE_JOBS`` workers in deterministic order, and replays
+whole runs from the content-addressed ``gocheck.analyze`` cache.
+
+Registered analyzers (run order):
+
+========== ======= ===========================================
+syntax     file    parse errors (tokenizer + full-grammar parser)
+lint       file    unused locals (shadow-aware), missing return, labels
+typecheck  file    manifest symbol/arity/field checks
+shadow     file    inner := shadowing a still-read outer binding
+ineffassign file   assignments never read before overwrite/return
+unreachable file   statements after a terminating statement
+loopclosure file   go/defer closures capturing range variables
+errcheck   file    discarded error results of manifest functions
+copylocks  file    lock-carrying types passed/returned by value
+structtag  file    malformed/duplicate json:/yaml: struct tags
+structural project package-level imports/duplicates/qualifiers
+localcalls project intra-project call checks over the index
+========== ======= ===========================================
+
+``LEGACY_ANALYZERS`` is the pre-framework ``check_project``
+composition; its diagnostics render byte-identically to the old pass
+output.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisError,
+    Analyzer,
+    Diagnostic,
+    all_names,
+    register,
+    registry,
+)
+
+# importing the analyzer modules populates the registry; order here IS
+# the run order within each scope
+from . import legacy  # noqa: F401,E402  (syntax, lint, typecheck, ...)
+from . import dataflow  # noqa: F401,E402  (shadow, ineffassign, ...)
+from . import apichecks  # noqa: F401,E402  (errcheck, copylocks, ...)
+
+from .driver import (  # noqa: F401,E402
+    FileContext,
+    ProjectContext,
+    analyze_project,
+    analyze_source,
+)
+
+#: the pre-framework `check_project` composition, in its output order
+LEGACY_ANALYZERS = (
+    "syntax", "lint", "typecheck", "structural", "localcalls"
+)
+
+__all__ = [
+    "AnalysisError",
+    "Analyzer",
+    "Diagnostic",
+    "FileContext",
+    "ProjectContext",
+    "LEGACY_ANALYZERS",
+    "all_names",
+    "analyze_project",
+    "analyze_source",
+    "register",
+    "registry",
+]

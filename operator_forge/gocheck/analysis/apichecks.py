@@ -1,0 +1,360 @@
+"""Manifest- and token-driven analyzers: errcheck, copylocks, structtag.
+
+These extend the type layer's manifest checks with the vet/staticcheck
+classes that need call-site or declaration-site context rather than
+data flow: discarded error results (errcheck), locks passed by value
+(`go vet -copylocks`), and malformed or duplicate struct tags
+(`go vet -structtag`) — the last directly exercised by generated CRD
+types, where every field carries a ``json:`` tag.
+"""
+
+from __future__ import annotations
+
+from ..manifest import ERROR_RESULTS, LOCK_TYPES
+from ..tokens import IDENT, KEYWORD, OP, STRING
+from .core import Analyzer, Diagnostic, register
+
+
+def _match_paren(toks, open_i: int) -> int:
+    """Token index of the ``)`` matching ``(`` at *open_i* (-1 if the
+    stream is malformed — callers bail silently)."""
+    depth = 0
+    for j in range(open_i, len(toks)):
+        t = toks[j]
+        if t.kind == OP and t.value in ("(", "[", "{"):
+            depth += 1
+        elif t.kind == OP and t.value in (")", "]", "}"):
+            depth -= 1
+            if depth == 0:
+                return j
+    return -1
+
+
+def _run_errcheck(ctx):
+    """A bare expression-statement call ``alias.Fn(...)`` where the
+    manifest records Fn's last result as ``error``: the error is
+    discarded.  Assignments (including ``_ =``), conditions and
+    chained calls are all non-bare and never flagged."""
+    parser = ctx.parser
+    toks = parser.toks
+    imports = ctx.imports
+    shadowed = ctx.shadowed
+    stmt_starts = {start: end for start, end in parser.expr_stmts}
+    out = []
+    for alias_i, name_i, _nargs, _spread in parser.qual_calls:
+        end = stmt_starts.get(alias_i)
+        if end is None:
+            continue  # not the start of an expression statement
+        alias = toks[alias_i].value
+        path = imports.get(alias)
+        if path is None or alias in shadowed:
+            continue
+        name = toks[name_i].value
+        if name not in ERROR_RESULTS.get(path, ()):
+            continue
+        open_i = name_i + 1
+        if not (toks[open_i].kind == OP and toks[open_i].value == "("):
+            continue
+        if _match_paren(toks, open_i) != end - 1:
+            continue  # the call is not the whole statement
+        tok = toks[alias_i]
+        out.append(Diagnostic(
+            ctx.path, tok.line, tok.col, "errcheck", "warning",
+            f"error return value of {alias}.{name} is not checked",
+        ))
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _lock_paths(imports: dict) -> dict:
+    """alias -> lock-type name set, for imports of lock-carrying
+    packages (``sync`` plus any manifest-tagged path)."""
+    return {
+        alias: LOCK_TYPES[path]
+        for alias, path in imports.items()
+        if path in LOCK_TYPES
+    }
+
+
+def _scan_lock_values(toks, lo: int, hi: int, locks: dict, base_depth: int):
+    """``alias.T`` lock types appearing BY VALUE at paren depth
+    *base_depth* within tokens [lo, hi): yields the alias token index.
+    Pointer (*T), slice/map/chan element, variadic and nested-group
+    positions are skipped."""
+    depth = 0
+    j = lo
+    while j < hi:
+        t = toks[j]
+        if t.kind == OP and t.value in ("(", "[", "{"):
+            depth += 1
+        elif t.kind == OP and t.value in (")", "]", "}"):
+            depth -= 1
+        elif (
+            depth == base_depth
+            and t.kind == IDENT
+            and j + 2 < hi
+            and toks[j + 1].kind == OP
+            and toks[j + 1].value == "."
+            and toks[j + 2].kind == IDENT
+            and t.value in locks
+            and toks[j + 2].value in locks[t.value]
+        ):
+            prev = toks[j - 1]
+            if not (prev.kind == OP and prev.value in (
+                "*", ".", "]", "...", "<-"
+            )) and not (prev.kind == KEYWORD and prev.value == "chan"):
+                yield j
+            j += 3
+            continue
+        j += 1
+
+
+def _run_copylocks(ctx):
+    """Function signatures (declarations and literals — shapes with a
+    body) whose receiver, a parameter, or a result takes a lock-
+    carrying type by value: every call copies the lock."""
+    parser = ctx.parser
+    toks = parser.toks
+    locks = _lock_paths(ctx.imports)
+    if not locks:
+        return []
+    shadowed = ctx.shadowed
+    locks = {a: s for a, s in locks.items() if a not in shadowed}
+    if not locks:
+        return []
+    out = []
+    body_opens = {start for start, _end in parser.func_spans}
+    i = 0
+    n = len(toks)
+    while i < n:
+        t = toks[i]
+        if not (t.kind == KEYWORD and t.value == "func"):
+            i += 1
+            continue
+        # walk the header: optional receiver group, name, optional type
+        # params, parameter group(s), optional results — stop at the
+        # body brace; a bodiless shape is a func *type*, not flagged
+        j = i + 1
+        header_spans = []
+        while j < n:
+            tj = toks[j]
+            if tj.kind == OP and tj.value == "(":
+                close = _match_paren(toks, j)
+                if close < 0:
+                    break
+                header_spans.append((j + 1, close))
+                j = close + 1
+            elif tj.kind == OP and tj.value == "[":
+                depth = 0
+                while j < n:
+                    if toks[j].kind == OP and toks[j].value == "[":
+                        depth += 1
+                    elif toks[j].kind == OP and toks[j].value == "]":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                j += 1
+            elif tj.kind == IDENT or (
+                tj.kind == OP and tj.value in ("*", ".", ",")
+            ):
+                j += 1
+            elif tj.kind == OP and tj.value == "{":
+                break
+            else:
+                break
+        is_definition = (
+            j < n and toks[j].kind == OP and toks[j].value == "{"
+            and j in body_opens
+        )
+        if is_definition:
+            for lo, hi in header_spans:
+                for a_i in _scan_lock_values(toks, lo, hi, locks, 0):
+                    tok = toks[a_i]
+                    out.append(Diagnostic(
+                        ctx.path, tok.line, tok.col, "copylocks",
+                        "warning",
+                        f"{toks[a_i].value}.{toks[a_i + 2].value} "
+                        "passed by value: contains a lock",
+                    ))
+            # bare (unparenthesized) result type between the last
+            # group and the body brace
+            if header_spans:
+                tail_lo = header_spans[-1][1] + 1
+                for a_i in _scan_lock_values(toks, tail_lo, j, locks, 0):
+                    tok = toks[a_i]
+                    out.append(Diagnostic(
+                        ctx.path, tok.line, tok.col, "copylocks",
+                        "warning",
+                        f"{toks[a_i].value}.{toks[a_i + 2].value} "
+                        "returned by value: contains a lock",
+                    ))
+        i = j if j > i else i + 1
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _parse_tag(raw: str):
+    """Decode a field-tag literal into (pairs, error): pairs is a list
+    of (key, value) per the reflect.StructTag convention.  Only raw
+    (backquoted) and interpreted (quoted) literals with conventional
+    contents parse; anything else returns an error string."""
+    if len(raw) >= 2 and raw[0] == "`" and raw[-1] == "`":
+        body = raw[1:-1]
+    elif len(raw) >= 2 and raw[0] == '"' and raw[-1] == '"':
+        body = raw[1:-1]
+        # conventional tags avoid escapes; bail (no finding) on any
+        try:
+            if "\\" in body:
+                return None, None
+        except Exception:  # pragma: no cover - defensive
+            return None, None
+    else:
+        return None, None
+    pairs = []
+    i = 0
+    n = len(body)
+    while i < n:
+        while i < n and body[i] == " ":
+            i += 1
+        if i >= n:
+            break
+        k = i
+        while i < n and body[i] not in (" ", ":", '"') and body[i] > "\x20":
+            i += 1
+        key = body[k:i]
+        if not key or i >= n or body[i] != ":":
+            return None, "bad syntax for struct tag pair"
+        i += 1
+        if i >= n or body[i] != '"':
+            return None, "bad syntax for struct tag value"
+        i += 1
+        v = i
+        while i < n and body[i] != '"':
+            if body[i] == "\\":
+                i += 1
+            i += 1
+        if i >= n:
+            return None, "bad syntax for struct tag value"
+        pairs.append((key, body[v:i]))
+        i += 1
+    return pairs, None
+
+
+def _run_structtag(ctx):
+    """Malformed tags and duplicate ``json:``/``yaml:`` names on
+    exported structs — the CRD-type surface every generated API file
+    exercises."""
+    parser = ctx.parser
+    toks = parser.toks
+    out = []
+    n = len(toks)
+    i = 0
+    while i < n - 3:
+        if not (
+            toks[i].kind == KEYWORD and toks[i].value == "type"
+            and toks[i + 1].kind == IDENT
+            and toks[i + 1].value[:1].isupper()
+            and toks[i + 2].kind == KEYWORD and toks[i + 2].value == "struct"
+            and toks[i + 3].kind == OP and toks[i + 3].value == "{"
+        ):
+            i += 1
+            continue
+        struct_name = toks[i + 1].value
+        depth = 0
+        j = i + 3
+        field_name = None
+        expect_field = True
+        seen: dict = {}  # (key, name) -> first field
+        while j < n:
+            t = toks[j]
+            if t.kind == OP and t.value in ("{", "(", "["):
+                depth += 1
+            elif t.kind == OP and t.value in ("}", ")", "]"):
+                depth -= 1
+                if depth == 0:
+                    break
+            elif depth == 1:
+                if t.kind == OP and t.value == ";":
+                    expect_field = True
+                elif expect_field and t.kind == IDENT:
+                    field_name = t.value
+                    expect_field = False
+                if t.kind == STRING:
+                    nxt = toks[j + 1] if j + 1 < n else None
+                    if nxt is not None and nxt.kind == OP and (
+                        nxt.value in (";", "}")
+                    ):
+                        out.extend(_check_tag(
+                            ctx.path, t, struct_name,
+                            field_name or "(embedded)", seen,
+                        ))
+            j += 1
+        i = j + 1
+    return out
+
+
+def _check_tag(path, tok, struct_name, field_name, seen) -> list:
+    pairs, err = _parse_tag(tok.value)
+    if err is not None:
+        return [Diagnostic(
+            path, tok.line, tok.col, "structtag", "warning",
+            f"struct field {field_name} has a malformed tag: {err}",
+        )]
+    if pairs is None:
+        return []
+    out = []
+    keys_in_tag = set()
+    for key, value in pairs:
+        if key in keys_in_tag:
+            out.append(Diagnostic(
+                path, tok.line, tok.col, "structtag", "warning",
+                f"struct field {field_name} repeats tag key {key!r}",
+            ))
+        keys_in_tag.add(key)
+        if key not in ("json", "yaml"):
+            continue
+        name = value.split(",", 1)[0]
+        if name in ("", "-"):
+            continue
+        first = seen.get((key, name))
+        if first is not None and first != field_name:
+            out.append(Diagnostic(
+                path, tok.line, tok.col, "structtag", "warning",
+                f"struct field {field_name} repeats {key} tag "
+                f"{name!r} also set on {first} ({struct_name})",
+            ))
+        else:
+            seen[(key, name)] = field_name
+    return out
+
+
+ERRCHECK = register(Analyzer(
+    name="errcheck",
+    doc="bare calls discarding a manifest function's error result "
+        "(the errcheck tool)",
+    scope="file",
+    requires=("parse", "text"),
+    run=_run_errcheck,
+    severity="warning",
+))
+
+COPYLOCKS = register(Analyzer(
+    name="copylocks",
+    doc="function signatures passing or returning lock-carrying "
+        "types by value (go vet -copylocks)",
+    scope="file",
+    requires=("parse", "text"),
+    run=_run_copylocks,
+    severity="warning",
+))
+
+STRUCTTAG = register(Analyzer(
+    name="structtag",
+    doc="malformed or duplicate json:/yaml: tags on exported structs "
+        "(go vet -structtag)",
+    scope="file",
+    requires=("parse",),
+    run=_run_structtag,
+    severity="warning",
+))

@@ -1,0 +1,140 @@
+"""Analyzer/Diagnostic model and registry for the gocheck vet driver.
+
+Modeled on golang.org/x/tools ``go/analysis``: each analyzer is a named,
+self-describing unit declaring what shared facts it needs (``requires``)
+and whether it runs per file or once per project (``scope``).  Analyzers
+emit structured :class:`Diagnostic` values instead of bare strings; the
+driver (driver.py) renders them back to the legacy ``file:line:col:
+message`` text for the CLI, byte-identical for the ported passes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+
+class AnalysisError(Exception):
+    """Raised for unknown analyzer names or misdirected entry points."""
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding.
+
+    ``line``/``col`` are 1-based; 0 means "no location at that
+    precision" (package-level findings like duplicate declarations
+    carry only a file, or no location at all).
+    """
+
+    file: str
+    line: int
+    col: int
+    analyzer: str
+    severity: str
+    message: str
+
+    def text(self) -> str:
+        """The legacy human rendering — byte-identical to what the
+        pre-driver passes printed."""
+        if self.line > 0 and self.col > 0:
+            return f"{self.file}:{self.line}:{self.col}: {self.message}"
+        if self.line > 0:
+            return f"{self.file}:{self.line}: {self.message}"
+        if self.file:
+            return f"{self.file}: {self.message}"
+        return self.message
+
+    def to_dict(self) -> dict:
+        """JSON shape with stable key order (one object per diagnostic
+        on the ``vet --json`` stream)."""
+        return {
+            "file": self.file,
+            "line": self.line,
+            "col": self.col,
+            "analyzer": self.analyzer,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+_LOC3_RE = re.compile(r"(?s)(.*?):(\d+):(\d+): (.*)")
+_LOC2_RE = re.compile(r"(?s)(.*?):(\d+): (.*)")
+_FILE_RE = re.compile(r"(?s)(.*?): (.*)")
+
+
+def from_text(analyzer: str, severity: str, text: str) -> Diagnostic:
+    """Wrap a legacy finding string into a Diagnostic whose ``text()``
+    round-trips byte-identically (lazy prefix split, so messages
+    containing colons re-concatenate unchanged)."""
+    m = _LOC3_RE.fullmatch(text)
+    if m:
+        return Diagnostic(m.group(1), int(m.group(2)), int(m.group(3)),
+                          analyzer, severity, m.group(4))
+    m = _LOC2_RE.fullmatch(text)
+    if m:
+        return Diagnostic(m.group(1), int(m.group(2)), 0,
+                          analyzer, severity, m.group(3))
+    m = _FILE_RE.fullmatch(text)
+    if m:
+        # any split re-concatenates identically in text(); the lazy
+        # prefix is the path for every legacy `path: message` shape
+        return Diagnostic(m.group(1), 0, 0, analyzer, severity, m.group(2))
+    return Diagnostic("", 0, 0, analyzer, severity, text)
+
+
+@dataclass(frozen=True)
+class Analyzer:
+    """One registered pass.
+
+    ``scope`` is ``"file"`` (run per parsed file, fanned out in input
+    order) or ``"project"`` (run once over the whole tree).  ``requires``
+    names the shared facts the driver must prepare: ``tokens``/``parse``
+    (the cached parse), ``facts`` (the scope/statement model,
+    facts.py), ``index`` (the cross-package ProjectIndex), ``text``
+    (raw source).  ``run`` takes a FileContext or ProjectContext and
+    returns a list of Diagnostics.
+    """
+
+    name: str
+    doc: str
+    scope: str
+    requires: tuple
+    run: object
+    severity: str = "error"
+
+
+_REGISTRY: dict[str, Analyzer] = {}
+
+
+def register(analyzer: Analyzer) -> Analyzer:
+    if analyzer.name in _REGISTRY:
+        raise AnalysisError(f"duplicate analyzer {analyzer.name!r}")
+    if analyzer.scope not in ("file", "project"):
+        raise AnalysisError(f"bad scope {analyzer.scope!r}")
+    _REGISTRY[analyzer.name] = analyzer
+    return analyzer
+
+
+def registry() -> dict[str, Analyzer]:
+    """Registered analyzers in registration (= run) order."""
+    return dict(_REGISTRY)
+
+
+def all_names() -> tuple:
+    return tuple(_REGISTRY)
+
+
+def resolve(names) -> list:
+    """Validate a name selection into Analyzer objects in REGISTRY
+    order (the run order is canonical regardless of spelling order)."""
+    if names is None:
+        return list(_REGISTRY.values())
+    wanted = list(names)
+    unknown = sorted(set(wanted) - set(_REGISTRY))
+    if unknown:
+        raise AnalysisError(
+            "unknown analyzer(s) " + ", ".join(repr(u) for u in unknown)
+            + "; known: " + ", ".join(_REGISTRY)
+        )
+    return [a for name, a in _REGISTRY.items() if name in set(wanted)]

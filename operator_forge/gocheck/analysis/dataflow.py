@@ -1,0 +1,371 @@
+"""Data-flow analyzers the isolated per-file passes could not express.
+
+All four need the scope/statement facts (facts.py): shadow and
+loopclosure resolve identifier uses against binding groups, ineffassign
+walks straight-line write windows, unreachable walks sibling statement
+groups.  Every analyzer is conservative by construction — token-level
+uncertainty always suppresses a finding, never invents one — mirroring
+the zero-false-positive contract of the passes they extend
+(counterparts: `go vet -shadow/-unreachable/-loopclosure`, the
+staticcheck/ineffassign tool).
+"""
+
+from __future__ import annotations
+
+from ..tokens import IDENT, KEYWORD, OP
+from .core import Analyzer, Diagnostic, register
+from .facts import (
+    CONTROL_KEYWORDS,
+    captured_names,
+    enclosing_func,
+    func_literals_within,
+    scopes_of,
+)
+
+
+def _run_shadow(ctx):
+    """An inner ``:=`` re-declaring a name whose outer binding is still
+    read after the inner scope closes — almost always a template bug
+    where ``=`` (assign) was meant."""
+    parser = ctx.parser
+    scopes = scopes_of(parser)
+    toks = parser.toks
+    out = []
+    seen = set()
+    for d in sorted(scopes.short_decl_set):
+        name = toks[d].value
+        if name == "_":
+            continue
+        inner_key = scopes.group_of(d)
+        inner_scope = inner_key[0]
+        if inner_scope is None:
+            continue
+        if scopes.kinds[inner_scope] == "stmt":
+            # `if err := f(); err != nil` header declarations are the
+            # idiomatic-by-construction class that makes `go vet
+            # -shadow` opt-in upstream; only block/loop-level shadows
+            # signal a `:=`-for-`=` template bug
+            continue
+        if d != min(scopes.groups[inner_key]):
+            continue  # one report per binding, at its first site
+        # the nearest enclosing binding of the same name that is
+        # already in scope at the inner declaration
+        outer_key = None
+        for key in scopes.by_name.get(name, ()):
+            if key == inner_key:
+                continue
+            if not scopes.strictly_inside(inner_scope, key[0]):
+                continue
+            if scopes.group_min_start[key] >= d:
+                continue  # comes into scope after the inner decl
+            if outer_key is None or scopes.strictly_inside(
+                key[0], outer_key[0]
+            ):
+                outer_key = key  # prefer the nearest enclosing scope
+        if outer_key is None:
+            continue
+        # the outer binding must still be read after the inner scope
+        # closes — otherwise the shadow is harmless
+        inner_end = scopes.scopes[inner_scope][1]
+        still_read = any(
+            j > inner_end and scopes.resolve(j, name) == outer_key
+            for j in scopes.uses_by_name.get(name, ())
+        )
+        if not still_read:
+            continue
+        if (inner_key, outer_key) in seen:
+            continue
+        seen.add((inner_key, outer_key))
+        outer_tok = toks[min(scopes.groups[outer_key])]
+        tok = toks[d]
+        out.append(Diagnostic(
+            ctx.path, tok.line, tok.col, "shadow", "warning",
+            f'declaration of "{name}" shadows declaration at line '
+            f"{outer_tok.line}",
+        ))
+    return out
+
+
+def _rhs_reads(toks, start: int, end: int, name: str) -> bool:
+    """Whether *name* is read in the statement tokens [start, next
+    ``;``] — the RHS of an assignment (ASI guarantees a ``;`` token at
+    the statement's end).  Occurrences past a nested func literal's
+    inner ``;`` only over-report a read, which suppresses a finding —
+    the safe direction."""
+    j = start
+    while j <= end:
+        t = toks[j]
+        if t.kind == OP and t.value == ";":
+            return False
+        if t.kind == IDENT and t.value == name and not (
+            toks[j - 1].kind == OP and toks[j - 1].value == "."
+        ):
+            return True
+        j += 1
+    return False
+
+
+def _run_ineffassign(ctx):
+    """A single-variable assignment whose value is provably overwritten
+    (same block, straight line) or never read before the function ends.
+    Any construct that could carry the value elsewhere — control flow,
+    closures capturing the name, address-of, goto labels, loops — makes
+    the variable opaque and suppresses the finding."""
+    parser = ctx.parser
+    scopes = scopes_of(parser)
+    toks = parser.toks
+    out = []
+    writes_by_func: dict = {}
+    for i, op in parser.plain_assigns:
+        span = enclosing_func(parser, i)
+        if span is None:
+            continue
+        writes_by_func.setdefault(span, []).append((i, op))
+    for span, writes in sorted(writes_by_func.items()):
+        start, end = span
+        captured = captured_names(parser, span)
+        has_labels = any(start <= l <= end for l in parser.labels)
+        # names referenced in go/defer statements: evaluation happens at
+        # another time than the statement's lexical position
+        in_go_defer: set = set()
+        for kw, stop in parser.go_defer:
+            if start <= kw and stop <= end:
+                in_go_defer.update(
+                    toks[j].value
+                    for j in range(kw, stop + 1)
+                    if toks[j].kind == IDENT
+                )
+        writes.sort()
+        write_index = {i: op for i, op in writes}
+        for i, op in writes:
+            if op not in ("=", ":="):
+                continue  # compound ops read the previous value
+            name = toks[i].value
+            if name == "_" or name in captured or name in in_go_defer:
+                continue
+            if toks[i - 1].kind == OP and toks[i - 1].value == "&":
+                continue
+            # only locals: writes resolving outside the recorded local
+            # bindings (parameters, named results, package vars) have
+            # observable lifetimes beyond this function
+            target = (
+                scopes.group_of(i) if i in scopes.decl_set
+                else scopes.resolve(i, name)
+            )
+            if target is None:
+                continue
+            if any(
+                toks[j - 1].kind == OP and toks[j - 1].value == "&"
+                for j in scopes.uses_by_name.get(name, ())
+                if start <= j <= end
+            ):
+                continue  # address taken somewhere in the function
+            block = scopes.innermost(i)
+            in_loop = any(
+                s <= i <= e for s, e in parser.loop_scopes
+            )
+            verdict = None  # "dead-overwrite" | "dead-tail" | None
+            saw_control = False
+            j = i + 2  # skip the ident and its assignment operator
+            while j <= end:
+                t = toks[j]
+                if t.kind == IDENT and t.value == name and not (
+                    toks[j - 1].kind == OP and toks[j - 1].value == "."
+                ):
+                    nxt_op = write_index.get(j)
+                    if (
+                        nxt_op in ("=", ":=")
+                        and not saw_control
+                        and scopes.innermost(j) == block
+                        and not _rhs_reads(toks, j + 2, end, name)
+                    ):
+                        # the overwrite's own RHS (`x = f(x)`,
+                        # `s = append(s, v)`) reads the previous value
+                        # — only a read-free overwrite is a dead store
+                        verdict = "dead-overwrite"
+                    break  # any other occurrence is a read
+                if t.kind == KEYWORD and t.value in CONTROL_KEYWORDS:
+                    saw_control = True
+                    if in_loop:
+                        break  # backward flow could read the value
+                j += 1
+            else:
+                # reached the end of the function without a read: dead,
+                # unless backward flow (loops, goto labels) could reach
+                # a read the lexical scan cannot see
+                if not in_loop and not has_labels:
+                    verdict = "dead-tail"
+            if verdict is not None:
+                tok = toks[i]
+                out.append(Diagnostic(
+                    ctx.path, tok.line, tok.col, "ineffassign", "warning",
+                    f"ineffectual assignment to {name}",
+                ))
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+_TERMINATORS = frozenset(
+    {"return", "goto", "fallthrough", "break", "continue"}
+)
+
+
+def _stmt_terminates(parser, start: int, group_end: int) -> bool:
+    toks = parser.toks
+    k = start
+    while (
+        k + 1 < len(toks)
+        and toks[k].kind == IDENT
+        and toks[k + 1].kind == OP
+        and toks[k + 1].value == ":"
+    ):
+        k += 2  # look through `label:` prefixes
+    t = toks[k]
+    if t.kind == KEYWORD and t.value in _TERMINATORS:
+        return True
+    if (
+        t.kind == IDENT
+        and t.value == "panic"
+        and k + 1 < len(toks)
+        and toks[k + 1].kind == OP
+        and toks[k + 1].value == "("
+    ):
+        return True
+    return False
+
+
+def _run_unreachable(ctx):
+    """Statements following a definitely-terminating statement in the
+    same sibling group.  `if`/`for`/`switch` never count as terminating
+    here (a branch may fall through), and a labeled follower is a goto
+    target, so only unconditional dead code is flagged — once per
+    group, like `go vet`."""
+    parser = ctx.parser
+    toks = parser.toks
+    out = []
+    groups: dict = {}
+    for gid, start in parser.stmt_groups:
+        groups.setdefault(gid, []).append(start)
+    for gid in sorted(groups):
+        starts = groups[gid]
+        for a, b in zip(starts, starts[1:]):
+            if not _stmt_terminates(parser, a, b):
+                continue
+            if (
+                toks[b].kind == IDENT
+                and b + 1 < len(toks)
+                and toks[b + 1].kind == OP
+                and toks[b + 1].value == ":"
+            ):
+                continue  # labeled: reachable via goto
+            tok = toks[b]
+            out.append(Diagnostic(
+                ctx.path, tok.line, tok.col, "unreachable", "warning",
+                "unreachable code",
+            ))
+            break  # one report per group
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _literal_header_mentions(parser, lit_span, name: str) -> bool:
+    """Whether the func literal's header (between its `func` keyword
+    and its body brace) declares *name* — the `func(x T) {...}(x)`
+    idiom that re-binds the loop variable safely."""
+    toks = parser.toks
+    open_i = lit_span[0]
+    k = open_i - 1
+    while k >= 0 and not (
+        toks[k].kind == KEYWORD and toks[k].value == "func"
+    ):
+        k -= 1
+    if k < 0:
+        return True  # malformed span: err on the silent side
+    return any(
+        toks[j].kind == IDENT and toks[j].value == name
+        for j in range(k, open_i)
+    )
+
+
+def _run_loopclosure(ctx):
+    """A `go`/`defer` func literal inside a `range` loop that captures
+    one of the loop's iteration variables — the classic reconcile-loop
+    bug where every goroutine sees the final element."""
+    parser = ctx.parser
+    scopes = scopes_of(parser)
+    toks = parser.toks
+    out = []
+    flagged = set()
+    for decls, body_open, body_close in parser.range_loops:
+        names = {
+            toks[d].value: scopes.group_of(d)
+            for d in decls
+            if toks[d].value != "_"
+        }
+        if not names:
+            continue
+        for kw, stop in parser.go_defer:
+            if not (body_open < kw and stop <= body_close):
+                continue
+            for lit in func_literals_within(parser, (kw, stop)):
+                for name, group in names.items():
+                    for j in scopes.uses_by_name.get(name, ()):
+                        if not (lit[0] < j < lit[1]):
+                            continue
+                        if scopes.resolve(j, name) != group:
+                            continue  # re-bound (`x := x`) or shadowed
+                        if _literal_header_mentions(parser, lit, name):
+                            continue  # passed as a parameter
+                        if j in flagged:
+                            continue
+                        flagged.add(j)
+                        tok = toks[j]
+                        out.append(Diagnostic(
+                            ctx.path, tok.line, tok.col, "loopclosure",
+                            "warning",
+                            f"loop variable {name} captured by func "
+                            "literal",
+                        ))
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+SHADOW = register(Analyzer(
+    name="shadow",
+    doc="inner := re-declaring a name whose outer binding is read "
+        "after the inner scope closes (go vet -shadow)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_shadow,
+    severity="warning",
+))
+
+INEFFASSIGN = register(Analyzer(
+    name="ineffassign",
+    doc="assignments whose value is overwritten or falls out of scope "
+        "before any read (the ineffassign tool)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_ineffassign,
+    severity="warning",
+))
+
+UNREACHABLE = register(Analyzer(
+    name="unreachable",
+    doc="statements after an unconditionally terminating statement "
+        "(go vet -unreachable)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_unreachable,
+    severity="warning",
+))
+
+LOOPCLOSURE = register(Analyzer(
+    name="loopclosure",
+    doc="go/defer closures capturing a range variable "
+        "(go vet -loopclosure)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_loopclosure,
+    severity="warning",
+))

@@ -1,0 +1,187 @@
+"""The multi-pass vet driver (go/analysis-style).
+
+One walk discovers the Go surface under go-tooling pruning rules; the
+driver then computes shared facts at most once per file/package — the
+content-cached parse (``gocheck.parse``), the cross-package index
+(``gocheck.index``), the scope/statement model (facts.py, memoized on
+the parser) — and fans files through ``perf.parallel_map`` in input
+order, so a JOBS=8 run reports byte-identically to the serial loop.
+Per-file diagnostics come back grouped by file with analyzers in
+registry order; project-scope analyzers run once after the fan-out.
+
+A whole run replays from the ``gocheck.analyze`` namespace
+(``OPERATOR_FORGE_CACHE`` off|mem|disk) when the tree's Go surface and
+the analyzer selection are unchanged — the analysis twin of the
+generation pipeline's plan replay.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...perf import parallel_map, spans
+from .. import cache
+from ..cache import project_index
+from ..manifest import MANIFEST
+from ..parser import GoSyntaxError, parse_source
+from ..structural import parse_imports, prune_go_dirs
+from ..tokens import GoTokenError
+from .core import AnalysisError, Diagnostic, resolve
+from .facts import scopes_of
+
+
+class FileContext:
+    """Shared per-file facts handed to file-scope analyzers."""
+
+    def __init__(self, path: str, text: str, parser, manifest: dict):
+        self.path = path
+        self.text = text
+        self.parser = parser
+        self.manifest = manifest
+        self._imports = None
+        self._shadowed = None
+
+    @property
+    def scopes(self):
+        return scopes_of(self.parser)
+
+    @property
+    def imports(self) -> dict:
+        """Import alias -> path (blank and dot imports dropped)."""
+        if self._imports is None:
+            self._imports = {
+                alias: path
+                for alias, path in parse_imports(self.text)
+                if alias not in ("_", ".")
+            }
+        return self._imports
+
+    @property
+    def shadowed(self) -> set:
+        """File-local names that shadow import aliases (typecheck's
+        false-positive guard, shared so every analyzer agrees)."""
+        if self._shadowed is None:
+            from ..typecheck import _shadowed_names
+
+            self._shadowed = _shadowed_names(self.parser, self.text)
+        return self._shadowed
+
+
+class ProjectContext:
+    """Facts for project-scope analyzers."""
+
+    def __init__(self, root: str, index, manifest: dict, files: list):
+        self.root = root
+        self.index = index
+        self.manifest = manifest
+        self.files = files
+
+
+def _go_files(root: str) -> list:
+    files: list = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = prune_go_dirs(dirnames)
+        for name in sorted(filenames):
+            if not name.endswith(".go") or name.startswith(("_", ".")):
+                continue
+            files.append(os.path.join(dirpath, name))
+    return files
+
+
+def _analyze_one(path: str, text: str, file_analyzers, manifest) -> list:
+    """All selected file-scope diagnostics for one source file.  A file
+    that fails to parse contributes its syntax error and nothing else,
+    like the pre-driver walker.  Load failures surface regardless of
+    the analyzer selection — a go/analysis driver never reports a tree
+    it could not load as clean."""
+    try:
+        parser = parse_source(text, path)
+    except (GoSyntaxError, GoTokenError) as exc:
+        from .core import from_text
+
+        return [from_text("syntax", "error", str(exc))]
+    except RecursionError:
+        return [Diagnostic(path, 0, 0, "syntax", "error",
+                           "nesting too deep to parse")]
+    ctx = FileContext(path, text, parser, manifest)
+    out: list = []
+    for analyzer in file_analyzers:
+        if analyzer.run is None:
+            continue  # syntax: handled above
+        out.extend(analyzer.run(ctx))
+    return out
+
+
+def analyze_project(root: str, analyzers=None) -> list:
+    """Run the selected analyzers (default: all registered) over every
+    checked ``.go`` file under *root*; returns Diagnostics in
+    deterministic order (files in walk order, analyzers in registry
+    order, project passes last)."""
+    selected = resolve(analyzers)
+    names = tuple(a.name for a in selected)
+    key = None
+    if cache.replay_enabled():
+        key = cache.analyze_key(root, names)
+        cached = cache.analyze_get(key)
+        if cached is not None:
+            return cached
+    with spans.span("gocheck.analyze"):
+        diagnostics = _analyze_live(root, selected)
+    if key is not None:
+        cache.analyze_put(key, diagnostics)
+    return diagnostics
+
+
+def _analyze_live(root: str, selected) -> list:
+    file_analyzers = [a for a in selected if a.scope == "file"]
+    project_analyzers = [a for a in selected if a.scope == "project"]
+    need_index = any("index" in a.requires for a in selected)
+    manifest = MANIFEST
+    index = None
+    if need_index:
+        index = project_index(root)
+        if index.module is not None:
+            manifest = index.merged_manifest(MANIFEST)
+    files = _go_files(root)
+
+    def analyze_file(path: str) -> list:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            return [Diagnostic(path, 0, 0, "syntax", "error",
+                               f"unreadable: {exc}")]
+        return _analyze_one(path, text, file_analyzers, manifest)
+
+    diagnostics: list = []
+    # per-file analysis is pure: fan out across OPERATOR_FORGE_JOBS,
+    # collecting in input order so the report matches the serial loop
+    for file_diags in parallel_map(analyze_file, files):
+        diagnostics.extend(file_diags)
+    pctx = ProjectContext(root, index, manifest, files)
+    for analyzer in project_analyzers:
+        diagnostics.extend(analyzer.run(pctx))
+    if not files:
+        # an empty match is a wrong path, not a clean project — `go
+        # vet` likewise errors on a pattern matching no files
+        diagnostics.append(Diagnostic(
+            root, 0, 0, "driver", "error", "no Go files found"
+        ))
+    return diagnostics
+
+
+def analyze_source(text: str, filename: str = "<go>",
+                   analyzers=None) -> list:
+    """Run file-scope analyzers over one in-memory source (tests, the
+    golden-fixture lint hook).  Project-scope analyzer names are
+    rejected — they need a tree."""
+    selected = resolve(analyzers)
+    project_scope = [a.name for a in selected if a.scope == "project"]
+    if analyzers is not None and project_scope:
+        raise AnalysisError(
+            "analyzer(s) "
+            + ", ".join(repr(n) for n in project_scope)
+            + " need a project tree; use analyze_project"
+        )
+    file_analyzers = [a for a in selected if a.scope == "file"]
+    return _analyze_one(filename, text, file_analyzers, MANIFEST)

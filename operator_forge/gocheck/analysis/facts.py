@@ -1,0 +1,196 @@
+"""Per-file scope and statement facts for the data-flow analyzers.
+
+Built once per parsed file from the events the parser records (block
+spans, loop scopes, declaration sites with scope-start positions, go/
+defer statement spans, sibling statement groups) and cached on the
+parser instance — parsers are content-cached and consumed read-only, so
+one facts build serves every analyzer of every run in the process.
+
+The model is deliberately token-positional, not an AST: a *scope* is a
+token span (a ``{}`` block or a whole ``for`` statement, whose header
+declarations — including range variables — must not merge with the
+enclosing block), a *binding group* is the set of declarations of one
+name in one scope (Go's ``x, err := ...; y, err := ...`` redeclaration
+makes same-scope declarations one variable), and *resolution* maps an
+identifier use to the innermost group whose scope contains it and whose
+scope-start precedes it.  Everything errs toward merging bindings
+(fewer, larger groups), which makes every consumer conservative: a use
+attributed to an outer binding can only suppress findings, never invent
+them.
+"""
+
+from __future__ import annotations
+
+from ..tokens import IDENT, KEYWORD, OP
+
+
+class Scopes:
+    """The scope model of one parsed file (see module docstring)."""
+
+    __slots__ = (
+        "parser", "scopes", "kinds", "parent", "decl_block", "groups",
+        "group_min_start", "by_name", "decl_set", "label_set",
+        "uses_by_name", "short_decl_set",
+    )
+
+    def __init__(self, parser):
+        self.parser = parser
+        toks = parser.toks
+        # scopes: real blocks plus for/if/switch/select statement
+        # scopes (header declarations live in the statement), sorted so
+        # an enclosing scope sorts before everything it contains
+        tagged = (
+            [(span, "block") for span in parser.blocks]
+            + [(span, "loop") for span in parser.loop_scopes]
+            + [(span, "stmt") for span in parser.stmt_scopes]
+        )
+        tagged.sort(key=lambda s: (s[0][0], -s[0][1]))
+        self.scopes = [span for span, _kind in tagged]
+        self.kinds = [kind for _span, kind in tagged]
+        self.decl_set = frozenset(parser.local_decls)
+        self.short_decl_set = frozenset(parser.short_decls)
+        self.label_set = frozenset(parser.labels)
+        # binding groups: (scope index, name) -> [decl token indices]
+        self.decl_block: dict[int, int] = {}
+        self.groups: dict[tuple, list] = {}
+        self.group_min_start: dict[tuple, int] = {}
+        starts = parser.decl_ops
+        for d in parser.local_decls:
+            name = toks[d].value
+            s = self.innermost(d)
+            self.decl_block[d] = s
+            key = (s, name)
+            self.groups.setdefault(key, []).append(d)
+            start = starts.get(d, d)
+            prev = self.group_min_start.get(key)
+            if prev is None or start < prev:
+                self.group_min_start[key] = start
+        # per-name group lists for resolution, innermost-first
+        self.by_name: dict[str, list] = {}
+        for (s, name), decls in self.groups.items():
+            self.by_name.setdefault(name, []).append((s, name))
+        for name, keys in self.by_name.items():
+            # a contained scope has a later (or equal) open and an
+            # earlier close; sorting by (-open, close) puts it first
+            keys.sort(key=lambda k: (-self._span(k[0])[0],
+                                     self._span(k[0])[1]))
+        # identifier uses (selector tails, declarations and label
+        # definitions excluded), grouped by name in token order
+        self.uses_by_name = {}
+        for j, tok in enumerate(toks):
+            if tok.kind != IDENT:
+                continue
+            if j in self.decl_set or j in self.label_set:
+                continue
+            prev = toks[j - 1] if j else None
+            if prev is not None and prev.kind == OP and prev.value == ".":
+                continue
+            self.uses_by_name.setdefault(tok.value, []).append(j)
+
+    def _span(self, scope_index: int):
+        return self.scopes[scope_index]
+
+    def innermost(self, i: int):
+        """Index of the innermost scope containing token *i* (None at
+        package level)."""
+        best = None
+        for idx, (start, end) in enumerate(self.scopes):
+            if start <= i <= end:
+                if best is None:
+                    best = idx
+                else:
+                    b_start, b_end = self.scopes[best]
+                    if (end - start) < (b_end - b_start):
+                        best = idx
+        return best
+
+    def scope_contains(self, scope_index, i: int) -> bool:
+        if scope_index is None:
+            return True  # package scope contains everything
+        start, end = self.scopes[scope_index]
+        return start <= i <= end
+
+    def resolve(self, j: int, name: str):
+        """The binding group a use of *name* at token *j* refers to, or
+        None when it resolves outside the recorded locals (parameter,
+        package-level, import...).  Innermost scope wins; a use before
+        a group's scope-start looks through to the enclosing scope."""
+        for key in self.by_name.get(name, ()):
+            scope_index = key[0]
+            if not self.scope_contains(scope_index, j):
+                continue
+            if self.group_min_start[key] < j:
+                return key
+        return None
+
+    def group_of(self, d: int):
+        """The binding group of declaration token *d*."""
+        return (self.decl_block.get(d), self.parser.toks[d].value)
+
+    def strictly_inside(self, inner, outer) -> bool:
+        """Whether scope *inner* is properly contained in *outer*
+        (package scope, None, contains every real scope)."""
+        if inner is None:
+            return False
+        if outer is None:
+            return True
+        i_start, i_end = self.scopes[inner]
+        o_start, o_end = self.scopes[outer]
+        return (o_start < i_start and i_end <= o_end) or (
+            o_start <= i_start and i_end < o_end
+        )
+
+
+def scopes_of(parser) -> Scopes:
+    """The (memoized) scope model for *parser*.  Parsers are immutable
+    after construction and shared across threads; the attribute write
+    is an idempotent benign race (both builders produce equal models).
+    """
+    cached = getattr(parser, "_analysis_scopes", None)
+    if cached is None:
+        cached = Scopes(parser)
+        parser._analysis_scopes = cached
+    return cached
+
+
+# Keywords that open control flow the straight-line ineffassign scan
+# cannot see through; hitting one aborts the window conservatively.
+CONTROL_KEYWORDS = frozenset(
+    {"if", "for", "switch", "select", "go", "defer", "goto",
+     "case", "default", "func", "fallthrough", "break", "continue"}
+)
+
+
+def func_literals_within(parser, span) -> list:
+    """Spans of function literals nested inside *span* (any recorded
+    func body properly contained in it)."""
+    start, end = span
+    return [
+        (s, e) for s, e in parser.func_spans if start < s and e <= end
+    ]
+
+
+def enclosing_func(parser, i: int):
+    """The innermost recorded function-body span containing token *i*."""
+    best = None
+    for start, end in parser.func_spans:
+        if start <= i <= end and (
+            best is None or (end - start) < (best[1] - best[0])
+        ):
+            best = (start, end)
+    return best
+
+
+def captured_names(parser, func_span) -> set:
+    """Names that appear inside closures nested in *func_span* — their
+    lifetimes are opaque to straight-line analysis."""
+    names = set()
+    toks = parser.toks
+    for s, e in func_literals_within(parser, func_span):
+        for j in range(s, e + 1):
+            t = toks[j]
+            if t.kind == IDENT and not (
+                j > 0 and toks[j - 1].kind == OP and toks[j - 1].value == "."
+            ):
+                names.add(t.value)
+    return names

@@ -1,0 +1,90 @@
+"""The pre-framework passes, ported onto the analyzer registry.
+
+Each wrapper calls the existing pass unchanged and lifts its finding
+strings into Diagnostics via :func:`core.from_text`, whose rendering
+round-trips byte-identically — the driver's output for these analyzers
+is provably the pre-driver output.
+"""
+
+from __future__ import annotations
+
+from ..lint import semantics_of
+from ..localindex import check_local_calls
+from ..structural import check_structure
+from ..typecheck import types_of
+from .core import Analyzer, from_text, register
+
+
+def _run_lint(ctx):
+    return [
+        from_text("lint", "error", s)
+        for s in semantics_of(ctx.parser, ctx.path)
+    ]
+
+
+def _run_typecheck(ctx):
+    return [
+        from_text("typecheck", "error", s)
+        for s in types_of(ctx.parser, ctx.text, ctx.path, ctx.manifest)
+    ]
+
+
+def _run_structural(pctx):
+    return [
+        from_text("structural", "error", s)
+        for s in check_structure(pctx.root)
+    ]
+
+
+def _run_localcalls(pctx):
+    return [
+        from_text("localcalls", "error", s)
+        for s in check_local_calls(pctx.root, pctx.index)
+    ]
+
+
+SYNTAX = register(Analyzer(
+    name="syntax",
+    doc="full-grammar parse: the errors `go build` reports first "
+        "(tokenizer + recursive-descent parser, Go 1.18+ generics); "
+        "load failures surface regardless of --analyzers selection",
+    scope="file",
+    requires=("parse",),
+    run=None,  # the driver IS the parse step; selection gates emission
+))
+
+LINT = register(Analyzer(
+    name="lint",
+    doc="declared-and-not-used locals (shadow-aware), missing return, "
+        "label defined and not used",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_lint,
+))
+
+TYPECHECK = register(Analyzer(
+    name="typecheck",
+    doc="manifest-driven symbol existence, call arity, literal kinds "
+        "and struct-literal fields for dependency + project packages",
+    scope="file",
+    requires=("parse", "text", "index"),
+    run=_run_typecheck,
+))
+
+STRUCTURAL = register(Analyzer(
+    name="structural",
+    doc="package-level compile errors: unused/duplicate imports, "
+        "duplicate declarations, unresolved qualifiers",
+    scope="project",
+    requires=("text",),
+    run=_run_structural,
+))
+
+LOCALCALLS = register(Analyzer(
+    name="localcalls",
+    doc="intra-project method chains and same-package call arity "
+        "against the indexed project surface",
+    scope="project",
+    requires=("index",),
+    run=_run_localcalls,
+))

@@ -44,7 +44,7 @@ from ..perf import spans
 
 # bump to invalidate previously persisted gocheck entries when the
 # cached record shapes (not the checker's behavior) change
-_SCHEMA = 1
+_SCHEMA = 2  # 2: parser records analysis-pass events (blocks, scopes...)
 
 _lock = threading.Lock()
 _scan_mem: dict = {}    # (sha, path) -> pristine _FileScan
@@ -252,6 +252,31 @@ def check_key(root: str, files=None, **flags) -> str:
         files = tree_state(root)
     return _key("check", root, os.path.abspath(root), files,
                 sorted(flags.items()))
+
+
+def analyze_key(root: str, analyzers: tuple) -> str:
+    """Cache key for one analyzer-driver run: the Go surface's file-hash
+    set (diagnostics are a pure function of pruned .go bytes + go.mod)
+    plus the selected analyzer names in run order.  The root — spelled
+    and resolved — is part of the key because diagnostics embed
+    caller-spelled paths."""
+    return _key("analyze", root, os.path.abspath(root),
+                go_file_state(root), tuple(analyzers))
+
+
+def analyze_get(key: str):
+    """Cached diagnostics list for *key*, or None (``gocheck.analyze``
+    namespace, modes per ``OPERATOR_FORGE_CACHE``)."""
+    if _mode() == "off":
+        return None
+    hit = pf_cache.get_cache().get("gocheck.analyze", key)
+    return None if hit is pf_cache.MISS else hit
+
+
+def analyze_put(key: str, diagnostics) -> None:
+    if _mode() == "off":
+        return
+    pf_cache.get_cache().put("gocheck.analyze", key, diagnostics)
 
 
 def check_get(key: str):

@@ -6,12 +6,17 @@ and not used" compile errors, which a template bug in generated code
 could otherwise only hit at `go build` time in CI.
 
 The analysis is conservative by construction (no false positives at the
-cost of false negatives): any later occurrence of the identifier inside
-its enclosing function body counts as a use — including assignments and
-struct-literal keys, which `go build` would not count.  Shadowed
-declarations therefore may escape detection; unused ones never get
-flagged spuriously.  Validated against the reference checkout's Go
-corpus, which compiles and must produce zero findings.
+cost of false negatives): any occurrence of the identifier that
+RESOLVES to the declaration's binding counts as a use — including
+assignments and struct-literal keys, which `go build` would not count.
+Resolution is scope-aware (delegated to the analysis framework's scope
+pass, analysis/facts.py): an occurrence inside a nested scope that
+re-declares the name binds to the inner declaration, so a shadowed
+outer declaration with no remaining uses is now detected — the false
+negative the pre-framework pass documented.  Bindings the scope model
+cannot attribute merge outward, so unused ones still never get flagged
+spuriously.  Validated against the reference checkout's Go corpus,
+which compiles and must produce zero findings.
 """
 
 from __future__ import annotations
@@ -119,7 +124,15 @@ def semantics_of(parser, filename: str = "<go>") -> list[str]:
                 best = (start, end)
         return best
 
-    reported: set[tuple[tuple[int, int], str]] = set()
+    # scope-aware use resolution: an occurrence counts for the binding
+    # it resolves to, so a use of an inner shadowing declaration no
+    # longer masks an unused outer one (and same-scope redeclarations
+    # — `x, err := ...; y, err := ...` — share one binding, reported
+    # once at the first site, like go build)
+    from .analysis.facts import scopes_of
+
+    scopes = scopes_of(parser)
+    reported_groups: set = set()
     for d in sorted(decl_indices):
         name = toks[d].value
         if name == "_":
@@ -127,24 +140,16 @@ def semantics_of(parser, filename: str = "<go>") -> list[str]:
         span = innermost_span(d)
         if span is None:
             continue
-        if (span, name) in reported:
-            # a later `:=` may re-record an existing variable; go build
-            # reports the unused declaration once, at its first site
+        group = scopes.group_of(d)
+        if group in reported_groups:
             continue
-        used = False
-        for j in range(span[0], span[1] + 1):
-            if j == d or j in decl_indices or j in label_indices:
-                continue
-            t = toks[j]
-            if t.kind != IDENT or t.value != name:
-                continue
-            prev = toks[j - 1]
-            if prev.kind == OP and prev.value == ".":
-                continue  # selector: x.name is not a use of local `name`
-            used = True
-            break
+        reported_groups.add(group)
+        used = any(
+            scopes.resolve(j, name) == group
+            for j in scopes.uses_by_name.get(name, ())
+            if span[0] <= j <= span[1]
+        )
         if not used:
-            reported.add((span, name))
             tok = toks[d]
             findings.append(
                 f"{filename}:{tok.line}:{tok.col}: "

@@ -660,3 +660,40 @@ MANIFEST: dict[str, dict] = {
 from .stdmanifest import STD_MANIFEST  # noqa: E402
 
 MANIFEST.update(STD_MANIFEST)
+
+
+# -- analyzer side tables (analysis/apichecks.py) --------------------------
+
+# Functions whose LAST result is `error`: the errcheck analyzer flags a
+# bare expression-statement call of one of these — the error is
+# silently discarded, the template-bug class behind lost reconcile
+# failures.  Listed per import path; only enumerated names are checked
+# (fmt-style print functions are deliberately absent, like the errcheck
+# tool's default excludes).
+ERROR_RESULTS: dict[str, frozenset] = {
+    "sigs.k8s.io/yaml": frozenset({
+        "Marshal", "Unmarshal", "UnmarshalStrict", "JSONToYAML",
+        "YAMLToJSON",
+    }),
+    "sigs.k8s.io/controller-runtime": frozenset({
+        "SetControllerReference",
+    }),
+    "sigs.k8s.io/controller-runtime/pkg/controller/controllerutil": (
+        frozenset({"SetControllerReference", "SetOwnerReference"})
+    ),
+    "encoding/json": frozenset({"Marshal", "Unmarshal"}),
+    "os": frozenset({
+        "Chdir", "Chmod", "Chown", "Mkdir", "MkdirAll", "Remove",
+        "RemoveAll", "Rename", "Setenv", "Symlink", "Truncate",
+        "Unsetenv", "WriteFile",
+    }),
+}
+
+# Types whose values contain a lock (sync.Mutex or equivalent no-copy
+# state): the copylocks analyzer flags function signatures passing or
+# returning one BY VALUE.  Per import path, like ERROR_RESULTS.
+LOCK_TYPES: dict[str, frozenset] = {
+    "sync": frozenset({
+        "Mutex", "RWMutex", "WaitGroup", "Once", "Cond", "Pool", "Map",
+    }),
+}

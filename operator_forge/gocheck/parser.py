@@ -93,6 +93,26 @@ class _Parser:
         self.qual_calls: list[tuple[int, int, int, bool]] = []
         # (alias tok, name tok, nargs, call-site `...` spread)
         self.qual_literals: list[tuple[int, int, list[str]]] = []
+        # Analysis-pass events (see analysis/): the scope and statement
+        # structure the data-flow analyzers consume.
+        self.blocks: list[tuple[int, int]] = []  # ('{' tok, '}' tok)
+        self.loop_scopes: list[tuple[int, int]] = []  # (for kw, '}' tok)
+        self.stmt_scopes: list[tuple[int, int]] = []  # if/switch/select
+        # statement spans: their header declarations scope to the
+        # statement (incl. else chains), not to the enclosing block
+        self.range_loops: list[tuple[tuple[int, ...], int, int]] = []
+        # (range-decl ident toks, body '{' tok, body '}' tok)
+        self.stmt_groups: list[tuple[int, int]] = []  # (group id, start tok)
+        self._next_group = 0
+        self.go_defer: list[tuple[int, int]] = []  # (kw tok, end tok)
+        self.expr_stmts: list[tuple[int, int]] = []  # (start, end) spans
+        self.plain_assigns: list[tuple[int, str]] = []
+        # (ident tok, op) for single-plain-ident LHS assignments
+        self.short_decls: list[int] = []  # `:=`-declared subset of
+        # local_decls (the shadow analyzer flags only these)
+        self.decl_ops: dict[int, int] = {}  # decl ident tok -> token
+        # index where its scope starts (end of the declaring statement:
+        # the RHS of `x := x` reads the OUTER x, per spec)
 
     # -- token plumbing ---------------------------------------------------
 
@@ -233,11 +253,21 @@ class _Parser:
         if self.at_op("="):
             self.advance()
             self.expr_list()
+            self._set_scope_start(indices)
             return
         self.parse_type()
         if self.at_op("="):
             self.advance()
             self.expr_list()
+        self._set_scope_start(indices)
+
+    def _set_scope_start(self, indices: list[int]) -> None:
+        """Record where the declared names come into scope: after the
+        declaring spec/statement, so RHS reads (`var x = x`) resolve to
+        the outer binding."""
+        if self.func_depth > 0:
+            for idx in indices:
+                self.decl_ops[idx] = self.i
 
     def type_spec(self):
         self.expect_ident()
@@ -536,6 +566,7 @@ class _Parser:
     # -- statements -------------------------------------------------------
 
     def block(self):
+        open_i = self.i
         self.expect_op("{")
         self.block_depth += 1
         try:
@@ -543,10 +574,17 @@ class _Parser:
         finally:
             self.block_depth -= 1
         self.expect_op("}")
+        self.blocks.append((open_i, self.i - 1))
 
     def stmt_list(self):
+        # every statement list (block body, switch/select clause) is one
+        # sibling group: the unreachable analyzer walks consecutive
+        # statements of a group
+        gid = self._next_group
+        self._next_group += 1
         self.skip_semis()
         while not (self.at_op("}") or self.at_kw("case", "default") or self.tok.kind == EOF):
+            self.stmt_groups.append((gid, self.i))
             self.statement()
             self.skip_semis()
 
@@ -600,8 +638,10 @@ class _Parser:
                 self.expect_semi()
                 return
             if v in ("go", "defer"):
+                kw_i = self.i
                 self.advance()
                 self.expression()
+                self.go_defer.append((kw_i, self.i))
                 self.expect_semi()
                 return
         if t.kind == OP and t.value == "{":
@@ -618,7 +658,10 @@ class _Parser:
             else:
                 self.expect_semi()
             return
-        self.simple_stmt()
+        start = self.i
+        tag = self.simple_stmt()
+        if tag == "expr":
+            self.expr_stmts.append((start, self.i))
         self.expect_semi()
 
     def simple_stmt(self, in_header: bool = False) -> str:
@@ -640,22 +683,35 @@ class _Parser:
             self.expression()
             return "assign"
         if self.tok.kind == OP and self.tok.value in _ASSIGN_OPS:
-            if self.tok.value == ":=":
-                self._record_short_decl(lhs_start, self.i)
+            op = self.tok.value
+            declared: list[int] = []
+            if op == ":=":
+                declared = self._record_short_decl(lhs_start, self.i)
+            single_plain = (
+                self.func_depth > 0
+                and self.i == lhs_start + 1
+                and self.toks[lhs_start].kind == IDENT
+            )
             self.advance()
             if in_header and self.at_kw("range"):
                 self.advance()
                 self.expression()
+                self._set_scope_start(declared)
                 return "range"
             self.expr_list()
+            self._set_scope_start(declared)
+            if single_plain:
+                self.plain_assigns.append((lhs_start, op))
             return "assign"
         return "expr"
 
-    def _record_short_decl(self, lhs_start: int, assign_i: int) -> None:
+    def _record_short_decl(self, lhs_start: int, assign_i: int) -> list[int]:
         """Record the LHS idents of a ``:=`` (a valid LHS is a plain
-        comma-separated identifier list, so anything else is skipped)."""
+        comma-separated identifier list, so anything else is skipped).
+        Returns the recorded indices so the caller can mark where their
+        scope starts once the RHS has been consumed."""
         if self.func_depth == 0:
-            return
+            return []
         indices = []
         expect_ident = True
         for j in range(lhs_start, assign_i):
@@ -666,9 +722,12 @@ class _Parser:
             elif not expect_ident and t.kind == OP and t.value == ",":
                 expect_ident = True
             else:
-                return  # not a plain ident list (syntactically invalid Go)
+                return []  # not a plain ident list (syntactically invalid Go)
         if not expect_ident:
             self.local_decls.extend(indices)
+            self.short_decls.extend(indices)
+            return indices
+        return []
 
     def header_clause(self) -> bool:
         """Parse an if/switch clause: [SimpleStmt ;] [SimpleStmt] before '{'.
@@ -698,6 +757,7 @@ class _Parser:
             self.allow_composite = saved
 
     def if_stmt(self):
+        if_i = self.i
         self.expect_kw("if")
         if not self.header_clause():
             self.error("missing condition in if statement")
@@ -706,25 +766,32 @@ class _Parser:
             self.advance()
             if self.at_kw("if"):
                 self.if_stmt()
+                self.stmt_scopes.append((if_i, self.i - 1))
                 return
             self.block()
             self.expect_semi()
         else:
             self.expect_semi()
+        self.stmt_scopes.append((if_i, self.i - 1))
 
     def for_stmt(self):
+        for_i = self.i
         self.expect_kw("for")
         saved = self.allow_composite
         self.allow_composite = False
+        n_decls = len(self.local_decls)
+        is_range = False
         if self.at_op("{"):
             pass  # infinite loop
         elif self.at_kw("range"):
+            is_range = True  # `for range x` — no iteration variables
             self.advance()
             self.expression()
         else:
             tag = None
             if not self.at_op(";"):
                 tag = self.simple_stmt(in_header=True)
+            is_range = tag == "range"
             if tag != "range" and self.at_op(";"):
                 self.advance()
                 if not self.at_op(";"):
@@ -733,10 +800,19 @@ class _Parser:
                 if not self.at_op("{"):
                     self.simple_stmt()
         self.allow_composite = saved
+        range_decls = tuple(self.local_decls[n_decls:]) if is_range else ()
+        body_open = self.i
         self.block()
+        # the for statement is a scope of its own: header-declared names
+        # (incl. range variables) live here, not in the enclosing block —
+        # sibling loops reusing a name must not merge into one binding
+        self.loop_scopes.append((for_i, self.i - 1))
+        if is_range:
+            self.range_loops.append((range_decls, body_open, self.i - 1))
         self.expect_semi()
 
     def switch_stmt(self):
+        switch_i = self.i
         self.expect_kw("switch")
         self.header_clause()
         self.expect_op("{")
@@ -757,6 +833,7 @@ class _Parser:
         finally:
             self.block_depth -= 1
         self.expect_op("}")
+        self.stmt_scopes.append((switch_i, self.i - 1))
         self.expect_semi()
 
     def case_item(self):
@@ -776,6 +853,7 @@ class _Parser:
         self.expression()
 
     def select_stmt(self):
+        select_i = self.i
         self.expect_kw("select")
         self.expect_op("{")
         self.block_depth += 1  # comm-clause bodies are nested statements
@@ -789,6 +867,7 @@ class _Parser:
         finally:
             self.block_depth -= 1
         self.expect_op("}")
+        self.stmt_scopes.append((select_i, self.i - 1))
         self.expect_semi()
 
     # -- expressions ------------------------------------------------------

@@ -1,19 +1,13 @@
-"""Project-tree walker for the Go syntax checker."""
+"""Project-tree walker for the Go syntax checker.
+
+Since the analyzer framework (analysis/), this is a thin rendering
+shim: the multi-pass driver computes everything, and ``check_project``
+renders the pre-framework analyzer set's structured diagnostics back
+into the legacy strings — byte-identical to the original per-pass
+walker, as tests/test_analysis_framework.py proves.
+"""
 
 from __future__ import annotations
-
-import os
-
-from ..perf import parallel_map
-from . import cache
-from .cache import project_index
-from .lint import semantics_of
-from .localindex import check_local_calls
-from .manifest import MANIFEST
-from .parser import GoSyntaxError, parse_source
-from .structural import check_structure, prune_go_dirs
-from .tokens import GoTokenError
-from .typecheck import types_of
 
 
 def check_project(root: str) -> list[str]:
@@ -26,68 +20,16 @@ def check_project(root: str) -> list[str]:
     and which may use build tags or language versions this checker does
     not model.  Unreadable or non-UTF-8 files are reported as errors,
     not raised.
+
+    Runs the legacy analyzer composition (syntax, lint, typecheck,
+    structural, localcalls) through the shared driver: facts are
+    computed once per file, files fan out across OPERATOR_FORGE_JOBS
+    in input order, and unchanged trees replay from the
+    ``gocheck.analyze`` cache.
     """
-    # the whole report is a pure function of the Go surface's bytes
-    # (vet reads only pruned .go files plus go.mod), so an unchanged
-    # surface replays the previous report; off mode skips the hashing
-    key = None
-    if cache.replay_enabled():
-        key = cache.check_key(root, files=cache.go_file_state(root),
-                              op="vet")
-        cached = cache.check_get(key)
-        if cached is not None:
-            return cached
-    errors: list[str] = []
-    # index the project's own packages so qualified references between
-    # them are checked closed, like the dependency manifest; the index
-    # is content-cached on the project's file-hash set, so re-checking
-    # an unchanged tree reuses it instead of re-scanning every file
-    index = project_index(root)
-    manifest = MANIFEST
-    if index.module is not None:
-        manifest = index.merged_manifest(MANIFEST)
-    files: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = prune_go_dirs(dirnames)
-        for name in sorted(filenames):
-            # like Go tooling: only .go files not prefixed with '_' or '.'
-            if not name.endswith(".go") or name.startswith(("_", ".")):
-                continue
-            files.append(os.path.join(dirpath, name))
-    checked = len(files)
+    from .analysis import LEGACY_ANALYZERS, analyze_project
 
-    def check_file(path: str) -> list[str]:
-        try:
-            with open(path, encoding="utf-8") as fh:
-                text = fh.read()
-        except (OSError, UnicodeDecodeError) as exc:
-            return [f"{path}: unreadable: {exc}"]
-        try:
-            parsed = parse_source(text, path)
-        except (GoSyntaxError, GoTokenError) as exc:
-            return [str(exc)]
-        except RecursionError:
-            return [f"{path}: nesting too deep to parse"]
-        out = list(semantics_of(parsed, path))
-        out.extend(types_of(parsed, text, path, manifest))
-        return out
-
-    # files are independent pure checks: fan them out across
-    # OPERATOR_FORGE_JOBS, collecting per-file error lists in input
-    # order so the report is identical to the serial loop (and to any
-    # process-pool batch leg wrapping this vet)
-    for file_errors in parallel_map(check_file, files):
-        errors.extend(file_errors)
-    # package-level structural checks (imports, duplicate funcs,
-    # unresolved qualifiers) — these tolerate unreadable files, so an
-    # error in one package doesn't suppress findings in another
-    errors.extend(check_structure(root))
-    # intra-project method chains and same-package call arity
-    errors.extend(check_local_calls(root, index))
-    if checked == 0:
-        # an empty match is a wrong path, not a clean project — `go vet`
-        # likewise errors on a package pattern matching no files
-        errors.append(f"{root}: no Go files found")
-    if key is not None:
-        cache.check_put(key, errors)
-    return errors
+    return [
+        diag.text()
+        for diag in analyze_project(root, analyzers=LEGACY_ANALYZERS)
+    ]

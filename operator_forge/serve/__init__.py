@@ -8,7 +8,8 @@ time on a GIL-bound thread pool.  This package amortizes the warmth PR 1
 requests and *many* cores:
 
 - :mod:`operator_forge.serve.jobs` — the job model: a manifest of N
-  init/create-api/vet/test requests over distinct output directories,
+  init/create-api/vet/lint/test requests over distinct output
+  directories,
   normalized to CLI argv vectors with deterministic ids;
 - :mod:`operator_forge.serve.runner` — executes one job in-process with
   file-hash dirty-tracking through the shared

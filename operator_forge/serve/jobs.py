@@ -1,9 +1,13 @@
 """Job model for ``operator-forge batch`` and ``serve``.
 
 A *job* is one CLI-equivalent request — ``init``, ``create-api``,
-``vet``, or ``test`` — normalized from a manifest entry (or a serve
-request) into the argv vector :func:`operator_forge.cli.main.main`
-accepts.  Manifests are YAML (or JSON — a JSON document is valid YAML):
+``vet``, ``lint``, or ``test`` — normalized from a manifest entry (or a
+serve request) into the argv vector :func:`operator_forge.cli.main.main`
+accepts.  ``lint`` is ``vet`` for machines: it runs the analyzer
+framework (optionally a selected subset via ``analyzers: a,b``) and
+always emits one JSON diagnostic object per line, so batch/serve
+clients never parse human text.  Manifests are YAML (or JSON — a JSON
+document is valid YAML):
 
 .. code-block:: yaml
 
@@ -44,6 +48,7 @@ COMMANDS = {
     "init": ("workload_config", "output_dir", "repo"),
     "create-api": ("workload_config", "output_dir"),
     "vet": ("path",),
+    "lint": ("path", "analyzers"),
     "test": ("path", "e2e", "run"),
 }
 
@@ -63,6 +68,7 @@ class Job:
     repo: str = ""
     e2e: bool = False
     run: str = ""
+    analyzers: str = ""
 
     def target(self) -> str:
         """The directory this job is 'about' — its output dir for
@@ -102,6 +108,13 @@ class Job:
                     self.workload_config, "--output-dir", self.output_dir]
         if self.command == "vet":
             return ["vet", self.path]
+        if self.command == "lint":
+            # structured by design: lint exists so batch/serve clients
+            # stop parsing human vet text
+            out = ["vet", self.path, "--json"]
+            if self.analyzers:
+                out += ["--analyzers", self.analyzers]
+            return out
         out = ["test", self.path]
         if self.e2e:
             out.append("--e2e")
@@ -187,6 +200,7 @@ def jobs_from_specs(specs, base_dir: str) -> list:
             repo=str(spec.get("repo", "")),
             e2e=bool(spec.get("e2e", False)),
             run=str(spec.get("run", "")),
+            analyzers=str(spec.get("analyzers", "")),
         )
         if command in ("init", "create-api"):
             if not job.workload_config or not job.output_dir:

@@ -11,7 +11,7 @@ the response, never interleaved with the protocol stream):
 
 - ``{"op": "ping"}`` — liveness + version;
 - ``{"op": "job", "job": {<job spec>}}`` (or the spec inlined with a
-  ``command`` key) — run one init/create-api/vet/test job;
+  ``command`` key) — run one init/create-api/vet/lint/test job;
 - ``{"op": "batch", "jobs": [<specs...>]}`` — run a batch through the
   orchestrator (grouped, fanned out, input-order results);
 - ``{"op": "stats"}`` — cache hit/miss counters and the span table the

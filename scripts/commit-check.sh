@@ -77,6 +77,36 @@ print(
     )
 )
 
+# analyzer framework (PR 4): the full analyzer set must report ZERO
+# findings on the emitted kitchen-sink tree, serial (JOBS=1), parallel
+# (JOBS=8) and cached re-runs must report byte-identical diagnostics in
+# every cache mode, and the warm (replayed) analysis must clear the
+# same 3x bar as the gocheck/batch gates.
+analyze = detail["analyze"]
+assert analyze["findings"] == 0, (
+    "%d analyzer findings on the emitted kitchen-sink tree"
+    % analyze["findings"]
+)
+assert analyze["warm_matches_cold"] is True, "analyzer warm replay diverged"
+for cache_mode, ok in analyze["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"analyzer serial/parallel/cached identity failed "
+        f"(cache={cache_mode})"
+    )
+assert analyze["warm_speedup"] >= 3, (
+    "warm analyzer run below the 3x bar: %.2f" % analyze["warm_speedup"]
+)
+print(
+    "analyzer contract OK: 0 findings, cold=%.3fs warm=%.3fs (x%.1f), "
+    "identity clean in %d cache modes"
+    % (
+        analyze["cold_cpu_s_median"],
+        analyze["warm_cpu_s_median"],
+        analyze["warm_speedup"],
+        len(analyze["identity_by_cache_mode"]),
+    )
+)
+
 # batch determinism (PR 3): serial, thread-parallel, and process-pool
 # batches must produce byte-identical output trees (and normalized
 # reports) in every cache mode, and the warm batch must clear the 3x
@@ -102,6 +132,23 @@ print(
     )
 )
 PYEOF
+
+# Analyzer zero-findings gate over the reference corpus (when the
+# checkout is mounted): the corpus compiles, so every analyzer —
+# including the data-flow set — must stay silent on it.
+if [[ -d /root/reference ]]; then
+    echo "analyzer reference-corpus gate: /root/reference"
+    (cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+from operator_forge.gocheck.analysis import analyze_project
+
+diags = analyze_project("/root/reference")
+for diag in diags[:20]:
+    print(diag.analyzer, diag.text())
+assert not diags, f"{len(diags)} analyzer findings on the reference corpus"
+print("reference corpus: analyzer-clean")
+PYEOF
+    )
+fi
 
 # Archive the slowest tests so future perf PRs can target them.
 # Heavy (full tier-1 run): skip with SKIP_DURATIONS=1 when iterating.

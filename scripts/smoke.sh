@@ -37,8 +37,16 @@ printf 'Copyright Smoke Test.\n' > "${WORK}/lic.txt"
 run update license --source-header-license "${WORK}/lic.txt" \
     --output-dir "${PROJ}"
 
-echo "==> vet (full-grammar parse + semantic + literal-kind gate)"
+echo "==> vet (analyzer framework: parse + semantic + data-flow gate)"
 run vet "${PROJ}"
+
+echo "==> vet --json with an analyzer subset (must emit nothing on a clean tree)"
+json_out="$(run vet "${PROJ}" --json --analyzers lint,shadow,structtag)"
+if [[ -n "${json_out}" ]]; then
+  echo "unexpected analyzer diagnostics:" >&2
+  echo "${json_out}" >&2
+  exit 1
+fi
 
 echo "==> the generated project's OWN test suite (interpreted go test ./...)"
 run test "${PROJ}" --e2e

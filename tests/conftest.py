@@ -30,6 +30,46 @@ except Exception:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    """Lint the golden Go fixtures before collection proper: drift in a
+    regenerated golden (syntax damage, unused/shadowed declarations,
+    broken struct tags) surfaces as a loud analyzer diagnostic here
+    instead of an opaque conformance diff later."""
+    from operator_forge.gocheck.analysis import analyze_source
+
+    golden_root = os.path.join(os.path.dirname(__file__), "golden")
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(golden_root):
+        for name in sorted(filenames):
+            if not name.endswith(".go.txt"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            # goldens are file fragments (type decls without a package
+            # clause); wrap so the parser sees a complete file, and
+            # undo the wrapper line so reported positions match the
+            # fixture on disk
+            import dataclasses
+
+            diags = analyze_source(
+                "package golden\n" + text,
+                os.path.relpath(path, golden_root),
+                analyzers=("syntax", "lint", "shadow", "structtag"),
+            )
+            problems.extend(
+                dataclasses.replace(
+                    diag, line=diag.line - 1
+                ).text() if diag.line > 1 else diag.text()
+                for diag in diags
+            )
+    if problems:
+        raise pytest.UsageError(
+            "golden Go fixtures fail the analyzer gate:\n  "
+            + "\n  ".join(problems)
+        )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_perf_state():
     """Isolate the process-global perf state (content cache, spans)

@@ -1087,3 +1087,121 @@ def kill_stats(entries) -> tuple[int, int, float]:
     killed = sum(1 for _m, verdict in entries if verdict is not None)
     total = len(entries)
     return killed, total, (killed / total if total else 1.0)
+
+
+# -- analyzer kill oracles (PR 4) ------------------------------------------
+#
+# One mutant per data-flow analyzer, each a realistic codegen regression
+# applied to the emitted standalone project: the analyzer is the kill
+# oracle (>= 1 diagnostic on the mutated file, 0 on the pristine one).
+# Shared by tests/test_analyzers.py; replacements apply first-occurrence
+# in order, so a mutant can touch the import block plus a signature.
+
+ANALYZER_MUTANTS = [
+    {
+        "analyzer": "shadow",
+        "path": "test/e2e/shop_bookstore_test.go",
+        "detail": "`=` regressed to `:=`: the namespace default only "
+                  "lands in a shadow, children converge in the wrong "
+                  "namespace",
+        "replacements": [(
+            "childNamespace = workload.GetNamespace()",
+            "childNamespace := workload.GetNamespace()",
+        )],
+    },
+    {
+        "analyzer": "ineffassign",
+        "path": "controllers/shop/bookstore_controller.go",
+        "detail": "the reconcile result is computed, zeroed, and the "
+                  "zero value returned — requeue decisions are lost",
+        "replacements": [(
+            "\treturn r.Phases.HandleExecution(r, req)\n",
+            "\tresult, err := r.Phases.HandleExecution(r, req)\n"
+            "\tresult = ctrl.Result{}\n"
+            "\treturn ctrl.Result{}, err\n",
+        )],
+    },
+    {
+        "analyzer": "unreachable",
+        "path": "controllers/shop/bookstore_controller.go",
+        "detail": "a fallback return emitted after the phase dispatch "
+                  "can never run",
+        "replacements": [(
+            "\treturn r.Phases.HandleExecution(r, req)\n",
+            "\treturn r.Phases.HandleExecution(r, req)\n"
+            "\treturn ctrl.Result{}, nil\n",
+        )],
+    },
+    {
+        "analyzer": "errcheck",
+        "path": "controllers/shop/bookstore_controller_test.go",
+        "detail": "the sample-decode error check was dropped: a bad "
+                  "sample silently tests an empty workload",
+        "replacements": [(
+            "\tif err := sigsyaml.Unmarshal([]byte(bookstore.Sample("
+            "false)), workload); err != nil {\n"
+            "\t\tt.Fatalf(\"unable to decode sample: %v\", err)\n"
+            "\t}\n",
+            "\tsigsyaml.Unmarshal([]byte(bookstore.Sample(false)), "
+            "workload)\n",
+        )],
+    },
+    {
+        "analyzer": "loopclosure",
+        "path": "test/e2e/shop_bookstore_test.go",
+        "detail": "per-child cleanup deferred inside the range loop "
+                  "without re-binding: every defer deletes the last "
+                  "child",
+        "replacements": [(
+            "\tfor _, child := range children {\n"
+            "\t\tchild := child\n"
+            "\t\tgvk := child.GetObjectKind().GroupVersionKind()\n",
+            "\tfor _, child := range children {\n"
+            "\t\tdefer func() { _ = k8sClient.Delete(ctx, child) }()\n"
+            "\t\tgvk := child.GetObjectKind().GroupVersionKind()\n",
+        )],
+    },
+    {
+        "analyzer": "copylocks",
+        "path": "controllers/shop/bookstore_controller.go",
+        "detail": "a state lock threaded through Reconcile by value: "
+                  "every call copies the mutex and guards nothing",
+        "replacements": [
+            ('\t"context"\n', '\t"context"\n\t"sync"\n'),
+            (
+                "func (r *BookStoreReconciler) Reconcile(ctx "
+                "context.Context, request ctrl.Request) (ctrl.Result, "
+                "error) {",
+                "func (r *BookStoreReconciler) Reconcile(ctx "
+                "context.Context, request ctrl.Request, stateLock "
+                "sync.Mutex) (ctrl.Result, error) {\n\t_ = stateLock",
+            ),
+        ],
+    },
+    {
+        "analyzer": "structtag",
+        "path": "apis/shop/v1alpha1/bookstore_types.go",
+        "detail": "a field-marker name collision: two spec fields "
+                  "serialize to the same json key",
+        "replacements": [(
+            'Image string `json:"image,omitempty"`',
+            'Image string `json:"replicas,omitempty"`',
+        )],
+    },
+]
+
+
+def apply_analyzer_mutant(proj: str, mutant: dict) -> tuple[str, str]:
+    """Return (original, mutated) source for one ANALYZER_MUTANTS entry
+    against a scaffolded project; asserts every replacement site exists
+    so template drift surfaces as a loud failure, not a vacuous pass."""
+    path = os.path.join(proj, mutant["path"])
+    with open(path, encoding="utf-8") as fh:
+        original = fh.read()
+    mutated = original
+    for old, new in mutant["replacements"]:
+        assert old in mutated, (
+            f"mutant site missing in {mutant['path']}: {old!r}"
+        )
+        mutated = mutated.replace(old, new, 1)
+    return original, mutated

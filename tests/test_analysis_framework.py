@@ -1,0 +1,325 @@
+"""Analyzer-framework contract (PR 4 tentpole).
+
+The driver may only ever change HOW diagnostics are produced, never
+WHAT they say: serial == parallel, cache off == mem == disk, replayed
+== live, and the legacy analyzer composition renders byte-identically
+to the pre-framework per-pass walker.
+"""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import check_project
+from operator_forge.gocheck.analysis import (
+    LEGACY_ANALYZERS,
+    AnalysisError,
+    analyze_project,
+    analyze_source,
+    registry,
+)
+from operator_forge.perf import cache as perfcache
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def standalone(tmp_path_factory) -> str:
+    out = str(tmp_path_factory.mktemp("analysis") / "proj")
+    config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+    with contextlib.redirect_stdout(io.StringIO()):
+        for argv in (
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/analysis", "--output-dir", out],
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out],
+        ):
+            assert cli_main(argv) == 0
+    return out
+
+
+@pytest.fixture()
+def broken(standalone, tmp_path) -> str:
+    """A copy of the generated project with seeded findings for every
+    legacy pass: a syntax error, an unused local, an unknown manifest
+    symbol, and an unused import."""
+    import shutil
+
+    proj = str(tmp_path / "broken")
+    shutil.copytree(standalone, proj)
+    pkg = os.path.join(proj, "brokenpkg")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "bad_syntax.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write("package brokenpkg\n\nfunc f( {\n")
+    with open(os.path.join(pkg, "bad_semantics.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(
+            "package brokenpkg\n\n"
+            'import "fmt"\n\n'
+            "func g() {\n"
+            "\tunused := 1\n"
+            '\tfmt.Println("x")\n'
+            "}\n"
+        )
+    with open(os.path.join(pkg, "bad_types.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(
+            "package brokenpkg\n\n"
+            'import "os"\n\n'
+            "func h() {\n"
+            "\tos.NoSuchFunction()\n"
+            "}\n"
+        )
+    return proj
+
+
+def dicts(diags):
+    return [d.to_dict() for d in diags]
+
+
+class TestRegistry:
+    def test_canonical_set_and_order(self):
+        names = list(registry())
+        assert names[:5] == [
+            "syntax", "lint", "typecheck", "structural", "localcalls"
+        ]
+        for new in ("shadow", "ineffassign", "unreachable",
+                    "loopclosure", "errcheck", "copylocks", "structtag"):
+            assert new in names
+        for analyzer in registry().values():
+            assert analyzer.doc
+            assert analyzer.scope in ("file", "project")
+            assert analyzer.severity in ("error", "warning")
+
+    def test_unknown_analyzer_rejected(self, standalone):
+        with pytest.raises(AnalysisError, match="nosuch"):
+            analyze_project(standalone, analyzers=["nosuch"])
+
+    def test_selection_runs_subset_only(self, broken):
+        diags = analyze_project(broken, analyzers=["lint"])
+        assert diags, "seeded unused local not found"
+        # load errors always surface (a driver never reports a tree it
+        # could not parse as clean); beyond that, only the selection
+        assert {d.analyzer for d in diags} == {"lint", "syntax"}
+        assert any(d.analyzer == "lint" for d in diags)
+
+    def test_parse_failures_surface_under_any_selection(self, broken):
+        diags = analyze_project(broken, analyzers=["structtag"])
+        assert any(d.analyzer == "syntax" for d in diags), (
+            "a subset selection must not report an unparseable tree "
+            "as clean"
+        )
+
+    def test_project_scope_rejected_for_single_source(self):
+        with pytest.raises(AnalysisError, match="structural"):
+            analyze_source("package p\n", "p.go",
+                           analyzers=["structural"])
+
+
+class TestLegacyByteIdentity:
+    def test_check_project_matches_composed_passes(self, broken):
+        """check_project (now driver-backed) must render exactly what
+        the pre-framework walker composed: per-file syntax-or-
+        (semantics+types), then structural, then local calls."""
+        from operator_forge.gocheck.cache import project_index
+        from operator_forge.gocheck.lint import semantics_of
+        from operator_forge.gocheck.localindex import check_local_calls
+        from operator_forge.gocheck.manifest import MANIFEST
+        from operator_forge.gocheck.parser import (
+            GoSyntaxError,
+            parse_source,
+        )
+        from operator_forge.gocheck.structural import (
+            check_structure,
+            prune_go_dirs,
+        )
+        from operator_forge.gocheck.tokens import GoTokenError
+        from operator_forge.gocheck.typecheck import types_of
+
+        expected = []
+        index = project_index(broken)
+        manifest = index.merged_manifest(MANIFEST)
+        files = []
+        for dirpath, dirnames, filenames in os.walk(broken):
+            dirnames[:] = prune_go_dirs(dirnames)
+            for name in sorted(filenames):
+                if not name.endswith(".go") or name.startswith(("_", ".")):
+                    continue
+                files.append(os.path.join(dirpath, name))
+        for path in files:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            try:
+                parsed = parse_source(text, path)
+            except (GoSyntaxError, GoTokenError) as exc:
+                expected.append(str(exc))
+                continue
+            expected.extend(semantics_of(parsed, path))
+            expected.extend(types_of(parsed, text, path, manifest))
+        expected.extend(check_structure(broken))
+        expected.extend(check_local_calls(broken, index))
+
+        got = check_project(broken)
+        assert got == expected
+        assert any("expected" in line for line in got)  # syntax seeded
+        assert any("declared and not used" in line for line in got)
+        assert any("no symbol" in line for line in got)
+
+    def test_clean_tree_still_clean(self, standalone):
+        assert check_project(standalone) == []
+
+    def test_empty_tree_reports_no_go_files(self, tmp_path):
+        out = check_project(str(tmp_path))
+        assert out == [f"{tmp_path}: no Go files found"]
+
+
+class TestDeterminism:
+    def test_repeat_runs_identical(self, broken):
+        perfcache.configure(mode="off")
+        assert dicts(analyze_project(broken)) == dicts(
+            analyze_project(broken)
+        )
+
+    def test_jobs_1_equals_jobs_8(self, broken, monkeypatch):
+        perfcache.configure(mode="off")
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        serial = dicts(analyze_project(broken))
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "8")
+        parallel = dicts(analyze_project(broken))
+        assert serial == parallel
+
+    def test_cache_modes_byte_identical(self, broken, tmp_path):
+        reference = None
+        for cache_mode in ("off", "mem", "disk"):
+            perfcache.configure(
+                mode=cache_mode,
+                root=str(tmp_path / "cache")
+                if cache_mode == "disk" else None,
+            )
+            perfcache.reset()
+            got = dicts(analyze_project(broken))
+            if reference is None:
+                reference = got
+            assert got == reference, f"diverged under cache={cache_mode}"
+
+    def test_warm_rerun_replays(self, standalone):
+        perfcache.configure(mode="mem")
+        cold = dicts(analyze_project(standalone))
+        warm = dicts(analyze_project(standalone))
+        assert cold == warm == []
+        stats = perfcache.stats().get("gocheck.analyze", {})
+        assert stats.get("hits", 0) >= 1
+
+    def test_touched_file_invalidates_replay(self, standalone, tmp_path):
+        import shutil
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        perfcache.configure(mode="mem")
+        assert analyze_project(proj) == []
+        path = os.path.join(proj, "main.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text + "\nfunc deadCodeProbe() {\n\tx := 1\n}\n")
+        diags = analyze_project(proj)
+        assert any(
+            d.analyzer == "lint" and "x declared and not used" in d.message
+            for d in diags
+        )
+
+
+class TestVetCLI:
+    def test_json_stream_stable_key_order(self, broken, capsys):
+        rc = cli_main(["vet", broken, "--json"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        lines = [line for line in out.splitlines() if line.strip()]
+        assert lines, "no diagnostics emitted"
+        for line in lines:
+            obj = json.loads(line)
+            assert list(obj) == [
+                "file", "line", "col", "analyzer", "severity", "message"
+            ]
+
+    def test_json_clean_tree_emits_nothing(self, standalone, capsys):
+        assert cli_main(["vet", standalone, "--json"]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_analyzers_flag_selects_subset(self, broken, capsys):
+        rc = cli_main(["vet", broken, "--json", "--analyzers",
+                       "lint,shadow"])
+        assert rc == 1
+        analyzers = {
+            json.loads(line)["analyzer"]
+            for line in capsys.readouterr().out.splitlines()
+            if line.strip()
+        }
+        # syntax load errors always ride along; nothing else beyond
+        # the selection may appear
+        assert analyzers <= {"lint", "shadow", "syntax"}
+        assert "lint" in analyzers
+
+    def test_unknown_analyzer_is_a_cli_error(self, standalone, capsys):
+        assert cli_main(["vet", standalone, "--analyzers", "bogus"]) == 1
+        assert "unknown analyzer" in capsys.readouterr().err
+
+    def test_human_output_unchanged_for_legacy_selection(
+        self, broken, capsys
+    ):
+        spelled = ",".join(LEGACY_ANALYZERS)
+        rc = cli_main(["vet", broken, "--analyzers", spelled])
+        assert rc == 1
+        err = capsys.readouterr().err
+        expected = check_project(broken)
+        assert [
+            line for line in err.splitlines() if not line.startswith("vet:")
+        ] == expected
+
+
+class TestLintJobKind:
+    def test_lint_job_emits_json_diagnostics(self, broken):
+        from operator_forge.serve.batch import run_batch
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        jobs = jobs_from_specs(
+            [{"command": "lint", "path": broken, "analyzers": "lint"}],
+            os.path.dirname(broken),
+        )
+        (result,) = run_batch(jobs)
+        assert result.rc == 1
+        payload = [
+            json.loads(line)
+            for line in result.stdout.splitlines()
+            if line.strip()
+        ]
+        assert payload and all(
+            obj["analyzer"] in ("lint", "syntax") for obj in payload
+        )
+        assert any(obj["analyzer"] == "lint" for obj in payload)
+
+    def test_lint_job_clean_tree_ok(self, standalone):
+        from operator_forge.serve.batch import run_batch
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        jobs = jobs_from_specs(
+            [{"command": "lint", "path": standalone}],
+            os.path.dirname(standalone),
+        )
+        (result,) = run_batch(jobs)
+        assert result.ok
+        assert result.stdout == ""
+
+    def test_lint_job_validates_path(self):
+        from operator_forge.serve.jobs import (
+            BatchManifestError,
+            jobs_from_specs,
+        )
+
+        with pytest.raises(BatchManifestError, match="path is required"):
+            jobs_from_specs([{"command": "lint"}], "/tmp")

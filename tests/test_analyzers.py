@@ -1,0 +1,320 @@
+"""Per-analyzer fixtures (PR 4): one firing and one non-firing Go
+sample per data-flow analyzer, the emitted-tree zero-findings gate, and
+the analyzer-oracle mutation battery (one realistic codegen regression
+per analyzer, killed by exactly that analyzer)."""
+
+import os
+
+import pytest
+
+from operator_forge.gocheck.analysis import analyze_source
+
+import mutation_oracle
+
+
+def findings(src: str, analyzer: str, extra=()) -> list:
+    diags = analyze_source(
+        src, "fixture.go", analyzers=[analyzer, *extra]
+    )
+    return [d for d in diags if d.analyzer == analyzer]
+
+
+class TestShadow:
+    def test_fires_on_block_level_shadow_still_read(self):
+        src = (
+            "package p\n\n"
+            'import "fmt"\n\n'
+            "func f(items []int) int {\n"
+            "\ttotal := 0\n"
+            "\tfor _, item := range items {\n"
+            "\t\ttotal := total + item\n"
+            "\t\tfmt.Println(total)\n"
+            "\t}\n"
+            "\treturn total\n"
+            "}\n"
+        )
+        (diag,) = findings(src, "shadow")
+        assert 'declaration of "total" shadows' in diag.message
+        assert "line 6" in diag.message
+        assert diag.line == 8
+
+    def test_silent_on_rebind_idiom_and_if_headers(self):
+        src = (
+            "package p\n\n"
+            'import "fmt"\n\n'
+            "func f(items []int) error {\n"
+            "\tfor _, item := range items {\n"
+            "\t\titem := item\n"
+            "\t\tdefer func() { fmt.Println(item) }()\n"
+            "\t}\n"
+            "\terr := fmt.Errorf(\"outer\")\n"
+            "\tif err := fmt.Errorf(\"inner\"); err != nil {\n"
+            "\t\tfmt.Println(err)\n"
+            "\t}\n"
+            "\treturn err\n"
+            "}\n"
+        )
+        assert findings(src, "shadow") == []
+
+
+class TestIneffassign:
+    def test_fires_on_overwrite_before_read(self):
+        src = (
+            "package p\n\n"
+            "func f() int {\n"
+            "\tx := compute()\n"
+            "\tx = 2\n"
+            "\treturn x\n"
+            "}\n\n"
+            "func compute() int { return 1 }\n"
+        )
+        (diag,) = findings(src, "ineffassign")
+        assert diag.message == "ineffectual assignment to x"
+        assert diag.line == 4
+
+    def test_silent_when_overwrite_rhs_reads_previous_value(self):
+        src = (
+            "package p\n\n"
+            "func f(h func(int) int, vs []int) ([]int, int) {\n"
+            "\tx := 1\n"
+            "\tx = h(x)\n"
+            "\tout := []int{}\n"
+            "\tout = append(out, vs...)\n"
+            "\treturn out, x\n"
+            "}\n"
+        )
+        assert findings(src, "ineffassign") == []
+
+    def test_silent_when_read_between_or_conditional(self):
+        src = (
+            "package p\n\n"
+            'import "fmt"\n\n'
+            "func f(ok bool) int {\n"
+            "\tx := 1\n"
+            "\tfmt.Println(x)\n"
+            "\tx = 2\n"
+            "\tif ok {\n"
+            "\t\tx = 3\n"
+            "\t}\n"
+            "\treturn x\n"
+            "}\n"
+        )
+        assert findings(src, "ineffassign") == []
+
+    def test_silent_on_closures_loops_and_address_of(self):
+        src = (
+            "package p\n\n"
+            "func f(use func(), get func() int) func() int {\n"
+            "\tx := 0\n"
+            "\tfor i := 0; i < 3; i++ {\n"
+            "\t\tx = get()\n"
+            "\t\tuse()\n"
+            "\t}\n"
+            "\ty := 0\n"
+            "\tp := &y\n"
+            "\ty = 5\n"
+            "\t_ = p\n"
+            "\treturn func() int { return x }\n"
+            "}\n"
+        )
+        assert findings(src, "ineffassign") == []
+
+
+class TestUnreachable:
+    def test_fires_after_terminating_statement(self):
+        src = (
+            "package p\n\n"
+            'import "fmt"\n\n'
+            "func f() int {\n"
+            "\treturn 1\n"
+            '\tfmt.Println("never")\n'
+            "\treturn 2\n"
+            "}\n"
+        )
+        diags = findings(src, "unreachable")
+        assert [d.message for d in diags] == ["unreachable code"]
+        assert diags[0].line == 7  # once per group, at the first dead stmt
+
+    def test_silent_on_branches_and_goto_targets(self):
+        src = (
+            "package p\n\n"
+            "func f(ok bool) int {\n"
+            "\tif ok {\n"
+            "\t\treturn 1\n"
+            "\t}\n"
+            "\treturn 2\n"
+            "}\n\n"
+            "func g(n int) int {\n"
+            "\tgoto done\n"
+            "done:\n"
+            "\treturn n\n"
+            "}\n"
+        )
+        assert findings(src, "unreachable") == []
+
+
+class TestErrcheck:
+    SRC = (
+        "package p\n\n"
+        'import "sigs.k8s.io/yaml"\n\n'
+        "func f(data []byte, obj interface{}) {\n"
+        "\t%s\n"
+        "}\n"
+    )
+
+    def test_fires_on_bare_manifest_error_call(self):
+        (diag,) = findings(self.SRC % "yaml.Unmarshal(data, obj)",
+                           "errcheck")
+        assert diag.message == (
+            "error return value of yaml.Unmarshal is not checked"
+        )
+
+    def test_silent_when_error_is_consumed_or_discarded_explicitly(self):
+        for stmt in (
+            "_ = yaml.Unmarshal(data, obj)",
+            "err := yaml.Unmarshal(data, obj); _ = err",
+        ):
+            assert findings(self.SRC % stmt, "errcheck") == []
+
+
+class TestLoopclosure:
+    SRC = (
+        "package p\n\n"
+        "func f(items []string, sink func(string)) {\n"
+        "\tfor _, item := range items {\n"
+        "%s"
+        "\t}\n"
+        "}\n"
+    )
+
+    def test_fires_on_go_and_defer_captures(self):
+        body = "\t\tgo func() {\n\t\t\tsink(item)\n\t\t}()\n"
+        (diag,) = findings(self.SRC % body, "loopclosure")
+        assert diag.message == (
+            "loop variable item captured by func literal"
+        )
+
+    def test_silent_on_rebind_param_and_sync_calls(self):
+        for body in (
+            # re-bound before capture
+            "\t\titem := item\n"
+            "\t\tgo func() {\n\t\t\tsink(item)\n\t\t}()\n",
+            # passed as a parameter
+            "\t\tgo func(item string) {\n\t\t\tsink(item)\n\t\t}(item)\n",
+            # synchronous closure: runs before the next iteration
+            "\t\tfunc() {\n\t\t\tsink(item)\n\t\t}()\n",
+        ):
+            assert findings(self.SRC % body, "loopclosure") == []
+
+
+class TestCopylocks:
+    def test_fires_on_value_param_and_result(self):
+        src = (
+            "package p\n\n"
+            'import "sync"\n\n'
+            "func f(mu sync.Mutex) {\n"
+            "\tmu.Lock()\n"
+            "}\n\n"
+            "func g() sync.WaitGroup {\n"
+            "\tvar wg sync.WaitGroup\n"
+            "\treturn wg\n"
+            "}\n"
+        )
+        msgs = [d.message for d in findings(src, "copylocks")]
+        assert "sync.Mutex passed by value: contains a lock" in msgs
+        assert (
+            "sync.WaitGroup returned by value: contains a lock" in msgs
+        )
+
+    def test_silent_on_pointers_slices_and_func_types(self):
+        src = (
+            "package p\n\n"
+            'import "sync"\n\n'
+            "func f(mu *sync.Mutex, pool []sync.Mutex, "
+            "m map[string]*sync.Mutex) {\n"
+            "\tmu.Lock()\n"
+            "\t_ = pool\n"
+            "\t_ = m\n"
+            "}\n\n"
+            "var hook func(sync.Mutex)\n"
+        )
+        assert findings(src, "copylocks") == []
+
+
+class TestStructtag:
+    def test_fires_on_duplicate_and_malformed_tags(self):
+        src = (
+            "package p\n\n"
+            "type Spec struct {\n"
+            "\tName string `json:\"name\"`\n"
+            "\tAlias string `json:\"name,omitempty\"`\n"
+            "\tBad string `json:name`\n"
+            "}\n"
+        )
+        msgs = [d.message for d in findings(src, "structtag")]
+        assert any("repeats json tag 'name'" in m for m in msgs)
+        assert any("malformed tag" in m for m in msgs)
+
+    def test_silent_on_conventional_and_unexported(self):
+        src = (
+            "package p\n\n"
+            "type Spec struct {\n"
+            "\tName string `json:\"name,omitempty\" yaml:\"name\"`\n"
+            "\tInline Meta `json:\",inline\"`\n"
+            "\tSkip string `json:\"-\"`\n"
+            "}\n\n"
+            "type Meta struct{}\n\n"
+            "type hidden struct {\n"
+            "\tA string `json:bad`\n"  # unexported: out of contract
+            "}\n"
+        )
+        assert findings(src, "structtag") == []
+
+
+class TestEmittedTreesClean:
+    @pytest.fixture(scope="class")
+    def standalone(self, tmp_path_factory):
+        return mutation_oracle.scaffold_standalone(
+            str(tmp_path_factory.mktemp("analyzer-clean"))
+        )
+
+    def test_all_analyzers_zero_findings_on_emitted_project(
+        self, standalone
+    ):
+        from operator_forge.gocheck.analysis import analyze_project
+
+        assert [d.text() for d in analyze_project(standalone)] == []
+
+    def test_analyzer_mutants_killed_by_their_analyzer(self, standalone):
+        """Each ANALYZER_MUTANTS entry is a realistic codegen
+        regression the named analyzer — and only a live analyzer —
+        catches: >= 1 finding on the mutated file, 0 on the pristine
+        one."""
+        assert len(mutation_oracle.ANALYZER_MUTANTS) == 7
+        assert {
+            m["analyzer"] for m in mutation_oracle.ANALYZER_MUTANTS
+        } == {
+            "shadow", "ineffassign", "unreachable", "errcheck",
+            "loopclosure", "copylocks", "structtag",
+        }
+        for mutant in mutation_oracle.ANALYZER_MUTANTS:
+            original, mutated = mutation_oracle.apply_analyzer_mutant(
+                standalone, mutant
+            )
+            name = mutant["analyzer"]
+            path = mutant["path"]
+            clean = [
+                d for d in analyze_source(original, path,
+                                          analyzers=[name])
+                if d.analyzer == name
+            ]
+            assert clean == [], f"{name} fires on pristine {path}"
+            killed = [
+                d for d in analyze_source(mutated, path,
+                                          analyzers=[name])
+                if d.analyzer == name
+            ]
+            assert killed, (
+                f"{name} missed its mutant in {path}: "
+                f"{mutant['detail']}"
+            )
