@@ -540,13 +540,15 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
             COMPREPLY=($(compgen -W "standalone collection component" -- "$cur"));;
         update)
             COMPREPLY=($(compgen -W "license" -- "$cur"));;
+        cache)
+            COMPREPLY=($(compgen -W "gc" -- "$cur"));;
         completion)
             COMPREPLY=($(compgen -W "bash zsh fish" -- "$cur"));;
         *)
@@ -557,16 +559,17 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test batch serve'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from update' -a 'license'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from cache' -a 'gc'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from completion' -a 'bash zsh fish'
 """
 
@@ -795,12 +798,59 @@ def cmd_batch(args: argparse.Namespace) -> int:
 
 def cmd_serve(args: argparse.Namespace) -> int:
     """`serve`: keep one resident process hot and answer JSON-lines
-    requests on stdin (ping/job/batch/stats/shutdown), one JSON
-    response line each — warm caches and compiled interpreter bodies
-    persist across requests."""
+    requests on stdin (ping/job/batch/watch/stats/shutdown), one JSON
+    response line each (watch streams one per cycle) — warm caches and
+    compiled interpreter bodies persist across requests."""
     from ..serve.server import serve_loop
 
     return serve_loop()
+
+
+def cmd_watch(args: argparse.Namespace) -> int:
+    """`watch`: the edit loop, served — run a batch manifest's jobs,
+    then poll their input trees (mtime+hash) and re-run the minimal
+    job set on every change.  Deltas feed the dependency graph
+    (perf/depgraph.py), so a one-file edit recomputes only that file's
+    artifacts plus their transitive dependents: the index is patched,
+    unchanged files' diagnostics replay, untouched test packages'
+    suites replay, and untouched job groups skip entirely.  Each cycle
+    prints its per-cycle `graph` dirty/reused/recomputed counts."""
+    from ..serve.watch import cmd_watch as run
+
+    return run(
+        args.manifest,
+        cycles=args.cycles if args.cycles > 0 else None,
+        interval=args.interval,
+        json_lines=args.json,
+    )
+
+
+def cmd_cache_gc(args: argparse.Namespace) -> int:
+    """`cache gc`: prune the on-disk content cache to its size ceiling
+    (OPERATOR_FORGE_CACHE_MAX_MB, default 256), least-recently-used
+    entries first.  Removal is whole-file, so surviving entries always
+    verify; a pruned entry is simply a future miss."""
+    import json as _json
+
+    max_bytes = None
+    if args.max_mb is not None:
+        max_bytes = int(args.max_mb * 1024 * 1024)
+    summary = perfcache.gc(max_bytes)
+    if args.json:
+        print(_json.dumps(summary))
+    else:
+        print(
+            "cache gc: %d entries, %.1f MiB -> %.1f MiB "
+            "(%d removed, ceiling %.0f MiB)"
+            % (
+                summary["entries"],
+                summary["bytes_before"] / (1024 * 1024),
+                summary["bytes_after"] / (1024 * 1024),
+                summary["removed"],
+                summary["max_bytes"] / (1024 * 1024),
+            )
+        )
+    return 0
 
 
 @functools.cache
@@ -1030,6 +1080,48 @@ def build_parser() -> argparse.ArgumentParser:
              "across requests)",
     )
     p_serve.set_defaults(func=cmd_serve)
+
+    p_watch = sub.add_parser(
+        "watch",
+        help="watch a batch manifest's input trees and re-run the "
+             "minimal job set on every change (incremental edit loop)",
+    )
+    p_watch.add_argument(
+        "--manifest", required=True,
+        help="YAML/JSON job manifest (same format as `batch`)",
+    )
+    p_watch.add_argument(
+        "--interval", type=float, default=0.5, metavar="SECONDS",
+        help="poll interval (default 0.5s)",
+    )
+    p_watch.add_argument(
+        "--cycles", type=int, default=0, metavar="N",
+        help="stop after N job runs (0 = watch until interrupted)",
+    )
+    p_watch.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON line per cycle instead of human summaries",
+    )
+    p_watch.set_defaults(func=cmd_watch)
+
+    p_cache = sub.add_parser(
+        "cache", help="manage the content-addressed cache"
+    )
+    cache_sub = p_cache.add_subparsers(dest="cache_command", required=True)
+    p_gc = cache_sub.add_parser(
+        "gc",
+        help="prune the disk cache to its size ceiling "
+             "(OPERATOR_FORGE_CACHE_MAX_MB, default 256), LRU first",
+    )
+    p_gc.add_argument(
+        "--max-mb", type=float, default=None, metavar="MB",
+        help="one-off ceiling override for this collection",
+    )
+    p_gc.add_argument(
+        "--json", action="store_true",
+        help="emit the collection summary as JSON",
+    )
+    p_gc.set_defaults(func=cmd_cache_gc)
 
     return parser
 

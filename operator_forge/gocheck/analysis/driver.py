@@ -3,23 +3,37 @@
 One walk discovers the Go surface under go-tooling pruning rules; the
 driver then computes shared facts at most once per file/package — the
 content-cached parse (``gocheck.parse``), the cross-package index
-(``gocheck.index``), the scope/statement model (facts.py, memoized on
-the parser) — and fans files through ``perf.parallel_map`` in input
-order, so a JOBS=8 run reports byte-identically to the serial loop.
-Per-file diagnostics come back grouped by file with analyzers in
-registry order; project-scope analyzers run once after the fan-out.
+(``gocheck.index``, patched incrementally through
+``ProjectIndex.apply_delta`` when the tree drifts), the scope/statement
+model (facts.py, memoized on the parser) — and fans files through
+``perf.parallel_map`` in input order, so a JOBS=8 run reports
+byte-identically to the serial loop.  Per-file diagnostics come back
+grouped by file with analyzers in registry order; project-scope
+analyzers run once after the fan-out.
 
-A whole run replays from the ``gocheck.analyze`` namespace
-(``OPERATOR_FORGE_CACHE`` off|mem|disk) when the tree's Go surface and
-the analyzer selection are unchanged — the analysis twin of the
-generation pipeline's plan replay.
+Two replay granularities (``OPERATOR_FORGE_CACHE`` off|mem|disk):
+
+- a whole run replays from the ``gocheck.analyze`` namespace when the
+  tree's Go surface and the analyzer selection are unchanged — the
+  analysis twin of the generation pipeline's plan replay;
+- when the whole-run key misses (the edit-one-file loop), each file's
+  diagnostics replay individually from the ``gocheck.analyze.file``
+  namespace through the :mod:`~operator_forge.perf.depgraph` graph:
+  a file's node is keyed on its own content hash and carries, as
+  automatically recorded edges, the signatures of the cross-file facts
+  it actually consulted (the manifest entries of its imports — project
+  package surfaces included), so an edit re-analyzes only the touched
+  file plus any file whose consulted facts changed.
 """
 
 from __future__ import annotations
 
 import os
+from collections.abc import Mapping
 
+from ... import __version__
 from ...perf import parallel_map, spans
+from ...perf.depgraph import GRAPH
 from .. import cache
 from ..cache import project_index
 from ..manifest import MANIFEST
@@ -33,7 +47,7 @@ from .facts import scopes_of
 class FileContext:
     """Shared per-file facts handed to file-scope analyzers."""
 
-    def __init__(self, path: str, text: str, parser, manifest: dict):
+    def __init__(self, path: str, text: str, parser, manifest):
         self.path = path
         self.text = text
         self.parser = parser
@@ -75,6 +89,96 @@ class ProjectContext:
         self.index = index
         self.manifest = manifest
         self.files = files
+
+
+#: dependency-key marker for "iterated the whole manifest"
+_ALL = "<all>"
+
+
+class _RecordingManifest(Mapping):
+    """A read-only manifest view that reports every key an analyzer
+    consults — the automatic edge recording of the dependency graph.
+    Key lookups record that key; iteration records :data:`_ALL` (the
+    whole surface becomes the dependency)."""
+
+    __slots__ = ("_base", "_record")
+
+    def __init__(self, base: dict, record):
+        self._base = base
+        self._record = record
+
+    def __getitem__(self, key):
+        self._record(key)
+        return self._base[key]
+
+    def get(self, key, default=None):
+        self._record(key)
+        return self._base.get(key, default)
+
+    def __contains__(self, key) -> bool:
+        self._record(key)
+        return key in self._base
+
+    def __iter__(self):
+        self._record(_ALL)
+        return iter(self._base)
+
+    def __len__(self) -> int:
+        return len(self._base)
+
+
+def _plain(value):
+    """Make a manifest entry hashable for :func:`operator_forge.perf
+    .cache.hash_parts`: only sets need converting (tagged + sorted);
+    dict ordering and sequence encoding are hash_parts' own canonical
+    rules — not duplicated here."""
+    if isinstance(value, (set, frozenset)):
+        return ("<set>",) + tuple(
+            sorted((_plain(v) for v in value), key=repr)
+        )
+    if isinstance(value, dict):
+        return {key: _plain(val) for key, val in value.items()}
+    if isinstance(value, (list, tuple)):
+        return tuple(_plain(v) for v in value)
+    return value
+
+
+# entry-identity keyed surface-signature memo: manifest entries are
+# plain dicts rebuilt only when their package's surface changes (the
+# stdlib manifest never, a project's merged manifest once per index),
+# so pinning the entry object alongside its signature lets repeated
+# edit-loop cycles skip re-canonicalizing hundreds of entries.  The
+# pinned reference keeps the id() stable, so identity can never alias.
+_surface_memo: dict = {}  # name -> (entry object, sig)
+
+cache.pf_cache.get_cache().reset_hooks.append(_surface_memo.clear)
+
+
+class _SurfaceSigs:
+    """Lazy signatures of the cross-file facts a file-scope analyzer
+    can consult: one per manifest entry (a package's exported surface),
+    plus the whole-manifest signature for :data:`_ALL`.  Safe under the
+    parallel fan-out (worst case two threads compute the same hash)."""
+
+    def __init__(self, manifest: dict):
+        self._manifest = manifest
+        self._all_sig = None
+
+    def sig(self, name):
+        if name is _ALL or name == _ALL:
+            if self._all_sig is None:
+                self._all_sig = cache.hash_surface(
+                    _ALL, _plain(self._manifest)
+                )
+            return self._all_sig
+        entry = self._manifest.get(name)
+        memo = _surface_memo.get(name)
+        if memo is not None and memo[0] is entry:
+            return memo[1]
+        source = _plain(entry) if entry is not None else "<absent>"
+        got = cache.hash_surface(name, source)
+        _surface_memo[name] = (entry, got)
+        return got
 
 
 def _go_files(root: str) -> list:
@@ -136,6 +240,7 @@ def _analyze_live(root: str, selected) -> list:
     file_analyzers = [a for a in selected if a.scope == "file"]
     project_analyzers = [a for a in selected if a.scope == "project"]
     need_index = any("index" in a.requires for a in selected)
+    replaying = cache.replay_enabled() and bool(file_analyzers)
     manifest = MANIFEST
     index = None
     if need_index:
@@ -143,15 +248,57 @@ def _analyze_live(root: str, selected) -> list:
         if index.module is not None:
             manifest = index.merged_manifest(MANIFEST)
     files = _go_files(root)
+    surfaces = _SurfaceSigs(manifest)
+    file_names = tuple(a.name for a in file_analyzers)
 
-    def analyze_file(path: str) -> list:
+    def current_sig_for(path: str, sha: str):
+        def current_sig(dep_key):
+            kind = dep_key[0]
+            if kind == "pkg":
+                return surfaces.sig(dep_key[1])
+            if kind == "src" and dep_key[1] == path:
+                return sha
+            return None
+
+        return current_sig
+
+    def read_and_analyze(path: str, manifest_view) -> list:
         try:
             with open(path, encoding="utf-8") as fh:
                 text = fh.read()
         except (OSError, UnicodeDecodeError) as exc:
             return [Diagnostic(path, 0, 0, "syntax", "error",
                                f"unreadable: {exc}")]
-        return _analyze_one(path, text, file_analyzers, manifest)
+        return _analyze_one(path, text, file_analyzers, manifest_view)
+
+    def analyze_file(path: str) -> list:
+        if not replaying:
+            return read_and_analyze(path, manifest)
+        # the stat-validated hash costs a stat, not a read: a
+        # replayed file is never even opened
+        sha = cache.file_sha_stat(path)
+        if sha is None:
+            return read_and_analyze(path, manifest)
+        # per-file node: keyed on the file's own bytes (+ the selected
+        # analyzers); cross-file facts it consulted ride along as
+        # recorded edges, validated against this run's surfaces.  The
+        # source edge is what the watch loop's reverse-dependency
+        # sweep invalidates on an edit.
+        key = ("analyze.file", cache._SCHEMA, __version__, path, sha,
+               file_names)
+        recording = _RecordingManifest(
+            manifest,
+            lambda name: GRAPH.read(("pkg", name), surfaces.sig(name)),
+        )
+
+        def build() -> list:
+            GRAPH.read(("src", path), sha)
+            return read_and_analyze(path, recording)
+
+        return GRAPH.memo(
+            "gocheck.analyze.file", key, current_sig_for(path, sha),
+            build,
+        )
 
     diagnostics: list = []
     # per-file analysis is pure: fan out across OPERATOR_FORGE_JOBS,

@@ -44,12 +44,16 @@ from ..perf import spans
 
 # bump to invalidate previously persisted gocheck entries when the
 # cached record shapes (not the checker's behavior) change
-_SCHEMA = 2  # 2: parser records analysis-pass events (blocks, scopes...)
+_SCHEMA = 3  # 3: ProjectIndex carries its per-file scan table (deltas)
 
 _lock = threading.Lock()
 _scan_mem: dict = {}    # (sha, path) -> pristine _FileScan
 _parse_mem: dict = {}   # (sha, filename) -> _Parser (read-only, shared)
 _index_mem: dict = {}   # key -> ProjectIndex (read-only, shared)
+# (root, abspath) -> (go_file_state, ProjectIndex): the last index per
+# root, kept so a changed tree patches it (ProjectIndex.apply_delta)
+# instead of re-reading every file
+_index_prev: dict = {}
 
 
 def _reset_identity() -> None:
@@ -57,6 +61,8 @@ def _reset_identity() -> None:
         _scan_mem.clear()
         _parse_mem.clear()
         _index_mem.clear()
+        _index_prev.clear()
+        _sha_stat_mem.clear()
     from . import compiler
 
     compiler.reset()
@@ -67,6 +73,51 @@ pf_cache.get_cache().reset_hooks.append(_reset_identity)
 
 def source_sha(text: str) -> str:
     return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+# -- stat-validated file hashing ------------------------------------------
+#
+# The edit loop re-snapshots whole trees every cycle; re-reading and
+# re-hashing every unchanged file dominates the warm path.  Hashes are
+# memoized per path, validated by (mtime_ns, size, inode) — and, like
+# the Go build cache's "racy timestamp" rule, trusted only once the
+# file's mtime is strictly older than the moment it was hashed, so an
+# in-place rewrite inside the filesystem's timestamp granularity can
+# never serve a stale hash.
+
+# quiet period before a memoized hash is trusted: must exceed the
+# WORST mtime granularity in the wild (1s on HFS+/some NFS), not just
+# Linux's — Go's build cache uses the same ~2s rule
+_RACY_NS = 2_000_000_000
+_sha_stat_mem: dict = {}  # path -> (mtime_ns, size, ino, hashed_at_ns, sha)
+
+
+def file_sha_stat(path: str):
+    """`perf.cache.file_sha` with a stat-validated memo (see above)."""
+    import time
+
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    with _lock:
+        entry = _sha_stat_mem.get(path)
+    if (
+        entry is not None
+        and entry[0] == st.st_mtime_ns
+        and entry[1] == st.st_size
+        and entry[2] == st.st_ino
+        and st.st_mtime_ns + _RACY_NS < entry[3]
+    ):
+        return entry[4]
+    sha = pf_cache.file_sha(path)
+    if sha is not None:
+        with _lock:
+            _sha_stat_mem[path] = (
+                st.st_mtime_ns, st.st_size, st.st_ino,
+                time.time_ns(), sha,
+            )
+    return sha
 
 
 def _mode() -> str:
@@ -82,6 +133,14 @@ def replay_enabled() -> bool:
 
 def _key(stage: str, *parts) -> str:
     return pf_cache.hash_parts(_SCHEMA, __version__, stage, *parts)
+
+
+def hash_surface(name, plain) -> str:
+    """Signature of one cross-file fact (a manifest entry's canonical
+    plain-data form) — the edge signature of the per-file analysis
+    nodes.  Version-keyed, so a generator upgrade invalidates every
+    recorded edge."""
+    return _key("surface", str(name), plain)
 
 
 def _memoized_build(stage: str, mem: dict, ident, key: str,
@@ -185,7 +244,7 @@ def tree_state(root: str) -> tuple:
             path = os.path.join(dirpath, name)
             if not os.path.isfile(path):
                 continue
-            sha = pf_cache.file_sha(path)
+            sha = file_sha_stat(path)
             out.append((os.path.relpath(path, root).replace(os.sep, "/"),
                         sha))
     return tuple(out)
@@ -200,7 +259,7 @@ def go_file_state(root: str) -> tuple:
     out = []
     gomod = os.path.join(root, "go.mod")
     if os.path.isfile(gomod):
-        out.append(("go.mod", pf_cache.file_sha(gomod)))
+        out.append(("go.mod", file_sha_stat(gomod)))
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
@@ -208,31 +267,75 @@ def go_file_state(root: str) -> tuple:
                 continue
             path = os.path.join(dirpath, name)
             out.append((os.path.relpath(path, root).replace(os.sep, "/"),
-                        pf_cache.file_sha(path)))
+                        file_sha_stat(path)))
     return tuple(sorted(out))
 
 
 # -- the cross-package project index -------------------------------------
 
 
-def project_index(root: str):
+def project_index(root: str, state: tuple | None = None):
     """A :class:`ProjectIndex` for *root*, keyed on its file-hash set
     instead of rebuilt per ``check_project`` call.  Indexes are
-    consumed read-only, so in-process hits share one instance."""
+    consumed read-only, so in-process hits share one instance.
+
+    When the file-hash set misses (the edit-loop case), the previous
+    index for this root is *patched* through
+    :meth:`~operator_forge.gocheck.localindex.ProjectIndex.apply_delta`
+    — re-reading only the changed/removed files — instead of re-derived
+    from scratch; delta and full builds are provably equal (both derive
+    packages from the same scan set).  ``state`` lets a caller that
+    already walked the Go surface pass its ``go_file_state`` along.
+    """
+    from ..perf.depgraph import GRAPH
     from .localindex import ProjectIndex
 
     if _mode() == "off":
         with spans.span("gocheck.index"):
             return ProjectIndex(root)
+    if state is None:
+        state = go_file_state(root)
     # the root — as spelled AND resolved — is part of the key: indexed
     # scans embed caller-spelled paths (error locations), so identical
     # trees at different roots, or the same root spelled differently
     # ('./proj' vs 'proj'), must not share an index
-    key = _key("index", root, os.path.abspath(root), go_file_state(root))
-    return _memoized_build(
-        "gocheck.index", _index_mem, key, key, "gocheck.index",
-        lambda: ProjectIndex(root),
-    )
+    ident = (root, os.path.abspath(root))
+    key = _key("index", root, os.path.abspath(root), state)
+    with _lock:
+        value = _index_mem.get(key)
+    cache = pf_cache.get_cache()
+    if value is None and _mode() == "disk":
+        hit = cache.get("gocheck.index", key, record_stats=False)
+        if hit is not pf_cache.MISS:
+            with _lock:
+                value = _index_mem.setdefault(key, hit)
+    if value is None:
+        cache._count("gocheck.index", "misses")
+        with _lock:
+            prev = _index_prev.get(ident)
+        with spans.span("gocheck.index"):
+            if prev is not None and prev[0] != state:
+                prev_map = dict(prev[0])
+                cur_map = dict(state)
+                changed = [
+                    rel for rel, sha in cur_map.items()
+                    if prev_map.get(rel) != sha
+                ]
+                removed = [rel for rel in prev_map if rel not in cur_map]
+                value = prev[1].apply_delta(changed, removed)
+            else:
+                value = ProjectIndex(root)
+        GRAPH.count("recomputed")
+        with _lock:
+            value = _index_mem.setdefault(key, value)
+        if _mode() == "disk":
+            cache.put("gocheck.index", key, value)
+    else:
+        cache._count("gocheck.index", "hits")
+        GRAPH.count("reused")
+    with _lock:
+        _index_prev[ident] = (state, value)
+    return value
 
 
 # -- whole-suite check results -------------------------------------------

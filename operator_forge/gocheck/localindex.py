@@ -544,6 +544,24 @@ class _FileScan:
             self.value_inits.append((nm, type_span, init_spans[idx]))
 
 
+def _indexable_rel(rel: str) -> bool:
+    """Whether a root-relative slash path would be visited by the
+    index's walk (go-tooling pruning rules)."""
+    parts = rel.split("/")
+    for part in parts[:-1]:
+        if part.startswith((".", "_")) or part in ("vendor", "testdata"):
+            return False
+    name = parts[-1]
+    return name.endswith(".go") and not name.startswith(("_", "."))
+
+
+def _walk_key(rel: str) -> tuple:
+    """Sort key reproducing the index walk's visit order (top-down,
+    directories and filenames sorted) for a root-relative slash path."""
+    parts = rel.split("/")
+    return (tuple(parts[:-1]), parts[-1])
+
+
 class ProjectIndex:
     """Cross-package index of one generated project tree."""
 
@@ -552,34 +570,127 @@ class ProjectIndex:
         self.module = _read_module_path(root)
         self.packages: dict[str, Package] = {}  # import path -> Package
         self.scans: list[_FileScan] = []
+        # relpath -> _FileScan in walk order; failures are relpaths whose
+        # read/tokenize failed (their dir's surface is then partial)
+        self._scans_by_rel: dict[str, _FileScan] = {}
+        self._failed_rels: set[str] = set()
         self._build()
 
     def _build(self) -> None:
         if self.module is None:
             return  # no go.mod: nothing to index
-        failed_dirs: set[str] = set()
         for dirpath, dirnames, filenames in os.walk(self.root):
             dirnames[:] = prune_go_dirs(dirnames)
             for name in sorted(filenames):
                 if not name.endswith(".go") or name.startswith(("_", ".")):
                     continue
                 path = os.path.join(dirpath, name)
-                try:
-                    with open(path, encoding="utf-8") as fh:
-                        text = fh.read()
-                    scan = _FileScan(path, text)
-                except (OSError, UnicodeDecodeError, GoTokenError,
-                        RecursionError):
-                    # unreadable/unparsable is reported elsewhere; here
-                    # it means this package's indexed surface is partial
-                    failed_dirs.add(dirpath)
-                    continue
-                self.scans.append(scan)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                self._scan_file(rel, path)
+        self._derive()
+
+    @property
+    def scan_map(self) -> dict:
+        """Root-relative slash path -> :class:`_FileScan`, in walk
+        order (the per-package replay layer walks imports through it)."""
+        return self._scans_by_rel
+
+    @property
+    def failed_rels(self) -> set:
+        """Root-relative paths whose scan failed (their imports — and
+        surfaces — are unknowable)."""
+        return self._failed_rels
+
+    def _scan_file(self, rel: str, path: str) -> None:
+        import hashlib
+
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            scan = _FileScan(path, text)
+            # content hash alongside the scan: the per-scan caches
+            # (localcalls, load surfaces) key on it
+            scan.src_sha = hashlib.sha256(
+                text.encode("utf-8")
+            ).hexdigest()
+            self._scans_by_rel[rel] = scan
+        except (OSError, UnicodeDecodeError, GoTokenError,
+                RecursionError):
+            # unreadable/unparsable is reported elsewhere; here it
+            # means this package's indexed surface is partial
+            self._failed_rels.add(rel)
+
+    def apply_delta(self, changed=(), removed=()) -> "ProjectIndex":
+        """A new index equal to ``ProjectIndex(self.root)`` after the
+        given file-set delta, re-reading only the touched files.
+
+        ``changed`` (added or modified) and ``removed`` are
+        root-relative slash paths; paths the index walk would prune are
+        ignored, and a ``go.mod`` change re-reads the module path.
+        Untouched per-file scans are shared with this index (scans are
+        immutable after construction), so a one-file edit costs one
+        file scan plus the cheap package derivation instead of a
+        whole-tree re-read — with the derived result provably identical
+        to a from-scratch rebuild (both run :meth:`_derive` over the
+        same scans in the same walk order)."""
+        changed = {p.replace(os.sep, "/") for p in changed}
+        removed = {p.replace(os.sep, "/") for p in removed}
+        touched = changed | removed
+        if "go.mod" in touched:
+            module = _read_module_path(self.root)
+        else:
+            module = self.module
+        if self.module is None and module is not None:
+            # the old index saw no go.mod and indexed nothing: there is
+            # no scan set to patch
+            return ProjectIndex(self.root)
+        new = ProjectIndex.__new__(ProjectIndex)
+        new.root = self.root
+        new.module = module
+        new.packages = {}
+        new.scans = []
+        new._scans_by_rel = {}
+        new._failed_rels = set()
+        if module is None:
+            return new  # matches a fresh build without go.mod
+        merged = {
+            rel: scan
+            for rel, scan in self._scans_by_rel.items()
+            if rel not in touched
+        }
+        failures = {rel for rel in self._failed_rels if rel not in touched}
+        new._failed_rels = failures
+        new._scans_by_rel = merged
+        for rel in changed:
+            if not _indexable_rel(rel):
+                continue
+            path = os.path.join(self.root, rel)
+            if not os.path.isfile(path):
+                continue  # raced away: the walk would not visit it
+            new._scan_file(rel, path)
+        new._scans_by_rel = dict(
+            sorted(new._scans_by_rel.items(), key=lambda kv: _walk_key(kv[0]))
+        )
+        new._derive()
+        return new
+
+    def _derive(self) -> None:
+        """Package registration, symbol indexing, and method attachment
+        over the current scan set — shared verbatim by the full build
+        and :meth:`apply_delta`, so the two paths cannot diverge."""
+        self.scans = list(self._scans_by_rel.values())
+        self.packages = {}
+        failed_dirs = {
+            os.path.dirname(rel) or "." for rel in self._failed_rels
+        }
+        reldirs = {
+            rel: os.path.dirname(rel) or "." for rel in self._scans_by_rel
+        }
         # register every package FIRST: type resolution inside
         # _index_scan must see packages that os.walk visits later
-        for scan in self.scans:
-            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
-            imp = self.module if rel == "." else f"{self.module}/{rel}"
+        for rel, scan in self._scans_by_rel.items():
+            reldir = reldirs[rel]
+            imp = self.module if reldir == "." else f"{self.module}/{reldir}"
             if scan.package.endswith("_test"):
                 continue  # external test packages add no API
             if imp not in self.packages:
@@ -587,19 +698,19 @@ class ProjectIndex:
                     dir=os.path.dirname(scan.path),
                     name=scan.package,
                     import_path=imp,
-                    complete=os.path.dirname(scan.path) not in failed_dirs,
+                    complete=reldir not in failed_dirs,
                 )
-        for scan in self.scans:
-            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
-            imp = self.module if rel == "." else f"{self.module}/{rel}"
+        for rel, scan in self._scans_by_rel.items():
+            reldir = reldirs[rel]
+            imp = self.module if reldir == "." else f"{self.module}/{reldir}"
             pkg = self.packages.get(imp)
             if pkg is None or pkg.name != scan.package:
                 continue  # _test package or mixed names
             self._index_scan(pkg, scan)
         # second pass: attach methods now that all types exist
-        for scan in self.scans:
-            rel = os.path.relpath(os.path.dirname(scan.path), self.root)
-            imp = self.module if rel == "." else f"{self.module}/{rel}"
+        for rel, scan in self._scans_by_rel.items():
+            reldir = reldirs[rel]
+            imp = self.module if reldir == "." else f"{self.module}/{reldir}"
             pkg = self.packages.get(imp)
             if pkg is None or scan.package != pkg.name:
                 continue
@@ -984,24 +1095,77 @@ def _count_args(toks: list[Token], lo: int, hi: int) -> tuple[int, bool]:
     return len(nonempty), spread
 
 
+def _scan_local_calls(idx: ProjectIndex, scan: _FileScan) -> list[str]:
+    """Intra-project call errors of one file's scan."""
+    rel = os.path.relpath(os.path.dirname(scan.path), idx.root)
+    imp = idx.module if rel == "." else f"{idx.module}/{rel}"
+    pkg = idx.packages.get(imp)
+    own = pkg if pkg is not None and pkg.name == scan.package else None
+    errors: list[str] = []
+    for fn in scan.funcs:
+        if fn["body"] is None:
+            continue
+        env = _body_env(idx, scan, fn)
+        errors.extend(_check_body(idx, scan, own, fn, env))
+    return errors
+
+
+def index_surface_sig(idx: ProjectIndex) -> str:
+    """One signature over everything the index *derives* — the module
+    path plus every file's load surface (declarations, types, methods,
+    values) and scan failures.  Per-file localcalls results are a pure
+    function of (the file's own bytes, this signature): a body edit
+    elsewhere leaves it unchanged, so every other file's errors replay.
+    Memoized on the index instance (indexes are immutable once built
+    and shared through the content cache)."""
+    cached = getattr(idx, "_surface_sig_memo", None)
+    if cached is not None:
+        return cached
+    from ..perf import cache as pf_cache
+
+    parts = []
+    for rel, scan in idx.scan_map.items():
+        sig = getattr(scan, "_load_surface_sig", None)
+        if sig is None:
+            from .cache import hash_surface
+
+            sig = hash_surface(rel, load_surface(scan))
+            scan._load_surface_sig = sig
+        parts.append((rel, sig))
+    sig = pf_cache.hash_parts(
+        idx.module or "", tuple(parts), tuple(sorted(idx.failed_rels))
+    )
+    idx._surface_sig_memo = sig
+    return sig
+
+
 def check_local_calls(root: str, idx: ProjectIndex | None = None) -> list[str]:
     """Validate intra-project calls through the index: method chains on
-    fields of known project types, and bare same-package func arity."""
+    fields of known project types, and bare same-package func arity.
+
+    Per-file results are cached (``gocheck.localcalls`` namespace) on
+    the file's own bytes plus :func:`index_surface_sig`: after an edit,
+    only the touched file — and, when declarations changed, the files
+    that could observe them — re-check."""
     if idx is None:
         idx = ProjectIndex(root)
     if idx.module is None:
         return []
+    from ..perf import cache as pf_cache
+
+    replay = pf_cache.get_cache().mode() != "off"
+    surface = index_surface_sig(idx) if replay else ""
     errors: list[str] = []
     for scan in idx.scans:
-        rel = os.path.relpath(os.path.dirname(scan.path), idx.root)
-        imp = idx.module if rel == "." else f"{idx.module}/{rel}"
-        pkg = idx.packages.get(imp)
-        own = pkg if pkg is not None and pkg.name == scan.package else None
-        for fn in scan.funcs:
-            if fn["body"] is None:
-                continue
-            env = _body_env(idx, scan, fn)
-            errors.extend(_check_body(idx, scan, own, fn, env))
+        sha = getattr(scan, "src_sha", None)
+        if replay and sha is not None:
+            errors.extend(pf_cache.memoized(
+                "gocheck.localcalls",
+                ("localcalls", scan.path, sha, surface),
+                lambda: _scan_local_calls(idx, scan),
+            ))
+        else:
+            errors.extend(_scan_local_calls(idx, scan))
     return errors
 
 
@@ -1185,3 +1349,75 @@ def _receiver_base(span) -> str | None:
     if toks and toks[0].kind == IDENT:
         return toks[0].value
     return None
+
+
+def load_surface(scan: _FileScan) -> tuple:
+    """The *load-relevant* shape of one file as plain data — everything
+    the interpreter consumes when the file's package is merely LOADED
+    into a world (declarations, type structure, method registrations,
+    package-level value initializers, and ``init`` function bodies),
+    excluding ordinary function/method bodies, which execute only when
+    called, and token positions, which only failure messages render.
+
+    Two files with equal surfaces are interchangeable for every test
+    suite that loads but never calls into their package: the
+    per-package replay layer (world.run_project_tests) keys suites on
+    the full bytes of their import closure but only on this surface for
+    the rest of the loaded tree, so a body edit in an unrelated package
+    leaves other suites replayable."""
+
+    def toks(span) -> tuple:
+        if not span:
+            return ()
+        return tuple(t.value for t in span)
+
+    funcs = []
+    for fn in scan.funcs:
+        recv = fn["recv"]
+        body = ()
+        if fn["name"] == "init" and recv is None and fn["body"]:
+            # init funcs RUN at package load: their bodies are surface
+            lo, hi = fn["body"]
+            body = tuple(t.value for t in scan.toks[lo:hi])
+        funcs.append((
+            fn["name"],
+            fn["arity"],
+            (recv[0] or "", toks(recv[1])) if recv else None,
+            tuple((name or "", toks(span)) for name, span in fn["params"]),
+            fn["generic"],
+            body,
+        ))
+    types = []
+    for td in scan.typedecls:
+        if td["kind"] == "struct":
+            types.append((
+                td["name"], "struct",
+                tuple((name, toks(span)) for name, span in td["fields"]),
+                tuple(toks(span) for span in td["embeds"]),
+                td["generic"],
+                tuple(sorted(td.get("tags", {}).items())),
+                tuple(td.get("embed_tags", ())),
+            ))
+        elif td["kind"] == "interface":
+            types.append((
+                td["name"], "interface",
+                tuple(sorted(td["methods"].items())),
+                tuple(toks(span) for span in td["embeds"]),
+                td["generic"],
+            ))
+        else:
+            types.append((
+                td["name"], td["kind"], toks(td["expr"]), td["generic"],
+            ))
+    values = tuple(
+        (name, toks(type_span), toks(init_span))
+        for name, type_span, init_span in scan.value_inits
+    )
+    return (
+        scan.package,
+        tuple(sorted(scan.imports.items())),
+        scan.has_dot_import,
+        tuple(funcs),
+        tuple(types),
+        values,
+    )

@@ -357,15 +357,93 @@ def check_duplicate_funcs(root: str) -> list[str]:
     return _duplicate_funcs(packages)
 
 
+def _package_structure(files: list) -> tuple[list, list]:
+    """(import/qualifier problems, duplicate-decl problems) of one
+    package's files — the per-package unit the memoized
+    :func:`check_structure` replays."""
+    problems: list[str] = []
+    for path, text, _ in files:
+        problems += [f"{path}: {p}" for p in check_imports(text)]
+    pkg_decls = _toplevel_decls([c for _, _, c in files])
+    problems += _unresolved_qualifiers(files, pkg_decls)
+    dups = _duplicate_funcs({None: files})
+    return problems, dups
+
+
+def _dir_structure(dirpath: str, names: list) -> tuple[list, list]:
+    """(import/qualifier problems, duplicate-decl problems) of one
+    directory's files, grouped by package clause exactly like
+    :func:`_load_packages` (unreadable files skipped — the parse pass
+    reports them)."""
+    packages: dict = defaultdict(list)
+    for name in names:
+        path = os.path.join(dirpath, name)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        clean = strip_strings_and_comments(text)
+        m = _PACKAGE_CLAUSE_RE.search(clean)
+        packages[m.group(1) if m else ""].append((path, text, clean))
+    problems: list[str] = []
+    dups: list[str] = []
+    for pkg in sorted(packages):
+        pkg_problems, pkg_dups = _package_structure(packages[pkg])
+        problems += pkg_problems
+        dups += pkg_dups
+    return problems, dups
+
+
 def check_structure(root: str) -> list[str]:
-    """All structural checks over a project tree (each file read and
-    stripped exactly once)."""
-    packages, problems = _load_packages(root)
-    for key in sorted(packages):
-        files = packages[key]
-        for path, text, _ in files:
-            problems += [f"{path}: {p}" for p in check_imports(text)]
-        pkg_decls = _toplevel_decls([c for _, _, c in files])
-        problems += _unresolved_qualifiers(files, pkg_decls)
-    problems += _duplicate_funcs(packages)
-    return problems
+    """All structural checks over a project tree.
+
+    Every check is package-local, so results are memoized per
+    directory on its files' content hashes (``gocheck.structural``
+    namespace; hashes come from the stat-validated memo, so unchanged
+    directories are not even re-read): after a one-file edit only that
+    file's directory is re-examined — output is assembled in the exact
+    order of the monolithic pass (imports/qualifiers for every package
+    in sorted (dir, package) order first, duplicates last).
+    """
+    from ..perf import cache as pf_cache
+
+    if pf_cache.get_cache().mode() == "off":
+        packages, problems = _load_packages(root)
+        dup_problems: list[str] = []
+        for key in sorted(packages):
+            pkg_problems, dups = _package_structure(packages[key])
+            problems += pkg_problems
+            dup_problems += dups
+        return problems + dup_problems
+
+    from . import cache as gocheck_cache
+
+    per_dir: dict = {}
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = prune_go_dirs(dirnames)
+        names = [
+            f for f in sorted(files)
+            if f.endswith(".go") and not f.startswith(("_", "."))
+        ]
+        if not names:
+            continue
+        content = tuple(
+            (name, gocheck_cache.file_sha_stat(os.path.join(dirpath, name)))
+            for name in names
+        )
+        per_dir[dirpath] = pf_cache.memoized(
+            "gocheck.structural",
+            ("structural", dirpath, content),
+            lambda: _dir_structure(dirpath, names),
+        )
+    # emit in sorted-dirpath order — byte-identical to the monolithic
+    # pass's sorted (dir, package) iteration (walk order can differ from
+    # string order around '-' vs '/')
+    problems = []
+    dup_problems = []
+    for dirpath in sorted(per_dir):
+        dir_problems, dups = per_dir[dirpath]
+        problems += dir_problems
+        dup_problems += dups
+    return problems + dup_problems

@@ -948,6 +948,118 @@ def discover_test_packages(root: str) -> list:
     return rels
 
 
+def _suite_dep_states(root: str, rels, state) -> tuple:
+    """Per-package dependency traces for the suite-replay layer.
+
+    A unit package's suite is a function of: the file-NAME set of the
+    tree (world construction walks it), the full bytes of its import
+    closure (code it can call) plus its own ``*_test.go`` files, the
+    full bytes of every non-Go file (CRDs, samples, go.mod — the
+    interpreter may read them), and — for every other loaded Go file —
+    only that file's *load surface* (declarations, type structure,
+    init bodies; see :func:`~operator_forge.gocheck.localindex
+    .load_surface`): packages outside the closure are loaded into the
+    world but never called into, so their function bodies cannot
+    affect this suite.  e2e suites (``test/``) interpret ``main.go``
+    and the companion CLI and therefore depend on the whole tree, as
+    does any package whose imports are unknowable (dot imports, scan
+    failures).
+
+    Returns ``(deps_by_rel, current_sig)`` for
+    :meth:`~operator_forge.perf.depgraph.DepGraph.memo`.
+    """
+    import posixpath
+
+    from ..perf import cache as pf_cache
+    from . import cache as gocheck_cache
+    from .localindex import load_surface
+
+    idx = gocheck_cache.project_index(root)
+    names_sig = pf_cache.hash_parts(tuple(rel for rel, _sha in state))
+    src_map = dict(state)
+    scan_map = idx.scan_map
+    failed = idx.failed_rels
+
+    def surface_sig(frel):
+        scan = scan_map.get(frel)
+        if scan is None:
+            return None
+        sig = getattr(scan, "_load_surface_sig", None)
+        if sig is None:
+            sig = gocheck_cache.hash_surface(frel, load_surface(scan))
+            scan._load_surface_sig = sig
+        return sig
+
+    module_ok = idx.module is not None
+    dir_imports: dict = {}  # package dir -> imported project dirs
+    dir_dot: set = set()    # dirs whose imports are unknowable
+    if module_ok:
+        module = idx.module
+        for frel, scan in scan_map.items():
+            reldir = posixpath.dirname(frel) or "."
+            entry = dir_imports.setdefault(reldir, set())
+            for path in scan.imports.values():
+                if path == module:
+                    entry.add(".")
+                elif path.startswith(module + "/"):
+                    entry.add(path[len(module) + 1:])
+            if scan.has_dot_import:
+                dir_dot.add(reldir)
+    failed_dirs = {posixpath.dirname(frel) or "." for frel in failed}
+
+    def closure_of(rel):
+        """Transitively imported project dirs, or None when the whole
+        tree must count (unresolvable imports along the way)."""
+        if not module_ok:
+            return None
+        seen = {rel}
+        queue = [rel]
+        while queue:
+            d = queue.pop()
+            if d in dir_dot or d in failed_dirs:
+                return None
+            for dep in dir_imports.get(d, ()):
+                if dep not in seen:
+                    seen.add(dep)
+                    queue.append(dep)
+        return seen
+
+    def deps_for(rel):
+        deps = {("names", ""): names_sig}
+        closure = None if rel.startswith("test/") else closure_of(rel)
+        for frel, sha in state:
+            if closure is None or not frel.endswith(".go"):
+                deps[("src", frel)] = sha
+                continue
+            reldir = posixpath.dirname(frel) or "."
+            if frel.endswith("_test.go"):
+                if reldir == rel:
+                    deps[("src", frel)] = sha
+                # other packages' test files are never loaded here
+                continue
+            if reldir in closure:
+                deps[("src", frel)] = sha
+            else:
+                surf = surface_sig(frel)
+                if surf is None:
+                    deps[("src", frel)] = sha
+                else:
+                    deps[("surf", frel)] = surf
+        return deps
+
+    def current_sig(dep_key):
+        kind, name = dep_key
+        if kind == "names":
+            return names_sig
+        if kind == "src":
+            return src_map.get(name)
+        if kind == "surf":
+            return surface_sig(name)
+        return None
+
+    return {rel: deps_for(rel) for rel in rels}, current_sig
+
+
 def run_project_tests(root: str, include_e2e: bool = False,
                       progress=None, run_filter: str | None = None,
                       on_test=None, on_test_start=None) -> list:
@@ -974,10 +1086,12 @@ def run_project_tests(root: str, include_e2e: bool = False,
     from . import compiler
 
     key = None
+    state = None
     if gocheck_cache.replay_enabled():  # off mode: skip the tree hash
+        state = gocheck_cache.tree_state(root)
         key = gocheck_cache.check_key(
-            root, include_e2e=include_e2e, run_filter=run_filter or "",
-            mode=compiler.mode(),
+            root, files=state, include_e2e=include_e2e,
+            run_filter=run_filter or "", mode=compiler.mode(),
         )
         cached = gocheck_cache.check_get(key)
         if cached is not None:
@@ -1020,6 +1134,52 @@ def run_project_tests(root: str, include_e2e: bool = False,
             )
 
     rels = discover_test_packages(root)
+
+    run_suite = run_one
+    if key is not None and not streaming:
+        # per-package replay: when the whole-report key missed (the
+        # edit-one-file loop), suites whose dependency trace — import
+        # closure bytes + load surfaces of the rest of the tree —
+        # still validates replay individually; only affected packages
+        # re-execute.  Faulted or skipped results are never recorded.
+        import copy as _copy
+
+        from .. import __version__ as _version
+        from ..perf.depgraph import GRAPH
+
+        pkg_deps, current_sig = _suite_dep_states(root, rels, state)
+        mode = compiler.mode()
+        root_abs = os.path.abspath(root)
+
+        def run_suite(rel: str) -> SuiteResult:
+            if rel.startswith("test/") and not include_e2e:
+                return run_one(rel)  # the skip marker: trivial
+            deps = pkg_deps.get(rel)
+            if deps is None:
+                return run_one(rel)
+            pkg_key = (
+                "check.pkg", gocheck_cache._SCHEMA, _version, root,
+                root_abs, rel, bool(include_e2e), run_filter or "", mode,
+            )
+            live: list = []
+
+            def build() -> SuiteResult:
+                res = run_one(rel)
+                live.append(res)
+                return res
+
+            res = GRAPH.memo(
+                "gocheck.checkpkg", pkg_key, current_sig, build,
+                deps=deps,
+                store_if=lambda r: not r.error and not r.skipped,
+            )
+            if not live:
+                # a replay: nothing executed, so the recorded wall
+                # time would misreport work that never happened
+                res = _copy.copy(res)
+                res.seconds = 0.0
+            return res
+
     with spans.span("gocheck.run"):
         if streaming:
             results = [run_one(rel) for rel in rels]
@@ -1031,7 +1191,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
                 for rel in rels:
                     if include_e2e or not rel.startswith("test/"):
                         progress(rel)
-            results = parallel_map(run_one, rels)
+            results = parallel_map(run_suite, rels)
     if key is not None and not any(res.error for res in results):
         # test FAILURES are deterministic verdicts and replay fine;
         # interpreter FAULTS may be transient (resource exhaustion under

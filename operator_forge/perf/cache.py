@@ -50,6 +50,9 @@ _SCHEMA = 1
 _MODES = ("off", "mem", "disk")
 DEFAULT_MODE = "mem"
 DEFAULT_DIR = ".operator-forge-cache"
+#: disk-store size ceiling (``OPERATOR_FORGE_CACHE_MAX_MB`` overrides;
+#: values <= 0 disable pruning)
+DEFAULT_MAX_MB = 256
 
 
 class _Miss:
@@ -206,6 +209,9 @@ class ContentCache:
         self._stats: dict = {}
         self._mode_override = None
         self._root_override = None
+        # bytes persisted since the last size check: gc on write is
+        # amortized so a hot loop never walks the store per put
+        self._written_since_gc = 0
         # callbacks run by reset(): sibling in-process caches (the
         # gocheck scan/index identity layers) register here so one
         # reset() call returns the whole process to a cold state
@@ -315,6 +321,14 @@ class ContentCache:
         signature, blob = data[:_SIG_BYTES], data[_SIG_BYTES:]
         if not hmac.compare_digest(signature, _sign(signing_key, blob)):
             return None
+        try:
+            # mark the entry used: relatime/noatime mounts barely move
+            # atime, so without this the LRU eviction would degrade to
+            # FIFO-by-write and evict the hottest entries first (Go's
+            # build cache touches entries on Get for the same reason)
+            os.utime(self._disk_path(stage, key))
+        except OSError:
+            pass
         return blob
 
     def _disk_write(self, stage: str, key: str, blob: bytes) -> None:
@@ -329,7 +343,81 @@ class ContentCache:
                 handle.write(_sign(signing_key, blob) + blob)
             os.replace(tmp, path)
         except OSError:
-            pass  # persistence is best-effort
+            return  # persistence is best-effort
+        self._maybe_gc(len(blob) + _SIG_BYTES)
+
+    # -- eviction --------------------------------------------------------
+
+    def max_bytes(self) -> int:
+        """The disk-store ceiling in bytes (<= 0 disables pruning)."""
+        raw = os.environ.get("OPERATOR_FORGE_CACHE_MAX_MB", "").strip()
+        try:
+            mb = float(raw) if raw else float(DEFAULT_MAX_MB)
+        except ValueError:
+            mb = float(DEFAULT_MAX_MB)
+        return int(mb * 1024 * 1024)
+
+    def _maybe_gc(self, written: int) -> None:
+        """Amortized on-write pruning: walk the store only after enough
+        new bytes accumulated to plausibly move the total."""
+        limit = self.max_bytes()
+        if limit <= 0:
+            return
+        with self._lock:
+            self._written_since_gc += written
+            if self._written_since_gc < max(limit // 32, 1024 * 1024):
+                return
+            self._written_since_gc = 0
+        try:
+            self.gc()
+        except OSError:
+            pass
+
+    def gc(self, max_bytes=None) -> dict:
+        """Prune the disk store to ``max_bytes`` (default: the
+        ``OPERATOR_FORGE_CACHE_MAX_MB`` ceiling), removing least-
+        recently-used entries first (by atime, ties by mtime).  Only
+        ``.pkl`` blobs are touched; removal is whole-file, so an entry
+        is either absent (a miss) or intact-and-signed — pruning can
+        never produce a blob that fails HMAC verification, and a reader
+        holding an open handle keeps its data (POSIX unlink semantics).
+        Returns a summary dict (stable key order)."""
+        limit = self.max_bytes() if max_bytes is None else int(max_bytes)
+        root = self.root()
+        entries = []  # (atime_ns, mtime_ns, size, path)
+        total = 0
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                if not name.endswith(".pkl"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                entries.append(
+                    (st.st_atime_ns, st.st_mtime_ns, st.st_size, path)
+                )
+                total += st.st_size
+        removed = 0
+        freed = 0
+        if limit > 0 and total > limit:
+            for _atime, _mtime, size, path in sorted(entries):
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue
+                removed += 1
+                freed += size
+                if total - freed <= limit:
+                    break
+        return {
+            "entries": len(entries),
+            "max_bytes": limit,
+            "removed": removed,
+            "bytes_before": total,
+            "bytes_after": total - freed,
+        }
 
 
 _CACHE = ContentCache()
@@ -349,6 +437,10 @@ def reset() -> None:
 
 def stats() -> dict:
     return _CACHE.stats()
+
+
+def gc(max_bytes=None) -> dict:
+    return _CACHE.gc(max_bytes)
 
 
 def memoized(stage: str, key_parts: tuple, compute):

@@ -1,0 +1,229 @@
+"""First-class dependency graph: minimal recomputation for the edit loop.
+
+Every caching layer before this one was all-or-nothing at its
+granularity — the project index was keyed on the *entire* file-hash
+set, the analysis driver replayed only byte-identical whole runs, and
+batch groups replayed only at tree fixed points — so the dominant real
+workload, "edit one file, re-vet/re-test", paid near-cold cost even
+though 95% of its inputs were unchanged.  This module is the engine
+that makes recomputation proportional to the size of the edit (the
+minimal-rebuild property of incremental build systems, cf. "Build
+Systems à la Carte"-style verifying traces):
+
+- **Nodes** are content-keyed artifacts: a file's per-analyzer
+  diagnostics, a package's test-suite result, the project index.
+- **Edges** are recorded automatically as a computation reads its
+  inputs: anything consulted under :meth:`DepGraph.recording` (a file's
+  bytes, a package's exported surface) lands in the node's dependency
+  trace via :meth:`DepGraph.read`, without the orchestration layer
+  enumerating inputs up front.
+- **Validation** is signature-based: a node replays only while every
+  recorded dependency's *current* signature matches the one recorded at
+  build time, so a single-file edit invalidates exactly that file's
+  nodes plus their transitive dependents and nothing else.
+
+Persistence piggybacks on :mod:`operator_forge.perf.cache`: each node's
+``(value, deps)`` trace is stored under its namespace in the shared
+:class:`~operator_forge.perf.cache.ContentCache` (honoring
+``OPERATOR_FORGE_CACHE`` off|mem|disk and the HMAC-signed disk format),
+while the in-process node table makes repeat validations a dict lookup.
+``off`` mode callers skip the graph entirely (see ``memo``), so the
+cache-off path pays zero overhead and always recomputes live.
+
+Counters (``dirty`` / ``reused`` / ``recomputed``) feed the serve
+layer's ``stats`` op and the per-cycle ``graph`` report of the
+``watch`` loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import cache as pf_cache
+
+
+class _Node:
+    __slots__ = ("value", "deps")
+
+    def __init__(self, value, deps: dict):
+        self.value = value
+        self.deps = deps
+
+
+class DepGraph:
+    """Thread-safe verifying-trace dependency graph."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._nodes: dict = {}   # key -> _Node
+        self._rdeps: dict = {}   # dep key -> set of node keys
+        self._tls = threading.local()
+        self._counts = {"dirty": 0, "reused": 0, "recomputed": 0}
+
+    # -- counters --------------------------------------------------------
+
+    def counters(self) -> dict:
+        """``{"dirty", "reused", "recomputed"}`` in stable key order."""
+        with self._lock:
+            return {
+                "dirty": self._counts["dirty"],
+                "reused": self._counts["reused"],
+                "recomputed": self._counts["recomputed"],
+            }
+
+    def count(self, what: str, n: int = 1) -> None:
+        """Bump a counter (layers doing their own trace validation —
+        the index delta path — report reuse/recompute through this)."""
+        with self._lock:
+            self._counts[what] += n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._nodes.clear()
+            self._rdeps.clear()
+            for name in self._counts:
+                self._counts[name] = 0
+
+    # -- automatic edge recording ----------------------------------------
+
+    def recording(self):
+        """Context manager collecting every :meth:`read` made on this
+        thread into a dependency dict (nested frames each see their own
+        reads plus their children's — an input consulted by a
+        subcomputation is an input of the whole)."""
+        return _RecordingFrame(self)
+
+    def read(self, key, sig) -> None:
+        """Note that the in-flight computation consulted input ``key``
+        whose current content signature is ``sig``.  A no-op outside
+        :meth:`recording` frames."""
+        frames = getattr(self._tls, "frames", None)
+        if frames:
+            for deps in frames:
+                deps[key] = sig
+
+    # -- nodes -----------------------------------------------------------
+
+    def _valid(self, deps: dict, current_sig_of) -> bool:
+        for dep_key, dep_sig in deps.items():
+            if current_sig_of(dep_key) != dep_sig:
+                return False
+        return True
+
+    def _install(self, key, value, deps: dict) -> None:
+        with self._lock:
+            old = self._nodes.get(key)
+            if old is not None:
+                for dep_key in old.deps:
+                    self._rdeps.get(dep_key, set()).discard(key)
+            self._nodes[key] = _Node(value, deps)
+            for dep_key in deps:
+                self._rdeps.setdefault(dep_key, set()).add(key)
+
+    def invalidate(self, keys) -> int:
+        """Drop the nodes depending (transitively) on any of ``keys``
+        — the reverse-dependency sweep a file edit triggers.  Returns
+        how many nodes were dirtied (also added to the ``dirty``
+        counter)."""
+        with self._lock:
+            queue = list(keys)
+            dropped = 0
+            seen = set()
+            while queue:
+                key = queue.pop()
+                if key in seen:
+                    continue
+                seen.add(key)
+                for dependent in self._rdeps.pop(key, ()):
+                    queue.append(dependent)
+                node = self._nodes.pop(key, None)
+                if node is not None:
+                    dropped += 1
+                    for dep_key in node.deps:
+                        self._rdeps.get(dep_key, set()).discard(key)
+            self._counts["dirty"] += dropped
+        return dropped
+
+    def _replay(self, value, deps: dict):
+        """A hit still *consumed* its recorded inputs: replay them into
+        any enclosing recording frame, so a composed computation's
+        trace includes what its replayed subcomputations consulted."""
+        for dep_key, dep_sig in deps.items():
+            self.read(dep_key, dep_sig)
+        return value
+
+    # -- the one-stop memoization entry point ----------------------------
+
+    def memo(self, namespace: str, key: tuple, current_sig_of, build,
+             deps=None, store_if=None):
+        """Return the node for ``key``, recomputing minimally.
+
+        ``key`` is a plain-data tuple (it doubles, hashed, as the
+        ContentCache key under ``namespace``).  ``current_sig_of`` maps
+        a dependency key to its *current* signature (``None`` = cannot
+        validate).  ``build()`` produces the value; its inputs are the
+        ``deps`` mapping when given, otherwise whatever ``build``
+        reported through :meth:`read` while running under a recording
+        frame.  ``store_if(value)`` may veto recording (transient
+        faults must never replay).  ``OPERATOR_FORGE_CACHE=off``
+        bypasses every store and always builds live.
+        """
+        cache = pf_cache.get_cache()
+        if cache.mode() == "off":
+            return build()
+        with self._lock:
+            node = self._nodes.get(key)
+        if node is not None and self._valid(node.deps, current_sig_of):
+            self.count("reused")
+            cache._count(namespace, "hits")
+            return self._replay(node.value, node.deps)
+        ckey = pf_cache.hash_parts(key)
+        record = cache.get(namespace, ckey, record_stats=False)
+        if (
+            record is not pf_cache.MISS
+            and isinstance(record, tuple)
+            and len(record) == 2
+            and isinstance(record[1], dict)
+            and self._valid(record[1], current_sig_of)
+        ):
+            value, traced = record
+            self._install(key, value, traced)
+            self.count("reused")
+            cache._count(namespace, "hits")
+            return self._replay(value, traced)
+        cache._count(namespace, "misses")
+        self.count("recomputed")
+        if deps is None:
+            with self.recording() as traced:
+                value = build()
+            deps = traced
+        else:
+            value = build()
+        if store_if is not None and not store_if(value):
+            return value
+        deps = dict(deps)
+        self._install(key, value, deps)
+        cache.put(namespace, ckey, (value, deps))
+        return value
+
+
+class _RecordingFrame:
+    def __init__(self, graph: DepGraph):
+        self._graph = graph
+        self.deps: dict = {}
+
+    def __enter__(self) -> dict:
+        tls = self._graph._tls
+        if not hasattr(tls, "frames"):
+            tls.frames = []
+        tls.frames.append(self.deps)
+        return self.deps
+
+    def __exit__(self, *exc) -> None:
+        self._graph._tls.frames.pop()
+
+
+#: the process-wide graph every incremental layer shares
+GRAPH = DepGraph()
+
+pf_cache.get_cache().reset_hooks.append(GRAPH.reset)

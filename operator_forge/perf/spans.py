@@ -9,6 +9,15 @@ breakdown in the BENCH JSON.
 Stages are *inclusive* and may nest or run on worker threads, so totals
 can overlap and, under ``OPERATOR_FORGE_JOBS>1``, sum to more than the
 elapsed wall time — read them as attribution, not as a partition.
+
+``span`` itself is a module attribute swapped between the timing
+implementation and a no-op closure returning a shared null context:
+with profiling off, a span costs one attribute lookup and zero clock
+or environment reads (bench.py's ``span_overhead`` micro-guard holds
+the disabled path under 1% of the codegen pipeline).  The swap happens
+whenever the enable state changes (:func:`enable`, :func:`use_env`,
+:func:`refresh`); code that mutates ``OPERATOR_FORGE_PROFILE`` mid-
+process must call :func:`refresh` (the process-pool workers do).
 """
 
 from __future__ import annotations
@@ -21,24 +30,37 @@ from contextlib import contextmanager
 _lock = threading.Lock()
 _totals: dict = {}  # name -> [calls, seconds]
 _forced = None  # None: follow the env var; bool: programmatic override
+_active = False
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0")
 
 
 def enabled() -> bool:
-    if _forced is not None:
-        return _forced
-    return os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0")
+    return _active
+
+
+def refresh() -> None:
+    """Recompute the enable state (override, else the env var) and swap
+    the ``span`` implementation accordingly."""
+    global _active, span
+    _active = _forced if _forced is not None else _env_enabled()
+    span = _span_on if _active else _span_off
 
 
 def enable(flag: bool = True) -> None:
     """Programmatic on/off override (bench.py, tests)."""
     global _forced
     _forced = flag
+    refresh()
 
 
 def use_env() -> None:
     """Drop any programmatic override; follow ``OPERATOR_FORGE_PROFILE``."""
     global _forced
     _forced = None
+    refresh()
 
 
 def reset() -> None:
@@ -53,17 +75,39 @@ def record(name: str, seconds: float) -> None:
         entry[1] += seconds
 
 
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _span_off(name: str):
+    """Profiling disabled: hand back the shared null context — no env
+    read, no clock read, no generator frame."""
+    return _NULL_SPAN
+
+
 @contextmanager
-def span(name: str):
-    """Time a stage; free (no clock reads) when profiling is disabled."""
-    if not enabled():
-        yield
-        return
+def _span_on(name: str):
     start = time.perf_counter()
     try:
         yield
     finally:
         record(name, time.perf_counter() - start)
+
+
+#: time a stage — rebound by :func:`refresh` to the no-op closure when
+#: profiling is off (always call as ``spans.span(...)``)
+span = _span_off
+
+refresh()
 
 
 def snapshot() -> dict:

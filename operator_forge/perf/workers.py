@@ -53,6 +53,7 @@ import threading
 
 from . import n_jobs
 from . import cache as pf_cache
+from . import spans
 
 _BACKENDS = ("thread", "process")
 DEFAULT_BACKEND = "thread"
@@ -135,6 +136,9 @@ def _apply_config(cfg: dict) -> None:
     # otherwise shadow the env)
     os.environ["OPERATOR_FORGE_WORKERS"] = "thread"
     set_backend("thread")
+    # spans caches the enable state (no per-call env reads); the shipped
+    # OPERATOR_FORGE_PROFILE value takes effect only after a refresh
+    spans.refresh()
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
     if cfg["gen"] != _worker_seen_gen[0]:

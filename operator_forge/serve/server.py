@@ -14,7 +14,16 @@ the response, never interleaved with the protocol stream):
   ``command`` key) — run one init/create-api/vet/lint/test job;
 - ``{"op": "batch", "jobs": [<specs...>]}`` — run a batch through the
   orchestrator (grouped, fanned out, input-order results);
-- ``{"op": "stats"}`` — cache hit/miss counters and the span table the
+- ``{"op": "watch", "jobs": [<specs...>], "cycles": N}`` — the edit
+  loop: run the jobs, then poll their input trees (``interval``
+  seconds, default 0.5) and re-run the minimal set on every change.
+  The one *streaming* op: each cycle emits its own response line
+  (``"op": "watch"``, per-cycle ``graph`` reuse counts), and a final
+  ``{"op": "watch", "done": true, "cycles": N}`` line closes the
+  request;
+- ``{"op": "stats"}`` — per-namespace cache hit/miss counters with
+  ratios (stable key order), the dependency graph's cumulative
+  dirty/reused/recomputed counters, and the span table the
   per-request ``serve:*`` spans feed;
 - ``{"op": "shutdown"}`` — acknowledge and exit 0 (EOF does the same).
 
@@ -33,6 +42,7 @@ import time
 from .. import __version__
 from ..perf import cache as pf_cache
 from ..perf import spans
+from ..perf.depgraph import GRAPH
 from .batch import run_batch
 from .jobs import BatchManifestError, jobs_from_specs
 from .runner import run_job
@@ -45,8 +55,27 @@ def _error(message: str, req_id=None) -> dict:
     return out
 
 
-def _handle(req: dict, base_dir: str) -> tuple:
-    """Dispatch one request; returns (response dict, keep_going)."""
+def _cache_report() -> dict:
+    """Per-namespace hit/miss counters with hit ratios, stable key
+    order (namespaces sorted; hits/misses/ratio fixed within)."""
+    out: dict = {}
+    snap = pf_cache.stats()
+    for stage in sorted(snap):
+        counts = snap[stage]
+        hits = counts.get("hits", 0)
+        misses = counts.get("misses", 0)
+        total = hits + misses
+        out[stage] = {
+            "hits": hits,
+            "misses": misses,
+            "ratio": round(hits / total, 4) if total else 0.0,
+        }
+    return out
+
+
+def _handle(req: dict, base_dir: str, emit=None) -> tuple:
+    """Dispatch one request; returns (response dict, keep_going).
+    ``emit`` delivers the intermediate lines of streaming ops (watch)."""
     op = req.get("op") or ("job" if "command" in req else None)
     req_id = req.get("id")
     if op == "ping":
@@ -55,10 +84,32 @@ def _handle(req: dict, base_dir: str) -> tuple:
         return ({"ok": True, "op": "shutdown"}, False)
     if op == "stats":
         return (
-            {"ok": True, "op": "stats", "cache": pf_cache.stats(),
-             "spans": spans.snapshot()},
+            {"ok": True, "op": "stats", "cache": _cache_report(),
+             "graph": GRAPH.counters(), "spans": spans.snapshot()},
             True,
         )
+    if op == "watch":
+        from .watch import watch_loop
+
+        jobs = jobs_from_specs(req.get("jobs"), base_dir)
+        cycles = req.get("cycles", 1)
+        if not isinstance(cycles, int) or cycles < 1:
+            return (_error("watch: cycles must be a positive integer",
+                           req_id), True)
+
+        def emit_cycle(payload: dict) -> None:
+            payload["ok"] = bool(payload["ok"])
+            if req_id is not None:
+                payload["id"] = req_id
+            if emit is not None:
+                emit(payload)
+
+        ran = watch_loop(
+            jobs, emit_cycle, cycles=cycles,
+            interval=float(req.get("interval", 0.5)),
+        )
+        return ({"ok": True, "op": "watch", "done": True,
+                 "cycles": ran}, True)
     if op == "job":
         spec = req.get("job") if "job" in req else {
             k: v for k, v in req.items() if k not in ("op",)
@@ -119,7 +170,8 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
             started = time.perf_counter()
             try:
                 with spans.span(f"serve:{op}"):
-                    response, keep_going = _handle(req, base_dir)
+                    response, keep_going = _handle(req, base_dir,
+                                                   emit=respond)
             except BatchManifestError as exc:
                 respond(_error(str(exc), req.get("id")))
                 continue

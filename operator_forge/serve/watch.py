@@ -1,0 +1,224 @@
+"""``operator-forge watch`` — the edit loop, served.
+
+Polls the directories a job set reads (mtime+size first, content hash
+only for files that moved), feeds each delta into the dependency graph
+(:data:`operator_forge.perf.depgraph.GRAPH` — reverse-dependency
+invalidation), and re-runs the job set; the incremental layers
+underneath (index delta, per-file analysis replay, per-package suite
+replay, per-job/group replay) recompute only what the edit reached.
+Each cycle emits one JSON-serializable payload::
+
+    {"op": "watch", "cycle": N, "changed": [...], "removed": [...],
+     "results": [<job result>...], "ok": true,
+     "graph": {"dirty": d, "reused": r, "recomputed": c},
+     "seconds": s}
+
+``graph`` counts are per-cycle deltas of the shared graph counters
+(also surfaced cumulatively by the serve ``stats`` op).  Jobs run
+in-process (groups in manifest order through the shared runner) so
+every cycle reuses the resident caches — the point of watching.
+
+The loop is deliberately injectable for tests and the serve op:
+``cycles`` bounds how many job runs happen (the first cycle always
+runs, unconditionally — it primes the graph), ``poll`` overrides the
+sleep between polls (tests mutate the tree there), and ``emit``
+receives each payload as it completes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..perf.depgraph import GRAPH
+from .batch import plan_groups
+from .runner import run_group
+
+
+def watch_roots(jobs) -> list:
+    """The directories whose bytes can invalidate any of *jobs* —
+    every job's read set plus its output tree (a generated dir is the
+    next job's input, and external edits to it must trigger too)."""
+    roots: list = []
+    for job in jobs:
+        for root in job.reads() + job.writes():
+            if root not in roots:
+                roots.append(root)
+    return roots
+
+
+def snapshot(roots) -> dict:
+    """``{root: {relpath: (mtime_ns, size)}}`` for every regular file
+    under each root, with the tree-state pruning rules (dot-dirs and
+    dot-files skipped).  Stat-only: content hashes happen lazily in
+    the layers below, through their stat-validated memo."""
+    out: dict = {}
+    for root in roots:
+        files: dict = {}
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                if name.startswith("."):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                files[rel] = (st.st_mtime_ns, st.st_size)
+        out[root] = files
+    return out
+
+
+def diff_snapshots(prev: dict, cur: dict) -> tuple:
+    """(changed, removed) path lists — each entry ``(root, rel)`` —
+    between two :func:`snapshot` results."""
+    changed: list = []
+    removed: list = []
+    for root, files in cur.items():
+        before = prev.get(root, {})
+        for rel, sig in files.items():
+            if before.get(rel) != sig:
+                changed.append((root, rel))
+        for rel in before:
+            if rel not in files:
+                removed.append((root, rel))
+    return changed, removed
+
+
+def _invalidate(changed, removed) -> int:
+    """Feed a delta into the dependency graph: every touched file's
+    source node is invalidated, sweeping its transitive dependents."""
+    keys = []
+    for root, rel in list(changed) + list(removed):
+        path = os.path.join(root, rel)
+        keys.append(("src", rel))
+        keys.append(("src", path))
+    return GRAPH.invalidate(keys) if keys else 0
+
+
+def run_jobs(jobs) -> list:
+    """One in-process pass over the job set: groups planned by
+    read/write conflict, run in manifest order, results in input
+    order (the watch loop's unit of work)."""
+    groups = plan_groups(jobs)
+    by_index: dict = {}
+    for group in groups:
+        for result in run_group(group):
+            by_index[result.index] = result
+    return [by_index[job.index] for job in jobs]
+
+
+def watch_cycle(jobs, cycle: int, changed=(), removed=(),
+                dirtied: int = 0) -> dict:
+    """Run the job set once and package the per-cycle payload.
+    ``dirtied`` is the node count the pre-cycle invalidation swept."""
+    counters_before = GRAPH.counters()
+    started = time.perf_counter()
+    results = run_jobs(jobs)
+    counters_after = GRAPH.counters()
+    graph = {
+        key: counters_after[key] - counters_before[key]
+        for key in ("dirty", "reused", "recomputed")
+    }
+    graph["dirty"] += dirtied
+    return {
+        "op": "watch",
+        "cycle": cycle,
+        "changed": sorted(rel for _root, rel in changed),
+        "removed": sorted(rel for _root, rel in removed),
+        "ok": all(r.ok for r in results),
+        "results": [r.to_dict() for r in results],
+        "graph": graph,
+        "seconds": round(time.perf_counter() - started, 4),
+    }
+
+
+def watch_loop(jobs, emit, cycles=None, interval: float = 0.5,
+               poll=None) -> int:
+    """Poll-and-rerun until ``cycles`` job runs have happened (forever
+    when ``None``).  The first cycle runs unconditionally; afterwards a
+    cycle fires only when the snapshot actually changed.  ``poll()``
+    replaces the between-poll sleep (tests edit the tree there; a
+    ``False`` return stops the loop).  Returns the number of cycles
+    run."""
+    roots = watch_roots(jobs)
+    ran = 0
+    emit(watch_cycle(jobs, ran))
+    ran += 1
+    state = snapshot(roots)
+    while cycles is None or ran < cycles:
+        if poll is not None:
+            if poll() is False:
+                break
+        else:  # pragma: no cover - timing loop
+            time.sleep(interval)
+        cur = snapshot(roots)
+        changed, removed = diff_snapshots(state, cur)
+        if not changed and not removed:
+            continue
+        state = cur
+        dirtied = _invalidate(changed, removed)
+        emit(watch_cycle(jobs, ran, changed, removed, dirtied))
+        ran += 1
+    return ran
+
+
+def cmd_watch(manifest_path: str, cycles=None, interval: float = 0.5,
+              json_lines: bool = False, out=None) -> int:
+    """The ``operator-forge watch`` CLI: watch a batch manifest's jobs
+    and re-run them on every tree change, streaming one JSON line (or
+    a human summary) per cycle."""
+    import json as _json
+    import sys
+
+    from .jobs import BatchManifestError, load_manifest
+
+    out = out if out is not None else sys.stdout
+    try:
+        jobs = load_manifest(manifest_path)
+    except BatchManifestError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    failures = []
+
+    def emit(payload: dict) -> None:
+        if not payload["ok"]:
+            failures.append(payload["cycle"])
+        if json_lines:
+            print(_json.dumps(payload), file=out, flush=True)
+            return
+        graph = payload["graph"]
+        edits = ""
+        if payload["changed"] or payload["removed"]:
+            edits = " (%s)" % ", ".join(
+                payload["changed"] + [f"-{r}" for r in payload["removed"]]
+            )
+        print(
+            "cycle %d: %s %d jobs in %.2fs — graph dirty=%d reused=%d "
+            "recomputed=%d%s"
+            % (
+                payload["cycle"],
+                "ok" if payload["ok"] else "FAIL",
+                len(payload["results"]),
+                payload["seconds"],
+                graph["dirty"], graph["reused"], graph["recomputed"],
+                edits,
+            ),
+            file=out, flush=True,
+        )
+        for result in payload["results"]:
+            if not result["ok"]:
+                print(f"  FAIL {result['id']} ({result['command']})",
+                      file=out)
+                for line in result["stderr"].rstrip().splitlines():
+                    print(f"      {line}", file=out)
+
+    try:
+        watch_loop(jobs, emit, cycles=cycles, interval=interval)
+    except KeyboardInterrupt:  # pragma: no cover - interactive exit
+        pass
+    return 1 if failures else 0

@@ -131,6 +131,45 @@ print(
         len(batch["identity_by_cache_mode"]),
     )
 )
+
+# incremental engine (PR 5): the edit-one-file vet+test cycle must be
+# byte-identical to a cache-off cold recompute — in-process AND through
+# the batch layer in off/mem/disk × thread/process × JOBS=1/8 — and at
+# least 3x faster than cold (the depgraph's minimal-recomputation bar).
+incremental = detail["incremental"]
+assert incremental["matches_cold"] is True, (
+    "incremental vet/test diverged from the cold recompute"
+)
+for cache_mode, ok in incremental["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"incremental identity failed (cache={cache_mode})"
+    )
+assert incremental["speedup"] >= 3, (
+    "edit-one-file cycle below the 3x bar: %.2f" % incremental["speedup"]
+)
+print(
+    "incremental contract OK: cold=%.3fs edit-one-file=%.3fs (x%.1f), "
+    "identity clean in %d cache modes (edited %s)"
+    % (
+        incremental["cold_cpu_s_median"],
+        incremental["incremental_cpu_s_median"],
+        incremental["speedup"],
+        len(incremental["identity_by_cache_mode"]),
+        incremental["edited_file"],
+    )
+)
+
+# spans fast path: with profiling off, span() must be a no-op closure —
+# its estimated share of a cold codegen run stays under 1%.
+span = detail["span_overhead"]
+assert span["ok"] is True, (
+    "profiling-off span overhead %.4f%% of the cold path"
+    % (span["fraction_of_cold"] * 100)
+)
+print(
+    "span overhead OK: %.0fns/call, %.4f%% of the cold codegen run"
+    % (span["per_call_ns"], span["fraction_of_cold"] * 100)
+)
 PYEOF
 
 # Analyzer zero-findings gate over the reference corpus (when the

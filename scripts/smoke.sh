@@ -48,6 +48,12 @@ if [[ -n "${json_out}" ]]; then
   exit 1
 fi
 
+echo "==> watch --cycles 1 (the incremental edit loop's entry point)"
+printf 'jobs:\n  - command: vet\n    path: %s\n' "${PROJ}" \
+    > "${WORK}/watch.yaml"
+run watch --manifest "${WORK}/watch.yaml" --cycles 1 \
+    | grep -q "graph dirty="
+
 echo "==> the generated project's OWN test suite (interpreted go test ./...)"
 run test "${PROJ}" --e2e
 

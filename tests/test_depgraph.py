@@ -1,0 +1,272 @@
+"""Dependency-graph engine unit tests (PR 5 tentpole).
+
+The graph may only ever change HOW MUCH work runs, never WHAT it
+produces: nodes replay only while every recorded dependency signature
+still matches, invalidation sweeps transitive dependents, and the
+``off`` cache mode bypasses the graph entirely.
+"""
+
+import os
+
+import pytest
+
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import spans
+from operator_forge.perf.depgraph import GRAPH, DepGraph
+
+
+@pytest.fixture
+def graph():
+    g = DepGraph()
+    yield g
+    g.reset()
+
+
+def sigs(mapping):
+    return mapping.get
+
+
+class TestMemo:
+    def test_recompute_then_reuse(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("src", "a"): "1"}
+        calls = []
+
+        def build():
+            calls.append(1)
+            return "value"
+
+        out1 = graph.memo("t", ("k",), sigs(current), build,
+                          deps={("src", "a"): "1"})
+        out2 = graph.memo("t", ("k",), sigs(current), build,
+                          deps={("src", "a"): "1"})
+        assert out1 == out2 == "value"
+        assert len(calls) == 1
+        assert graph.counters() == {
+            "dirty": 0, "reused": 1, "recomputed": 1,
+        }
+
+    def test_changed_dep_recomputes(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("src", "a"): "1"}
+        calls = []
+        graph.memo("t", ("k",), sigs(current), lambda: calls.append(1),
+                   deps=dict(current))
+        current[("src", "a")] = "2"
+        graph.memo("t", ("k",), sigs(current), lambda: calls.append(1),
+                   deps=dict(current))
+        assert len(calls) == 2
+        assert graph.counters()["recomputed"] == 2
+
+    def test_off_mode_always_builds_and_stores_nothing(self, graph):
+        perfcache.configure(mode="off")
+        calls = []
+        for _ in range(3):
+            graph.memo("t", ("k",), sigs({("src", "a"): "1"}),
+                       lambda: calls.append(1) or "v",
+                       deps={("src", "a"): "1"})
+        assert len(calls) == 3
+        assert graph.counters() == {
+            "dirty": 0, "reused": 0, "recomputed": 0,
+        }
+
+    def test_store_if_vetoes_recording(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("src", "a"): "1"}
+        calls = []
+
+        def build():
+            calls.append(1)
+            return "transient-fault"
+
+        for _ in range(2):
+            graph.memo("t", ("k",), sigs(current), build,
+                       deps=dict(current),
+                       store_if=lambda v: v != "transient-fault")
+        assert len(calls) == 2  # never replayed
+
+    def test_disk_trace_survives_process_state_reset(self, graph,
+                                                     tmp_path):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        current = {("src", "a"): "1"}
+        calls = []
+        graph.memo("t", ("k",), sigs(current),
+                   lambda: calls.append(1) or "v", deps=dict(current))
+        # a fresh graph (new process, conceptually) replays from disk
+        other = DepGraph()
+        out = other.memo("t", ("k",), sigs(current),
+                         lambda: calls.append(1) or "v",
+                         deps=dict(current))
+        assert out == "v" and len(calls) == 1
+        assert other.counters()["reused"] == 1
+
+
+class TestRecording:
+    def test_edges_recorded_automatically(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("pkg", "fmt"): "s1"}
+        calls = []
+
+        def build():
+            calls.append(1)
+            graph.read(("pkg", "fmt"), current[("pkg", "fmt")])
+            return "v"
+
+        graph.memo("t", ("k",), sigs(current), build)
+        graph.memo("t", ("k",), sigs(current), build)
+        assert len(calls) == 1
+        current[("pkg", "fmt")] = "s2"  # the consulted fact changed
+        graph.memo("t", ("k",), sigs(current), build)
+        assert len(calls) == 2
+
+    def test_nested_frames_propagate_to_parents(self, graph):
+        with graph.recording() as outer:
+            with graph.recording() as inner:
+                graph.read(("src", "x"), "1")
+            graph.read(("src", "y"), "2")
+        assert inner == {("src", "x"): "1"}
+        assert outer == {("src", "x"): "1", ("src", "y"): "2"}
+
+    def test_read_outside_recording_is_noop(self, graph):
+        graph.read(("src", "x"), "1")  # must not raise
+
+
+class TestInvalidate:
+    def test_transitive_dependents_dropped(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("src", "a"): "1"}
+        graph.memo("t", ("mid",), sigs(current), lambda: "m",
+                   deps={("src", "a"): "1"})
+        graph.memo("t", ("top",), sigs(current), lambda: "t",
+                   deps={("mid",): None})  # depends on the mid node key
+        dropped = graph.invalidate([("src", "a")])
+        assert dropped == 2  # mid and, transitively, top
+        assert graph.counters()["dirty"] == 2
+
+    def test_unrelated_nodes_survive(self, graph):
+        perfcache.configure(mode="mem")
+        current = {("src", "a"): "1", ("src", "b"): "1"}
+        calls = []
+        graph.memo("t", ("ka",), sigs(current),
+                   lambda: calls.append("a"), deps={("src", "a"): "1"})
+        graph.memo("t", ("kb",), sigs(current),
+                   lambda: calls.append("b"), deps={("src", "b"): "1"})
+        graph.invalidate([("src", "a")])
+        graph.memo("t", ("kb",), sigs(current),
+                   lambda: calls.append("b"), deps={("src", "b"): "1"})
+        assert calls == ["a", "b"]  # kb replayed after the sweep
+
+    def test_global_graph_resets_with_the_content_cache(self):
+        perfcache.configure(mode="mem")
+        GRAPH.memo("t", ("k",), lambda _k: "1", lambda: "v",
+                   deps={("src", "a"): "1"})
+        assert GRAPH.counters()["recomputed"] >= 1
+        perfcache.reset()
+        assert GRAPH.counters() == {
+            "dirty": 0, "reused": 0, "recomputed": 0,
+        }
+
+
+class TestSpanFastPath:
+    def test_disabled_span_is_shared_noop(self, monkeypatch):
+        monkeypatch.delenv("OPERATOR_FORGE_PROFILE", raising=False)
+        spans.use_env()
+        assert spans.enabled() is False
+        assert spans.span("x") is spans.span("y")  # one shared context
+        with spans.span("fast.noop"):
+            pass
+        assert "fast.noop" not in spans.snapshot()
+
+    def test_enable_swaps_in_the_timing_span(self):
+        spans.enable(True)
+        try:
+            with spans.span("fast.timed"):
+                pass
+            assert spans.snapshot()["fast.timed"]["calls"] == 1
+        finally:
+            spans.use_env()
+
+    def test_refresh_follows_env_change(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_PROFILE", "1")
+        spans.use_env()
+        assert spans.enabled() is True
+        monkeypatch.setenv("OPERATOR_FORGE_PROFILE", "0")
+        assert spans.enabled() is True  # cached: no per-call env reads
+        spans.refresh()
+        assert spans.enabled() is False
+
+
+class TestCacheEviction:
+    def _fill(self, n=8, size=4096):
+        cache = perfcache.get_cache()
+        for i in range(n):
+            cache.put("evict", f"key-{i}", os.urandom(size))
+        return cache
+
+    def test_gc_prunes_lru_to_ceiling(self, tmp_path, monkeypatch):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cache = self._fill()
+        summary = cache.gc(max_bytes=3 * 5000)
+        assert summary["removed"] >= 4
+        assert summary["bytes_after"] <= 3 * 5000
+        assert summary["bytes_after"] < summary["bytes_before"]
+
+    def test_surviving_entries_still_verify(self, tmp_path):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cache = self._fill()
+        values = {
+            i: cache.get("evict", f"key-{i}") for i in range(8)
+        }
+        cache.gc(max_bytes=3 * 5000)
+        # drop the in-memory layer: force every get through disk+HMAC
+        perfcache.reset()
+        hits = misses = 0
+        for i in range(8):
+            got = cache.get("evict", f"key-{i}")
+            if got is perfcache.MISS:
+                misses += 1  # pruned: a miss, never a verify error
+            else:
+                hits += 1
+                assert got == values[i]  # intact and authenticated
+        assert misses >= 4 and hits >= 1
+
+    def test_in_flight_read_survives_prune(self, tmp_path):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cache = self._fill(n=2)
+        stage_dir = os.path.join(str(tmp_path / "cache"), "evict")
+        blobs = []
+        for sub in os.listdir(stage_dir):
+            for name in os.listdir(os.path.join(stage_dir, sub)):
+                blobs.append(os.path.join(stage_dir, sub, name))
+        handle = open(blobs[0], "rb")  # an in-flight reader
+        cache.gc(max_bytes=0)
+        assert handle.read()  # POSIX unlink: open handle keeps its data
+        handle.close()
+
+    def test_max_mb_env_and_off_switch(self, monkeypatch):
+        cache = perfcache.get_cache()
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "64")
+        assert cache.max_bytes() == 64 * 1024 * 1024
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "0")
+        assert cache.max_bytes() <= 0
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "bogus")
+        assert cache.max_bytes() == 256 * 1024 * 1024
+
+    def test_cache_gc_cli(self, tmp_path, capsys, monkeypatch):
+        import json
+
+        from operator_forge.cli.main import main as cli_main
+
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        self._fill(n=4)
+        assert cli_main(["cache", "gc", "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["entries"] == 4 and summary["removed"] == 0
+        assert cli_main(["cache", "gc", "--max-mb", "0.003"]) == 0
+        out = capsys.readouterr().out
+        assert "cache gc:" in out and "removed" in out

@@ -335,6 +335,87 @@ class TestServeLoop:
         assert shutdown_resp["ok"] and shutdown_resp["op"] == "shutdown"
         assert os.path.exists(os.path.join(out_dir, "PROJECT"))
 
+    def test_stats_reports_ratios_and_graph_counters(self, tmp_path):
+        """The stats op reports per-namespace hit/miss RATIOS (stable
+        key order) and the dependency graph's cumulative counters."""
+        perfcache.configure(mode="mem")
+        config = _config_copy(str(tmp_path), "stats")
+        out_dir = str(tmp_path / "stats-served")
+        job = {"command": "init", "workload_config": config,
+               "output_dir": out_dir, "repo": "github.com/acme/app"}
+        requests = [job, {"command": "vet", "path": out_dir},
+                    {"command": "vet", "path": out_dir},
+                    {"op": "stats"}, {"op": "shutdown"}]
+        in_stream = io.StringIO(
+            "\n".join(json.dumps(r) for r in requests) + "\n"
+        )
+        out_stream = io.StringIO()
+        assert serve_loop(in_stream, out_stream) == 0
+        responses = [
+            json.loads(line)
+            for line in out_stream.getvalue().splitlines()
+        ]
+        stats = responses[3]
+        assert stats["ok"] and stats["op"] == "stats"
+        # namespaces sorted; every entry carries hits/misses/ratio
+        assert list(stats["cache"]) == sorted(stats["cache"])
+        for entry in stats["cache"].values():
+            assert list(entry) == ["hits", "misses", "ratio"]
+            total = entry["hits"] + entry["misses"]
+            expected = entry["hits"] / total if total else 0.0
+            assert abs(entry["ratio"] - expected) < 1e-3
+        # the gocheck namespaces the vet path feeds are present, and
+        # the repeated vet actually hit
+        assert "gocheck.parse" in stats["cache"]
+        assert "gocheck.index" in stats["cache"]
+        # the repeated vet replayed at the job level (whole-job trace)
+        assert stats["cache"]["serve.job"]["hits"] >= 1
+        assert list(stats["graph"]) == ["dirty", "reused", "recomputed"]
+        assert stats["graph"]["recomputed"] > 0
+
+    def test_watch_op_streams_cycles_then_done(self, tmp_path):
+        """watch is the one streaming op: one response line per cycle
+        plus a final done line, all echoing the request id."""
+        perfcache.configure(mode="mem")
+        config = _config_copy(str(tmp_path), "watch")
+        out_dir = str(tmp_path / "watch-served")
+        requests = [
+            {"command": "init", "workload_config": config,
+             "output_dir": out_dir, "repo": "github.com/acme/app"},
+            {"id": "w", "op": "watch", "cycles": 1,
+             "jobs": [{"command": "vet", "path": out_dir}]},
+            {"op": "shutdown"},
+        ]
+        in_stream = io.StringIO(
+            "\n".join(json.dumps(r) for r in requests) + "\n"
+        )
+        out_stream = io.StringIO()
+        assert serve_loop(in_stream, out_stream) == 0
+        responses = [
+            json.loads(line)
+            for line in out_stream.getvalue().splitlines()
+        ]
+        cycle, done = responses[1], responses[2]
+        assert cycle["op"] == "watch" and cycle["cycle"] == 0
+        assert cycle["id"] == "w" and cycle["ok"]
+        assert list(cycle["graph"]) == ["dirty", "reused", "recomputed"]
+        assert done["op"] == "watch" and done["done"] is True
+        assert done["cycles"] == 1 and done["id"] == "w"
+
+    def test_watch_op_rejects_bad_cycles(self, tmp_path):
+        requests = [
+            {"op": "watch", "cycles": 0, "jobs": [
+                {"command": "vet", "path": str(tmp_path)}]},
+            {"op": "shutdown"},
+        ]
+        in_stream = io.StringIO(
+            "\n".join(json.dumps(r) for r in requests) + "\n"
+        )
+        out_stream = io.StringIO()
+        assert serve_loop(in_stream, out_stream) == 0
+        first = json.loads(out_stream.getvalue().splitlines()[0])
+        assert not first["ok"] and "cycles" in first["error"]
+
     def test_warm_serve_requests_replay(self, tmp_path):
         perfcache.configure(mode="mem")
         config = _config_copy(str(tmp_path), "warm")
