@@ -20,6 +20,7 @@ import argparse
 import functools
 import os
 import sys
+import threading
 
 from operator_forge.utils import yamlcompat as pyyaml
 
@@ -540,7 +541,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -559,12 +560,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -829,28 +830,156 @@ def cmd_cache_gc(args: argparse.Namespace) -> int:
     """`cache gc`: prune the on-disk content cache to its size ceiling
     (OPERATOR_FORGE_CACHE_MAX_MB, default 256), least-recently-used
     entries first.  Removal is whole-file, so surviving entries always
-    verify; a pruned entry is simply a future miss."""
+    verify; a pruned entry is simply a future miss.  The summary is
+    always machine-readable JSON (stable key order) — scripts consume
+    it, and `--verbose` adds detail keys rather than switching to
+    human prose."""
     import json as _json
 
     max_bytes = None
     if args.max_mb is not None:
         max_bytes = int(args.max_mb * 1024 * 1024)
     summary = perfcache.gc(max_bytes)
-    if args.json:
-        print(_json.dumps(summary))
-    else:
-        print(
-            "cache gc: %d entries, %.1f MiB -> %.1f MiB "
-            "(%d removed, ceiling %.0f MiB)"
-            % (
-                summary["entries"],
-                summary["bytes_before"] / (1024 * 1024),
-                summary["bytes_after"] / (1024 * 1024),
-                summary["removed"],
-                summary["max_bytes"] / (1024 * 1024),
-            )
-        )
+    out = {
+        "entries_removed": summary["entries_removed"],
+        "bytes_reclaimed": summary["bytes_reclaimed"],
+        "bytes_remaining": summary["bytes_remaining"],
+    }
+    if args.verbose or args.json:
+        # detail keys, including the pre-PR-6 --json spellings, so
+        # existing consumers of removed/bytes_before/bytes_after keep
+        # reading real values
+        for key in ("entries", "max_bytes", "removed", "bytes_before",
+                    "bytes_after"):
+            out[key] = summary[key]
+    print(_json.dumps(out))
     return 0
+
+
+def cmd_stats(args: argparse.Namespace) -> int:
+    """`stats`: the observability surface of this process — per-
+    namespace cache hit/miss attribution, dependency-graph counters,
+    the metrics registry (counters, gauges, p50/p99 latency
+    histograms), and the span table — in stable key order.  A one-shot
+    CLI process reports its own (mostly cold) state; the same document
+    is what a resident `serve` process answers to the `stats` op, where
+    the numbers accumulate across requests."""
+    import json as _json
+
+    from ..perf import metrics
+
+    report = metrics.report()
+    if args.json:
+        print(_json.dumps(report))
+        return 0
+    print("cache namespaces:")
+    for stage, entry in report["cache"].items():
+        print(
+            f"  {stage}: {entry['hits']} hits / {entry['misses']} "
+            f"misses (ratio {entry['ratio']})"
+        )
+    if not report["cache"]:
+        print("  (none)")
+    graph = report["graph"]
+    print(
+        "graph: dirty=%d reused=%d recomputed=%d"
+        % (graph["dirty"], graph["reused"], graph["recomputed"])
+    )
+    snap = report["metrics"]
+    for name, value in snap["counters"].items():
+        print(f"counter {name}: {value}")
+    for name, value in snap["gauges"].items():
+        print(f"gauge {name}: {value}")
+    for name, hist in snap["histograms"].items():
+        print(
+            f"histogram {name}: count={hist['count']} "
+            f"p50={hist['p50']} p99={hist['p99']} max={hist['max']}"
+        )
+    if report["spans"]:
+        print("spans:")
+        for name, data in report["spans"].items():
+            print(
+                f"  {name}: {data['calls']} calls, {data['s']:.4f}s"
+            )
+    return 0
+
+
+def cmd_explain(args: argparse.Namespace) -> int:
+    """`explain`: why would (or did) an edit recompute what it
+    recomputed?  Given a project root and one or more changed files,
+    print the invalidation chain — changed file → dirtied per-file
+    diagnostics node → dirtied package suites (reverse import
+    closure) → project-index delta → minimally re-run jobs.  The
+    chain is derived from the tree's bytes, not from live cache state,
+    so the report is byte-identical across cache modes, worker
+    backends, and job counts (the observability counterpart of Bazel's
+    --explain and `go build`'s cache-key reasoning)."""
+    import json as _json
+
+    from operator_forge.gocheck.explain import (
+        explain_report,
+        explain_summary,
+    )
+
+    root = args.path
+    if not os.path.isdir(root):
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 1
+    # copies: argparse's append action hands back the parser's shared
+    # default list when a flag wasn't passed, and build_parser() is
+    # cached — mutating it would pollute every later parse
+    changed = list(args.changed or [])
+    removed = list(args.removed or [])
+    if not changed and not removed:
+        print(
+            "error: pass --changed <file> (and/or --removed <file>), "
+            "relative to the project root",
+            file=sys.stderr,
+        )
+        return 1
+    for rel in list(changed):
+        if not os.path.exists(os.path.join(root, rel)):
+            print(
+                f"warning: {rel} does not exist under {root} "
+                "(explaining it as a removal)",
+                file=sys.stderr,
+            )
+            changed.remove(rel)
+            removed.append(rel)
+    if args.json:
+        for entry in explain_summary(root, changed, removed):
+            print(_json.dumps(entry))
+        return 0
+    sys.stdout.write(explain_report(root, changed, removed))
+    return 0
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """`trace`: run any operator-forge command with structured tracing
+    enabled and write the merged timeline as Chrome trace-event JSON
+    (load it in chrome://tracing or Perfetto).  Worker processes ship
+    their span buffers back through the HMAC-signed result round-trip,
+    so one file covers serial, thread-pool, and process-pool work.
+    Equivalent: OPERATOR_FORGE_TRACE=<path> operator-forge <cmd>."""
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        raise CLIError(
+            "trace: give a command to run, e.g. "
+            "`operator-forge trace --out trace.json vet <dir>`"
+        )
+    if cmd[0] == "trace":
+        raise CLIError("trace: cannot trace itself")
+    spans.clear_events()
+    spans.enable_tracing(True)
+    try:
+        rc = main(cmd)
+    finally:
+        spans.enable_tracing(None)
+        n = spans.write_chrome_trace(args.out)
+        print(f"trace: {n} events -> {args.out}", file=sys.stderr)
+    return rc
 
 
 @functools.cache
@@ -1119,11 +1248,77 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_gc.add_argument(
         "--json", action="store_true",
-        help="emit the collection summary as JSON",
+        help="include the detail keys older scripts consumed "
+             "(removed, bytes_before, bytes_after, ...); the summary "
+             "itself is always JSON",
+    )
+    p_gc.add_argument(
+        "--verbose", action="store_true",
+        help="include detail keys (entries, max_bytes, removed, "
+             "bytes_before, bytes_after) in the JSON summary",
     )
     p_gc.set_defaults(func=cmd_cache_gc)
 
+    p_stats = sub.add_parser(
+        "stats",
+        help="report the observability surface: cache hit/miss "
+             "attribution, graph counters, metrics (p50/p99 "
+             "histograms), and the span table",
+    )
+    p_stats.add_argument(
+        "--json", action="store_true",
+        help="emit the full report as one JSON object (stable key "
+             "order) instead of the human summary",
+    )
+    p_stats.set_defaults(func=cmd_stats)
+
+    p_explain = sub.add_parser(
+        "explain",
+        help="print the invalidation chain a changed file triggers "
+             "(what recomputes, and why) for a generated project",
+    )
+    p_explain.add_argument("path", help="root of the generated project")
+    p_explain.add_argument(
+        "--changed", action="append", default=[], metavar="FILE",
+        help="a changed file, relative to the project root "
+             "(repeatable)",
+    )
+    p_explain.add_argument(
+        "--removed", action="append", default=[], metavar="FILE",
+        help="a removed file, relative to the project root "
+             "(repeatable)",
+    )
+    p_explain.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON object per changed file (stable key "
+             "order) instead of the text report",
+    )
+    p_explain.set_defaults(func=cmd_explain)
+
+    p_trace = sub.add_parser(
+        "trace",
+        help="run a command with structured tracing and write a "
+             "Chrome trace-event JSON timeline",
+    )
+    p_trace.add_argument(
+        "--out", required=True, metavar="PATH",
+        help="where to write the Chrome trace JSON",
+    )
+    p_trace.add_argument(
+        "cmd", nargs=argparse.REMAINDER,
+        help="the operator-forge command to run under tracing",
+    )
+    p_trace.set_defaults(func=cmd_trace)
+
     return parser
+
+
+# re-entrancy depth across every thread: batch/serve jobs and the
+# `trace` wrapper all call main() recursively, and the env-driven
+# Chrome-trace export must fire once, at the OUTERMOST exit — not per
+# nested job (which would overwrite the file mid-run)
+_depth_lock = threading.Lock()
+_main_depth = [0]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1131,6 +1326,8 @@ def main(argv: list[str] | None = None) -> int:
     # loop both catch it at their own boundary, keeping the serve
     # package out of the startup import path
     args = build_parser().parse_args(argv)
+    with _depth_lock:
+        _main_depth[0] += 1
     try:
         with spans.span(f"command:{args.command}"):
             return args.func(args)
@@ -1153,6 +1350,13 @@ def main(argv: list[str] | None = None) -> int:
             pass
         return 141
     finally:
+        with _depth_lock:
+            _main_depth[0] -= 1
+            outermost = _main_depth[0] == 0
+        trace_path = os.environ.get("OPERATOR_FORGE_TRACE", "").strip()
+        if outermost and trace_path and not spans.trace_export_suppressed():
+            n = spans.write_chrome_trace(trace_path)
+            print(f"trace: {n} events -> {trace_path}", file=sys.stderr)
         # a profiled run that fails still reports the work it did
         if os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0"):
             spans.report(sys.stderr)

@@ -1,0 +1,247 @@
+"""Invalidation provenance, derived structurally (``explain``).
+
+``operator-forge explain <root> --changed <file>`` answers *"what
+recomputes, and why, when this file changes?"* — the local counterpart
+of Bazel's ``--explain`` log and ``go build``'s cache-key reasoning.
+
+The report is deliberately **not** read from the live dependency graph
+(:data:`operator_forge.perf.depgraph.GRAPH` records *what happened*,
+which legitimately differs across cache modes — ``off`` installs no
+nodes at all — and across worker backends, where process workers keep
+their own graphs).  Instead the chain is **derived from the tree's
+bytes**: the file's package membership, the project's reverse import
+closure (``go.mod`` module path + per-file imports), and the artifact
+kinds each incremental layer keys on (per-file diagnostics, per-package
+suites, the project index, generation plans).  A pure function of tree
+content is byte-identical across ``OPERATOR_FORGE_CACHE=off|mem|disk``,
+``OPERATOR_FORGE_WORKERS=thread|process``, and any ``JOBS`` width —
+the property bench.py's ``telemetry.explain_identity`` guard and
+tests/test_observability.py enforce.
+
+The same derivation feeds the ``watch`` loop's per-cycle provenance
+summary and the serve ``explain`` op.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .gopkg import ProjectRuntime
+from .structural import parse_imports, prune_go_dirs
+
+
+def module_path(root: str) -> str:
+    """The project's Go module path (``go.mod``), with the same
+    fallback the interpreter's world uses."""
+    return ProjectRuntime._module_path(root)
+
+
+def _pkg_path(module: str, pkg_dir: str) -> str:
+    return module if pkg_dir == "." else f"{module}/{pkg_dir}"
+
+
+# per-file import memo keyed on (path, mtime_ns, size): the watch loop
+# calls package_imports every cycle, and a one-file edit must cost one
+# file READ, not a whole-tree re-parse (the walk itself is stat-only —
+# the same order of work as the watch snapshot poll).  Bounded: stale
+# paths are dropped whenever the table outgrows the live tree.
+_file_imports_memo: dict = {}
+
+
+def _imports_of(path: str, mtime_ns: int, size: int):
+    key = (mtime_ns, size)
+    hit = _file_imports_memo.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except (OSError, UnicodeDecodeError):
+        return ()
+    imports = tuple(p for _alias, p in parse_imports(text))
+    _file_imports_memo[path] = (key, imports)
+    return imports
+
+
+def package_imports(root: str) -> dict:
+    """``{package_dir_rel: sorted imported paths}`` over every ``.go``
+    file under ``root`` (test files included — a package's suite
+    re-runs when anything in its *test* import closure changes too),
+    with the standard tree-pruning rules.  Unchanged files (same
+    mtime+size) replay their imports from the in-process memo."""
+    imports: dict = {}
+    live_paths = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = prune_go_dirs(dirnames)
+        go_files = [
+            name for name in sorted(filenames)
+            if name.endswith(".go") and not name.startswith(("_", "."))
+        ]
+        if not go_files:
+            continue
+        rel = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        rel = "." if rel == "." else rel
+        paths = set()
+        for name in go_files:
+            path = os.path.join(dirpath, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            live_paths.add(path)
+            paths.update(_imports_of(path, st.st_mtime_ns, st.st_size))
+        imports[rel] = sorted(paths)
+    if len(_file_imports_memo) > 4 * max(len(live_paths), 256):
+        # many roots/deleted trees accumulated: drop dead entries
+        for path in list(_file_imports_memo):
+            if path not in live_paths:
+                del _file_imports_memo[path]
+    return imports
+
+
+def reverse_import_chains(root: str, pkg_dir: str, imports=None) -> dict:
+    """``{dependent_pkg_dir: import chain}`` for every package whose
+    (transitive) import closure contains ``pkg_dir``'s package.  The
+    chain lists package dirs from the dependent down to ``pkg_dir``;
+    BFS expands in sorted order, so the first-found chain — and with
+    it the whole mapping — is deterministic."""
+    module = module_path(root)
+    if imports is None:
+        imports = package_imports(root)
+    chains: dict = {}
+    order = sorted(imports)  # hoisted: BFS determinism needs the order,
+    frontier = [(pkg_dir, (pkg_dir,))]  # not a re-sort per frontier entry
+    while frontier:
+        next_frontier = []
+        for target_dir, chain in frontier:
+            target_path = _pkg_path(module, target_dir)
+            for importer in order:
+                if importer == pkg_dir or importer in chains:
+                    continue
+                if target_path in imports[importer]:
+                    chains[importer] = (importer,) + chain
+                    next_frontier.append((importer, (importer,) + chain))
+        frontier = next_frontier
+    return chains
+
+
+def chain_for(root: str, rel: str, imports=None) -> list:
+    """The invalidation chain for one changed file, as deterministic
+    report lines (no timestamps, no absolute paths beyond what the
+    caller passed as ``root``)."""
+    rel = rel.replace(os.sep, "/")
+    lines = [f"file {rel} changed"]
+    if rel == "go.mod":
+        lines.append(
+            "  -> module path may change: every package re-keys "
+            "(project index, all suites, all import resolution)"
+        )
+        lines.append("  -> jobs re-run: vet, test (full recompute)")
+        return lines
+    if rel.endswith(".go"):
+        pkg_dir = os.path.dirname(rel).replace(os.sep, "/") or "."
+        module = module_path(root)
+        lines.append(
+            f"  -> invalidated node src:{rel} "
+            f"(re-parse + re-analyze: per-file diagnostics)"
+        )
+        if rel.endswith("_test.go"):
+            lines.append(
+                f"  -> invalidated suite {pkg_dir} "
+                f"(package owns the edited test file)"
+            )
+        else:
+            lines.append(
+                f"  -> invalidated suite {pkg_dir} "
+                f"(package contains {rel})"
+            )
+            lines.append(
+                f"  -> invalidated package surface "
+                f"pkg:{_pkg_path(module, pkg_dir)} "
+                f"(exported decls consulted by other files' analysis)"
+            )
+            for dep_dir, chain in sorted(
+                reverse_import_chains(root, pkg_dir, imports).items()
+            ):
+                arrow = " -> ".join(chain)
+                lines.append(
+                    f"  -> invalidated suite {dep_dir} "
+                    f"(import chain: {arrow})"
+                )
+        lines.append(
+            f"  -> project index patched by delta ({rel}); "
+            f"unchanged files' scans replay"
+        )
+        lines.append(
+            "  -> jobs re-run minimally: vet, test "
+            "(every other artifact replays from its trace)"
+        )
+        return lines
+    # a non-Go input: workload config, marker-annotated manifest, or
+    # any other byte the generation plan snapshotted
+    lines.append(
+        "  -> generation plan dependency snapshot no longer matches "
+        "(config/manifest bytes are part of the plan key)"
+    )
+    lines.append(
+        "  -> init / create api re-render; byte-identical outputs are "
+        "left untouched"
+    )
+    lines.append(
+        "  -> regenerated files re-vet / re-test downstream; "
+        "unchanged artifacts replay"
+    )
+    return lines
+
+
+def explain_report(root: str, changed, removed=(), imports=None) -> str:
+    """The full deterministic provenance report for a change set:
+    one chain block per changed/removed file, sorted, with a one-line
+    header.  ``changed``/``removed`` are paths relative to ``root``;
+    pass a precomputed ``imports`` map to share one tree walk across
+    sibling calls (the serve op derives summary AND report)."""
+    changed = sorted(
+        {str(rel).replace(os.sep, "/") for rel in changed}
+    )
+    removed = sorted(
+        {str(rel).replace(os.sep, "/") for rel in removed}
+    )
+    total = len(changed) + len(removed)
+    noun = "change" if total == 1 else "changes"
+    out = [f"explain: {total} {noun} under {root}"]
+    if imports is None and any(
+        rel.endswith(".go") for rel in changed + removed
+    ):
+        imports = package_imports(root)
+    for rel in changed:
+        out.extend(chain_for(root, rel, imports))
+    for rel in removed:
+        out.append(f"file {rel} removed")
+        out.extend(chain_for(root, rel, imports)[1:])
+    return "\n".join(out) + "\n"
+
+
+def explain_summary(root: str, changed, removed=(), imports=None) -> list:
+    """Structured form of :func:`explain_report` for JSON consumers
+    (the ``watch`` per-cycle payload and ``explain --json``): a sorted
+    list of ``{"file", "event", "chain"}`` entries."""
+    rels_changed = sorted(
+        {str(rel).replace(os.sep, "/") for rel in changed}
+    )
+    rels_removed = sorted(
+        {str(rel).replace(os.sep, "/") for rel in removed}
+    )
+    if imports is None and any(
+        rel.endswith(".go") for rel in rels_changed + rels_removed
+    ):
+        imports = package_imports(root)
+    out = []
+    for event, rels in (("changed", rels_changed),
+                        ("removed", rels_removed)):
+        for rel in rels:
+            out.append({
+                "file": rel,
+                "event": event,
+                "chain": chain_for(root, rel, imports)[1:],
+            })
+    return out

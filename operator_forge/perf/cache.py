@@ -381,7 +381,12 @@ class ContentCache:
         is either absent (a miss) or intact-and-signed — pruning can
         never produce a blob that fails HMAC verification, and a reader
         holding an open handle keeps its data (POSIX unlink semantics).
-        Returns a summary dict (stable key order)."""
+        Returns a summary dict (stable key order; ``entries_removed`` /
+        ``bytes_reclaimed`` / ``bytes_remaining`` are the CLI's JSON
+        contract, the rest detail).  Evictions are counted in the
+        metrics registry (``cache.evictions`` /
+        ``cache.bytes_reclaimed``) whether the sweep came from the
+        amortized on-write trigger or ``cache gc``."""
         limit = self.max_bytes() if max_bytes is None else int(max_bytes)
         root = self.root()
         entries = []  # (atime_ns, mtime_ns, size, path)
@@ -411,7 +416,15 @@ class ContentCache:
                 freed += size
                 if total - freed <= limit:
                     break
+        if removed:
+            from . import metrics
+
+            metrics.counter("cache.evictions").inc(removed)
+            metrics.counter("cache.bytes_reclaimed").inc(freed)
         return {
+            "entries_removed": removed,
+            "bytes_reclaimed": freed,
+            "bytes_remaining": total - freed,
             "entries": len(entries),
             "max_bytes": limit,
             "removed": removed,

@@ -33,6 +33,19 @@ cache-off path pays zero overhead and always recomputes live.
 Counters (``dirty`` / ``reused`` / ``recomputed``) feed the serve
 layer's ``stats`` op and the per-cycle ``graph`` report of the
 ``watch`` loop.
+
+**Invalidation provenance** (PR 6): the graph also records *why* each
+node went dirty — the changed input edge that failed validation and,
+for reverse-dependency sweeps, the chain of node keys from the root
+cause to the dirtied node.  :meth:`DepGraph.provenance` returns the
+recorded table (bounded, deterministic order) and
+:meth:`DepGraph.last_invalidation` the most recent sweep's summary;
+the serve ``stats`` op surfaces both.  The *deterministic* explain
+report (``operator-forge explain``) is derived structurally from the
+tree instead (:mod:`operator_forge.gocheck.explain`), because this
+recorded table legitimately differs across cache modes and worker
+backends — an ``off``-mode run installs no nodes at all, and process
+workers keep their own graphs.
 """
 
 from __future__ import annotations
@@ -40,6 +53,16 @@ from __future__ import annotations
 import threading
 
 from . import cache as pf_cache
+
+
+def _render_key(key) -> str:
+    """Human/JSON rendering of a plain-data node or input key:
+    ``("src", "a.go")`` → ``src:a.go``; long composite keys keep their
+    leading namespace tag plus the string parts worth reading."""
+    if isinstance(key, tuple):
+        parts = [str(p) for p in key if isinstance(p, (str, int, bool))]
+        return ":".join(parts) if parts else repr(key)
+    return str(key)
 
 
 class _Node:
@@ -53,12 +76,20 @@ class _Node:
 class DepGraph:
     """Thread-safe verifying-trace dependency graph."""
 
+    #: recorded-provenance table cap: known keys keep updating, but no
+    #: NEW keys are stored past it (bounds memory on long serve
+    #: sessions; the counters still count every dirtied node)
+    PROVENANCE_CAP = 4096
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._nodes: dict = {}   # key -> _Node
         self._rdeps: dict = {}   # dep key -> set of node keys
         self._tls = threading.local()
         self._counts = {"dirty": 0, "reused": 0, "recomputed": 0}
+        # node key -> {"cause": root input key, "via": key chain}
+        self._prov: dict = {}
+        self._last_invalidation: dict = {}
 
     # -- counters --------------------------------------------------------
 
@@ -81,8 +112,43 @@ class DepGraph:
         with self._lock:
             self._nodes.clear()
             self._rdeps.clear()
+            self._prov.clear()
+            self._last_invalidation = {}
             for name in self._counts:
                 self._counts[name] = 0
+
+    # -- provenance ------------------------------------------------------
+
+    def _record_cause(self, key, cause, via=()) -> None:
+        # caller holds self._lock; a key already in the table always
+        # updates (stale causes must not outlive the cap), only NEW
+        # keys stop landing once the cap is reached
+        if key in self._prov or len(self._prov) < self.PROVENANCE_CAP:
+            self._prov[key] = {"cause": cause, "via": tuple(via)}
+
+    def provenance(self) -> list:
+        """The recorded why-did-this-recompute table, deterministic
+        order (sorted by node key repr): one entry per dirtied or
+        stale-validated node — ``{"node", "cause", "via"}``, each a
+        plain-data key rendered with :func:`_render_key`."""
+        with self._lock:
+            items = list(self._prov.items())
+        out = [
+            {
+                "node": _render_key(key),
+                "cause": _render_key(entry["cause"]),
+                "via": [_render_key(k) for k in entry["via"]],
+            }
+            for key, entry in items
+        ]
+        out.sort(key=lambda e: (e["node"], e["cause"]))
+        return out
+
+    def last_invalidation(self) -> dict:
+        """Summary of the most recent :meth:`invalidate` sweep:
+        ``{"roots": [...], "dirtied": n}`` (empty before any sweep)."""
+        with self._lock:
+            return dict(self._last_invalidation)
 
     # -- automatic edge recording ----------------------------------------
 
@@ -104,11 +170,17 @@ class DepGraph:
 
     # -- nodes -----------------------------------------------------------
 
-    def _valid(self, deps: dict, current_sig_of) -> bool:
+    def _first_stale(self, deps: dict, current_sig_of):
+        """The first dependency key whose current signature no longer
+        matches the recorded one (the *changed input edge*), or
+        ``None`` when the whole trace still validates."""
         for dep_key, dep_sig in deps.items():
             if current_sig_of(dep_key) != dep_sig:
-                return False
-        return True
+                return dep_key
+        return None
+
+    def _valid(self, deps: dict, current_sig_of) -> bool:
+        return self._first_stale(deps, current_sig_of) is None
 
     def _install(self, key, value, deps: dict) -> None:
         with self._lock:
@@ -124,24 +196,34 @@ class DepGraph:
         """Drop the nodes depending (transitively) on any of ``keys``
         — the reverse-dependency sweep a file edit triggers.  Returns
         how many nodes were dirtied (also added to the ``dirty``
-        counter)."""
+        counter).  Each dropped node's provenance is recorded: the
+        root-cause input key it was reached from and the chain of node
+        keys in between."""
+        roots = list(keys)
         with self._lock:
-            queue = list(keys)
+            # queue entries: (key, root cause key, chain of keys walked
+            # from the cause to — but not including — this key)
+            queue = [(key, key, ()) for key in roots]
             dropped = 0
             seen = set()
             while queue:
-                key = queue.pop()
+                key, cause, via = queue.pop()
                 if key in seen:
                     continue
                 seen.add(key)
                 for dependent in self._rdeps.pop(key, ()):
-                    queue.append(dependent)
+                    queue.append((dependent, cause, via + (key,)))
                 node = self._nodes.pop(key, None)
                 if node is not None:
                     dropped += 1
+                    self._record_cause(key, cause, via)
                     for dep_key in node.deps:
                         self._rdeps.get(dep_key, set()).discard(key)
             self._counts["dirty"] += dropped
+            self._last_invalidation = {
+                "roots": sorted(_render_key(key) for key in roots),
+                "dirtied": dropped,
+            }
         return dropped
 
     def _replay(self, value, deps: dict):
@@ -173,10 +255,16 @@ class DepGraph:
             return build()
         with self._lock:
             node = self._nodes.get(key)
-        if node is not None and self._valid(node.deps, current_sig_of):
-            self.count("reused")
-            cache._count(namespace, "hits")
-            return self._replay(node.value, node.deps)
+        if node is not None:
+            stale = self._first_stale(node.deps, current_sig_of)
+            if stale is None:
+                self.count("reused")
+                cache._count(namespace, "hits")
+                return self._replay(node.value, node.deps)
+            # the changed input edge that dirtied this node, recorded
+            # at the moment staleness is observed
+            with self._lock:
+                self._record_cause(key, stale)
         ckey = pf_cache.hash_parts(key)
         record = cache.get(namespace, ckey, record_stats=False)
         if (
@@ -184,13 +272,17 @@ class DepGraph:
             and isinstance(record, tuple)
             and len(record) == 2
             and isinstance(record[1], dict)
-            and self._valid(record[1], current_sig_of)
         ):
             value, traced = record
-            self._install(key, value, traced)
-            self.count("reused")
-            cache._count(namespace, "hits")
-            return self._replay(value, traced)
+            stale = self._first_stale(traced, current_sig_of)
+            if stale is None:
+                self._install(key, value, traced)
+                self.count("reused")
+                cache._count(namespace, "hits")
+                return self._replay(value, traced)
+            if node is None:
+                with self._lock:
+                    self._record_cause(key, stale)
         cache._count(namespace, "misses")
         self.count("recomputed")
         if deps is None:

@@ -1,0 +1,273 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+The observability counterpart of :mod:`operator_forge.perf.spans`
+(which answers "where did the time go?"): this module answers "how much
+work happened, and how fast was each unit?".  Three instrument kinds,
+all thread-safe and cheap enough to stay always-on:
+
+- :class:`Counter` — monotonically increasing integer (cache
+  evictions, worker-pool task submissions/completions);
+- :class:`Gauge` — a settable point-in-time value (worker-pool queue
+  depth), or a *callback* gauge read lazily at snapshot time;
+- :class:`Histogram` — fixed-bucket latency distribution with
+  count/sum and interpolated p50/p99 (per-serve-job and
+  per-watch-cycle seconds).
+
+:func:`snapshot` renders the registry in stable key order (instrument
+kind, then name, then fixed fields within), so serve ``stats`` diffs
+and ``operator-forge stats --json`` output are deterministic for a
+given sequence of observations.  :func:`report` additionally pulls the
+sibling observability surfaces — per-namespace ContentCache hit/miss
+attribution and the dependency graph's dirty/reused/recomputed
+counters — into one stable-ordered document (the ``stats`` payload).
+
+No module-level imports of the cache/graph layers: they import *this*
+module (eviction accounting), so the pull direction stays lazy to keep
+the import graph acyclic.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: default latency buckets (seconds) — tuned for the serve/watch loop:
+#: sub-ms replays up to multi-second cold batch runs
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_lock = threading.Lock()
+_counters: dict = {}
+_gauges: dict = {}
+_callback_gauges: dict = {}
+_histograms: dict = {}
+
+
+class Counter:
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with _lock:
+            self._value += n
+
+    def value(self) -> int:
+        with _lock:
+            return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def set(self, value) -> None:
+        with _lock:
+            self._value = value
+
+    def add(self, n=1) -> None:
+        with _lock:
+            self._value += n
+
+    def value(self):
+        with _lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram.  Buckets are cumulative-upper-bound
+    counts (Prometheus-style ``le``); quantiles interpolate linearly
+    inside the winning bucket, which is exact enough for p50/p99
+    reporting and requires no per-observation allocation."""
+
+    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_max")
+
+    def __init__(self, name: str, buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf overflow
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def observe(self, value: float) -> None:
+        idx = len(self.buckets)
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                idx = i
+                break
+        with _lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += value
+            if value > self._max:
+                self._max = value
+
+    def _quantile_from(self, counts, count, peak, q: float):
+        rank = q * count
+        seen = 0.0
+        for i, bucket_count in enumerate(counts):
+            if bucket_count == 0:
+                continue
+            if seen + bucket_count >= rank:
+                if i == len(self.buckets):
+                    # overflow bucket: the tracked maximum is the
+                    # honest upper estimate (never silently clamp to
+                    # the top bound — a 45s job must not read as 10s)
+                    return max(peak, self.buckets[-1])
+                lower = self.buckets[i - 1] if i > 0 else 0.0
+                upper = self.buckets[i]
+                frac = (rank - seen) / bucket_count
+                estimate = lower + (upper - lower) * min(max(frac, 0.0), 1.0)
+                # interpolation reads the bucket's upper range, but no
+                # quantile can exceed the largest observation
+                return min(estimate, peak)
+            seen += bucket_count
+        return max(peak, self.buckets[-1])
+
+    def quantile(self, q: float):
+        """Interpolated quantile estimate; ``None`` when empty.
+        Quantiles landing in the overflow bucket report the observed
+        maximum (an upper bound) instead of clamping to the top
+        bucket bound."""
+        with _lock:
+            count = self._count
+            counts = list(self._counts)
+            peak = self._max
+        if count == 0:
+            return None
+        return self._quantile_from(counts, count, peak, q)
+
+    def summary(self) -> dict:
+        with _lock:
+            count = self._count
+            total = self._sum
+            counts = list(self._counts)
+            peak = self._max
+        out = {
+            "count": count,
+            "sum": round(total, 6),
+            "max": round(peak, 6),
+            "p50": None,
+            "p99": None,
+        }
+        if count:
+            out["p50"] = round(
+                self._quantile_from(counts, count, peak, 0.50), 6
+            )
+            out["p99"] = round(
+                self._quantile_from(counts, count, peak, 0.99), 6
+            )
+        return out
+
+
+def counter(name: str) -> Counter:
+    with _lock:
+        inst = _counters.get(name)
+        if inst is None:
+            inst = _counters[name] = Counter(name)
+    return inst
+
+
+def gauge(name: str) -> Gauge:
+    with _lock:
+        inst = _gauges.get(name)
+        if inst is None:
+            inst = _gauges[name] = Gauge(name)
+    return inst
+
+
+def register_gauge(name: str, fn) -> None:
+    """A callback gauge: ``fn()`` is read at snapshot time — for
+    values that already live elsewhere and would otherwise need
+    continuous mirroring."""
+    with _lock:
+        _callback_gauges[name] = fn
+
+
+def histogram(name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
+    with _lock:
+        inst = _histograms.get(name)
+        if inst is None:
+            inst = _histograms[name] = Histogram(name, buckets)
+    return inst
+
+
+def reset() -> None:
+    """Drop every instrument, callback-gauge registrations included
+    (tests and bench legs re-register what they need; a leaked
+    registration would keep its closure alive and make snapshots
+    test-order dependent)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _callback_gauges.clear()
+        _histograms.clear()
+
+
+def snapshot() -> dict:
+    """The registry in stable key order:
+    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}`` with
+    names sorted inside each kind and fixed fields per histogram."""
+    with _lock:
+        counter_items = {n: c._value for n, c in _counters.items()}
+        gauge_items = {n: g._value for n, g in _gauges.items()}
+        callbacks = dict(_callback_gauges)
+        histogram_items = list(_histograms.items())
+    for name, fn in callbacks.items():
+        try:
+            gauge_items[name] = fn()
+        except Exception:
+            gauge_items[name] = None
+    return {
+        "counters": {n: counter_items[n] for n in sorted(counter_items)},
+        "gauges": {n: gauge_items[n] for n in sorted(gauge_items)},
+        "histograms": {
+            n: h.summary()
+            for n, h in sorted(histogram_items, key=lambda kv: kv[0])
+        },
+    }
+
+
+def cache_report() -> dict:
+    """Per-namespace ContentCache hit/miss counters with hit ratios,
+    stable key order (namespaces sorted; hits/misses/ratio fixed
+    within) — the attribution surface serve ``stats`` has reported
+    since PR 5, now shared with the ``stats`` CLI."""
+    from . import cache as pf_cache
+
+    out: dict = {}
+    snap = pf_cache.stats()
+    for stage in sorted(snap):
+        counts = snap[stage]
+        hits = counts.get("hits", 0)
+        misses = counts.get("misses", 0)
+        total = hits + misses
+        out[stage] = {
+            "hits": hits,
+            "misses": misses,
+            "ratio": round(hits / total, 4) if total else 0.0,
+        }
+    return out
+
+
+def report() -> dict:
+    """The whole observability surface in one stable-ordered document:
+    cache attribution, graph counters, the metrics registry, and the
+    span table (the serve ``stats`` op and ``operator-forge stats``
+    both render this)."""
+    from . import spans
+    from .depgraph import GRAPH
+
+    return {
+        "cache": cache_report(),
+        "graph": GRAPH.counters(),
+        "metrics": snapshot(),
+        "spans": spans.snapshot(),
+    }

@@ -1,27 +1,43 @@
-"""Lightweight span profiler for the generation pipeline.
+"""Span profiler and tracer for the generation pipeline.
 
-Env-gated (``OPERATOR_FORGE_PROFILE=1``) or enabled programmatically
-(bench.py).  Spans aggregate wall-clock durations per stage name into a
-process-global, thread-safe table; the CLI prints the table to stderr on
-exit when the env var is set, and bench.py surfaces it as the ``stages``
-breakdown in the BENCH JSON.
+Two telemetry layers share one instrumentation point (``spans.span``):
+
+- **Aggregate totals** (``OPERATOR_FORGE_PROFILE=1`` or programmatic
+  :func:`enable`): wall-clock durations per stage name in a
+  process-global, thread-safe table; the CLI prints the table to stderr
+  on exit when the env var is set, and bench.py surfaces it as the
+  ``stages`` breakdown in the BENCH JSON.
+- **Structured trace events** (``OPERATOR_FORGE_TRACE=path`` or
+  programmatic :func:`enable_tracing`): every span additionally records
+  a trace event — span id, parent span id, process id, thread id,
+  start timestamp, duration, and a small args dict — into a bounded
+  ring buffer (:data:`DEFAULT_RING` events, oldest dropped first;
+  ``OPERATOR_FORGE_TRACE_EVENTS`` overrides).  The buffer exports as
+  Chrome trace-event JSON (:func:`write_chrome_trace` — load it in
+  ``chrome://tracing`` / Perfetto), and process-pool workers drain
+  their buffers into each task's HMAC-signed result so the parent's
+  timeline covers serial, thread, and process execution in one file
+  (see :mod:`operator_forge.perf.workers`).
 
 Stages are *inclusive* and may nest or run on worker threads, so totals
 can overlap and, under ``OPERATOR_FORGE_JOBS>1``, sum to more than the
 elapsed wall time — read them as attribution, not as a partition.
 
-``span`` itself is a module attribute swapped between the timing
-implementation and a no-op closure returning a shared null context:
-with profiling off, a span costs one attribute lookup and zero clock
-or environment reads (bench.py's ``span_overhead`` micro-guard holds
-the disabled path under 1% of the codegen pipeline).  The swap happens
-whenever the enable state changes (:func:`enable`, :func:`use_env`,
-:func:`refresh`); code that mutates ``OPERATOR_FORGE_PROFILE`` mid-
-process must call :func:`refresh` (the process-pool workers do).
+``span`` itself is a module attribute swapped between the tracing
+implementation, the timing implementation, and a no-op closure
+returning a shared null context: with both layers off, a span costs one
+attribute lookup and zero clock or environment reads (bench.py's
+``span_overhead`` and ``telemetry`` micro-guards hold the disabled path
+under 1% of the codegen pipeline).  The swap happens whenever the
+enable state changes (:func:`enable`, :func:`enable_tracing`,
+:func:`use_env`, :func:`refresh`); code that mutates the env vars
+mid-process must call :func:`refresh` (the process-pool workers do).
 """
 
 from __future__ import annotations
 
+import collections
+import itertools
 import os
 import threading
 import time
@@ -31,41 +47,114 @@ _lock = threading.Lock()
 _totals: dict = {}  # name -> [calls, seconds]
 _forced = None  # None: follow the env var; bool: programmatic override
 _active = False
+_trace_forced = None  # None: follow OPERATOR_FORGE_TRACE; bool: override
+_trace_active = False
+
+#: default trace ring-buffer capacity (events); the ring bounds memory
+#: on long serve/watch sessions — a full ring drops the OLDEST events
+DEFAULT_RING = 100_000
+
+_ids = itertools.count(1)  # span ids; next() is GIL-atomic
+_span_stack = threading.local()  # per-thread open-span id stack
+# cached: getpid() is a syscall (tens of µs under sandboxed kernels)
+# and the pid only changes at fork, where the hook below refreshes it
+_PID = os.getpid()
+
+
+def _ring_capacity() -> int:
+    raw = os.environ.get("OPERATOR_FORGE_TRACE_EVENTS", "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_RING
+    except ValueError:
+        n = DEFAULT_RING
+    return max(n, 1)
+
+
+_events: collections.deque = collections.deque(maxlen=DEFAULT_RING)
 
 
 def _env_enabled() -> bool:
     return os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0")
 
 
+def _env_trace_path() -> str:
+    return os.environ.get("OPERATOR_FORGE_TRACE", "").strip()
+
+
 def enabled() -> bool:
     return _active
 
 
+def trace_enabled() -> bool:
+    return _trace_active
+
+
 def refresh() -> None:
-    """Recompute the enable state (override, else the env var) and swap
-    the ``span`` implementation accordingly."""
-    global _active, span
+    """Recompute the enable states (overrides, else the env vars) and
+    swap the ``span`` implementation accordingly."""
+    global _active, _trace_active, span, _events
     _active = _forced if _forced is not None else _env_enabled()
-    span = _span_on if _active else _span_off
+    _trace_active = (
+        _trace_forced if _trace_forced is not None
+        else bool(_env_trace_path())
+    )
+    if _trace_active:
+        if _events.maxlen != _ring_capacity():
+            with _lock:
+                _events = collections.deque(_events, maxlen=_ring_capacity())
+        span = _span_trace
+    elif _active:
+        span = _span_on
+    else:
+        span = _span_off
 
 
 def enable(flag: bool = True) -> None:
-    """Programmatic on/off override (bench.py, tests)."""
+    """Programmatic aggregate-totals on/off override (bench.py, tests)."""
     global _forced
     _forced = flag
     refresh()
 
 
+def enable_tracing(flag) -> None:
+    """Programmatic trace-event on/off override; ``None`` restores the
+    ``OPERATOR_FORGE_TRACE`` env-driven state."""
+    global _trace_forced
+    _trace_forced = flag
+    refresh()
+
+
 def use_env() -> None:
-    """Drop any programmatic override; follow ``OPERATOR_FORGE_PROFILE``."""
-    global _forced
+    """Drop the programmatic overrides; follow the env vars."""
+    global _forced, _trace_forced
     _forced = None
+    _trace_forced = None
     refresh()
 
 
 def reset() -> None:
     with _lock:
         _totals.clear()
+
+
+def clear_events() -> None:
+    with _lock:
+        _events.clear()
+
+
+def _clear_events_after_fork() -> None:
+    # a forked worker inherits the parent's ring by copy-on-write; its
+    # first drain must ship only events the WORKER produced
+    global _PID
+    _PID = os.getpid()
+    _events.clear()
+    stack = getattr(_span_stack, "ids", None)
+    if stack:
+        stack.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_clear_events_after_fork)
 
 
 def record(name: str, seconds: float) -> None:
@@ -88,14 +177,14 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
-def _span_off(name: str):
-    """Profiling disabled: hand back the shared null context — no env
+def _span_off(name: str, args=None):
+    """Telemetry disabled: hand back the shared null context — no env
     read, no clock read, no generator frame."""
     return _NULL_SPAN
 
 
 @contextmanager
-def _span_on(name: str):
+def _span_on(name: str, args=None):
     start = time.perf_counter()
     try:
         yield
@@ -103,30 +192,167 @@ def _span_on(name: str):
         record(name, time.perf_counter() - start)
 
 
+class _TraceSpan:
+    """Tracing context: aggregate totals PLUS one ring-buffer event per
+    span, with parent linkage via a per-thread open-span stack."""
+
+    __slots__ = ("name", "args", "start", "sid", "parent")
+
+    def __init__(self, name: str, args):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        stack = getattr(_span_stack, "ids", None)
+        if stack is None:
+            stack = _span_stack.ids = []
+        self.parent = stack[-1] if stack else 0
+        self.sid = next(_ids)
+        stack.append(self.sid)
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed = time.perf_counter() - self.start
+        stack = _span_stack.ids
+        if stack and stack[-1] == self.sid:
+            stack.pop()
+        record(self.name, elapsed)
+        # span linkage is authoritative: user args never clobber it
+        event_args = dict(self.args) if self.args else {}
+        event_args["id"] = self.sid
+        event_args["parent"] = self.parent
+        _events.append({
+            "name": self.name,
+            "ph": "X",
+            "pid": _PID,
+            "tid": threading.get_ident(),
+            "ts": round(self.start * 1e6, 1),
+            "dur": round(elapsed * 1e6, 1),
+            "args": event_args,
+        })
+        return False
+
+
+def _span_trace(name: str, args=None):
+    return _TraceSpan(name, args)
+
+
 #: time a stage — rebound by :func:`refresh` to the no-op closure when
-#: profiling is off (always call as ``spans.span(...)``)
+#: telemetry is off (always call as ``spans.span(...)``).  The optional
+#: ``args`` mapping lands in the trace event (small, plain data only).
 span = _span_off
 
 refresh()
 
 
-def snapshot() -> dict:
-    """``{stage: {"calls": n, "s": seconds}}``, sorted by stage name."""
+# -- trace-event access ----------------------------------------------------
+
+
+def events_snapshot() -> list:
+    """A copy of the current ring-buffer contents, oldest first."""
     with _lock:
-        return {
-            name: {"calls": calls, "s": round(seconds, 6)}
-            for name, (calls, seconds) in sorted(_totals.items())
-        }
+        return list(_events)
+
+
+def drain_events() -> list:
+    """Pop and return every buffered event (the worker-side shipping
+    primitive: each process-pool task drains its ring into the sealed
+    result so the parent can merge one timeline)."""
+    with _lock:
+        out = list(_events)
+        _events.clear()
+    return out
+
+
+def ingest_events(events) -> None:
+    """Append externally produced events (a worker's drained buffer)
+    into this process's ring."""
+    if not events:
+        return
+    with _lock:
+        _events.extend(events)
+
+
+_export_suppressed = False
+
+
+def suppress_trace_export(flag: bool = True) -> None:
+    """Process-pool workers call this (via their shipped task config):
+    a worker's nested CLI mains must NOT write the env-configured trace
+    file — its events ship back through the sealed result round-trip
+    and the parent writes one merged file."""
+    global _export_suppressed
+    _export_suppressed = flag
+
+
+def trace_export_suppressed() -> bool:
+    return _export_suppressed
+
+
+def chrome_trace() -> dict:
+    """The buffered events as a Chrome trace-event JSON object
+    (``chrome://tracing`` / Perfetto's legacy JSON format).  Events are
+    sorted by timestamp then span id, so repeated exports of the same
+    buffer are byte-identical."""
+    events = sorted(
+        events_snapshot(),
+        key=lambda e: (e["ts"], e["args"].get("id", 0)),
+    )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "operator-forge"},
+    }
+
+
+def write_chrome_trace(path: str) -> int:
+    """Write the Chrome trace JSON to ``path``; returns the number of
+    events written.  Best-effort: an unwritable path is reported to
+    stderr, never raised (telemetry must not fail the command)."""
+    import json
+    import sys
+
+    trace = chrome_trace()
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as handle:
+            json.dump(trace, handle)
+    except OSError as exc:
+        print(f"trace: cannot write {path}: {exc}", file=sys.stderr)
+        return 0
+    return len(trace["traceEvents"])
+
+
+# -- aggregate access ------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """``{stage: {"calls": n, "s": seconds}}`` in deterministic report
+    order: total seconds descending, stage name as the tie-break — so
+    serve ``stats`` and bench ``stages`` diffs are stable run to run."""
+    with _lock:
+        items = [
+            (name, calls, round(seconds, 6))
+            for name, (calls, seconds) in _totals.items()
+        ]
+    items.sort(key=lambda item: (-item[2], item[0]))
+    return {
+        name: {"calls": calls, "s": seconds}
+        for name, calls, seconds in items
+    }
 
 
 def report(stream) -> None:
-    """Print the aggregate table (slowest stage first)."""
+    """Print the aggregate table (slowest stage first, name
+    tie-break — :func:`snapshot` order)."""
     snap = snapshot()
     if not snap:
         return
     width = max(len(name) for name in snap)
     print(f"{'stage'.ljust(width)}  {'calls':>7}  {'seconds':>10}", file=stream)
-    for name, data in sorted(snap.items(), key=lambda kv: -kv[1]["s"]):
+    for name, data in snap.items():
         print(
             f"{name.ljust(width)}  {data['calls']:>7}  {data['s']:>10.4f}",
             file=stream,

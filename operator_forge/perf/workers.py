@@ -28,7 +28,10 @@ Worker coordination details:
   (:mod:`operator_forge.perf.cache`): the worker seals
   ``sign(key, pickle(value)) + pickle(value)`` and the parent verifies
   before unpickling, so a corrupted or substituted result surfaces as
-  an authentication error instead of deserializing.
+  an authentication error instead of deserializing.  When tracing is
+  on (``OPERATOR_FORGE_TRACE``) each sealed result also carries the
+  worker's drained span-event buffer; the parent ingests it into its
+  own ring, so one Chrome trace covers the whole process tree.
 - **config shipping** — forked workers snapshot the parent's state at
   fork time only, so each task carries the parent's *current* cache
   mode/root overrides, gocheck interpreter mode, relevant env knobs,
@@ -107,6 +110,8 @@ _SHIPPED_ENV = (
     "OPERATOR_FORGE_JOBS",
     "OPERATOR_FORGE_GOCHECK",
     "OPERATOR_FORGE_PROFILE",
+    "OPERATOR_FORGE_TRACE",
+    "OPERATOR_FORGE_TRACE_EVENTS",
 )
 
 
@@ -119,6 +124,10 @@ def _task_config() -> dict:
         "cache_root": cache._root_override,
         "gocheck_mode": compiler._forced,
         "env": {k: os.environ.get(k) for k in _SHIPPED_ENV},
+        # the programmatic tracing override (cmd_trace, tests) — env
+        # shipping alone would miss it, and a worker forked mid-trace
+        # would otherwise keep its fork-time state forever
+        "trace": spans._trace_forced,
         "gen": _reset_gen[0],
     }
 
@@ -137,8 +146,12 @@ def _apply_config(cfg: dict) -> None:
     os.environ["OPERATOR_FORGE_WORKERS"] = "thread"
     set_backend("thread")
     # spans caches the enable state (no per-call env reads); the shipped
-    # OPERATOR_FORGE_PROFILE value takes effect only after a refresh
-    spans.refresh()
+    # OPERATOR_FORGE_PROFILE / OPERATOR_FORGE_TRACE values and the
+    # parent's programmatic tracing override take effect here (the
+    # enable_tracing call refreshes).  Workers never write the trace
+    # file themselves — their events ship back in each sealed result
+    spans.suppress_trace_export(True)
+    spans.enable_tracing(cfg["trace"])
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
     if cfg["gen"] != _worker_seen_gen[0]:
@@ -172,21 +185,34 @@ def _unseal(wrapped: tuple):
     return pickle.loads(data)
 
 
+def _trace_payload() -> list:
+    """The worker's buffered trace events, drained for shipping.  A
+    fresh worker's ring starts empty (spans clears it after fork), so
+    every drain ships exactly the events produced since the previous
+    task — the parent merges them into one timeline, distinguished by
+    the worker's pid in each event."""
+    if not spans.trace_enabled():
+        return []
+    return spans.drain_events()
+
+
 def _sealed_call(cfg: dict, fn, item) -> tuple:
     """Worker-side task wrapper: apply the parent's shipped config,
-    run, seal the outcome.  Task exceptions are sealed as values (not
-    raised through the executor), so anything that DOES raise out of a
-    future is, by construction, an infrastructure failure."""
+    run, seal the outcome (plus the worker's drained trace-event
+    buffer).  Task exceptions are sealed as values (not raised through
+    the executor), so anything that DOES raise out of a future is, by
+    construction, an infrastructure failure."""
     _apply_config(cfg)
     try:
-        return _seal(("ok", fn(item)))
+        return _seal(("ok", fn(item), _trace_payload()))
     except BaseException as exc:
+        events = _trace_payload()
         try:
-            return _seal(("err", exc))
+            return _seal(("err", exc, events))
         except Exception:  # the exception itself didn't pickle
             return _seal(("err", RuntimeError(
                 f"{type(exc).__name__}: {exc}"
-            )))
+            ), events))
 
 
 class _TaskFailure(Exception):
@@ -327,15 +353,34 @@ def _thread_map(fn, items, jobs: int) -> list:
 
 
 def _process_map(pool, fn, items) -> list:
+    from . import metrics
+
     cfg = _task_config()
-    futures = [pool.submit(_sealed_call, cfg, fn, item) for item in items]
-    out = []
-    for future in futures:
-        kind, payload = _unseal(future.result())
-        if kind == "err":
-            raise _TaskFailure(payload)
-        out.append(payload)
-    return out
+    queue_depth = metrics.gauge("workers.queue_depth")
+    metrics.counter("workers.tasks_submitted").inc(len(items))
+    queue_depth.add(len(items))
+    done = 0
+    try:
+        futures = [
+            pool.submit(_sealed_call, cfg, fn, item) for item in items
+        ]
+        out = []
+        for future in futures:
+            kind, payload, events = _unseal(future.result())
+            done += 1
+            queue_depth.add(-1)  # live backlog, not batch size
+            metrics.counter("workers.tasks_completed").inc()
+            # merge the worker's timeline into the parent's ring: one
+            # Chrome trace then covers serial, thread, and process runs
+            spans.ingest_events(events)
+            if kind == "err":
+                raise _TaskFailure(payload)
+            out.append(payload)
+        return out
+    finally:
+        # a task/infra error abandons the remaining futures; the gauge
+        # must not leak their depth
+        queue_depth.add(-(len(items) - done))
 
 
 def map_ordered(fn, items) -> list:

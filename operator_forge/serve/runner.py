@@ -48,7 +48,7 @@ import time
 
 from .. import __version__
 from ..perf import cache as pf_cache
-from ..perf import spans
+from ..perf import metrics, spans
 from .jobs import Job, JobResult
 
 _STAGE = "serve.job"
@@ -159,13 +159,17 @@ def run_job(job: Job) -> JobResult:
         hit = cache.get(_STAGE, key)
         if hit is not pf_cache.MISS:
             rc, stdout, stderr = hit
+            metrics.counter("serve.jobs_replayed").inc()
+            metrics.histogram("serve.job.seconds").observe(0.0)
             return JobResult(
                 id=job.id, command=job.command, rc=rc, stdout=stdout,
                 stderr=stderr, seconds=0.0, cached=True, index=job.index,
             )
 
     started = time.perf_counter()
-    with spans.span(f"serve.job:{job.command}"), _captured() as (
+    with spans.span(
+        f"serve.job:{job.command}", args={"job": job.id}
+    ), _captured() as (
         out_buf, err_buf
     ):
         try:
@@ -181,6 +185,8 @@ def run_job(job: Job) -> JobResult:
         stdout=out_buf.getvalue(), stderr=err_buf.getvalue(),
         seconds=time.perf_counter() - started, index=job.index,
     )
+    metrics.counter("serve.jobs_executed").inc()
+    metrics.histogram("serve.job.seconds").observe(result.seconds)
     if key is not None and rc == 0:
         out_root = _out_root(job)
         post_out = _tree_state(out_root) if out_root else ()
@@ -238,6 +244,9 @@ def run_group(group) -> list:
             )
         hit = cache.get(_GROUP_STAGE, key)
         if hit is not pf_cache.MISS:
+            metrics.counter("serve.jobs_replayed").inc(len(group))
+            for _ in group:
+                metrics.histogram("serve.job.seconds").observe(0.0)
             return [
                 JobResult(
                     id=job.id, command=job.command, rc=rc,

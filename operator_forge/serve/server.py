@@ -23,8 +23,16 @@ the response, never interleaved with the protocol stream):
   request;
 - ``{"op": "stats"}`` — per-namespace cache hit/miss counters with
   ratios (stable key order), the dependency graph's cumulative
-  dirty/reused/recomputed counters, and the span table the
-  per-request ``serve:*`` spans feed;
+  dirty/reused/recomputed counters, the metrics registry
+  (counters/gauges + p50/p99 latency histograms for serve jobs and
+  watch cycles), the graph's recorded invalidation provenance, and
+  the span table the per-request ``serve:*`` spans feed;
+- ``{"op": "explain", "path": <root>, "changed": [...]}`` — the
+  invalidation-provenance report: for each changed file, the
+  deterministic chain of artifacts its edit dirties (derived
+  structurally — byte-identical across cache modes and worker
+  backends).  With ``changed`` omitted, the last ``watch`` cycle's
+  recorded change set answers "why did the last cycle recompute?";
 - ``{"op": "shutdown"}`` — acknowledge and exit 0 (EOF does the same).
 
 Malformed lines answer ``{"ok": false, "error": ...}`` and the loop
@@ -40,8 +48,7 @@ import sys
 import time
 
 from .. import __version__
-from ..perf import cache as pf_cache
-from ..perf import spans
+from ..perf import metrics, spans
 from ..perf.depgraph import GRAPH
 from .batch import run_batch
 from .jobs import BatchManifestError, jobs_from_specs
@@ -52,24 +59,6 @@ def _error(message: str, req_id=None) -> dict:
     out = {"ok": False, "error": message}
     if req_id is not None:
         out["id"] = req_id
-    return out
-
-
-def _cache_report() -> dict:
-    """Per-namespace hit/miss counters with hit ratios, stable key
-    order (namespaces sorted; hits/misses/ratio fixed within)."""
-    out: dict = {}
-    snap = pf_cache.stats()
-    for stage in sorted(snap):
-        counts = snap[stage]
-        hits = counts.get("hits", 0)
-        misses = counts.get("misses", 0)
-        total = hits + misses
-        out[stage] = {
-            "hits": hits,
-            "misses": misses,
-            "ratio": round(hits / total, 4) if total else 0.0,
-        }
     return out
 
 
@@ -84,8 +73,57 @@ def _handle(req: dict, base_dir: str, emit=None) -> tuple:
         return ({"ok": True, "op": "shutdown"}, False)
     if op == "stats":
         return (
-            {"ok": True, "op": "stats", "cache": _cache_report(),
-             "graph": GRAPH.counters(), "spans": spans.snapshot()},
+            {"ok": True, "op": "stats", "cache": metrics.cache_report(),
+             "graph": GRAPH.counters(),
+             "metrics": metrics.snapshot(),
+             "provenance": {
+                 "last_invalidation": GRAPH.last_invalidation(),
+                 "recorded": GRAPH.provenance(),
+             },
+             "spans": spans.snapshot()},
+            True,
+        )
+    if op == "explain":
+        import os as _os
+
+        from ..gocheck.explain import explain_report, explain_summary
+        from . import watch as watch_mod
+
+        root = req.get("path") or base_dir
+        if not _os.path.isabs(root):
+            root = _os.path.normpath(_os.path.join(base_dir, root))
+        changed = req.get("changed")
+        removed = req.get("removed") or []
+        if changed is not None or "removed" in req:
+            # an explicit change set — a removed-only request counts
+            if not _os.path.isdir(root):
+                return (_error(
+                    f"explain: {root} is not a directory", req_id), True)
+            changed = changed or []
+            # one shared import map: summary and report each need it
+            from ..gocheck.explain import package_imports
+
+            imports = package_imports(root)
+            return (
+                {"ok": True, "op": "explain",
+                 "path": req.get("path") or root,
+                 "changes": explain_summary(
+                     root, changed, removed, imports=imports),
+                 "report": explain_report(
+                     root, changed, removed, imports=imports)},
+                True,
+            )
+        # no explicit change set: answer for the last watch cycle,
+        # deriving each file against the watch root it was recorded
+        # under (rels are relative to THAT root, not the request path)
+        roots, changes, report = watch_mod.last_cycle_explain()
+        if not roots:
+            return (_error(
+                "explain: no change set — pass \"changed\": [...] "
+                "or run a watch cycle first", req_id), True)
+        return (
+            {"ok": True, "op": "explain", "roots": roots,
+             "changes": changes, "report": report},
             True,
         )
     if op == "watch":

@@ -11,12 +11,20 @@ Each cycle emits one JSON-serializable payload::
     {"op": "watch", "cycle": N, "changed": [...], "removed": [...],
      "results": [<job result>...], "ok": true,
      "graph": {"dirty": d, "reused": r, "recomputed": c},
+     "provenance": [{"file": rel, "event": "changed",
+                     "chain": [...]}, ...],
      "seconds": s}
 
 ``graph`` counts are per-cycle deltas of the shared graph counters
-(also surfaced cumulatively by the serve ``stats`` op).  Jobs run
-in-process (groups in manifest order through the shared runner) so
-every cycle reuses the resident caches — the point of watching.
+(also surfaced cumulatively by the serve ``stats`` op).
+``provenance`` is the per-cycle invalidation story — for every changed
+or removed file, the deterministic chain of artifacts it dirtied,
+derived structurally by :mod:`operator_forge.gocheck.explain` (so it
+is identical whatever cache mode or worker backend ran the cycle).
+Each cycle's wall time also lands in the ``watch.cycle.seconds``
+metrics histogram (p50/p99 via serve ``stats``).  Jobs run in-process
+(groups in manifest order through the shared runner) so every cycle
+reuses the resident caches — the point of watching.
 
 The loop is deliberately injectable for tests and the serve op:
 ``cycles`` bounds how many job runs happen (the first cycle always
@@ -30,9 +38,16 @@ from __future__ import annotations
 import os
 import time
 
+from ..perf import metrics
 from ..perf.depgraph import GRAPH
 from .batch import plan_groups
 from .runner import run_group
+
+#: the most recent cycle's change set — ``(root, rel)`` pairs — kept so
+#: a later serve ``explain`` op (no explicit ``changed`` list) can
+#: answer "why did the last cycle recompute?"
+LAST_CHANGED: list = []
+LAST_REMOVED: list = []
 
 
 def watch_roots(jobs) -> list:
@@ -112,6 +127,55 @@ def run_jobs(jobs) -> list:
     return [by_index[job.index] for job in jobs]
 
 
+def _group_by_root(changed, removed) -> dict:
+    """``{root: ([changed rels], [removed rels])}`` from the watch
+    loop's ``(root, rel)`` pairs — rels stay relative to the watch
+    root they were recorded under."""
+    by_root: dict = {}
+    for idx, pairs in enumerate((changed, removed)):
+        for root, rel in pairs:
+            by_root.setdefault(root, ([], []))[idx].append(rel)
+    return by_root
+
+
+def _provenance_summary(changed, removed) -> list:
+    """Per-cycle invalidation story: for every touched file, the
+    deterministic structural chain from the edit to the artifacts it
+    dirtied (grouped per watch root, roots in sorted order)."""
+    from ..gocheck.explain import explain_summary
+
+    by_root = _group_by_root(changed, removed)
+    out: list = []
+    for root in sorted(by_root):
+        rels_changed, rels_removed = by_root[root]
+        out.extend(explain_summary(root, rels_changed, rels_removed))
+    return out
+
+
+def last_cycle_explain() -> tuple:
+    """``(sorted roots, structured changes, joined text report)`` for
+    the most recent cycle's recorded change set — the serve ``explain``
+    op's no-change-set answer.  Empty roots means nothing recorded."""
+    from ..gocheck.explain import (
+        explain_report,
+        explain_summary,
+        package_imports,
+    )
+
+    by_root = _group_by_root(LAST_CHANGED, LAST_REMOVED)
+    changes: list = []
+    reports: list = []
+    for root in sorted(by_root):
+        rels_changed, rels_removed = by_root[root]
+        # one shared walk per root for both renderings
+        imports = package_imports(root) if os.path.isdir(root) else {}
+        changes.extend(explain_summary(
+            root, rels_changed, rels_removed, imports=imports))
+        reports.append(explain_report(
+            root, rels_changed, rels_removed, imports=imports))
+    return sorted(by_root), changes, "".join(reports)
+
+
 def watch_cycle(jobs, cycle: int, changed=(), removed=(),
                 dirtied: int = 0) -> dict:
     """Run the job set once and package the per-cycle payload.
@@ -119,6 +183,8 @@ def watch_cycle(jobs, cycle: int, changed=(), removed=(),
     counters_before = GRAPH.counters()
     started = time.perf_counter()
     results = run_jobs(jobs)
+    seconds = time.perf_counter() - started
+    metrics.histogram("watch.cycle.seconds").observe(seconds)
     counters_after = GRAPH.counters()
     graph = {
         key: counters_after[key] - counters_before[key]
@@ -133,7 +199,8 @@ def watch_cycle(jobs, cycle: int, changed=(), removed=(),
         "ok": all(r.ok for r in results),
         "results": [r.to_dict() for r in results],
         "graph": graph,
-        "seconds": round(time.perf_counter() - started, 4),
+        "provenance": _provenance_summary(changed, removed),
+        "seconds": round(seconds, 4),
     }
 
 
@@ -161,6 +228,8 @@ def watch_loop(jobs, emit, cycles=None, interval: float = 0.5,
         if not changed and not removed:
             continue
         state = cur
+        LAST_CHANGED[:] = sorted(changed)
+        LAST_REMOVED[:] = sorted(removed)
         dirtied = _invalidate(changed, removed)
         emit(watch_cycle(jobs, ran, changed, removed, dirtied))
         ran += 1
@@ -210,6 +279,10 @@ def cmd_watch(manifest_path: str, cycles=None, interval: float = 0.5,
             ),
             file=out, flush=True,
         )
+        for entry in payload.get("provenance", ()):
+            print(f"  why: {entry['file']} {entry['event']}", file=out)
+            for line in entry["chain"]:
+                print(f"  {line}", file=out)
         for result in payload["results"]:
             if not result["ok"]:
                 print(f"  FAIL {result['id']} ({result['command']})",

@@ -2,8 +2,10 @@
 # Conventional-commit check for the latest commit (reference:
 # test/scripts/commit-check-latest.sh — same contract, fresh implementation),
 # plus the perf contract of the incremental generation engine (PR 1),
-# the gocheck fast-path determinism bar (PR 2), and the batch/serve
-# determinism + throughput bar (PR 3).
+# the gocheck fast-path determinism bar (PR 2), the batch/serve
+# determinism + throughput bar (PR 3), and the observability contract
+# (PR 6: telemetry on/off byte identity, disabled-path overhead,
+# explain determinism).
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -169,6 +171,39 @@ assert span["ok"] is True, (
 print(
     "span overhead OK: %.0fns/call, %.4f%% of the cold codegen run"
     % (span["per_call_ns"], span["fraction_of_cold"] * 100)
+)
+
+# observability (PR 6): telemetry must never change an output byte —
+# a tracing-on init/vet/test run is byte-identical to telemetry-off;
+# the disabled path stays under the 1% micro-bar WITH the tracing
+# layer present; and the `explain` provenance report is byte-identical
+# across cache modes × worker backends × JOBS widths.
+telemetry = detail["telemetry"]
+assert telemetry["disabled_ok"] is True, (
+    "telemetry-disabled span overhead %.4f%% of the cold path"
+    % (telemetry["disabled_fraction_of_cold"] * 100)
+)
+assert telemetry["identity_telemetry_on_off"] is True, (
+    "tracing-on init/vet/test diverged from the telemetry-off run"
+)
+assert telemetry["explain_identity"] is True, (
+    "explain reports diverged across %d legs" % telemetry["explain_legs"]
+)
+assert telemetry["explain_names_change"].startswith("file "), (
+    "explain does not name the changed file: %r"
+    % telemetry["explain_names_change"]
+)
+print(
+    "observability contract OK: disabled %.0fns/call (%.4f%% of cold), "
+    "enabled %.0fns/call (host-noise sensitive), on/off identity clean, "
+    "explain deterministic over %d legs (%s)"
+    % (
+        telemetry["disabled_per_call_ns"],
+        telemetry["disabled_fraction_of_cold"] * 100,
+        telemetry["enabled_per_call_ns"],
+        telemetry["explain_legs"],
+        telemetry["explain_file"],
+    )
 )
 PYEOF
 

@@ -72,23 +72,40 @@ def pytest_configure(config):
 
 @pytest.fixture(autouse=True)
 def _fresh_perf_state():
-    """Isolate the process-global perf state (content cache, spans)
-    between tests: correctness must never depend on what an earlier test
-    happened to cache, and perf tests configure modes explicitly."""
+    """Isolate the process-global perf state (content cache, spans,
+    trace ring, metrics registry) between tests: correctness must never
+    depend on what an earlier test happened to cache, and perf tests
+    configure modes explicitly."""
     from operator_forge.perf import cache as perfcache
-    from operator_forge.perf import spans, workers
+    from operator_forge.perf import metrics, spans, workers
+
+    import sys
+
+    def _clear_watch_state():
+        # only if the serve layer is loaded: a watch cycle's recorded
+        # change set must not leak into a later test's serve explain
+        watch_mod = sys.modules.get("operator_forge.serve.watch")
+        if watch_mod is not None:
+            watch_mod.LAST_CHANGED.clear()
+            watch_mod.LAST_REMOVED.clear()
 
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
     spans.reset()
+    spans.clear_events()
+    metrics.reset()
     workers.set_backend(None)
+    _clear_watch_state()
     yield
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
     spans.reset()
+    spans.clear_events()
+    metrics.reset()
     workers.set_backend(None)
+    _clear_watch_state()
 
 
 def list_samples(project: str, full_only: bool = False) -> list[str]:

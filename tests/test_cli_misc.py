@@ -546,6 +546,14 @@ class TestBench:
         # ... the warm-cache determinism guard (rc would be 1 on
         # failure, but assert the reported field too) ...
         assert detail["warm_matches_cold"] is True
+        # ... the observability section (PR 6): disabled-path overhead
+        # under the 1% bar, telemetry on/off byte identity, explain
+        # determinism across the guard matrix ...
+        telemetry = detail["telemetry"]
+        assert telemetry["disabled_ok"] is True
+        assert telemetry["identity_telemetry_on_off"] is True
+        assert telemetry["explain_identity"] is True
+        assert telemetry["explain_names_change"].startswith("file ")
         # ... and the serving-layer batch section (PR 3)
         batch = detail["batch"]
         assert batch["jobs"] == 8

@@ -1,0 +1,681 @@
+"""Observability layer (PR 6 acceptance).
+
+Three properties, none of which may ever change an output byte:
+
+- **tracing** — structured span events (id/parent/pid/tid/ts/dur/args)
+  in a bounded ring, exported as well-formed Chrome trace JSON, with
+  process-pool workers shipping their buffers back through the signed
+  result round-trip so one timeline covers every backend;
+- **metrics** — the registry's counters/gauges/histograms snapshot in
+  stable key order, wired into cache attribution, graph counters,
+  worker queue depth, and serve/watch latency;
+- **provenance** — the depgraph records why nodes recomputed, and the
+  ``explain`` report (CLI + serve op) is byte-identical across cache
+  modes × worker backends × JOBS widths, because it derives from tree
+  bytes, not live cache state.
+"""
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import metrics, spans, workers
+from operator_forge.perf.depgraph import GRAPH
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def steady_tree(tmp_path_factory):
+    """A converged standalone project tree, built once per module;
+    tests copy it before mutating."""
+    base = tmp_path_factory.mktemp("obs")
+    config = os.path.join(str(base), "cfg", "workload.yaml")
+    shutil.copytree(
+        os.path.join(FIXTURES, "standalone"), os.path.dirname(config)
+    )
+    tree = os.path.join(str(base), "steady")
+    with contextlib.redirect_stdout(io.StringIO()):
+        for _ in range(2):
+            assert cli_main([
+                "init", "--workload-config", config,
+                "--repo", "github.com/acme/app", "--output-dir", tree,
+            ]) == 0
+            assert cli_main([
+                "create", "api", "--workload-config", config,
+                "--output-dir", tree,
+            ]) == 0
+    return tree
+
+
+@pytest.fixture
+def tree(steady_tree, tmp_path):
+    out = str(tmp_path / "proj")
+    shutil.copytree(steady_tree, out)
+    return out
+
+
+class TestTraceEvents:
+    def test_disabled_records_nothing_and_stays_noop(self, monkeypatch):
+        monkeypatch.delenv("OPERATOR_FORGE_TRACE", raising=False)
+        monkeypatch.delenv("OPERATOR_FORGE_PROFILE", raising=False)
+        spans.use_env()
+        assert spans.trace_enabled() is False
+        assert spans.span("a") is spans.span("b")  # shared null context
+        with spans.span("obs.off"):
+            pass
+        assert spans.events_snapshot() == []
+
+    def test_event_fields_and_parent_linkage(self):
+        spans.enable_tracing(True)
+        with spans.span("obs.outer", args={"k": "v"}):
+            with spans.span("obs.inner"):
+                pass
+        events = spans.events_snapshot()
+        by_name = {e["name"]: e for e in events}
+        outer, inner = by_name["obs.outer"], by_name["obs.inner"]
+        for event in (outer, inner):
+            assert event["ph"] == "X"
+            assert event["pid"] == os.getpid()
+            assert event["tid"] > 0
+            assert event["dur"] >= 0
+        assert inner["args"]["parent"] == outer["args"]["id"]
+        assert outer["args"]["parent"] == 0
+        assert outer["args"]["k"] == "v"
+        # inner started after, ended before: containment in time
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+
+    def test_tracing_also_feeds_aggregate_totals(self):
+        spans.enable_tracing(True)
+        with spans.span("obs.total"):
+            pass
+        assert spans.snapshot()["obs.total"]["calls"] == 1
+
+    def test_ring_buffer_bounds_memory(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_TRACE_EVENTS", "16")
+        spans.enable_tracing(True)
+        for i in range(64):
+            with spans.span(f"obs.ring.{i}"):
+                pass
+        events = spans.events_snapshot()
+        assert len(events) == 16
+        # oldest dropped first: the survivors are the most recent spans
+        assert events[-1]["name"] == "obs.ring.63"
+
+    def test_env_var_enables_tracing(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(
+            "OPERATOR_FORGE_TRACE", str(tmp_path / "t.json")
+        )
+        spans.use_env()
+        assert spans.trace_enabled() is True
+        monkeypatch.delenv("OPERATOR_FORGE_TRACE")
+        spans.refresh()
+        assert spans.trace_enabled() is False
+
+    def test_chrome_trace_export_shape(self, tmp_path):
+        spans.enable_tracing(True)
+        with spans.span("obs.export"):
+            pass
+        path = str(tmp_path / "trace.json")
+        n = spans.write_chrome_trace(path)
+        assert n == 1
+        with open(path, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        assert trace["displayTimeUnit"] == "ms"
+        (event,) = trace["traceEvents"]
+        assert set(event) >= {
+            "name", "ph", "pid", "tid", "ts", "dur", "args"
+        }
+
+    def test_drain_and_ingest_round_trip(self):
+        spans.enable_tracing(True)
+        with spans.span("obs.drain"):
+            pass
+        drained = spans.drain_events()
+        assert [e["name"] for e in drained] == ["obs.drain"]
+        assert spans.events_snapshot() == []
+        spans.ingest_events(drained)
+        assert [e["name"] for e in spans.events_snapshot()] == [
+            "obs.drain"
+        ]
+
+
+def _traced_task(i: int) -> int:
+    with spans.span("obs.task", args={"item": i}):
+        return i * 2
+
+
+class TestCrossProcessTraceMerge:
+    def test_worker_events_merge_into_parent_ring(self, monkeypatch):
+        """A process-backend map produces one parent-side buffer whose
+        event set includes every worker task's span — the union of the
+        worker buffers (each task's span appears exactly once), with
+        worker pids distinguishing the timeline rows."""
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "4")
+        monkeypatch.setenv(
+            "OPERATOR_FORGE_TRACE", "/dev/null"
+        )  # workers enable tracing via the shipped env
+        spans.use_env()
+        workers.set_backend("process")
+        out = workers.map_ordered(_traced_task, list(range(8)))
+        assert out == [i * 2 for i in range(8)]
+        events = [
+            e for e in spans.events_snapshot()
+            if e["name"] == "obs.task"
+        ]
+        items = sorted(e["args"]["item"] for e in events)
+        assert items == list(range(8))  # the union, exactly once each
+        if any(e["pid"] != os.getpid() for e in events):
+            # fork worked: worker events carry their own pid
+            assert {e["pid"] for e in events} != {os.getpid()}
+
+    def test_programmatic_tracing_ships_worker_events(self, monkeypatch):
+        """cmd_trace enables tracing programmatically (no env var);
+        the override must reach process-pool workers through the
+        shipped task config, not just fork-time state."""
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "2")
+        monkeypatch.delenv("OPERATOR_FORGE_TRACE", raising=False)
+        workers.set_backend("process")
+        # fork the pool with tracing OFF, then enable programmatically
+        assert workers.map_ordered(_traced_task, [9, 9]) == [18, 18]
+        spans.clear_events()
+        spans.enable_tracing(True)
+        out = workers.map_ordered(_traced_task, [1, 2, 3, 4])
+        assert out == [2, 4, 6, 8]
+        items = sorted(
+            e["args"]["item"] for e in spans.events_snapshot()
+            if e["name"] == "obs.task"
+        )
+        assert items == [1, 2, 3, 4]
+        # and turning it off reaches the same persistent workers too
+        spans.enable_tracing(False)
+        spans.clear_events()
+        assert workers.map_ordered(_traced_task, [5]) == [10]
+        assert spans.events_snapshot() == []
+
+    def test_process_batch_trace_equals_union_and_is_wellformed(
+        self, tree, tmp_path, monkeypatch
+    ):
+        """A process-backend batch run under tracing yields one
+        well-formed Chrome trace containing both parent-side serve
+        spans and worker-side gocheck spans."""
+        manifest = tmp_path / "batch.yaml"
+        manifest.write_text(
+            "jobs:\n"
+            f"  - command: vet\n    path: {tree}\n"
+            f"  - command: lint\n    path: {tree}\n"
+        )
+        monkeypatch.setenv("OPERATOR_FORGE_WORKERS", "process")
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "2")
+        spans.enable_tracing(True)
+        spans.clear_events()
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert cli_main(["batch", "--manifest", str(manifest)]) == 0
+        path = str(tmp_path / "trace.json")
+        n = spans.write_chrome_trace(path)
+        assert n > 0
+        with open(path, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        events = trace["traceEvents"]
+        assert len(events) == n
+        names = {e["name"] for e in events}
+        assert any(name.startswith("serve.job:") for name in names)
+        assert "gocheck.analyze" in names
+        for event in events:
+            assert event["ph"] == "X"
+            assert isinstance(event["args"]["id"], int)
+        # timestamps sorted: repeated exports are byte-stable
+        ts = [(e["ts"], e["args"]["id"]) for e in events]
+        assert ts == sorted(ts)
+
+
+class TestSnapshotOrdering:
+    def test_snapshot_sorted_by_seconds_desc_then_name(self):
+        spans.enable(True)
+        spans.record("obs.b", 0.5)
+        spans.record("obs.a", 0.5)
+        spans.record("obs.c", 2.0)
+        assert list(spans.snapshot()) == ["obs.c", "obs.a", "obs.b"]
+
+    def test_report_follows_snapshot_order(self):
+        spans.enable(True)
+        spans.record("obs.slow", 2.0)
+        spans.record("obs.fast", 0.1)
+        buf = io.StringIO()
+        spans.report(buf)
+        lines = buf.getvalue().splitlines()
+        assert lines[1].startswith("obs.slow")
+        assert lines[2].startswith("obs.fast")
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_snapshot_stable_order(self):
+        metrics.counter("obs.z").inc(2)
+        metrics.counter("obs.a").inc()
+        metrics.gauge("obs.depth").set(3)
+        hist = metrics.histogram("obs.lat")
+        for value in (0.002, 0.004, 0.03, 0.4):
+            hist.observe(value)
+        snap = metrics.snapshot()
+        assert list(snap) == ["counters", "gauges", "histograms"]
+        assert list(snap["counters"]) == sorted(snap["counters"])
+        assert snap["counters"]["obs.z"] == 2
+        assert snap["gauges"]["obs.depth"] == 3
+        summary = snap["histograms"]["obs.lat"]
+        assert summary["count"] == 4
+        assert 0 < summary["p50"] <= 0.05
+        assert summary["p50"] <= summary["p99"]
+
+    def test_callback_gauge_read_at_snapshot_time(self):
+        state = {"v": 1}
+        metrics.register_gauge("obs.cb", lambda: state["v"])
+        assert metrics.snapshot()["gauges"]["obs.cb"] == 1
+        state["v"] = 7
+        assert metrics.snapshot()["gauges"]["obs.cb"] == 7
+
+    def test_histogram_empty_quantiles_are_none(self):
+        summary = metrics.histogram("obs.empty").summary()
+        assert summary == {
+            "count": 0, "sum": 0.0, "max": 0.0, "p50": None, "p99": None
+        }
+
+    def test_histogram_overflow_reports_observed_max(self):
+        """A value past the top bucket must not silently clamp to the
+        bucket bound — the observed maximum is the honest estimate."""
+        hist = metrics.histogram("obs.slowjob")
+        hist.observe(45.0)
+        summary = hist.summary()
+        assert summary["max"] == 45.0
+        assert summary["p99"] == 45.0  # not 10.0 (the top bound)
+
+    def test_worker_pool_counters(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "2")
+        workers.set_backend("process")
+        out = workers.map_ordered(_traced_task, [1, 2, 3])
+        assert out == [2, 4, 6]
+        snap = metrics.snapshot()
+        assert snap["counters"]["workers.tasks_submitted"] == 3
+        assert snap["counters"]["workers.tasks_completed"] == 3
+        assert snap["gauges"]["workers.queue_depth"] == 0
+
+    def test_serve_job_latency_histogram(self, tree):
+        from operator_forge.serve.jobs import jobs_from_specs
+        from operator_forge.serve.runner import run_job
+
+        jobs = jobs_from_specs(
+            [{"command": "vet", "path": tree}], os.getcwd()
+        )
+        run_job(jobs[0])
+        summary = metrics.snapshot()["histograms"]["serve.job.seconds"]
+        assert summary["count"] == 1
+        assert summary["p50"] is not None
+
+    def test_stats_cli_json_stable_order(self, capsys):
+        metrics.counter("obs.cli").inc()
+        assert cli_main(["stats", "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert list(report) == ["cache", "graph", "metrics", "spans"]
+        assert list(report["graph"]) == ["dirty", "reused", "recomputed"]
+        assert report["metrics"]["counters"]["obs.cli"] == 1
+        assert list(report["cache"]) == sorted(report["cache"])
+
+    def test_cache_eviction_counts_in_registry(self, tmp_path):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cache = perfcache.get_cache()
+        for i in range(6):
+            cache.put("evict", f"key-{i}", os.urandom(4096))
+        summary = cache.gc(max_bytes=2 * 5000)
+        assert summary["entries_removed"] >= 2
+        assert summary["bytes_reclaimed"] > 0
+        assert summary["bytes_remaining"] == summary["bytes_after"]
+        snap = metrics.snapshot()
+        assert snap["counters"]["cache.evictions"] >= 2
+        assert snap["counters"]["cache.bytes_reclaimed"] > 0
+
+
+class TestCacheGcJson:
+    def test_gc_cli_prints_json_summary(self, tmp_path, capsys):
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        cache = perfcache.get_cache()
+        for i in range(4):
+            cache.put("evict", f"key-{i}", os.urandom(4096))
+        assert cli_main(["cache", "gc"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert list(summary) == [
+            "entries_removed", "bytes_reclaimed", "bytes_remaining"
+        ]
+        assert summary["entries_removed"] == 0
+        assert cli_main(
+            ["cache", "gc", "--max-mb", "0.003", "--verbose"]
+        ) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["entries_removed"] >= 1
+        assert summary["bytes_reclaimed"] > 0
+        assert summary["entries"] == 4
+
+
+class TestDepgraphProvenance:
+    def test_stale_dep_records_cause(self):
+        perfcache.configure(mode="mem")
+        GRAPH.reset()
+        sig = {"a": "1"}
+        GRAPH.memo("t", ("obs-k",), sig.get, lambda: "v1",
+                   deps={"a": "1"})
+        sig["a"] = "2"
+        GRAPH.memo("t", ("obs-k",), sig.get, lambda: "v2",
+                   deps={"a": "2"})
+        entries = GRAPH.provenance()
+        assert entries == [{"node": "obs-k", "cause": "a", "via": []}]
+
+    def test_invalidate_records_chain_to_root_cause(self):
+        perfcache.configure(mode="mem")
+        GRAPH.reset()
+        GRAPH.memo("t", ("n1",), lambda k: "1", lambda: "v",
+                   deps={("src", "f.go"): "1"})
+        GRAPH.memo("t", ("n2",), lambda k: "1", lambda: "v",
+                   deps={("n1",): "1"})
+        dirtied = GRAPH.invalidate([("src", "f.go")])
+        assert dirtied == 2
+        entries = {e["node"]: e for e in GRAPH.provenance()}
+        assert entries["n1"]["cause"] == "src:f.go"
+        assert entries["n2"]["cause"] == "src:f.go"
+        assert entries["n2"]["via"] == ["src:f.go", "n1"]
+        last = GRAPH.last_invalidation()
+        assert last == {"roots": ["src:f.go"], "dirtied": 2}
+
+    def test_reset_clears_provenance(self):
+        perfcache.configure(mode="mem")
+        GRAPH.reset()
+        GRAPH.memo("t", ("n1",), lambda k: "1", lambda: "v",
+                   deps={("src", "f.go"): "1"})
+        GRAPH.invalidate([("src", "f.go")])
+        assert GRAPH.provenance()
+        GRAPH.reset()
+        assert GRAPH.provenance() == []
+        assert GRAPH.last_invalidation() == {}
+
+
+def _explain_text(tree: str, rel: str) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert cli_main(["explain", tree, "--changed", rel]) == 0
+    return buf.getvalue()
+
+
+class TestExplain:
+    REL = os.path.join("apis", "shop", "v1alpha1", "bookstore_types.go")
+
+    def test_names_changed_file_and_chain(self, tree):
+        out = _explain_text(tree, self.REL)
+        rel = self.REL.replace(os.sep, "/")
+        assert f"file {rel} changed" in out
+        assert f"invalidated node src:{rel}" in out
+        assert "invalidated suite apis/shop/v1alpha1" in out
+        # the reverse import closure names dependents with their chain
+        assert "invalidated suite controllers/shop (import chain: " in out
+        assert "project index patched by delta" in out
+        assert "jobs re-run minimally: vet, test" in out
+
+    def test_byte_identical_across_modes_backends_jobs(
+        self, tree, monkeypatch
+    ):
+        """The acceptance matrix: an edit-one-file explain is
+        byte-identical across cache off/mem/disk × thread/process ×
+        JOBS=1/8."""
+        target = os.path.join(tree, self.REL)
+        with open(target, "a", encoding="utf-8") as fh:
+            fh.write("\n// observability edit\n")
+        time.sleep(0.02)
+        outputs = set()
+        for mode in ("off", "mem", "disk"):
+            for backend in ("thread", "process"):
+                for jobs in ("1", "8"):
+                    perfcache.configure(
+                        mode=mode,
+                        root=os.path.join(tree, ".cache")
+                        if mode == "disk" else None,
+                    )
+                    perfcache.reset()
+                    workers.set_backend(backend)
+                    monkeypatch.setenv("OPERATOR_FORGE_JOBS", jobs)
+                    outputs.add(_explain_text(tree, self.REL))
+        assert len(outputs) == 1
+        perfcache.configure(None, None)
+
+    def test_go_mod_and_config_chains(self, tree):
+        out = _explain_text(tree, "go.mod")
+        assert "module path may change" in out
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli_main([
+                "explain", tree, "--changed", "config/samples"
+                + os.sep + "..nonexistent.yaml",
+            ]) == 0
+        assert "generation plan" in buf.getvalue()
+
+    def test_removed_file_reported(self, tree):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli_main([
+                "explain", tree, "--removed", self.REL,
+            ]) == 0
+        assert "removed" in buf.getvalue()
+
+    def test_json_mode_one_object_per_file(self, tree, capsys):
+        assert cli_main([
+            "explain", tree, "--changed", self.REL, "--json",
+        ]) == 0
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.splitlines()
+        ]
+        assert len(lines) == 1
+        assert list(lines[0]) == ["file", "event", "chain"]
+        assert lines[0]["event"] == "changed"
+
+    def test_requires_a_change_set(self, tree, capsys):
+        assert cli_main(["explain", tree]) == 1
+        assert "--changed" in capsys.readouterr().err
+
+
+class TestServeObservability:
+    def _serve(self, requests, cwd) -> list:
+        from operator_forge.serve.server import serve_loop
+
+        in_stream = io.StringIO(
+            "".join(json.dumps(r) + "\n" for r in requests)
+        )
+        out_stream = io.StringIO()
+        old = os.getcwd()
+        os.chdir(cwd)
+        try:
+            assert serve_loop(in_stream, out_stream) == 0
+        finally:
+            os.chdir(old)
+        return [
+            json.loads(line)
+            for line in out_stream.getvalue().splitlines()
+        ]
+
+    def test_stats_op_reports_metrics_and_provenance(self, tree):
+        responses = self._serve([
+            {"op": "job", "command": "vet", "path": tree},
+            {"op": "stats"},
+            {"op": "shutdown"},
+        ], os.path.dirname(tree))
+        stats = responses[1]
+        assert stats["ok"] and stats["op"] == "stats"
+        assert list(stats["metrics"]) == [
+            "counters", "gauges", "histograms"
+        ]
+        job_hist = stats["metrics"]["histograms"]["serve.job.seconds"]
+        assert job_hist["count"] >= 1 and job_hist["p99"] is not None
+        assert list(stats["provenance"]) == [
+            "last_invalidation", "recorded"
+        ]
+
+    def test_explain_op_matches_cli(self, tree):
+        rel = TestExplain.REL.replace(os.sep, "/")
+        responses = self._serve([
+            {"op": "explain", "path": tree, "changed": [rel],
+             "id": "e1"},
+            {"op": "shutdown"},
+        ], os.path.dirname(tree))
+        explain = responses[0]
+        assert explain["ok"] and explain["id"] == "e1"
+        assert explain["report"] == _explain_text(tree, rel)
+        assert explain["changes"][0]["file"] == rel
+
+    def test_explain_op_accepts_removed_only_change_set(self, tree):
+        rel = TestExplain.REL.replace(os.sep, "/")
+        responses = self._serve([
+            {"op": "explain", "path": tree, "removed": [rel],
+             "id": "er"},
+            {"op": "shutdown"},
+        ], os.path.dirname(tree))
+        explain = responses[0]
+        assert explain["ok"] and explain["id"] == "er"
+        assert f"file {rel} removed" in explain["report"]
+        assert explain["changes"][0]["event"] == "removed"
+
+    def test_explain_op_defaults_to_last_watch_cycle_root(self, tree):
+        """The no-change-set fallback derives each file against the
+        WATCH root it was recorded under, not the request cwd — the
+        module path and reverse-import chains come from the watched
+        project."""
+        from operator_forge.serve import watch as watch_mod
+
+        rel = TestExplain.REL.replace(os.sep, "/")
+        watch_mod.LAST_CHANGED[:] = [(tree, rel)]
+        watch_mod.LAST_REMOVED[:] = []
+        try:
+            responses = self._serve([
+                {"op": "explain", "id": "e3"},
+                {"op": "shutdown"},
+            ], os.path.dirname(tree))
+        finally:
+            watch_mod.LAST_CHANGED.clear()
+        explain = responses[0]
+        assert explain["ok"] and explain["roots"] == [tree]
+        assert explain["report"] == _explain_text(tree, rel)
+        assert "github.com/acme/app" in explain["report"]
+
+    def test_explain_op_without_change_set_errors(self, tree):
+        from operator_forge.serve import watch as watch_mod
+
+        # the fallback is process-resident state: an earlier watch
+        # cycle (any test in this process) would legitimately satisfy
+        # the op, so empty it to exercise the no-change-set error
+        watch_mod.LAST_CHANGED.clear()
+        watch_mod.LAST_REMOVED.clear()
+        responses = self._serve([
+            {"op": "explain", "path": tree, "id": "e2"},
+            {"op": "shutdown"},
+        ], os.path.dirname(tree))
+        assert responses[0]["ok"] is False
+        assert "no change set" in responses[0]["error"]
+
+
+class TestWatchProvenance:
+    def test_cycle_payload_carries_chains(self, tree):
+        from operator_forge.serve.jobs import jobs_from_specs
+        from operator_forge.serve.watch import watch_loop
+
+        perfcache.configure(mode="mem")
+        perfcache.reset()
+        jobs = jobs_from_specs(
+            [{"command": "vet", "path": tree}], os.getcwd()
+        )
+        target = os.path.join(tree, TestExplain.REL)
+
+        def poll():
+            with open(target, "a", encoding="utf-8") as fh:
+                fh.write("\n// watch edit\n")
+            time.sleep(0.02)
+            return True
+
+        payloads = []
+        watch_loop(jobs, payloads.append, cycles=2, poll=poll)
+        prime, cycle = payloads
+        assert prime["provenance"] == []
+        (entry,) = [
+            e for e in cycle["provenance"]
+            if e["file"] == TestExplain.REL.replace(os.sep, "/")
+        ]
+        assert entry["event"] == "changed"
+        assert any(
+            "invalidated suite apis/shop/v1alpha1" in line
+            for line in entry["chain"]
+        )
+        # the cycle's latency landed in the watch histogram
+        summary = metrics.snapshot()["histograms"]["watch.cycle.seconds"]
+        assert summary["count"] == 2
+
+
+class TestTraceCli:
+    def test_trace_subcommand_writes_chrome_json(
+        self, tree, tmp_path, capsys
+    ):
+        out = str(tmp_path / "trace.json")
+        assert cli_main(["trace", "--out", out, "vet", tree]) == 0
+        captured = capsys.readouterr()
+        assert "trace:" in captured.err
+        with open(out, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "command:vet" in names
+        # tracing is a wrapper: the wrapped command's output is intact
+        assert "vet: all Go files check cleanly" in captured.out
+
+    def test_trace_requires_a_command(self, capsys):
+        assert cli_main(["trace", "--out", "/tmp/x.json"]) == 1
+        assert "give a command" in capsys.readouterr().err
+
+    def test_env_var_export_on_exit(self, tree, tmp_path, monkeypatch,
+                                    capsys):
+        out = str(tmp_path / "env-trace.json")
+        monkeypatch.setenv("OPERATOR_FORGE_TRACE", out)
+        spans.use_env()
+        try:
+            assert cli_main(["vet", tree]) == 0
+        finally:
+            monkeypatch.delenv("OPERATOR_FORGE_TRACE")
+            spans.use_env()
+        with open(out, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        assert trace["traceEvents"]
+
+
+class TestTelemetryByteIdentity:
+    def test_traced_vet_and_test_match_untraced(self, tree):
+        """Telemetry on/off must not change an output byte — report
+        objects compare equal between a traced and an untraced run."""
+        from operator_forge.gocheck.analysis import analyze_project
+        from operator_forge.gocheck.world import run_project_tests
+
+        perfcache.configure(mode="off")
+        diags_off = analyze_project(tree)
+        results_off = run_project_tests(tree)
+        spans.enable_tracing(True)
+        diags_on = analyze_project(tree)
+        results_on = run_project_tests(tree)
+        spans.enable_tracing(None)
+        assert [d.to_dict() for d in diags_off] == [
+            d.to_dict() for d in diags_on
+        ]
+        sig = lambda rs: [  # noqa: E731
+            (r.rel, r.ok, r.error, sorted(r.ran),
+             [(n, m) for n, m in r.failures])
+            for r in rs
+        ]
+        assert sig(results_off) == sig(results_on)
