@@ -685,6 +685,195 @@ def telemetry_section(tmp: str, steady_tree: str,
     }
 
 
+def chaos_section(tmp: str, stage_totals_cold: dict, cold_cpu_med: float,
+                  runs: int) -> dict:
+    """The robustness contract (PR 7), three guards in one section:
+
+    - **recovery identity** — the 8-job batch run under deterministic
+      fault injection (a worker crash, damaged disk-cache entries, a
+      transient job failure: ``OPERATOR_FORGE_FAULTS`` semantics) must
+      produce output trees and normalized reports byte-identical to a
+      fault-free cache-off serial run, across every cache mode ×
+      worker backend × JOBS width — the self-healing layer (respawn /
+      retry / quarantine / recompute) must heal invisibly;
+    - **chaos throughput** — the warm batch re-run under injected
+      crashes and corrupt entries, reported as a ratio against the
+      fault-free warm batch.  Reported, not gated: recovery cost is
+      real work (pool respawns, recomputes) and, like every timing
+      here, carries the host-noise caveat;
+    - **fault-free overhead** — with no spec configured the planted
+      injection sites are one env read + string compare; their
+      estimated share of a cold codegen run must stay under 1%
+      (measured like span_overhead, using the span count as a
+      conservative stand-in for site hits — real sites fire orders of
+      magnitude less often than spans)."""
+    from operator_forge.perf import faults, workers
+    from operator_forge.serve.batch import run_batch
+    from operator_forge.serve.jobs import jobs_from_specs
+
+    # worker.crash breaks the whole pool (the executor tears down every
+    # worker, some mid-write) — exactly the blast radius recovery must
+    # absorb.  task.hang stays out of the bench spec: killing it needs a
+    # deadline shorter than the injected hang but longer than any
+    # legitimate group, which would dominate the section's wall time;
+    # the kill-at-deadline path is proven by tests/test_robustness.py.
+    spec = (
+        "worker.crash@batch.group:2,"
+        "cache.corrupt@disk:3,cache.torn@disk:7,job.fail@serve.job:1"
+    )
+
+    # fault-free fast path: per-call cost of a planted site, bounded
+    # against the cold codegen run like the span-overhead micro-guard
+    faults.configure(None)
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        faults.fire("disk", "cache.corrupt", "cache.torn", "cache.zero")
+    per_call = (time.perf_counter() - start) / n
+    total_calls = sum(d["calls"] for d in stage_totals_cold.values())
+    calls_per_run = total_calls / max(runs, 1)
+    fraction = (
+        per_call * calls_per_run / cold_cpu_med if cold_cpu_med > 0 else 0.0
+    )
+
+    saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+
+    def set_jobs(value):
+        os.environ["OPERATOR_FORGE_JOBS"] = value
+
+    def run(specs):
+        results = run_batch(jobs_from_specs(specs, tmp))
+        bad = [(r.id, r.stderr) for r in results if not r.ok]
+        assert not bad, f"chaos batch job failed: {bad}"
+        return results
+
+    def counter_values():
+        from operator_forge.perf import metrics
+
+        return {
+            name: metrics.counter(name).value()
+            for name in (
+                "faults.injected", "worker.retries", "worker.respawns",
+                "worker.timeouts", "worker.quarantined",
+                "cache.corrupt_entries", "cache.quarantined",
+                "serve.job.retries",
+            )
+        }
+
+    fault_free_wall, chaos_wall = [], []
+    guards = {}
+    disk_root = tempfile.mkdtemp(prefix="operator-forge-chaoscache-")
+    try:
+        # throughput legs: the warm (steady, disk-cache, process-pool)
+        # batch, first fault-free, then with the spec live — per chaos
+        # run the counters reset and the pool is discarded so each run
+        # injects the identical fault sequence into fresh workers
+        warm_specs = _batch_specs(tmp, "chaos-warm")
+        workers.set_backend("process")
+        set_jobs("8")
+        pf_cache.configure(
+            mode="disk", root=os.path.join(disk_root, "warm")
+        )
+        pf_cache.reset()
+        for _ in range(3):  # reach the scaffold fixed point + record
+            run(warm_specs)
+        for _ in range(BATCH_RUNS):
+            start = time.perf_counter()
+            run(warm_specs)
+            fault_free_wall.append(time.perf_counter() - start)
+        before = counter_values()
+        for _ in range(BATCH_RUNS):
+            # fresh workers per run keep the injected fault sequence
+            # identical — but the fork/startup of the 8-worker pool is
+            # paid OUTSIDE the timed window (one un-timed fault-free
+            # warm run on the fresh pool), matching the warmed pool the
+            # fault-free timings enjoyed; otherwise the ratio would
+            # conflate pre-fault pool cold-start with recovery cost —
+            # a deterministic bias, not the host noise the caveat
+            # covers
+            workers._discard_process_pool()
+            faults.configure(None)
+            run(warm_specs)
+            faults.configure(spec)
+            faults.reset()
+            start = time.perf_counter()
+            run(warm_specs)
+            chaos_wall.append(time.perf_counter() - start)
+        faults.configure(None)
+        recovered = {
+            name: value - before[name]
+            for name, value in counter_values().items()
+        }
+        pf_cache.configure(mode="mem")
+
+        # identity matrix: fresh-dir batches with the spec live, every
+        # leg compared against a fault-free cache-off serial reference
+        workers.set_backend("thread")
+        set_jobs("1")
+        pf_cache.configure(mode="off")
+        ref_specs = _batch_specs(tmp, "chaos-ref")
+        ref_dirs = sorted(
+            {s["output_dir"] for s in ref_specs if "output_dir" in s}
+        )
+        ref_sig = _batch_signature(run(ref_specs), ref_dirs, tmp)
+        for cache_mode in GUARD_MODES:
+            leg_ok = True
+            for leg, (backend, jobs) in enumerate((
+                ("thread", "1"), ("thread", "8"), ("process", "8"),
+            )):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(disk_root, f"{cache_mode}-leg{leg}")
+                    if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                workers.set_backend(backend)
+                workers._discard_process_pool()
+                set_jobs(jobs)
+                faults.configure(spec)
+                faults.reset()
+                specs = _batch_specs(tmp, f"chaos-{cache_mode}-{leg}")
+                dirs = sorted({
+                    s["output_dir"] for s in specs if "output_dir" in s
+                })
+                sig = _batch_signature(run(specs), dirs, tmp)
+                leg_ok = leg_ok and sig == ref_sig
+            guards[cache_mode] = leg_ok
+    finally:
+        faults.configure(None)
+        pf_cache.configure(mode="mem")
+        workers.set_backend(None)
+        if saved_jobs is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+        shutil.rmtree(disk_root, ignore_errors=True)
+
+    fault_free_med = statistics.median(fault_free_wall)
+    chaos_med = statistics.median(chaos_wall)
+    return {
+        "spec": spec,
+        "runs": BATCH_RUNS,
+        "fault_free_warm_wall_s_median": round(fault_free_med, 4),
+        "chaos_warm_wall_s_median": round(chaos_med, 4),
+        "throughput_ratio": round(
+            fault_free_med / chaos_med if chaos_med > 0 else 0.0, 3
+        ),
+        "faults_injected": recovered["faults.injected"],
+        "recovered": recovered,
+        "identity_by_cache_mode": guards,
+        "disabled_per_call_ns": round(per_call * 1e9, 1),
+        "disabled_fraction_of_cold": round(fraction, 6),
+        "disabled_ok": fraction < 0.01,
+        "headline": "chaos = the warm batch re-run with "
+        "OPERATOR_FORGE_FAULTS injecting a worker crash (whole-pool "
+        "teardown), damaged disk entries, and a transient job failure; "
+        "throughput ratio is reported with the host-noise caveat, the "
+        "identity matrix (vs a fault-free cache-off serial run) and "
+        "the <1% fault-free site overhead are enforced",
+    }
+
+
 def _batch_specs(base: str, suffix: str) -> list:
     """The 8-job kitchen-sink batch workload: three init + create-api
     chains over distinct output dirs, plus a vet and a test of the
@@ -988,6 +1177,13 @@ def main() -> None:
             statistics.median(cpu["cold"]), MEASURED_RUNS,
         )
 
+        # the robustness layer: recovery identity under injected
+        # faults, chaos throughput ratio, fault-free site overhead
+        chaos = chaos_section(
+            tmp, stage_totals["cold"],
+            statistics.median(cpu["cold"]), MEASURED_RUNS,
+        )
+
         loc = sum(fixture_loc.values())
         summary = {
             phase: _phase_summary(cpu[phase], wall[phase], loc)
@@ -1046,6 +1242,7 @@ def main() -> None:
                     stage_totals["cold"], cold_med, MEASURED_RUNS
                 ),
                 "telemetry": telemetry,
+                "chaos": chaos,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
                 "up to ~15% (host scheduling/steal), and the host itself "
@@ -1129,6 +1326,26 @@ def main() -> None:
             print(
                 "explain determinism guard FAILED: provenance reports "
                 "diverged across cache modes / backends / job counts",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not all(chaos["identity_by_cache_mode"].values()):
+            print(
+                "chaos recovery-identity guard FAILED: a fault-injected "
+                "batch diverged from the fault-free cache-off serial run",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not chaos["disabled_ok"]:
+            print(
+                "fault-site overhead guard FAILED: fault-free injection "
+                "sites exceed 1% of the cold codegen path",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if chaos["faults_injected"] <= 0:
+            print(
+                "chaos guard FAILED: the chaos legs injected no faults",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -549,7 +549,7 @@ _operator_forge() {
         update)
             COMPREPLY=($(compgen -W "license" -- "$cur"));;
         cache)
-            COMPREPLY=($(compgen -W "gc" -- "$cur"));;
+            COMPREPLY=($(compgen -W "gc verify" -- "$cur"));;
         completion)
             COMPREPLY=($(compgen -W "bash zsh fish" -- "$cur"));;
         *)
@@ -570,7 +570,7 @@ complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api we
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from update' -a 'license'
-complete -c operator-forge -f -n '__fish_seen_subcommand_from cache' -a 'gc'
+complete -c operator-forge -f -n '__fish_seen_subcommand_from cache' -a 'gc verify'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from completion' -a 'bash zsh fish'
 """
 
@@ -854,6 +854,23 @@ def cmd_cache_gc(args: argparse.Namespace) -> int:
             out[key] = summary[key]
     print(_json.dumps(out))
     return 0
+
+
+def cmd_cache_verify(args: argparse.Namespace) -> int:
+    """`cache verify`: scan the whole persisted store, authenticating
+    (HMAC) and unpickling every entry — the no-toolchain analogue of
+    GOCACHE verification.  Bad entries (unreadable, truncated, failed
+    signature, unpicklable) are reported; with --repair they move to
+    the quarantine/ directory so they can never be re-read.  The
+    summary is always machine-readable JSON (stable key order).
+    Exit status: 1 when bad entries remain in the live store (found
+    without --repair, or --repair could not move them), 0 otherwise
+    (clean store, or --repair quarantined every bad entry)."""
+    import json as _json
+
+    summary = perfcache.verify(repair=args.repair)
+    print(_json.dumps(summary))
+    return 1 if summary["bad"] > summary["quarantined"] else 0
 
 
 def cmd_stats(args: argparse.Namespace) -> int:
@@ -1258,6 +1275,18 @@ def build_parser() -> argparse.ArgumentParser:
              "bytes_before, bytes_after) in the JSON summary",
     )
     p_gc.set_defaults(func=cmd_cache_gc)
+    p_verify = cache_sub.add_parser(
+        "verify",
+        help="scan the disk cache, authenticating and unpickling "
+             "every entry; report (and with --repair quarantine) "
+             "damaged ones",
+    )
+    p_verify.add_argument(
+        "--repair", action="store_true",
+        help="move bad entries to the quarantine/ directory instead "
+             "of only reporting them",
+    )
+    p_verify.set_defaults(func=cmd_cache_verify)
 
     p_stats = sub.add_parser(
         "stats",
@@ -1319,6 +1348,18 @@ def build_parser() -> argparse.ArgumentParser:
 # nested job (which would overwrite the file mid-run)
 _depth_lock = threading.Lock()
 _main_depth = [0]
+
+
+def _new_depth_lock_after_fork() -> None:
+    # fork (the perf.workers process pool) can land while a parent
+    # thread holds the re-entrancy lock; the child would inherit it
+    # locked and deadlock on its first main() call
+    global _depth_lock
+    _depth_lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_new_depth_lock_after_fork)
 
 
 def main(argv: list[str] | None = None) -> int:

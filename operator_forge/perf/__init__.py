@@ -68,6 +68,24 @@ def _executor(jobs: int) -> ThreadPoolExecutor:
         return pool
 
 
+def env_number(name: str, default, *, cast=float, minimum=0):
+    """Parse a numeric tuning knob from the environment.
+
+    Empty/missing or unparseable values fall back to ``default``; the
+    result is floored at ``minimum`` (pass ``minimum=None`` to skip the
+    clamp).  One definition for every ``OPERATOR_FORGE_*`` numeric knob
+    — timeouts, retry budgets, fault-hang duration — so the parse rule
+    can't drift between subsystems."""
+    raw = os.environ.get(name, "").strip()
+    try:
+        value = cast(raw) if raw else default
+    except ValueError:
+        value = default
+    if minimum is not None and value < minimum:
+        value = minimum
+    return value
+
+
 def n_jobs() -> int:
     """Worker count for parallel pipeline stages.
 

@@ -42,6 +42,7 @@ import threading
 from dataclasses import dataclass, field as dc_field
 
 from .. import __version__
+from . import faults
 
 # bump to invalidate every previously persisted entry when the record
 # layout (not the generator output) changes
@@ -50,6 +51,9 @@ _SCHEMA = 1
 _MODES = ("off", "mem", "disk")
 DEFAULT_MODE = "mem"
 DEFAULT_DIR = ".operator-forge-cache"
+#: damaged persisted entries are moved here (never deleted in place and
+#: never re-read): ``<root>/quarantine/<stage>-<key>.pkl``
+QUARANTINE_DIRNAME = "quarantine"
 #: disk-store size ceiling (``OPERATOR_FORGE_CACHE_MAX_MB`` overrides;
 #: values <= 0 disable pruning)
 DEFAULT_MAX_MB = 256
@@ -200,6 +204,30 @@ def _sign(key: bytes, blob: bytes) -> bytes:
     return hmac.new(key, blob, hashlib.sha256).digest()
 
 
+def _damage_entry(path: str, kind: str) -> None:
+    """Chaos-harness damage applied to a just-persisted entry —
+    deterministic stand-ins for bit rot (``cache.corrupt``), a torn
+    write (``cache.torn``), and a zeroed inode (``cache.zero``).  Every
+    variant fails verification on the next read and lands in
+    quarantine; none is ever unpickled."""
+    try:
+        size = os.path.getsize(path)
+        if kind == "cache.zero":
+            with open(path, "wb"):
+                pass
+        elif kind == "cache.torn":
+            with open(path, "r+b") as handle:
+                handle.truncate(max(size // 2, 1))
+        else:  # cache.corrupt: flip the last payload byte
+            with open(path, "r+b") as handle:
+                handle.seek(size - 1)
+                last = handle.read(1)
+                handle.seek(size - 1)
+                handle.write(bytes([last[0] ^ 0xFF]))
+    except OSError:
+        pass
+
+
 class ContentCache:
     """Thread-safe content-addressed store with hit/miss accounting."""
 
@@ -254,12 +282,50 @@ class ContentCache:
     def _count(self, stage: str, what: str) -> None:
         with self._lock:
             entry = self._stats.setdefault(stage, {"hits": 0, "misses": 0})
-            entry[what] += 1
+            entry[what] = entry.get(what, 0) + 1
 
     # -- store ----------------------------------------------------------
 
     def _disk_path(self, stage: str, key: str) -> str:
         return os.path.join(self.root(), stage, key[:2], key + ".pkl")
+
+    # -- quarantine -----------------------------------------------------
+
+    def _quarantine_file(self, path: str, stage: str) -> bool:
+        """Move a damaged persisted entry into ``quarantine/``.  The
+        one unacceptable outcome is leaving a bad file in place to be
+        re-read (and re-fail) forever, so if the move itself fails the
+        entry is removed instead.  Returns whether the file is gone
+        from the live store — ``False`` means it could be neither
+        moved nor removed, so callers must not report it healed."""
+        from . import metrics
+
+        dest_dir = os.path.join(self.root(), QUARANTINE_DIRNAME)
+        try:
+            os.makedirs(dest_dir, exist_ok=True)
+            os.replace(
+                path,
+                os.path.join(dest_dir, f"{stage}-{os.path.basename(path)}"),
+            )
+        except OSError:
+            try:
+                os.remove(path)
+            except OSError:
+                return False  # unmovable AND unremovable: still in place
+        metrics.counter("cache.quarantined").inc()
+        self._count(stage, "quarantined")
+        return True
+
+    def _corrupt_entry(self, stage: str, key: str) -> None:
+        """Account a detected-corrupt entry (counter + namespace) and
+        quarantine whatever is persisted under it."""
+        from . import metrics
+
+        metrics.counter("cache.corrupt_entries").inc()
+        self._count(stage, "corrupt")
+        path = self._disk_path(stage, key)
+        if os.path.exists(path):
+            self._quarantine_file(path, stage)
 
     def get(self, stage: str, key: str, record_stats: bool = True):
         """Fetch a value; returns :data:`MISS` when absent.  Hits always
@@ -281,7 +347,13 @@ class ContentCache:
         try:
             value = pickle.loads(blob)
         except Exception:
-            # a corrupt persisted entry is just a miss
+            # a corrupt entry is a miss — but never a *silent* one: it
+            # is counted, attributed to its namespace, dropped from the
+            # mem store, and its disk file quarantined so the same bad
+            # bytes can never be re-read
+            with self._lock:
+                self._mem.pop((stage, key), None)
+            self._corrupt_entry(stage, key)
             if record_stats:
                 self._count(stage, "misses")
             return MISS
@@ -317,9 +389,15 @@ class ContentCache:
         except OSError:
             return None
         if len(data) <= _SIG_BYTES:
+            # a zero-byte or truncated-below-signature file: a torn
+            # write, not an absent entry — quarantine it
+            self._corrupt_entry(stage, key)
             return None
         signature, blob = data[:_SIG_BYTES], data[_SIG_BYTES:]
         if not hmac.compare_digest(signature, _sign(signing_key, blob)):
+            # tampered, bit-rotted, or torn mid-blob: never unpickled,
+            # and never left in place to fail verification again
+            self._corrupt_entry(stage, key)
             return None
         try:
             # mark the entry used: relatime/noatime mounts barely move
@@ -344,17 +422,25 @@ class ContentCache:
             os.replace(tmp, path)
         except OSError:
             return  # persistence is best-effort
+        for kind in faults.fire(
+            "disk", "cache.corrupt", "cache.torn", "cache.zero"
+        ):
+            # every kind fire() logged and counted must materialize:
+            # two kinds landing on the same hit apply in spec order
+            # (each damages whatever bytes the previous one left), or
+            # fired()/faults.injected would overstate the injection
+            _damage_entry(path, kind)
         self._maybe_gc(len(blob) + _SIG_BYTES)
 
     # -- eviction --------------------------------------------------------
 
     def max_bytes(self) -> int:
         """The disk-store ceiling in bytes (<= 0 disables pruning)."""
-        raw = os.environ.get("OPERATOR_FORGE_CACHE_MAX_MB", "").strip()
-        try:
-            mb = float(raw) if raw else float(DEFAULT_MAX_MB)
-        except ValueError:
-            mb = float(DEFAULT_MAX_MB)
+        from . import env_number
+
+        mb = env_number(
+            "OPERATOR_FORGE_CACHE_MAX_MB", float(DEFAULT_MAX_MB), minimum=None
+        )
         return int(mb * 1024 * 1024)
 
     def _maybe_gc(self, written: int) -> None:
@@ -391,7 +477,12 @@ class ContentCache:
         root = self.root()
         entries = []  # (atime_ns, mtime_ns, size, path)
         total = 0
-        for dirpath, _dirnames, filenames in os.walk(root):
+        for dirpath, dirnames, filenames in os.walk(root):
+            # quarantined entries are out of the live store: not counted
+            # against the ceiling, and never "evicted" back to life
+            dirnames[:] = [
+                d for d in dirnames if d != QUARANTINE_DIRNAME
+            ]
             for name in filenames:
                 if not name.endswith(".pkl"):
                     continue
@@ -432,8 +523,106 @@ class ContentCache:
             "bytes_after": total - freed,
         }
 
+    # -- verification ----------------------------------------------------
+
+    def verify(self, repair: bool = False) -> dict:
+        """Scan the whole persisted store, authenticating and
+        unpickling every entry — the no-toolchain analogue of GOCACHE
+        verification.  An entry is *bad* when it is unreadable, shorter
+        than a signature, fails HMAC, or (signed, therefore ours) fails
+        to unpickle.  With ``repair`` bad entries move to
+        ``quarantine/``; without it the scan only reports.  Returns a
+        stable-key-order summary: ``scanned`` / ``ok`` / ``bad`` /
+        ``quarantined`` / ``entries`` (sorted store-relative paths of
+        the bad ones).  ``quarantined`` can lag ``bad`` when an entry
+        could be neither moved nor removed (e.g. a read-only store
+        dir) — such entries are still live, not healed."""
+        from . import metrics
+
+        signing_key = _load_hmac_key()
+        root = self.root()
+        scanned = ok = quarantined = 0
+        bad_entries: list = []
+        if signing_key is None:
+            # no signing key means disk persistence is disabled: the
+            # read path never touches these files, so nothing can be
+            # authenticated and nothing is "damage" — scanning would
+            # condemn (and with repair, quarantine) an entire store the
+            # runtime already ignores
+            return {
+                "scanned": scanned,
+                "ok": ok,
+                "bad": 0,
+                "quarantined": quarantined,
+                "entries": [],
+            }
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != QUARANTINE_DIRNAME
+            )
+            for name in sorted(filenames):
+                if not name.endswith(".pkl"):
+                    continue
+                path = os.path.join(dirpath, name)
+                scanned += 1
+                good = False
+                try:
+                    with open(path, "rb") as handle:
+                        data = handle.read()
+                    if len(data) > _SIG_BYTES:  # key non-None: early-out above
+                        signature = data[:_SIG_BYTES]
+                        blob = data[_SIG_BYTES:]
+                        if hmac.compare_digest(
+                            signature, _sign(signing_key, blob)
+                        ):
+                            pickle.loads(blob)  # signed by us: safe
+                            good = True
+                except Exception:
+                    good = False
+                if good:
+                    ok += 1
+                    continue
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                bad_entries.append(rel)
+                if repair:
+                    # counted only on a successful quarantine: a
+                    # report-only scan is an idempotent observation
+                    # (its JSON carries the bad count), and a failed
+                    # move leaves the entry for the next scan to retry
+                    # — counting either would show phantom repeat
+                    # corruption in stats
+                    stage = rel.split("/", 1)[0]
+                    if self._quarantine_file(path, stage):
+                        # same accounting pair the inline read path
+                        # records (_corrupt_entry): the global counter
+                        # AND the per-namespace attribution, so serve
+                        # stats reconcile against cache.corrupt_entries
+                        metrics.counter("cache.corrupt_entries").inc()
+                        self._count(stage, "corrupt")
+                        quarantined += 1
+        return {
+            "scanned": scanned,
+            "ok": ok,
+            "bad": len(bad_entries),
+            "quarantined": quarantined,
+            "entries": sorted(bad_entries),
+        }
+
 
 _CACHE = ContentCache()
+
+
+def _new_locks_after_fork() -> None:
+    # fork (the perf.workers process pool) can land while another
+    # parent thread holds a cache lock; the child would inherit it
+    # locked and deadlock on its first get/put
+    global _hmac_lock
+    _hmac_lock = threading.Lock()
+    _CACHE._lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_new_locks_after_fork)
 
 
 def get_cache() -> ContentCache:
@@ -454,6 +643,10 @@ def stats() -> dict:
 
 def gc(max_bytes=None) -> dict:
     return _CACHE.gc(max_bytes)
+
+
+def verify(repair: bool = False) -> dict:
+    return _CACHE.verify(repair)
 
 
 def memoized(stage: str, key_parts: tuple, compute):

@@ -1,0 +1,282 @@
+"""Deterministic fault injection — the chaos harness (PR 7).
+
+Every execution subsystem (process-pool workers, the disk cache, the
+serve job runner, the watch scanner) carries planted injection sites;
+this registry decides, deterministically, which hits of which site
+actually fire.  Faults are configured by ``OPERATOR_FORGE_FAULTS`` (or
+programmatically via :func:`configure`) as nth-hit counters — never
+wall-clock randomness — so a failing chaos run replays exactly:
+
+.. code-block:: text
+
+    spec  := entry ("," entry)*
+    entry := kind "@" site [":" nth]        # nth defaults to 1
+
+``kind`` names the failure to inject, ``site`` the planted location it
+applies to (``*`` matches any site), and ``nth`` the 1-based hit of
+that site on which it fires (one entry fires at most once; repeat the
+entry with different counters to fire again).  Example::
+
+    OPERATOR_FORGE_FAULTS=worker.crash@batch.group:2,cache.corrupt@disk:3,job.fail@serve.job:1
+
+Registered kinds and the sites where they are planted:
+
+===================  =====================  ================================
+kind                 planted site           effect when fired
+===================  =====================  ================================
+``worker.crash``     any worker map site    pool child ``os._exit``\\ s hard
+                     (``batch.group``, …)   before sealing its result
+``task.hang``        any worker map site    pool child sleeps past any
+                                            deadline (kill-at-deadline path)
+``cache.corrupt``    ``disk``               one byte of the just-persisted
+                                            entry is flipped
+``cache.torn``       ``disk``               the just-persisted entry is
+                                            truncated mid-blob (torn write)
+``cache.zero``       ``disk``               the just-persisted entry is
+                                            truncated to zero bytes
+``job.fail``         ``serve.job``          a transient exception is raised
+                                            before the job executes
+``watch.vanish``     ``scan``               a scanned file vanishes between
+                                            listing and stat (rename race)
+``watch.scan_error`` ``scan.walk``          the whole snapshot walk raises
+                                            a transient ``OSError``
+===================  =====================  ================================
+
+Hit counters are per-process: forked pool workers restart from zero
+(an at-fork hook), and the parent ships its programmatic spec with
+each task, so a worker observes the same configuration the parent
+does.  Worker-directed kinds (``worker.crash`` / ``task.hang``) are
+counted and planned in the *parent* at submission time — a retried
+task is a fresh submission and does not replay an already-consumed
+counter, which is what makes every injected fault recoverable.
+
+The standing contract (enforced by bench.py's ``chaos`` section and
+the commit-check chaos step): with any spec whose faults are
+recoverable, final outputs are byte-identical to the fault-free
+cache-off run — and with no spec configured, the planted sites cost
+<1% of a cold codegen run (the fault-free fast path below).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_VAR = "OPERATOR_FORGE_FAULTS"
+
+#: every kind a spec may name; parse rejects anything else so a typo'd
+#: chaos run fails loudly instead of silently injecting nothing
+KINDS = (
+    "worker.crash",
+    "task.hang",
+    "cache.corrupt",
+    "cache.torn",
+    "cache.zero",
+    "job.fail",
+    "watch.vanish",
+    "watch.scan_error",
+)
+
+
+class FaultSpecError(ValueError):
+    """A malformed ``OPERATOR_FORGE_FAULTS`` spec."""
+
+
+def parse_spec(text: str) -> tuple:
+    """Parse a spec string into ``(kind, site, nth)`` triples."""
+    out = []
+    for raw_entry in text.split(","):
+        entry = raw_entry.strip()
+        if not entry:
+            continue
+        kind, sep, rest = entry.partition("@")
+        kind = kind.strip()
+        if not sep or not rest.strip():
+            raise FaultSpecError(
+                f"fault entry {entry!r} must look like kind@site[:nth]"
+            )
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r}; known: " + ", ".join(KINDS)
+            )
+        site, sep, nth_text = rest.partition(":")
+        site = site.strip()
+        if not site:
+            raise FaultSpecError(f"fault entry {entry!r} has an empty site")
+        if sep:
+            try:
+                nth = int(nth_text.strip())
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault entry {entry!r}: nth must be an integer"
+                ) from None
+            if nth < 1:
+                raise FaultSpecError(
+                    f"fault entry {entry!r}: nth must be >= 1"
+                )
+        else:
+            nth = 1
+        out.append((kind, site, nth))
+    return tuple(out)
+
+
+_lock = threading.Lock()
+_fork_child = [False]  # pool children never report unfired entries
+_forced = None  # programmatic spec override (None: follow the env var)
+# raw-text cache: the fault-free fast path is one env read + one string
+# compare per planted-site hit, no parsing and no lock
+_raw = [None]
+_active = [()]
+_hits: dict = {}
+_fired: list = []
+
+
+def _current() -> tuple:
+    raw = _forced if _forced is not None else os.environ.get(ENV_VAR, "")
+    if raw == _raw[0]:
+        return _active[0]
+    with _lock:
+        if raw != _raw[0]:
+            _active[0] = parse_spec(raw) if raw.strip() else ()
+            _hits.clear()
+            _fired.clear()
+            _raw[0] = raw
+    return _active[0]
+
+
+def configure(spec=None) -> None:
+    """Programmatic spec override (``None`` restores env selection).
+    Validates eagerly and always resets the hit counters, so a test or
+    bench leg starts every configuration from hit zero."""
+    global _forced
+    if spec is not None:
+        parse_spec(spec)  # fail here, not at the first injection site
+    with _lock:
+        _forced = spec
+        _raw[0] = None  # force re-parse (and a counter reset) next hit
+
+
+def forced_spec():
+    """The current programmatic override (shipped to pool workers)."""
+    return _forced
+
+
+def reset() -> None:
+    """Reset hit counters and the fired log, keeping the spec."""
+    with _lock:
+        _hits.clear()
+        _fired.clear()
+
+
+def enabled() -> bool:
+    return bool(_current())
+
+
+def fire(site: str, *kinds) -> tuple:
+    """Count one hit of ``site`` and return the subset of ``kinds``
+    whose counters landed on this hit (usually empty).  One call is one
+    hit however many kinds are probed, so sites with several possible
+    failures stay deterministic."""
+    active = _current()
+    if not active:
+        return ()
+    out = []
+    with _lock:
+        count = _hits.get(site, 0) + 1
+        _hits[site] = count
+        for kind, spec_site, nth in active:
+            if (
+                kind in kinds
+                and nth == count
+                and (spec_site == site or spec_site == "*")
+            ):
+                out.append(kind)
+                _fired.append((kind, site, count))
+    if out:
+        from . import metrics
+
+        metrics.counter("faults.injected").inc(len(out))
+    return tuple(out)
+
+
+def should_fire(kind: str, site: str) -> bool:
+    """Convenience wrapper for single-kind sites."""
+    return bool(fire(site, kind))
+
+
+def fired() -> tuple:
+    """The ``(kind, site, nth)`` log of injected faults, in firing
+    order — the determinism handle: same spec + same call sequence
+    means the same log, byte for byte."""
+    with _lock:
+        return tuple(_fired)
+
+
+def unfired() -> tuple:
+    """Spec entries that have not fired (yet) in this process, in spec
+    order.  Kinds are validated at parse, but sites are free strings
+    (worker map sites are caller-named), so a typo'd or never-planted
+    site cannot be rejected up front — it surfaces here instead."""
+    active = _current()
+    if not active:
+        return ()
+    log = fired()
+    return tuple(
+        (kind, site, nth)
+        for kind, site, nth in active
+        if not any(
+            f_kind == kind and f_nth == nth
+            and (site == "*" or f_site == site)
+            for f_kind, f_site, f_nth in log
+        )
+    )
+
+
+def _warn_unfired_at_exit() -> None:
+    # the loud half of the determinism story: a spec entry naming a
+    # never-planted site (or an nth above the site's traffic) parses
+    # fine and then silently injects nothing — the exact trap a chaos
+    # harness exists to avoid.  Report it on the REAL stderr (captured
+    # job output must stay byte-identical) from the process that owns
+    # the spec; forked pool children see a partial view (their counters
+    # restart from zero) and stay quiet.
+    if _fork_child[0]:
+        return
+    try:
+        pending = unfired()
+    except Exception:
+        return  # a malformed env spec already failed loudly at parse
+    if not pending:
+        return
+    import sys
+
+    stream = sys.__stderr__ or sys.stderr
+    entries = ",".join(f"{k}@{s}:{n}" for k, s, n in pending)
+    print(
+        f"operator-forge: configured fault(s) never fired: {entries} — "
+        "check the site against the planted sites (see perf/faults.py) "
+        "and the nth against the site's traffic",
+        file=stream,
+    )
+
+
+import atexit  # noqa: E402
+
+atexit.register(_warn_unfired_at_exit)
+
+
+def _reset_after_fork() -> None:
+    # a forked pool worker counts its own site hits from zero — the
+    # parent's consumed counters must not leak into the child, or the
+    # nth-hit semantics would depend on fork timing.  The lock is
+    # re-created too: fork can land while another parent thread holds
+    # it, and the child would inherit it locked forever
+    global _lock
+    _lock = threading.Lock()
+    _fork_child[0] = True
+    _hits.clear()
+    _fired.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)

@@ -28,6 +28,7 @@ the import graph acyclic.
 
 from __future__ import annotations
 
+import os
 import threading
 
 #: default latency buckets (seconds) — tuned for the serve/watch loop:
@@ -42,6 +43,20 @@ _counters: dict = {}
 _gauges: dict = {}
 _callback_gauges: dict = {}
 _histograms: dict = {}
+
+
+def _new_lock_after_fork() -> None:
+    # fork (the perf.workers process pool) can land while another
+    # parent thread holds the registry lock; the child would inherit
+    # it locked and deadlock on its first instrument update.  All
+    # instruments read the module global at call time, so reassigning
+    # is sufficient.
+    global _lock
+    _lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_new_lock_after_fork)
 
 
 class Counter:
@@ -199,6 +214,23 @@ def histogram(name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
     return inst
 
 
+def counters_snapshot() -> dict:
+    """``{name: value}`` for every counter — the cheap raw form the
+    process-pool workers use to compute per-task deltas for shipping
+    (gauges and histograms stay process-local)."""
+    with _lock:
+        return {name: c._value for name, c in _counters.items()}
+
+
+def ingest_counters(deltas: dict) -> None:
+    """Merge a worker's shipped counter deltas into this registry, so
+    events that happened inside a pool child (a quarantined cache
+    entry, a retried job) are visible in the parent's stats."""
+    for name, value in deltas.items():
+        if isinstance(value, int) and value > 0:
+            counter(name).inc(value)
+
+
 def reset() -> None:
     """Drop every instrument, callback-gauge registrations included
     (tests and bench legs re-register what they need; a leaked
@@ -254,6 +286,13 @@ def cache_report() -> dict:
             "misses": misses,
             "ratio": round(hits / total, 4) if total else 0.0,
         }
+        # the damage-attribution counts (corrupt, quarantined) ride
+        # along when present — dropping them here would leave the
+        # per-namespace records cache.py keeps unreachable from every
+        # stats surface
+        for key in sorted(counts):
+            if key not in ("hits", "misses"):
+                out[stage][key] = counts[key]
     return out
 
 

@@ -144,9 +144,12 @@ def clear_events() -> None:
 
 def _clear_events_after_fork() -> None:
     # a forked worker inherits the parent's ring by copy-on-write; its
-    # first drain must ship only events the WORKER produced
-    global _PID
+    # first drain must ship only events the WORKER produced.  The lock
+    # is re-created: fork can land while another parent thread holds
+    # it, and the child would inherit it locked forever
+    global _PID, _lock
     _PID = os.getpid()
+    _lock = threading.Lock()
     _events.clear()
     stack = getattr(_span_stack, "ids", None)
     if stack:

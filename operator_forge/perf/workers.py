@@ -43,25 +43,126 @@ Worker coordination details:
   drops all pool singletons in the child; in-worker fan-out is forced
   back to ``thread`` to keep process trees flat.
 
-Infrastructure failures (fork unavailable, broken pool, unpicklable
-task) fall back to the thread backend; since every batch job is
-deterministic and idempotent this changes wall-clock, never output.
+Self-healing (PR 7): the process backend no longer degrades silently.
+Each map round submits the pending tasks, and anything that comes back
+broken — a dead pool (``BrokenProcessPool`` after a worker crash), a
+task that blows the ``OPERATOR_FORGE_TASK_TIMEOUT`` deadline (the hung
+pool processes are killed), or a result that cannot cross the pickle
+boundary — marks the uncollected tasks failed and triggers a bounded
+deterministic retry: the pool is respawned and only the failed tasks
+re-run (``worker.retries`` / ``worker.respawns`` / ``worker.timeouts``
+metrics).  After ``OPERATOR_FORGE_TASK_RETRIES`` retries the surviving
+tasks are quarantined to in-thread execution (``worker.quarantined``)
+and the degradation is recorded: a one-shot warning on the real stderr
+(bypassing job capture, so output bytes never change), a
+``worker.degraded`` counter, a ``workers.degraded`` gauge, and the
+:func:`pool_state` surface serve ``stats`` reports.  Because every
+task is deterministic and idempotent, recovery changes wall-clock,
+never output — the chaos harness (:mod:`operator_forge.perf.faults`)
+proves it by injecting ``worker.crash`` / ``task.hang`` at the
+submission sites and asserting byte-identity with the fault-free run.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import sys
 import threading
+import time
 
-from . import n_jobs
+from . import env_number, n_jobs
 from . import cache as pf_cache
+from . import faults
 from . import spans
 
 _BACKENDS = ("thread", "process")
 DEFAULT_BACKEND = "thread"
+#: bounded deterministic retry for broken/hung/crashed rounds
+DEFAULT_TASK_RETRIES = 2
+#: deterministic backoff step between retry rounds (seconds, no jitter)
+_BACKOFF_S = 0.05
 
 _forced = None
+
+
+def task_timeout() -> float:
+    """Per-task deadline in seconds (``OPERATOR_FORGE_TASK_TIMEOUT``;
+    0 or unset disables).  Applied while collecting each process-pool
+    result; a task that exceeds it is killed with its pool and
+    retried."""
+    return env_number("OPERATOR_FORGE_TASK_TIMEOUT", 0.0)
+
+
+def task_retries() -> int:
+    """How many retry rounds a failing map gets before the surviving
+    tasks are quarantined to in-thread execution
+    (``OPERATOR_FORGE_TASK_RETRIES``, default 2)."""
+    return env_number(
+        "OPERATOR_FORGE_TASK_RETRIES", DEFAULT_TASK_RETRIES, cast=int
+    )
+
+
+def _hang_seconds() -> float:
+    """How long an injected ``task.hang`` sleeps — long enough that an
+    unkilled hang is obvious, short enough that a deadline-less test
+    run eventually finishes (``OPERATOR_FORGE_FAULT_HANG_S``)."""
+    return env_number("OPERATOR_FORGE_FAULT_HANG_S", 30.0)
+
+
+# -- degradation accounting ----------------------------------------------
+#
+# The old behavior — any infra failure silently falls back to threads —
+# hid dead pools behind unexplained slowness.  Degradation is now a
+# recorded event: metrics, a gauge, a pool_state() surface for serve
+# `stats`, and a one-shot human warning.
+
+_degraded = {"active": False, "reason": ""}
+_warned_once = [False]
+
+
+def _degrade(reason: str) -> None:
+    from . import metrics
+
+    _degraded["active"] = True
+    _degraded["reason"] = reason
+    metrics.counter("worker.degraded").inc()
+    # conftest's metrics.reset() drops registrations, so (re)register
+    # lazily at the moment the gauge becomes meaningful
+    metrics.register_gauge(
+        "workers.degraded", lambda: 1 if _degraded["active"] else 0
+    )
+    if not _warned_once[0]:
+        _warned_once[0] = True
+        # the REAL stderr: inside a captured batch/serve job the routed
+        # sys.stderr would fold this warning into the job's output and
+        # break byte-identity with a non-degraded run
+        stream = sys.__stderr__ or sys.stderr
+        print(
+            "operator-forge: process pool degraded to threads: "
+            f"{reason} (this warning prints once)",
+            file=stream,
+        )
+
+
+def pool_state() -> dict:
+    """The execution-backend surface serve ``stats`` reports: the
+    selected backend, whether the pool has degraded, and why.  The
+    degraded flag is sticky — it records that this process fell back
+    at least once — until :func:`reset_degraded` clears it."""
+    return {
+        "backend": backend(),
+        "degraded": _degraded["active"],
+        "degraded_reason": _degraded["reason"],
+    }
+
+
+def reset_degraded() -> None:
+    """Clear the sticky degradation record (tests, or an operator
+    after remediating the cause); the one-shot stderr warning stays
+    one-shot per process."""
+    _degraded["active"] = False
+    _degraded["reason"] = ""
 
 
 def backend() -> str:
@@ -112,6 +213,11 @@ _SHIPPED_ENV = (
     "OPERATOR_FORGE_PROFILE",
     "OPERATOR_FORGE_TRACE",
     "OPERATOR_FORGE_TRACE_EVENTS",
+    "OPERATOR_FORGE_FAULTS",
+    "OPERATOR_FORGE_FAULT_HANG_S",
+    "OPERATOR_FORGE_TASK_TIMEOUT",
+    "OPERATOR_FORGE_TASK_RETRIES",
+    "OPERATOR_FORGE_JOB_RETRIES",
 )
 
 
@@ -128,6 +234,9 @@ def _task_config() -> dict:
         # shipping alone would miss it, and a worker forked mid-trace
         # would otherwise keep its fork-time state forever
         "trace": spans._trace_forced,
+        # the programmatic fault-spec override (bench legs, tests) —
+        # env shipping alone would miss it
+        "faults": faults.forced_spec(),
         "gen": _reset_gen[0],
     }
 
@@ -154,6 +263,10 @@ def _apply_config(cfg: dict) -> None:
     spans.enable_tracing(cfg["trace"])
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
+    if cfg["faults"] != faults.forced_spec():
+        # only on change: configure() resets the worker's hit counters,
+        # and a per-task reset would re-fire every :1 fault forever
+        faults.configure(cfg["faults"])
     if cfg["gen"] != _worker_seen_gen[0]:
         _worker_seen_gen[0] = cfg["gen"]
         pf_cache.reset()
@@ -196,23 +309,94 @@ def _trace_payload() -> list:
     return spans.drain_events()
 
 
-def _sealed_call(cfg: dict, fn, item) -> tuple:
+# worker-side counter baseline: a forked child inherits the parent's
+# registry values by copy-on-write, so shipping raw values would
+# re-count the parent's own history — each task ships only the delta
+# since the previous shipment (or the fork)
+_shipped_counters: dict = {}
+
+
+def _baseline_counters_after_fork() -> None:
+    from . import metrics
+
+    # after-fork hooks run in registration (= import) order, so this
+    # can run BEFORE metrics' own lock-reset hook — and the inherited
+    # registry lock may be held by a parent thread that doesn't exist
+    # in the child.  Replace it first (idempotent; metrics' hook just
+    # makes another fresh lock) instead of acquiring it and deadlocking
+    metrics._new_lock_after_fork()
+    _shipped_counters.clear()
+    _shipped_counters.update(metrics.counters_snapshot())
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_baseline_counters_after_fork)
+
+
+def _counter_payload() -> dict:
+    """Counter increments this worker produced since its last shipment
+    — merged into the parent's registry on collection, so worker-side
+    events (a quarantined cache entry, a retried job) show up in serve
+    ``stats`` and the bench chaos accounting instead of dying with the
+    child's registry."""
+    from . import metrics
+
+    current = metrics.counters_snapshot()
+    deltas = {}
+    for name, value in current.items():
+        previous = _shipped_counters.get(name, 0)
+        if value > previous:
+            deltas[name] = value - previous
+    _shipped_counters.clear()
+    _shipped_counters.update(current)
+    return deltas
+
+
+def _sealed_call(cfg: dict, fn, item, inject=()) -> tuple:
     """Worker-side task wrapper: apply the parent's shipped config,
     run, seal the outcome (plus the worker's drained trace-event
     buffer).  Task exceptions are sealed as values (not raised through
     the executor), so anything that DOES raise out of a future is, by
-    construction, an infrastructure failure."""
+    construction, an infrastructure failure.
+
+    ``inject`` is the chaos harness's per-task plan, decided in the
+    parent at submission time (a retried task is a fresh submission, so
+    a consumed fault never re-fires): ``worker.crash`` dies hard before
+    any work or seal, ``task.hang`` sleeps past any deadline."""
     _apply_config(cfg)
+    for kind in inject:
+        if kind == "worker.crash":
+            os._exit(23)  # a hard child death: no seal, no result
+        if kind == "task.hang":
+            time.sleep(_hang_seconds())
     try:
-        return _seal(("ok", fn(item), _trace_payload()))
+        outcome = ("ok", fn(item))
     except BaseException as exc:
-        events = _trace_payload()
+        outcome = ("err", exc)
+    # drained exactly once, AFTER the task ran: _trace_payload and
+    # _counter_payload consume their baselines, so draining them inside
+    # a seal attempt that then fails to pickle would ship a second,
+    # empty drain on the err path — the task's spans and counter
+    # increments would silently never reach the parent
+    events = _trace_payload()
+    counters = _counter_payload()
+    if outcome[0] == "ok":
         try:
-            return _seal(("err", exc, events))
-        except Exception:  # the exception itself didn't pickle
-            return _seal(("err", RuntimeError(
-                f"{type(exc).__name__}: {exc}"
-            ), events))
+            return _seal(("ok", outcome[1], events, counters))
+        except BaseException as exc:
+            # the RESULT didn't pickle.  That is not the task's own
+            # error (the task succeeded) and not a pool failure either:
+            # ship it as its own kind so the parent quarantines the
+            # task to threads — where the result never has to cross a
+            # pickle boundary and the map can still succeed
+            outcome = ("unsealable", exc)
+    kind = outcome[0]  # "err" or "unsealable" from here on
+    try:
+        return _seal((kind, outcome[1], events, counters))
+    except Exception:  # the exception itself didn't pickle
+        return _seal((kind, RuntimeError(
+            f"{type(outcome[1]).__name__}: {outcome[1]}"
+        ), events, counters))
 
 
 class _TaskFailure(Exception):
@@ -254,11 +438,52 @@ _proc_pool = None
 _proc_size = 0
 
 
+#: strong references to discarded executors — ``(pool, manager
+#: thread)`` pairs held until each manager thread has exited.  CPython
+#: 3.10's ProcessPoolExecutor registers a weakref callback that
+#: acquires the manager thread's shutdown_lock; the manager holds that
+#: lock around its wakeup-pipe clear, which it re-enters on every
+#: poll.  If the executor is garbage-collected while the manager is
+#: inside that critical section (GC can run on any thread, including
+#: the manager itself mid-clear), the callback deadlocks against the
+#: held lock and wedges every later joiner — including interpreter
+#: exit.  Holding a reference until the thread is done means the
+#: callback can never fire while the lock can be held.  The thread is
+#: captured eagerly because ``shutdown()`` nulls the executor's
+#: ``_executor_manager_thread`` attribute immediately — so
+#: :func:`_retire_pool` must run BEFORE the pool's ``shutdown()``.
+_retired_pools: list = []
+
+#: child-side keep-alive: a forked worker inherits copies of the
+#: parent's executors AND (possibly) a shutdown_lock the parent's
+#: manager thread held at fork time — locked forever in the child.
+#: Dropping those copies would let the child's GC fire the weakref
+#: callback and wedge on that dead lock, so they are kept reachable
+#: for the child's lifetime instead.
+_inherited_pools: list = []
+
+
+def _retire_pool(pool) -> None:
+    thread = getattr(pool, "_executor_manager_thread", None)
+    if thread is not None and thread.is_alive():
+        _retired_pools.append((pool, thread))
+    _retired_pools[:] = [
+        (p, t) for p, t in _retired_pools if t.is_alive()
+    ]
+
+
 def _forget_pools_after_fork() -> None:
     # a forked child inherits the executor objects but not their
-    # threads/processes; using one would hang forever
-    global _proc_pool, _proc_size
+    # threads/processes; using one would hang forever.  The lock is
+    # re-created too: fork can land while another parent thread holds
+    # it, and the child would inherit it locked forever
+    global _proc_pool, _proc_size, _pool_lock
+    _pool_lock = threading.Lock()
     _fan_pools.clear()
+    if _proc_pool is not None:
+        _inherited_pools.append(_proc_pool)
+    _inherited_pools.extend(p for p, _t in _retired_pools)
+    _retired_pools.clear()
     _proc_pool = None
     _proc_size = 0
 
@@ -269,15 +494,37 @@ if hasattr(os, "register_at_fork"):
 
 def _shutdown_pools() -> None:
     # orderly teardown; letting interpreter finalization collect a live
-    # ProcessPoolExecutor prints spurious weakref tracebacks
+    # ProcessPoolExecutor prints spurious weakref tracebacks.  The wait
+    # is bounded: a worker hung in a task (no deadline configured) must
+    # not wedge process exit — after the grace period it is terminated,
+    # which also unblocks concurrent.futures' own atexit join
     global _proc_pool
     with _pool_lock:
         for pool in _fan_pools.values():
             pool.shutdown(wait=False)
         _fan_pools.clear()
-        if _proc_pool is not None:
-            _proc_pool.shutdown(wait=True)
-            _proc_pool = None
+        pool, _proc_pool = _proc_pool, None
+        if pool is not None:
+            _retire_pool(pool)  # under _pool_lock, before shutdown()
+    if pool is None:
+        return
+    # capture the children BEFORE shutdown(): it nulls pool._processes,
+    # which would make the bounded join below a silent no-op — and the
+    # hung-worker wedge this exists to prevent would be back
+    procs = list((getattr(pool, "_processes", None) or {}).values())
+    pool.shutdown(wait=False)
+    deadline = time.monotonic() + 5.0
+    for proc in procs:
+        try:
+            proc.join(max(0.0, deadline - time.monotonic()))
+        except Exception:
+            pass
+    for proc in procs:
+        try:
+            if proc.is_alive():
+                proc.terminate()
+        except Exception:
+            pass
 
 
 import atexit  # noqa: E402
@@ -312,6 +559,7 @@ def _process_pool():
     with _pool_lock:
         if _proc_pool is None or _proc_size != jobs:
             if _proc_pool is not None:
+                _retire_pool(_proc_pool)  # before shutdown() nulls it
                 _proc_pool.shutdown(wait=False)
             # fork (not spawn): workers inherit warm module/caches state
             # and the loaded sys.modules task functions pickle against
@@ -328,9 +576,35 @@ def _discard_process_pool() -> None:
     global _proc_pool, _proc_size
     with _pool_lock:
         if _proc_pool is not None:
+            _retire_pool(_proc_pool)  # before shutdown() nulls it
             _proc_pool.shutdown(wait=False)
         _proc_pool = None
         _proc_size = 0
+
+
+def _kill_process_pool() -> None:
+    """Terminate the pool's worker processes and discard the pool.  A
+    hung task never returns, so ``shutdown(wait=False)`` alone would
+    leave its process running (and holding memory) forever — the
+    deadline path needs a hard kill before the respawn."""
+    global _proc_pool, _proc_size
+    with _pool_lock:
+        pool = _proc_pool
+        _proc_pool = None
+        _proc_size = 0
+        if pool is not None:
+            _retire_pool(pool)  # under _pool_lock, before shutdown()
+    if pool is None:
+        return
+    for proc in list((getattr(pool, "_processes", None) or {}).values()):
+        try:
+            proc.terminate()
+        except Exception:
+            pass
+    try:
+        pool.shutdown(wait=False)
+    except Exception:
+        pass
 
 
 def _infra_errors() -> tuple:
@@ -346,71 +620,303 @@ def _infra_errors() -> tuple:
     )
 
 
+#: the subset of infra failures (by :func:`_collect_round`'s recorded
+#: type name) that are deterministic properties of the task or its
+#: payload — serialization and import/attribute lookup at the pickle
+#: boundary.  They fail identically on every respawn-and-rerun, unlike
+#: pool deaths (BrokenProcessPool/EOFError/BrokenPipeError) and blown
+#: deadlines, which retries exist for.
+_NON_RETRYABLE_INFRA = ("PicklingError", "AttributeError", "ImportError")
+
+
 def _thread_map(fn, items, jobs: int) -> list:
     pool = _thread_pool(jobs)
     futures = [pool.submit(fn, item) for item in items]
     return [future.result() for future in futures]
 
 
-def _process_map(pool, fn, items) -> list:
+def _deadline_map(fn, items, deadline: float) -> list:
+    """Serial in-process execution with the per-task deadline kept: one
+    daemon thread per task, joined against the deadline.  A thread
+    cannot be killed, but a daemon one cannot wedge process exit either
+    — the task is abandoned and the deadline surfaces as
+    ``TimeoutError`` instead of the caller blocking forever on a task
+    that already proved it hangs."""
+    out = []
+    for item in items:
+        box: dict = {}
+
+        def run(_box=box, _item=item):
+            try:
+                _box["out"] = fn(_item)
+            except BaseException as exc:  # re-raised on the caller
+                _box["exc"] = exc
+
+        thread = threading.Thread(
+            target=run, daemon=True, name="quarantined-task"
+        )
+        thread.start()
+        thread.join(deadline)
+        if thread.is_alive():
+            raise TimeoutError(
+                "quarantined task exceeded OPERATOR_FORGE_TASK_TIMEOUT "
+                f"({deadline:g}s) in-thread"
+            )
+        if "exc" in box:
+            raise box["exc"]
+        out.append(box["out"])
+    return out
+
+
+def _collect_round(pool, fn, pending, site: str, deadline: float):
+    """Submit one round of ``(index, item)`` tasks and collect in
+    order.  Returns ``(completed, failed, task_error, broken_reason,
+    unsealable)``: ``completed`` maps index -> payload, ``failed``
+    lists the tasks to retry, ``task_error`` is a task's own
+    (deterministic) exception — never retried — ``broken_reason`` says
+    what killed the round, and ``unsealable`` lists ``(index, item,
+    exc)`` tasks that SUCCEEDED in the child but whose result could not
+    cross the pickle boundary (quarantine-bound: a pool re-run fails
+    identically)."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
     from . import metrics
 
     cfg = _task_config()
     queue_depth = metrics.gauge("workers.queue_depth")
-    metrics.counter("workers.tasks_submitted").inc(len(items))
-    queue_depth.add(len(items))
-    done = 0
+    metrics.counter("workers.tasks_submitted").inc(len(pending))
+    queue_depth.add(len(pending))
     try:
         futures = [
-            pool.submit(_sealed_call, cfg, fn, item) for item in items
+            (
+                index,
+                item,
+                pool.submit(
+                    _sealed_call, cfg, fn, item,
+                    faults.fire(site, "worker.crash", "task.hang"),
+                ),
+            )
+            for index, item in pending
         ]
-        out = []
-        for future in futures:
-            kind, payload, events = _unseal(future.result())
-            done += 1
-            queue_depth.add(-1)  # live backlog, not batch size
-            metrics.counter("workers.tasks_completed").inc()
-            # merge the worker's timeline into the parent's ring: one
-            # Chrome trace then covers serial, thread, and process runs
-            spans.ingest_events(events)
-            if kind == "err":
-                raise _TaskFailure(payload)
-            out.append(payload)
-        return out
+    except Exception as exc:
+        # submission itself failed (the pool broke between creation
+        # and submit): nothing ran, everything stays pending
+        queue_depth.add(-len(pending))
+        _discard_process_pool()
+        return (
+            {}, list(pending), None, f"submit: {type(exc).__name__}", []
+        )
+    completed: dict = {}
+    failed: list = []
+    unsealable: list = []
+    task_error = None
+    broken = None
+    processed = 0
+    try:
+        for index, item, future in futures:
+            if broken is not None or task_error is not None:
+                # the pool is gone (or a task raised): the rest of the
+                # round cannot be trusted to complete — but a future
+                # that finished BEFORE the break still holds a good
+                # sealed result; harvest it instead of re-running its
+                # task next round
+                harvested = False
+                if task_error is None and future.done():
+                    try:
+                        kind, payload, events, counters = _unseal(
+                            future.result(0)
+                        )
+                        if kind != "unsealable":
+                            # see the main collection path: the
+                            # in-thread re-run is authoritative
+                            spans.ingest_events(events)
+                            metrics.ingest_counters(counters)
+                        if kind == "err":
+                            task_error = _TaskFailure(payload)
+                        elif kind == "unsealable":
+                            unsealable.append((index, item, payload))
+                        else:
+                            completed[index] = payload
+                            metrics.counter(
+                                "workers.tasks_completed"
+                            ).inc()
+                        harvested = True
+                    except Exception:
+                        pass  # broken future: falls through to failed
+                if not harvested:
+                    failed.append((index, item))
+                processed += 1
+                queue_depth.add(-1)
+                continue
+            try:
+                kind, payload, events, counters = _unseal(
+                    future.result(deadline if deadline > 0 else None)
+                )
+                # merge the worker's timeline into the parent's ring:
+                # one Chrome trace covers serial/thread/process runs.
+                # Not for an unsealable result: its task re-runs
+                # in-thread as the authoritative execution, so
+                # ingesting the child's shipment too would double-count
+                # the task's counters and duplicate its spans
+                if kind != "unsealable":
+                    spans.ingest_events(events)
+                    metrics.ingest_counters(counters)
+                if kind == "err":
+                    task_error = _TaskFailure(payload)
+                elif kind == "unsealable":
+                    unsealable.append((index, item, payload))
+                else:
+                    completed[index] = payload
+                    metrics.counter("workers.tasks_completed").inc()
+            except FuturesTimeout:
+                metrics.counter("worker.timeouts").inc()
+                _kill_process_pool()  # a hung child must die, not linger
+                failed.append((index, item))
+                broken = "task deadline exceeded"
+            except _infra_errors() as exc:
+                _discard_process_pool()
+                failed.append((index, item))
+                broken = type(exc).__name__
+            processed += 1
+            queue_depth.add(-1)
     finally:
-        # a task/infra error abandons the remaining futures; the gauge
-        # must not leak their depth
-        queue_depth.add(-(len(items) - done))
+        # an unexpected raise (e.g. result authentication failure) must
+        # not leak the unprocessed futures' depth
+        queue_depth.add(-(len(futures) - processed))
+    return completed, failed, task_error, broken, unsealable
 
 
-def map_ordered(fn, items) -> list:
+def _process_map_resilient(fn, items, jobs: int, site: str) -> list:
+    """The self-healing process-pool driver: submit, collect, and on
+    infra failure (dead pool, blown deadline, unpicklable result)
+    respawn the pool and retry only the failed tasks — bounded and
+    deterministic.  Tasks that survive every retry are quarantined to
+    in-thread execution; either way the caller gets the full result
+    list in input order."""
+    from . import metrics
+
+    results: dict = {}
+    pending = list(enumerate(items))
+    retries = task_retries()
+    deadline = task_timeout()
+    attempt = 0
+    broken = None
+    # did any round actually run tasks and break?  Only then can a
+    # hanger be hiding among the survivors (even a pickle-boundary
+    # round may conceal one behind the first recorded breakage); a
+    # pool that never started leaves every task unsuspected
+    ran_and_broke = False
+    while pending:
+        try:
+            pool = _process_pool()
+        except Exception as exc:
+            # fork unsupported or worker startup failed; nothing ran
+            # yet, so the thread fallback below takes the whole map
+            _degrade(f"pool start failed: {type(exc).__name__}: {exc}")
+            break
+        completed, failed, task_error, broken, unsealable = (
+            _collect_round(pool, fn, pending, site, deadline)
+        )
+        results.update(completed)
+        if task_error is not None:
+            # the task's own exception, verbatim: deterministic jobs
+            # fail identically on retry, so surface it immediately
+            raise task_error.cause
+        if unsealable:
+            # the task SUCCEEDED in the child but its result cannot
+            # cross the pickle boundary — a deterministic property of
+            # the output, so a pool re-run fails identically.  Run it
+            # in-thread, where the result never has to pickle; the task
+            # provably ran to completion in the child, so it is not a
+            # hang suspect and needs no deadline
+            sample = unsealable[0][2]
+            metrics.counter("worker.quarantined").inc(len(unsealable))
+            _degrade(
+                f"{len(unsealable)} task(s) returned results that "
+                "cannot cross the pickle boundary "
+                f"({type(sample).__name__}: {sample}); quarantined to "
+                "in-thread execution"
+            )
+            outputs = _thread_map(
+                fn, [item for _index, item, _exc in unsealable],
+                max(1, min(jobs, len(unsealable))),
+            )
+            for (index, _item, _exc), output in zip(unsealable, outputs):
+                results[index] = output
+        if not failed:
+            pending = []
+            break
+        pending = failed
+        ran_and_broke = True
+        if broken in _NON_RETRYABLE_INFRA:
+            # serialization / import-lookup failures at the pickle
+            # boundary are deterministic properties of the task or its
+            # payload: every respawn-and-rerun fails identically, so
+            # burning the retry budget (pool forks, backoff sleeps,
+            # full re-execution) is pure waste — quarantine now
+            metrics.counter("worker.quarantined").inc(len(pending))
+            _degrade(
+                f"{len(pending)} task(s) failed at the pickle boundary "
+                f"({broken}); quarantined to in-thread execution"
+            )
+            break
+        attempt += 1
+        if attempt > retries:
+            metrics.counter("worker.quarantined").inc(len(pending))
+            _degrade(
+                f"{len(pending)} task(s) unrecovered after {retries} "
+                f"retr{'y' if retries == 1 else 'ies'} ({broken}); "
+                "quarantined to in-thread execution"
+            )
+            break
+        metrics.counter("worker.retries").inc(len(failed))
+        metrics.counter("worker.respawns").inc()
+        time.sleep(_BACKOFF_S * attempt)  # deterministic, no jitter
+    if pending:
+        # poison-task quarantine / degraded fallback: the survivors run
+        # on threads in this process — deterministic and idempotent, so
+        # output is identical, just without multicore scaling.  When a
+        # round actually ran and broke, a deadline (if configured) is
+        # kept — regardless of what broke the final round: a crashing
+        # sibling can report the round as BrokenProcessPool while a
+        # survivor is still the hanger, and an unbounded fallback would
+        # wedge this thread forever, the exact dead loop the deadline
+        # exists to prevent — so a hang surfaces as TimeoutError.  A
+        # pool that never STARTED is different: no task ever ran, none
+        # is suspect, and the serial deadline map would silently turn
+        # an N-way batch into 1-way — that path keeps the parallel
+        # thread fallback (the thread backend's own semantics, which
+        # never applies the per-task deadline)
+        if deadline > 0 and ran_and_broke:
+            outputs = _deadline_map(
+                fn, [item for _index, item in pending], deadline
+            )
+        else:
+            outputs = _thread_map(
+                fn, [item for _index, item in pending],
+                max(1, min(jobs, len(pending))),
+            )
+        for (index, _item), output in zip(pending, outputs):
+            results[index] = output
+    return [results[index] for index in range(len(items))]
+
+
+def map_ordered(fn, items, site: str = "task") -> list:
     """Ordered map over ``items`` through the selected backend.
 
     ``fn`` must be a module-level callable and ``items`` picklable when
     the ``process`` backend is active (they cross the fork boundary);
     the ``thread``/serial paths have no such requirement.  One job (or
-    one item) short-circuits to the plain serial loop.
+    one item) short-circuits to the plain serial loop.  ``site`` names
+    this map's fault-injection site (see
+    :mod:`operator_forge.perf.faults`); worker-directed faults are
+    planned per submission in the parent, so they only apply to the
+    ``process`` backend.
     """
     items = list(items)
     jobs = min(n_jobs(), len(items))
     if jobs <= 1 or len(items) <= 1:
         return [fn(item) for item in items]
     if backend() == "process":
-        try:
-            pool = _process_pool()
-        except Exception:
-            # fork unsupported or worker startup failed; nothing ran
-            # yet, so threads take the whole map
-            return _thread_map(fn, items, jobs)
-        try:
-            return _process_map(pool, fn, items)
-        except _TaskFailure as failure:
-            raise failure.cause  # the task's own error, verbatim
-        except _infra_errors():
-            # the pool died or the task didn't pickle: jobs are
-            # deterministic and idempotent, so re-running on threads
-            # yields the identical result, just without multicore
-            # scaling
-            _discard_process_pool()
-            return _thread_map(fn, items, jobs)
+        return _process_map_resilient(fn, items, jobs, site)
     return _thread_map(fn, items, jobs)

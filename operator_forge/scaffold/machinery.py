@@ -16,12 +16,103 @@ from __future__ import annotations
 
 import enum
 import os
+import re
+import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
 from ..perf import parallel_map, spans
 
 MARKER_PREFIX = "+operator-builder:scaffold:"
+
+#: directories already swept for stale publish temps, once per process
+#: (a per-publish glob would rescan a growing directory for every file
+#: written — O(entries²) on the cold codegen path the <1% overhead
+#: bars guard).  Unlocked on purpose: a racing double-sweep is two
+#: harmless listdir/remove passes (ENOENT is swallowed), and after a
+#: fork the inherited entries stay valid — the parent already swept
+#: them.
+_swept_dirs: set = set()
+#: the suffix carries a tool-unique marker on purpose: the sweeper may
+#: only ever match its OWN litter — a bare ``.tmp-<pid>-<tid>`` would
+#: also match (and delete) a user's unrelated file that happens to fit
+#: the pattern in a tree the scaffold publishes into
+_TMP_MARKER = ".operator-forge-tmp"
+_STALE_TMP = re.compile(re.escape(_TMP_MARKER) + r"-(\d+)-\d+$")
+
+
+def _pid_alive(pid: int) -> bool:
+    """Whether ``pid`` is a live process.  ``EPERM`` means alive but
+    owned by someone else; only a definite ``ProcessLookupError`` (or
+    an impossible pid) reads as dead."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+def _sweep_stale_temps(directory: str) -> None:
+    """Remove write-sideways temps a hard-killed attempt left behind
+    (they never reached their ``os.replace``).  Only temps from OTHER,
+    DEAD pids are stale: parallel per-file writes publish siblings into
+    the same directory concurrently, so a same-pid temp is in-flight by
+    definition (thread death without process death runs _publish's
+    cleanup path), and an other-pid temp whose writer is still running
+    (two terminals publishing into one tree, a detached serve handler)
+    is in-flight too — removing it would fail that process's
+    ``os.replace``.  Pid recycling can make true litter look alive;
+    that litter just waits for a later sweep, which is fine — temps are
+    never adopted (SKIP policies check the target path and publishes
+    are atomic), so one sweep on first contact with each directory is
+    enough, and litter from THIS process dying lands in the next
+    process's first sweep."""
+    if directory in _swept_dirs:
+        return
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        # not created yet (or transiently unlistable): nothing swept,
+        # so don't latch — the next publish retries the listing
+        return
+    _swept_dirs.add(directory)
+    own_pid = str(os.getpid())
+    for name in entries:
+        match = _STALE_TMP.search(name)
+        if (
+            match
+            and match.group(1) != own_pid
+            and not _pid_alive(int(match.group(1)))
+        ):
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
+def _publish(target: str, content: str) -> None:
+    """Atomically publish ``content`` at ``target``: write sideways,
+    then rename.  A write interrupted mid-stream (a crashed worker, a
+    pool teardown killing its siblings, a hard process kill) must never
+    leave a torn file behind — a preserve-on-exists policy or a
+    crash-retried batch group would adopt it, breaking the recovery
+    byte-identity contract."""
+    _sweep_stale_temps(os.path.dirname(target) or ".")
+    tmp = f"{target}{_TMP_MARKER}-{os.getpid()}-{threading.get_ident()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as handle:
+            handle.write(content)
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class ScaffoldError(Exception):
@@ -167,8 +258,7 @@ class Scaffold:
                 pass
         else:
             self._ensure_dir(os.path.dirname(target))
-        with open(target, "w", encoding="utf-8") as handle:
-            handle.write(content)
+        _publish(target, content)
         return ("written", spec.path, None)
 
     def _record(self, outcome: tuple) -> None:
@@ -244,5 +334,4 @@ class Scaffold:
         indent = lines[marker_idx][: len(lines[marker_idx]) - len(lines[marker_idx].lstrip())]
         inserted = [indent + l if l.strip() else l for l in code.split("\n")]
         lines[marker_idx:marker_idx] = inserted
-        with open(target, "w", encoding="utf-8") as handle:
-            handle.write("\n".join(lines))
+        _publish(target, "\n".join(lines))

@@ -73,8 +73,22 @@ def plan_groups(jobs) -> list:
     return [g["jobs"] for g in groups]
 
 
-def _run_group(group) -> list:
-    # module-level so the process backend can pickle it by reference
+def _run_group(payload) -> list:
+    """Execute one scheduling group; module-level so the process
+    backend can pickle it by reference.  ``fresh_roots`` are output
+    roots that did not exist when the batch started: a group can be
+    re-executed (the workers layer retries tasks whose worker crashed,
+    hung past the deadline, or was torn down with a broken pool), and a
+    dead attempt may have left a partial tree behind — scaffolding's
+    preserve-on-exists semantics must never adopt it, so every
+    execution starts those roots from scratch.  Pre-existing roots are
+    left alone: regenerating over them is already convergent (partial
+    rewrites are re-written on retry because their bytes differ)."""
+    import shutil
+
+    group, fresh_roots = payload
+    for root in fresh_roots:
+        shutil.rmtree(root, ignore_errors=True)
     return run_group(group)
 
 
@@ -82,8 +96,18 @@ def run_batch(jobs) -> list:
     """Run every job; returns :class:`JobResult` objects in input
     order regardless of how groups were scheduled."""
     groups = plan_groups(jobs)
+    payloads = []
+    for group in groups:
+        fresh_roots = []
+        for job in group:
+            for root in job.writes():
+                if root not in fresh_roots and not os.path.isdir(root):
+                    fresh_roots.append(root)
+        payloads.append((group, fresh_roots))
     with spans.span("serve.batch"):
-        per_group = workers.map_ordered(_run_group, groups)
+        per_group = workers.map_ordered(
+            _run_group, payloads, site="batch.group"
+        )
     by_index = {
         result.index: result
         for results in per_group

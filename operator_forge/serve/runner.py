@@ -48,11 +48,24 @@ import time
 
 from .. import __version__
 from ..perf import cache as pf_cache
-from ..perf import metrics, spans
+from ..perf import env_number, faults, metrics, spans
 from .jobs import Job, JobResult
 
 _STAGE = "serve.job"
 _SCHEMA = 1
+
+#: bounded deterministic retry for exceptions that escape a job's own
+#: error handling (``OPERATOR_FORGE_JOB_RETRIES``) — a job that *fails*
+#: (nonzero rc) is a result and is never retried; a job that *raises*
+#: is plausibly transient (injected faults, I/O hiccups) and gets
+#: re-run on fresh capture buffers before being reported as rc 1
+DEFAULT_JOB_RETRIES = 2
+
+
+def job_retries() -> int:
+    return env_number(
+        "OPERATOR_FORGE_JOB_RETRIES", DEFAULT_JOB_RETRIES, cast=int
+    )
 
 
 class _ThreadRouter(io.TextIOBase):
@@ -83,6 +96,18 @@ _capture_lock = threading.Lock()
 _capture_depth = 0
 _router_out = None
 _router_err = None
+
+
+def _new_capture_lock_after_fork() -> None:
+    # fork (the perf.workers process pool) can land while a parent
+    # thread holds the capture lock; the child would inherit it locked
+    # and deadlock installing its own capture
+    global _capture_lock
+    _capture_lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_new_capture_lock_after_fork)
 
 
 @contextlib.contextmanager
@@ -167,19 +192,50 @@ def run_job(job: Job) -> JobResult:
             )
 
     started = time.perf_counter()
-    with spans.span(
-        f"serve.job:{job.command}", args={"job": job.id}
-    ), _captured() as (
-        out_buf, err_buf
-    ):
-        try:
-            rc = cli_main(job.argv())
-        except SystemExit as exc:  # argparse rejection of a bad spec
-            code = exc.code
-            rc = code if isinstance(code, int) else (0 if code is None else 1)
-        except Exception as exc:  # one job must never take down a batch
-            err_buf.write(f"internal error: {exc}\n")
-            rc = 1
+    retries = job_retries()
+    attempt = 0
+    while True:
+        # fresh capture buffers per attempt: a retried job's output
+        # must be byte-identical to a first-try success, with no
+        # residue from the failed attempt
+        with spans.span(
+            f"serve.job:{job.command}", args={"job": job.id}
+        ), _captured() as (
+            out_buf, err_buf
+        ):
+            try:
+                if faults.should_fire("job.fail", "serve.job"):
+                    raise RuntimeError(
+                        "injected fault: job.fail@serve.job"
+                    )
+                rc = cli_main(job.argv())
+                break
+            except SystemExit as exc:  # argparse rejection of a bad spec
+                code = exc.code
+                rc = code if isinstance(code, int) else (
+                    0 if code is None else 1
+                )
+                break
+            except Exception as exc:
+                # one job must never take down a batch — and an escaped
+                # exception (unlike a nonzero rc) is plausibly
+                # transient, so it earns a bounded deterministic retry.
+                # TimeoutError is the exception to that: it is the
+                # workers layer's verdict that a task hangs on every
+                # attempt (its own retry/respawn/quarantine budget is
+                # already spent proving it), so re-running the whole
+                # job would multiply the full deadline wait and leak
+                # more abandoned daemon threads for the same outcome
+                if attempt < retries and not isinstance(
+                    exc, TimeoutError
+                ):
+                    attempt += 1
+                    metrics.counter("serve.job.retries").inc()
+                    time.sleep(0.01 * attempt)  # deterministic backoff
+                    continue
+                err_buf.write(f"internal error: {exc}\n")
+                rc = 1
+                break
     result = JobResult(
         id=job.id, command=job.command, rc=rc,
         stdout=out_buf.getvalue(), stderr=err_buf.getvalue(),

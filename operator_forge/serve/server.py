@@ -35,36 +35,149 @@ the response, never interleaved with the protocol stream):
   recorded change set answers "why did the last cycle recompute?";
 - ``{"op": "shutdown"}`` — acknowledge and exit 0 (EOF does the same).
 
-Malformed lines answer ``{"ok": false, "error": ...}`` and the loop
-continues; a request's ``id`` is echoed in its response so pipelined
-clients can correlate.  Relative job paths resolve against the server's
-working directory.
+Malformed lines answer ``{"ok": false, "error": ..., "error_kind":
+...}`` and the loop continues; a request's ``id`` is echoed in its
+response so pipelined clients can correlate.  Relative job paths
+resolve against the server's working directory.
+
+Robustness (PR 7):
+
+- **error taxonomy** — every error response carries ``error_kind``
+  (``bad_request`` / ``timeout`` / ``infra`` / ``internal``), and each
+  is counted in the metrics registry as ``serve.errors.<kind>`` —
+  surfaced by ``stats`` so operators see *what class* of failures a
+  resident server has absorbed, not just that it kept answering;
+- **per-request deadlines** — with ``OPERATOR_FORGE_SERVE_TIMEOUT``
+  set (seconds), a request that exceeds it is answered with a
+  ``timeout`` error and abandoned.  Abandonment is output suppression
+  plus unwind-at-next-emit, not thread cancellation: a streaming
+  handler (``watch``) unwinds at its next cycle, but a non-streaming
+  one (``job``/``batch``) runs to completion detached and may still
+  be writing its output tree — treat a timeout answer as "outcome
+  unknown", not "not executed", and don't immediately re-submit the
+  same job over the same output dir.  The detached handler also still
+  shares this process's worker pool and global cache/config state: if
+  one of its tasks later blows the task deadline it kills the shared
+  pool, breaking a live handler's round mid-collection (the live
+  request still recovers through the workers layer's retry path, at
+  retry cost and possibly a degraded record) — so a serve deadline
+  paired with a much longer task deadline is a misconfiguration;
+  keep ``OPERATOR_FORGE_TASK_TIMEOUT`` at or below
+  ``OPERATOR_FORGE_SERVE_TIMEOUT`` when both are set;
+- **graceful shutdown** — SIGTERM/SIGINT (or
+  :func:`request_shutdown`) drains: the in-flight request finishes and
+  is answered, a final ``{"op": "shutdown", "drained": true}`` line is
+  emitted, and the loop exits 0 without taking further work;
+- the ``stats`` op additionally reports the worker-pool state
+  (``workers``: backend, degraded flag, reason).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
 from .. import __version__
-from ..perf import metrics, spans
+from ..perf import env_number, metrics, spans
 from ..perf.depgraph import GRAPH
 from .batch import run_batch
 from .jobs import BatchManifestError, jobs_from_specs
 from .runner import run_job
 
+#: error taxonomy: why did a request fail?
+#: - ``bad_request`` — the client sent something unusable (bad JSON,
+#:   unknown op, invalid manifest/params)
+#: - ``timeout`` — the per-request deadline expired
+#: - ``infra`` — the execution substrate failed (dead process pool,
+#:   pickle transport, I/O)
+#: - ``internal`` — an unclassified server-side bug
+ERROR_KINDS = ("bad_request", "timeout", "infra", "internal")
 
-def _error(message: str, req_id=None) -> dict:
-    out = {"ok": False, "error": message}
+
+class _AbandonedRequest(Exception):
+    """Raised inside a deadline-abandoned handler's emit to unwind it
+    (streaming ops like ``watch`` would otherwise run forever after
+    their client already got the timeout answer)."""
+
+
+_drain = threading.Event()
+
+
+class _DrainSignal(BaseException):
+    """Raised *from the signal handler* to break an idle loop out of
+    its blocking stdin read.  After a Python-level handler returns,
+    the interrupted ``read`` syscall is transparently restarted (PEP
+    475), so merely setting the drain flag would leave an idle server
+    blocked — unkillable by SIGTERM/SIGINT — until the next request
+    line arrives.  ``BaseException`` so the loop's per-request
+    ``except Exception`` catch-alls can't swallow it."""
+
+
+#: is a request currently being dispatched/answered?  Written only by
+#: the loop's main thread; read by the signal handler (which runs on
+#: that same thread, between bytecodes) to decide whether raising
+#: :class:`_DrainSignal` would abort in-flight work.
+_busy = [False]
+
+
+def request_shutdown(signum=None, frame=None) -> None:
+    """Begin a graceful shutdown: the loop finishes (drains) the
+    in-flight request, answers it, emits a final drained-shutdown
+    line, and exits 0.  Installed as the SIGTERM/SIGINT handler by
+    :func:`serve_loop`; safe to call programmatically from any
+    thread.  As a *signal handler* on an idle loop it additionally
+    raises to interrupt the blocking read — only on the first signal
+    (a repeated SIGTERM during the drained exit must not break the
+    final protocol line mid-write) and only when no request is in
+    flight (aborting one would violate the drain promise)."""
+    already = _drain.is_set()
+    _drain.set()
+    if signum is not None and not already and not _busy[0]:
+        raise _DrainSignal()
+
+
+def request_timeout() -> float:
+    """Per-request deadline in seconds (``OPERATOR_FORGE_SERVE_TIMEOUT``;
+    0 or unset disables)."""
+    return env_number("OPERATOR_FORGE_SERVE_TIMEOUT", 0.0)
+
+
+def _classify(exc: BaseException) -> str:
+    """Map an escaped exception onto the error taxonomy."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    if isinstance(exc, TimeoutError):
+        return "timeout"
+    if isinstance(
+        exc,
+        (BrokenProcessPool, BrokenPipeError, ConnectionError,
+         EOFError, OSError, MemoryError),
+    ):
+        return "infra"
+    return "internal"
+
+
+def _error(message: str, req_id=None, kind: str = "bad_request") -> dict:
+    if kind not in ERROR_KINDS:
+        # the taxonomy is closed — clients and the serve.errors.<kind>
+        # counters key on it — so a drifted kind is itself an
+        # unclassified server-side bug
+        kind = "internal"
+    out = {"ok": False, "error": message, "error_kind": kind}
     if req_id is not None:
         out["id"] = req_id
     return out
 
 
-def _handle(req: dict, base_dir: str, emit=None) -> tuple:
+def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
     """Dispatch one request; returns (response dict, keep_going).
-    ``emit`` delivers the intermediate lines of streaming ops (watch)."""
+    ``emit`` delivers the intermediate lines of streaming ops (watch);
+    ``abandoned`` (an Event) tells a long-polling op its client already
+    received a deadline answer, so it must stop instead of waiting for
+    its next emit to unwind it — a quiet-tree watch may never emit."""
     op = req.get("op") or ("job" if "command" in req else None)
     req_id = req.get("id")
     if op == "ping":
@@ -72,6 +185,8 @@ def _handle(req: dict, base_dir: str, emit=None) -> tuple:
     if op == "shutdown":
         return ({"ok": True, "op": "shutdown"}, False)
     if op == "stats":
+        from ..perf import workers
+
         return (
             {"ok": True, "op": "stats", "cache": metrics.cache_report(),
              "graph": GRAPH.counters(),
@@ -80,7 +195,8 @@ def _handle(req: dict, base_dir: str, emit=None) -> tuple:
                  "last_invalidation": GRAPH.last_invalidation(),
                  "recorded": GRAPH.provenance(),
              },
-             "spans": spans.snapshot()},
+             "spans": spans.snapshot(),
+             "workers": workers.pool_state()},
             True,
         )
     if op == "explain":
@@ -142,9 +258,43 @@ def _handle(req: dict, base_dir: str, emit=None) -> tuple:
             if emit is not None:
                 emit(payload)
 
+        try:
+            interval = float(req.get("interval", 0.5))
+        except (TypeError, ValueError):
+            return (_error("watch: interval must be a number", req_id),
+                    True)
+        if not (0 < interval < float("inf")):  # rejects NaN too
+            # a zero/negative interval would make drain_aware_poll a
+            # zero-sleep busy loop (its deadline is already expired on
+            # every call), and NaN would raise out of time.sleep
+            # mid-watch — both answer as bad_request instead
+            return (_error("watch: interval must be a positive number",
+                           req_id), True)
+
+        def drain_aware_poll() -> bool:
+            # a shutdown signal landing while this (busy) op runs only
+            # sets the drain flag — raising would abort in-flight work
+            # — so the watch must observe it itself between polls, or a
+            # quiet tree would keep the server unkillable forever.  The
+            # same goes for deadline abandonment: unwind-at-next-emit
+            # never fires while the tree stays quiet, so the flag is
+            # polled here too or every timed-out watch would leave a
+            # permanent background poller.  The sleep is chunked so
+            # stop latency stays bounded however long the client's
+            # interval is
+            deadline = time.monotonic() + interval
+            while not _drain.is_set():
+                if abandoned is not None and abandoned.is_set():
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return True
+                time.sleep(min(0.1, remaining))
+            return False
+
         ran = watch_loop(
-            jobs, emit_cycle, cycles=cycles,
-            interval=float(req.get("interval", 0.5)),
+            jobs, emit_cycle, cycles=cycles, interval=interval,
+            poll=drain_aware_poll,
         )
         return ({"ok": True, "op": "watch", "done": True,
                  "cycles": ran}, True)
@@ -175,10 +325,8 @@ def _handle(req: dict, base_dir: str, emit=None) -> tuple:
 
 
 def serve_loop(in_stream=None, out_stream=None) -> int:
-    """Serve requests until shutdown/EOF.  Streams default to
+    """Serve requests until shutdown/EOF/drain.  Streams default to
     stdin/stdout (the ``operator-forge serve`` entry point)."""
-    import os
-
     in_stream = in_stream if in_stream is not None else sys.stdin
     out_stream = out_stream if out_stream is not None else sys.stdout
     base_dir = os.getcwd()
@@ -186,45 +334,196 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
     # them), so collection is on for the loop's lifetime regardless of
     # OPERATOR_FORGE_PROFILE
     spans.enable(True)
+    _drain.clear()
+    installed = []
 
-    def respond(payload: dict) -> None:
+    # one writer at a time: with a deadline configured the handler runs
+    # on its own thread, and its stream emits must serialize against
+    # the main thread's timeout response or the line-oriented protocol
+    # could interleave
+    out_lock = threading.Lock()
+
+    def _respond_locked(payload: dict) -> None:
+        # every error response is accounted by kind — the serve.errors
+        # taxonomy the stats op surfaces
+        if payload.get("ok") is False and "error_kind" in payload:
+            metrics.counter(
+                "serve.errors." + str(payload["error_kind"])
+            ).inc()
         out_stream.write(json.dumps(payload) + "\n")
         out_stream.flush()
 
-    try:
-        for line in in_stream:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                req = json.loads(line)
-            except json.JSONDecodeError as exc:
-                respond(_error(f"invalid JSON: {exc}"))
-                continue
-            if not isinstance(req, dict):
-                respond(_error("request must be a JSON object"))
-                continue
-            op = req.get("op") or ("job" if "command" in req else "?")
-            started = time.perf_counter()
-            try:
-                with spans.span(f"serve:{op}"):
-                    response, keep_going = _handle(req, base_dir,
-                                                   emit=respond)
-            except BatchManifestError as exc:
-                respond(_error(str(exc), req.get("id")))
-                continue
-            except Exception as exc:  # bad request must not kill the loop
-                respond(_error(f"internal error: {exc}", req.get("id")))
-                continue
-            if req.get("id") is not None:
-                # the request id wins over a job spec's defaulted id
-                response["id"] = req.get("id")
-            response.setdefault(
-                "seconds", round(time.perf_counter() - started, 4)
-            )
-            respond(response)
-            if not keep_going:
-                return 0
+    def respond(payload: dict) -> None:
+        with out_lock:
+            _respond_locked(payload)
+
+    def drained_exit() -> int:
+        respond({"ok": True, "op": "shutdown", "drained": True})
         return 0
+
+    deadline = request_timeout()
+    _busy[0] = False
+    lines = iter(in_stream)
+    try:
+        # handlers are installed inside this try: from the first
+        # installed signal on, a SIGTERM/SIGINT can raise _DrainSignal,
+        # and raising it anywhere outside the except below would crash
+        # the loop with a traceback instead of the drained exit 0 the
+        # protocol promises
+        if threading.current_thread() is threading.main_thread():
+            import signal
+
+            for signum in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    installed.append(
+                        (signum, signal.signal(signum, request_shutdown))
+                    )
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+        while True:
+            # every iteration — including the error/timeout `continue`
+            # paths below — re-checks the drain flag BEFORE blocking on
+            # the next read: a signal that landed mid-request (busy, so
+            # the handler didn't raise) must drain here, not sit parked
+            # behind a read that may never see another line
+            if _drain.is_set():
+                return drained_exit()
+            line = next(lines, None)
+            if line is None:  # EOF
+                break
+            if _drain.is_set():  # shutdown arrived during the read
+                return drained_exit()
+            # dispatch-through-respond runs busy: a shutdown signal
+            # landing there only sets the drain flag and the request
+            # finishes (drain is checked at the top of the next
+            # iteration).  Only an idle read blocked in ``in_stream``
+            # is interrupted, via the handler's _DrainSignal (caught
+            # below)
+            _busy[0] = True
+            try:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    respond(_error(f"invalid JSON: {exc}"))
+                    continue
+                if not isinstance(req, dict):
+                    respond(_error("request must be a JSON object"))
+                    continue
+                op = req.get("op") or ("job" if "command" in req else "?")
+                started = time.perf_counter()
+                abandoned = threading.Event()
+
+                def guarded_emit(payload: dict, _flag=abandoned) -> None:
+                    # a deadline-abandoned handler must not interleave
+                    # its late stream lines into the protocol; the flag
+                    # check and the write share out_lock with the
+                    # timeout response, so either the emit lands whole
+                    # before the abandonment or not at all.  Raising
+                    # (instead of silently dropping) unwinds streaming
+                    # handlers — a watch loop would otherwise keep
+                    # polling and running jobs forever after its client
+                    # got the timeout answer
+                    with out_lock:
+                        if _flag.is_set():
+                            raise _AbandonedRequest()
+                        _respond_locked(payload)
+
+                def dispatch(_req=req, _op=op, _emit=guarded_emit,
+                             _abandoned=abandoned):
+                    with spans.span(f"serve:{_op}"):
+                        return _handle(_req, base_dir, emit=_emit,
+                                       abandoned=_abandoned)
+
+                try:
+                    if deadline > 0:
+                        box: dict = {}
+
+                        def run_boxed(_box=box, _dispatch=dispatch):
+                            try:
+                                _box["out"] = _dispatch()
+                            except BaseException as exc:
+                                _box["exc"] = exc
+
+                        worker = threading.Thread(
+                            target=run_boxed, daemon=True,
+                            name="serve-request",
+                        )
+                        worker.start()
+                        worker.join(deadline)
+                        if worker.is_alive():
+                            # the handler keeps running detached until
+                            # its next emit unwinds it; its response
+                            # (and any late stream lines) are dropped.
+                            # The flag is set under out_lock so no emit
+                            # is mid-write when the timeout answer goes
+                            # out
+                            with out_lock:
+                                abandoned.set()
+                            metrics.counter(
+                                "serve.requests_abandoned"
+                            ).inc()
+                            respond(_error(
+                                f"deadline exceeded after {deadline:g}s",
+                                req.get("id"), kind="timeout",
+                            ))
+                            continue
+                        if "exc" in box:
+                            raise box["exc"]
+                        response, keep_going = box["out"]
+                    else:
+                        response, keep_going = dispatch()
+                except BatchManifestError as exc:
+                    respond(_error(str(exc), req.get("id")))
+                    continue
+                except Exception as exc:  # must not kill the loop
+                    kind = _classify(exc)
+                    label = "internal error" if kind == "internal" else (
+                        f"{kind} error"
+                    )
+                    respond(_error(
+                        f"{label}: {exc}", req.get("id"), kind=kind
+                    ))
+                    continue
+                if req.get("id") is not None:
+                    # the request id wins over a job spec's defaulted id
+                    response["id"] = req.get("id")
+                response.setdefault(
+                    "seconds", round(time.perf_counter() - started, 4)
+                )
+                respond(response)
+                if not keep_going:
+                    # disarm request_shutdown's idle raise before
+                    # leaving: a signal landing in the teardown window
+                    # (the outer finally restoring handlers) would
+                    # otherwise raise _DrainSignal past the except
+                    # below and crash the clean exit with a traceback.
+                    # _busy is still True here, so the set itself is
+                    # race-free
+                    _drain.set()
+                    return 0
+            finally:
+                _busy[0] = False
+        drained = _drain.is_set()
+        _drain.set()  # EOF: disarm the teardown window (see above)
+        if drained:
+            return drained_exit()
+        return 0
+    except _DrainSignal:
+        # a shutdown signal broke the idle blocking read (the rare
+        # window between reading a line and going busy drops that
+        # just-read, not-yet-started request — drain only promises
+        # finishing in-flight work)
+        return drained_exit()
     finally:
+        if installed:
+            import signal
+
+            for signum, previous in installed:
+                try:
+                    signal.signal(signum, previous)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
         spans.use_env()

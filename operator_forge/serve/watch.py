@@ -38,7 +38,7 @@ from __future__ import annotations
 import os
 import time
 
-from ..perf import metrics
+from ..perf import faults, metrics
 from ..perf.depgraph import GRAPH
 from .batch import plan_groups
 from .runner import run_group
@@ -66,7 +66,19 @@ def snapshot(roots) -> dict:
     """``{root: {relpath: (mtime_ns, size)}}`` for every regular file
     under each root, with the tree-state pruning rules (dot-dirs and
     dot-files skipped).  Stat-only: content hashes happen lazily in
-    the layers below, through their stat-validated memo."""
+    the layers below, through their stat-validated memo.
+
+    A file that vanishes between listing and stat (an editor's
+    atomic-rename replace, a build step's temp file) is simply skipped
+    — it reads as removed this poll and reappears on the next, which
+    the invalidation layer already handles; the ``watch.vanish`` chaos
+    fault exercises exactly this race."""
+    # one enabled() probe per poll, not one per scanned file: with no
+    # fault spec active the stat-only hot loop must stay stat-only
+    # (10k files × 2 Hz would otherwise pay 20k registry probes/s)
+    chaos = faults.enabled()
+    if chaos and faults.should_fire("watch.scan_error", "scan.walk"):
+        raise OSError("injected fault: watch.scan_error@scan.walk")
     out: dict = {}
     for root in roots:
         files: dict = {}
@@ -78,14 +90,37 @@ def snapshot(roots) -> dict:
                 if name.startswith("."):
                     continue
                 path = os.path.join(dirpath, name)
+                if chaos and faults.should_fire("watch.vanish", "scan"):
+                    continue  # chaos: lost the stat race on this file
                 try:
                     st = os.stat(path)
                 except OSError:
-                    continue
+                    continue  # vanished mid-scan: the real race
                 rel = os.path.relpath(path, root).replace(os.sep, "/")
                 files[rel] = (st.st_mtime_ns, st.st_size)
         out[root] = files
     return out
+
+
+def _snapshot_with_retry(roots, retries: int = 3, backoff: float = 0.05):
+    """:func:`snapshot` with bounded deterministic backoff: a transient
+    ``OSError`` from the walk (a directory swapped out mid-scan, an
+    NFS hiccup, the injected ``watch.scan_error``) must degrade to a
+    skipped poll, never kill a long-lived watch loop.  Returns ``None``
+    when the tree stayed unreadable — the caller keeps its previous
+    state and polls again."""
+    for attempt in range(retries + 1):
+        try:
+            return snapshot(roots)
+        except OSError:
+            if attempt < retries:
+                # counted only when a retry actually follows; the final
+                # failed attempt is the poll's one scan_failures, not
+                # a phantom extra retry
+                metrics.counter("watch.scan_retries").inc()
+                time.sleep(backoff * (attempt + 1))
+    metrics.counter("watch.scan_failures").inc()
+    return None
 
 
 def diff_snapshots(prev: dict, cur: dict) -> tuple:
@@ -216,14 +251,19 @@ def watch_loop(jobs, emit, cycles=None, interval: float = 0.5,
     ran = 0
     emit(watch_cycle(jobs, ran))
     ran += 1
-    state = snapshot(roots)
+    # an unreadable first snapshot primes empty: the next successful
+    # poll then reads every file as changed — one redundant (but
+    # correct) cycle instead of a dead loop
+    state = _snapshot_with_retry(roots) or {}
     while cycles is None or ran < cycles:
         if poll is not None:
             if poll() is False:
                 break
         else:  # pragma: no cover - timing loop
             time.sleep(interval)
-        cur = snapshot(roots)
+        cur = _snapshot_with_retry(roots)
+        if cur is None:
+            continue  # tree unreadable this poll: keep state, retry
         changed, removed = diff_snapshots(state, cur)
         if not changed and not removed:
             continue

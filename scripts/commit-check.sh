@@ -3,9 +3,10 @@
 # test/scripts/commit-check-latest.sh — same contract, fresh implementation),
 # plus the perf contract of the incremental generation engine (PR 1),
 # the gocheck fast-path determinism bar (PR 2), the batch/serve
-# determinism + throughput bar (PR 3), and the observability contract
+# determinism + throughput bar (PR 3), the observability contract
 # (PR 6: telemetry on/off byte identity, disabled-path overhead,
-# explain determinism).
+# explain determinism), and the chaos/self-healing contract (PR 7:
+# recovery byte-identity under injected faults, fault-site overhead).
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -203,6 +204,42 @@ print(
         telemetry["enabled_per_call_ns"],
         telemetry["explain_legs"],
         telemetry["explain_file"],
+    )
+)
+
+# chaos / self-healing (PR 7): batches run under deterministic fault
+# injection (worker crash, hung task, damaged disk entries, transient
+# job failure) must recover to output byte-identical to the fault-free
+# cache-off serial run, across every cache mode x backend x jobs leg;
+# the fault-free cost of the planted injection sites stays under the
+# same 1% micro-bar as spans.  The chaos/fault-free throughput ratio is
+# reported with the host-noise caveat, not gated.
+chaos = detail["chaos"]
+for cache_mode, ok in chaos["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"chaos recovery identity failed (cache={cache_mode}): "
+        "fault-injected batch diverged from the fault-free run"
+    )
+assert chaos["disabled_ok"] is True, (
+    "fault-free injection-site overhead %.4f%% of the cold path"
+    % (chaos["disabled_fraction_of_cold"] * 100)
+)
+assert chaos["faults_injected"] > 0, "chaos legs injected no faults"
+recovered = chaos["recovered"]
+print(
+    "chaos contract OK: %d faults injected, recovery identity clean in "
+    "%d cache modes, chaos/fault-free warm throughput ratio %.2f "
+    "(host-noise sensitive), sites %.0fns/call (%.4f%% of cold), "
+    "recovered via %d retries / %d respawns / %d timeouts"
+    % (
+        chaos["faults_injected"],
+        len(chaos["identity_by_cache_mode"]),
+        chaos["throughput_ratio"],
+        chaos["disabled_per_call_ns"],
+        chaos["disabled_fraction_of_cold"] * 100,
+        recovered["worker.retries"],
+        recovered["worker.respawns"],
+        recovered["worker.timeouts"],
     )
 )
 PYEOF

@@ -77,7 +77,7 @@ def _fresh_perf_state():
     depend on what an earlier test happened to cache, and perf tests
     configure modes explicitly."""
     from operator_forge.perf import cache as perfcache
-    from operator_forge.perf import metrics, spans, workers
+    from operator_forge.perf import faults, metrics, spans, workers
 
     import sys
 
@@ -96,6 +96,9 @@ def _fresh_perf_state():
     spans.clear_events()
     metrics.reset()
     workers.set_backend(None)
+    workers.reset_degraded()
+    faults.configure(None)
+    faults.reset()
     _clear_watch_state()
     yield
     perfcache.configure(None, None)
@@ -105,6 +108,9 @@ def _fresh_perf_state():
     spans.clear_events()
     metrics.reset()
     workers.set_backend(None)
+    workers.reset_degraded()
+    faults.configure(None)
+    faults.reset()
     _clear_watch_state()
 
 

@@ -554,6 +554,14 @@ class TestBench:
         assert telemetry["identity_telemetry_on_off"] is True
         assert telemetry["explain_identity"] is True
         assert telemetry["explain_names_change"].startswith("file ")
+        # ... the chaos/self-healing section (PR 7): recovery identity
+        # under injected faults, faults actually injected, fault-free
+        # site overhead under the 1% bar ...
+        chaos = detail["chaos"]
+        assert all(chaos["identity_by_cache_mode"].values())
+        assert chaos["faults_injected"] > 0
+        assert chaos["disabled_ok"] is True
+        assert chaos["throughput_ratio"] > 0
         # ... and the serving-layer batch section (PR 3)
         batch = detail["batch"]
         assert batch["jobs"] == 8

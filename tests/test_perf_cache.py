@@ -128,6 +128,101 @@ class TestDiskPersistence:
         assert cache.get("stage", "aa" * 32) is perfcache.MISS
 
 
+class TestDiskDamageRecovery:
+    """Every way a persisted entry can be damaged on disk — torn write
+    (partial file), truncation below the signature, a flipped HMAC
+    byte, a zero-byte file, and a signed-but-unpicklable payload — must
+    read as a miss, move the bad file to quarantine (never left in
+    place to be re-read), and recompute to the identical value
+    (PR 7)."""
+
+    KEY = "aa" * 32
+
+    def _store_one(self, tmp_path):
+        if perfcache._load_hmac_key() is None:
+            # _disk_write silently skips persistence without a signing
+            # key, so there would be no entry on disk to damage
+            pytest.skip("no writable home for the signing key")
+        cache_dir = str(tmp_path / "cache")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=cache_dir)
+        cache.put("stage", self.KEY, {"v": 1})
+        cache.reset()  # force the disk path
+        [entry] = [
+            os.path.join(dirpath, name)
+            for dirpath, dirnames, names in os.walk(cache_dir)
+            if perfcache.QUARANTINE_DIRNAME not in dirpath
+            for name in names
+        ]
+        return cache, cache_dir, entry
+
+    def _assert_recovers(self, cache, cache_dir, entry):
+        from operator_forge.perf import metrics
+
+        assert cache.get("stage", self.KEY) is perfcache.MISS
+        # the bad file is gone from the live store...
+        assert not os.path.exists(entry)
+        # ...and accounted: quarantined with its namespace recorded
+        qdir = os.path.join(cache_dir, perfcache.QUARANTINE_DIRNAME)
+        assert os.path.isdir(qdir) and len(os.listdir(qdir)) == 1
+        assert metrics.counter("cache.quarantined").value() >= 1
+        assert cache.stats()["stage"].get("misses", 0) >= 1
+        # recompute identity: a fresh store/load round-trips again
+        cache.put("stage", self.KEY, {"v": 1})
+        cache.reset()
+        assert cache.get("stage", self.KEY) == {"v": 1}
+
+    def test_torn_write_partial_file(self, tmp_path):
+        cache, cache_dir, entry = self._store_one(tmp_path)
+        size = os.path.getsize(entry)
+        with open(entry, "r+b") as handle:
+            handle.truncate(size // 2)  # torn mid-blob, past the sig
+        cache.reset()
+        self._assert_recovers(cache, cache_dir, entry)
+
+    def test_truncated_below_signature(self, tmp_path):
+        cache, cache_dir, entry = self._store_one(tmp_path)
+        with open(entry, "r+b") as handle:
+            handle.truncate(8)
+        cache.reset()
+        self._assert_recovers(cache, cache_dir, entry)
+
+    def test_flipped_hmac_byte(self, tmp_path):
+        cache, cache_dir, entry = self._store_one(tmp_path)
+        with open(entry, "r+b") as handle:
+            first = handle.read(1)
+            handle.seek(0)
+            handle.write(bytes([first[0] ^ 0xFF]))  # inside the sig
+        cache.reset()
+        self._assert_recovers(cache, cache_dir, entry)
+
+    def test_zero_byte_entry(self, tmp_path):
+        cache, cache_dir, entry = self._store_one(tmp_path)
+        with open(entry, "wb"):
+            pass
+        cache.reset()
+        self._assert_recovers(cache, cache_dir, entry)
+
+    def test_signed_but_unpicklable_payload(self, tmp_path):
+        """A valid signature over garbage (only producible by the
+        keyholder — e.g. a half-migrated schema) must hit the unpickle
+        guard: counted as corrupt, namespace recorded, quarantined."""
+        from operator_forge.perf import metrics
+
+        key = perfcache._load_hmac_key()
+        if key is None:
+            pytest.skip("no writable home for the signing key")
+        cache, cache_dir, entry = self._store_one(tmp_path)
+        garbage = b"not a pickle at all"
+        with open(entry, "wb") as handle:
+            handle.write(perfcache._sign(key, garbage) + garbage)
+        cache.reset()
+        assert cache.get("stage", self.KEY) is perfcache.MISS
+        assert metrics.counter("cache.corrupt_entries").value() == 1
+        assert cache.stats()["stage"]["corrupt"] == 1
+        assert not os.path.exists(entry)
+
+
 class TestInvalidation:
     def _copy_fixture(self, name: str, dest) -> str:
         src = os.path.join(FIXTURES, name)

@@ -1,0 +1,1098 @@
+"""Chaos harness + self-healing execution (PR 7 acceptance).
+
+Deterministic fault injection (operator_forge/perf/faults.py) must be
+exactly reproducible — nth-hit counters, never randomness — and every
+recoverable injected fault must heal invisibly: worker crashes respawn
+the pool and retry, hung tasks die at the deadline, poisoned tasks
+quarantine to in-thread execution, damaged cache entries quarantine and
+recompute, transient job failures retry on fresh buffers, the serve
+loop classifies and counts its errors, and the watch loop survives
+vanishing files and transient scan errors.  The standing contract:
+with faults injected, final outputs are byte-identical to the
+fault-free run (bench.py's ``chaos`` section enforces the full
+cache × backend × jobs matrix; the identity test here is the quick
+in-tree version).
+"""
+
+import contextlib
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import faults, metrics, workers
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+CONFIG = os.path.join(FIXTURES, "standalone", "workload.yaml")
+
+
+# module-level task functions: the process backend ships them by
+# reference across the fork boundary
+def _double(x):
+    return x * 2
+
+
+def _boom(x):
+    raise ValueError(f"task error {x}")
+
+
+def _sleepy(x):
+    if x == "hang":
+        time.sleep(60)
+    return x
+
+
+def _count_one(x):
+    metrics.counter("test.worker_side").inc()
+    return x
+
+
+def _call(f):
+    return f()
+
+
+def _make_adder(x):
+    metrics.counter("test.unsealable_side").inc()
+    return lambda y: x + y
+
+
+class TestFaultSpec:
+    def test_parse_spec(self):
+        assert faults.parse_spec(
+            "worker.crash@batch.group:2, cache.corrupt@disk ,"
+            "job.fail@serve.job:1"
+        ) == (
+            ("worker.crash", "batch.group", 2),
+            ("cache.corrupt", "disk", 1),
+            ("job.fail", "serve.job", 1),
+        )
+        assert faults.parse_spec("") == ()
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["worker.crash", "bogus.kind@site", "job.fail@", "job.fail@s:0",
+         "job.fail@s:x", "@site:1"],
+    )
+    def test_parse_spec_rejects(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_configure_validates_eagerly(self):
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure("not-a-spec")
+
+    def test_nth_hit_counters_are_deterministic(self):
+        """Same spec + same call sequence => the same fired log, byte
+        for byte — the whole point of counter-based injection."""
+        logs = []
+        for _ in range(2):
+            faults.configure(
+                "job.fail@serve.job:2,job.fail@serve.job:4,"
+                "cache.corrupt@disk:1"
+            )
+            for _hit in range(5):
+                faults.fire("serve.job", "job.fail")
+            faults.fire("disk", "cache.corrupt", "cache.torn")
+            logs.append(faults.fired())
+        assert logs[0] == logs[1] == (
+            ("job.fail", "serve.job", 2),
+            ("job.fail", "serve.job", 4),
+            ("cache.corrupt", "disk", 1),
+        )
+
+    def test_one_call_is_one_hit_however_many_kinds(self):
+        faults.configure("cache.zero@disk:2")
+        assert faults.fire("disk", "cache.corrupt", "cache.torn",
+                           "cache.zero") == ()
+        assert faults.fire("disk", "cache.corrupt", "cache.torn",
+                           "cache.zero") == ("cache.zero",)
+
+    def test_every_fired_damage_kind_materializes(self, tmp_path):
+        """Two damage kinds landing on the same write hit both apply
+        (in spec order) — fired() and faults.injected must never claim
+        an injection that didn't happen to the bytes on disk."""
+        root = str(tmp_path / "store")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=root)
+        faults.configure("cache.corrupt@disk:1,cache.zero@disk:1")
+        cache.put("stage", "bb" * 32, {"v": 2})
+        assert faults.fired() == (
+            ("cache.corrupt", "disk", 1), ("cache.zero", "disk", 1),
+        )
+        # the LAST kind's effect is what remains: zero truncates after
+        # corrupt's byte flip
+        files = [
+            os.path.join(dirpath, name)
+            for dirpath, _dirs, names in os.walk(root)
+            for name in names
+        ]
+        assert len(files) == 1
+        assert os.path.getsize(files[0]) == 0
+
+    def test_wildcard_site(self):
+        faults.configure("worker.crash@*:1")
+        assert faults.fire("anything.at.all", "worker.crash") == (
+            "worker.crash",
+        )
+
+    def test_unfired_entries_warn_loudly_at_exit(self, monkeypatch):
+        """Sites are free strings (worker map sites are caller-named),
+        so a typo'd or never-planted site parses fine and injects
+        nothing — the exit hook must surface it instead of letting the
+        chaos run silently pass fault-free."""
+        faults.configure(
+            "job.fail@serve.job:1,worker.crash@no.such.site:1"
+        )
+        faults.fire("serve.job", "job.fail")
+        assert faults.unfired() == (
+            ("worker.crash", "no.such.site", 1),
+        )
+        captured = io.StringIO()
+        monkeypatch.setattr(sys, "__stderr__", captured)
+        faults._warn_unfired_at_exit()
+        text = captured.getvalue()
+        assert "worker.crash@no.such.site:1" in text
+        assert "job.fail" not in text
+        # a fully-fired spec (and a fork child's partial view) is quiet
+        faults.configure("job.fail@serve.job:1")
+        faults.fire("serve.job", "job.fail")
+        assert faults.unfired() == ()
+        quiet = io.StringIO()
+        monkeypatch.setattr(sys, "__stderr__", quiet)
+        faults._warn_unfired_at_exit()
+        monkeypatch.setattr(faults, "_fork_child", [True])
+        faults.configure("job.fail@serve.job:9")
+        faults._warn_unfired_at_exit()
+        assert quiet.getvalue() == ""
+
+    def test_env_spec_and_injected_metric(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_FAULTS", "job.fail@serve.job:1")
+        faults.reset()
+        assert faults.enabled()
+        assert faults.should_fire("job.fail", "serve.job")
+        assert metrics.counter("faults.injected").value() == 1
+
+    def test_disabled_is_free_of_state(self):
+        assert not faults.enabled()
+        assert faults.fire("serve.job", "job.fail") == ()
+        assert faults.fired() == ()
+
+
+class TestWorkerSelfHealing:
+    def _fresh_process_pool(self, monkeypatch, jobs="4"):
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", jobs)
+        workers.set_backend("process")
+        workers._discard_process_pool()
+
+    def test_crash_respawns_pool_and_retries(self, monkeypatch):
+        self._fresh_process_pool(monkeypatch)
+        faults.configure("worker.crash@t.map:2")
+        out = workers.map_ordered(_double, [1, 2, 3, 4, 5], site="t.map")
+        assert out == [2, 4, 6, 8, 10]
+        assert metrics.counter("worker.respawns").value() >= 1
+        assert metrics.counter("worker.retries").value() >= 1
+        assert ("worker.crash", "t.map", 2) in faults.fired()
+
+    def test_hang_is_killed_at_deadline_and_retried(self, monkeypatch):
+        self._fresh_process_pool(monkeypatch)
+        monkeypatch.setenv("OPERATOR_FORGE_TASK_TIMEOUT", "1")
+        monkeypatch.setenv("OPERATOR_FORGE_FAULT_HANG_S", "30")
+        faults.configure("task.hang@t.map:1")
+        start = time.monotonic()
+        out = workers.map_ordered(_double, [7, 8, 9], site="t.map")
+        elapsed = time.monotonic() - start
+        assert out == [14, 16, 18]
+        assert elapsed < 20, f"hung task not killed at deadline: {elapsed}s"
+        assert metrics.counter("worker.timeouts").value() >= 1
+
+    def test_poison_task_quarantines_to_threads(self, monkeypatch):
+        """After the retry budget, the survivors run in-thread and the
+        degradation is recorded — no more silent fallback."""
+        self._fresh_process_pool(monkeypatch)
+        monkeypatch.setenv("OPERATOR_FORGE_TASK_RETRIES", "0")
+        faults.configure("worker.crash@t.map:1")
+        out = workers.map_ordered(_double, [1, 2, 3], site="t.map")
+        assert out == [2, 4, 6]
+        assert metrics.counter("worker.quarantined").value() >= 1
+        assert metrics.counter("worker.degraded").value() >= 1
+        state = workers.pool_state()
+        assert state["degraded"] is True
+        assert state["degraded_reason"]
+        # the standing gauge the metrics registry reports
+        assert metrics.snapshot()["gauges"]["workers.degraded"] == 1
+
+    def test_task_own_error_propagates_verbatim(self, monkeypatch):
+        """A task's own exception is deterministic: it re-raises as
+        itself, with no retry storm and no thread fallback."""
+        self._fresh_process_pool(monkeypatch)
+        with pytest.raises(ValueError, match="task error"):
+            workers.map_ordered(_boom, [1, 2, 3], site="t.map")
+        assert metrics.counter("worker.retries").value() == 0
+
+    def test_deterministic_hang_surfaces_timeout_error(self, monkeypatch):
+        """A task that hangs every attempt (not an injected one-shot)
+        exhausts its retries and must surface TimeoutError from the
+        in-process quarantine run too — never wedge the caller forever
+        on a task that already proved it hangs."""
+        self._fresh_process_pool(monkeypatch, jobs="2")
+        monkeypatch.setenv("OPERATOR_FORGE_TASK_TIMEOUT", "1")
+        monkeypatch.setenv("OPERATOR_FORGE_TASK_RETRIES", "0")
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            workers.map_ordered(_sleepy, ["a", "hang"], site="t.map")
+        assert time.monotonic() - start < 20
+
+    def test_worker_counters_ship_to_parent(self, monkeypatch):
+        """Counter increments produced inside pool children merge into
+        the parent registry, so worker-side events (quarantined cache
+        entries, retried jobs) are visible in serve stats."""
+        self._fresh_process_pool(monkeypatch)
+        out = workers.map_ordered(_count_one, [1, 2, 3, 4], site="t.map")
+        assert out == [1, 2, 3, 4]
+        assert metrics.counter("test.worker_side").value() == 4
+
+    def test_pickle_boundary_failure_skips_retry_budget(self, monkeypatch):
+        """An unpicklable task item fails identically on every respawn:
+        it must quarantine to in-thread execution immediately instead of
+        burning the retry budget on pool forks and backoff sleeps."""
+        self._fresh_process_pool(monkeypatch)
+        out = workers.map_ordered(_call, [lambda: 41, lambda: 42],
+                                  site="t.map")
+        assert out == [41, 42]
+        assert metrics.counter("worker.retries").value() == 0
+        assert metrics.counter("worker.respawns").value() == 0
+        assert metrics.counter("worker.quarantined").value() >= 2
+        assert workers.pool_state()["degraded"] is True
+
+    def test_unsealable_result_quarantines_to_threads(self, monkeypatch):
+        """A task that SUCCEEDS in the child but whose result cannot
+        cross the pickle boundary must quarantine to in-thread
+        execution (where the result never pickles) instead of raising
+        the pickling internal as the task's own error — and without
+        burning the retry budget, since a pool re-run fails
+        identically.  Healthy sibling tasks keep their pool results."""
+        self._fresh_process_pool(monkeypatch)
+        out = workers.map_ordered(
+            _make_adder, [1, 2, 3, 4], site="t.map"
+        )
+        assert [f(10) for f in out] == [11, 12, 13, 14]
+        assert metrics.counter("worker.retries").value() == 0
+        assert metrics.counter("worker.respawns").value() == 0
+        assert metrics.counter("worker.quarantined").value() >= 4
+        state = workers.pool_state()
+        assert state["degraded"] is True
+        assert "pickle boundary" in state["degraded_reason"]
+        # the in-thread re-run is the authoritative execution: the
+        # child's shipped counter deltas are dropped, so the task's
+        # own counters count each task exactly once, not twice
+        assert metrics.counter("test.unsealable_side").value() == 4
+
+    def test_pool_start_failure_keeps_parallel_thread_fallback(
+        self, monkeypatch
+    ):
+        """A pool that never STARTED has no hang suspects: even with a
+        task deadline configured, the degraded fallback must keep the
+        parallel thread map (the thread backend's own semantics) — the
+        serial one-task-at-a-time deadline map would silently turn an
+        N-way batch into 1-way."""
+        self._fresh_process_pool(monkeypatch)
+        monkeypatch.setenv("OPERATOR_FORGE_TASK_TIMEOUT", "30")
+
+        def no_pool():
+            raise OSError("fork unavailable")
+
+        monkeypatch.setattr(workers, "_process_pool", no_pool)
+        monkeypatch.setattr(
+            workers, "_deadline_map",
+            lambda *a, **k: pytest.fail("serial deadline map selected"),
+        )
+        out = workers.map_ordered(_double, [1, 2, 3, 4], site="t.map")
+        assert out == [2, 4, 6, 8]
+        assert workers.pool_state()["degraded"] is True
+
+    def test_shutdown_pools_terminates_hung_children(self, monkeypatch):
+        """The atexit teardown's bounded join must capture the pool's
+        children BEFORE shutdown() nulls pool._processes — otherwise
+        the join-then-terminate is a silent no-op and a worker hung in
+        a task (no deadline configured) wedges interpreter exit."""
+        self._fresh_process_pool(monkeypatch, jobs="2")
+        pool = workers._process_pool()
+        pool.submit(time.sleep, 60)  # children spawn on first submit
+        procs = list(pool._processes.values())
+        assert procs
+        start = time.monotonic()
+        workers._shutdown_pools()
+        assert time.monotonic() - start < 30
+        deadline = time.monotonic() + 10
+        while any(p.is_alive() for p in procs):
+            if time.monotonic() > deadline:
+                pytest.fail("hung child outlived _shutdown_pools")
+            time.sleep(0.1)
+
+    def test_retry_rounds_only_rerun_failures(self, monkeypatch):
+        """Completed results survive a mid-round crash; only the
+        uncollected tail re-runs (tasks are idempotent, so either way
+        output is identical — this pins the cheaper behavior)."""
+        self._fresh_process_pool(monkeypatch, jobs="2")
+        faults.configure("worker.crash@t.map:4")
+        out = workers.map_ordered(_double, list(range(6)), site="t.map")
+        assert out == [0, 2, 4, 6, 8, 10]
+
+
+class TestCacheSelfHealing:
+    @pytest.mark.parametrize(
+        "kind", ["cache.corrupt", "cache.torn", "cache.zero"]
+    )
+    def test_injected_write_damage_quarantines_and_recomputes(
+        self, kind, tmp_path
+    ):
+        root = str(tmp_path / "store")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=root)
+        faults.configure(f"{kind}@disk:1")
+        cache.put("stage", "aa" * 32, {"v": 1})
+        cache.reset()  # force the disk path
+        assert cache.get("stage", "aa" * 32) is perfcache.MISS
+        qdir = os.path.join(root, perfcache.QUARANTINE_DIRNAME)
+        assert os.path.isdir(qdir) and len(os.listdir(qdir)) == 1
+        assert metrics.counter("cache.quarantined").value() == 1
+        assert metrics.counter("cache.corrupt_entries").value() == 1
+        # the namespace is recorded with the corruption
+        assert cache.stats()["stage"]["corrupt"] == 1
+        # recompute identity: a fresh store/load round-trips again
+        faults.configure(None)
+        cache.put("stage", "aa" * 32, {"v": 1})
+        cache.reset()
+        assert cache.get("stage", "aa" * 32) == {"v": 1}
+
+    def test_damage_attribution_reaches_the_stats_surface(
+        self, monkeypatch
+    ):
+        """The per-namespace corrupt/quarantined counts ride through
+        metrics.cache_report() — the surface serve ``stats`` and the
+        stats CLI render — instead of being reachable only from
+        cache.stats() in tests."""
+        monkeypatch.setattr(
+            perfcache, "stats",
+            lambda: {"stage": {"hits": 3, "misses": 1, "corrupt": 2,
+                               "quarantined": 2}},
+        )
+        report = metrics.cache_report()
+        assert report["stage"] == {
+            "hits": 3, "misses": 1, "ratio": 0.75,
+            "corrupt": 2, "quarantined": 2,
+        }
+        # stable key order: hits/misses/ratio fixed, extras sorted after
+        assert list(report["stage"]) == [
+            "hits", "misses", "ratio", "corrupt", "quarantined",
+        ]
+
+    def test_verify_reports_then_repairs(self, tmp_path):
+        root = str(tmp_path / "store")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=root)
+        # the spec must be live while the store is written: disabled
+        # sites do not advance hit counters
+        faults.configure("cache.torn@disk:5,cache.zero@disk:6")
+        for i in range(4):
+            cache.put("stage", f"{i:02d}" * 32, {"v": i})
+        cache.put("stage", "aa" * 32, {"v": 97})
+        cache.put("stage", "bb" * 32, {"v": 98})
+        faults.configure(None)
+        summary = cache.verify()
+        assert summary["scanned"] == 6
+        assert summary["bad"] == 2 and summary["quarantined"] == 0
+        assert len(summary["entries"]) == 2
+        # a report-only scan is an idempotent observation: re-scanning
+        # known-bad entries must not show phantom new corruption
+        assert metrics.counter("cache.corrupt_entries").value() == 0
+        # report-only left them in place; repair moves them (and counts)
+        repaired = cache.verify(repair=True)
+        assert repaired["bad"] == 2 and repaired["quarantined"] == 2
+        assert metrics.counter("cache.corrupt_entries").value() == 2
+        # the same accounting pair the inline read path records: the
+        # per-namespace corrupt count must reconcile with the global
+        # counter after a repair scan
+        assert cache.stats()["stage"]["corrupt"] == 2
+        clean = cache.verify()
+        assert clean["scanned"] == 4 and clean["bad"] == 0
+        qdir = os.path.join(root, perfcache.QUARANTINE_DIRNAME)
+        assert len(os.listdir(qdir)) == 2
+
+    def test_cache_verify_cli(self, tmp_path, capsys, monkeypatch):
+        store = str(tmp_path / "store")
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE", "disk")
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_DIR", store)
+        cache = perfcache.get_cache()
+        faults.configure("cache.zero@disk:2")
+        cache.put("stage", "cc" * 32, {"v": 1})
+        cache.put("stage", "dd" * 32, {"v": 2})
+        faults.configure(None)
+
+        assert cli_main(["cache", "verify"]) == 1  # bad entry, unrepaired
+        report = json.loads(capsys.readouterr().out)
+        assert list(report) == ["scanned", "ok", "bad", "quarantined",
+                                "entries"]
+        assert report["bad"] == 1
+
+        assert cli_main(["cache", "verify", "--repair"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["quarantined"] == 1
+
+        assert cli_main(["cache", "verify"]) == 0  # clean store
+        report = json.loads(capsys.readouterr().out)
+        assert report == {"scanned": 1, "ok": 1, "bad": 0,
+                          "quarantined": 0, "entries": []}
+
+    def test_quarantine_survives_gc(self, tmp_path):
+        """gc must neither count quarantined entries against the
+        ceiling nor resurrect them."""
+        root = str(tmp_path / "store")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=root)
+        faults.configure("cache.torn@disk:1")
+        cache.put("stage", "aa" * 32, {"v": 1})
+        faults.configure(None)
+        cache.reset()
+        assert cache.get("stage", "aa" * 32) is perfcache.MISS  # quarantined
+        summary = cache.gc(max_bytes=1)
+        assert summary["entries"] == 0  # the live store is empty
+        qdir = os.path.join(root, perfcache.QUARANTINE_DIRNAME)
+        assert len(os.listdir(qdir)) == 1  # untouched by the sweep
+
+    def test_verify_repair_unmovable_entry_not_reported_healed(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """A bad entry that can be neither moved to quarantine nor
+        removed (e.g. a read-only store dir) is still live: the repair
+        summary must not count it quarantined, the corruption counter
+        must not tick (the next scan will re-find it), and the CLI must
+        keep exiting 1 instead of telling the operator the store
+        healed."""
+        store = str(tmp_path / "store")
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE", "disk")
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_DIR", store)
+        cache = perfcache.get_cache()
+        if perfcache._load_hmac_key() is None:
+            pytest.skip("no writable home for the signing key")
+        faults.configure("cache.zero@disk:1")
+        cache.put("stage", "aa" * 32, {"v": 1})
+        faults.configure(None)
+
+        real_replace, real_remove = os.replace, os.remove
+
+        def _frozen(op):
+            def inner(src, *args, **kwargs):
+                if str(src).startswith(store):
+                    raise OSError("injected: immutable store dir")
+                return op(src, *args, **kwargs)
+
+            return inner
+
+        monkeypatch.setattr(os, "replace", _frozen(real_replace))
+        monkeypatch.setattr(os, "remove", _frozen(real_remove))
+        summary = cache.verify(repair=True)
+        assert summary["bad"] == 1 and summary["quarantined"] == 0
+        assert metrics.counter("cache.corrupt_entries").value() == 0
+        assert cli_main(["cache", "verify", "--repair"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["bad"] == 1 and report["quarantined"] == 0
+        # once the store is movable again, the same entry heals
+        monkeypatch.setattr(os, "replace", real_replace)
+        monkeypatch.setattr(os, "remove", real_remove)
+        assert cli_main(["cache", "verify", "--repair"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["quarantined"] == 1
+
+
+class TestPublishSweep:
+    def test_sweep_removes_dead_pid_temps_only(self, tmp_path):
+        """First contact with a directory sweeps crash litter (publish
+        temps whose writer pid is dead) but spares in-flight temps:
+        same-pid ones are concurrent parallel_map siblings, and
+        live-other-pid ones belong to another running process
+        publishing into the same tree — removing those would fail that
+        process's os.replace."""
+        from operator_forge.scaffold import machinery
+
+        d = str(tmp_path / "out")
+        os.makedirs(d)
+        dead = subprocess.Popen([sys.executable, "-c", ""])
+        dead.wait()  # reaped: its pid now reads as gone
+        own, live = os.getpid(), os.getppid()
+        mark = machinery._TMP_MARKER
+        litter = f"a.go{mark}-{dead.pid}-1"
+        sibling = f"b.go{mark}-{own}-1"
+        other_writer = f"c.go{mark}-{live}-1"
+        # a user's own file that happens to fit a generic tmp pattern
+        # must never match the tool-unique marker
+        user_file = f"notes.tmp-{dead.pid}-7"
+        for name in (litter, sibling, other_writer, user_file):
+            with open(os.path.join(d, name), "w") as handle:
+                handle.write("partial")
+        machinery._swept_dirs.discard(d)
+        machinery._sweep_stale_temps(d)
+        names = sorted(os.listdir(d))
+        assert litter not in names
+        assert sibling in names and other_writer in names
+        assert user_file in names
+
+    def test_failed_listing_does_not_latch_the_sweep(
+        self, tmp_path, monkeypatch
+    ):
+        """A transient listdir failure (EACCES mid-permission-change,
+        dir not created yet) must not mark the directory swept — the
+        next publish retries and still removes crash litter."""
+        from operator_forge.scaffold import machinery
+
+        d = str(tmp_path / "out")
+        os.makedirs(d)
+        dead = subprocess.Popen([sys.executable, "-c", ""])
+        dead.wait()
+        litter = f"a.go{machinery._TMP_MARKER}-{dead.pid}-1"
+        with open(os.path.join(d, litter), "w") as handle:
+            handle.write("partial")
+        machinery._swept_dirs.discard(d)
+        real_listdir = os.listdir
+
+        def flaky_listdir(path):
+            raise OSError("transient EACCES")
+
+        monkeypatch.setattr(os, "listdir", flaky_listdir)
+        machinery._sweep_stale_temps(d)  # fails, must not latch
+        monkeypatch.setattr(os, "listdir", real_listdir)
+        assert d not in machinery._swept_dirs
+        machinery._sweep_stale_temps(d)
+        assert litter not in os.listdir(d)
+        assert d in machinery._swept_dirs
+
+
+def _norm(text: str, mapping) -> str:
+    for real, placeholder in mapping:
+        text = text.replace(real, placeholder)
+    return re.sub(r"\d+\.\d+s", "<t>", text)
+
+
+def _tree_digest(root: str) -> str:
+    digest = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            digest.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as handle:
+                digest.update(handle.read())
+            digest.update(b"\0")
+    return digest.hexdigest()
+
+
+class TestServeRobustness:
+    def test_transient_job_failure_retries_to_identical_output(
+        self, tmp_path
+    ):
+        from operator_forge.serve.jobs import jobs_from_specs
+        from operator_forge.serve.runner import run_job
+
+        perfcache.configure(mode="off")  # every run live
+        base = str(tmp_path)
+        spec = {"command": "init", "workload_config": CONFIG,
+                "repo": "github.com/acme/app"}
+        ref_job, chaos_job = jobs_from_specs(
+            [dict(spec, output_dir="ref"), dict(spec, output_dir="chaos")],
+            base,
+        )
+        ref = run_job(ref_job)
+        faults.configure("job.fail@serve.job:1")
+        got = run_job(chaos_job)
+        assert faults.fired() == (("job.fail", "serve.job", 1),)
+        assert (ref.rc, got.rc) == (0, 0)
+        # byte-identical output modulo the distinct output dirs
+        assert _norm(got.stdout, [("chaos", "X")]) == _norm(
+            ref.stdout, [("ref", "X")]
+        )
+        assert got.stderr == ref.stderr == ""
+        assert metrics.counter("serve.job.retries").value() == 1
+        assert _tree_digest(os.path.join(base, "ref")) == _tree_digest(
+            os.path.join(base, "chaos")
+        )
+
+    def test_exhausted_retries_report_internal_error(self, monkeypatch):
+        from operator_forge.serve.jobs import jobs_from_specs
+        from operator_forge.serve.runner import run_job
+
+        monkeypatch.setenv("OPERATOR_FORGE_JOB_RETRIES", "1")
+        faults.configure("job.fail@serve.job:1,job.fail@serve.job:2")
+        job = jobs_from_specs([{"command": "vet", "path": "/nowhere"}],
+                              "/tmp")[0]
+        result = run_job(job)
+        assert result.rc == 1
+        assert "internal error: injected fault" in result.stderr
+        assert metrics.counter("serve.job.retries").value() == 1
+
+    def test_task_deadline_verdict_is_not_retried(self, monkeypatch):
+        """TimeoutError escaping a job is the workers layer's verdict
+        that a task hangs on every attempt — its own retry/quarantine
+        budget already proved it.  Re-running the whole job would
+        multiply the full deadline wait for the same outcome, so the
+        job-level retry must not fire."""
+        from operator_forge.serve import runner
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        monkeypatch.setenv("OPERATOR_FORGE_JOB_RETRIES", "2")
+
+        def hang_verdict(argv):
+            raise TimeoutError("quarantined task exceeded deadline")
+
+        monkeypatch.setattr("operator_forge.cli.main.main", hang_verdict)
+        job = jobs_from_specs([{"command": "vet", "path": "/nowhere"}],
+                              "/tmp")[0]
+        result = runner.run_job(job)
+        assert result.rc == 1
+        assert "internal error" in result.stderr
+        assert metrics.counter("serve.job.retries").value() == 0
+
+    def test_error_taxonomy_counted_and_surfaced(self):
+        from operator_forge.serve.server import serve_loop
+
+        lines = [
+            "not json at all",
+            json.dumps(["a", "list"]),
+            json.dumps({"op": "nope"}),
+            json.dumps({"op": "batch", "jobs": [{"command": "bogus"}]}),
+            # malformed client params are bad_request, not internal
+            json.dumps({"op": "watch", "interval": "abc",
+                        "jobs": [{"command": "vet", "path": "/nowhere"}]}),
+            # a zero/negative interval would busy-loop the poll; NaN
+            # would raise out of time.sleep mid-watch
+            json.dumps({"op": "watch", "interval": -1,
+                        "jobs": [{"command": "vet", "path": "/nowhere"}]}),
+            json.dumps({"op": "watch", "interval": "nan",
+                        "jobs": [{"command": "vet", "path": "/nowhere"}]}),
+            json.dumps({"op": "stats"}),
+            json.dumps({"op": "shutdown"}),
+        ]
+        out = io.StringIO()
+        assert serve_loop(io.StringIO("\n".join(lines) + "\n"), out) == 0
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        for resp in responses[:7]:
+            assert resp["ok"] is False
+            assert resp["error_kind"] == "bad_request"
+        stats = responses[7]
+        counters = stats["metrics"]["counters"]
+        assert counters["serve.errors.bad_request"] == 7
+        assert stats["workers"]["backend"] in ("thread", "process")
+        assert stats["workers"]["degraded"] in (False, True)
+
+    def test_error_taxonomy_is_closed(self):
+        from operator_forge.serve.server import (
+            ERROR_KINDS, _classify, _error,
+        )
+
+        # a drifted kind is itself an unclassified server-side bug
+        assert _error("x", kind="bogus")["error_kind"] == "internal"
+        for exc in (TimeoutError(), BrokenPipeError(), OSError(),
+                    MemoryError(), ValueError(), RuntimeError()):
+            assert _classify(exc) in ERROR_KINDS
+
+    def test_request_deadline_answers_timeout(self, monkeypatch):
+        from operator_forge.serve import server
+
+        monkeypatch.setenv("OPERATOR_FORGE_SERVE_TIMEOUT", "0.2")
+        real_handle = server._handle
+
+        def slow_handle(req, base_dir, emit=None, abandoned=None):
+            if req.get("op") == "ping" and req.get("id") == "slow":
+                time.sleep(1.5)
+            return real_handle(req, base_dir, emit=emit,
+                               abandoned=abandoned)
+
+        monkeypatch.setattr(server, "_handle", slow_handle)
+        lines = [
+            json.dumps({"op": "ping", "id": "slow"}),
+            json.dumps({"op": "ping", "id": "quick"}),
+            json.dumps({"op": "shutdown"}),
+        ]
+        out = io.StringIO()
+        assert server.serve_loop(
+            io.StringIO("\n".join(lines) + "\n"), out
+        ) == 0
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert responses[0]["ok"] is False
+        assert responses[0]["error_kind"] == "timeout"
+        assert responses[0]["id"] == "slow"
+        # the loop stays responsive after abandoning the slow request
+        assert responses[1]["ok"] is True and responses[1]["id"] == "quick"
+        assert metrics.counter("serve.requests_abandoned").value() == 1
+
+    def test_abandoned_streaming_handler_unwinds(self, monkeypatch):
+        """A deadline-abandoned streaming handler (the watch op shape)
+        must unwind at its next emit — not keep polling and running
+        jobs forever — and its late lines must never land after the
+        timeout answer."""
+        import threading
+
+        from operator_forge.serve import server
+
+        monkeypatch.setenv("OPERATOR_FORGE_SERVE_TIMEOUT", "0.2")
+        unwound = threading.Event()
+        real_handle = server._handle
+
+        def streaming_handle(req, base_dir, emit=None, abandoned=None):
+            if req.get("op") == "ping" and req.get("id") == "stream":
+                try:
+                    while True:
+                        time.sleep(0.05)
+                        emit({"ok": True, "tick": True})
+                except server._AbandonedRequest:
+                    unwound.set()
+                    raise
+            return real_handle(req, base_dir, emit=emit,
+                               abandoned=abandoned)
+
+        monkeypatch.setattr(server, "_handle", streaming_handle)
+        lines = [
+            json.dumps({"op": "ping", "id": "stream"}),
+            json.dumps({"op": "shutdown"}),
+        ]
+        out = io.StringIO()
+        assert server.serve_loop(
+            io.StringIO("\n".join(lines) + "\n"), out
+        ) == 0
+        assert unwound.wait(5), "abandoned handler kept running"
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        timeout_at = next(
+            i for i, r in enumerate(responses)
+            if r.get("error_kind") == "timeout"
+        )
+        # ticks may stream before the deadline, never after it
+        assert all(
+            "tick" not in r for r in responses[timeout_at + 1:]
+        )
+        assert responses[-1]["op"] == "shutdown"
+
+    def test_graceful_shutdown_drains_in_flight_request(self):
+        from operator_forge.serve import server
+
+        def stream():
+            yield json.dumps({"op": "ping", "id": 1}) + "\n"
+            # the signal arrives while the server would be reading the
+            # next request: the in-flight one above was fully answered,
+            # and the one below must never start
+            server.request_shutdown()
+            yield json.dumps({"op": "ping", "id": 2}) + "\n"
+
+        out = io.StringIO()
+        assert server.serve_loop(stream(), out) == 0
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert [r.get("id") for r in responses] == [1, None]
+        assert responses[0]["ok"] is True
+        assert responses[1] == {"ok": True, "op": "shutdown",
+                                "drained": True}
+
+    def test_sigterm_interrupts_idle_blocking_read(self):
+        # the PEP 475 regression: after the Python-level handler
+        # returns, an interrupted read() is transparently restarted —
+        # so a handler that only sets the drain flag leaves an idle
+        # server blocked (and unkillable) until the next request line.
+        # The handler must raise to break the read and drain now.
+        import signal
+        import threading
+
+        from operator_forge.serve import server
+
+        read_fd, write_fd = os.pipe()
+        in_stream = os.fdopen(read_fd, "r")
+        out = io.StringIO()
+        kick = threading.Timer(
+            0.2, os.kill, (os.getpid(), signal.SIGTERM)
+        )
+        # a regression would block forever on the pipe: EOF it after a
+        # generous grace period so the suite fails instead of hanging
+        rescue = threading.Timer(20.0, os.close, (write_fd,))
+        kick.start()
+        rescue.start()
+        started = time.monotonic()
+        try:
+            rc = server.serve_loop(in_stream, out)
+        finally:
+            kick.cancel()
+            rescue.cancel()
+            in_stream.close()
+            try:
+                os.close(write_fd)
+            except OSError:
+                pass  # the rescue path already closed it
+        elapsed = time.monotonic() - started
+        assert rc == 0
+        assert elapsed < 5.0  # unblocked by the signal, not the rescue
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert responses == [{"ok": True, "op": "shutdown",
+                              "drained": True}]
+
+    def test_abandoned_quiet_watch_stops_polling(self, project,
+                                                 monkeypatch):
+        """A deadline-abandoned watch over a QUIET tree has no next
+        emit to unwind it: the poll itself must observe the abandoned
+        flag, or every timed-out watch leaves a permanent background
+        poller re-running jobs behind later requests."""
+        import threading
+
+        from operator_forge.serve import server
+
+        monkeypatch.setenv("OPERATOR_FORGE_SERVE_TIMEOUT", "2.0")
+        lines = [
+            json.dumps({"op": "watch", "cycles": 5, "interval": 0.05,
+                        "jobs": [{"command": "vet", "path": project}]}),
+            json.dumps({"op": "shutdown"}),
+        ]
+        out = io.StringIO()
+        assert server.serve_loop(
+            io.StringIO("\n".join(lines) + "\n"), out
+        ) == 0
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert any(r.get("error_kind") == "timeout" for r in responses)
+        # the detached handler must die once it notices the flag — not
+        # keep polling the quiet tree forever
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+            t.name == "serve-request" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            time.sleep(0.05)
+        assert not any(
+            t.name == "serve-request" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_sigterm_drains_quiet_watch_op(self, project):
+        """SIGTERM while the server is busy in a watch op over a quiet
+        tree (no change cycle ever completes, so the op would otherwise
+        poll forever) must still drain: the watch observes the flag
+        between polls, finishes its done line, and the loop exits 0."""
+        import signal
+        import threading
+
+        from operator_forge.serve import server
+
+        read_fd, write_fd = os.pipe()
+        in_stream = os.fdopen(read_fd, "r")
+        out = io.StringIO()
+        request = json.dumps({
+            "op": "watch", "cycles": 3, "interval": 0.1,
+            "jobs": [{"command": "vet", "path": project}],
+        })
+        os.write(write_fd, (request + "\n").encode())
+        kick = threading.Timer(
+            1.0, os.kill, (os.getpid(), signal.SIGTERM)
+        )
+        rescue = threading.Timer(30.0, os.close, (write_fd,))
+        kick.start()
+        rescue.start()
+        started = time.monotonic()
+        try:
+            rc = server.serve_loop(in_stream, out)
+        finally:
+            kick.cancel()
+            rescue.cancel()
+            in_stream.close()
+            try:
+                os.close(write_fd)
+            except OSError:
+                pass
+        elapsed = time.monotonic() - started
+        assert rc == 0
+        assert elapsed < 15.0  # unblocked by the signal, not the rescue
+        lines = [json.loads(l) for l in out.getvalue().splitlines()]
+        # first watch cycle ran, the op closed early (1 < 3 cycles),
+        # and the drained shutdown line ends the stream
+        assert lines[0]["op"] == "watch" and lines[0]["ok"] is True
+        done = [l for l in lines if l.get("done")]
+        assert done and done[0]["cycles"] < 3
+        assert lines[-1] == {"ok": True, "op": "shutdown",
+                             "drained": True}
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    """A generated standalone project for the watch-loop tests."""
+    base = tmp_path_factory.mktemp("robust-watch")
+    tree = str(base / "proj")
+    with contextlib.redirect_stdout(io.StringIO()):
+        for _ in range(2):  # reach the scaffold fixed point
+            assert cli_main([
+                "init", "--workload-config", CONFIG,
+                "--repo", "github.com/acme/app", "--output-dir", tree,
+            ]) == 0
+            assert cli_main([
+                "create", "api", "--workload-config", CONFIG,
+                "--output-dir", tree,
+            ]) == 0
+    return tree
+
+
+class TestWatchRobustness:
+    def _jobs(self, tree):
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        return jobs_from_specs(
+            [{"command": "vet", "path": tree}], os.path.dirname(tree)
+        )
+
+    def test_vanish_race_does_not_kill_the_loop(self, project, tmp_path):
+        """A file vanishing between listing and stat (editor atomic
+        rename) reads as a spurious remove+re-add: the loop keeps
+        running and every cycle's results stay identical."""
+        from operator_forge.serve.watch import watch_loop
+
+        perfcache.configure(mode="mem")
+        shutil.copytree(project, str(tmp_path / "proj"))
+        tree = str(tmp_path / "proj")
+        jobs = self._jobs(tree)
+        # fire two vanishes somewhere inside the second poll's scan
+        payloads = []
+        polls = [0]
+
+        def poll():
+            polls[0] += 1
+            if polls[0] == 1:
+                faults.configure("watch.vanish@scan:5,watch.vanish@scan:6")
+                return True
+            return polls[0] < 4  # give the re-add poll a chance to fire
+
+        ran = watch_loop(jobs, payloads.append, cycles=None, poll=poll)
+        assert any(k == "watch.vanish" for k, _s, _n in faults.fired())
+        assert ran >= 2  # prime + at least the spurious-remove cycle
+        assert all(p["ok"] for p in payloads)
+        signatures = {
+            tuple(
+                (r["command"], r["rc"], r["stdout"]) for r in p["results"]
+            )
+            for p in payloads
+        }
+        assert len(signatures) == 1  # every cycle reported identically
+
+    def test_transient_scan_error_backs_off_and_recovers(
+        self, project, tmp_path
+    ):
+        from operator_forge.serve.watch import watch_loop
+
+        perfcache.configure(mode="mem")
+        shutil.copytree(project, str(tmp_path / "proj"))
+        tree = str(tmp_path / "proj")
+        jobs = self._jobs(tree)
+        target = os.path.join(tree, "main.go")
+        payloads = []
+        polls = [0]
+
+        def poll():
+            polls[0] += 1
+            if polls[0] == 1:
+                # one whole poll's snapshot attempts fail (retries
+                # exhausted -> skipped poll), then the next poll sees
+                # the edit
+                faults.configure(
+                    "watch.scan_error@scan.walk:1,"
+                    "watch.scan_error@scan.walk:2,"
+                    "watch.scan_error@scan.walk:3,"
+                    "watch.scan_error@scan.walk:4"
+                )
+                with open(target, "a", encoding="utf-8") as fh:
+                    fh.write("\n// chaos edit\n")
+                time.sleep(0.02)
+                return True
+            return polls[0] < 5
+
+        ran = watch_loop(jobs, payloads.append, cycles=3, poll=poll)
+        assert metrics.counter("watch.scan_failures").value() >= 1
+        assert ran == 2  # prime + the post-recovery change cycle
+        assert payloads[1]["changed"] == ["main.go"]
+        assert all(p["ok"] for p in payloads)
+
+
+class TestRecoveryIdentity:
+    def test_chaos_batch_matches_fault_free_reference(self, tmp_path):
+        """The acceptance contract in miniature: an init/create-api/
+        vet/test batch run under injected worker crash + disk
+        corruption + transient job failure produces byte-identical
+        trees and reports to the fault-free cache-off serial run (the
+        full cache × backend × jobs matrix runs in bench.py's chaos
+        section under commit-check)."""
+        from operator_forge.serve.batch import run_batch
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        base = str(tmp_path)
+        spec = "worker.crash@batch.group:1,cache.corrupt@disk:2," \
+               "job.fail@serve.job:1"
+
+        def run_leg(suffix):
+            out = os.path.join(base, f"out-{suffix}")
+            specs = [
+                {"command": "init", "workload_config": CONFIG,
+                 "output_dir": out, "repo": "github.com/acme/app"},
+                {"command": "create-api", "workload_config": CONFIG,
+                 "output_dir": out},
+                {"command": "vet", "path": out},
+                {"command": "test", "path": out},
+            ]
+            results = run_batch(jobs_from_specs(specs, base))
+            sig = [
+                (r.id, r.command, r.rc,
+                 _norm(r.stdout, [(out, "<out>"), (base, "<base>")]),
+                 _norm(r.stderr, [(out, "<out>"), (base, "<base>")]))
+                for r in results
+            ]
+            return sig, _tree_digest(out)
+
+        saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+        try:
+            # fault-free reference: cache off, serial, thread backend
+            perfcache.configure(mode="off")
+            workers.set_backend("thread")
+            os.environ["OPERATOR_FORGE_JOBS"] = "1"
+            ref_sig, ref_digest = run_leg("ref")
+
+            # chaos leg A: mem cache, process pool, parallel
+            perfcache.configure(mode="mem")
+            perfcache.reset()
+            workers.set_backend("process")
+            workers._discard_process_pool()
+            os.environ["OPERATOR_FORGE_JOBS"] = "4"
+            faults.configure(spec)
+            sig_a, digest_a = run_leg("chaos-mem")
+            fired_a = faults.fired()
+
+            # chaos leg B: disk cache (the corrupt-entry path), serial
+            perfcache.configure(
+                mode="disk", root=os.path.join(base, "store")
+            )
+            perfcache.reset()
+            workers.set_backend("thread")
+            os.environ["OPERATOR_FORGE_JOBS"] = "1"
+            faults.configure(spec)
+            faults.reset()
+            sig_b, digest_b = run_leg("chaos-disk")
+            fired_b = faults.fired()
+        finally:
+            faults.configure(None)
+            workers.set_backend(None)
+            perfcache.configure(None, None)
+            if saved_jobs is None:
+                os.environ.pop("OPERATOR_FORGE_JOBS", None)
+            else:
+                os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+
+        assert fired_a, "chaos leg A injected nothing"
+        assert fired_b, "chaos leg B injected nothing"
+        assert sig_a == ref_sig
+        assert sig_b == ref_sig
+        assert digest_a == ref_digest
+        assert digest_b == ref_digest
