@@ -874,6 +874,271 @@ def chaos_section(tmp: str, stage_totals_cold: dict, cold_cpu_med: float,
     }
 
 
+def remote_section(tmp: str, steady_tree: str, stage_totals_cold: dict,
+                   cold_cpu_med: float, runs: int) -> dict:
+    """The remote-tier contract (PR 9), in one section:
+
+    - **cold-worker bar** — a process with an EMPTY local cache dir
+      running the kitchen-sink check/vet/test workload against a
+      populated remote tier must reach ≥3x cold-local throughput
+      (ROADMAP item 2's own acceptance bar), byte-identical to the
+      cold-local run;
+    - **compiled-closure hydration** — with the whole-report/suite
+      replay namespaces dropped server-side so suites actually
+      execute, process-pool workers hydrating from the remote tier
+      report ``compile.hydrated > 0`` and ``compile.reused > 0``
+      (shipped counter deltas), with on-demand lowering near zero;
+    - **identity** — remote-on batches (thread and process legs, every
+      cache mode) and a fault-injected leg
+      (``remote.corrupt``/``remote.unreachable``) must match the
+      remote-off cache-off serial reference; a server killed mid-run
+      degrades to local with identical output;
+    - **fault-free overhead** — the planted ``remote`` fault site
+      costs <1% of a cold codegen run when no spec is active (the same
+      micro-guard as spans/chaos)."""
+    from operator_forge.gocheck import check_project
+    from operator_forge.gocheck.world import run_project_tests
+    from operator_forge.perf import faults, metrics, workers
+    from operator_forge.perf import remote as pf_remote
+    from operator_forge.serve.batch import run_batch
+    from operator_forge.serve.jobs import jobs_from_specs
+
+    # fault-free fast path: per-call cost of the planted remote site
+    faults.configure(None)
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        faults.fire(
+            "remote", "remote.unreachable", "remote.corrupt", "remote.hang"
+        )
+    per_call = (time.perf_counter() - start) / n
+    total_calls = sum(d["calls"] for d in stage_totals_cold.values())
+    calls_per_run = total_calls / max(runs, 1)
+    fraction = (
+        per_call * calls_per_run / cold_cpu_med if cold_cpu_med > 0 else 0.0
+    )
+
+    remote_runs = 1 if FAST else max(1, BATCH_RUNS)
+    section_root = tempfile.mkdtemp(prefix="operator-forge-remotebench-")
+    server_store = os.path.join(section_root, "server-store")
+    sock = os.path.join(section_root, "remote.sock")
+    # a second steady tree for the two-group process-pool hydration leg
+    # (content-addressed keys embed caller-spelled paths, so the remote
+    # tier must be populated with BOTH trees)
+    import io
+    import contextlib
+
+    tree2 = os.path.join(section_root, "kitchen-sink-steady2")
+    with contextlib.redirect_stdout(io.StringIO()):
+        generate("kitchen-sink", "github.com/bench/kitchen-sink", tree2)
+        generate("kitchen-sink", "github.com/bench/kitchen-sink", tree2)
+
+    def workload(tree):
+        """The check/vet/test workload; returns a comparable signature."""
+        diags = check_project(tree)
+        results = run_project_tests(tree, include_e2e=True)
+        return ([str(d) for d in diags], _result_signature(results))
+
+    saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+    srv = pf_remote.CacheServer("unix:" + sock, root=server_store)
+    srv.start()
+    hydration = {}
+    guards = {}
+    cold_wall, warm_wall = [], []
+    try:
+        # populate: warm the remote tier from a throwaway local root
+        pf_remote.configure(sock)
+        pf_cache.configure(
+            mode="disk", root=os.path.join(section_root, "populate")
+        )
+        pf_cache.reset()
+        for tree in (steady_tree, tree2):
+            workload(tree)
+        assert pf_remote.flush(), "remote write-behind flush failed"
+
+        # cold-local baseline: empty local dir, no remote
+        pf_remote.configure("")
+        ref_sig = None
+        for i in range(remote_runs):
+            pf_cache.configure(
+                mode="disk", root=os.path.join(section_root, f"coldL{i}")
+            )
+            pf_cache.reset()
+            start = time.perf_counter()
+            ref_sig = workload(steady_tree)
+            cold_wall.append(time.perf_counter() - start)
+
+        # the cold-worker bar: empty local dir, populated remote
+        pf_remote.configure(sock)
+        warm_sig = None
+        for i in range(remote_runs):
+            pf_cache.configure(
+                mode="disk", root=os.path.join(section_root, f"coldR{i}")
+            )
+            pf_cache.reset()
+            start = time.perf_counter()
+            warm_sig = workload(steady_tree)
+            warm_wall.append(time.perf_counter() - start)
+        matches_cold = warm_sig == ref_sig
+
+        # compiled-closure hydration in process-pool workers: drop the
+        # replay namespaces server-side so the suites execute, then fan
+        # two test jobs over the pool from an empty local root
+        for ns in ("gocheck.check", "gocheck.checkpkg", "gocheck.analyze"):
+            shutil.rmtree(os.path.join(server_store, ns),
+                          ignore_errors=True)
+        workers.set_backend("process")
+        workers._discard_process_pool()
+        os.environ["OPERATOR_FORGE_JOBS"] = "8"
+        pf_cache.configure(
+            mode="disk", root=os.path.join(section_root, "hydrate")
+        )
+        pf_cache.reset()
+        counter_names = (
+            "compile.lowered", "compile.reused", "compile.hydrated",
+            "cache.remote_hits",
+        )
+        before = {
+            name: metrics.counter(name).value() for name in counter_names
+        }
+        results = run_batch(jobs_from_specs(
+            [{"command": "test", "path": steady_tree},
+             {"command": "test", "path": tree2}],
+            section_root,
+        ))
+        bad = [(r.id, r.stderr) for r in results if not r.ok]
+        assert not bad, f"remote hydration batch job failed: {bad}"
+        hydration = {
+            name: metrics.counter(name).value() - before[name]
+            for name in counter_names
+        }
+        workers.set_backend(None)
+        workers._discard_process_pool()
+
+        # identity matrix: remote-on batches vs the remote-off
+        # cache-off serial reference, plus a fault-injected leg
+        os.environ["OPERATOR_FORGE_JOBS"] = "1"
+        workers.set_backend("thread")
+        pf_remote.configure("")
+        pf_cache.configure(mode="off")
+        ref_specs = _batch_specs(section_root, "remote-ref")
+        ref_dirs = sorted(
+            {s["output_dir"] for s in ref_specs if "output_dir" in s}
+        )
+
+        def run(specs):
+            results = run_batch(jobs_from_specs(specs, section_root))
+            bad = [(r.id, r.stderr) for r in results if not r.ok]
+            assert not bad, f"remote identity batch job failed: {bad}"
+            return results
+
+        ref_batch_sig = _batch_signature(
+            run(ref_specs), ref_dirs, section_root
+        )
+        pf_remote.configure(sock)
+        for cache_mode in GUARD_MODES:
+            leg_ok = True
+            for leg, (backend, jobs) in enumerate((
+                ("thread", "8"), ("process", "8"),
+            )):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(
+                        section_root, f"rm-{cache_mode}-leg{leg}"
+                    ) if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                workers.set_backend(backend)
+                workers._discard_process_pool()
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                specs = _batch_specs(
+                    section_root, f"remote-{cache_mode}-{leg}"
+                )
+                dirs = sorted({
+                    s["output_dir"] for s in specs if "output_dir" in s
+                })
+                sig = _batch_signature(run(specs), dirs, section_root)
+                leg_ok = leg_ok and sig == ref_batch_sig
+                pf_remote.reset_degraded()
+            guards[cache_mode] = leg_ok
+        workers.set_backend("thread")
+        workers._discard_process_pool()
+
+        # fault leg: a lying server (corrupt) plus a vanishing one
+        # (unreachable on a later hit) — output must still match
+        os.environ["OPERATOR_FORGE_JOBS"] = "8"
+        pf_cache.configure(mode="mem")
+        pf_cache.reset()
+        pf_remote.reset_degraded()
+        faults.configure(
+            "remote.corrupt@remote:1,remote.unreachable@remote:3"
+        )
+        faults.reset()
+        fault_specs = _batch_specs(section_root, "remote-faults")
+        fault_dirs = sorted({
+            s["output_dir"] for s in fault_specs if "output_dir" in s
+        })
+        fault_sig = _batch_signature(
+            run(fault_specs), fault_dirs, section_root
+        )
+        faults_injected = len(faults.fired())
+        faults.configure(None)
+        identity_under_faults = fault_sig == ref_batch_sig
+        pf_remote.reset_degraded()
+
+        # degrade leg: the server is killed; the cold worker must land
+        # on identical output via local recompute, with the degrade
+        # recorded (one-shot warning + gauge)
+        srv.stop()
+        pf_cache.configure(
+            mode="disk", root=os.path.join(section_root, "degrade")
+        )
+        pf_cache.reset()
+        degrade_sig = workload(steady_tree)
+        degrade_matches = degrade_sig == ref_sig
+        degraded_recorded = pf_remote.state()["degraded"] is True
+        pf_remote.reset_degraded()
+    finally:
+        faults.configure(None)
+        pf_remote.configure(None)
+        pf_remote.reset_degraded()
+        pf_cache.configure(mode="mem")
+        workers.set_backend(None)
+        if saved_jobs is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+        srv.stop()
+        shutil.rmtree(section_root, ignore_errors=True)
+
+    cold_med = statistics.median(cold_wall)
+    warm_med = statistics.median(warm_wall)
+    return {
+        "fixture": "kitchen-sink",
+        "runs": remote_runs,
+        "cold_local_wall_s_median": round(cold_med, 4),
+        "remote_warm_wall_s_median": round(warm_med, 4),
+        "speedup": round(cold_med / warm_med if warm_med > 0 else 0.0, 2),
+        "matches_cold": matches_cold,
+        "hydration": hydration,
+        "identity_by_cache_mode": guards,
+        "identity_under_faults": identity_under_faults,
+        "faults_injected": faults_injected,
+        "degrade_matches_cold": degrade_matches,
+        "degraded_recorded": degraded_recorded,
+        "disabled_per_call_ns": round(per_call * 1e9, 1),
+        "disabled_fraction_of_cold": round(fraction, 6),
+        "disabled_ok": fraction < 0.01,
+        "headline": "cold-local = empty local cache dir, no remote; "
+        "remote-warm = the same empty-local-dir process against a "
+        "populated remote tier (ROADMAP item 2's cold-worker bar, ≥3x "
+        "enforced); hydration counters are worker-shipped deltas with "
+        "the replay namespaces dropped so suites execute; identity "
+        "legs (incl. corrupt/unreachable faults and a killed server) "
+        "compare against the remote-off cache-off serial reference",
+    }
+
+
 def _batch_specs(base: str, suffix: str) -> list:
     """The 8-job kitchen-sink batch workload: three init + create-api
     chains over distinct output dirs, plus a vet and a test of the
@@ -1184,6 +1449,14 @@ def main() -> None:
             statistics.median(cpu["cold"]), MEASURED_RUNS,
         )
 
+        # the remote tier: the cold-worker bar (empty local dir vs a
+        # populated remote), compiled-closure hydration in workers,
+        # remote-on identity incl. fault legs, fault-site overhead
+        remote = remote_section(
+            tmp, steady["kitchen-sink"], stage_totals["cold"],
+            statistics.median(cpu["cold"]), MEASURED_RUNS,
+        )
+
         loc = sum(fixture_loc.values())
         summary = {
             phase: _phase_summary(cpu[phase], wall[phase], loc)
@@ -1243,6 +1516,7 @@ def main() -> None:
                 ),
                 "telemetry": telemetry,
                 "chaos": chaos,
+                "remote": remote,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
                 "up to ~15% (host scheduling/steal), and the host itself "
@@ -1346,6 +1620,49 @@ def main() -> None:
         if chaos["faults_injected"] <= 0:
             print(
                 "chaos guard FAILED: the chaos legs injected no faults",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if remote["speedup"] < 3:
+            print(
+                "remote cold-worker guard FAILED: empty-local-dir run "
+                "against the populated remote tier below the 3x bar: "
+                "%.2f" % remote["speedup"],
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not remote["matches_cold"] or not remote["degrade_matches_cold"]:
+            print(
+                "remote identity guard FAILED: the remote-warm (or "
+                "killed-server degrade) run diverged from cold-local",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not all(remote["identity_by_cache_mode"].values()) or not (
+            remote["identity_under_faults"]
+        ):
+            print(
+                "remote batch-identity guard FAILED: a remote-on (or "
+                "fault-injected) batch diverged from the remote-off "
+                "cache-off serial reference",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            remote["hydration"].get("compile.hydrated", 0) <= 0
+            or remote["hydration"].get("compile.reused", 0) <= 0
+        ):
+            print(
+                "remote hydration guard FAILED: workers reported no "
+                "compiled-closure hydration/reuse "
+                f"({remote['hydration']})",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not remote["disabled_ok"]:
+            print(
+                "remote fault-site overhead guard FAILED: fault-free "
+                "remote sites exceed 1% of the cold codegen path",
                 file=sys.stderr,
             )
             sys.exit(1)

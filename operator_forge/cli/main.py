@@ -541,7 +541,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -560,12 +560,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache stats explain trace'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -839,12 +839,24 @@ def cmd_cache_gc(args: argparse.Namespace) -> int:
     max_bytes = None
     if args.max_mb is not None:
         max_bytes = int(args.max_mb * 1024 * 1024)
+    purged = None
+    if args.purge_quarantine:
+        # purge BEFORE the sweep so the reported quarantine footprint
+        # reflects the post-purge state (normally zero)
+        purged = perfcache.get_cache().purge_quarantine()
     summary = perfcache.gc(max_bytes)
     out = {
         "entries_removed": summary["entries_removed"],
         "bytes_reclaimed": summary["bytes_reclaimed"],
         "bytes_remaining": summary["bytes_remaining"],
+        # quarantined files are outside the live store but still on
+        # disk; gc reports them (and --purge-quarantine reclaims them)
+        "quarantine_entries": summary["quarantine_entries"],
+        "quarantine_bytes": summary["quarantine_bytes"],
     }
+    if purged is not None:
+        out["quarantine_purged_entries"] = purged["entries_removed"]
+        out["quarantine_purged_bytes"] = purged["bytes_reclaimed"]
     if args.verbose or args.json:
         # detail keys, including the pre-PR-6 --json spellings, so
         # existing consumers of removed/bytes_before/bytes_after keep
@@ -854,6 +866,22 @@ def cmd_cache_gc(args: argparse.Namespace) -> int:
             out[key] = summary[key]
     print(_json.dumps(out))
     return 0
+
+
+def cmd_cache_server(args: argparse.Namespace) -> int:
+    """`cache-server`: serve a shared content-addressed artifact store
+    over a unix socket or TCP (the remote tier of the three-level
+    mem → disk → remote cache hierarchy).  Blobs are stored and served
+    as the opaque HMAC-signed bytes clients produce; the server never
+    unpickles and never needs the signing key — clients verify every
+    fetched blob with their own key before deserializing, so a
+    compromised or mismatched server costs misses, never code
+    execution.  The store reuses the local disk layout, including the
+    LRU ceiling (OPERATOR_FORGE_CACHE_MAX_MB / --max-mb).  Point
+    clients at it with OPERATOR_FORGE_REMOTE_CACHE=<addr>."""
+    from ..perf.remote import serve_cache
+
+    return serve_cache(args.listen, root=args.dir, max_mb=args.max_mb)
 
 
 def cmd_cache_verify(args: argparse.Namespace) -> int:
@@ -1274,6 +1302,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="include detail keys (entries, max_bytes, removed, "
              "bytes_before, bytes_after) in the JSON summary",
     )
+    p_gc.add_argument(
+        "--purge-quarantine", action="store_true",
+        help="also delete quarantined (damaged, already-neutralized) "
+             "entries instead of only reporting their footprint",
+    )
     p_gc.set_defaults(func=cmd_cache_gc)
     p_verify = cache_sub.add_parser(
         "verify",
@@ -1287,6 +1320,29 @@ def build_parser() -> argparse.ArgumentParser:
              "of only reporting them",
     )
     p_verify.set_defaults(func=cmd_cache_verify)
+
+    p_cache_server = sub.add_parser(
+        "cache-server",
+        help="serve a shared remote artifact cache (content-addressed "
+             "get/put over a unix socket or TCP) for "
+             "OPERATOR_FORGE_REMOTE_CACHE clients",
+    )
+    p_cache_server.add_argument(
+        "--listen", required=True, metavar="ADDR",
+        help="unix:/path/to.sock (or any path) for a unix socket, "
+             "host:port for TCP (port 0 picks a free port)",
+    )
+    p_cache_server.add_argument(
+        "--dir", default=None, metavar="DIR",
+        help="store directory (default: OPERATOR_FORGE_CACHE_DIR or "
+             ".operator-forge-cache)",
+    )
+    p_cache_server.add_argument(
+        "--max-mb", type=float, default=None, metavar="MB",
+        help="LRU store ceiling override "
+             "(default: OPERATOR_FORGE_CACHE_MAX_MB, 256)",
+    )
+    p_cache_server.set_defaults(func=cmd_cache_server)
 
     p_stats = sub.add_parser(
         "stats",

@@ -157,7 +157,11 @@ def _memoized_build(stage: str, mem: dict, ident, key: str,
     with _lock:
         value = mem.get(ident)
     cache = pf_cache.get_cache()
-    if value is None and mode == "disk":
+    # the pickling store is consulted past the identity layer when the
+    # disk tier is on — or when the remote tier is (mem mode + remote
+    # still reads through mem → remote)
+    persistent = mode == "disk" or pf_cache.remote_active()
+    if value is None and persistent:
         hit = cache.get(stage, key, record_stats=False)
         if hit is not pf_cache.MISS:
             with _lock:
@@ -168,7 +172,7 @@ def _memoized_build(stage: str, mem: dict, ident, key: str,
             value = build()
         with _lock:
             value = mem.setdefault(ident, value)
-        if mode == "disk":
+        if persistent:
             cache.put(stage, key, value)
     else:
         cache._count(stage, "hits")
@@ -304,7 +308,8 @@ def project_index(root: str, state: tuple | None = None):
     with _lock:
         value = _index_mem.get(key)
     cache = pf_cache.get_cache()
-    if value is None and _mode() == "disk":
+    persistent = _mode() == "disk" or pf_cache.remote_active()
+    if value is None and persistent:
         hit = cache.get("gocheck.index", key, record_stats=False)
         if hit is not pf_cache.MISS:
             with _lock:
@@ -328,7 +333,7 @@ def project_index(root: str, state: tuple | None = None):
         GRAPH.count("recomputed")
         with _lock:
             value = _index_mem.setdefault(key, value)
-        if _mode() == "disk":
+        if persistent:
             cache.put("gocheck.index", key, value)
     else:
         cache._count("gocheck.index", "hits")

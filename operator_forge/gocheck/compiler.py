@@ -73,14 +73,54 @@ def set_mode(value=None) -> None:
 # compiled closures — every interpreter-bound object (registries,
 # natives, scans) is reached through the runtime _Eval — so sharing a
 # runner between worlds is safe.
+#
+# Cross-process reuse (PR 9): Python closures cannot cross a pickle
+# boundary, so what persists per content hash is the *serializable
+# lowering product* — the content-cached token scan (``gocheck.scan``)
+# plus a per-sha manifest of the body spans that were lowered
+# (``gocheck.lower``).  :func:`hydrate_scan` reconstitutes every
+# recorded body in one batch from those cached tokens — no source
+# re-read, no re-tokenize, no lazy lowering interleaved with execution
+# — so a cold process (or, through the pre-fork warm path, every pool
+# worker at once) starts with a populated registry instead of
+# re-lowering on demand.  Visibility counters: ``compile.lowered``
+# (a body lowered on demand), ``compile.hydrated`` (a body
+# reconstituted from a persisted manifest), ``compile.reused`` (a
+# registry hit) — workers ship them to the parent with each sealed
+# result, so serve ``stats`` and the bench see the reuse win directly.
 
 _registry: dict = {}
 _registry_lock = threading.Lock()
+_lowered_spans: dict = {}   # sha -> set of (lo, hi) lowered this process
+_dirty_shas: set = set()    # shas whose manifest needs persisting
+_hydrated: set = set()      # shas whose manifest was already consulted
+# registry-hit tally for the hot path: compiled_block runs once per
+# interpreted function CALL, so it must not take the global metrics
+# lock (twice) per hit — hits accumulate in a plain cell (the rare
+# lost increment under thread races is an acceptable error for a
+# visibility counter) and reconcile into ``compile.reused`` at
+# :func:`flush_counters` boundaries (end of a test run, manifest
+# flush) — before the worker delta shipping reads the registry
+_reused_pending = [0]
 
 
 def reset() -> None:
     with _registry_lock:
         _registry.clear()
+        _lowered_spans.clear()
+        _dirty_shas.clear()
+        _hydrated.clear()
+        _reused_pending[0] = 0
+
+
+def flush_counters() -> None:
+    """Reconcile the lock-free registry-hit tally into the metrics
+    registry (``compile.reused``)."""
+    pending, _reused_pending[0] = _reused_pending[0], 0
+    if pending:
+        from ..perf import metrics
+
+        metrics.counter("compile.reused").inc(pending)
 
 
 def compiled_block(scan, lo: int, hi: int):
@@ -91,23 +131,143 @@ def compiled_block(scan, lo: int, hi: int):
         key = (sha, lo, hi)
         runner = _registry.get(key)
         if runner is not None:
+            _reused_pending[0] += 1
             return runner
     else:
         local = scan.__dict__.setdefault("_compiled_bodies", {})
         runner = local.get((lo, hi))
         if runner is not None:
+            _reused_pending[0] += 1
             return runner
     try:
         with spans.span("gocheck.compile"):
             runner = _Compiler(scan).block(lo, hi)
     except RecursionError:
         return None
+    from ..perf import metrics
+
+    metrics.counter("compile.lowered").inc()
     if sha is not None:
         with _registry_lock:
             _registry[key] = runner
+            _lowered_spans.setdefault(sha, set()).add((lo, hi))
+            _dirty_shas.add(sha)
     else:
         local[(lo, hi)] = runner
     return runner
+
+
+# -- cross-process lowering manifests (``gocheck.lower``) -----------------
+
+_LOWER_STAGE = "gocheck.lower"
+
+
+def _lower_key(sha: str) -> str:
+    from . import cache as gocheck_cache
+
+    return gocheck_cache._key("lower", sha)
+
+
+def hydrate_scan(scan) -> int:
+    """Pre-compile every body a previous process recorded for this
+    scan's content hash, straight from the cached token stream.  One
+    manifest lookup per sha per process (negative results memoized);
+    bodies already in the registry are skipped.  Returns the number of
+    bodies hydrated.  A no-op in walk mode, with the cache off, or for
+    sha-less scans."""
+    from ..perf import cache as pf_cache
+    from ..perf import metrics
+
+    sha = getattr(scan, "sha", None)
+    if sha is None or mode() != "compile":
+        return 0
+    cache = pf_cache.get_cache()
+    if cache.mode() == "off":
+        return 0
+    with _registry_lock:
+        if sha in _hydrated:
+            return 0
+        _hydrated.add(sha)
+    manifest = cache.get(_LOWER_STAGE, _lower_key(sha))
+    if manifest is pf_cache.MISS or not isinstance(manifest, tuple):
+        return 0
+    count = 0
+    with spans.span("gocheck.hydrate"):
+        for span_pair in manifest:
+            try:
+                lo, hi = int(span_pair[0]), int(span_pair[1])
+            except (TypeError, ValueError, IndexError):
+                continue  # a damaged manifest entry is just skipped
+            key = (sha, lo, hi)
+            if _registry.get(key) is not None:
+                continue
+            try:
+                runner = _Compiler(scan).block(lo, hi)
+            except RecursionError:
+                continue
+            with _registry_lock:
+                _registry[key] = runner
+                _lowered_spans.setdefault(sha, set()).add((lo, hi))
+            count += 1
+    if count:
+        metrics.counter("compile.hydrated").inc(count)
+    return count
+
+
+def flush_lowered() -> int:
+    """Persist the dirty lowering manifests (merged with any previously
+    recorded spans for the same sha) into the ``gocheck.lower``
+    namespace — disk and, when configured, the remote tier.  Called at
+    the end of a test run and at process exit; cheap no-op when nothing
+    new was lowered.  Returns the number of manifests written."""
+    from ..perf import cache as pf_cache
+
+    flush_counters()  # every flush boundary also reconciles the tally
+    cache = pf_cache.get_cache()
+    if cache.mode() == "off":
+        return 0
+    with _registry_lock:
+        dirty = {sha: frozenset(_lowered_spans.get(sha, ()))
+                 for sha in _dirty_shas}
+        _dirty_shas.clear()
+    written = 0
+    for sha, spans_set in dirty.items():
+        if not spans_set:
+            continue
+        key = _lower_key(sha)
+        previous = cache.get(_LOWER_STAGE, key, record_stats=False)
+        merged = set(spans_set)
+        if previous is not pf_cache.MISS and isinstance(previous, tuple):
+            merged.update(
+                (int(lo), int(hi)) for lo, hi in previous
+            )
+        value = tuple(sorted(merged))
+        if previous is not pf_cache.MISS and value == previous:
+            continue
+        cache.put(_LOWER_STAGE, key, value)
+        written += 1
+    return written
+
+
+def _flush_at_exit() -> None:
+    try:
+        if flush_lowered():
+            # atexit is LIFO and the remote module usually registers
+            # its drain before this hook runs, so a manifest persisted
+            # here would sit in an already-drained write-behind queue —
+            # drain again explicitly (cheap no-op without a remote)
+            import sys
+
+            remote = sys.modules.get("operator_forge.perf.remote")
+            if remote is not None:
+                remote.flush()
+    except Exception:
+        pass  # exit paths never raise over a best-effort persist
+
+
+import atexit  # noqa: E402
+
+atexit.register(_flush_at_exit)
 
 
 class _CompileError(Exception):

@@ -1995,6 +1995,12 @@ class Interp:
         # tokenize+scan work; every interpreter gets its own shallow
         # copy of the pristine scan
         scan = scan_source(path, text)
+        # cross-process closure reuse: reconstitute any bodies a
+        # previous process recorded for this content hash (one batched
+        # compile from the cached tokens, memoized per sha) so
+        # execution starts with a populated registry instead of
+        # lowering on demand
+        compiler.hydrate_scan(scan)
         # backref for cross-package dispatch: a method reached through
         # the shared registry must execute under ITS package's funcs,
         # consts and imports, not the caller's

@@ -1197,6 +1197,11 @@ def run_project_tests(root: str, include_e2e: bool = False,
         # interpreter FAULTS may be transient (resource exhaustion under
         # parallel load) and must never become a cached permanent FAIL
         gocheck_cache.check_put(key, results)
+    # persist the lowering manifests this run produced, so a later
+    # cold process (or a pool worker hydrating from the shared tiers)
+    # reconstitutes the compiled bodies instead of re-lowering them
+    # lazily mid-execution; no-op when nothing new was lowered
+    compiler.flush_lowered()
     return results
 
 

@@ -329,7 +329,13 @@ class ContentCache:
 
     def get(self, stage: str, key: str, record_stats: bool = True):
         """Fetch a value; returns :data:`MISS` when absent.  Hits always
-        return a freshly deserialized copy."""
+        return a freshly deserialized copy.
+
+        Three read-through tiers: mem, then (disk mode) the local disk
+        store, then — with ``OPERATOR_FORGE_REMOTE_CACHE`` configured —
+        the remote tier.  A remote hit is HMAC-verified with the local
+        key before it is ever unpickled, then populates the local
+        tiers, so later lookups stay local."""
         mode = self.mode()
         if mode == "off":
             return MISS
@@ -337,6 +343,11 @@ class ContentCache:
             blob = self._mem.get((stage, key))
         if blob is None and mode == "disk":
             blob = self._disk_read(stage, key)
+            if blob is not None:
+                with self._lock:
+                    self._mem[(stage, key)] = blob
+        if blob is None:
+            blob = self._remote_read(stage, key)
             if blob is not None:
                 with self._lock:
                     self._mem[(stage, key)] = blob
@@ -375,7 +386,36 @@ class ContentCache:
             self._mem[(stage, key)] = blob
         if mode == "disk":
             self._disk_write(stage, key, blob)
+        self._remote_write(stage, key, blob)
         return value
+
+    def _remote_read(self, stage: str, key: str):
+        """The third read-through tier: a verified pickle blob from the
+        remote cache, or ``None``.  On a hit the disk tier is populated
+        too (re-signed with the local key), so the entry survives this
+        process.  Never raises — remote failures degrade inside
+        :mod:`operator_forge.perf.remote`."""
+        from . import remote
+
+        if not remote.active():
+            return None
+        blob = remote.fetch(stage, key)
+        if blob is None:
+            return None
+        self._count(stage, "remote_hits")
+        if self.mode() == "disk":
+            self._disk_write(stage, key, blob)
+        return blob
+
+    def _remote_write(self, stage: str, key: str, blob: bytes) -> None:
+        """Write-behind to the remote tier: enqueue and return — the
+        upload happens off the hot path (bounded queue, batched,
+        flushed at exit; backlog drops with a counter)."""
+        from . import remote
+
+        if not remote.active():
+            return
+        remote.enqueue_put(stage, key, blob)
 
     def _disk_read(self, stage: str, key: str):
         """Read and authenticate a persisted blob; anything unsigned,
@@ -512,16 +552,82 @@ class ContentCache:
 
             metrics.counter("cache.evictions").inc(removed)
             metrics.counter("cache.bytes_reclaimed").inc(freed)
+        quarantine = self.quarantine_stats()
         return {
             "entries_removed": removed,
             "bytes_reclaimed": freed,
             "bytes_remaining": total - freed,
+            # quarantined files are excluded from the live accounting
+            # above, but they still occupy disk — report them so `gc`
+            # consumers see the whole footprint, not just the store
+            "quarantine_entries": quarantine["entries"],
+            "quarantine_bytes": quarantine["bytes"],
             "entries": len(entries),
             "max_bytes": limit,
             "removed": removed,
             "bytes_before": total,
             "bytes_after": total - freed,
         }
+
+    # -- quarantine accounting -------------------------------------------
+
+    def quarantine_stats(self) -> dict:
+        """Disk footprint of the quarantine directory: totals plus a
+        per-namespace breakdown (file names are
+        ``<stage>-<key>.pkl``, and stage names never contain ``-``
+        followed by a hex key, so the split on the LAST dash is
+        unambiguous).  The directory is flat, so this is one scandir."""
+        base = os.path.join(self.root(), QUARANTINE_DIRNAME)
+        entries = 0
+        total = 0
+        by_namespace: dict = {}
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(base, name)
+            try:
+                size = os.stat(path).st_size
+            except OSError:
+                continue
+            entries += 1
+            total += size
+            stem = name[:-4] if name.endswith(".pkl") else name
+            stage = stem.rpartition("-")[0] or stem
+            record = by_namespace.setdefault(
+                stage, {"entries": 0, "bytes": 0}
+            )
+            record["entries"] += 1
+            record["bytes"] += size
+        return {
+            "entries": entries,
+            "bytes": total,
+            "by_namespace": {k: by_namespace[k] for k in sorted(by_namespace)},
+        }
+
+    def purge_quarantine(self) -> dict:
+        """Delete every quarantined file (``cache gc
+        --purge-quarantine``): quarantine exists so damaged bytes are
+        preserved for inspection, not forever — this is the reclaim
+        path.  Returns ``{"entries_removed", "bytes_reclaimed"}``."""
+        base = os.path.join(self.root(), QUARANTINE_DIRNAME)
+        removed = 0
+        freed = 0
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(base, name)
+            try:
+                size = os.stat(path).st_size
+                os.remove(path)
+            except OSError:
+                continue
+            removed += 1
+            freed += size
+        return {"entries_removed": removed, "bytes_reclaimed": freed}
 
     # -- verification ----------------------------------------------------
 
@@ -647,6 +753,16 @@ def gc(max_bytes=None) -> dict:
 
 def verify(repair: bool = False) -> dict:
     return _CACHE.verify(repair)
+
+
+def remote_active() -> bool:
+    """Whether the remote tier participates in lookups right now (an
+    address is configured, the client has not degraded, and a signing
+    key exists) — callers that gate pickling-store round trips on
+    ``mode == "disk"`` widen the gate with this."""
+    from . import remote
+
+    return remote.active()
 
 
 def memoized(stage: str, key_parts: tuple, compute):

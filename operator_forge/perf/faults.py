@@ -40,6 +40,15 @@ kind                 planted site           effect when fired
                                             listing and stat (rename race)
 ``watch.scan_error`` ``scan.walk``          the whole snapshot walk raises
                                             a transient ``OSError``
+``remote.unreachable`` ``remote``           every connection attempt of one
+                                            remote-cache fetch is refused
+                                            (dead server: degrade path)
+``remote.corrupt``   ``remote``             the fetched remote payload has
+                                            its last byte flipped (lying
+                                            server: HMAC reject, recompute)
+``remote.hang``      ``remote``             the remote fetch sleeps past the
+                                            read deadline (hung server:
+                                            deadline-then-degrade path)
 ===================  =====================  ================================
 
 Hit counters are per-process: forked pool workers restart from zero
@@ -75,6 +84,9 @@ KINDS = (
     "job.fail",
     "watch.vanish",
     "watch.scan_error",
+    "remote.unreachable",
+    "remote.corrupt",
+    "remote.hang",
 )
 
 

@@ -276,8 +276,14 @@ def cache_report() -> dict:
 
     out: dict = {}
     snap = pf_cache.stats()
-    for stage in sorted(snap):
-        counts = snap[stage]
+    # quarantined files are invisible to the in-memory counters (they
+    # are cross-process disk state) — fold the per-namespace disk
+    # accounting in so `stats` reports the reclaimable footprint, not
+    # just this process's detections.  Only namespaces with entries
+    # appear, so a clean store adds nothing
+    quarantine = pf_cache.get_cache().quarantine_stats()["by_namespace"]
+    for stage in sorted(set(snap) | set(quarantine)):
+        counts = snap.get(stage, {})
         hits = counts.get("hits", 0)
         misses = counts.get("misses", 0)
         total = hits + misses
@@ -286,13 +292,16 @@ def cache_report() -> dict:
             "misses": misses,
             "ratio": round(hits / total, 4) if total else 0.0,
         }
-        # the damage-attribution counts (corrupt, quarantined) ride
-        # along when present — dropping them here would leave the
-        # per-namespace records cache.py keeps unreachable from every
-        # stats surface
+        # the damage-attribution counts (corrupt, quarantined,
+        # remote_*) ride along when present — dropping them here would
+        # leave the per-namespace records cache.py keeps unreachable
+        # from every stats surface
         for key in sorted(counts):
             if key not in ("hits", "misses"):
                 out[stage][key] = counts[key]
+        if stage in quarantine:
+            out[stage]["quarantine_entries"] = quarantine[stage]["entries"]
+            out[stage]["quarantine_bytes"] = quarantine[stage]["bytes"]
     return out
 
 

@@ -218,6 +218,10 @@ _SHIPPED_ENV = (
     "OPERATOR_FORGE_TASK_TIMEOUT",
     "OPERATOR_FORGE_TASK_RETRIES",
     "OPERATOR_FORGE_JOB_RETRIES",
+    "OPERATOR_FORGE_REMOTE_CACHE",
+    "OPERATOR_FORGE_REMOTE_TIMEOUT",
+    "OPERATOR_FORGE_REMOTE_RETRIES",
+    "OPERATOR_FORGE_REMOTE_QUEUE",
 )
 
 
@@ -237,8 +241,18 @@ def _task_config() -> dict:
         # the programmatic fault-spec override (bench legs, tests) —
         # env shipping alone would miss it
         "faults": faults.forced_spec(),
+        # the programmatic remote-cache address override, same reason
+        "remote": _remote_forced(),
         "gen": _reset_gen[0],
     }
+
+
+def _remote_forced():
+    # lazy: the remote module only loads once something configures it
+    import sys
+
+    remote = sys.modules.get("operator_forge.perf.remote")
+    return remote._forced_addr if remote is not None else None
 
 
 def _apply_config(cfg: dict) -> None:
@@ -267,6 +281,12 @@ def _apply_config(cfg: dict) -> None:
         # only on change: configure() resets the worker's hit counters,
         # and a per-task reset would re-fire every :1 fault forever
         faults.configure(cfg["faults"])
+    if cfg["remote"] != _remote_forced():
+        # only on change, same reason: configure() clears the sticky
+        # degraded state and the per-run negative memo
+        from . import remote
+
+        remote.configure(cfg["remote"])
     if cfg["gen"] != _worker_seen_gen[0]:
         _worker_seen_gen[0] = cfg["gen"]
         pf_cache.reset()
@@ -341,6 +361,11 @@ def _counter_payload() -> dict:
     child's registry."""
     from . import metrics
 
+    compiler = sys.modules.get("operator_forge.gocheck.compiler")
+    if compiler is not None:
+        # reconcile the compiler's lock-free registry-hit tally before
+        # snapshotting, so compile.reused deltas ship with this task
+        compiler.flush_counters()
     current = metrics.counters_snapshot()
     deltas = {}
     for name, value in current.items():

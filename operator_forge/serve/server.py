@@ -22,11 +22,14 @@ the response, never interleaved with the protocol stream):
   ``{"op": "watch", "done": true, "cycles": N}`` line closes the
   request;
 - ``{"op": "stats"}`` — per-namespace cache hit/miss counters with
-  ratios (stable key order), the dependency graph's cumulative
+  ratios (stable key order, incl. quarantine footprint and remote-hit
+  attribution), the dependency graph's cumulative
   dirty/reused/recomputed counters, the metrics registry
   (counters/gauges + p50/p99 latency histograms for serve jobs and
-  watch cycles), the graph's recorded invalidation provenance, and
-  the span table the per-request ``serve:*`` spans feed;
+  watch cycles), the graph's recorded invalidation provenance, the
+  remote-cache tier state (address, degraded flag, write-behind
+  backlog), and the span table the per-request ``serve:*`` spans
+  feed;
 - ``{"op": "explain", "path": <root>, "changed": [...]}`` — the
   invalidation-provenance report: for each changed file, the
   deterministic chain of artifacts its edit dirties (derived
@@ -185,8 +188,13 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
     if op == "shutdown":
         return ({"ok": True, "op": "shutdown"}, False)
     if op == "stats":
-        from ..perf import workers
+        import sys as _sys
 
+        from ..perf import remote, workers
+
+        compiler = _sys.modules.get("operator_forge.gocheck.compiler")
+        if compiler is not None:
+            compiler.flush_counters()  # compile.reused is tallied lazily
         return (
             {"ok": True, "op": "stats", "cache": metrics.cache_report(),
              "graph": GRAPH.counters(),
@@ -195,6 +203,7 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
                  "last_invalidation": GRAPH.last_invalidation(),
                  "recorded": GRAPH.provenance(),
              },
+             "remote": remote.state(),
              "spans": spans.snapshot(),
              "workers": workers.pool_state()},
             True,

@@ -242,7 +242,156 @@ print(
         recovered["worker.timeouts"],
     )
 )
+
+# remote tier (PR 9): the cold-worker bar — an empty-local-cache-dir
+# process against a populated remote tier must clear 3x cold-local and
+# stay byte-identical, including the killed-server degrade leg and the
+# corrupt/unreachable fault legs; process-pool workers must report
+# compiled-closure hydration (compile.hydrated/compile.reused shipped
+# deltas); the fault-free remote sites stay under the 1% micro-bar.
+remote = detail["remote"]
+assert remote["speedup"] >= 3, (
+    "remote cold-worker run below the 3x bar: %.2f" % remote["speedup"]
+)
+assert remote["matches_cold"] is True, "remote-warm run diverged"
+assert remote["degrade_matches_cold"] is True, (
+    "killed-server degrade leg diverged from cold-local"
+)
+assert remote["degraded_recorded"] is True, (
+    "killed-server leg did not record the degrade"
+)
+for cache_mode, ok in remote["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"remote-on batch identity failed (cache={cache_mode})"
+    )
+assert remote["identity_under_faults"] is True, (
+    "fault-injected remote leg diverged from the reference"
+)
+assert remote["faults_injected"] > 0, "remote fault legs injected nothing"
+assert remote["hydration"]["compile.hydrated"] > 0, (
+    "workers hydrated no compiled closures from the remote tier"
+)
+assert remote["hydration"]["compile.reused"] > 0, (
+    "workers reported no compiled-closure reuse"
+)
+assert remote["disabled_ok"] is True, (
+    "fault-free remote-site overhead %.4f%% of the cold path"
+    % (remote["disabled_fraction_of_cold"] * 100)
+)
+print(
+    "remote contract OK: cold-local=%.3fs remote-warm=%.3fs (x%.1f), "
+    "hydrated %d bodies / %d reuses in workers, identity clean in %d "
+    "cache modes + fault leg, sites %.0fns/call (%.4f%% of cold)"
+    % (
+        remote["cold_local_wall_s_median"],
+        remote["remote_warm_wall_s_median"],
+        remote["speedup"],
+        remote["hydration"]["compile.hydrated"],
+        remote["hydration"]["compile.reused"],
+        len(remote["identity_by_cache_mode"]),
+        remote["disabled_per_call_ns"],
+        remote["disabled_fraction_of_cold"] * 100,
+    )
+)
 PYEOF
+
+# Remote-tier cross-process step (PR 9): a REAL cache-server process
+# (not the bench's in-process one) serves a batch identity matrix over
+# a unix socket, then is killed mid-run for the degrade leg.
+echo "remote contract: cross-process identity through a live cache-server"
+(cd "$repo_root" && OPERATOR_FORGE_BENCH_FAST=1 "${PYTHON:-python3}" - <<'PYEOF'
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import bench
+from operator_forge.perf import cache as pf_cache
+from operator_forge.perf import remote as pf_remote
+from operator_forge.perf import workers
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.jobs import jobs_from_specs
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-remotestep-")
+sock = os.path.join(tmp, "remote.sock")
+server = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "cache-server",
+     "--listen", sock, "--dir", os.path.join(tmp, "store")],
+    stderr=subprocess.DEVNULL,
+)
+try:
+    for _ in range(200):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit("cache-server did not bind its socket")
+
+    def run(specs):
+        results = run_batch(jobs_from_specs(specs, tmp))
+        bad = [(r.id, r.stderr) for r in results if not r.ok]
+        assert not bad, f"batch job failed: {bad}"
+        return results
+
+    def leg_sig(suffix):
+        specs = bench._batch_specs(tmp, suffix)
+        dirs = sorted(
+            {s["output_dir"] for s in specs if "output_dir" in s}
+        )
+        return bench._batch_signature(run(specs), dirs, tmp)
+
+    # reference: no remote, cache off, serial
+    os.environ["OPERATOR_FORGE_JOBS"] = "1"
+    workers.set_backend("thread")
+    pf_cache.configure(mode="off")
+    ref = leg_sig("ref")
+
+    # leg 1: disk + live remote, thread-parallel (populates the server)
+    pf_remote.configure(sock)
+    pf_cache.configure(mode="disk", root=os.path.join(tmp, "disk1"))
+    pf_cache.reset()
+    os.environ["OPERATOR_FORGE_JOBS"] = "8"
+    assert leg_sig("live-thread") == ref, "remote-on thread leg diverged"
+    assert pf_remote.flush(), "write-behind flush failed"
+
+    # leg 2: the cold worker — EMPTY local dir, process pool, warm server
+    pf_cache.configure(mode="disk", root=os.path.join(tmp, "disk2"))
+    pf_cache.reset()
+    workers.set_backend("process")
+    workers._discard_process_pool()
+    assert leg_sig("live-process") == ref, "remote-on process leg diverged"
+    workers.set_backend("thread")
+    workers._discard_process_pool()
+
+    # leg 3: kill the server MID-RUN — the tier must degrade to local
+    # with byte-identical output
+    pf_cache.configure(mode="disk", root=os.path.join(tmp, "disk3"))
+    pf_cache.reset()
+    killer = threading.Timer(0.3, server.kill)
+    killer.start()
+    try:
+        assert leg_sig("killed") == ref, "killed-server leg diverged"
+    finally:
+        killer.cancel()
+        server.kill()
+    print(
+        "remote cross-process step OK: thread/process/killed-server "
+        "legs all byte-identical to the cache-off serial reference "
+        "(degraded=%s)" % pf_remote.state()["degraded"]
+    )
+finally:
+    pf_remote.configure(None)
+    pf_cache.configure(mode="mem")
+    workers.set_backend(None)
+    os.environ.pop("OPERATOR_FORGE_JOBS", None)
+    server.kill()
+    server.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
 
 # Analyzer zero-findings gate over the reference corpus (when the
 # checkout is mounted): the corpus compiles, so every analyzer —

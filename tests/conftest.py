@@ -89,6 +89,13 @@ def _fresh_perf_state():
             watch_mod.LAST_CHANGED.clear()
             watch_mod.LAST_REMOVED.clear()
 
+    def _reset_remote():
+        # only if the remote tier is loaded: a configured address or a
+        # sticky degrade from one test must not leak into the next
+        remote_mod = sys.modules.get("operator_forge.perf.remote")
+        if remote_mod is not None:
+            remote_mod.configure(None)
+
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
@@ -99,6 +106,7 @@ def _fresh_perf_state():
     workers.reset_degraded()
     faults.configure(None)
     faults.reset()
+    _reset_remote()
     _clear_watch_state()
     yield
     perfcache.configure(None, None)
@@ -111,6 +119,7 @@ def _fresh_perf_state():
     workers.reset_degraded()
     faults.configure(None)
     faults.reset()
+    _reset_remote()
     _clear_watch_state()
 
 

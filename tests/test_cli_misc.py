@@ -562,6 +562,21 @@ class TestBench:
         assert chaos["faults_injected"] > 0
         assert chaos["disabled_ok"] is True
         assert chaos["throughput_ratio"] > 0
+        # ... the remote-tier section (PR 9): the cold-worker bar
+        # (empty local dir vs populated remote, ≥3x), byte-identity
+        # incl. the killed-server degrade and fault legs, and the
+        # worker-shipped compiled-closure hydration counters ...
+        remote = detail["remote"]
+        assert remote["speedup"] >= 3
+        assert remote["matches_cold"] is True
+        assert remote["degrade_matches_cold"] is True
+        assert remote["degraded_recorded"] is True
+        assert all(remote["identity_by_cache_mode"].values())
+        assert remote["identity_under_faults"] is True
+        assert remote["faults_injected"] > 0
+        assert remote["hydration"]["compile.hydrated"] > 0
+        assert remote["hydration"]["compile.reused"] > 0
+        assert remote["disabled_ok"] is True
         # ... and the serving-layer batch section (PR 3)
         batch = detail["batch"]
         assert batch["jobs"] == 8

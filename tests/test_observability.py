@@ -352,9 +352,12 @@ class TestCacheGcJson:
         assert cli_main(["cache", "gc"]) == 0
         summary = json.loads(capsys.readouterr().out)
         assert list(summary) == [
-            "entries_removed", "bytes_reclaimed", "bytes_remaining"
+            "entries_removed", "bytes_reclaimed", "bytes_remaining",
+            "quarantine_entries", "quarantine_bytes",
         ]
         assert summary["entries_removed"] == 0
+        assert summary["quarantine_entries"] == 0
+        assert summary["quarantine_bytes"] == 0
         assert cli_main(
             ["cache", "gc", "--max-mb", "0.003", "--verbose"]
         ) == 0
