@@ -541,7 +541,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -560,12 +560,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test batch serve watch cache cache-server stats explain trace'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -791,10 +791,13 @@ def cmd_batch(args: argparse.Namespace) -> int:
     the batch orchestrator (PR 3) — jobs over distinct directories fan
     out across the OPERATOR_FORGE_WORKERS=thread|process backend, jobs
     over one directory chain in manifest order, unchanged jobs replay
-    from the content cache, and results report in manifest order."""
+    from the content cache, and results report in manifest order.
+    With --addr the manifest runs through a resident `operator-forge
+    daemon` instead of this process, so its warm caches serve the
+    batch."""
     from ..serve.batch import cmd_batch as run
 
-    return run(args.manifest, json_lines=args.json)
+    return run(args.manifest, json_lines=args.json, addr=args.addr)
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
@@ -805,6 +808,58 @@ def cmd_serve(args: argparse.Namespace) -> int:
     from ..serve.server import serve_loop
 
     return serve_loop()
+
+
+def cmd_daemon(args: argparse.Namespace) -> int:
+    """`daemon`: the serve protocol for N concurrent clients — a
+    unix/TCP socket listener whose sessions multiplex over the shared
+    worker pool through a round-robin fair scheduler with bounded
+    per-session and global admission queues (`busy` + retry_after on
+    overflow), per-project cache namespaces, and cache-memory budgets
+    enforced by a maintenance tick.  SIGTERM/SIGINT (or a client's
+    shutdown op) drains: in-flight requests finish, every session gets
+    a final drained-shutdown line, exit 0.  The `gopls -listen` /
+    Bazel-server analogue."""
+    from ..serve.daemon import serve_daemon
+
+    return serve_daemon(args.listen, clients=args.clients)
+
+
+def cmd_connect(args: argparse.Namespace) -> int:
+    """`connect`: drive a running daemon from a terminal or script —
+    JSON-lines requests on stdin are relayed to the daemon and every
+    response line (including a watch op's streamed cycles) is printed
+    to stdout as it arrives.  stdin EOF half-closes the connection and
+    waits for the daemon's remaining answers."""
+    from ..serve.daemon import DaemonClient
+
+    try:
+        client = DaemonClient(args.addr)
+    except OSError as exc:
+        print(f"error: daemon at {args.addr}: {exc}", file=sys.stderr)
+        return 1
+
+    def pump_responses():
+        while True:
+            line = client.read_line()
+            if not line:
+                return
+            sys.stdout.write(line)
+            sys.stdout.flush()
+
+    reader = threading.Thread(target=pump_responses, daemon=True)
+    reader.start()
+    try:
+        for line in sys.stdin:
+            if not line.strip():
+                continue
+            client.send_line(line)
+    except (OSError, KeyboardInterrupt):
+        pass
+    client.half_close()
+    reader.join()
+    client.close()
+    return 0
 
 
 def cmd_watch(args: argparse.Namespace) -> int:
@@ -1246,6 +1301,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="emit one JSON line per job result plus a summary line",
     )
+    p_batch.add_argument(
+        "--addr", default="", metavar="ADDR",
+        help="run the manifest through a running `operator-forge "
+             "daemon` at this address (unix:/path or host:port) "
+             "instead of this process",
+    )
     p_batch.set_defaults(func=cmd_batch)
 
     p_serve = sub.add_parser(
@@ -1254,6 +1315,35 @@ def build_parser() -> argparse.ArgumentParser:
              "across requests)",
     )
     p_serve.set_defaults(func=cmd_serve)
+
+    p_daemon = sub.add_parser(
+        "daemon",
+        help="serve the JSON-lines protocol to N concurrent clients "
+             "over a unix or TCP socket (fair scheduling, bounded "
+             "admission queues, shared warm caches)",
+    )
+    p_daemon.add_argument(
+        "--listen", required=True, metavar="ADDR",
+        help="unix:/path/to.sock (or any path) for a unix socket, "
+             "host:port for TCP (port 0 picks a free port)",
+    )
+    p_daemon.add_argument(
+        "--clients", type=int, default=None, metavar="N",
+        help="concurrent-connection ceiling (default: "
+             "OPERATOR_FORGE_DAEMON_CLIENTS, 64)",
+    )
+    p_daemon.set_defaults(func=cmd_daemon)
+
+    p_connect = sub.add_parser(
+        "connect",
+        help="relay JSON-lines requests from stdin to a running "
+             "daemon and print its responses",
+    )
+    p_connect.add_argument(
+        "--addr", required=True, metavar="ADDR",
+        help="the daemon's listen address (unix:/path or host:port)",
+    )
+    p_connect.set_defaults(func=cmd_connect)
 
     p_watch = sub.add_parser(
         "watch",

@@ -32,6 +32,7 @@ after the cacheable stage computes them).
 
 from __future__ import annotations
 
+import collections
 import enum
 import hashlib
 import hmac
@@ -233,13 +234,20 @@ class ContentCache:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._mem: dict = {}
+        # insertion/recency-ordered: get() marks entries used so the
+        # mem tier evicts least-recently-USED when over budget (a
+        # long-lived daemon would otherwise grow without bound)
+        self._mem: collections.OrderedDict = collections.OrderedDict()
+        self._mem_bytes = 0
         self._stats: dict = {}
         self._mode_override = None
         self._root_override = None
         # bytes persisted since the last size check: gc on write is
         # amortized so a hot loop never walks the store per put
         self._written_since_gc = 0
+        # one disk sweep at a time: two writers crossing the amortized
+        # threshold together must not both walk-and-evict the store
+        self._gc_inflight = False
         # callbacks run by reset(): sibling in-process caches (the
         # gocheck scan/index identity layers) register here so one
         # reset() call returns the whole process to a cold state
@@ -271,6 +279,7 @@ class ContentCache:
         entries survive — they are re-validated content hashes)."""
         with self._lock:
             self._mem.clear()
+            self._mem_bytes = 0
             self._stats.clear()
         for hook in list(self.reset_hooks):
             hook()
@@ -288,6 +297,51 @@ class ContentCache:
 
     def _disk_path(self, stage: str, key: str) -> str:
         return os.path.join(self.root(), stage, key[:2], key + ".pkl")
+
+    # -- mem-tier budget -------------------------------------------------
+    #
+    # The mem tier shares the OPERATOR_FORGE_CACHE_MAX_MB ceiling with
+    # the disk store.  Accounting is byte-exact (blob lengths) and all
+    # mutation happens under self._lock, so concurrent daemon sessions
+    # can put/evict without racing the totals.
+
+    def _mem_store_locked(self, mem_key: tuple, blob: bytes) -> None:
+        old = self._mem.pop(mem_key, None)
+        if old is not None:
+            self._mem_bytes -= len(old)
+        self._mem[mem_key] = blob
+        self._mem_bytes += len(blob)
+
+    def _mem_drop_locked(self, mem_key: tuple) -> None:
+        old = self._mem.pop(mem_key, None)
+        if old is not None:
+            self._mem_bytes -= len(old)
+
+    def _evict_mem_locked(self, limit: int) -> int:
+        evicted = 0
+        while self._mem_bytes > limit and self._mem:
+            _key, blob = self._mem.popitem(last=False)
+            self._mem_bytes -= len(blob)
+            evicted += 1
+        return evicted
+
+    def _mem_insert(self, mem_key: tuple, blob: bytes) -> None:
+        """Store a mem-tier blob and enforce the budget (LRU)."""
+        limit = self.max_bytes()
+        with self._lock:
+            self._mem_store_locked(mem_key, blob)
+            evicted = (
+                self._evict_mem_locked(limit) if limit > 0 else 0
+            )
+        if evicted:
+            from . import metrics
+
+            metrics.counter("cache.mem_evictions").inc(evicted)
+
+    def mem_footprint(self) -> tuple:
+        """(entries, bytes) currently resident in the mem tier."""
+        with self._lock:
+            return len(self._mem), self._mem_bytes
 
     # -- quarantine -----------------------------------------------------
 
@@ -341,16 +395,18 @@ class ContentCache:
             return MISS
         with self._lock:
             blob = self._mem.get((stage, key))
+            if blob is not None:
+                # LRU freshness: a hit is a use, so eviction under the
+                # mem budget stays least-recently-USED
+                self._mem.move_to_end((stage, key))
         if blob is None and mode == "disk":
             blob = self._disk_read(stage, key)
             if blob is not None:
-                with self._lock:
-                    self._mem[(stage, key)] = blob
+                self._mem_insert((stage, key), blob)
         if blob is None:
             blob = self._remote_read(stage, key)
             if blob is not None:
-                with self._lock:
-                    self._mem[(stage, key)] = blob
+                self._mem_insert((stage, key), blob)
         if blob is None:
             if record_stats:
                 self._count(stage, "misses")
@@ -363,7 +419,7 @@ class ContentCache:
             # mem store, and its disk file quarantined so the same bad
             # bytes can never be re-read
             with self._lock:
-                self._mem.pop((stage, key), None)
+                self._mem_drop_locked((stage, key))
             self._corrupt_entry(stage, key)
             if record_stats:
                 self._count(stage, "misses")
@@ -382,8 +438,7 @@ class ContentCache:
             blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
             return value  # unpicklable values simply aren't cached
-        with self._lock:
-            self._mem[(stage, key)] = blob
+        self._mem_insert((stage, key), blob)
         if mode == "disk":
             self._disk_write(stage, key, blob)
         self._remote_write(stage, key, blob)
@@ -485,7 +540,10 @@ class ContentCache:
 
     def _maybe_gc(self, written: int) -> None:
         """Amortized on-write pruning: walk the store only after enough
-        new bytes accumulated to plausibly move the total."""
+        new bytes accumulated to plausibly move the total.  Concurrent
+        writers (daemon sessions) crossing the threshold together elect
+        ONE sweeper — the rest return immediately, their bytes already
+        folded into the shared accumulator."""
         limit = self.max_bytes()
         if limit <= 0:
             return
@@ -493,11 +551,17 @@ class ContentCache:
             self._written_since_gc += written
             if self._written_since_gc < max(limit // 32, 1024 * 1024):
                 return
+            if self._gc_inflight:
+                return  # another writer is already sweeping
+            self._gc_inflight = True
             self._written_since_gc = 0
         try:
             self.gc()
         except OSError:
             pass
+        finally:
+            with self._lock:
+                self._gc_inflight = False
 
     def gc(self, max_bytes=None) -> dict:
         """Prune the disk store to ``max_bytes`` (default: the
@@ -568,6 +632,40 @@ class ContentCache:
             "bytes_before": total,
             "bytes_after": total - freed,
         }
+
+    def enforce_budget(self) -> dict:
+        """Bound BOTH resident tiers to the ``OPERATOR_FORGE_CACHE_MAX_MB``
+        ceiling right now — the daemon's maintenance-tick hook.  The
+        on-write triggers only fire while entries are being written; a
+        long-lived daemon that mostly replays would otherwise never
+        evict, so this applies the mem LRU eviction unconditionally and
+        runs the disk LRU sweep (disk mode only) through the same
+        single-sweeper election as the amortized path.  Returns
+        ``{"mem_evicted": n, "disk": gc-summary-or-None}``."""
+        out = {"mem_evicted": 0, "disk": None}
+        limit = self.max_bytes()
+        if limit <= 0:
+            return out
+        with self._lock:
+            evicted = self._evict_mem_locked(limit)
+        if evicted:
+            from . import metrics
+
+            metrics.counter("cache.mem_evictions").inc(evicted)
+        out["mem_evicted"] = evicted
+        if self.mode() == "disk":
+            with self._lock:
+                if self._gc_inflight:
+                    return out  # a writer's sweep is already running
+                self._gc_inflight = True
+            try:
+                out["disk"] = self.gc()
+            except OSError:
+                pass
+            finally:
+                with self._lock:
+                    self._gc_inflight = False
+        return out
 
     # -- quarantine accounting -------------------------------------------
 

@@ -206,6 +206,15 @@ def register_gauge(name: str, fn) -> None:
         _callback_gauges[name] = fn
 
 
+def unregister_gauge(name: str) -> None:
+    """Drop a callback gauge registration (the daemon registers a
+    per-session queue-depth gauge per connection and must release it
+    when the session closes, or a long-lived daemon's snapshot would
+    grow one dead key per client ever served)."""
+    with _lock:
+        _callback_gauges.pop(name, None)
+
+
 def histogram(name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
     with _lock:
         inst = _histograms.get(name)

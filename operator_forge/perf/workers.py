@@ -502,8 +502,13 @@ def _forget_pools_after_fork() -> None:
     # threads/processes; using one would hang forever.  The lock is
     # re-created too: fork can land while another parent thread holds
     # it, and the child would inherit it locked forever
-    global _proc_pool, _proc_size, _pool_lock
+    global _proc_pool, _proc_size, _pool_lock, _active_maps_lock
     _pool_lock = threading.Lock()
+    # the fair-dispatch accounting is per-process: a child forked while
+    # a parent map was in flight must not inherit its count (or a
+    # possibly-held lock)
+    _active_maps_lock = threading.Lock()
+    _active_maps[0] = 0
     _fan_pools.clear()
     if _proc_pool is not None:
         _inherited_pools.append(_proc_pool)
@@ -654,10 +659,60 @@ def _infra_errors() -> tuple:
 _NON_RETRYABLE_INFRA = ("PicklingError", "AttributeError", "ImportError")
 
 
+# -- fair dispatch (PR 10) -------------------------------------------------
+#
+# Daemon sessions run concurrent maps over the SAME executor tier.  An
+# unbounded submit of a 64-group batch would occupy every pool slot
+# before a sibling session's map gets one (executor queues are FIFO),
+# so when maps overlap each submits in fair-share waves: at most
+# ceil(width / active_maps) futures in flight per map.  A lone map is
+# unchanged — one submit, no waves.
+
+_active_maps_lock = threading.Lock()
+_active_maps = [0]
+
+
+def _enter_map() -> int:
+    from . import metrics
+
+    with _active_maps_lock:
+        _active_maps[0] += 1
+        active = _active_maps[0]
+    metrics.gauge("workers.active_maps").set(active)
+    return active
+
+
+def _exit_map() -> None:
+    from . import metrics
+
+    with _active_maps_lock:
+        _active_maps[0] -= 1
+        active = _active_maps[0]
+    metrics.gauge("workers.active_maps").set(active)
+
+
 def _thread_map(fn, items, jobs: int) -> list:
     pool = _thread_pool(jobs)
-    futures = [pool.submit(fn, item) for item in items]
-    return [future.result() for future in futures]
+    active = _enter_map()
+    try:
+        if active <= 1 or len(items) <= 1:
+            futures = [pool.submit(fn, item) for item in items]
+            return [future.result() for future in futures]
+        # fair-share waves: concurrent maps (daemon sessions) each keep
+        # at most their share of the pool in flight, so a wide batch
+        # cannot monopolize the FIFO submission queue.  Results still
+        # collect in input order; output is unchanged.
+        share = max(1, (jobs + active - 1) // active)
+        out: list = []
+        for start in range(0, len(items), share):
+            wave = [
+                pool.submit(fn, item)
+                for item in items[start:start + share]
+            ]
+            out.extend(future.result() for future in wave)
+        return out
+    finally:
+        _exit_map()
 
 
 def _deadline_map(fn, items, deadline: float) -> list:

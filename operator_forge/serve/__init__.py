@@ -25,10 +25,18 @@ requests and *many* cores:
 - :mod:`operator_forge.serve.server` — ``operator-forge serve``: a
   resident process reading JSON-lines requests from stdin, answering
   one JSON line per request, with per-request spans feeding the
-  profiler and bench.py's ``batch`` section.
+  profiler and bench.py's ``batch`` section;
+- :mod:`operator_forge.serve.daemon` /
+  :mod:`operator_forge.serve.session` — ``operator-forge daemon``
+  (PR 10): the same protocol served to N concurrent socket clients
+  through a round-robin fair scheduler with bounded admission queues,
+  cross-session path locks, per-project cache namespaces, and the one
+  shared SIGTERM drain; ``connect`` and ``batch --addr`` are the
+  client side.
 
-Serial, thread-parallel, and process-pool execution produce
-byte-identical output trees in every cache mode
-(tests/test_serve_batch.py; bench.py's ``batch.identity_by_cache_mode``
-guard, enforced by scripts/commit-check.sh).
+Serial, thread-parallel, process-pool, and multi-client daemon
+execution produce byte-identical output trees in every cache mode
+(tests/test_serve_batch.py, tests/test_daemon.py; bench.py's
+``batch.identity_by_cache_mode`` + ``daemon`` guards, enforced by
+scripts/commit-check.sh).
 """

@@ -117,7 +117,7 @@ def run_batch(jobs) -> list:
 
 
 def cmd_batch(manifest_path: str, json_lines: bool = False,
-              out=None) -> int:
+              out=None, addr: str = None) -> int:
     out = out if out is not None else sys.stdout
     try:
         jobs = load_manifest(manifest_path)
@@ -125,34 +125,60 @@ def cmd_batch(manifest_path: str, json_lines: bool = False,
         print(f"error: {exc}", file=sys.stderr)
         return 1
     started = time.perf_counter()
-    results = run_batch(jobs)
+    if addr:
+        # run through a resident daemon instead of this process: the
+        # manifest is loaded (and its paths resolved) locally, shipped
+        # as one batch op, and the daemon's warm caches do the work
+        from .daemon import DaemonClient
+
+        try:
+            with DaemonClient(addr) as client:
+                response = client.request({
+                    "op": "batch",
+                    "jobs": [job.to_spec() for job in jobs],
+                })
+        except (OSError, ConnectionError) as exc:
+            print(f"error: daemon at {addr}: {exc}", file=sys.stderr)
+            return 1
+        if response.get("ok") is False and "error" in response:
+            print(f"error: daemon: {response['error']}",
+                  file=sys.stderr)
+            return 1
+        result_dicts = response.get("results", [])
+        backend = f"daemon:{addr}"
+    else:
+        results = run_batch(jobs)
+        result_dicts = [r.to_dict() for r in results]
+        backend = workers.backend()
     elapsed = time.perf_counter() - started
-    ok = sum(1 for r in results if r.ok)
-    cached = sum(1 for r in results if r.cached)
-    failed = len(results) - ok
+    ok = sum(1 for r in result_dicts if r["ok"])
+    cached = sum(1 for r in result_dicts if r["cached"])
+    failed = len(result_dicts) - ok
     summary = {
-        "jobs": len(results),
+        "jobs": len(result_dicts),
         "ok": ok,
         "cached": cached,
         "failed": failed,
         "seconds": round(elapsed, 4),
-        "backend": workers.backend(),
+        "backend": backend,
         "parallelism": n_jobs(),
     }
     if json_lines:
-        for result in results:
-            print(json.dumps(result.to_dict()), file=out)
+        for result in result_dicts:
+            print(json.dumps(result), file=out)
         print(json.dumps({"summary": summary}), file=out)
     else:
-        for result in results:
-            status = "ok  " if result.ok else "FAIL"
-            suffix = " (cached)" if result.cached else (
-                f" ({result.seconds:.2f}s)"
+        for result in result_dicts:
+            status = "ok  " if result["ok"] else "FAIL"
+            suffix = " (cached)" if result["cached"] else (
+                " ({:.2f}s)".format(result["seconds"])
             )
-            print(f"{status}  {result.id}  {result.command}{suffix}",
-                  file=out)
-            if not result.ok:
-                for line in result.stderr.rstrip().splitlines():
+            print(
+                f"{status}  {result['id']}  {result['command']}{suffix}",
+                file=out,
+            )
+            if not result["ok"]:
+                for line in result["stderr"].rstrip().splitlines():
                     print(f"      {line}", file=out)
         print(
             f"batch: {summary['jobs']} jobs, {ok} ok, {cached} cached, "

@@ -1,0 +1,777 @@
+"""``operator-forge daemon`` — the serve protocol for N clients.
+
+The stdio ``serve`` loop keeps one resident process hot for ONE caller.
+This module is the multi-client transport the reference toolchain
+solves with long-lived daemons (``gopls -listen``, the Bazel server):
+one hot process — warm ContentCache tiers, compiled interpreter
+closures, the pre-forked worker pool — multiplexed across many editors
+and CI shards over a unix or TCP socket.
+
+Architecture:
+
+- **listener** — ``daemon --listen <unix:/path|host:port>`` accepts up
+  to ``OPERATOR_FORGE_DAEMON_CLIENTS`` concurrent connections; each
+  becomes a :class:`~operator_forge.serve.session.Session` speaking the
+  existing newline-JSON ping/job/batch/watch/stats/explain/shutdown
+  protocol (a ``shutdown`` op from any client drains the whole daemon,
+  like ``gopls`` exit / ``bazel shutdown``);
+- **fair scheduler** — sessions own bounded request queues and a pool
+  of dispatcher threads (``OPERATOR_FORGE_DAEMON_WORKERS``) serves them
+  ROUND-ROBIN, one in-flight request per session, so a client that
+  queued a 64-job batch cannot starve an editor's single vet: the next
+  free dispatcher always takes the next *session's* request, not the
+  next request of the busiest session.  Queue wait is observable
+  (``daemon.queue_wait.seconds`` histogram, p50/p99 via ``stats``);
+- **backpressure** — admission is bounded twice: per session
+  (``OPERATOR_FORGE_DAEMON_SESSION_QUEUE``) and globally
+  (``OPERATOR_FORGE_DAEMON_QUEUE``).  An over-budget request is
+  answered immediately with the ``busy`` taxonomy kind and a
+  ``retry_after`` hint — never buffered without bound;
+- **cross-session safety** — requests that touch overlapping trees
+  serialize through a read/write path-lock (two clients hammering the
+  same project run their jobs one at a time, byte-identical to a
+  serial run; readers of one tree still fan out), while requests over
+  disjoint trees run concurrently.  Replay records are additionally
+  partitioned per project (:func:`operator_forge.serve.runner`'s
+  scoped namespaces layered on ContentCache);
+- **cache budgets under load** — a maintenance tick
+  (``OPERATOR_FORGE_DAEMON_IDLE_GC_S``) calls
+  :meth:`ContentCache.enforce_budget` so a long-lived daemon honors
+  ``OPERATOR_FORGE_CACHE_MAX_MB`` on BOTH resident tiers (mem LRU
+  eviction + disk LRU gc) even when no write ever crosses the
+  amortized on-write threshold;
+- **drain** — SIGTERM/SIGINT run the same
+  :func:`~operator_forge.serve.server.request_shutdown` machinery as
+  stdio serve (it lives once): the listener closes, in-flight requests
+  finish and are answered, every session gets a final ``{"op":
+  "shutdown", "drained": true}`` line, and the process exits 0.
+
+:class:`DaemonClient` is the client side — ``operator-forge connect``
+relays stdin/stdout to a daemon, and ``batch --addr`` runs a manifest
+through one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from ..perf import cache as pf_cache
+from ..perf import env_number, metrics, n_jobs
+from ..perf.remote import parse_listen
+from . import runner
+from . import server
+from .batch import _overlaps
+from .jobs import BatchManifestError, jobs_from_specs
+from .server import dispatch_request, request_timeout
+from .session import CONNECT_RETRY_AFTER_S, Session
+
+DEFAULT_MAX_CLIENTS = 64
+DEFAULT_SESSION_QUEUE = 16
+DEFAULT_GLOBAL_QUEUE = 256
+DEFAULT_IDLE_GC_S = 30.0
+
+
+def max_clients() -> int:
+    """Concurrent-connection ceiling (``OPERATOR_FORGE_DAEMON_CLIENTS``,
+    default 64); a connection beyond it is answered ``busy`` and
+    closed."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_CLIENTS", DEFAULT_MAX_CLIENTS,
+        cast=int, minimum=1,
+    )
+
+
+def session_queue_depth() -> int:
+    """Per-session pending-request bound
+    (``OPERATOR_FORGE_DAEMON_SESSION_QUEUE``, default 16)."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_SESSION_QUEUE", DEFAULT_SESSION_QUEUE,
+        cast=int, minimum=1,
+    )
+
+
+def global_queue_depth() -> int:
+    """Daemon-wide admission bound across all sessions
+    (``OPERATOR_FORGE_DAEMON_QUEUE``, default 256)."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_QUEUE", DEFAULT_GLOBAL_QUEUE,
+        cast=int, minimum=1,
+    )
+
+
+def daemon_workers() -> int:
+    """Dispatcher-thread count (``OPERATOR_FORGE_DAEMON_WORKERS``;
+    default: CPU-bound-ish, at least 2 so a long batch never blocks an
+    editor's vet)."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_WORKERS",
+        max(2, min(8, n_jobs())), cast=int, minimum=1,
+    )
+
+
+def idle_gc_interval() -> float:
+    """Seconds between cache-budget maintenance ticks
+    (``OPERATOR_FORGE_DAEMON_IDLE_GC_S``, default 30; <= 0 disables)."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_IDLE_GC_S", DEFAULT_IDLE_GC_S,
+        minimum=None,
+    )
+
+
+def lock_timeout() -> float:
+    """How long a dispatcher waits for conflicting trees to free
+    before answering ``busy`` (``OPERATOR_FORGE_DAEMON_LOCK_S``,
+    default 60).  Bounded so a long-lived holder (a watch over the
+    same tree, a deadline-abandoned writer still running detached) can
+    only ever cost a conflicting client a retry, never a permanently
+    parked dispatcher thread."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_LOCK_S", 60.0, minimum=0.1
+    )
+
+
+def _request_roots(req: dict, base_dir: str) -> tuple:
+    """(reads, writes) directory sets a request will touch — the
+    daemon's cross-session conflict key.  Unparseable specs lock
+    nothing (dispatch answers ``bad_request`` anyway).  This parses
+    the specs a second time (``_handle`` parses them again inside the
+    dispatch) — deliberate: the roots are needed BEFORE dispatch to
+    take the locks, and spec normalization is path arithmetic, far
+    below one job's tree-state snapshot cost."""
+    op = req.get("op") or ("job" if "command" in req else None)
+    if op == "job":
+        specs = [
+            req.get("job") if "job" in req
+            else {k: v for k, v in req.items() if k not in ("op",)}
+        ]
+    elif op in ("batch", "watch"):
+        specs = req.get("jobs")
+    else:
+        return (), ()
+    try:
+        jobs = jobs_from_specs(specs, base_dir)
+    except (BatchManifestError, TypeError, ValueError):
+        return (), ()
+    reads: list = []
+    writes: list = []
+    for job in jobs:
+        for root in job.reads():
+            if root not in reads:
+                reads.append(root)
+        for root in job.writes():
+            if root not in writes:
+                writes.append(root)
+    return tuple(reads), tuple(writes)
+
+
+class _PathLocks:
+    """All-or-nothing read/write locks over directory roots (nested
+    dirs overlap, like the batch scheduler's conflict rule): writers
+    exclude everything overlapping, readers exclude only overlapping
+    writers.  Acquisition is atomic over the whole root set, so two
+    requests can never deadlock holding halves of each other's roots,
+    and BOUNDED: a conflict that does not clear within the timeout
+    returns ``None`` so the caller answers ``busy`` instead of parking
+    a dispatcher thread forever behind a long-lived holder."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._held: list = []  # (root, is_write)
+
+    def _conflicts(self, reads, writes) -> bool:
+        for root, held_write in self._held:
+            for w in writes:
+                if _overlaps(root, w):
+                    return True
+            if held_write:
+                for r in reads:
+                    if _overlaps(root, r):
+                        return True
+        return False
+
+    def acquire(self, reads, writes, timeout=None, cancelled=None):
+        """A token on success; ``None`` when the conflict did not
+        clear within ``timeout``, the request was ``cancelled`` (its
+        client disconnected), or a drain began mid-wait."""
+        reads = tuple(sorted(set(reads)))
+        writes = tuple(sorted(set(writes)))
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._cond:
+            while self._conflicts(reads, writes):
+                if cancelled is not None and cancelled.is_set():
+                    return None
+                if server.draining():
+                    return None
+                wait = 0.25
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+            for root in reads:
+                self._held.append((root, False))
+            for root in writes:
+                self._held.append((root, True))
+        return (reads, writes)
+
+    def release(self, token) -> None:
+        if token is None:
+            return
+        reads, writes = token
+        with self._cond:
+            for root in reads:
+                self._held.remove((root, False))
+            for root in writes:
+                self._held.remove((root, True))
+            self._cond.notify_all()
+
+
+class ForgeDaemon:
+    """The multi-client daemon: listener + sessions + fair scheduler."""
+
+    def __init__(self, listen: str, clients=None):
+        self.spec = parse_listen(listen)
+        self._max_clients = clients if clients else max_clients()
+        self.base_dir = os.getcwd()
+        self._listener = None
+        self._accept_thread = None
+        self._dispatchers: list = []
+        self._maintenance = None
+        self._stop_event = threading.Event()
+        self._cond = threading.Condition()
+        self._sessions: list = []
+        self._queued = 0  # global pending count, guarded by _cond
+        self._rr = 0      # round-robin cursor, guarded by _cond
+        self._next_sid = 0
+        self._locks = _PathLocks()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        self._stop_done = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def address(self) -> str:
+        if self.spec[0] == "unix":
+            return self.spec[1]
+        host, port = self._listener.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def _bind(self) -> None:
+        if self.spec[0] == "unix":
+            path = self.spec[1]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.spec[1], self.spec[2]))
+        sock.listen(min(128, self._max_clients * 2))
+        # a bounded accept timeout: neither close() nor shutdown()
+        # reliably wakes a thread blocked in accept() (AF_UNIX on
+        # Linux), so the accept loop wakes on its own to observe the
+        # drain flag — worst-case drain latency is one poll
+        sock.settimeout(0.5)
+        self._listener = sock
+
+    def _boot(self) -> None:
+        # per-request serve:* spans are part of the stats contract,
+        # exactly like the stdio loop
+        from ..perf import spans
+
+        spans.enable(True)
+        server._drain.clear()
+        self._stop_event.clear()
+        server.on_drain(self._on_drain)
+        server.register_stats_source("daemon", self._stats_payload)
+        metrics.register_gauge(
+            "daemon.active_sessions", lambda: len(self._sessions)
+        )
+        metrics.register_gauge(
+            "daemon.queued_requests", lambda: self._queued
+        )
+        # concurrent clients on different trees share one ContentCache:
+        # partition the replay namespaces per project
+        runner.set_project_scoping(True)
+        for i in range(daemon_workers()):
+            thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"daemon-dispatch-{i}",
+            )
+            thread.start()
+            self._dispatchers.append(thread)
+        if idle_gc_interval() > 0:
+            self._maintenance = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name="daemon-maintenance",
+            )
+            self._maintenance.start()
+
+    def start(self) -> None:
+        """Bind and accept on a background thread (tests, bench).  The
+        CLI uses :meth:`serve_forever` instead."""
+        if self._listener is None:
+            self._bind()
+        self._boot()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="daemon-accept",
+        )
+        self._accept_thread.start()
+
+    def serve_forever(self) -> None:
+        """Blocking accept loop on the calling thread (the CLI path);
+        a drain — signal or a client's shutdown op — returns."""
+        if self._listener is None:
+            self._bind()
+        self._boot()
+        self._accept_loop()
+
+    def _on_drain(self) -> None:
+        # runs (possibly in signal-handler context) when a drain
+        # begins: break the blocked accept and wake the scheduler so
+        # dispatchers can retire.  Must stay tiny and non-blocking.
+        try:
+            # new connections are refused from here on; the accept
+            # thread itself wakes via its bounded accept timeout
+            # (neither close nor shutdown reliably interrupts a
+            # blocked accept on AF_UNIX)
+            self._listener.close()
+        except (OSError, AttributeError):
+            pass
+        self._stop_event.set()
+        # best-effort wake: this may run as a SIGNAL HANDLER on the
+        # main thread, and the accept loop (same thread) may hold
+        # _cond at that instant — a blocking acquire would
+        # self-deadlock.  Dispatchers re-check the drain flag on a
+        # bounded wait anyway, so a skipped notify only costs latency
+        if self._cond.acquire(blocking=False):
+            try:
+                self._cond.notify_all()
+            finally:
+                self._cond.release()
+
+    def _accept_loop(self) -> None:
+        while not server.draining():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue  # periodic wakeup: re-check the drain flag
+            except OSError:
+                return  # listener closed: draining
+            conn.settimeout(None)  # sessions use blocking I/O
+            if server.draining():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            with self._cond:
+                active = len(self._sessions)
+            if active >= self._max_clients:
+                # admission control at the connection level: answer
+                # once (the busy taxonomy kind), close, keep listening
+                metrics.counter("daemon.busy_rejections").inc()
+                payload = server._error(
+                    f"daemon at its {self._max_clients}-client "
+                    "capacity", kind="busy",
+                )
+                payload["retry_after"] = CONNECT_RETRY_AFTER_S
+                try:
+                    conn.sendall(
+                        (json.dumps(payload) + "\n").encode("utf-8")
+                    )
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            with self._cond:
+                self._next_sid += 1
+                session = Session(self, conn, f"s{self._next_sid}")
+                self._sessions.append(session)
+            metrics.counter("daemon.sessions_opened").inc()
+            metrics.register_gauge(
+                f"daemon.session.{session.id}.queue_depth",
+                session.queue_depth,
+            )
+            session.start()
+
+    # -- admission (reader threads) --------------------------------------
+
+    def _enqueue(self, session: Session, req: dict) -> None:
+        rejected = None
+        with self._cond:
+            if server.draining():
+                rejected = "daemon is draining"
+            elif len(session.queue) >= session_queue_depth():
+                rejected = (
+                    f"session queue full "
+                    f"({session_queue_depth()} pending)"
+                )
+            elif self._queued >= global_queue_depth():
+                rejected = (
+                    f"admission queue full "
+                    f"({global_queue_depth()} pending)"
+                )
+            else:
+                session.queue.append((req, time.monotonic()))
+                self._queued += 1
+                metrics.counter("daemon.requests").inc()
+                self._cond.notify()
+        if rejected is not None:
+            session.reject_busy(req, rejected)
+
+    def _reader_finished(self, session: Session) -> None:
+        with self._cond:
+            self._cond.notify_all()
+        self._maybe_close(session)
+
+    def _maybe_close(self, session: Session) -> None:
+        """Retire a session whose client is done: reader at EOF (or
+        dead transport), nothing queued, nothing in flight."""
+        with self._cond:
+            done = session.read_done and not session.busy and (
+                not session.queue or session.dead.is_set()
+            )
+            if done:
+                if session.queue:
+                    # a dead client's queued remainder is abandoned
+                    metrics.counter("serve.requests_abandoned").inc(
+                        len(session.queue)
+                    )
+                    self._queued -= len(session.queue)
+                    session.queue.clear()
+                if session in self._sessions:
+                    self._sessions.remove(session)
+                else:
+                    done = False
+        if done:
+            metrics.unregister_gauge(
+                f"daemon.session.{session.id}.queue_depth"
+            )
+            metrics.counter("daemon.sessions_closed").inc()
+            session.close()
+
+    # -- the fair scheduler ----------------------------------------------
+
+    def _next_work(self):
+        """Round-robin across sessions with pending work: block until a
+        request is dispatchable, return ``(session, req, waited_s)`` —
+        or ``None`` when draining (dispatchers retire)."""
+        with self._cond:
+            while True:
+                if server.draining():
+                    return None
+                n = len(self._sessions)
+                for offset in range(n):
+                    index = (self._rr + 1 + offset) % n
+                    session = self._sessions[index]
+                    if session.busy or not session.queue:
+                        continue
+                    if session.dead.is_set():
+                        continue  # _maybe_close will reap it
+                    self._rr = index
+                    req, waited = session.pop_request()
+                    self._queued -= 1
+                    session.busy = True
+                    return session, req, waited
+                # bounded: the drain wake from _on_drain is
+                # best-effort (signal-handler context), so the flag is
+                # re-checked on a timer as the backstop
+                self._cond.wait(0.5)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            work = self._next_work()
+            if work is None:
+                return
+            session, req, waited = work
+            metrics.histogram("daemon.queue_wait.seconds").observe(
+                waited
+            )
+            abandoned = threading.Event()
+            session.current_abandoned = abandoned
+            if session.dead.is_set():
+                abandoned.set()
+            keep_going = True
+            try:
+                if abandoned.is_set():
+                    metrics.counter("serve.requests_abandoned").inc()
+                else:
+                    reads, writes = _request_roots(req, self.base_dir)
+                    token = self._locks.acquire(
+                        reads, writes, timeout=lock_timeout(),
+                        cancelled=session.dead,
+                    )
+                    if token is None:
+                        # the conflicting holder (a watch over the same
+                        # tree, a still-running abandoned writer) did
+                        # not clear in time: backpressure, not an
+                        # indefinitely parked dispatcher
+                        metrics.counter("daemon.lock_timeouts").inc()
+                        session.reject_busy(
+                            req,
+                            "a conflicting request holds the target "
+                            "tree(s); retry",
+                        )
+                    else:
+                        # released via on_settled — which, for a
+                        # deadline-abandoned request, fires only when
+                        # the detached handler actually finishes, so a
+                        # zombie writer keeps its trees locked and no
+                        # sibling can interleave writes with it
+                        keep_going = dispatch_request(
+                            req, self.base_dir, session.out_lock,
+                            session.respond_locked, request_timeout(),
+                            abandoned=abandoned,
+                            on_settled=(
+                                lambda _t=token:
+                                self._locks.release(_t)
+                            ),
+                        )
+            finally:
+                session.current_abandoned = None
+                with self._cond:
+                    session.busy = False
+                    session.requests_total += 1
+                    self._cond.notify_all()
+            self._maybe_close(session)
+            if not keep_going:
+                # a client-requested shutdown drains the whole daemon
+                # through the one shared drain implementation; this
+                # dispatcher runs the teardown itself (stop() skips
+                # joining the calling thread) so every session gets its
+                # drained-shutdown line even in embedded (start()) mode
+                server.request_shutdown()
+                self.stop()
+
+    # -- maintenance -----------------------------------------------------
+
+    def _maintenance_loop(self) -> None:
+        interval = idle_gc_interval()
+        while not self._stop_event.wait(interval):
+            try:
+                pf_cache.get_cache().enforce_budget()
+            except Exception:
+                pass  # maintenance must never take the daemon down
+
+    # -- stats -----------------------------------------------------------
+
+    def _stats_payload(self) -> dict:
+        with self._cond:
+            sessions = {s.id: s.state() for s in self._sessions}
+            queued = self._queued
+        return {
+            "listen": self.address(),
+            "max_clients": self._max_clients,
+            "active_sessions": len(sessions),
+            "queued_requests": queued,
+            "sessions": {k: sessions[k] for k in sorted(sessions)},
+        }
+
+    # -- teardown --------------------------------------------------------
+
+    def stop(self) -> None:
+        """Drain and tear down (idempotent): finish in-flight requests,
+        answer them, send every session the final drained-shutdown
+        line, release globals."""
+        with self._stop_lock:
+            if self._stopped:
+                # a concurrent caller (the CLI's finally racing a
+                # shutdown-op dispatcher) must not return before the
+                # first stop finished tearing sessions down
+                self._stop_done.wait(60.0)
+                return
+            self._stopped = True
+        server.request_shutdown()  # idempotent; runs _on_drain once
+        current = threading.current_thread()
+        for thread in self._dispatchers:
+            if thread is not current:
+                # generous: drain promises FINISHING in-flight work,
+                # and a cold batch request can legitimately run long
+                thread.join(60.0)
+        with self._cond:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+            self._queued = 0
+        for session in sessions:
+            try:
+                session.respond(
+                    {"ok": True, "op": "shutdown", "drained": True}
+                )
+            except Exception:
+                pass
+            metrics.unregister_gauge(
+                f"daemon.session.{session.id}.queue_depth"
+            )
+            session.close()
+        thread = self._accept_thread
+        if thread is not None and thread is not current:
+            thread.join(5.0)
+        if self.spec[0] == "unix":
+            try:
+                os.unlink(self.spec[1])
+            except OSError:
+                pass
+        server.remove_drain_callback(self._on_drain)
+        server.unregister_stats_source("daemon")
+        metrics.unregister_gauge("daemon.active_sessions")
+        metrics.unregister_gauge("daemon.queued_requests")
+        runner.set_project_scoping(False)
+        self._stop_done.set()
+
+
+def serve_daemon(listen: str, clients=None) -> int:
+    """The ``operator-forge daemon`` entry point: bind, print one
+    status line on stderr, serve until SIGTERM/SIGINT (or a client's
+    shutdown op), then drain and exit 0."""
+    import sys
+
+    daemon = ForgeDaemon(listen, clients=clients)
+    daemon._bind()
+    print(
+        f"daemon: listening on {daemon.address()} "
+        f"(max {daemon._max_clients} clients)",
+        file=sys.stderr, flush=True,
+    )
+    installed = []
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                installed.append((
+                    signum,
+                    signal.signal(signum, server.request_shutdown),
+                ))
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+    try:
+        daemon.serve_forever()
+    except server._DrainSignal:
+        pass  # signal broke the blocked accept: drain below
+    finally:
+        daemon.stop()
+        if installed:
+            import signal
+
+            for signum, previous in installed:
+                try:
+                    signal.signal(signum, previous)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+    print("daemon: drained, exiting", file=sys.stderr, flush=True)
+    return 0
+
+
+# -- client ----------------------------------------------------------------
+
+
+class DaemonClient:
+    """One connection to a running daemon.  Requests go out as JSON
+    lines; responses come back one JSON object per line, each echoing
+    the request's ``id`` (``busy`` rejections may arrive ahead of an
+    earlier queued request's answer — correlate by id when
+    pipelining)."""
+
+    def __init__(self, addr: str, timeout=None):
+        spec = parse_listen(addr)
+        if spec[0] == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            if timeout:
+                sock.settimeout(timeout)
+            sock.connect(spec[1])
+        else:
+            sock = socket.create_connection(
+                (spec[1], spec[2]), timeout=timeout
+            )
+        self._sock = sock
+        self._reader = sock.makefile("r", encoding="utf-8")
+
+    def send(self, payload: dict) -> None:
+        self._sock.sendall(
+            (json.dumps(payload) + "\n").encode("utf-8")
+        )
+
+    def read(self):
+        """The next response line as a dict, or ``None`` when the
+        daemon closed the connection."""
+        line = self.read_line()
+        if not line:
+            return None
+        return json.loads(line)
+
+    # raw-line surface for relays (`operator-forge connect`): the
+    # protocol is line-oriented, so a pass-through client should not
+    # have to re-encode through dicts (or reach into the transport)
+
+    def send_line(self, line: str) -> None:
+        """Forward one raw protocol line (newline appended if
+        missing)."""
+        if not line.endswith("\n"):
+            line += "\n"
+        self._sock.sendall(line.encode("utf-8"))
+
+    def read_line(self) -> str:
+        """The next raw response line (``""`` on EOF)."""
+        return self._reader.readline()
+
+    def half_close(self) -> None:
+        """Shut down the write side: no more requests will be sent,
+        but remaining responses can still be read until the daemon
+        closes."""
+        import socket as _socket
+
+        try:
+            self._sock.shutdown(_socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def request(self, payload: dict) -> dict:
+        """One round trip (non-streaming ops)."""
+        self.send(payload)
+        response = self.read()
+        if response is None:
+            raise ConnectionError("daemon closed the connection")
+        return response
+
+    def stream(self, payload: dict):
+        """Send a streaming op (watch) and yield every response line
+        until the terminal one (``done`` or an error)."""
+        self.send(payload)
+        while True:
+            response = self.read()
+            if response is None:
+                return
+            yield response
+            if response.get("done") or response.get("ok") is False:
+                return
+
+    def close(self) -> None:
+        try:
+            self._reader.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

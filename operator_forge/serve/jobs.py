@@ -96,6 +96,17 @@ class Job:
             return (os.path.abspath(self.output_dir),)
         return ()
 
+    def to_spec(self) -> dict:
+        """The job as a serve-protocol spec mapping (paths already
+        resolved) — how ``batch --addr`` ships a locally loaded
+        manifest to a running daemon."""
+        out = {"command": self.command, "id": self.id}
+        for key in COMMANDS[self.command]:
+            value = getattr(self, key.replace("-", "_"))
+            if value:
+                out[key] = value
+        return out
+
     def argv(self) -> list:
         if self.command == "init":
             out = ["init", "--workload-config", self.workload_config,

@@ -54,6 +54,41 @@ from .jobs import Job, JobResult
 _STAGE = "serve.job"
 _SCHEMA = 1
 
+# -- per-project cache namespaces (daemon) -------------------------------
+#
+# The daemon serves many clients on many trees through ONE ContentCache.
+# Content keys already make cross-tree collisions impossible (every key
+# folds in the tree-state hashes), but one flat namespace means one
+# project's churn competes with every other's in the mem/disk LRU and
+# the per-namespace stats lump all clients together.  With scoping
+# enabled (the daemon turns it on), each job's replay records land in a
+# per-project namespace — `serve.job.<12-hex of its target dir>` —
+# layered on the shared store: eviction pressure and hit/miss
+# attribution partition per tree, and the bytes recorded are identical
+# (replay == re-run still holds, namespaces only partition the store).
+
+_project_scoped = [False]
+
+
+def set_project_scoping(enabled: bool) -> None:
+    """Enable per-project cache namespaces (the daemon's setting; the
+    stdio serve loop and one-shot batch keep the flat namespace)."""
+    _project_scoped[0] = bool(enabled)
+
+
+def project_scoping() -> bool:
+    return _project_scoped[0]
+
+
+def _scope_label(roots) -> str:
+    return pf_cache.hash_parts(tuple(sorted(roots)))[:12]
+
+
+def _job_stage(job: Job) -> str:
+    if not _project_scoped[0]:
+        return _STAGE
+    return f"{_STAGE}.{_scope_label((job.target(),))}"
+
 #: bounded deterministic retry for exceptions that escape a job's own
 #: error handling (``OPERATOR_FORGE_JOB_RETRIES``) — a job that *fails*
 #: (nonzero rc) is a result and is never retried; a job that *raises*
@@ -171,6 +206,7 @@ def run_job(job: Job) -> JobResult:
     from ..cli.main import main as cli_main
 
     cache = pf_cache.get_cache()
+    stage = _job_stage(job)
     key = None
     pre_out: tuple = ()
     if cache.mode() != "off":
@@ -181,7 +217,7 @@ def run_job(job: Job) -> JobResult:
             out_root = _out_root(job)
             pre_out = _tree_state(out_root) if out_root else ()
             key = _job_key(job, pre_deps, pre_out)
-        hit = cache.get(_STAGE, key)
+        hit = cache.get(stage, key)
         if hit is not pf_cache.MISS:
             rc, stdout, stderr = hit
             metrics.counter("serve.jobs_replayed").inc()
@@ -249,7 +285,7 @@ def run_job(job: Job) -> JobResult:
         if post_out == pre_out:
             # fixed point: replaying (skipping) this job later is
             # indistinguishable from re-running it on the same bytes
-            cache.put(_STAGE, key, (rc, result.stdout, result.stderr))
+            cache.put(stage, key, (rc, result.stdout, result.stderr))
     return result
 
 
@@ -278,6 +314,12 @@ def run_group(group) -> list:
     order), replaying the whole chain when nothing it reads or writes
     has changed since a recorded fixed-point run."""
     cache = pf_cache.get_cache()
+    group_stage = _GROUP_STAGE
+    if _project_scoped[0]:
+        group_stage = (
+            f"{_GROUP_STAGE}."
+            f"{_scope_label({job.target() for job in group})}"
+        )
     key = None
     pre_out: tuple = ()
     if len(group) > 1 and cache.mode() != "off":
@@ -298,7 +340,7 @@ def run_group(group) -> list:
                 if any(job.command == "test" for job in group) else "",
                 pre_deps, pre_out,
             )
-        hit = cache.get(_GROUP_STAGE, key)
+        hit = cache.get(group_stage, key)
         if hit is not pf_cache.MISS:
             metrics.counter("serve.jobs_replayed").inc(len(group))
             for _ in group:
@@ -324,7 +366,7 @@ def run_group(group) -> list:
             # restored the minimal main.go and create-api re-completed
             # it): skipping the whole group later reproduces this state
             cache.put(
-                _GROUP_STAGE, key,
+                group_stage, key,
                 [(r.rc, r.stdout, r.stderr) for r in results],
             )
     return results

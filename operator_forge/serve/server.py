@@ -73,6 +73,15 @@ Robustness (PR 7):
   emitted, and the loop exits 0 without taking further work;
 - the ``stats`` op additionally reports the worker-pool state
   (``workers``: backend, degraded flag, reason).
+
+Multi-client transport (PR 10): the same protocol is served to N
+concurrent socket clients by ``operator-forge daemon``
+(:mod:`operator_forge.serve.daemon`) — per-connection sessions
+(:mod:`operator_forge.serve.session`) multiplex over this module's
+shared :func:`dispatch_request` machinery, so the deadline, taxonomy
+(including the daemon-only ``busy`` admission rejections), and the
+SIGTERM/SIGINT drain implementation live once and cannot drift between
+the stdio and socket transports.
 """
 
 from __future__ import annotations
@@ -93,11 +102,14 @@ from .runner import run_job
 #: error taxonomy: why did a request fail?
 #: - ``bad_request`` — the client sent something unusable (bad JSON,
 #:   unknown op, invalid manifest/params)
+#: - ``busy`` — admission control rejected the request (a daemon
+#:   session's queue, or the global admission queue, is full); the
+#:   response carries a ``retry_after`` hint in seconds
 #: - ``timeout`` — the per-request deadline expired
 #: - ``infra`` — the execution substrate failed (dead process pool,
 #:   pickle transport, I/O)
 #: - ``internal`` — an unclassified server-side bug
-ERROR_KINDS = ("bad_request", "timeout", "infra", "internal")
+ERROR_KINDS = ("bad_request", "busy", "timeout", "infra", "internal")
 
 
 class _AbandonedRequest(Exception):
@@ -107,6 +119,33 @@ class _AbandonedRequest(Exception):
 
 
 _drain = threading.Event()
+
+#: callbacks run once when a drain begins — the socket daemon registers
+#: one that closes its listener (breaking the blocked ``accept``) and
+#: wakes its scheduler, so the SIGTERM/SIGINT machinery lives ONCE here
+#: and both transports (stdio serve, socket daemon) share it
+_drain_callbacks: list = []
+
+
+def on_drain(callback) -> None:
+    """Register a callback to run when a drain begins (idempotent per
+    drain: callbacks fire only on the first :func:`request_shutdown`).
+    Callbacks may run in signal-handler context — keep them tiny and
+    non-blocking (closing a socket, setting an event)."""
+    if callback not in _drain_callbacks:
+        _drain_callbacks.append(callback)
+
+
+def remove_drain_callback(callback) -> None:
+    try:
+        _drain_callbacks.remove(callback)
+    except ValueError:
+        pass
+
+
+def draining() -> bool:
+    """Whether a drain has been requested (shared by both transports)."""
+    return _drain.is_set()
 
 
 class _DrainSignal(BaseException):
@@ -138,6 +177,12 @@ def request_shutdown(signum=None, frame=None) -> None:
     flight (aborting one would violate the drain promise)."""
     already = _drain.is_set()
     _drain.set()
+    if not already:
+        for callback in list(_drain_callbacks):
+            try:
+                callback()
+            except Exception:
+                pass  # a drain must never die in a notification hook
     if signum is not None and not already and not _busy[0]:
         raise _DrainSignal()
 
@@ -161,6 +206,33 @@ def _classify(exc: BaseException) -> str:
     ):
         return "infra"
     return "internal"
+
+
+#: extra top-level keys merged into every ``stats`` response — the
+#: daemon registers its session/queue surface here so the one shared
+#: stats op reports it without the server module knowing the daemon
+_STATS_SOURCES: dict = {}
+
+
+def register_stats_source(name: str, fn) -> None:
+    """``fn()`` is called per ``stats`` request and its result becomes
+    the response's ``name`` key (the daemon's per-session queue-depth /
+    active-session surface)."""
+    _STATS_SOURCES[name] = fn
+
+
+def unregister_stats_source(name: str) -> None:
+    _STATS_SOURCES.pop(name, None)
+
+
+def _count_error(payload: dict) -> None:
+    """Account an error response by taxonomy kind — shared by every
+    transport's respond path so ``serve.errors.<kind>`` counters cover
+    stdio and socket sessions alike."""
+    if payload.get("ok") is False and "error_kind" in payload:
+        metrics.counter(
+            "serve.errors." + str(payload["error_kind"])
+        ).inc()
 
 
 def _error(message: str, req_id=None, kind: str = "bad_request") -> dict:
@@ -195,19 +267,24 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
         compiler = _sys.modules.get("operator_forge.gocheck.compiler")
         if compiler is not None:
             compiler.flush_counters()  # compile.reused is tallied lazily
-        return (
-            {"ok": True, "op": "stats", "cache": metrics.cache_report(),
-             "graph": GRAPH.counters(),
-             "metrics": metrics.snapshot(),
-             "provenance": {
-                 "last_invalidation": GRAPH.last_invalidation(),
-                 "recorded": GRAPH.provenance(),
-             },
-             "remote": remote.state(),
-             "spans": spans.snapshot(),
-             "workers": workers.pool_state()},
-            True,
-        )
+        payload = {
+            "ok": True, "op": "stats", "cache": metrics.cache_report(),
+            "graph": GRAPH.counters(),
+            "metrics": metrics.snapshot(),
+            "provenance": {
+                "last_invalidation": GRAPH.last_invalidation(),
+                "recorded": GRAPH.provenance(),
+            },
+            "remote": remote.state(),
+            "spans": spans.snapshot(),
+            "workers": workers.pool_state(),
+        }
+        for name, fn in sorted(_STATS_SOURCES.items()):
+            try:
+                payload[name] = fn()
+            except Exception:
+                pass  # a stats source must never fail the stats op
+        return (payload, True)
     if op == "explain":
         import os as _os
 
@@ -333,6 +410,153 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
     return (_error(f"unknown op {op!r}", req_id), True)
 
 
+def dispatch_request(req: dict, base_dir: str, out_lock,
+                     respond_locked, deadline: float,
+                     abandoned=None, on_settled=None) -> bool:
+    """Dispatch ONE parsed request through the shared machinery —
+    deadline boxing, the error taxonomy, id echo, ``seconds`` stamping,
+    streaming-emit abandonment — and answer it via ``respond_locked``
+    (called with ``out_lock`` held; it must write exactly one protocol
+    line and may raise :class:`_AbandonedRequest` when its transport is
+    gone).  Returns ``keep_going`` (``False`` for the shutdown op).
+
+    Both transports call this: the stdio loop with its stdout writer,
+    each daemon session with its socket writer — so the PR 7 behaviors
+    (timeout answers, ``serve.errors.<kind>`` accounting, late-emit
+    suppression) cannot drift between them.  ``abandoned`` optionally
+    supplies the request's cancellation Event (a daemon session passes
+    one it can set when the client disconnects mid-request).
+
+    ``on_settled`` is called EXACTLY ONCE when the handler's side
+    effects are actually over: on normal completion, on error — or,
+    for a deadline-abandoned request, when the detached handler thread
+    finally finishes, which may be long after the timeout answer went
+    out.  The daemon hangs its cross-session path-lock release here,
+    so a zombie writer keeps its trees locked and no sibling session
+    can interleave writes with it."""
+    settle_lock = threading.Lock()
+    settled = [False]
+
+    def settle() -> None:
+        if on_settled is None:
+            return
+        with settle_lock:
+            if settled[0]:
+                return
+            settled[0] = True
+        on_settled()
+
+    handed_off = [False]
+    try:
+        return _dispatch_inner(
+            req, base_dir, out_lock, respond_locked, deadline,
+            abandoned, settle, handed_off,
+        )
+    except _AbandonedRequest:
+        # the transport died mid-request (client disconnect): the work
+        # was abandoned cleanly — counted, never answered
+        metrics.counter("serve.requests_abandoned").inc()
+        return True
+    finally:
+        # every path settles: directly here, unless settlement was
+        # handed to a deadline-boxed handler thread (whose own finally
+        # fires when the handler truly finishes, detached or not)
+        if not handed_off[0]:
+            settle()
+
+
+def _dispatch_inner(req, base_dir, out_lock, respond_locked,
+                    deadline, abandoned, settle, handed_off):
+    op = req.get("op") or ("job" if "command" in req else "?")
+    req_id = req.get("id")
+    started = time.perf_counter()
+    if abandoned is None:
+        abandoned = threading.Event()
+
+    def respond(payload: dict) -> None:
+        with out_lock:
+            respond_locked(payload)
+
+    def guarded_emit(payload: dict, _flag=abandoned) -> None:
+        # a deadline-abandoned (or disconnected) handler must not
+        # interleave its late stream lines into the protocol; the flag
+        # check and the write share out_lock with the timeout response,
+        # so either the emit lands whole before the abandonment or not
+        # at all.  Raising (instead of silently dropping) unwinds
+        # streaming handlers — a watch loop would otherwise keep
+        # polling and running jobs forever after its client already got
+        # the timeout answer (or went away)
+        with out_lock:
+            if _flag.is_set():
+                raise _AbandonedRequest()
+            respond_locked(payload)
+
+    def dispatch():
+        with spans.span(f"serve:{op}"):
+            return _handle(req, base_dir, emit=guarded_emit,
+                           abandoned=abandoned)
+
+    try:
+        if deadline > 0:
+            box: dict = {}
+
+            def run_boxed(_box=box, _dispatch=dispatch):
+                try:
+                    _box["out"] = _dispatch()
+                except BaseException as exc:
+                    _box["exc"] = exc
+                finally:
+                    # the handler's side effects end HERE — possibly
+                    # long after a timeout answer abandoned it
+                    settle()
+
+            worker = threading.Thread(
+                target=run_boxed, daemon=True, name="serve-request",
+            )
+            worker.start()
+            handed_off[0] = True
+            worker.join(deadline)
+            if worker.is_alive():
+                # the handler keeps running detached until its next
+                # emit unwinds it; its response (and any late stream
+                # lines) are dropped.  The flag is set under out_lock
+                # so no emit is mid-write when the timeout answer goes
+                # out
+                with out_lock:
+                    abandoned.set()
+                metrics.counter("serve.requests_abandoned").inc()
+                respond(_error(
+                    f"deadline exceeded after {deadline:g}s",
+                    req_id, kind="timeout",
+                ))
+                return True
+            if "exc" in box:
+                raise box["exc"]
+            response, keep_going = box["out"]
+        else:
+            response, keep_going = dispatch()
+    except _AbandonedRequest:
+        raise  # the transport is gone: counted by dispatch_request
+    except BatchManifestError as exc:
+        respond(_error(str(exc), req_id))
+        return True
+    except Exception as exc:  # must not kill the serving loop
+        kind = _classify(exc)
+        label = "internal error" if kind == "internal" else (
+            f"{kind} error"
+        )
+        respond(_error(f"{label}: {exc}", req_id, kind=kind))
+        return True
+    if req_id is not None:
+        # the request id wins over a job spec's defaulted id
+        response["id"] = req_id
+    response.setdefault(
+        "seconds", round(time.perf_counter() - started, 4)
+    )
+    respond(response)
+    return keep_going
+
+
 def serve_loop(in_stream=None, out_stream=None) -> int:
     """Serve requests until shutdown/EOF/drain.  Streams default to
     stdin/stdout (the ``operator-forge serve`` entry point)."""
@@ -355,10 +579,7 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
     def _respond_locked(payload: dict) -> None:
         # every error response is accounted by kind — the serve.errors
         # taxonomy the stats op surfaces
-        if payload.get("ok") is False and "error_kind" in payload:
-            metrics.counter(
-                "serve.errors." + str(payload["error_kind"])
-            ).inc()
+        _count_error(payload)
         out_stream.write(json.dumps(payload) + "\n")
         out_stream.flush()
 
@@ -421,88 +642,9 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
                 if not isinstance(req, dict):
                     respond(_error("request must be a JSON object"))
                     continue
-                op = req.get("op") or ("job" if "command" in req else "?")
-                started = time.perf_counter()
-                abandoned = threading.Event()
-
-                def guarded_emit(payload: dict, _flag=abandoned) -> None:
-                    # a deadline-abandoned handler must not interleave
-                    # its late stream lines into the protocol; the flag
-                    # check and the write share out_lock with the
-                    # timeout response, so either the emit lands whole
-                    # before the abandonment or not at all.  Raising
-                    # (instead of silently dropping) unwinds streaming
-                    # handlers — a watch loop would otherwise keep
-                    # polling and running jobs forever after its client
-                    # got the timeout answer
-                    with out_lock:
-                        if _flag.is_set():
-                            raise _AbandonedRequest()
-                        _respond_locked(payload)
-
-                def dispatch(_req=req, _op=op, _emit=guarded_emit,
-                             _abandoned=abandoned):
-                    with spans.span(f"serve:{_op}"):
-                        return _handle(_req, base_dir, emit=_emit,
-                                       abandoned=_abandoned)
-
-                try:
-                    if deadline > 0:
-                        box: dict = {}
-
-                        def run_boxed(_box=box, _dispatch=dispatch):
-                            try:
-                                _box["out"] = _dispatch()
-                            except BaseException as exc:
-                                _box["exc"] = exc
-
-                        worker = threading.Thread(
-                            target=run_boxed, daemon=True,
-                            name="serve-request",
-                        )
-                        worker.start()
-                        worker.join(deadline)
-                        if worker.is_alive():
-                            # the handler keeps running detached until
-                            # its next emit unwinds it; its response
-                            # (and any late stream lines) are dropped.
-                            # The flag is set under out_lock so no emit
-                            # is mid-write when the timeout answer goes
-                            # out
-                            with out_lock:
-                                abandoned.set()
-                            metrics.counter(
-                                "serve.requests_abandoned"
-                            ).inc()
-                            respond(_error(
-                                f"deadline exceeded after {deadline:g}s",
-                                req.get("id"), kind="timeout",
-                            ))
-                            continue
-                        if "exc" in box:
-                            raise box["exc"]
-                        response, keep_going = box["out"]
-                    else:
-                        response, keep_going = dispatch()
-                except BatchManifestError as exc:
-                    respond(_error(str(exc), req.get("id")))
-                    continue
-                except Exception as exc:  # must not kill the loop
-                    kind = _classify(exc)
-                    label = "internal error" if kind == "internal" else (
-                        f"{kind} error"
-                    )
-                    respond(_error(
-                        f"{label}: {exc}", req.get("id"), kind=kind
-                    ))
-                    continue
-                if req.get("id") is not None:
-                    # the request id wins over a job spec's defaulted id
-                    response["id"] = req.get("id")
-                response.setdefault(
-                    "seconds", round(time.perf_counter() - started, 4)
+                keep_going = dispatch_request(
+                    req, base_dir, out_lock, _respond_locked, deadline
                 )
-                respond(response)
                 if not keep_going:
                     # disarm request_shutdown's idle raise before
                     # leaving: a signal landing in the teardown window

@@ -1,0 +1,216 @@
+"""One daemon client connection: framing, queueing, and capture.
+
+A :class:`Session` owns exactly one accepted socket.  Its reader thread
+speaks newline-JSON — the same one-object-per-line protocol the stdio
+``serve`` loop reads, so a client can pipe the identical request stream
+at either transport — and enqueues parsed requests into the session's
+bounded queue for the daemon's fair scheduler
+(:mod:`operator_forge.serve.daemon`) to dispatch.  Responses are
+written back one JSON line each, serialized by a per-session lock so a
+streaming op's cycle lines can never interleave with a sibling
+request's answer.
+
+Protocol robustness on the socket path:
+
+- **bad JSON / non-object requests** answer ``bad_request`` and the
+  connection continues (the stdio rule);
+- **oversized lines** (over :data:`MAX_LINE` bytes) answer
+  ``bad_request`` and close THIS connection only — a peer that cannot
+  frame its requests can no longer be trusted on a byte stream, but
+  sibling sessions and the listener are untouched;
+- **torn lines** (EOF with no trailing newline) are dropped — a torn
+  frame is never treated as data;
+- **admission rejections** (session queue or the daemon's global queue
+  full) answer immediately from the reader thread with the ``busy``
+  taxonomy kind plus a ``retry_after`` hint, so backpressure is a
+  protocol answer, never unbounded buffering;
+- **mid-request disconnect**: a failed response write marks the
+  session dead and raises the shared
+  :class:`~operator_forge.serve.server._AbandonedRequest`, so the
+  in-flight handler unwinds at its next emit, the abandonment is
+  counted (``serve.requests_abandoned``), and the queued remainder is
+  discarded.
+
+Output capture needs nothing session-specific: job stdout/stderr is
+routed per-thread by the runner's ``_ThreadRouter``, and each session
+has at most one request in flight (the scheduler dispatches the next
+one only after the current answer is written), so a dispatcher thread's
+capture buffers are naturally per-session.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..perf import metrics
+from .server import _AbandonedRequest, _count_error, _error
+
+#: hard ceiling on one request line — an 8 MiB JSON object is far past
+#: any real batch manifest; beyond it the peer is mis-framing
+MAX_LINE = 8 * 1024 * 1024
+
+#: the retry_after hint (seconds) carried by request-level busy
+#: rejections (a queue slot frees as soon as one request dispatches)
+RETRY_AFTER_S = 0.05
+
+#: the hint for CONNECTION-level rejections (daemon at its client
+#: cap): a session slot frees only when some client finishes, so the
+#: suggested backoff is an order of magnitude longer
+CONNECT_RETRY_AFTER_S = 0.5
+
+
+class Session:
+    """One accepted daemon connection (reader thread + response lock +
+    bounded request queue)."""
+
+    def __init__(self, daemon, conn, session_id: str):
+        self.daemon = daemon
+        self.conn = conn
+        self.id = session_id
+        #: serializes every protocol write on this connection — shared
+        #: with dispatch_request as its out_lock
+        self.out_lock = threading.Lock()
+        #: pending (request, enqueue_monotonic) pairs, appended by the
+        #: reader thread, popped by the scheduler under the daemon lock
+        self.queue: list = []
+        #: a request from this session is currently dispatching; the
+        #: scheduler skips busy sessions so responses stay ordered
+        self.busy = False
+        #: the transport is dead (write failed / oversized close);
+        #: set-once, observed by respond and the scheduler
+        self.dead = threading.Event()
+        #: the in-flight request's abandonment Event (shared with
+        #: dispatch_request) so a disconnect can cancel it mid-stream
+        self.current_abandoned = None
+        #: reader thread saw EOF — no further requests will arrive
+        self.read_done = False
+        self.requests_total = 0
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"daemon-session-{session_id}",
+        )
+
+    def start(self) -> None:
+        self._reader.start()
+
+    # -- response path --------------------------------------------------
+
+    def respond_locked(self, payload: dict) -> None:
+        """Write one protocol line (caller holds ``out_lock``).  On a
+        dead transport raises ``_AbandonedRequest`` so the shared
+        dispatcher counts the abandonment and unwinds streaming ops."""
+        _count_error(payload)
+        if self.dead.is_set():
+            raise _AbandonedRequest()
+        try:
+            self.conn.sendall(
+                (json.dumps(payload) + "\n").encode("utf-8")
+            )
+        except OSError:
+            self._mark_dead()
+            raise _AbandonedRequest() from None
+
+    def respond(self, payload: dict) -> None:
+        with self.out_lock:
+            self.respond_locked(payload)
+
+    def _mark_dead(self) -> None:
+        self.dead.set()
+        abandoned = self.current_abandoned
+        if abandoned is not None:
+            # cancel the in-flight request too: a quiet-tree watch has
+            # no next emit to fail at, so the poll must observe this
+            abandoned.set()
+
+    # -- reader ----------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            stream = self.conn.makefile(
+                "r", encoding="utf-8", errors="replace"
+            )
+            while not self.dead.is_set():
+                line = stream.readline(MAX_LINE + 1)
+                if not line:
+                    return  # clean EOF: no more requests
+                if len(line) > MAX_LINE:
+                    # the peer is mis-framing: answer once, close this
+                    # connection — siblings and the listener live on
+                    self._answer_error(
+                        f"request line exceeds {MAX_LINE} bytes"
+                    )
+                    self._mark_dead()
+                    return
+                if not line.endswith("\n"):
+                    return  # torn line at EOF: never treated as data
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    self._answer_error(f"invalid JSON: {exc}")
+                    continue
+                if not isinstance(req, dict):
+                    self._answer_error("request must be a JSON object")
+                    continue
+                self.daemon._enqueue(self, req)
+        except (OSError, ValueError):
+            self._mark_dead()  # connection reset / closed under us
+        finally:
+            self.read_done = True
+            self.daemon._reader_finished(self)
+
+    def _answer_error(self, message: str) -> None:
+        try:
+            self.respond(_error(message))
+        except _AbandonedRequest:
+            pass
+
+    def reject_busy(self, req: dict, reason: str) -> None:
+        """Answer an admission rejection immediately (reader thread):
+        the PR 7 taxonomy's ``busy`` kind plus a retry_after hint."""
+        metrics.counter("daemon.busy_rejections").inc()
+        payload = _error(reason, req.get("id"), kind="busy")
+        payload["retry_after"] = RETRY_AFTER_S
+        try:
+            self.respond(payload)
+        except _AbandonedRequest:
+            pass
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def pop_request(self):
+        """(request, queue-wait seconds) — caller holds the daemon
+        scheduler lock."""
+        req, enqueued = self.queue.pop(0)
+        return req, time.monotonic() - enqueued
+
+    def state(self) -> dict:
+        """The per-session surface serve ``stats`` reports."""
+        return {
+            "queue_depth": len(self.queue),
+            "in_flight": self.busy,
+            "requests": self.requests_total,
+        }
+
+    def close(self) -> None:
+        self.dead.set()
+        import socket as _socket
+
+        try:
+            # a plain close() defers the real close while the reader
+            # thread's makefile holds an io-ref on the socket; shutdown
+            # forces EOF to the peer (and unblocks our own reader) now
+            self.conn.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass

@@ -293,6 +293,44 @@ print(
         remote["disabled_fraction_of_cold"] * 100,
     )
 )
+
+# daemon (PR 10): the socket load generator must report jobs/sec and
+# p50/p99 latency at 1, 8, and 64 simulated clients; the warm daemon
+# clears 3x over the cold-serial one-shot CLI; every client's bytes
+# match the cache-off serial recompute; and the fairness guard holds
+# (a 1-job client's p99 while a 64-job batch client runs stays within
+# the bounded factor of its solo p99).
+daemon = detail["daemon"]
+for level in ("1", "8", "64"):
+    entry = daemon["levels"][level]
+    assert entry["jobs_per_s"] > 0, f"no daemon throughput at {level} clients"
+    assert entry["p50_ms"] is not None and entry["p99_ms"] is not None
+assert daemon["warm_speedup"] >= 3, (
+    "warm daemon below the 3x bar over cold-serial one-shot CLI: %.2f"
+    % daemon["warm_speedup"]
+)
+assert daemon["identity"] is True, (
+    "a daemon client's response diverged from the cache-off serial recompute"
+)
+assert daemon["fairness"]["ok"] is True, (
+    "daemon fairness guard failed: contended p99 %.1fms vs solo %.1fms"
+    % (daemon["fairness"]["contended_p99_ms"],
+       daemon["fairness"]["solo_p99_ms"])
+)
+print(
+    "daemon contract OK: warm=%.1f jobs/s (x%.1f over cold-serial), "
+    "p99 @1/8/64 clients = %.1f/%.1f/%.1fms, fairness ratio %.1f "
+    "(bound %.0f), identity clean"
+    % (
+        daemon["warm_daemon_jobs_per_s"],
+        daemon["warm_speedup"],
+        daemon["levels"]["1"]["p99_ms"],
+        daemon["levels"]["8"]["p99_ms"],
+        daemon["levels"]["64"]["p99_ms"],
+        daemon["fairness"]["ratio"],
+        daemon["fairness"]["bound"],
+    )
+)
 PYEOF
 
 # Remote-tier cross-process step (PR 9): a REAL cache-server process
@@ -392,6 +430,131 @@ finally:
     shutil.rmtree(tmp, ignore_errors=True)
 PYEOF
 )
+
+# Daemon step (PR 10): a REAL daemon subprocess serves 8 concurrent
+# client PROCESSES (batch --addr) on distinct projects; every client's
+# output trees and normalized results must match its own cache-off
+# serial recompute, then SIGTERM must drain gracefully with exit 0.
+echo "daemon contract: 8 concurrent client processes against a live daemon"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from bench import tree_digest
+from operator_forge.perf import cache as pf_cache
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.jobs import jobs_from_specs
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-daemonstep-")
+sock = os.path.join(tmp, "daemon.sock")
+fixture = os.path.join("tests", "fixtures", "standalone")
+N = 8
+
+
+def specs_for(i, flavor):
+    cfg = os.path.abspath(os.path.join(tmp, f"cfg-{i}", "workload.yaml"))
+    out = os.path.join(tmp, flavor, f"client-{i}", "out")
+    return [
+        {"command": "init", "workload_config": cfg, "output_dir": out,
+         "repo": f"github.com/acme/client{i}"},
+        {"command": "create-api", "workload_config": cfg,
+         "output_dir": out},
+        {"command": "vet", "path": out},
+    ], out
+
+
+def norm(text, out):
+    return re.sub(r"\d+\.\d+s", "<t>", text.replace(out, "<out>"))
+
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "daemon",
+     "--listen", sock],
+    stderr=subprocess.PIPE, text=True,
+)
+try:
+    for i in range(N):
+        shutil.copytree(fixture, os.path.join(tmp, f"cfg-{i}"))
+    for _ in range(400):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit("daemon did not bind its socket")
+
+    # the cache-off serial reference, one tree per client
+    pf_cache.configure(mode="off")
+    refs = {}
+    for i in range(N):
+        specs, out = specs_for(i, "ref")
+        results = run_batch(jobs_from_specs(specs, tmp))
+        assert all(r.ok for r in results), f"reference {i} failed"
+        refs[i] = (
+            tree_digest(out),
+            [(r.command, r.rc, norm(r.stdout, out)) for r in results],
+        )
+    pf_cache.configure(mode="mem")
+
+    # 8 concurrent CLIENT PROCESSES, each batching its own project
+    clients = []
+    for i in range(N):
+        specs, out = specs_for(i, "live")
+        manifest = os.path.join(tmp, f"jobs-{i}.yaml")
+        with open(manifest, "w") as fh:
+            json.dump({"jobs": specs}, fh)  # JSON is valid YAML
+        clients.append((i, out, subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "batch",
+             "--addr", sock, "--manifest", manifest, "--json"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )))
+    for i, out, proc in clients:
+        stdout, stderr = proc.communicate(timeout=300)
+        assert proc.returncode == 0, f"client {i} failed: {stderr}"
+        lines = [json.loads(l) for l in stdout.strip().splitlines()]
+        got = [
+            (l["command"], l["rc"], norm(l["stdout"], out))
+            for l in lines[:-1]
+        ]
+        ref_digest, ref_results = refs[i]
+        assert got == ref_results, f"client {i} results diverged"
+        assert tree_digest(out) == ref_digest, (
+            f"client {i} tree diverged from its cache-off serial "
+            "recompute"
+        )
+
+    daemon.send_signal(signal.SIGTERM)
+    rc = daemon.wait(timeout=60)
+    stderr = daemon.stderr.read()
+    assert rc == 0, f"daemon exit {rc}: {stderr}"
+    assert "drained" in stderr, f"no drain line: {stderr}"
+    print(
+        "daemon step OK: %d concurrent client processes byte-identical "
+        "to their cache-off serial recomputes, SIGTERM drained exit 0"
+        % N
+    )
+finally:
+    if daemon.poll() is None:
+        daemon.kill()
+        daemon.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
+# Completions must offer the daemon-era verbs.
+for verb in daemon connect; do
+    if ! (cd "$repo_root" && "${PYTHON:-python3}" -m operator_forge.cli.main completion bash | grep -q "$verb"); then
+        echo "completions missing '$verb'" >&2
+        exit 1
+    fi
+done
+echo "completions OK: daemon/connect present"
 
 # Analyzer zero-findings gate over the reference corpus (when the
 # checkout is mounted): the corpus compiles, so every analyzer —

@@ -1,0 +1,669 @@
+"""Multi-client forge daemon (PR 10 acceptance).
+
+The daemon may only ever change HOW requests are served — N concurrent
+socket sessions over one shared pool instead of one stdio stream —
+never WHAT they produce: every client's job/batch results must be
+byte-identical to a cache-off serial recompute across cache modes ×
+worker backends × job counts, including two clients hammering the same
+project concurrently.  Backpressure must be observable (the ``busy``
+taxonomy kind, per-session queue depth and queue-wait percentiles in
+``stats``), protocol damage must stay scoped to the one offending
+connection, and both transports must share one SIGTERM drain.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import metrics, workers
+from operator_forge.serve import session as session_mod
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.daemon import DaemonClient, ForgeDaemon
+from operator_forge.serve.jobs import jobs_from_specs
+
+from test_perf_cache import FIXTURES, assert_identical_trees
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _config_copy(base: str, name: str) -> str:
+    dst = os.path.join(base, f"cfg-{name}")
+    if not os.path.isdir(dst):
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), dst)
+    return os.path.join(dst, "workload.yaml")
+
+
+def _chain_specs(config: str, out_dir: str) -> list:
+    return [
+        {"command": "init", "workload_config": config,
+         "output_dir": out_dir, "repo": "github.com/acme/app"},
+        {"command": "create-api", "workload_config": config,
+         "output_dir": out_dir},
+        {"command": "vet", "path": out_dir},
+    ]
+
+
+def _start_daemon(tmp_path, **kwargs) -> ForgeDaemon:
+    daemon = ForgeDaemon(
+        f"unix:{tmp_path}/forge-{time.monotonic_ns()}.sock", **kwargs
+    )
+    daemon.start()
+    return daemon
+
+
+def _wait_for(predicate, timeout=10.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class TestDaemonProtocol:
+    def test_end_to_end_two_clients(self, tmp_path):
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "e2e")
+        out_dir = os.path.join(base, "out-e2e")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as c1, \
+                    DaemonClient(daemon.address()) as c2:
+                ping = c1.request({"op": "ping"})
+                assert ping["ok"] and ping["version"]
+                job = c1.request({
+                    "id": "r1", "command": "init",
+                    "workload_config": config, "output_dir": out_dir,
+                    "repo": "github.com/acme/app",
+                })
+                assert job["ok"] and job["id"] == "r1" and job["rc"] == 0
+                batch = c2.request({"op": "batch", "jobs": [
+                    {"command": "create-api", "workload_config": config,
+                     "output_dir": out_dir},
+                    {"command": "vet", "path": out_dir},
+                ]})
+                assert batch["ok"]
+                assert [r["command"] for r in batch["results"]] == [
+                    "create-api", "vet",
+                ]
+                stats = c1.request({"op": "stats"})
+                # the daemon surface: active sessions, per-session
+                # queue depth, and the queue-wait histogram
+                assert stats["daemon"]["active_sessions"] == 2
+                for state in stats["daemon"]["sessions"].values():
+                    assert set(state) == {
+                        "queue_depth", "in_flight", "requests",
+                    }
+                hist = stats["metrics"]["histograms"][
+                    "daemon.queue_wait.seconds"
+                ]
+                assert hist["count"] >= 4
+                assert hist["p50"] is not None
+                assert hist["p99"] is not None
+                # per-project replay namespaces are live under the
+                # daemon: serve.job records partition per target tree
+                assert any(
+                    ns.startswith("serve.job.")
+                    for ns in stats["cache"]
+                ), sorted(stats["cache"])
+                # a shutdown op drains the whole daemon: BOTH sessions
+                # get the final drained line
+                down = c1.request({"op": "shutdown"})
+                assert down["ok"] and down["op"] == "shutdown"
+                assert c1.read() == {
+                    "ok": True, "op": "shutdown", "drained": True,
+                }
+                assert c2.read() == {
+                    "ok": True, "op": "shutdown", "drained": True,
+                }
+                assert c1.read() is None  # connection closed
+            assert os.path.exists(os.path.join(out_dir, "PROJECT"))
+        finally:
+            daemon.stop()
+
+    def test_bad_json_keeps_connection(self, tmp_path):
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                client._sock.sendall(b"this is not json\n")
+                resp = client.read()
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "bad_request"
+                client._sock.sendall(b"[1, 2, 3]\n")
+                resp = client.read()
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "bad_request"
+                # the connection survived both
+                assert client.request({"op": "ping"})["ok"]
+        finally:
+            daemon.stop()
+
+    def test_oversized_line_closes_one_connection(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setattr(session_mod, "MAX_LINE", 1024)
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as bad, \
+                    DaemonClient(daemon.address()) as good:
+                bad._sock.sendall(
+                    b'{"op": "ping", "pad": "' + b"x" * 4096 + b'"}\n'
+                )
+                resp = bad.read()
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "bad_request"
+                assert "exceeds" in resp["error"]
+                assert bad.read() is None  # THIS connection closed...
+                # ...but the listener and the sibling session live on
+                assert good.request({"op": "ping"})["ok"]
+                with DaemonClient(daemon.address()) as fresh:
+                    assert fresh.request({"op": "ping"})["ok"]
+        finally:
+            daemon.stop()
+
+    def test_torn_line_is_dropped_cleanly(self, tmp_path):
+        daemon = _start_daemon(tmp_path)
+        try:
+            torn = DaemonClient(daemon.address())
+            torn._sock.sendall(b'{"op": "ping"')  # no newline, then gone
+            torn.close()
+            with DaemonClient(daemon.address()) as client:
+                assert client.request({"op": "ping"})["ok"]
+                _wait_for(
+                    lambda: daemon._stats_payload()[
+                        "active_sessions"] == 1,
+                    message="torn session reaped",
+                )
+        finally:
+            daemon.stop()
+
+    def test_midrequest_disconnect_abandons_cleanly(self, tmp_path):
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "gone")
+        out_dir = os.path.join(base, "out-gone")
+        before = metrics.counter("serve.requests_abandoned").value()
+        daemon = _start_daemon(tmp_path)
+        try:
+            client = DaemonClient(daemon.address())
+            client.send({
+                "command": "init", "workload_config": config,
+                "output_dir": out_dir, "repo": "github.com/acme/app",
+            })
+            client.close()  # gone before the answer
+            _wait_for(
+                lambda: metrics.counter(
+                    "serve.requests_abandoned"
+                ).value() > before,
+                message="abandoned request counted",
+            )
+            # the daemon is unharmed: a fresh client is served
+            with DaemonClient(daemon.address()) as fresh:
+                assert fresh.request({"op": "ping"})["ok"]
+            _wait_for(
+                lambda: daemon._stats_payload()["active_sessions"] == 0,
+                message="dead session reaped",
+            )
+        finally:
+            daemon.stop()
+
+
+class TestBackpressure:
+    def test_session_queue_overflow_answers_busy(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_WORKERS", "1")
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_SESSION_QUEUE", "1")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                # occupy the one dispatcher (and this session's
+                # in-flight slot) with a quiet-tree watch
+                client.send({
+                    "id": "w", "op": "watch", "cycles": 3,
+                    "interval": 0.1,
+                    "jobs": [{"command": "vet", "path": str(tmp_path)}],
+                })
+                _wait_for(
+                    lambda: any(
+                        s["in_flight"]
+                        for s in daemon._stats_payload()[
+                            "sessions"].values()
+                    ),
+                    message="watch in flight",
+                )
+                # 1 fits the session queue; the next two must answer
+                # busy IMMEDIATELY (the reader thread rejects them)
+                for i in range(3):
+                    client.send({"op": "ping", "id": f"p{i}"})
+                busy = []
+                deadline = time.monotonic() + 10
+                while len(busy) < 2 and time.monotonic() < deadline:
+                    resp = client.read()
+                    assert resp is not None
+                    if resp.get("error_kind") == "busy":
+                        busy.append(resp)
+                assert len(busy) == 2
+                for resp in busy:
+                    assert resp["ok"] is False
+                    assert resp["retry_after"] > 0
+                    assert "session queue full" in resp["error"]
+        finally:
+            daemon.stop()
+        assert metrics.counter("daemon.busy_rejections").value() >= 2
+
+    def test_global_admission_bound_answers_busy(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_WORKERS", "1")
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_QUEUE", "1")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as blocker, \
+                    DaemonClient(daemon.address()) as client:
+                blocker.send({
+                    "op": "watch", "cycles": 3, "interval": 0.1,
+                    "jobs": [{"command": "vet", "path": str(tmp_path)}],
+                })
+                _wait_for(
+                    lambda: any(
+                        s["in_flight"]
+                        for s in daemon._stats_payload()[
+                            "sessions"].values()
+                    ),
+                    message="watch in flight",
+                )
+                client.send({"op": "ping", "id": "fits"})
+                _wait_for(
+                    lambda: daemon._stats_payload()[
+                        "queued_requests"] >= 1,
+                    message="first request queued",
+                )
+                resp = client.request({"op": "ping", "id": "over"})
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "busy"
+                assert "admission queue full" in resp["error"]
+        finally:
+            daemon.stop()
+
+    def test_lock_conflict_times_out_to_busy(self, tmp_path,
+                                             monkeypatch):
+        """A request conflicting with a long-lived holder (here: a
+        watch whose manifest WRITES the tree) must answer busy after
+        the bounded lock wait — never park a dispatcher forever."""
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_LOCK_S", "0.3")
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "lockt")
+        out_dir = os.path.join(base, "out-lockt")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as holder, \
+                    DaemonClient(daemon.address()) as contender:
+                # the watch holds out_dir's WRITE lock for its whole
+                # stream (its manifest generates into it)
+                holder.send({
+                    "op": "watch", "cycles": 3, "interval": 0.1,
+                    "jobs": [
+                        {"command": "init", "workload_config": config,
+                         "output_dir": out_dir,
+                         "repo": "github.com/acme/app"},
+                    ],
+                })
+                assert holder.read()["op"] == "watch"  # cycle 0 ran
+                resp = contender.request(
+                    {"id": "c", "command": "vet", "path": out_dir}
+                )
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "busy"
+                assert "conflicting" in resp["error"]
+                assert resp["id"] == "c"
+        finally:
+            daemon.stop()
+        assert metrics.counter("daemon.lock_timeouts").value() >= 1
+
+    def test_abandoned_writer_keeps_its_locks(self, tmp_path,
+                                              monkeypatch):
+        """A deadline-abandoned request's detached handler may still
+        be mutating its tree: the path locks must stay held until it
+        actually finishes, so a sibling session answers busy instead
+        of interleaving writes — and the tree frees afterwards."""
+        import operator_forge.serve.server as server_mod
+
+        monkeypatch.setenv("OPERATOR_FORGE_SERVE_TIMEOUT", "0.2")
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_LOCK_S", "0.3")
+        config = _config_copy(str(tmp_path), "zombie")
+        target = str(tmp_path / "slow-tree")
+        real_handle = server_mod._handle
+        zombie_done = threading.Event()
+
+        def slow_handle(req, base_dir, emit=None, abandoned=None):
+            if req.get("id") == "slow":
+                time.sleep(1.0)  # past the 0.2s deadline: abandoned
+                zombie_done.set()
+            return real_handle(req, base_dir, emit=emit,
+                               abandoned=abandoned)
+
+        monkeypatch.setattr(server_mod, "_handle", slow_handle)
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as a, \
+                    DaemonClient(daemon.address()) as b:
+                # a WRITER: init holds target's write lock, which the
+                # sibling's read (vet) must conflict with
+                a.send({
+                    "id": "slow", "command": "init",
+                    "workload_config": config, "output_dir": target,
+                    "repo": "github.com/acme/app",
+                })
+                timeout_resp = a.read()
+                assert timeout_resp["error_kind"] == "timeout"
+                # the zombie still runs: B's conflicting request must
+                # NOT acquire the tree — busy after the bounded wait
+                resp = b.request(
+                    {"id": "b1", "command": "vet", "path": target}
+                )
+                assert resp["error_kind"] == "busy", resp
+                # once the zombie settles, the tree frees (the lock
+                # table empties) and the session stays serviceable —
+                # the liveness probe is a ping, immune to the 0.2s
+                # serve deadline still in force (a cold vet under
+                # full-suite load is not)
+                assert zombie_done.wait(10)
+                _wait_for(
+                    lambda: not daemon._locks._held,
+                    message="zombie released its locks",
+                )
+                resp = b.request({"op": "ping", "id": "b2"})
+                assert resp["ok"] and resp["id"] == "b2"
+        finally:
+            daemon.stop()
+
+    def test_client_cap_rejects_extra_connection(self, tmp_path):
+        daemon = _start_daemon(tmp_path, clients=1)
+        try:
+            with DaemonClient(daemon.address()) as first:
+                assert first.request({"op": "ping"})["ok"]
+                with DaemonClient(daemon.address()) as second:
+                    resp = second.read()
+                    assert resp["ok"] is False
+                    assert resp["error_kind"] == "busy"
+                    assert resp["retry_after"] > 0
+                    assert second.read() is None  # closed
+                # the admitted session is unaffected
+                assert first.request({"op": "ping"})["ok"]
+        finally:
+            daemon.stop()
+
+
+class TestDaemonIdentity:
+    @pytest.mark.parametrize("mode", ["off", "mem", "disk"])
+    @pytest.mark.parametrize("backend,jobs", [
+        ("thread", "1"), ("thread", "8"),
+        ("process", "1"), ("process", "8"),
+    ])
+    def test_daemon_matches_cacheoff_serial(
+        self, mode, backend, jobs, tmp_path, monkeypatch
+    ):
+        """Two concurrent clients — one running the full chain, one an
+        independent init — must write trees byte-identical to the
+        cache-off serial in-process recompute, in every cache mode ×
+        worker backend × JOBS width."""
+        base = str(tmp_path)
+        config_a = _config_copy(base, "a")
+        config_b = _config_copy(base, "b")
+
+        # reference: cache-off serial, in-process (no daemon)
+        perfcache.configure(mode="off")
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        workers.set_backend("thread")
+        ref_a = os.path.join(base, "ref", "out-a")
+        ref_b = os.path.join(base, "ref", "out-b")
+        results = run_batch(jobs_from_specs(
+            _chain_specs(config_a, ref_a) + [
+                {"command": "init", "workload_config": config_b,
+                 "output_dir": ref_b, "repo": "github.com/acme/app"},
+            ], base,
+        ))
+        assert all(r.ok for r in results)
+
+        # the daemon leg
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", jobs)
+        workers.set_backend(backend)
+        perfcache.configure(
+            mode=mode,
+            root=os.path.join(base, "cache") if mode == "disk" else None,
+        )
+        perfcache.reset()
+        leg_a = os.path.join(base, "leg", "out-a")
+        leg_b = os.path.join(base, "leg", "out-b")
+        daemon = _start_daemon(tmp_path)
+        try:
+            outcome = {}
+
+            def drive(name, payload):
+                with DaemonClient(daemon.address()) as client:
+                    outcome[name] = client.request(payload)
+
+            threads = [
+                threading.Thread(target=drive, args=("chain", {
+                    "op": "batch",
+                    "jobs": _chain_specs(config_a, leg_a),
+                })),
+                threading.Thread(target=drive, args=("init", {
+                    "command": "init", "workload_config": config_b,
+                    "output_dir": leg_b, "repo": "github.com/acme/app",
+                })),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert outcome["chain"]["ok"], outcome["chain"]
+            assert outcome["init"]["rc"] == 0, outcome["init"]
+        finally:
+            daemon.stop()
+            workers.set_backend(None)
+        assert_identical_trees(ref_a, leg_a)
+        assert_identical_trees(ref_b, leg_b)
+
+    def test_two_clients_hammer_same_project(self, tmp_path):
+        """Concurrent clients over ONE project: generation chains and
+        vets interleave across sessions, the path locks serialize the
+        conflicts, and the tree converges to the cache-off serial
+        result — byte for byte."""
+        base = str(tmp_path)
+        config = _config_copy(base, "shared")
+
+        perfcache.configure(mode="off")
+        ref = os.path.join(base, "ref-out")
+        for _ in range(2):
+            results = run_batch(jobs_from_specs(
+                _chain_specs(config, ref), base,
+            ))
+            assert all(r.ok for r in results)
+
+        perfcache.configure(mode="mem")
+        perfcache.reset()
+        target = os.path.join(base, "ham-out")
+        daemon = _start_daemon(tmp_path)
+        try:
+            failures = []
+
+            def hammer(rounds):
+                with DaemonClient(daemon.address()) as client:
+                    for _ in range(rounds):
+                        resp = client.request({
+                            "op": "batch",
+                            "jobs": _chain_specs(config, target),
+                        })
+                        if not resp.get("ok"):
+                            failures.append(resp)
+
+            threads = [
+                threading.Thread(target=hammer, args=(3,))
+                for _ in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            assert not failures, failures[:1]
+        finally:
+            daemon.stop()
+        assert_identical_trees(ref, target)
+
+
+class TestDaemonDrain:
+    def _spawn(self, tmp_path, extra_env=None):
+        sock = str(tmp_path / "proc.sock")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT
+        env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+        if extra_env:
+            env.update(extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main",
+             "daemon", "--listen", sock],
+            cwd=str(tmp_path), env=env,
+            stderr=subprocess.PIPE, text=True,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(sock):
+                return proc, sock
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        proc.kill()
+        raise AssertionError(
+            f"daemon did not bind: {proc.stderr.read()}"
+        )
+
+    def test_sigterm_on_idle_daemon_exits_zero(self, tmp_path):
+        """The same SIGTERM-on-idle contract the stdio transport pins
+        (test_robustness.test_sigterm_interrupts_idle_blocking_read),
+        run against the socket transport."""
+        proc, _sock = self._spawn(tmp_path)
+        time.sleep(0.3)  # idle
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        stderr = proc.stderr.read()
+        assert rc == 0, stderr
+        assert "drained" in stderr
+
+    def test_sigterm_mid_request_drains_and_answers(self, tmp_path):
+        """SIGTERM while a session is mid-watch (the stdio contract of
+        test_sigterm_drains_quiet_watch_op, on the socket transport):
+        the in-flight op observes the drain, finishes its done line,
+        the session gets the drained-shutdown line, and the daemon
+        exits 0."""
+        proc, sock = self._spawn(tmp_path)
+        client = DaemonClient(sock, timeout=60)
+        client.send({
+            "op": "watch", "cycles": 3, "interval": 0.1,
+            "jobs": [{"command": "vet", "path": str(tmp_path)}],
+        })
+        first = client.read()  # cycle 0 ran: the request is in flight
+        assert first["op"] == "watch" and first["cycle"] == 0
+        proc.send_signal(signal.SIGTERM)
+        lines = []
+        while True:
+            resp = client.read()
+            if resp is None:
+                break
+            lines.append(resp)
+        client.close()
+        rc = proc.wait(timeout=30)
+        assert rc == 0, proc.stderr.read()
+        done = [l for l in lines if l.get("done")]
+        assert done and done[0]["cycles"] < 3  # closed early, answered
+        assert lines[-1] == {
+            "ok": True, "op": "shutdown", "drained": True,
+        }
+
+    def test_connect_cli_relays_requests(self, tmp_path):
+        proc, sock = self._spawn(tmp_path)
+        try:
+            env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+            out = subprocess.run(
+                [sys.executable, "-m", "operator_forge.cli.main",
+                 "connect", "--addr", sock],
+                input='{"op": "ping", "id": "c"}\n',
+                capture_output=True, text=True, timeout=60, env=env,
+            )
+            assert out.returncode == 0, out.stderr
+            resp = json.loads(out.stdout.strip().splitlines()[0])
+            assert resp["ok"] and resp["id"] == "c"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=30)
+
+
+class TestFairScheduling:
+    def test_round_robin_interleaves_sessions(
+        self, tmp_path, monkeypatch
+    ):
+        """With one dispatcher, a session that queued many requests
+        must not starve a later session: once the in-flight request
+        finishes, the round-robin serves the OTHER session's request
+        before the flooder's queued backlog."""
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_WORKERS", "1")
+        order = []
+        order_lock = threading.Lock()
+        blocker_started = threading.Event()
+        release_blocker = threading.Event()
+
+        from operator_forge.serve import daemon as daemon_mod
+
+        real_dispatch = daemon_mod.dispatch_request
+
+        def spying_dispatch(req, *args, **kwargs):
+            if req.get("op") == "ping":
+                with order_lock:
+                    order.append(req.get("id"))
+            if req.get("id") == "blocker":
+                # a deterministically slow request: holds the one
+                # dispatcher until both queues are provably populated
+                blocker_started.set()
+                release_blocker.wait(30)
+            return real_dispatch(req, *args, **kwargs)
+
+        monkeypatch.setattr(
+            daemon_mod, "dispatch_request", spying_dispatch
+        )
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as hog, \
+                    DaemonClient(daemon.address()) as probe:
+                hog.send({"op": "ping", "id": "blocker"})
+                assert blocker_started.wait(10)
+                for i in range(4):
+                    hog.send({"op": "ping", "id": f"hog-{i}"})
+                probe.send({"op": "ping", "id": "probe"})
+                _wait_for(
+                    lambda: daemon._stats_payload()[
+                        "queued_requests"] >= 5,
+                    message="both queues populated",
+                )
+                release_blocker.set()
+                resp = probe.read()
+                assert resp["id"] == "probe" and resp["ok"]
+                # drain the hog's answers so every dispatch is recorded
+                hog_ids = [hog.read()["id"] for _ in range(5)]
+                assert hog_ids[0] == "blocker"
+        finally:
+            release_blocker.set()
+            daemon.stop()
+        # the probe was dispatched ahead of the flooder's backlog
+        assert order.index("probe") < order.index("hog-0")

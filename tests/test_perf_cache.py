@@ -325,3 +325,112 @@ class TestCacheStore:
         cache.configure(mode="off")
         cache.put("stage", "key", "value")
         assert cache.get("stage", "key") is perfcache.MISS
+
+
+class TestMemBudget:
+    """The mem-tier LRU budget (PR 10): a long-lived daemon must honor
+    OPERATOR_FORGE_CACHE_MAX_MB on the resident tier too, and the
+    accounting must hold under concurrent writers."""
+
+    def test_mem_tier_evicts_lru_within_budget(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "0.01")  # 10 KiB
+        cache = perfcache.ContentCache()
+        cache.configure(mode="mem")
+        blob = "x" * 3000  # ~3 KiB pickled
+        cache.put("stage", "a", blob)
+        cache.put("stage", "b", blob)
+        assert cache.get("stage", "a") == blob  # touch: a is now MRU
+        cache.put("stage", "c", blob)
+        cache.put("stage", "d", blob)  # over budget: evict LRU (b)
+        entries, total = cache.mem_footprint()
+        assert total <= int(0.01 * 1024 * 1024)
+        assert cache.get("stage", "b") is perfcache.MISS  # evicted
+        assert cache.get("stage", "d") == blob            # newest kept
+
+    def test_concurrent_writers_respect_mem_budget(self, monkeypatch):
+        import threading
+
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "0.05")  # 50 KiB
+        cache = perfcache.ContentCache()
+        cache.configure(mode="mem")
+        limit = int(0.05 * 1024 * 1024)
+        errors = []
+
+        def writer(worker):
+            try:
+                for i in range(200):
+                    key = f"{worker}-{i}"
+                    cache.put("stage", key, "y" * 2048)
+                    cache.get("stage", key)
+            except Exception as exc:  # noqa: BLE001 - recorded
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:3]
+        entries, total = cache.mem_footprint()
+        assert total <= limit, (entries, total, limit)
+        # the budget evicted, it did not wipe: recent entries survive
+        assert entries > 0
+
+    def test_enforce_budget_bounds_both_tiers(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "100")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=str(tmp_path / "store"))
+        for i in range(6):
+            cache.put("stage", f"k{i:02d}", "z" * 4096)
+        # shrink the ceiling AFTER writing: only the maintenance hook
+        # (the daemon's idle tick) can bring the store back under it
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "0.01")
+        summary = cache.enforce_budget()
+        assert summary["mem_evicted"] > 0
+        _entries, total = cache.mem_footprint()
+        assert total <= int(0.01 * 1024 * 1024)
+        assert summary["disk"] is not None
+        assert summary["disk"]["entries_removed"] > 0
+        assert summary["disk"]["bytes_remaining"] <= int(
+            0.01 * 1024 * 1024
+        )
+
+    def test_concurrent_maybe_gc_elects_one_sweeper(self, monkeypatch,
+                                                    tmp_path):
+        import threading
+
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_MAX_MB", "0.001")
+        cache = perfcache.ContentCache()
+        cache.configure(mode="disk", root=str(tmp_path / "store"))
+        active = [0]
+        peak = [0]
+        gate = threading.Lock()
+        real_gc = cache.gc
+
+        def tracking_gc(*args, **kwargs):
+            with gate:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            try:
+                import time as _time
+
+                _time.sleep(0.05)  # widen the overlap window
+                return real_gc(*args, **kwargs)
+            finally:
+                with gate:
+                    active[0] -= 1
+
+        monkeypatch.setattr(cache, "gc", tracking_gc)
+        threads = [
+            threading.Thread(
+                target=cache._maybe_gc, args=(10 * 1024 * 1024,)
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert peak[0] == 1, f"{peak[0]} concurrent disk sweeps"
