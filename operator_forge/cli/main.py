@@ -728,8 +728,10 @@ def cmd_test(args: argparse.Namespace) -> int:
     Packages fan out across OPERATOR_FORGE_JOBS threads (each package
     gets an isolated world; the report is collected in input order, so
     it is identical to a serial run), function bodies execute through
-    the closure-compiled interpreter (OPERATOR_FORGE_GOCHECK=compile),
-    and a re-run over a byte-identical tree replays the cached report
+    the tiered interpreter (OPERATOR_FORGE_GOCHECK=walk|compile|
+    bytecode, default bytecode: closure-lowered once per content hash,
+    hot bodies promoted to register bytecode), and a re-run over a
+    byte-identical tree replays the cached report
     (OPERATOR_FORGE_CACHE).  `-v` streams per-test lines and therefore
     runs packages serially."""
     from operator_forge.gocheck.world import run_project_tests
@@ -984,6 +986,19 @@ def cmd_stats(args: argparse.Namespace) -> int:
     print(
         "graph: dirty=%d reused=%d recomputed=%d"
         % (graph["dirty"], graph["reused"], graph["recomputed"])
+    )
+    tiers = report["tiers"]
+    print(
+        "tiers: mode=%s lowered=%d promoted=%d hydrated=%d reused=%d "
+        "bytecode_executed=%d deopt=%d"
+        % (
+            tiers.get("mode"), tiers.get("compile.lowered", 0),
+            tiers.get("compile.promoted", 0),
+            tiers.get("compile.hydrated", 0),
+            tiers.get("compile.reused", 0),
+            tiers.get("bytecode.executed", 0),
+            tiers.get("bytecode.deopt", 0),
+        )
     )
     snap = report["metrics"]
     for name, value in snap["counters"].items():

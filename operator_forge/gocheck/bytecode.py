@@ -1,0 +1,3241 @@
+"""Register bytecode: the third gocheck execution tier.
+
+The closure compiler (:mod:`~operator_forge.gocheck.compiler`) lowers
+each hot body ONCE to nested Python closures — structural decisions are
+made at compile time, but execution still pays one Python call frame
+per AST-ish node.  This module lowers the same subset one rung further:
+a body becomes a :class:`Program` — a flat instruction list over a
+constant pool, executed register-machine style by one tight dispatch
+loop (:func:`execute`).  Straight-line expression work (literal loads,
+name lookups, binops, selectors, calls, indexing) runs as consecutive
+instructions in one frame instead of a chain of closure calls, and
+control flow (``if``/``for``/``switch``/``break``/``continue``)
+compiles to jumps with explicit scope push/pop bookkeeping.
+
+Two properties the closure tier cannot offer fall out of the encoding:
+
+- **Programs pickle.**  Instructions are tuples of ints and the
+  constant pool holds only plain data (scalars, token spans, composite
+  specs, nested sub-Programs), so promoted bodies persist inside the
+  ``gocheck.lower`` manifests and a cold process — or a pool worker —
+  hydrates *executable* programs straight from the cache, with no
+  re-lowering at all (the closure tier must recompile from cached
+  tokens).
+- **Promotion is cheap to defer.**  Lowering runs only when the
+  profile says a body is hot (see ``compiled_block`` in the compiler
+  module), so cold bodies never pay the translation.
+
+Behavior identity is the same hard contract the closure tier carries:
+every instruction mirrors the corresponding walk/closure code path
+branch for branch — evaluation order, scope creation points, the
+documented junk tolerance, the ``_StopExpr`` composite-over-non-type
+unwinding (reified here as compile-time "spine" fold tables), even the
+places the walk evaluator mutates ``ev.env`` before resolving type
+names.  Anything outside the supported subset raises
+:class:`Unsupported` during lowering and the body simply stays at the
+closure tier (``bytecode.deopt`` counts these), exactly as the closure
+compiler degrades to walk today.  Nothing binds early: names, methods,
+and types resolve at execution time through the running ``_Eval``.
+"""
+
+from __future__ import annotations
+
+from . import interp as I
+from .compiler import _Compiler, _CompileError, _bounded_group_end
+from .tokens import FLOAT, IDENT, IMAG, INT, KEYWORD, OP, RUNE, STRING
+
+__all__ = ["Program", "Unsupported", "lower_block", "execute",
+           "make_runner", "flush_executed", "reset"]
+
+
+class Unsupported(Exception):
+    """This shape is outside the bytecode subset — the body stays at
+    the closure tier (which has its own walk fallback)."""
+
+
+# -- opcodes ---------------------------------------------------------------
+#
+# One int per operation; operand layout is documented next to each
+# execute() branch.  Keep the numbering dense — execute() dispatches on
+# int equality and the hot ops sit first in the ladder.
+#
+# Call-argument specs ("parts") are tuples of (kind, payload, spread):
+# kind "r" reads a register, "n" looks a name up at call time, "c"
+# loads a constant.  The lowering folds adjacent trailing LOOKUP/CONST
+# instructions into "n"/"c" entries (pure tail fusion: the folded
+# loads were the instructions immediately before the call, so their
+# evaluation order — including a missing-name error's position — is
+# unchanged).
+
+(
+    OP_LOOKUP,       # dst, name_ci            dst = ev.lookup(name, env)
+    OP_CALL,         # dst, rcallee, parts_ci, ctx_ci
+    OP_LOOKSEL,      # dst, name_ci, sel_ci    fused pkg.Name
+    OP_CONST,        # dst, ci                 dst = consts[ci]
+    OP_PUSH,         # -                       env = Env(env)
+    OP_POP,          # -
+    OP_JIF,          # ra, target              jump if not truthy
+    OP_SEL,          # dst, ra, name_ci        field/method selector
+    OP_CALLSEL,      # dst, robj, sel_ci, parts_ci, ctx_ci
+    OP_BINJIF,       # op_ci, ra, rb, target   fused compare-and-branch
+    OP_BINOP,        # dst, op_ci, ra, rb
+    OP_DEFINE_FAST,  # name_ci, ra             x := <one value>
+    OP_ASSIGN_FAST,  # tgt_ci, ra              x = <one value>
+    OP_JUMP,         # target
+    OP_MOV,          # dst, ra
+    OP_TRUTHY,       # dst, ra
+    OP_INDEX,        # dst, ra, rk
+    OP_RET1,         # ra
+    OP_RET_NAME,     # name_ci                 fused return <name>
+    OP_RET_CONST,    # ci                      fused return <literal>
+    OP_RETN,         # regs_ci
+    OP_RET_NONE,     # -
+    OP_VALUES,       # dst, regs_ci            build values list
+    OP_EXPAND,       # rlist, n
+    OP_DEFINE_N,     # rlist, tregs_ci
+    OP_WRITE_N,      # rlist, tregs_ci
+    OP_TGT_NAME,     # dst, ci                 precomputed ("name", x)
+    OP_TGT_SEL,      # dst, robj, name_ci
+    OP_TGT_INDEX,    # dst, robj, rkey
+    OP_TGT_STAR,     # dst, robj
+    OP_INC_NAME,     # tgt_ci, delta           fused name++/--
+    OP_NOT,          # dst, ra
+    OP_NEG,          # dst, ra
+    OP_DEREF,        # dst, ra
+    OP_ADDR,         # dst, name_ci, target    &x scalar-ref probe
+    OP_AND_SHORT,    # ra, dst, target
+    OP_OR_SHORT,     # ra, dst, target
+    OP_ASSERT,       # dst, ra, text_ci
+    OP_COMPOSITE,    # dst, ra, spec_ci, spine_ci, root_reg, root_pc
+    OP_MAPLIT,       # dst, spec_ci
+    OP_SLICELIT,     # dst, span_ci, spec_ci
+    OP_BYTES,        # dst, ra                 []byte(x)
+    OP_LEN,          # dst, ra
+    OP_APPEND,       # dst, parts_ci
+    OP_PANIC,        # ra
+    OP_CONV,         # dst, ra, name_ci        numeric conversion
+    OP_STR,          # dst, ra                 string(x)
+    OP_NEW,          # dst, tname_ci
+    OP_MAKEMAP,      # dst
+    OP_MAKESLICE,    # dst
+    OP_CLOSURE,      # dst, fnrec_ci, prog_ci
+    OP_POPN,         # n
+    OP_JIT,          # ra, target              jump if isinstance tuple
+    OP_COMMAOK,      # rlist, rc, rk
+    OP_AUG,          # rt, rlist, op_ci
+    OP_VARZERO,      # names_ci, span_ci
+    OP_RANGEPREP,    # dst, ra
+    OP_DEFER,        # rcallee, rargs
+    OP_GO,           # rcallee, rargs
+    OP_CALLARGS,     # dst, parts_ci           build args list (defer/go)
+    OP_INCDEC,       # rt, delta               general target ++/--
+    OP_CONSTDEFER,   # dst, conv, raw_ci       deferred literal decode
+    OP_CALLNS,       # dst, name_ci, sel_ci, parts_ci, ctx_ci  pkg.F(...)
+    OP_CALLN,        # dst, name_ci, parts_ci, ctx_ci          f(...)
+    OP_RET_CALL,     # dst, rcallee, parts_ci, ctx_ci   return f(...)
+    OP_END,          # -                       program epilogue sentinel
+    OP_RANGEITER,    # rseq, rcur, name0_ci, name1_ci, target
+    OP_POPJUMP,      # n, target               fused scope-pop + jump
+    OP_AUG_NAME,     # tgt_ci, rv, op_ci       x += <one value>
+    OP_DEFINE_NAMES, # names_ci, rlist         a, b := values
+    OP_WRITE_NAMES,  # tgts_ci, rlist          a, b = values
+    OP_BINJIF_S,     # op_ci, ka, pa, kb, pb, target  (k: 0=reg 1=name 2=const)
+    OP_JIF_NAME,     # name_ci, target         branch on a bare name
+    # _P twins: on fall-through (branch not taken), also push a scope —
+    # the branch-into-block shape every if/for body pays
+    OP_JIF_P,        # ra, target
+    OP_JIF_NAME_P,   # name_ci, target
+    OP_BINJIF_P,     # op_ci, ra, rb, target
+    OP_BINJIF_S_P,   # op_ci, ka, pa, kb, pb, target
+    OP_CASE_P,       # vregs_ci, rsubj, tagless, target  (push on match)
+    # fused build+expand+assign (the no-comma-ok multi-target shapes)
+    OP_DEFINE_NAMES_V,  # names_ci, vregs_ci, n
+    OP_WRITE_NAMES_V,   # tgts_ci, vregs_ci, n
+    OP_VARDEF_V,        # names_ci, vregs_ci, n
+    OP_MAPLIT_C,     # dst, tmpl_ci            all-const map literal
+) = range(82)
+
+
+class Program:
+    """A lowered body: flat ``code`` (tuples of ints) over ``consts``.
+    ``out`` names the result register for expression sub-programs
+    (composite elements); statement programs leave it None.  Programs
+    are immutable after construction and pickle into the
+    ``gocheck.lower`` manifests — ``_runner`` is a per-process memo of
+    the counting runner wrapper and never crosses the pickle boundary.
+    """
+
+    __slots__ = ("code", "consts", "nregs", "out", "_runner", "_steps")
+
+    def __init__(self, code, consts, nregs, out=None):
+        self.code = code
+        self.consts = consts
+        self.nregs = nregs
+        self.out = out
+        self._runner = None
+        self._steps = None
+
+    def __getstate__(self):
+        return (self.code, self.consts, self.nregs, self.out)
+
+    def __setstate__(self, state):
+        self.code, self.consts, self.nregs, self.out = state
+        self._runner = None
+        self._steps = None
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Program)
+            and self.code == other.code
+            and self.consts == other.consts
+            and self.nregs == other.nregs
+            and self.out == other.out
+        )
+
+    __hash__ = None  # mutable-ish container semantics; keyed by span
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"<Program {len(self.code)} ops, {len(self.consts)} consts, "
+            f"{self.nregs} regs>"
+        )
+
+
+# statically shareable aliases (hot path, same set the compiler binds)
+_Env = I.Env
+_truthy = I._truthy
+_apply_binop = I._apply_binop
+_go_eq = I._go_eq
+_get_attr = I._get_attr
+_go_index = I._go_index
+_type_assert = I._type_assert
+_GoStruct = I.GoStruct
+_Closure = I.Closure
+_VarRef = I.VarRef
+_Return = I._Return
+_AssertResult = I._AssertResult
+_expand = I._expand
+
+# deferred-literal decoders, by small int (picklable reference)
+_DEFER_CONVS = (
+    I._unquote,
+    lambda raw: int(raw, 0),
+    float,
+)
+
+# ``bytecode.executed`` accumulates in a plain cell for the same reason
+# the compiler's ``compile.reused`` does: runners execute once per
+# interpreted function call and must not take the metrics lock per
+# invocation.  Reconciled by compiler.flush_counters() at run/seal
+# boundaries.
+_executed_pending = [0]
+
+
+def flush_executed() -> None:
+    pending, _executed_pending[0] = _executed_pending[0], 0
+    if pending:
+        from ..perf import metrics
+
+        metrics.counter("bytecode.executed").inc(pending)
+
+
+def reset() -> None:
+    _executed_pending[0] = 0
+
+
+def make_runner(prog: Program):
+    """A ``runner(ev, env)`` for *prog*, memoized on the program so
+    hydrated and promoted bodies share one wrapper.  ``functools.
+    partial`` keeps the call C-level (no wrapper frame); execute()
+    itself tallies ``bytecode.executed``."""
+    runner = prog._runner
+    if runner is None:
+        import functools
+
+        runner = prog._runner = functools.partial(execute, prog)
+    return runner
+
+
+def lower_block(scan, lo: int, hi: int):
+    """Lower ``scan.toks[lo:hi]`` to a Program, or None when any
+    contained construct is outside the bytecode subset.  Lowering
+    failures are *never* errors: the body simply stays at the closure
+    tier, whose own walk fallback owns exact error reproduction — so
+    any exception here (including a lowering bug) safely deopts."""
+    try:
+        return _Lower(scan).program(lo, hi)
+    except (Unsupported, _CompileError, RecursionError):
+        return None
+    except Exception:
+        return None
+
+
+def run_expr(prog: Program, ev, env):
+    """Evaluate an expression sub-program and return its result."""
+    return execute(prog, ev, env)[prog.out]
+
+
+# -- composite-literal builders -------------------------------------------
+#
+# Mirrors compiler._composite_body.build / compiler._build_composite over
+# serializable specs: entries are (kind, name, first, second) where
+# expression slots are ("c", value) constants, ("n", name) call-time
+# lookups, or ("p", Program) sub-programs (the lowering collapses
+# single-instruction element expressions — the overwhelmingly common
+# literal/name case — into the first two so a composite build does not
+# pay a register-file setup per element); "elided" holds a nested spec.
+
+
+def _eval_slot(slot, ev, env):
+    kind = slot[0]
+    if kind == "c":
+        return slot[1]
+    if kind == "n":
+        return ev.lookup(slot[1], env)
+    prog = slot[1]
+    return execute(prog, ev, env)[prog.out]
+
+
+def _build_spec(spec, ev, env, tname, expr_keys, elem_type):
+    fields = {}
+    elems = []
+    for kind, name, first, second in spec:
+        if kind == "elem":
+            # the hot shape (slice/struct element lists): inline the
+            # slot evaluation to skip a call per element
+            k0 = first[0]
+            if k0 == "c":
+                elems.append(first[1])
+            elif k0 == "n":
+                elems.append(ev.lookup(first[1], env))
+            else:
+                prog = first[1]
+                elems.append(execute(prog, ev, env)[prog.out])
+        elif kind == "dualkey":
+            if expr_keys:
+                key = _eval_slot(first, ev, env)  # key first, like walk
+                fields[key] = _eval_slot(second, ev, env)
+            else:
+                fields[name] = _eval_slot(second, ev, env)
+        elif kind == "kv":
+            key = _eval_slot(first, ev, env)
+            fields[key] = _eval_slot(second, ev, env)
+        elif kind == "elided":
+            if elem_type is not None:
+                elems.append(_build_composite(ev, env, elem_type, first))
+            else:
+                elems.append(_build_spec(first, ev, env, "<anon>", False,
+                                         None))
+    if tname == "slice":
+        return elems
+    if tname == "map":
+        return fields
+    if elems and not fields:
+        return elems  # e.g. []Event{...} routed through slice
+    return _GoStruct(tname, fields)
+
+
+def _build_composite(ev, env, typeval, spec):
+    if isinstance(typeval, I.MapTypeRef):
+        return _build_spec(spec, ev, env, "map", True, None)
+    if isinstance(typeval, I.TypeFactory):
+        built = _build_spec(spec, ev, env, typeval.name, False, None)
+        fields = built.fields if isinstance(built, _GoStruct) else {}
+        return typeval.make(fields)
+    if isinstance(typeval, I.TypeRef):
+        return _build_spec(spec, ev, env, typeval.name, False, None)
+    built = _build_spec(spec, ev, env, "<native>", False, None)
+    inst = typeval()
+    if isinstance(built, _GoStruct):
+        for fname, fval in built.fields.items():
+            setattr(inst, fname, fval)
+    return inst
+
+
+# -- the dispatch loop -----------------------------------------------------
+
+
+def _execute_ladder(prog: Program, ev, env):
+    """Run *prog* against the live evaluator/scope.  Returns the
+    register file (expression sub-programs read their ``out`` slot).
+    Exceptions — ``_Return`` from OP_RET*, ``GoPanic``/``GoInterpError``
+    from runtime ops — propagate to the caller exactly as they do from
+    the closure tier; local scope bookkeeping is simply abandoned.
+
+    The ladder is ordered by measured dynamic frequency over the
+    kitchen-sink corpus; every program ends with OP_END, so the loop
+    runs without a bounds check."""
+    _executed_pending[0] += 1
+    code = prog.code
+    consts = prog.consts
+    regs = [None] * prog.nregs
+    scopes = []
+    pc = 0
+    lookup = ev.lookup
+    call_value = ev._call_value
+    while True:
+        ins = code[pc]
+        op = ins[0]
+        if op == OP_LOOKSEL:
+            regs[ins[1]] = _resolve_sel(
+                ev, lookup(consts[ins[2]], env), consts[ins[3]]
+            )
+        elif op == OP_LOOKUP:
+            regs[ins[1]] = lookup(consts[ins[2]], env)
+        elif op == OP_PUSH:
+            scopes.append(env)
+            env = _Env(env)
+        elif op == OP_CALLNS:
+            callee = _resolve_sel(
+                ev, lookup(consts[ins[2]], env), consts[ins[3]]
+            )
+            args = _build_args(
+                _bind_parts(consts[ins[4]], consts), ev, regs, env
+            )
+            if callee is None:
+                text, line, col = consts[ins[5]]
+                raise I.GoInterpError(
+                    f"not callable: nil ({text!r} at {line}:{col})"
+                )
+            regs[ins[1]] = call_value(callee, args)
+        elif op == OP_POP:
+            env = scopes.pop()
+        elif op == OP_END:
+            return regs
+        elif op == OP_BINJIF_S:
+            # the `if err != nil` / `i < n` shape, one dispatch: both
+            # operands resolved in place (0=reg, 1=name, 2=const) in
+            # their original left-to-right order
+            k = ins[2]
+            if k == 0:
+                a = regs[ins[3]]
+            elif k == 1:
+                a = lookup(consts[ins[3]], env)
+            else:
+                a = consts[ins[3]]
+            k = ins[4]
+            if k == 0:
+                b = regs[ins[5]]
+            elif k == 1:
+                b = lookup(consts[ins[5]], env)
+            else:
+                b = consts[ins[5]]
+            if not _truthy(_apply_binop(consts[ins[1]], a, b)):
+                pc = ins[6]
+                continue
+        elif op == OP_BINJIF:
+            if not _truthy(_apply_binop(
+                consts[ins[1]], regs[ins[2]], regs[ins[3]]
+            )):
+                pc = ins[4]
+                continue
+        elif op == OP_BINJIF_S_P:
+            k = ins[2]
+            if k == 0:
+                a = regs[ins[3]]
+            elif k == 1:
+                a = lookup(consts[ins[3]], env)
+            else:
+                a = consts[ins[3]]
+            k = ins[4]
+            if k == 0:
+                b = regs[ins[5]]
+            elif k == 1:
+                b = lookup(consts[ins[5]], env)
+            else:
+                b = consts[ins[5]]
+            if not _truthy(_apply_binop(consts[ins[1]], a, b)):
+                pc = ins[6]
+                continue
+            scopes.append(env)
+            env = _Env(env)
+        elif op == OP_BINJIF_P:
+            if not _truthy(_apply_binop(
+                consts[ins[1]], regs[ins[2]], regs[ins[3]]
+            )):
+                pc = ins[4]
+                continue
+            scopes.append(env)
+            env = _Env(env)
+        elif op == OP_JIF_P:
+            if not _truthy(regs[ins[1]]):
+                pc = ins[2]
+                continue
+            scopes.append(env)
+            env = _Env(env)
+        elif op == OP_JIF_NAME_P:
+            if not _truthy(lookup(consts[ins[1]], env)):
+                pc = ins[2]
+                continue
+            scopes.append(env)
+            env = _Env(env)
+        elif op == OP_JIF_NAME:
+            if not _truthy(lookup(consts[ins[1]], env)):
+                pc = ins[2]
+                continue
+        elif op == OP_CONST:
+            regs[ins[1]] = consts[ins[2]]
+        elif op == OP_DEFINE_FAST:
+            value = regs[ins[2]]
+            if isinstance(value, _AssertResult):
+                value = value[0]  # _expand's one-target unwrap
+            env.define(consts[ins[1]], value)
+        elif op == OP_CALL or op == OP_RET_CALL:
+            callee = regs[ins[2]]
+            args = _build_args(
+                _bind_parts(consts[ins[3]], consts), ev, regs, env
+            )
+            if callee is None:
+                text, line, col = consts[ins[4]]
+                raise I.GoInterpError(
+                    f"not callable: nil ({text!r} at {line}:{col})"
+                )
+            if op == OP_RET_CALL:
+                raise _Return(call_value(callee, args))
+            regs[ins[1]] = call_value(callee, args)
+        elif op == OP_AND_SHORT:
+            if not _truthy(regs[ins[1]]):
+                regs[ins[2]] = False
+                pc = ins[3]
+                continue
+        elif op == OP_RANGEITER:
+            seq = regs[ins[1]]
+            cur = regs[ins[2]]
+            if cur >= len(seq):
+                pc = ins[5]
+                continue
+            key, value = seq[cur]
+            regs[ins[2]] = cur + 1
+            scopes.append(env)
+            env = _Env(env)
+            if ins[3] >= 0:
+                env.define(consts[ins[3]], key)
+            if ins[4] >= 0:
+                env.define(consts[ins[4]], value)
+        elif op == OP_MAPLIT:
+            regs[ins[1]] = _build_spec(consts[ins[2]], ev, env, "map",
+                                       True, None)
+        elif op == OP_VALUES:
+            regs[ins[1]] = [regs[r] for r in consts[ins[2]]]
+        elif op == OP_EXPAND:
+            regs[ins[1]] = _expand(regs[ins[1]], ins[2])
+        elif op == OP_POPJUMP:
+            n = ins[1]
+            env = scopes[-n]
+            del scopes[-n:]
+            pc = ins[2]
+            continue
+        elif op == OP_BINOP:
+            regs[ins[1]] = _apply_binop(
+                consts[ins[2]], regs[ins[3]], regs[ins[4]]
+            )
+        elif op == OP_JIF:
+            if not _truthy(regs[ins[1]]):
+                pc = ins[2]
+                continue
+        elif op == OP_AUG_NAME:
+            target = consts[ins[1]]
+            value = regs[ins[2]]
+            if isinstance(value, _AssertResult):
+                value = value[0]  # _expand's one-target unwrap
+            old = ev._read_target(target, env)
+            ev._write_target(
+                target, _apply_binop(consts[ins[3]], old, value), env
+            )
+        elif op == OP_COMPOSITE:
+            value = regs[ins[2]]
+            if isinstance(value, (I.TypeRef, type)):
+                regs[ins[1]] = _build_composite(
+                    ev, env, value, consts[ins[3]]
+                )
+            else:
+                # walk's _StopExpr: the composite brace over a non-type
+                # value folds the pending ancestor binops (the compile-
+                # time spine) onto the carried value, the rest of the
+                # rooted expression is skipped, and the root yields it
+                for entry in consts[ins[4]]:
+                    if entry[0] == "b":
+                        value = _apply_binop(entry[1], regs[entry[2]],
+                                             value)
+                    else:
+                        value = _truthy(value)
+                regs[ins[5]] = value
+                pc = ins[6]
+                continue
+        elif op == OP_SLICELIT:
+            ev.env = env  # _resolve_type_value reads ev.env
+            elem_type = ev._resolve_type_value(consts[ins[2]])
+            regs[ins[1]] = _build_spec(consts[ins[3]], ev, env, "slice",
+                                       False, elem_type)
+        elif op == OP_SEL:
+            regs[ins[1]] = _resolve_sel(ev, regs[ins[2]],
+                                        consts[ins[3]])
+        elif op == OP_DEFINE_NAMES:
+            values = regs[ins[2]]
+            for name, value in zip(consts[ins[1]], values):
+                env.define(name, value)
+        elif op == OP_DEFINE_NAMES_V:
+            values = _expand([regs[r] for r in consts[ins[2]]], ins[3])
+            for name, value in zip(consts[ins[1]], values):
+                env.define(name, value)
+        elif op == OP_WRITE_NAMES_V:
+            values = _expand([regs[r] for r in consts[ins[2]]], ins[3])
+            for target, value in zip(consts[ins[1]], values):
+                ev._write_target(target, value, env)
+        elif op == OP_VARDEF_V:
+            values = _expand([regs[r] for r in consts[ins[2]]], ins[3])
+            for name, value in zip(consts[ins[1]], values):
+                env.define(name, value)
+        elif op == OP_MAPLIT_C:
+            regs[ins[1]] = dict(consts[ins[2]])
+        elif op == OP_CASE_P:
+            subject = regs[ins[2]]
+            tagless = ins[3]
+            matched = False
+            for vr in consts[ins[1]]:
+                value = regs[vr]
+                matched = (
+                    _truthy(value) if tagless else _go_eq(subject, value)
+                )
+                if matched:
+                    break
+            if matched:
+                scopes.append(env)
+                env = _Env(env)
+                pc = ins[4]
+                continue
+        elif op == OP_DEFINE_N:
+            values = regs[ins[1]]
+            targets = [regs[r] for r in consts[ins[2]]]
+            for target, value in zip(targets, values):
+                if target[0] != "name":
+                    raise I.GoInterpError(":= target must be a name")
+                env.define(target[1], value)
+        elif op == OP_RETN:
+            out = []
+            for kind, payload in consts[ins[1]]:
+                if kind == "r":
+                    out.append(regs[payload])
+                elif kind == "n":
+                    out.append(lookup(payload, env))
+                else:
+                    out.append(consts[payload])
+            raise _Return(tuple(out))
+        elif op == OP_CALLN:
+            callee = lookup(consts[ins[2]], env)
+            args = _build_args(
+                _bind_parts(consts[ins[3]], consts), ev, regs, env
+            )
+            if callee is None:
+                text, line, col = consts[ins[4]]
+                raise I.GoInterpError(
+                    f"not callable: nil ({text!r} at {line}:{col})"
+                )
+            regs[ins[1]] = call_value(callee, args)
+        elif op == OP_ASSIGN_FAST:
+            value = regs[ins[2]]
+            if isinstance(value, _AssertResult):
+                value = value[0]
+            ev._write_target(consts[ins[1]], value, env)
+        elif op == OP_OR_SHORT:
+            if _truthy(regs[ins[1]]):
+                regs[ins[2]] = True
+                pc = ins[3]
+                continue
+        elif op == OP_JUMP:
+            pc = ins[1]
+            continue
+        elif op == OP_RET1:
+            raise _Return(regs[ins[1]])
+        elif op == OP_RET_NAME:
+            raise _Return(lookup(consts[ins[1]], env))
+        elif op == OP_RET_CONST:
+            raise _Return(consts[ins[1]])
+        elif op == OP_RET_NONE:
+            raise _Return(None)
+        elif op == OP_CALLSEL:
+            callee = _resolve_sel(ev, regs[ins[2]], consts[ins[3]])
+            args = _build_args(
+                _bind_parts(consts[ins[4]], consts), ev, regs, env
+            )
+            if callee is None:
+                text, line, col = consts[ins[5]]
+                raise I.GoInterpError(
+                    f"not callable: nil ({text!r} at {line}:{col})"
+                )
+            regs[ins[1]] = call_value(callee, args)
+        elif op == OP_INDEX:
+            regs[ins[1]] = _go_index(regs[ins[2]], regs[ins[3]])
+        elif op == OP_TRUTHY:
+            regs[ins[1]] = _truthy(regs[ins[2]])
+        elif op == OP_MOV:
+            regs[ins[1]] = regs[ins[2]]
+        elif op == OP_WRITE_NAMES:
+            values = regs[ins[2]]
+            for target, value in zip(consts[ins[1]], values):
+                ev._write_target(target, value, env)
+        elif op == OP_WRITE_N:
+            values = regs[ins[1]]
+            targets = [regs[r] for r in consts[ins[2]]]
+            for target, value in zip(targets, values):
+                ev._write_target(target, value, env)
+        elif op == OP_TGT_NAME:
+            regs[ins[1]] = consts[ins[2]]
+        elif op == OP_TGT_SEL:
+            regs[ins[1]] = ("sel", regs[ins[2]], consts[ins[3]])
+        elif op == OP_TGT_INDEX:
+            regs[ins[1]] = ("index", regs[ins[2]], regs[ins[3]])
+        elif op == OP_TGT_STAR:
+            regs[ins[1]] = ("star", regs[ins[2]])
+        elif op == OP_INC_NAME:
+            target = consts[ins[1]]
+            old = ev._read_target(target, env)
+            ev._write_target(target, old + ins[2], env)
+        elif op == OP_NOT:
+            regs[ins[1]] = not _truthy(regs[ins[2]])
+        elif op == OP_NEG:
+            regs[ins[1]] = -regs[ins[2]]
+        elif op == OP_DEREF:
+            value = regs[ins[2]]
+            if isinstance(value, _VarRef):
+                value = value.get()
+            regs[ins[1]] = value
+        elif op == OP_ADDR:
+            name = consts[ins[2]]
+            if env.has(name) and isinstance(
+                env.get(name), (str, int, float, bool)
+            ):
+                regs[ins[1]] = _VarRef(env, name)
+                pc = ins[3]
+                continue
+        elif op == OP_ASSERT:
+            value = regs[ins[2]]
+            ok = _type_assert(value, consts[ins[3]])
+            regs[ins[1]] = _AssertResult((value if ok else None, ok))
+        elif op == OP_BYTES:
+            value = regs[ins[2]]
+            regs[ins[1]] = (
+                value.encode() if isinstance(value, str) else value
+            )
+        elif op == OP_LEN:
+            value = regs[ins[2]]
+            regs[ins[1]] = 0 if value is None else len(value)
+        elif op == OP_APPEND:
+            args = _build_args(
+                _bind_parts(consts[ins[2]], consts), ev, regs, env
+            )
+            base = list(args[0]) if args[0] else []
+            base.extend(args[1:])
+            regs[ins[1]] = base
+        elif op == OP_PANIC:
+            raise I.GoPanic(regs[ins[1]])
+        elif op == OP_CONV:
+            value = regs[ins[2]]
+            conv = I._NUMERIC_CONVERSIONS[consts[ins[3]]]
+            regs[ins[1]] = conv(value) if value is not None else 0
+        elif op == OP_STR:
+            value = regs[ins[2]]
+            if isinstance(value, (bytes, bytearray)):
+                regs[ins[1]] = value.decode()
+            elif isinstance(value, int) and not isinstance(value, bool):
+                regs[ins[1]] = chr(value)
+            else:
+                regs[ins[1]] = "" if value is None else str(value)
+        elif op == OP_NEW:
+            regs[ins[1]] = _GoStruct(consts[ins[2]])
+        elif op == OP_MAKEMAP:
+            regs[ins[1]] = {}
+        elif op == OP_MAKESLICE:
+            regs[ins[1]] = []
+        elif op == OP_CLOSURE:
+            closure = _Closure(consts[ins[2]], ev.scan, env)
+            # absolute spans: the runtime scan's tokens are
+            # content-identical to the compile-time ones
+            closure.toks = ev.scan.toks
+            closure.compiled = make_runner(consts[ins[3]])
+            regs[ins[1]] = closure
+        elif op == OP_POPN:
+            n = ins[1]
+            env = scopes[-n]
+            del scopes[-n:]
+        elif op == OP_JIT:
+            if isinstance(regs[ins[1]], tuple):
+                pc = ins[2]
+                continue
+        elif op == OP_COMMAOK:
+            container = regs[ins[2]]
+            key = regs[ins[3]]
+            if container is None:
+                pair = ("", False)
+            elif isinstance(container, dict):
+                pair = (container.get(key, ""), key in container)
+            else:
+                pair = None
+            if pair is not None:
+                regs[ins[1]] = list(pair)
+        elif op == OP_AUG:
+            target = regs[ins[1]]
+            values = regs[ins[2]]
+            old = ev._read_target(target, env)
+            ev._write_target(
+                target, _apply_binop(consts[ins[3]], old, values[0]), env
+            )
+        elif op == OP_VARZERO:
+            ev.env = env  # _zero_value resolves type names through ev.env
+            zero = ev._zero_value(consts[ins[2]])
+            for name in consts[ins[1]]:
+                env.define(name, zero() if callable(zero) else zero)
+        elif op == OP_RANGEPREP:
+            iterable = regs[ins[2]]
+            if iterable is None:
+                iterable = []
+            regs[ins[1]] = (
+                list(iterable.items()) if isinstance(iterable, dict)
+                else list(enumerate(iterable))
+            )
+        elif op == OP_DEFER:
+            ev.defers.append((regs[ins[1]], regs[ins[2]]))
+        elif op == OP_GO:
+            ev.interp.sched.spawn(ev.interp, regs[ins[1]], regs[ins[2]])
+        elif op == OP_CALLARGS:
+            regs[ins[1]] = _build_args(
+                _bind_parts(consts[ins[2]], consts), ev, regs, env
+            )
+        elif op == OP_INCDEC:
+            target = regs[ins[1]]
+            old = ev._read_target(target, env)
+            ev._write_target(target, old + ins[2], env)
+        elif op == OP_CONSTDEFER:
+            # a malformed literal defers the decode (and its error) to
+            # execution time, exactly where walk raises it
+            regs[ins[1]] = _DEFER_CONVS[ins[2]](consts[ins[3]])
+        else:  # pragma: no cover - compiler/loop version skew guard
+            raise I.GoInterpError(f"bad bytecode op {op}")
+        pc += 1
+
+
+# -- the lowering compiler -------------------------------------------------
+
+
+class _Lower:
+    """Translates token spans of one scan into Programs.
+
+    Span navigation (statement ends, clause splits, switch clause
+    walking, type ends, param items) is delegated to an embedded
+    closure-tier :class:`_Compiler` so both tiers segment source
+    identically by construction; only the emission differs.  Any
+    ``_CompileError`` those helpers raise becomes a deopt.
+    """
+
+    def __init__(self, scan):
+        self.scan = scan
+        self.toks = scan.toks
+        self.aux = _Compiler(scan)
+        self.code = []          # lists while building; tuples at finish
+        self.consts = []
+        self._const_ids = {}
+        self._reg = 0
+        self._maxreg = 0
+        self._root_lo = 0
+        self._spine = []        # pending-binop stack of the current root
+        self._stops = []        # COMPOSITE instr indices of current root
+        self._root_had_stops = False  # set at each expr_root close
+        self._blocks = []       # enclosing breakables (loops + switches)
+        self._depth = 0         # current scope depth
+        # peephole fence: no fusion may pop or rewrite an instruction
+        # below this index — anything below is (or may be) a jump
+        # target whose landing semantics must stay fixed
+        self._barrier = 0
+
+    # -- emission helpers -------------------------------------------------
+
+    def emit(self, *ins) -> int:
+        self.code.append(list(ins))
+        return len(self.code) - 1
+
+    def alloc(self) -> int:
+        reg = self._reg
+        self._reg = reg + 1
+        if self._reg > self._maxreg:
+            self._maxreg = self._reg
+        return reg
+
+    def const(self, value) -> int:
+        try:
+            key = (type(value).__name__, value)
+            idx = self._const_ids.get(key)
+            if idx is None:
+                idx = len(self.consts)
+                self.consts.append(value)
+                self._const_ids[key] = idx
+            return idx
+        except TypeError:  # unhashable (token spans, specs, programs)
+            self.consts.append(value)
+            return len(self.consts) - 1
+
+    def here(self) -> int:
+        return len(self.code)
+
+    def _resolve(self, idx: int) -> None:
+        """Point the forward jump at *idx* to the next instruction and
+        fence the peepholes (the landing position is now load-bearing)."""
+        self.code[idx][-1] = len(self.code)
+        self._barrier = len(self.code)
+
+    def _fusable(self) -> bool:
+        """Whether the last emitted instruction may be popped/rewritten
+        (it exists and is not a jump-target fence position)."""
+        return len(self.code) > self._barrier
+
+    def push_scope(self) -> None:
+        self.emit(OP_PUSH)
+        self._depth += 1
+
+    def pop_scope(self) -> None:
+        self._depth -= 1
+        if self._fusable():
+            last = self.code[-1]
+            if last[0] == OP_POP:
+                self.code[-1] = [OP_POPN, 2]
+                return
+            if last[0] == OP_POPN:
+                last[1] += 1
+                return
+        self.emit(OP_POP)
+
+    def emit_jump(self, target) -> int:
+        """A jump, fusing an immediately-preceding scope pop (the
+        block-exit POP;JUMP shape every loop body and then-branch
+        emits)."""
+        if self._fusable():
+            last = self.code[-1]
+            if last[0] == OP_POP:
+                self.code[-1] = [OP_POPJUMP, 1, target]
+                return len(self.code) - 1
+            if last[0] == OP_POPN:
+                self.code[-1] = [OP_POPJUMP, last[1], target]
+                return len(self.code) - 1
+        return self.emit(OP_JUMP, target)
+
+    def _finish(self, out):
+        self.emit(OP_END)
+        code = tuple(tuple(ins) for ins in self.code)
+        return Program(code, tuple(self.consts), max(self._maxreg, 1), out)
+
+    def program(self, lo: int, hi: int) -> Program:
+        self.stmts(lo, hi)
+        return self._finish(None)
+
+    def _sub_program(self, lo: int, hi: int) -> Program:
+        """A statement sub-program (func-literal body) with its own
+        register/const space."""
+        return _Lower(self.scan).program(lo, hi)
+
+    def _sub_expr(self, lo: int, hi: int) -> tuple:
+        """An expression slot (composite element / key): a collapsed
+        ("c", value) / ("n", name) for single-instruction expressions,
+        else a ("p", Program) sub-program."""
+        sub = _Lower(self.scan)
+        out = sub.expr_root(lo, hi)
+        if len(sub.code) == 1:
+            ins = sub.code[0]
+            if ins[0] == OP_CONST and ins[1] == out:
+                return ("c", sub.consts[ins[2]])
+            if ins[0] == OP_LOOKUP and ins[1] == out:
+                return ("n", sub.consts[ins[2]])
+        return ("p", sub._finish(out))
+
+    # == blocks and statements ===========================================
+
+    def stmts(self, lo: int, hi: int) -> None:
+        toks = self.toks
+        i = lo
+        while i < hi:
+            t = toks[i]
+            if t.kind == OP and t.value == ";":
+                i += 1
+                continue
+            # registers are statement-scoped: values flow between
+            # statements through Env, never registers, so each
+            # statement's temporaries are reclaimed for the next
+            watermark = self._reg
+            i = self._stmt(i, hi)
+            self._reg = watermark
+
+    def _stmt(self, i: int, hi: int) -> int:
+        toks = self.toks
+        t = toks[i]
+        if t.kind == KEYWORD:
+            v = t.value
+            if v == "return":
+                return self._stmt_return(i, hi)
+            if v == "if":
+                return self._stmt_if(i, hi)
+            if v == "for":
+                return self._stmt_for(i, hi)
+            if v == "switch":
+                return self._stmt_switch(i, hi)
+            if v == "continue":
+                self._emit_continue()
+                return i + 1
+            if v == "break":
+                self._emit_break()
+                return i + 1
+            if v == "var":
+                return self._stmt_var(i, hi)
+            if v in ("defer", "go"):
+                return self._stmt_defer_go(i, hi, is_go=(v == "go"))
+            raise Unsupported(v)
+        if t.kind == OP and t.value == "{":
+            lo2, hi2 = I._group_span(toks, i)
+            self.push_scope()
+            self.stmts(lo2, hi2)
+            self.pop_scope()
+            return hi2 + 1
+        return self._simple_stmt(i, hi)
+
+    def _emit_break(self) -> None:
+        if not self._blocks:
+            raise Unsupported("break outside loop/switch")
+        target = self._blocks[-1]
+        n = self._depth - target["break_depth"]
+        target["breaks"].append(
+            self.emit(OP_POPJUMP, n, None) if n
+            else self.emit(OP_JUMP, None)
+        )
+
+    def _emit_continue(self) -> None:
+        target = None
+        for entry in reversed(self._blocks):
+            if entry["kind"] == "loop":
+                target = entry
+                break
+        if target is None:
+            raise Unsupported("continue outside loop")
+        n = self._depth - target["cont_depth"]
+        target["conts"].append(
+            self.emit(OP_POPJUMP, n, None) if n
+            else self.emit(OP_JUMP, None)
+        )
+
+    def _patch(self, indices, target_pc) -> None:
+        for idx in indices:
+            self.code[idx][-1] = target_pc
+        self._barrier = len(self.code)
+
+    # -- return / defer / go ---------------------------------------------
+
+    def _stmt_return(self, i: int, hi: int) -> int:
+        end = self.aux._stmt_end(i + 1, hi)
+        if end == i + 1:
+            self.emit(OP_RET_NONE)
+            return end
+        spans_list = I._split_commas(self.toks, i + 1, end)
+        regs = [self.expr_root(slo, shi) for slo, shi in spans_list]
+        if len(regs) == 1:
+            last = self.code[-1] if self._fusable() else None
+            if last is not None and last[1] == regs[0] and (
+                last[0] == OP_LOOKUP or last[0] == OP_CONST
+            ):
+                self.code.pop()
+                self.emit(
+                    OP_RET_NAME if last[0] == OP_LOOKUP else OP_RET_CONST,
+                    last[2],
+                )
+            elif last is not None and last[0] == OP_CALL and (
+                last[1] == regs[0]
+            ):
+                # return f(...): raise straight from the call
+                self.code.pop()
+                self.emit(OP_RET_CALL, last[1], last[2], last[3],
+                          last[4])
+            else:
+                self.emit(OP_RET1, regs[0])
+        else:
+            # multi-value return: trailing bare loads fold into the
+            # spec (same tail rule as call parts)
+            entries = [["r", r] for r in regs]
+            for ent in reversed(entries):
+                last = self.code[-1] if self._fusable() else None
+                if last is None or last[1] != ent[1]:
+                    break
+                if last[0] == OP_LOOKUP:
+                    ent[0], ent[1] = "n", self.consts[last[2]]
+                    self.code.pop()
+                elif last[0] == OP_CONST:
+                    ent[0], ent[1] = "c", last[2]
+                    self.code.pop()
+                else:
+                    break
+            self.emit(OP_RETN,
+                      self.const(tuple(tuple(e) for e in entries)))
+        return end
+
+    def _stmt_defer_go(self, i: int, hi: int, is_go: bool) -> int:
+        toks = self.toks
+        end = self.aux._stmt_end(i + 1, hi)
+        close = end - 1
+        if not (toks[close].kind == OP and toks[close].value == ")"):
+            raise Unsupported("defer/go")
+        depth = 0
+        j = close
+        while j > i:
+            t = toks[j]
+            if t.kind == OP and t.value in ")]}":
+                depth += 1
+            elif t.kind == OP and t.value in "([{":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        rcallee = self.expr_root(i + 1, j)
+        rargs = self._call_args(j + 1, close)
+        self.emit(OP_GO if is_go else OP_DEFER, rcallee, rargs)
+        return end
+
+    # -- control clauses --------------------------------------------------
+
+    def _stmt_if(self, i: int, hi: int) -> int:
+        toks = self.toks
+        segments, brace = self.aux._clause_parts(i + 1)
+        self.push_scope()  # the clause scope (walk creates it always)
+        if len(segments) == 2:
+            self._simple_stmt(segments[0][0], segments[0][1])
+            cond_lo, cond_hi = segments[1]
+        elif len(segments) == 1:
+            cond_lo, cond_hi = segments[0]
+        else:
+            raise Unsupported("if clause")
+        rcond = self.expr_root(cond_lo, cond_hi)
+        jif = self.emit_jif(rcond, push=True)  # then-scope fused in
+        blo, bhi = I._group_span(toks, brace)
+        self._depth += 1
+        self.stmts(blo, bhi)
+        self.pop_scope()
+        after = bhi + 1
+        chain_end = after
+        if (
+            after < hi
+            and toks[after].kind == KEYWORD
+            and toks[after].value == "else"
+        ):
+            skip = self.emit_jump(None)
+            self._resolve(jif)
+            j = after + 1
+            if toks[j].kind == KEYWORD and toks[j].value == "if":
+                # the nested if chains off THIS clause scope, exactly
+                # like walk's else_step(ev, scope)
+                chain_end = self._stmt_if(j, hi)
+            else:
+                elo, ehi = I._group_span(toks, j)
+                self.push_scope()
+                self.stmts(elo, ehi)
+                self.pop_scope()
+                chain_end = ehi + 1
+            self._resolve(skip)
+        else:
+            self._resolve(jif)
+        self.pop_scope()  # the clause scope
+        return chain_end
+
+    def _stmt_for(self, i: int, hi: int) -> int:
+        toks = self.toks
+        segments, brace = self.aux._clause_parts(i + 1)
+        blo, bhi = I._group_span(toks, brace)
+        after = bhi + 1
+        # range form?  (walk scans the single segment without depth
+        # tracking; mirror that exactly)
+        flat = None
+        if len(segments) == 1:
+            lo_s, hi_s = segments[0]
+            for j in range(lo_s, hi_s):
+                if toks[j].kind == KEYWORD and toks[j].value == "range":
+                    flat = j
+                    break
+        if flat is not None:
+            return self._stmt_range(segments[0], flat, blo, bhi, after)
+        if len(segments) == 1 and segments[0][0] == segments[0][1]:
+            segments = []  # bare `for {`
+        if len(segments) == 3:
+            return self._stmt_for3(segments, blo, bhi, after)
+        if len(segments) <= 1:
+            return self._stmt_while(segments, blo, bhi, after)
+        raise Unsupported("for clause")
+
+    def _stmt_range(self, segment, flat, blo, bhi, after) -> int:
+        toks = self.toks
+        lo_s, hi_s = segment
+        names = []
+        k = lo_s
+        while k < flat and toks[k].kind == IDENT:
+            names.append(toks[k].value)
+            if toks[k + 1].kind == OP and toks[k + 1].value == ",":
+                k += 2
+            else:
+                k += 1
+                break
+        riter = self.expr_root(flat + 1, hi_s)
+        rseq = self.alloc()
+        rcur = self.alloc()
+        self.emit(OP_RANGEPREP, rseq, riter)
+        self.emit(OP_CONST, rcur, self.const(0))
+        # one fused op per iteration: advance + fresh scope + binds
+        # (exhaustion jumps out at the pre-push depth)
+        next_pc = self.emit(
+            OP_RANGEITER, rseq, rcur,
+            self.const(names[0]) if names else -1,
+            self.const(names[1]) if len(names) > 1 else -1,
+            None,
+        )
+        block = {
+            "kind": "loop", "breaks": [], "conts": [],
+            "break_depth": self._depth, "cont_depth": self._depth,
+        }
+        self._blocks.append(block)
+        self._depth += 1  # the scope RANGEITER pushes per iteration
+        self._barrier = len(self.code)  # next_pc is a live jump target
+        self.stmts(blo, bhi)
+        self._depth -= 1
+        self.emit(OP_POPJUMP, 1, next_pc)
+        self._blocks.pop()
+        end_pc = self.here()
+        self.code[next_pc][-1] = end_pc
+        self._barrier = len(self.code)
+        self._patch(block["breaks"], end_pc)
+        self._patch(block["conts"], next_pc)
+        return after
+
+    def _stmt_for3(self, segments, blo, bhi, after) -> int:
+        init_lo, init_hi = segments[0]
+        cond_lo, cond_hi = segments[1]
+        post_lo, post_hi = segments[2]
+        self.push_scope()  # the clause scope shared by init/cond/post
+        if init_hi > init_lo:
+            self._simple_stmt(init_lo, init_hi)
+        cond_pc = self.here()
+        self._barrier = len(self.code)  # jump-back landing position
+        jif = None
+        if cond_hi > cond_lo:
+            rcond = self.expr_root(cond_lo, cond_hi)
+            jif = self.emit_jif(rcond, push=True)  # body scope fused
+        block = {
+            "kind": "loop", "breaks": [], "conts": [],
+            "break_depth": self._depth, "cont_depth": self._depth,
+        }
+        self._blocks.append(block)
+        if jif is not None:
+            self._depth += 1  # the scope the fused branch pushes
+        else:
+            self.push_scope()  # fresh body scope per iteration
+        self.stmts(blo, bhi)
+        self.pop_scope()
+        post_pc = self.here()
+        # continue lands here: fence the peephole so the back-jump
+        # fusion below cannot swallow the landing position
+        self._barrier = len(self.code)
+        if post_hi > post_lo:
+            watermark = self._reg
+            self._simple_stmt(post_lo, post_hi)
+            self._reg = watermark
+        self.emit_jump(cond_pc)
+        self._blocks.pop()
+        end_pc = self.here()
+        if jif is not None:
+            self.code[jif][-1] = end_pc
+            self._barrier = len(self.code)
+        self._patch(block["breaks"], end_pc)
+        self._patch(block["conts"], post_pc)
+        self.pop_scope()  # the clause scope
+        return after
+
+    def _stmt_while(self, segments, blo, bhi, after) -> int:
+        cond_pc = self.here()
+        self._barrier = len(self.code)  # jump-back landing position
+        jif = None
+        if segments:
+            rcond = self.expr_root(*segments[0])
+            jif = self.emit_jif(rcond, push=True)  # body scope fused
+        block = {
+            "kind": "loop", "breaks": [], "conts": [],
+            "break_depth": self._depth, "cont_depth": self._depth,
+        }
+        self._blocks.append(block)
+        if jif is not None:
+            self._depth += 1  # the scope the fused branch pushes
+        else:
+            self.push_scope()  # fresh body scope per iteration
+        self.stmts(blo, bhi)
+        self.pop_scope()
+        self.emit_jump(cond_pc)
+        self._blocks.pop()
+        end_pc = self.here()
+        if jif is not None:
+            self.code[jif][-1] = end_pc
+            self._barrier = len(self.code)
+        self._patch(block["breaks"], end_pc)
+        self._patch(block["conts"], cond_pc)
+        return after
+
+    # -- switch -----------------------------------------------------------
+
+    def _stmt_switch(self, i: int, hi: int) -> int:
+        toks = self.toks
+        segments, brace = self.aux._clause_parts(i + 1)
+        ts = (
+            I._Eval._type_switch_parts(toks, segments[-1])
+            if segments else None
+        )
+        if ts is not None:
+            raise Unsupported("type switch")  # closure tier handles it
+        self.push_scope()  # the clause scope
+        if len(segments) == 2:
+            self._simple_stmt(segments[0][0], segments[0][1])
+            segments = segments[1:]
+        tagless = True
+        rsubj = self.alloc()
+        if len(segments) == 1 and segments[0][1] > segments[0][0]:
+            rsubj = self.expr_root(segments[0][0], segments[0][1])
+            tagless = False
+        else:
+            self.emit(OP_CONST, rsubj, self.const(True))
+        blo, bhi = I._group_span(toks, brace)
+        cases = []
+        default_span = None
+        for exprs, slo, shi in self.aux._switch_clauses(blo, bhi):
+            if exprs is None:
+                default_span = (slo, shi)  # last default wins, like walk
+                continue
+            cases.append((exprs, slo, shi))
+        block = {
+            "kind": "switch", "breaks": [], "conts": None,
+            "break_depth": self._depth,
+        }
+        case_jumps = []
+        for exprs, _slo, _shi in cases:
+            vregs = [
+                self.expr_root(vlo, vhi)
+                for vlo, vhi in I._split_commas(toks, exprs[0], exprs[1])
+            ]
+            case_jumps.append(self.emit(
+                OP_CASE_P, self.const(tuple(vregs)), rsubj,
+                1 if tagless else 0, None,
+            ))
+        default_jump = self.emit(OP_JUMP, None)
+        self._blocks.append(block)
+        for idx, (_exprs, slo, shi) in enumerate(cases):
+            self._resolve(case_jumps[idx])
+            self._depth += 1  # the scope the matching CASE_P pushed
+            self.stmts(slo, shi)
+            self.pop_scope()
+            block["breaks"].append(self.emit_jump(None))
+        self._resolve(default_jump)
+        if default_span is not None:
+            self.push_scope()
+            self.stmts(default_span[0], default_span[1])
+            self.pop_scope()
+        self._blocks.pop()
+        end_pc = self.here()
+        self._patch(block["breaks"], end_pc)
+        self.pop_scope()  # the clause scope
+        return bhi + 1
+
+    # -- var --------------------------------------------------------------
+
+    def _stmt_var(self, i: int, hi: int) -> int:
+        toks = self.toks
+        end = self.aux._stmt_end(i + 1, hi)
+        j = i + 1
+        names = []
+        while j < end and toks[j].kind == IDENT:
+            names.append(toks[j].value)
+            if (
+                j + 1 < end
+                and toks[j + 1].kind == OP
+                and toks[j + 1].value == ","
+            ):
+                j += 2
+            else:
+                j += 1
+                break
+        eq = None
+        depth = 0
+        for k in range(j, end):
+            t = toks[k]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif t.value == "=" and depth == 0:
+                    eq = k
+                    break
+        if eq is not None:
+            vregs = [
+                self.expr_root(slo, shi)
+                for slo, shi in I._split_commas(toks, eq + 1, end)
+            ]
+            self.emit(OP_VARDEF_V, self.const(tuple(names)),
+                      self.const(tuple(vregs)), len(names))
+            return end
+        type_span = toks[j:end]
+        self.emit(OP_VARZERO, self.const(tuple(names)),
+                  self.const(type_span))
+        return end
+
+    # -- simple statements ------------------------------------------------
+
+    def _simple_stmt(self, i: int, hi: int) -> int:
+        toks = self.toks
+        end = self.aux._stmt_end(i, hi)
+        depth = 0
+        op_at = None
+        op_val = None
+        for j in range(i, end):
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif depth == 0 and t.value in (
+                    ":=", "=", "+=", "-=", "*=", "/=", "|=", "&=", "%=",
+                ):
+                    op_at = j
+                    op_val = t.value
+                    break
+        if op_at is None:
+            if (
+                end - 2 >= i
+                and toks[end - 1].kind == OP
+                and toks[end - 1].value in ("++", "--")
+            ):
+                delta = 1 if toks[end - 1].value == "++" else -1
+                if end - 1 - i == 1 and toks[i].kind == IDENT:
+                    self.emit(OP_INC_NAME,
+                              self.const(("name", toks[i].value)), delta)
+                    return end
+                rtarget = self._compile_target(i, end - 1)
+                self.emit(OP_INCDEC, rtarget, delta)
+                return end
+            self.expr_root(i, end)  # expression statement, result dropped
+            return end
+        rhs_spans = I._split_commas(toks, op_at + 1, end)
+        vregs = [self.expr_root(slo, shi) for slo, shi in rhs_spans]
+        target_spans = I._split_commas(toks, i, op_at)
+        n_targets = len(target_spans)
+        if n_targets == 1 and len(vregs) == 1:
+            tlo, thi = target_spans[0]
+            if thi - tlo == 1 and toks[tlo].kind == IDENT:
+                # the dominant statement shape: one value into one bare
+                # name — skip the values/targets list machinery (the
+                # ops apply _expand's one-target _AssertResult unwrap)
+                if op_val == ":=":
+                    self.emit(OP_DEFINE_FAST,
+                              self.const(toks[tlo].value), vregs[0])
+                elif op_val == "=":
+                    self.emit(OP_ASSIGN_FAST,
+                              self.const(("name", toks[tlo].value)),
+                              vregs[0])
+                else:
+                    self.emit(OP_AUG_NAME,
+                              self.const(("name", toks[tlo].value)),
+                              vregs[0], self.const(op_val[:-1]))
+                return end
+        all_names = all(
+            thi - tlo == 1 and toks[tlo].kind == IDENT
+            for tlo, thi in target_spans
+        )
+        comma = (
+            self._comma_ok_spans(op_at + 1, end)
+            if n_targets == 2 and len(vregs) == 1 else None
+        )
+        if all_names and comma is None and op_val in (":=", "="):
+            # side-effect-free targets, no comma-ok: one fused
+            # build+expand+assign op
+            if op_val == ":=":
+                self.emit(
+                    OP_DEFINE_NAMES_V,
+                    self.const(tuple(
+                        toks[tlo].value for tlo, _thi in target_spans
+                    )),
+                    self.const(tuple(vregs)), n_targets,
+                )
+            else:
+                self.emit(
+                    OP_WRITE_NAMES_V,
+                    self.const(tuple(
+                        ("name", toks[tlo].value)
+                        for tlo, _thi in target_spans
+                    )),
+                    self.const(tuple(vregs)), n_targets,
+                )
+            return end
+        rlist = self.alloc()
+        self.emit(OP_VALUES, rlist, self.const(tuple(vregs)))
+        if comma is not None:
+            jit = self.emit(OP_JIT, vregs[0], None)
+            rc = self.expr_root(comma[0], comma[1])
+            rk = self.expr_root(comma[2], comma[3])
+            self.emit(OP_COMMAOK, rlist, rc, rk)
+            self._resolve(jit)
+        self.emit(OP_EXPAND, rlist, n_targets)
+        if all_names and op_val == ":=":
+            # side-effect-free targets: no target-build ops needed
+            self.emit(OP_DEFINE_NAMES, self.const(tuple(
+                toks[tlo].value for tlo, _thi in target_spans
+            )), rlist)
+            return end
+        if all_names and op_val == "=":
+            self.emit(OP_WRITE_NAMES, self.const(tuple(
+                ("name", toks[tlo].value) for tlo, _thi in target_spans
+            )), rlist)
+            return end
+        tregs = [
+            self._compile_target(slo, shi) for slo, shi in target_spans
+        ]
+        if op_val == ":=":
+            self.emit(OP_DEFINE_N, rlist, self.const(tuple(tregs)))
+        elif op_val != "=":
+            self.emit(OP_AUG, tregs[0], rlist, self.const(op_val[:-1]))
+        else:
+            self.emit(OP_WRITE_N, rlist, self.const(tuple(tregs)))
+        return end
+
+    def _comma_ok_spans(self, lo: int, hi: int):
+        """Static mirror of compiler._compile_comma_ok's shape scan:
+        (container_lo, container_hi, key_lo, key_hi) for a trailing
+        top-level ``container[key]``, else None."""
+        toks = self.toks
+        j = lo
+        while j < hi:
+            t = toks[j]
+            if t.kind == OP and t.value in "([{":
+                g_end = I._skip_group_from(toks, j)
+                if t.value == "[" and g_end == hi and j > lo:
+                    return (lo, j, j + 1, g_end - 1)
+                j = g_end
+                continue
+            j += 1
+        return None
+
+    def _compile_target(self, lo: int, hi: int) -> int:
+        """Emit an assignment-target build; returns the register that
+        will hold the same ("name"|"sel"|"index"|"star", ...) tuple
+        walk's _parse_target produces, with identical evaluation
+        order."""
+        toks = self.toks
+        dst = self.alloc()
+        if hi - lo == 1 and toks[lo].kind == IDENT:
+            self.emit(OP_TGT_NAME, dst,
+                      self.const(("name", toks[lo].value)))
+            return dst
+        if toks[lo].kind == OP and toks[lo].value == "*":
+            robj = self.expr_root(lo + 1, hi)
+            self.emit(OP_TGT_STAR, dst, robj)
+            return dst
+        depth = 0
+        last_dot = None
+        last_idx = None
+        j = lo
+        while j < hi:
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([":
+                    if t.value == "[" and depth == 0:
+                        last_idx = j
+                        last_dot = None
+                    depth += 1
+                    j = I._skip_group_from(toks, j)
+                    depth -= 1
+                    continue
+                if t.value == "." and depth == 0:
+                    last_dot = j
+            j += 1
+        if last_dot is not None:
+            robj = self.expr_root(lo, last_dot)
+            self.emit(OP_TGT_SEL, dst, robj,
+                      self.const(toks[last_dot + 1].value))
+            return dst
+        if last_idx is not None:
+            robj = self.expr_root(lo, last_idx)
+            ilo, ihi = I._group_span(toks, last_idx)
+            rkey = self.expr_root(ilo, ihi)
+            self.emit(OP_TGT_INDEX, dst, robj, rkey)
+            return dst
+        raise Unsupported("assignment target")
+
+    # == expressions =====================================================
+
+    def expr_root(self, lo: int, hi: int) -> int:
+        """Rooted expression over toks[lo:hi]: parses the longest valid
+        prefix and ignores trailing tokens, like each walk
+        ``_eval_range`` call.  The root is also the _StopExpr unwind
+        boundary: COMPOSITE stops emitted inside jump here with their
+        pending-binop spine folded."""
+        saved_root = self._root_lo
+        saved_spine = self._spine
+        saved_stops = self._stops
+        self._root_lo = lo
+        self._spine = []
+        self._stops = []
+        try:
+            reg, _pos = self.expression(lo, hi, 1)
+        finally:
+            stops = self._stops
+            self._root_lo = saved_root
+            self._spine = saved_spine
+            self._stops = saved_stops
+        end_pc = self.here()
+        if stops:
+            for idx in stops:
+                self.code[idx][5] = reg
+                self.code[idx][6] = end_pc
+            self._barrier = end_pc  # the stop landing pad is now fixed
+        self._root_had_stops = bool(stops)
+        return reg
+
+    def emit_jif(self, rcond, push: bool = False) -> int:
+        """A conditional branch on the root just compiled into *rcond*,
+        fusing an immediately-preceding comparison (BINOP → BINJIF) and
+        folding its trailing bare LOOKUP/CONST operands in place — the
+        whole ``if err != nil`` / ``i < n`` shape becomes one
+        dispatch.  With ``push``, the fall-through path also enters a
+        fresh scope (the _P twins); the caller tracks the depth."""
+        if self._fusable():
+            last = self.code[-1]
+            if last[0] == OP_BINOP and last[1] == rcond:
+                op_ci, ra, rb = last[2], last[3], last[4]
+                self.code.pop()
+                slots = [[0, ra], [0, rb]]
+                for slot in (slots[1], slots[0]):  # tail-first
+                    prev = self.code[-1] if self._fusable() else None
+                    if prev is None or prev[1] != slot[1]:
+                        break
+                    if prev[0] == OP_LOOKUP:
+                        slot[0], slot[1] = 1, prev[2]
+                        self.code.pop()
+                    elif prev[0] == OP_CONST:
+                        slot[0], slot[1] = 2, prev[2]
+                        self.code.pop()
+                    else:
+                        break
+                if slots[0][0] or slots[1][0]:
+                    return self.emit(
+                        OP_BINJIF_S_P if push else OP_BINJIF_S,
+                        op_ci, slots[0][0], slots[0][1],
+                        slots[1][0], slots[1][1], None,
+                    )
+                return self.emit(OP_BINJIF_P if push else OP_BINJIF,
+                                 op_ci, ra, rb, None)
+            if last[0] == OP_LOOKUP and last[1] == rcond:
+                self.code.pop()
+                return self.emit(OP_JIF_NAME_P if push else OP_JIF_NAME,
+                                 last[2], None)
+        return self.emit(OP_JIF_P if push else OP_JIF, rcond, None)
+
+    def expression(self, lo: int, hi: int, min_prec: int):
+        toks = self.toks
+        reg, pos = self.unary(lo, hi)
+        while pos < hi:
+            t = toks[pos]
+            if t.kind != OP or t.value not in I._BIN_PRECEDENCE:
+                break
+            prec = I._BIN_PRECEDENCE[t.value]
+            if prec < min_prec:
+                break
+            op = t.value
+            if op == "&&" or op == "||":
+                dst = self.alloc()
+                short = self.emit(
+                    OP_AND_SHORT if op == "&&" else OP_OR_SHORT,
+                    reg, dst, None,
+                )
+                # a composite stop inside the rhs folds through this
+                # node as a truthy coercion (walk's run_and/run_or
+                # apply `left and/or _truthy(stop.value)` with left
+                # already decided)
+                self._spine.append(("t",))
+                rrhs, pos = self.expression(pos + 1, hi, prec + 1)
+                self._spine.pop()
+                self.emit(OP_TRUTHY, dst, rrhs)
+                self._resolve(short)
+                reg = dst
+            else:
+                self._spine.append(("b", op, reg))
+                rrhs, pos = self.expression(pos + 1, hi, prec + 1)
+                self._spine.pop()
+                dst = self.alloc()
+                self.emit(OP_BINOP, dst, self.const(op), reg, rrhs)
+                reg = dst
+        return reg, pos
+
+    def unary(self, lo: int, hi: int):
+        toks = self.toks
+        t = toks[lo]
+        if t.kind == OP:
+            if t.value == "!":
+                rsub, pos = self.unary(lo + 1, hi)
+                dst = self.alloc()
+                self.emit(OP_NOT, dst, rsub)
+                return dst, pos
+            if t.value == "-":
+                rsub, pos = self.unary(lo + 1, hi)
+                dst = self.alloc()
+                self.emit(OP_NEG, dst, rsub)
+                return dst, pos
+            if t.value == "&":
+                # the scalar-ref shape (&x on a bare ident) is a static
+                # property; whether x currently holds a scalar is not
+                if (
+                    lo + 1 < hi
+                    and toks[lo + 1].kind == IDENT
+                    and not (
+                        lo + 2 < hi
+                        and toks[lo + 2].kind == OP
+                        and toks[lo + 2].value in ".[{("
+                    )
+                ):
+                    name = toks[lo + 1].value
+                    dst = self.alloc()
+                    addr = self.emit(OP_ADDR, dst, self.const(name), None)
+                    rsub, pos = self.unary(lo + 1, hi)
+                    self.emit(OP_MOV, dst, rsub)
+                    self._resolve(addr)
+                    return dst, pos
+                return self.unary(lo + 1, hi)  # pointers transparent
+            if t.value == "*":
+                rsub, pos = self.unary(lo + 1, hi)
+                dst = self.alloc()
+                self.emit(OP_DEREF, dst, rsub)
+                return dst, pos
+        return self.postfix(lo, hi)
+
+    def postfix(self, lo: int, hi: int):
+        toks = self.toks
+        reg, pos = self.operand(lo, hi)
+        while pos < hi:
+            t = toks[pos]
+            if t.kind == OP and t.value == ".":
+                if pos + 1 >= hi:
+                    # a trailing `.` crashes the walk evaluator at this
+                    # point; deopt so the lower tiers crash identically
+                    raise Unsupported("dangling selector")
+                nxt = toks[pos + 1]
+                if nxt.kind == OP and nxt.value == "(":
+                    glo = pos + 2
+                    ghi = _bounded_group_end(toks, pos + 1, hi) - 1
+                    type_text = "".join(
+                        tok.value for tok in toks[glo:ghi]
+                    )
+                    dst = self.alloc()
+                    self.emit(OP_ASSERT, dst, reg, self.const(type_text))
+                    reg = dst
+                    pos = ghi + 1
+                    continue
+                dst = self.alloc()
+                last = self.code[-1] if self._fusable() else None
+                if (
+                    last is not None
+                    and last[0] == OP_LOOKUP
+                    and last[1] == reg
+                ):
+                    # fused pkg.Name — adjacent, so order is unchanged
+                    self.code.pop()
+                    self.emit(OP_LOOKSEL, dst, last[2],
+                              self.const(nxt.value))
+                else:
+                    self.emit(OP_SEL, dst, reg, self.const(nxt.value))
+                reg = dst
+                pos += 2
+                continue
+            if t.kind == OP and t.value == "(":
+                end = _bounded_group_end(toks, pos, hi)
+                parts = self._call_parts(pos + 1, end - 1)
+                callee_text = "".join(
+                    tok.value
+                    for tok in toks[max(self._root_lo, pos - 3):pos]
+                )
+                ctx = self.const((callee_text, t.line, t.col))
+                dst = self.alloc()
+                # callee fusion: when the callee-producing instruction
+                # is still adjacent (every arg folded, or none emitted
+                # code), fold it into the call — resolution order
+                # (callee, then args) is exactly the closure tier's
+                last = self.code[-1] if self._fusable() else None
+                if last is not None and last[1] == reg and (
+                    last[0] == OP_SEL
+                    or last[0] == OP_LOOKSEL
+                    or last[0] == OP_LOOKUP
+                ):
+                    self.code.pop()
+                    if last[0] == OP_SEL:
+                        self.emit(OP_CALLSEL, dst, last[2], last[3],
+                                  self.const(parts), ctx)
+                    elif last[0] == OP_LOOKSEL:
+                        self.emit(OP_CALLNS, dst, last[2], last[3],
+                                  self.const(parts), ctx)
+                    else:
+                        self.emit(OP_CALLN, dst, last[2],
+                                  self.const(parts), ctx)
+                else:
+                    self.emit(OP_CALL, dst, reg, self.const(parts), ctx)
+                reg = dst
+                pos = end
+                continue
+            if t.kind == OP and t.value == "[":
+                end = _bounded_group_end(toks, pos, hi)
+                rkey = self.expr_root(pos + 1, end - 1)
+                dst = self.alloc()
+                self.emit(OP_INDEX, dst, reg, rkey)
+                reg = dst
+                pos = end
+                continue
+            if t.kind == OP and t.value == "{":
+                end = _bounded_group_end(toks, pos, hi)
+                spec = self._composite_spec(pos + 1, end - 1)
+                dst = self.alloc()
+                spine = self.const(tuple(reversed(self._spine)))
+                idx = self.emit(
+                    OP_COMPOSITE, dst, reg, self.const(spec), spine,
+                    None, None,
+                )
+                self._stops.append(idx)
+                reg = dst
+                pos = end
+                continue
+            break
+        return reg, pos
+
+    def _call_parts(self, lo: int, hi: int) -> tuple:
+        """Compile call arguments and return the parts spec, folding a
+        trailing run of bare LOOKUP/CONST args into "n"/"c" entries
+        (the folded instructions were the ones immediately before the
+        consuming op, so every side effect — including a missing-name
+        error — keeps its position)."""
+        toks = self.toks
+        parts = []
+        for slo, shi in I._split_commas(toks, lo, hi):
+            spread = (
+                toks[shi - 1].kind == OP and toks[shi - 1].value == "..."
+            )
+            end = shi - 1 if spread else shi
+            parts.append(["r", self.expr_root(slo, end), spread])
+        for part in reversed(parts):
+            last = self.code[-1] if self._fusable() else None
+            if last is None or last[1] != part[1]:
+                break
+            if last[0] == OP_LOOKUP:
+                part[0], part[1] = "n", self.consts[last[2]]
+                self.code.pop()
+            elif last[0] == OP_CONST:
+                part[0], part[1] = "c", last[2]
+                self.code.pop()
+            else:
+                break
+        return tuple(tuple(p) for p in parts)
+
+    def _call_args(self, lo: int, hi: int) -> int:
+        """Args built into a register (the defer/go form, which needs
+        the evaluated list at statement time)."""
+        parts = self._call_parts(lo, hi)
+        dst = self.alloc()
+        self.emit(OP_CALLARGS, dst, self.const(parts))
+        return dst
+
+    # -- operands ---------------------------------------------------------
+
+    def operand(self, lo: int, hi: int):
+        toks = self.toks
+        if lo >= hi:
+            raise Unsupported("empty operand")
+        t = toks[lo]
+        if t.kind == STRING:
+            return self._literal(0, I._unquote, t.value), lo + 1
+        if t.kind == INT:
+            return self._literal(1, lambda raw: int(raw, 0), t.value), \
+                lo + 1
+        if t.kind == FLOAT:
+            return self._literal(2, float, t.value), lo + 1
+        if t.kind in (RUNE, IMAG):
+            dst = self.alloc()
+            self.emit(OP_CONST, dst, self.const(t.value))
+            return dst, lo + 1
+        if t.kind == IDENT:
+            return self._operand_ident(lo, hi)
+        if t.kind == OP:
+            if t.value == "(":
+                end = _bounded_group_end(toks, lo, hi)
+                reg = self.expr_root(lo + 1, end - 1)
+                return reg, end
+            if t.value == "[":
+                return self._operand_slice_type(lo, hi)
+        if t.kind == KEYWORD:
+            if t.value == "map":
+                j = _bounded_group_end(toks, lo + 1, hi)  # [K]
+                j = self.aux._type_end(j, hi)  # V
+                if not (
+                    j < hi and toks[j].kind == OP and toks[j].value == "{"
+                ):
+                    raise Unsupported("map literal")
+                end = _bounded_group_end(toks, j, hi)
+                spec = self._composite_spec(j + 1, end - 1)
+                dst = self.alloc()
+                if spec and all(
+                    entry[0] == "kv"
+                    and entry[2][0] == "c" and entry[3][0] == "c"
+                    for entry in spec
+                ):
+                    # every key and value is a literal: pre-build the
+                    # dict once and copy it per execution (insertion
+                    # and duplicate-key order match the spec walk)
+                    template = {
+                        entry[2][1]: entry[3][1] for entry in spec
+                    }
+                    self.emit(OP_MAPLIT_C, dst, self.const(template))
+                else:
+                    self.emit(OP_MAPLIT, dst, self.const(spec))
+                return dst, end
+            if t.value == "func":
+                return self._operand_func_literal(lo, hi)
+        raise Unsupported(f"operand {t.value!r}")
+
+    def _literal(self, conv: int, fn, raw: str) -> int:
+        """Decode a literal at compile time; a malformed literal defers
+        the conversion (and its error) to execution time, exactly where
+        walk raises it."""
+        dst = self.alloc()
+        try:
+            value = fn(raw)
+        except Exception:
+            self.emit(OP_CONSTDEFER, dst, conv, self.const(raw))
+            return dst
+        self.emit(OP_CONST, dst, self.const(value))
+        return dst
+
+    def _operand_ident(self, lo: int, hi: int):
+        toks = self.toks
+        name = toks[lo].value
+        has_call = (
+            lo + 1 < hi
+            and toks[lo + 1].kind == OP
+            and toks[lo + 1].value == "("
+        )
+        if has_call and name in (
+            "len", "cap", "append", "panic", "string", "new", "make",
+        ) or (has_call and name in I._NUMERIC_CONVERSIONS):
+            end = _bounded_group_end(toks, lo + 1, hi)
+            glo, ghi = lo + 2, end - 1
+            dst = self.alloc()
+            if name in ("len", "cap"):
+                rarg = self.expr_root(glo, ghi)
+                self.emit(OP_LEN, dst, rarg)
+                return dst, end
+            if name == "append":
+                parts = self._call_parts(glo, ghi)
+                self.emit(OP_APPEND, dst, self.const(parts))
+                return dst, end
+            if name == "panic":
+                rarg = self.expr_root(glo, ghi)
+                self.emit(OP_PANIC, rarg)
+                return dst, end
+            if name in I._NUMERIC_CONVERSIONS:
+                rarg = self.expr_root(glo, ghi)
+                self.emit(OP_CONV, dst, rarg, self.const(name))
+                return dst, end
+            if name == "string":
+                rarg = self.expr_root(glo, ghi)
+                self.emit(OP_STR, dst, rarg)
+                return dst, end
+            if name == "new":
+                self.emit(OP_NEW, dst, self.const(toks[glo].value))
+                return dst, end
+            # make
+            is_map = (
+                glo < ghi
+                and toks[glo].kind == KEYWORD
+                and toks[glo].value == "map"
+            )
+            self.emit(OP_MAKEMAP if is_map else OP_MAKESLICE, dst)
+            return dst, end
+        dst = self.alloc()
+        self.emit(OP_LOOKUP, dst, self.const(name))
+        return dst, lo + 1
+
+    def _operand_slice_type(self, lo: int, hi: int):
+        toks = self.toks
+        close = _bounded_group_end(toks, lo, hi) - 1
+        j = close + 1
+        k = self.aux._type_end(j, hi)
+        if k < hi and toks[k].kind == OP and toks[k].value == "{":
+            end = _bounded_group_end(toks, k, hi)
+            elem_span = toks[j:k]
+            spec = self._composite_spec(k + 1, end - 1)
+            dst = self.alloc()
+            self.emit(OP_SLICELIT, dst, self.const(elem_span),
+                      self.const(spec))
+            return dst, end
+        if k < hi and toks[k].kind == OP and toks[k].value == "(":
+            end = _bounded_group_end(toks, k, hi)
+            rarg = self.expr_root(k + 1, end - 1)
+            type_text = "".join(tok.value for tok in toks[j:k])
+            if type_text == "byte":
+                dst = self.alloc()
+                self.emit(OP_BYTES, dst, rarg)
+                return dst, end
+            return rarg, end  # other slice conversions pass through
+        raise Unsupported("slice type")
+
+    def _operand_func_literal(self, lo: int, hi: int):
+        toks = self.toks
+        j = lo + 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "("):
+            raise Unsupported("func literal")
+        pend = _bounded_group_end(toks, j, hi)
+        params = self.aux._param_items(j + 1, pend - 1)
+        j = pend
+        while j < hi:
+            t = toks[j]
+            if t.kind == KEYWORD and t.value in ("struct", "interface"):
+                j += 1
+                if j < hi and toks[j].value == "{":
+                    j = _bounded_group_end(toks, j, hi)
+                continue
+            if t.kind == OP and t.value == "{":
+                break
+            if t.kind == OP and t.value in "([":
+                j = _bounded_group_end(toks, j, hi)
+                continue
+            j += 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "{"):
+            raise Unsupported("func literal body")
+        end = _bounded_group_end(toks, j, hi)
+        blo, bhi = j + 1, end - 1
+        body_prog = self._sub_program(blo, bhi)
+        fn_record = {
+            "name": "<literal>", "recv": None,
+            "params": params,
+            "body": (blo, bhi), "generic": False, "arity": None,
+        }
+        dst = self.alloc()
+        self.emit(OP_CLOSURE, dst, self.const(fn_record),
+                  self.const(body_prog))
+        return dst, end
+
+    # -- composite literals ----------------------------------------------
+
+    def _composite_spec(self, lo: int, hi: int) -> tuple:
+        """Compile a composite-literal body into a serializable spec
+        mirroring compiler._composite_body (both key interpretations
+        are compiled, because which one applies depends on the runtime
+        type); expression slots become sub-Programs."""
+        toks = self.toks
+        elements = []
+        for slo, shi in I._split_commas(toks, lo, hi):
+            colon = None
+            depth = 0
+            for j in range(slo, shi):
+                t = toks[j]
+                if t.kind == OP:
+                    if t.value in "([{":
+                        depth += 1
+                    elif t.value in ")]}":
+                        depth -= 1
+                    elif t.value == ":" and depth == 0:
+                        colon = j
+                        break
+            if (
+                colon is not None
+                and toks[slo].kind == IDENT
+                and colon == slo + 1
+            ):
+                # `Name: value` — a field key for struct literals, an
+                # expression key for map literals; compile both reads
+                elements.append((
+                    "dualkey", toks[slo].value,
+                    self._sub_expr(slo, colon),
+                    self._sub_expr(colon + 1, shi),
+                ))
+            elif colon is not None:
+                elements.append((
+                    "kv", None,
+                    self._sub_expr(slo, colon),
+                    self._sub_expr(colon + 1, shi),
+                ))
+            elif toks[slo].kind == OP and toks[slo].value == "{":
+                g_end = _bounded_group_end(toks, slo, shi)
+                elements.append((
+                    "elided", None,
+                    self._composite_spec(slo + 1, g_end - 1), None,
+                ))
+            else:
+                elements.append(
+                    ("elem", None, self._sub_expr(slo, shi), None)
+                )
+        return tuple(elements)
+
+
+# -- the threaded-code backend --------------------------------------------
+#
+# The pickled Program is the canonical artifact; per process, the first
+# execution "threads" it — every instruction becomes one specialized
+# Python closure with its operands (register indices, names, constant
+# values, jump targets) pre-resolved at closure-creation time, and the
+# run loop is just `pc = steps[pc](ev, regs, frame)`.  A direct
+# closure call replaces the dispatch ladder's compare chain and the
+# per-operand consts[]/ins[] indexing, which is what lets the bytecode
+# tier match (rather than trail) the closure tier's call performance
+# while keeping the flat, serializable encoding.
+#
+# `frame` is a two-slot list: frame[0] the current Env, frame[1] the
+# scope stack.  Steps return the next pc; OP_END returns -1.  The
+# ladder (:func:`_execute_ladder`) stays as the reference backend —
+# tests pin both to identical behavior over the corpus.
+
+_FACTORIES = {}
+
+
+def _op_factory(opcode):
+    def register(fn):
+        _FACTORIES[opcode] = fn
+        return fn
+    return register
+
+
+def _resolve_sel(ev, value, name):
+    """The selector semantics shared by SEL/LOOKSEL/CALLSEL/CALLNS."""
+    if isinstance(value, _GoStruct) and name not in value.fields:
+        interp = ev.interp
+        key = (value.tname, name)
+        entry = interp.own_methods.get(key) or interp.methods.get(key)
+        if entry is not None:
+            fn, scan = entry
+            return _Closure(fn, scan, _Env(), recv_value=value)
+        promoted = ev._promoted(value, name)
+        if promoted is not None:
+            return promoted
+    return _get_attr(value, name)
+
+
+def _build_args(parts, ev, regs, env):
+    """The call-argument builder shared by every call-shaped step."""
+    args = []
+    for kind, payload, spread in parts:
+        if kind == "r":
+            value = regs[payload]
+        elif kind == "n":
+            value = ev.lookup(payload, env)
+        else:
+            value = payload
+        if spread:
+            args.extend(value or [])
+        else:
+            args.append(value)
+    if len(args) == 1 and isinstance(args[0], tuple):
+        args = list(args[0])
+    return args
+
+
+def _bind_parts(parts, consts):
+    """Pre-resolve "c" const slots to their values (the runtime never
+    touches the pool again)."""
+    return tuple(
+        (kind, consts[payload] if kind == "c" else payload, spread)
+        for kind, payload, spread in parts
+    )
+
+
+@_op_factory(OP_LOOKUP)
+def _f_lookup(ins, consts, pc):
+    dst, name, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = ev.lookup(name, frame[0])
+        return nxt
+    return step
+
+
+@_op_factory(OP_LOOKSEL)
+def _f_looksel(ins, consts, pc):
+    dst, name, sel, nxt = ins[1], consts[ins[2]], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _resolve_sel(ev, ev.lookup(name, frame[0]), sel)
+        return nxt
+    return step
+
+
+@_op_factory(OP_SEL)
+def _f_sel(ins, consts, pc):
+    dst, ra, sel, nxt = ins[1], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _resolve_sel(ev, regs[ra], sel)
+        return nxt
+    return step
+
+
+@_op_factory(OP_CONST)
+def _f_const(ins, consts, pc):
+    dst, value, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = value
+        return nxt
+    return step
+
+
+@_op_factory(OP_CONSTDEFER)
+def _f_constdefer(ins, consts, pc):
+    dst, conv, raw, nxt = ins[1], _DEFER_CONVS[ins[2]], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = conv(raw)  # the deferred malformed-literal error
+        return nxt
+    return step
+
+
+@_op_factory(OP_PUSH)
+def _f_push(ins, consts, pc):
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        frame[1].append(frame[0])
+        frame[0] = _Env(frame[0])
+        return nxt
+    return step
+
+
+@_op_factory(OP_POP)
+def _f_pop(ins, consts, pc):
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        frame[0] = frame[1].pop()
+        return nxt
+    return step
+
+
+@_op_factory(OP_POPN)
+def _f_popn(ins, consts, pc):
+    n, nxt = ins[1], pc + 1
+
+    def step(ev, regs, frame):
+        scopes = frame[1]
+        frame[0] = scopes[-n]
+        del scopes[-n:]
+        return nxt
+    return step
+
+
+@_op_factory(OP_POPJUMP)
+def _f_popjump(ins, consts, pc):
+    n, target = ins[1], ins[2]
+
+    def step(ev, regs, frame):
+        scopes = frame[1]
+        frame[0] = scopes[-n]
+        del scopes[-n:]
+        return target
+    return step
+
+
+@_op_factory(OP_JUMP)
+def _f_jump(ins, consts, pc):
+    target = ins[1]
+
+    def step(ev, regs, frame):
+        return target
+    return step
+
+
+@_op_factory(OP_END)
+def _f_end(ins, consts, pc):
+    def step(ev, regs, frame):
+        return -1
+    return step
+
+
+@_op_factory(OP_MOV)
+def _f_mov(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = regs[ra]
+        return nxt
+    return step
+
+
+@_op_factory(OP_TRUTHY)
+def _f_truthy(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _truthy(regs[ra])
+        return nxt
+    return step
+
+
+@_op_factory(OP_NOT)
+def _f_not(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = not _truthy(regs[ra])
+        return nxt
+    return step
+
+
+@_op_factory(OP_NEG)
+def _f_neg(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = -regs[ra]
+        return nxt
+    return step
+
+
+@_op_factory(OP_DEREF)
+def _f_deref(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        if isinstance(value, _VarRef):
+            value = value.get()
+        regs[dst] = value
+        return nxt
+    return step
+
+
+@_op_factory(OP_ADDR)
+def _f_addr(ins, consts, pc):
+    dst, name, target, nxt = ins[1], consts[ins[2]], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        if env.has(name) and isinstance(
+            env.get(name), (str, int, float, bool)
+        ):
+            regs[dst] = _VarRef(env, name)
+            return target
+        return nxt
+    return step
+
+
+@_op_factory(OP_BINOP)
+def _f_binop(ins, consts, pc):
+    dst, opname, ra, rb, nxt = ins[1], consts[ins[2]], ins[3], ins[4], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _apply_binop(opname, regs[ra], regs[rb])
+        return nxt
+    return step
+
+
+@_op_factory(OP_JIF)
+def _f_jif(ins, consts, pc):
+    ra, target, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        return nxt if _truthy(regs[ra]) else target
+    return step
+
+
+@_op_factory(OP_JIF_P)
+def _f_jif_p(ins, consts, pc):
+    ra, target, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        if _truthy(regs[ra]):
+            frame[1].append(frame[0])
+            frame[0] = _Env(frame[0])
+            return nxt
+        return target
+    return step
+
+
+@_op_factory(OP_JIF_NAME)
+def _f_jif_name(ins, consts, pc):
+    name, target, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        return nxt if _truthy(ev.lookup(name, frame[0])) else target
+    return step
+
+
+@_op_factory(OP_JIF_NAME_P)
+def _f_jif_name_p(ins, consts, pc):
+    name, target, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        if _truthy(ev.lookup(name, frame[0])):
+            frame[1].append(frame[0])
+            frame[0] = _Env(frame[0])
+            return nxt
+        return target
+    return step
+
+
+@_op_factory(OP_BINJIF)
+def _f_binjif(ins, consts, pc):
+    opname, ra, rb, target, nxt = (
+        consts[ins[1]], ins[2], ins[3], ins[4], pc + 1
+    )
+
+    def step(ev, regs, frame):
+        if _truthy(_apply_binop(opname, regs[ra], regs[rb])):
+            return nxt
+        return target
+    return step
+
+
+@_op_factory(OP_BINJIF_P)
+def _f_binjif_p(ins, consts, pc):
+    opname, ra, rb, target, nxt = (
+        consts[ins[1]], ins[2], ins[3], ins[4], pc + 1
+    )
+
+    def step(ev, regs, frame):
+        if _truthy(_apply_binop(opname, regs[ra], regs[rb])):
+            frame[1].append(frame[0])
+            frame[0] = _Env(frame[0])
+            return nxt
+        return target
+    return step
+
+
+def _slot_reader(kind, payload, consts):
+    """A tiny reader for BINJIF_S operand slots, pre-bound."""
+    if kind == 0:
+        def read(ev, regs, env, _r=payload):
+            return regs[_r]
+    elif kind == 1:
+        def read(ev, regs, env, _n=consts[payload]):
+            return ev.lookup(_n, env)
+    else:
+        value = consts[payload]
+
+        def read(ev, regs, env, _v=value):
+            return _v
+    return read
+
+
+@_op_factory(OP_BINJIF_S)
+def _f_binjif_s(ins, consts, pc):
+    opname = consts[ins[1]]
+    read_a = _slot_reader(ins[2], ins[3], consts)
+    read_b = _slot_reader(ins[4], ins[5], consts)
+    target, nxt = ins[6], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        if _truthy(_apply_binop(
+            opname, read_a(ev, regs, env), read_b(ev, regs, env)
+        )):
+            return nxt
+        return target
+    return step
+
+
+@_op_factory(OP_BINJIF_S_P)
+def _f_binjif_s_p(ins, consts, pc):
+    opname = consts[ins[1]]
+    read_a = _slot_reader(ins[2], ins[3], consts)
+    read_b = _slot_reader(ins[4], ins[5], consts)
+    target, nxt = ins[6], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        if _truthy(_apply_binop(
+            opname, read_a(ev, regs, env), read_b(ev, regs, env)
+        )):
+            frame[1].append(env)
+            frame[0] = _Env(env)
+            return nxt
+        return target
+    return step
+
+
+@_op_factory(OP_AND_SHORT)
+def _f_and_short(ins, consts, pc):
+    ra, dst, target, nxt = ins[1], ins[2], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        if _truthy(regs[ra]):
+            return nxt
+        regs[dst] = False
+        return target
+    return step
+
+
+@_op_factory(OP_OR_SHORT)
+def _f_or_short(ins, consts, pc):
+    ra, dst, target, nxt = ins[1], ins[2], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        if _truthy(regs[ra]):
+            regs[dst] = True
+            return target
+        return nxt
+    return step
+
+
+@_op_factory(OP_CALL)
+def _f_call(ins, consts, pc):
+    dst = ins[1]
+    rcallee = ins[2]
+    parts = _bind_parts(consts[ins[3]], consts)
+    ctx = consts[ins[4]]
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        callee = regs[rcallee]
+        args = _build_args(parts, ev, regs, frame[0])
+        if callee is None:
+            text, line, col = ctx
+            raise I.GoInterpError(
+                f"not callable: nil ({text!r} at {line}:{col})"
+            )
+        regs[dst] = ev._call_value(callee, args)
+        return nxt
+    return step
+
+
+@_op_factory(OP_RET_CALL)
+def _f_ret_call(ins, consts, pc):
+    rcallee = ins[2]
+    parts = _bind_parts(consts[ins[3]], consts)
+    ctx = consts[ins[4]]
+
+    def step(ev, regs, frame):
+        callee = regs[rcallee]
+        args = _build_args(parts, ev, regs, frame[0])
+        if callee is None:
+            text, line, col = ctx
+            raise I.GoInterpError(
+                f"not callable: nil ({text!r} at {line}:{col})"
+            )
+        raise _Return(ev._call_value(callee, args))
+    return step
+
+
+@_op_factory(OP_CALLSEL)
+def _f_callsel(ins, consts, pc):
+    dst, robj, sel = ins[1], ins[2], consts[ins[3]]
+    parts = _bind_parts(consts[ins[4]], consts)
+    ctx = consts[ins[5]]
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        callee = _resolve_sel(ev, regs[robj], sel)
+        args = _build_args(parts, ev, regs, frame[0])
+        if callee is None:
+            text, line, col = ctx
+            raise I.GoInterpError(
+                f"not callable: nil ({text!r} at {line}:{col})"
+            )
+        regs[dst] = ev._call_value(callee, args)
+        return nxt
+    return step
+
+
+@_op_factory(OP_CALLNS)
+def _f_callns(ins, consts, pc):
+    dst, name, sel = ins[1], consts[ins[2]], consts[ins[3]]
+    parts = _bind_parts(consts[ins[4]], consts)
+    ctx = consts[ins[5]]
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        callee = _resolve_sel(ev, ev.lookup(name, env), sel)
+        args = _build_args(parts, ev, regs, env)
+        if callee is None:
+            text, line, col = ctx
+            raise I.GoInterpError(
+                f"not callable: nil ({text!r} at {line}:{col})"
+            )
+        regs[dst] = ev._call_value(callee, args)
+        return nxt
+    return step
+
+
+@_op_factory(OP_CALLN)
+def _f_calln(ins, consts, pc):
+    dst, name = ins[1], consts[ins[2]]
+    parts = _bind_parts(consts[ins[3]], consts)
+    ctx = consts[ins[4]]
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        callee = ev.lookup(name, env)
+        args = _build_args(parts, ev, regs, env)
+        if callee is None:
+            text, line, col = ctx
+            raise I.GoInterpError(
+                f"not callable: nil ({text!r} at {line}:{col})"
+            )
+        regs[dst] = ev._call_value(callee, args)
+        return nxt
+    return step
+
+
+@_op_factory(OP_CALLARGS)
+def _f_callargs(ins, consts, pc):
+    dst = ins[1]
+    parts = _bind_parts(consts[ins[2]], consts)
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _build_args(parts, ev, regs, frame[0])
+        return nxt
+    return step
+
+
+@_op_factory(OP_APPEND)
+def _f_append(ins, consts, pc):
+    dst = ins[1]
+    parts = _bind_parts(consts[ins[2]], consts)
+    nxt = pc + 1
+
+    def step(ev, regs, frame):
+        args = _build_args(parts, ev, regs, frame[0])
+        base = list(args[0]) if args[0] else []
+        base.extend(args[1:])
+        regs[dst] = base
+        return nxt
+    return step
+
+
+@_op_factory(OP_INDEX)
+def _f_index(ins, consts, pc):
+    dst, ra, rk, nxt = ins[1], ins[2], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _go_index(regs[ra], regs[rk])
+        return nxt
+    return step
+
+
+@_op_factory(OP_ASSERT)
+def _f_assert(ins, consts, pc):
+    dst, ra, text, nxt = ins[1], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        ok = _type_assert(value, text)
+        regs[dst] = _AssertResult((value if ok else None, ok))
+        return nxt
+    return step
+
+
+@_op_factory(OP_COMPOSITE)
+def _f_composite(ins, consts, pc):
+    dst, rbase = ins[1], ins[2]
+    spec, spine = consts[ins[3]], consts[ins[4]]
+    root_reg, root_pc, nxt = ins[5], ins[6], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[rbase]
+        if isinstance(value, (I.TypeRef, type)):
+            regs[dst] = _build_composite(ev, frame[0], value, spec)
+            return nxt
+        # walk's _StopExpr: fold the pending-binop spine and yield the
+        # carried value at the expression root
+        for entry in spine:
+            if entry[0] == "b":
+                value = _apply_binop(entry[1], regs[entry[2]], value)
+            else:
+                value = _truthy(value)
+        regs[root_reg] = value
+        return root_pc
+    return step
+
+
+@_op_factory(OP_MAPLIT)
+def _f_maplit(ins, consts, pc):
+    dst, spec, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _build_spec(spec, ev, frame[0], "map", True, None)
+        return nxt
+    return step
+
+
+@_op_factory(OP_MAPLIT_C)
+def _f_maplit_c(ins, consts, pc):
+    dst, template, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = dict(template)
+        return nxt
+    return step
+
+
+@_op_factory(OP_SLICELIT)
+def _f_slicelit(ins, consts, pc):
+    dst, span, spec, nxt = ins[1], consts[ins[2]], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        ev.env = env  # _resolve_type_value reads ev.env
+        elem_type = ev._resolve_type_value(span)
+        regs[dst] = _build_spec(spec, ev, env, "slice", False, elem_type)
+        return nxt
+    return step
+
+
+@_op_factory(OP_BYTES)
+def _f_bytes(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        regs[dst] = value.encode() if isinstance(value, str) else value
+        return nxt
+    return step
+
+
+@_op_factory(OP_LEN)
+def _f_len(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        regs[dst] = 0 if value is None else len(value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_PANIC)
+def _f_panic(ins, consts, pc):
+    ra = ins[1]
+
+    def step(ev, regs, frame):
+        raise I.GoPanic(regs[ra])
+    return step
+
+
+@_op_factory(OP_CONV)
+def _f_conv(ins, consts, pc):
+    dst, ra, name, nxt = ins[1], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        conv = I._NUMERIC_CONVERSIONS[name]
+        regs[dst] = conv(value) if value is not None else 0
+        return nxt
+    return step
+
+
+@_op_factory(OP_STR)
+def _f_str(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[ra]
+        if isinstance(value, (bytes, bytearray)):
+            regs[dst] = value.decode()
+        elif isinstance(value, int) and not isinstance(value, bool):
+            regs[dst] = chr(value)
+        else:
+            regs[dst] = "" if value is None else str(value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_NEW)
+def _f_new(ins, consts, pc):
+    dst, tname, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = _GoStruct(tname)
+        return nxt
+    return step
+
+
+@_op_factory(OP_MAKEMAP)
+def _f_makemap(ins, consts, pc):
+    dst, nxt = ins[1], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = {}
+        return nxt
+    return step
+
+
+@_op_factory(OP_MAKESLICE)
+def _f_makeslice(ins, consts, pc):
+    dst, nxt = ins[1], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = []
+        return nxt
+    return step
+
+
+@_op_factory(OP_CLOSURE)
+def _f_closure(ins, consts, pc):
+    dst, fnrec, prog, nxt = ins[1], consts[ins[2]], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        closure = _Closure(fnrec, ev.scan, frame[0])
+        # absolute spans: the runtime scan's tokens are
+        # content-identical to the compile-time ones
+        closure.toks = ev.scan.toks
+        closure.compiled = make_runner(prog)
+        regs[dst] = closure
+        return nxt
+    return step
+
+
+@_op_factory(OP_JIT)
+def _f_jit(ins, consts, pc):
+    ra, target, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        return target if isinstance(regs[ra], tuple) else nxt
+    return step
+
+
+@_op_factory(OP_COMMAOK)
+def _f_commaok(ins, consts, pc):
+    rlist, rc, rk, nxt = ins[1], ins[2], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        container = regs[rc]
+        key = regs[rk]
+        if container is None:
+            pair = ("", False)
+        elif isinstance(container, dict):
+            pair = (container.get(key, ""), key in container)
+        else:
+            pair = None
+        if pair is not None:
+            regs[rlist] = list(pair)
+        return nxt
+    return step
+
+
+@_op_factory(OP_VALUES)
+def _f_values(ins, consts, pc):
+    dst, vregs, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = [regs[r] for r in vregs]
+        return nxt
+    return step
+
+
+@_op_factory(OP_EXPAND)
+def _f_expand(ins, consts, pc):
+    rlist, n, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[rlist] = _expand(regs[rlist], n)
+        return nxt
+    return step
+
+
+@_op_factory(OP_DEFINE_N)
+def _f_define_n(ins, consts, pc):
+    rlist, tregs, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        values = regs[rlist]
+        targets = [regs[r] for r in tregs]
+        env = frame[0]
+        for target, value in zip(targets, values):
+            if target[0] != "name":
+                raise I.GoInterpError(":= target must be a name")
+            env.define(target[1], value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_WRITE_N)
+def _f_write_n(ins, consts, pc):
+    rlist, tregs, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        values = regs[rlist]
+        targets = [regs[r] for r in tregs]
+        env = frame[0]
+        for target, value in zip(targets, values):
+            ev._write_target(target, value, env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_DEFINE_NAMES)
+def _f_define_names(ins, consts, pc):
+    names, rlist, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        for name, value in zip(names, regs[rlist]):
+            env.define(name, value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_WRITE_NAMES)
+def _f_write_names(ins, consts, pc):
+    targets, rlist, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        for target, value in zip(targets, regs[rlist]):
+            ev._write_target(target, value, env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_DEFINE_NAMES_V)
+def _f_define_names_v(ins, consts, pc):
+    names, vregs, n, nxt = consts[ins[1]], consts[ins[2]], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        values = _expand([regs[r] for r in vregs], n)
+        env = frame[0]
+        for name, value in zip(names, values):
+            env.define(name, value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_WRITE_NAMES_V)
+def _f_write_names_v(ins, consts, pc):
+    targets, vregs, n, nxt = consts[ins[1]], consts[ins[2]], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        values = _expand([regs[r] for r in vregs], n)
+        env = frame[0]
+        for target, value in zip(targets, values):
+            ev._write_target(target, value, env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_VARDEF_V)
+def _f_vardef_v(ins, consts, pc):
+    names, vregs, n, nxt = consts[ins[1]], consts[ins[2]], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        values = _expand([regs[r] for r in vregs], n)
+        env = frame[0]
+        for name, value in zip(names, values):
+            env.define(name, value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_VARZERO)
+def _f_varzero(ins, consts, pc):
+    names, span, nxt = consts[ins[1]], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        ev.env = env  # _zero_value resolves type names through ev.env
+        zero = ev._zero_value(span)
+        for name in names:
+            env.define(name, zero() if callable(zero) else zero)
+        return nxt
+    return step
+
+
+@_op_factory(OP_DEFINE_FAST)
+def _f_define_fast(ins, consts, pc):
+    name, rv, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[rv]
+        if isinstance(value, _AssertResult):
+            value = value[0]  # _expand's one-target unwrap
+        frame[0].define(name, value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_ASSIGN_FAST)
+def _f_assign_fast(ins, consts, pc):
+    target, rv, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[rv]
+        if isinstance(value, _AssertResult):
+            value = value[0]
+        ev._write_target(target, value, frame[0])
+        return nxt
+    return step
+
+
+@_op_factory(OP_AUG)
+def _f_aug(ins, consts, pc):
+    rt, rlist, opname, nxt = ins[1], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        target = regs[rt]
+        values = regs[rlist]
+        env = frame[0]
+        old = ev._read_target(target, env)
+        ev._write_target(
+            target, _apply_binop(opname, old, values[0]), env
+        )
+        return nxt
+    return step
+
+
+@_op_factory(OP_AUG_NAME)
+def _f_aug_name(ins, consts, pc):
+    target, rv, opname, nxt = consts[ins[1]], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        value = regs[rv]
+        if isinstance(value, _AssertResult):
+            value = value[0]  # _expand's one-target unwrap
+        env = frame[0]
+        old = ev._read_target(target, env)
+        ev._write_target(target, _apply_binop(opname, old, value), env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_INC_NAME)
+def _f_inc_name(ins, consts, pc):
+    target, delta, nxt = consts[ins[1]], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        env = frame[0]
+        old = ev._read_target(target, env)
+        ev._write_target(target, old + delta, env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_INCDEC)
+def _f_incdec(ins, consts, pc):
+    rt, delta, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        target = regs[rt]
+        env = frame[0]
+        old = ev._read_target(target, env)
+        ev._write_target(target, old + delta, env)
+        return nxt
+    return step
+
+
+@_op_factory(OP_TGT_NAME)
+def _f_tgt_name(ins, consts, pc):
+    dst, target, nxt = ins[1], consts[ins[2]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = target
+        return nxt
+    return step
+
+
+@_op_factory(OP_TGT_SEL)
+def _f_tgt_sel(ins, consts, pc):
+    dst, robj, name, nxt = ins[1], ins[2], consts[ins[3]], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = ("sel", regs[robj], name)
+        return nxt
+    return step
+
+
+@_op_factory(OP_TGT_INDEX)
+def _f_tgt_index(ins, consts, pc):
+    dst, robj, rkey, nxt = ins[1], ins[2], ins[3], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = ("index", regs[robj], regs[rkey])
+        return nxt
+    return step
+
+
+@_op_factory(OP_TGT_STAR)
+def _f_tgt_star(ins, consts, pc):
+    dst, robj, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        regs[dst] = ("star", regs[robj])
+        return nxt
+    return step
+
+
+@_op_factory(OP_RANGEPREP)
+def _f_rangeprep(ins, consts, pc):
+    dst, ra, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        iterable = regs[ra]
+        if iterable is None:
+            iterable = []
+        regs[dst] = (
+            list(iterable.items()) if isinstance(iterable, dict)
+            else list(enumerate(iterable))
+        )
+        return nxt
+    return step
+
+
+@_op_factory(OP_RANGEITER)
+def _f_rangeiter(ins, consts, pc):
+    rseq, rcur = ins[1], ins[2]
+    name0 = consts[ins[3]] if ins[3] >= 0 else None
+    name1 = consts[ins[4]] if ins[4] >= 0 else None
+    target, nxt = ins[5], pc + 1
+
+    def step(ev, regs, frame):
+        seq = regs[rseq]
+        cur = regs[rcur]
+        if cur >= len(seq):
+            return target
+        key, value = seq[cur]
+        regs[rcur] = cur + 1
+        frame[1].append(frame[0])
+        env = frame[0] = _Env(frame[0])
+        if name0 is not None:
+            env.define(name0, key)
+        if name1 is not None:
+            env.define(name1, value)
+        return nxt
+    return step
+
+
+@_op_factory(OP_CASE_P)
+def _f_case_p(ins, consts, pc):
+    vregs, rsubj, tagless, target, nxt = (
+        consts[ins[1]], ins[2], ins[3], ins[4], pc + 1
+    )
+
+    def step(ev, regs, frame):
+        subject = regs[rsubj]
+        matched = False
+        for vr in vregs:
+            value = regs[vr]
+            matched = (
+                _truthy(value) if tagless else _go_eq(subject, value)
+            )
+            if matched:
+                break
+        if matched:
+            frame[1].append(frame[0])
+            frame[0] = _Env(frame[0])
+            return target
+        return nxt
+    return step
+
+
+@_op_factory(OP_RET_NONE)
+def _f_ret_none(ins, consts, pc):
+    def step(ev, regs, frame):
+        raise _Return(None)
+    return step
+
+
+@_op_factory(OP_RET1)
+def _f_ret1(ins, consts, pc):
+    ra = ins[1]
+
+    def step(ev, regs, frame):
+        raise _Return(regs[ra])
+    return step
+
+
+@_op_factory(OP_RET_NAME)
+def _f_ret_name(ins, consts, pc):
+    name = consts[ins[1]]
+
+    def step(ev, regs, frame):
+        raise _Return(ev.lookup(name, frame[0]))
+    return step
+
+
+@_op_factory(OP_RET_CONST)
+def _f_ret_const(ins, consts, pc):
+    value = consts[ins[1]]
+
+    def step(ev, regs, frame):
+        raise _Return(value)
+    return step
+
+
+@_op_factory(OP_RETN)
+def _f_retn(ins, consts, pc):
+    entries = consts[ins[1]]
+
+    def step(ev, regs, frame):
+        out = []
+        env = frame[0]
+        for kind, payload in entries:
+            if kind == "r":
+                out.append(regs[payload])
+            elif kind == "n":
+                out.append(ev.lookup(payload, env))
+            else:
+                out.append(consts[payload])
+        raise _Return(tuple(out))
+    return step
+
+
+@_op_factory(OP_DEFER)
+def _f_defer(ins, consts, pc):
+    rcallee, rargs, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        ev.defers.append((regs[rcallee], regs[rargs]))
+        return nxt
+    return step
+
+
+@_op_factory(OP_GO)
+def _f_go(ins, consts, pc):
+    rcallee, rargs, nxt = ins[1], ins[2], pc + 1
+
+    def step(ev, regs, frame):
+        ev.interp.sched.spawn(ev.interp, regs[rcallee], regs[rargs])
+        return nxt
+    return step
+
+
+def _compile_steps(prog: Program):
+    """Thread *prog* once: one specialized step closure per
+    instruction, memoized on the program."""
+    consts = prog.consts
+    steps = []
+    for pc, ins in enumerate(prog.code):
+        factory = _FACTORIES.get(ins[0])
+        if factory is None:
+            op = ins[0]
+
+            def step(ev, regs, frame, _op=op):  # pragma: no cover
+                raise I.GoInterpError(f"bad bytecode op {_op}")
+            steps.append(step)
+            continue
+        steps.append(factory(ins, consts, pc))
+    prog._steps = steps
+    return steps
+
+
+def execute(prog: Program, ev, env):
+    """Run *prog* against the live evaluator/scope via the threaded
+    backend.  Returns the register file (expression sub-programs read
+    their ``out`` slot).  Exceptions — ``_Return`` from the RET steps,
+    ``GoPanic``/``GoInterpError`` from runtime steps — propagate to the
+    caller exactly as from the closure tier."""
+    _executed_pending[0] += 1
+    steps = prog._steps
+    if steps is None:
+        steps = _compile_steps(prog)
+    regs = [None] * prog.nregs
+    frame = [env, []]
+    pc = 0
+    while pc >= 0:
+        pc = steps[pc](ev, regs, frame)
+    return regs

@@ -44,7 +44,7 @@ from ..perf import spans
 
 # bump to invalidate previously persisted gocheck entries when the
 # cached record shapes (not the checker's behavior) change
-_SCHEMA = 3  # 3: ProjectIndex carries its per-file scan table (deltas)
+_SCHEMA = 4  # 4: gocheck.lower manifests carry bytecode Programs
 
 _lock = threading.Lock()
 _scan_mem: dict = {}    # (sha, path) -> pristine _FileScan

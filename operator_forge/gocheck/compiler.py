@@ -29,9 +29,20 @@ produce byte-identical suite reports):
   shapes raise the same errors at the same execution points walk
   would, and never at compile time.
 
-Mode selection: ``OPERATOR_FORGE_GOCHECK=walk|compile`` (default
-``compile``), overridable programmatically via :func:`set_mode` for
-tests and the bench identity guards.
+Tier ladder (PR 11): ``OPERATOR_FORGE_GOCHECK=walk|compile|bytecode``
+selects the execution *ceiling* (default ``bytecode``), overridable
+programmatically via :func:`set_mode` for tests and the bench identity
+guards.  Under the ``bytecode`` ceiling, promotion is profile-guided:
+a body is lowered to closures on its first call (as under ``compile``),
+and once its per-body reuse counter reaches
+``OPERATOR_FORGE_GOCHECK_PROMOTE`` registry hits (default 2, 0 =
+promote immediately) it is lowered one rung further to the register
+bytecode of :mod:`~operator_forge.gocheck.bytecode` — a picklable flat
+program that also persists inside the ``gocheck.lower`` manifests, so
+cold processes and pool workers hydrate *executable* programs instead
+of recompiling.  A body outside the bytecode subset falls back a tier
+(``bytecode.deopt``) and stays at the closure tier, exactly as
+``compile`` falls back to ``walk`` today.
 """
 
 from __future__ import annotations
@@ -39,14 +50,15 @@ from __future__ import annotations
 import os
 import threading
 
-from ..perf import spans
+from ..perf import env_number, spans
 from . import interp as I
 from .tokens import FLOAT, IDENT, IMAG, INT, KEYWORD, OP, RUNE, STRING
 
-_MODES = ("walk", "compile")
-DEFAULT_MODE = "compile"
+_MODES = ("walk", "compile", "bytecode")
+DEFAULT_MODE = "bytecode"
 
 _forced = None
+_forced_promote = None
 
 
 def mode() -> str:
@@ -63,6 +75,19 @@ def set_mode(value=None) -> None:
     if value is not None and value not in _MODES:
         raise ValueError(f"unknown gocheck mode {value!r}; known: {_MODES}")
     _forced = value
+
+
+def promote_after() -> int:
+    """Registry hits before a body graduates closure → bytecode."""
+    if _forced_promote is not None:
+        return _forced_promote
+    return int(env_number("OPERATOR_FORGE_GOCHECK_PROMOTE", 2, cast=int))
+
+
+def set_promote_after(value=None) -> None:
+    """Programmatic override (``None`` restores env-driven selection)."""
+    global _forced_promote
+    _forced_promote = value if value is None else int(value)
 
 
 # -- compiled-body registry ----------------------------------------------
@@ -94,6 +119,14 @@ _registry_lock = threading.Lock()
 _lowered_spans: dict = {}   # sha -> set of (lo, hi) lowered this process
 _dirty_shas: set = set()    # shas whose manifest needs persisting
 _hydrated: set = set()      # shas whose manifest was already consulted
+# the bytecode tier (PR 11): promoted bodies keyed like the closure
+# registry, plus the serializable Programs per sha for manifest
+# persistence, the per-body reuse profile driving promotion, and the
+# bodies that deopted (outside the bytecode subset — never retried)
+_bc_registry: dict = {}     # (sha, lo, hi) -> counting bytecode runner
+_bc_programs: dict = {}     # sha -> {(lo, hi): Program}
+_hits: dict = {}            # (sha, lo, hi) -> closure-registry hits
+_bc_failed: set = set()     # (sha, lo, hi) that deopted at lowering
 # registry-hit tally for the hot path: compiled_block runs once per
 # interpreted function CALL, so it must not take the global metrics
 # lock (twice) per hit — hits accumulate in a plain cell (the rare
@@ -105,35 +138,92 @@ _reused_pending = [0]
 
 
 def reset() -> None:
+    import sys
+
     with _registry_lock:
         _registry.clear()
         _lowered_spans.clear()
         _dirty_shas.clear()
         _hydrated.clear()
+        _bc_registry.clear()
+        _bc_programs.clear()
+        _hits.clear()
+        _bc_failed.clear()
         _reused_pending[0] = 0
+    bc = sys.modules.get("operator_forge.gocheck.bytecode")
+    if bc is not None:
+        bc.reset()
 
 
 def flush_counters() -> None:
-    """Reconcile the lock-free registry-hit tally into the metrics
-    registry (``compile.reused``)."""
+    """Reconcile the lock-free registry-hit tallies into the metrics
+    registry (``compile.reused`` here, ``bytecode.executed`` in the
+    bytecode module)."""
+    import sys
+
     pending, _reused_pending[0] = _reused_pending[0], 0
     if pending:
         from ..perf import metrics
 
         metrics.counter("compile.reused").inc(pending)
+    bc = sys.modules.get("operator_forge.gocheck.bytecode")
+    if bc is not None:
+        bc.flush_executed()
+
+
+def _promote(scan, sha: str, lo: int, hi: int, key):
+    """Lower a hot body closure → bytecode.  Success installs the
+    counting runner and records the Program for manifest persistence
+    (``compile.promoted``); an out-of-subset body deopts permanently
+    (``bytecode.deopt``) and stays at the closure tier."""
+    from ..perf import metrics
+    from . import bytecode
+
+    with spans.span("gocheck.promote"):
+        prog = bytecode.lower_block(scan, lo, hi)
+    if prog is None:
+        _bc_failed.add(key)
+        metrics.counter("bytecode.deopt").inc()
+        return None
+    runner = bytecode.make_runner(prog)
+    with _registry_lock:
+        _bc_registry[key] = runner
+        _bc_programs.setdefault(sha, {})[(lo, hi)] = prog
+        _lowered_spans.setdefault(sha, set()).add((lo, hi))
+        _dirty_shas.add(sha)
+    metrics.counter("compile.promoted").inc()
+    return runner
 
 
 def compiled_block(scan, lo: int, hi: int):
     """The compiled runner for ``scan.toks[lo:hi]``, or None when the
-    body cannot be compiled at all (pathological nesting)."""
+    body cannot be compiled at all (pathological nesting).  Under the
+    ``bytecode`` ceiling the per-body reuse profile decides when a
+    closure-tier body graduates to the register bytecode."""
     sha = getattr(scan, "sha", None)
+    tiered = mode() == "bytecode"
     if sha is not None:
         key = (sha, lo, hi)
+        if tiered:
+            runner = _bc_registry.get(key)
+            if runner is not None:
+                return runner  # the runner tallies bytecode.executed
         runner = _registry.get(key)
         if runner is not None:
             _reused_pending[0] += 1
+            if tiered and key not in _bc_failed:
+                # the promotion profile: plain-cell increments (same
+                # acceptable-race contract as _reused_pending)
+                hits = _hits.get(key, 0) + 1
+                _hits[key] = hits
+                if hits >= promote_after():
+                    promoted = _promote(scan, sha, lo, hi, key)
+                    if promoted is not None:
+                        return promoted
             return runner
     else:
+        # sha-less scans cannot key the cross-world registries; they
+        # stay at the closure tier
         local = scan.__dict__.setdefault("_compiled_bodies", {})
         runner = local.get((lo, hi))
         if runner is not None:
@@ -152,6 +242,11 @@ def compiled_block(scan, lo: int, hi: int):
             _registry[key] = runner
             _lowered_spans.setdefault(sha, set()).add((lo, hi))
             _dirty_shas.add(sha)
+        if tiered and key not in _bc_failed and promote_after() <= 0:
+            # profile floor of 0: promote at first lowering
+            promoted = _promote(scan, sha, lo, hi, key)
+            if promoted is not None:
+                return promoted
     else:
         local[(lo, hi)] = runner
     return runner
@@ -170,16 +265,20 @@ def _lower_key(sha: str) -> str:
 
 def hydrate_scan(scan) -> int:
     """Pre-compile every body a previous process recorded for this
-    scan's content hash, straight from the cached token stream.  One
-    manifest lookup per sha per process (negative results memoized);
-    bodies already in the registry are skipped.  Returns the number of
-    bodies hydrated.  A no-op in walk mode, with the cache off, or for
-    sha-less scans."""
+    scan's content hash.  One manifest lookup per sha per process
+    (negative results memoized); bodies already in a registry are
+    skipped.  Manifest entries are ``((lo, hi), program_or_None)``:
+    under the ``bytecode`` ceiling a recorded Program installs
+    *directly* (no recompilation at all — the unpickle IS the
+    hydration), while program-less spans — and every span under the
+    ``compile`` ceiling — are closure-lowered from the cached token
+    stream as before.  Returns the number of bodies hydrated.  A no-op
+    in walk mode, with the cache off, or for sha-less scans."""
     from ..perf import cache as pf_cache
     from ..perf import metrics
 
     sha = getattr(scan, "sha", None)
-    if sha is None or mode() != "compile":
+    if sha is None or mode() == "walk":
         return 0
     cache = pf_cache.get_cache()
     if cache.mode() == "off":
@@ -191,14 +290,30 @@ def hydrate_scan(scan) -> int:
     manifest = cache.get(_LOWER_STAGE, _lower_key(sha))
     if manifest is pf_cache.MISS or not isinstance(manifest, tuple):
         return 0
+    tiered = mode() == "bytecode"
+    if tiered:
+        from . import bytecode
     count = 0
     with spans.span("gocheck.hydrate"):
-        for span_pair in manifest:
+        for entry in manifest:
             try:
-                lo, hi = int(span_pair[0]), int(span_pair[1])
+                (lo, hi), prog = entry
+                lo, hi = int(lo), int(hi)
             except (TypeError, ValueError, IndexError):
                 continue  # a damaged manifest entry is just skipped
             key = (sha, lo, hi)
+            if tiered and prog is not None and isinstance(
+                prog, bytecode.Program
+            ):
+                if _bc_registry.get(key) is not None:
+                    continue
+                runner = bytecode.make_runner(prog)
+                with _registry_lock:
+                    _bc_registry[key] = runner
+                    _bc_programs.setdefault(sha, {})[(lo, hi)] = prog
+                    _lowered_spans.setdefault(sha, set()).add((lo, hi))
+                count += 1
+                continue
             if _registry.get(key) is not None:
                 continue
             try:
@@ -216,10 +331,12 @@ def hydrate_scan(scan) -> int:
 
 def flush_lowered() -> int:
     """Persist the dirty lowering manifests (merged with any previously
-    recorded spans for the same sha) into the ``gocheck.lower``
-    namespace — disk and, when configured, the remote tier.  Called at
-    the end of a test run and at process exit; cheap no-op when nothing
-    new was lowered.  Returns the number of manifests written."""
+    recorded entries for the same sha) into the ``gocheck.lower``
+    namespace — disk and, when configured, the remote tier.  Entries
+    are ``((lo, hi), program_or_None)``; a promoted body's Program
+    always wins over a bare span from an earlier flush.  Called at the
+    end of a test run and at process exit; cheap no-op when nothing new
+    was lowered.  Returns the number of manifests written."""
     from ..perf import cache as pf_cache
 
     flush_counters()  # every flush boundary also reconciles the tally
@@ -227,21 +344,33 @@ def flush_lowered() -> int:
     if cache.mode() == "off":
         return 0
     with _registry_lock:
-        dirty = {sha: frozenset(_lowered_spans.get(sha, ()))
-                 for sha in _dirty_shas}
+        dirty = {
+            sha: (
+                frozenset(_lowered_spans.get(sha, ())),
+                dict(_bc_programs.get(sha, {})),
+            )
+            for sha in _dirty_shas
+        }
         _dirty_shas.clear()
     written = 0
-    for sha, spans_set in dirty.items():
+    for sha, (spans_set, programs) in dirty.items():
         if not spans_set:
             continue
         key = _lower_key(sha)
         previous = cache.get(_LOWER_STAGE, key, record_stats=False)
-        merged = set(spans_set)
+        merged = {span: programs.get(span) for span in spans_set}
         if previous is not pf_cache.MISS and isinstance(previous, tuple):
-            merged.update(
-                (int(lo), int(hi)) for lo, hi in previous
-            )
-        value = tuple(sorted(merged))
+            for entry in previous:
+                try:
+                    (lo, hi), prog = entry
+                    span = (int(lo), int(hi))
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if merged.get(span) is None:
+                    merged[span] = prog
+        value = tuple(
+            (span, merged[span]) for span in sorted(merged)
+        )
         if previous is not pf_cache.MISS and value == previous:
             continue
         cache.put(_LOWER_STAGE, key, value)

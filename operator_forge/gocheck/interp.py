@@ -2136,10 +2136,11 @@ class Interp:
         _bind_params(env, fn["params"], args)
         ev = _Eval(self, scan, env)
         lo, hi = fn["body"]
-        # compile mode lowers the body to closures once per content
-        # hash; walk mode (and a failed compile) re-walks the tokens
+        # the compile/bytecode tiers lower the body once per content
+        # hash (compiled_block picks the tier from the reuse profile);
+        # walk mode (and a failed compile) re-walks the tokens
         runner = None
-        if compiler.mode() == "compile":
+        if compiler.mode() != "walk":
             runner = compiler.compiled_block(scan, lo, hi)
         try:
             if runner is not None:
@@ -3502,7 +3503,7 @@ class _Eval:
             ev = _Eval(owner, callee.scan, env)
             lo, hi = fn["body"]
             runner = getattr(callee, "compiled", None)
-            if runner is not None and compiler.mode() != "compile":
+            if runner is not None and compiler.mode() == "walk":
                 runner = None
             try:
                 if runner is not None:

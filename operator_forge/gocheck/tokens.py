@@ -8,10 +8,26 @@ an identifier, a literal, one of the keywords break/continue/fallthrough/
 return, one of ++/--, or one of )/]/}.  (Rule 2 — eliding semicolons
 before ")" or "}" — is handled by the parser accepting optional
 semicolons there.)
+
+Two scanners produce the identical token stream:
+
+- :func:`tokenize` is the vectorized fast path (PR 11): one precompiled
+  master regex consumes a whole token per C-level match — identifier
+  runs, number starts, string/rune/comment bodies, whitespace runs, and
+  the full operator table as a longest-first alternation — replacing
+  the per-character advances (and the per-char operator-bucket probes)
+  the scalar loop pays.  It covers ASCII input; non-ASCII source and
+  every lexical-error case delegate to the scalar scanner, which owns
+  exact error reproduction.
+- :func:`_tokenize_scalar` is the original per-character reference
+  implementation.  The differential test in tests/test_bytecode_tier.py
+  pins the two to byte-identical streams (kind, value, line, col) over
+  the emitted corpus and the tricky-shape corpus.
 """
 
 from __future__ import annotations
 
+import re
 import sys
 from dataclasses import dataclass
 
@@ -31,7 +47,9 @@ KEYWORDS = frozenset(
     struct switch type var""".split()
 )
 
-# Longest-first so the scanner can use greedy matching.
+# Longest-first so the scanner can use greedy matching (and so the
+# master regex alternation, which takes the FIRST matching branch,
+# prefers the longest operator).
 OPERATORS = sorted(
     [
         "<<=", ">>=", "&^=", "...",
@@ -43,18 +61,6 @@ OPERATORS = sorted(
     key=len,
     reverse=True,
 )
-
-# Length-bucketed operator sets, hoisted to module level: greedy
-# matching becomes three O(1) membership tests instead of a linear
-# startswith() sweep over the whole table per operator token.
-_OPS_BY_LEN = (
-    frozenset(op for op in OPERATORS if len(op) == 3),
-    frozenset(op for op in OPERATORS if len(op) == 2),
-    frozenset(op for op in OPERATORS if len(op) == 1),
-)
-# the bucket matcher probes exactly lengths 3,2,1 — a longer operator
-# would be silently unmatchable
-assert max(len(op) for op in OPERATORS) == 3
 
 # Every token value the scanner can emit more than once is interned:
 # keywords, operators, and identifiers repeat heavily across the files
@@ -82,6 +88,11 @@ _LITERAL_KINDS = frozenset({INT, FLOAT, IMAG, RUNE, STRING})
 
 @dataclass
 class Token:
+    # manual __slots__ rather than dataclass(slots=True): the package
+    # supports 3.9, where the kwarg does not exist; with no field
+    # defaults the two spellings are equivalent
+    __slots__ = ("kind", "value", "line", "col")
+
     kind: str
     value: str
     line: int
@@ -106,6 +117,120 @@ _DIGITS = {
 }
 
 
+def _scan_number(text: str, i: int, n: int, filename: str, line: int,
+                 col: int):
+    """Scan a number starting at ``text[i]`` (a digit, or '.'+digit).
+    Returns ``(kind, j)`` with ``j`` one past the literal; malformed
+    literals raise a GoTokenError at the given position — the ONE
+    implementation both scanner paths share, so their numeric grammars
+    cannot drift."""
+
+    def err(msg):  # cold path: only malformed literals reach it
+        raise GoTokenError(filename, line, col, msg)
+
+    j = i
+    kind = INT
+    if text[i] == "0" and j + 1 < n and text[j + 1] in "bBoOxX":
+        base = text[j + 1].lower()
+        digits = _DIGITS[base]
+        j += 2
+        k = j
+        while j < n and text[j] in digits:
+            j += 1
+        if j == k:
+            err(f"malformed 0{base} literal")
+        if base == "x":
+            # hex float: mantissa may contain '.', needs p-exponent
+            if j < n and text[j] == ".":
+                j += 1
+                while j < n and text[j] in digits:
+                    j += 1
+                kind = FLOAT
+            if j < n and text[j] in "pP":
+                kind = FLOAT
+                j += 1
+                if j < n and text[j] in "+-":
+                    j += 1
+                if j >= n or not text[j].isdigit():
+                    err("malformed hex float exponent")
+                while j < n and (text[j].isdigit() or text[j] == "_"):
+                    j += 1
+            elif kind == FLOAT:
+                err("hex float requires p exponent")
+    else:
+        while j < n and (text[j].isdigit() or text[j] == "_"):
+            j += 1
+        if j < n and text[j] == ".":
+            kind = FLOAT
+            j += 1
+            while j < n and (text[j].isdigit() or text[j] == "_"):
+                j += 1
+        if j < n and text[j] in "eE":
+            kind = FLOAT
+            j += 1
+            if j < n and text[j] in "+-":
+                j += 1
+            if j >= n or not text[j].isdigit():
+                err("malformed exponent")
+            while j < n and (text[j].isdigit() or text[j] == "_"):
+                j += 1
+    if j < n and text[j] == "i":
+        kind = IMAG
+        j += 1
+    return kind, j
+
+
+# -- the vectorized scanner ------------------------------------------------
+#
+# One alternation, ordered so that (a) comments come before the "/"
+# operators, (b) a "."-led number comes before the "."/"..." operators,
+# and (c) each BAD* branch fires exactly when its well-formed sibling
+# cannot match — unterminated comment/string, or a stray character —
+# at which point the whole scan delegates to the scalar path, which
+# raises the identical GoTokenError.  The catch-all makes the pattern
+# total: every position matches some branch.
+
+_MASTER = re.compile(
+    r"\n"                                   # NL (lastgroup None)
+    r"|(?P<WS>[ \t\r]+)"
+    r"|(?P<IDENT>[A-Za-z_][A-Za-z0-9_]*)"
+    r"|(?P<NUM>\.?[0-9])"                   # number START; helper scans
+    r"|(?P<LC>//[^\n]*)"
+    r"|(?P<BC>/\*(?s:.*?)\*/)"
+    r"|(?P<BADBC>/\*)"
+    r"|(?P<RAW>`[^`]*`)"
+    r"|(?P<BADRAW>`)"
+    r"|(?P<STR>\"(?:\\[^\n]|[^\"\\\n])*\")"
+    r"|(?P<RUNE>'(?:\\[^\n]|[^'\\\n])*')"
+    r"|(?P<BADQ>[\"'])"
+    r"|(?P<OPTOK>" + "|".join(re.escape(op) for op in OPERATORS) + r")"
+    r"|(?P<BAD>.)"
+)
+
+
+def _asi_pending(t: Token) -> bool:
+    if t.kind == IDENT or t.kind in _LITERAL_KINDS:
+        return True
+    if t.kind == KEYWORD and t.value in _ASI_AFTER_KEYWORDS:
+        return True
+    if t.kind == OP and t.value in _ASI_AFTER_OPS:
+        return True
+    return False
+
+
+# group numbers for lastindex dispatch (None = the bare \n branch)
+_G = _MASTER.groupindex
+_G_WS = _G["WS"]
+_G_IDENT = _G["IDENT"]
+_G_NUM = _G["NUM"]
+_G_LC = _G["LC"]
+_G_BC = _G["BC"]
+_G_RAW = _G["RAW"]
+_G_STR = _G["STR"]
+_G_RUNE = _G["RUNE"]
+_G_OPTOK = _G["OPTOK"]
+
+
 def tokenize(text: str, filename: str = "<go>") -> list[Token]:
     """Tokenize Go source, applying semicolon insertion.
 
@@ -113,6 +238,101 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
     discarded (a general comment containing no newline counts as nothing;
     one containing newlines acts as a newline for ASI, per spec).
     """
+    if not text.isascii():
+        # unicode identifiers/digits follow str.isalpha()/isdigit();
+        # the regex alternation covers only the ASCII fast path
+        return _tokenize_scalar(text, filename)
+    tokens: list[Token] = []
+    append = tokens.append
+    match = _MASTER.match
+    n = len(text)
+    pos = 0
+    line = 1
+    line_start = 0  # absolute index of the current line's first char
+    eof_col = None  # scalar-parity quirk: comment-to-EOF freezes col
+    while pos < n:
+        m = match(text, pos)
+        gi = m.lastindex
+        if gi == _G_IDENT:
+            word = _INTERN(m.group())
+            append(Token(
+                KEYWORD if word in KEYWORDS else IDENT, word,
+                line, pos - line_start + 1,
+            ))
+            pos = m.end()
+            continue
+        if gi == _G_OPTOK:
+            append(Token(OP, _INTERN(m.group()), line,
+                         pos - line_start + 1))
+            pos = m.end()
+            continue
+        if gi == _G_WS:
+            pos = m.end()
+            continue
+        if gi is None:  # the newline branch
+            if tokens and _asi_pending(tokens[-1]):
+                append(Token(OP, ";", line, pos - line_start + 1))
+            pos += 1
+            line += 1
+            line_start = pos
+            continue
+        if gi == _G_NUM:
+            col = pos - line_start + 1
+            num_kind, j = _scan_number(text, pos, n, filename, line, col)
+            append(Token(num_kind, text[pos:j], line, col))
+            pos = j
+            continue
+        if gi == _G_STR:
+            append(Token(STRING, m.group(), line, pos - line_start + 1))
+            pos = m.end()
+            continue
+        if gi == _G_RUNE:
+            append(Token(RUNE, m.group(), line, pos - line_start + 1))
+            pos = m.end()
+            continue
+        if gi == _G_RAW:
+            body = m.group()
+            append(Token(STRING, body, line, pos - line_start + 1))
+            count = body.count("\n")
+            if count:
+                line += count
+                line_start = pos + body.rfind("\n") + 1
+            pos = m.end()
+            continue
+        if gi == _G_LC:
+            if m.end() >= n:
+                # scalar parity: a line comment ending the file leaves
+                # the column at the comment start for the EOF tokens
+                eof_col = pos - line_start + 1
+            pos = m.end()
+            continue
+        if gi == _G_BC:
+            body = text[pos + 2:m.end() - 2]
+            count = body.count("\n")
+            if count:
+                if tokens and _asi_pending(tokens[-1]):
+                    append(Token(OP, ";", line, pos - line_start + 1))
+                line += count
+                line_start = pos + 2 + body.rfind("\n") + 1
+            pos = m.end()
+            continue
+        # BADBC / BADRAW / BADQ / BAD: a lexical error somewhere at or
+        # after this point — the scalar path owns exact error positions
+        return _tokenize_scalar(text, filename)
+    # EOF acts like a newline for semicolon insertion.
+    col = (n - line_start + 1) if eof_col is None else eof_col
+    if tokens and _asi_pending(tokens[-1]):
+        append(Token(OP, ";", line, col))
+    append(Token(EOF, "", line, col))
+    return tokens
+
+
+# -- the scalar reference scanner -----------------------------------------
+
+
+def _tokenize_scalar(text: str, filename: str = "<go>") -> list[Token]:
+    """The per-character reference scanner: handles non-ASCII source
+    and reproduces every lexical error with its exact position."""
     tokens: list[Token] = []
     i = 0
     n = len(text)
@@ -125,14 +345,7 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
     def asi_pending() -> bool:
         if not tokens:
             return False
-        t = tokens[-1]
-        if t.kind in (IDENT,) or t.kind in _LITERAL_KINDS:
-            return True
-        if t.kind == KEYWORD and t.value in _ASI_AFTER_KEYWORDS:
-            return True
-        if t.kind == OP and t.value in _ASI_AFTER_OPS:
-            return True
-        return False
+        return _asi_pending(tokens[-1])
 
     def insert_semi():
         if asi_pending():
@@ -193,55 +406,8 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
 
         # Numbers (incl. ".5" floats).
         if ch.isdigit() or (ch == "." and i + 1 < n and text[i + 1].isdigit()):
-            j = i
-            kind = INT
-            if ch == "0" and j + 1 < n and text[j + 1] in "bBoOxX":
-                base = text[j + 1].lower()
-                digits = _DIGITS[base]
-                j += 2
-                k = j
-                while j < n and text[j] in digits:
-                    j += 1
-                if j == k:
-                    err(f"malformed 0{base} literal")
-                if base == "x":
-                    # hex float: mantissa may contain '.', needs p-exponent
-                    if j < n and text[j] == ".":
-                        j += 1
-                        while j < n and text[j] in digits:
-                            j += 1
-                        kind = FLOAT
-                    if j < n and text[j] in "pP":
-                        kind = FLOAT
-                        j += 1
-                        if j < n and text[j] in "+-":
-                            j += 1
-                        if j >= n or not text[j].isdigit():
-                            err("malformed hex float exponent")
-                        while j < n and (text[j].isdigit() or text[j] == "_"):
-                            j += 1
-                    elif kind == FLOAT:
-                        err("hex float requires p exponent")
-            else:
-                while j < n and (text[j].isdigit() or text[j] == "_"):
-                    j += 1
-                if j < n and text[j] == ".":
-                    kind = FLOAT
-                    j += 1
-                    while j < n and (text[j].isdigit() or text[j] == "_"):
-                        j += 1
-                if j < n and text[j] in "eE":
-                    kind = FLOAT
-                    j += 1
-                    if j < n and text[j] in "+-":
-                        j += 1
-                    if j >= n or not text[j].isdigit():
-                        err("malformed exponent")
-                    while j < n and (text[j].isdigit() or text[j] == "_"):
-                        j += 1
-            if j < n and text[j] == "i":
-                kind = IMAG
-                j += 1
+            kind, j = _scan_number(text, i, n, filename, start_line,
+                                   start_col)
             tokens.append(Token(kind, text[i:j], start_line, start_col))
             col += j - i
             i = j
@@ -288,7 +454,7 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
             i = j + 1
             continue
 
-        # Operators / punctuation: longest-first via the length buckets.
+        # Operators / punctuation: longest-first via the master table.
         op = None
         three = text[i : i + 3]
         if three in _OPS_BY_LEN[0]:
@@ -310,3 +476,14 @@ def tokenize(text: str, filename: str = "<go>") -> list[Token]:
     insert_semi()
     tokens.append(Token(EOF, "", line, col))
     return tokens
+
+
+# Length-bucketed operator sets for the scalar path's greedy matcher.
+_OPS_BY_LEN = (
+    frozenset(op for op in OPERATORS if len(op) == 3),
+    frozenset(op for op in OPERATORS if len(op) == 2),
+    frozenset(op for op in OPERATORS if len(op) == 1),
+)
+# the bucket matcher probes exactly lengths 3,2,1 — a longer operator
+# would be silently unmatchable
+assert max(len(op) for op in OPERATORS) == 3

@@ -28,6 +28,9 @@ def _run_pattern(exceptions: set) -> "re.Pattern[str]":
 _TOKEN_RUN_RE = _run_pattern(_TOKEN_EXCEPTIONS)
 _NAKED_RUN_RE = _run_pattern(_NAKED_EXCEPTIONS)
 _BREAK_RUN_RE = _run_pattern(set(" \n"))
+# quoted-value bodies: longest run up to the closing delimiter or a
+# newline (the newline branch keeps its per-case handling)
+_QUOTED_RUN_RES = {q: _run_pattern({q, "\n"}) for q in ('"', "'", "`")}
 
 Literal = Union[str, int, float, bool]
 
@@ -75,17 +78,23 @@ class _Scanner:
     # -- top level ------------------------------------------------------
 
     def scan(self) -> ScanResult:
-        while not self.at_end():
-            ch = self.text[self.pos]
-            if ch == "+":
-                start = self.pos
-                self.pos += 1
-                if self.peek().isalpha():
-                    self._scan_marker(start)
-                # '+' not followed by a letter: plain comment text
-            else:
-                self.pos += 1
-        return self.result
+        # whole-buffer candidate discovery: jump straight to each '+'
+        # with str.find instead of advancing per character — the
+        # overwhelming majority of comment text contains no markers,
+        # and find() skips it at C speed.  Semantics are unchanged: a
+        # '+' not followed by a letter is plain comment text, and the
+        # next find() resumes right after it (re-examining a following
+        # '+' exactly as the per-char loop did).
+        text = self.text
+        n = len(text)
+        while True:
+            idx = text.find("+", self.pos)
+            if idx == -1:
+                self.pos = n
+                return self.result
+            self.pos = idx + 1
+            if self.peek().isalpha():
+                self._scan_marker(idx)
 
     # -- marker body ----------------------------------------------------
 
@@ -197,7 +206,13 @@ class _Scanner:
         opened_at = self.pos
         self.pos += 1
         out: list[str] = []
+        run = _QUOTED_RUN_RES[quote]
         while True:
+            # one regex run to the next delimiter or newline instead of
+            # a per-character append loop
+            match = run.match(self.text, self.pos)
+            out.append(match.group())
+            self.pos = match.end()
             if self.at_end():
                 raise ScanError(
                     f"unmatched string delimiter {quote} at position {opened_at}"
@@ -206,21 +221,18 @@ class _Scanner:
             if ch == quote:
                 self.pos += 1
                 return "".join(out)
-            if ch == "\n":
-                if quote != "`":
-                    raise ScanError(
-                        f"unmatched string delimiter {quote} at position "
-                        f"{opened_at}"
-                    )
-                # backtick strings may continue across comment lines; the
-                # comment prefix of the next line is not part of the value
-                # (internal/markers/lexer/state.go:201-210)
-                out.append(ch)
-                self.pos += 1
-                self._skip_comment_prefix()
-                continue
+            # ch == "\n"
+            if quote != "`":
+                raise ScanError(
+                    f"unmatched string delimiter {quote} at position "
+                    f"{opened_at}"
+                )
+            # backtick strings may continue across comment lines; the
+            # comment prefix of the next line is not part of the value
+            # (internal/markers/lexer/state.go:201-210)
             out.append(ch)
             self.pos += 1
+            self._skip_comment_prefix()
 
     def _skip_comment_prefix(self) -> None:
         mark = self.pos

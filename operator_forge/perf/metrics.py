@@ -314,11 +314,34 @@ def cache_report() -> dict:
     return out
 
 
+def tier_report() -> dict:
+    """Execution-tier attribution (PR 11): the gocheck tier ceiling and
+    the ladder counters — bodies lowered to closures, promoted to
+    bytecode, reconstituted from manifests, registry reuse, bytecode
+    program executions, and deopts — in stable key order.  Worker
+    processes ship the same counters in their sealed-result deltas, so
+    a resident daemon's numbers aggregate fleet-wide."""
+    import sys
+
+    compiler = sys.modules.get("operator_forge.gocheck.compiler")
+    if compiler is None:
+        return {"mode": None}
+    compiler.flush_counters()  # reconcile the lock-free tallies
+    counts = counters_snapshot()
+    out = {"mode": compiler.mode()}
+    for name in (
+        "compile.lowered", "compile.promoted", "compile.hydrated",
+        "compile.reused", "bytecode.executed", "bytecode.deopt",
+    ):
+        out[name] = counts.get(name, 0)
+    return out
+
+
 def report() -> dict:
     """The whole observability surface in one stable-ordered document:
-    cache attribution, graph counters, the metrics registry, and the
-    span table (the serve ``stats`` op and ``operator-forge stats``
-    both render this)."""
+    cache attribution, graph counters, the metrics registry, the
+    execution-tier ladder, and the span table (the serve ``stats`` op
+    and ``operator-forge stats`` both render this)."""
     from . import spans
     from .depgraph import GRAPH
 
@@ -327,4 +350,5 @@ def report() -> dict:
         "graph": GRAPH.counters(),
         "metrics": snapshot(),
         "spans": spans.snapshot(),
+        "tiers": tier_report(),
     }

@@ -210,6 +210,7 @@ _SHIPPED_ENV = (
     "OPERATOR_FORGE_CACHE_DIR",
     "OPERATOR_FORGE_JOBS",
     "OPERATOR_FORGE_GOCHECK",
+    "OPERATOR_FORGE_GOCHECK_PROMOTE",
     "OPERATOR_FORGE_PROFILE",
     "OPERATOR_FORGE_TRACE",
     "OPERATOR_FORGE_TRACE_EVENTS",
@@ -233,6 +234,7 @@ def _task_config() -> dict:
         "cache_mode": cache._mode_override,
         "cache_root": cache._root_override,
         "gocheck_mode": compiler._forced,
+        "gocheck_promote": compiler._forced_promote,
         "env": {k: os.environ.get(k) for k in _SHIPPED_ENV},
         # the programmatic tracing override (cmd_trace, tests) — env
         # shipping alone would miss it, and a worker forked mid-trace
@@ -277,6 +279,7 @@ def _apply_config(cfg: dict) -> None:
     spans.enable_tracing(cfg["trace"])
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
+    compiler.set_promote_after(cfg.get("gocheck_promote"))
     if cfg["faults"] != faults.forced_spec():
         # only on change: configure() resets the worker's hit counters,
         # and a per-task reset would re-fire every :1 fault forever

@@ -277,6 +277,7 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
             },
             "remote": remote.state(),
             "spans": spans.snapshot(),
+            "tiers": metrics.tier_report(),
             "workers": workers.pool_state(),
         }
         for name, fn in sorted(_STATS_SOURCES.items()):

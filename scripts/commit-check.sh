@@ -331,6 +331,46 @@ print(
         daemon["fairness"]["bound"],
     )
 )
+
+# tiered execution (PR 11): walk/compile/bytecode reports must be
+# identical on kitchen-sink (the bench also re-checks the matrix in
+# check_section's five tier×jobs legs per cache mode) and on the
+# monorepo-lite cold leg, the bytecode warm check execution must clear
+# the 3x bar over walk, and the bytecode leg must actually attribute
+# executed programs.
+tiered = detail["tiered"]
+assert tiered["identity"] is True, "tier identity diverged (kitchen-sink)"
+assert tiered["monorepo_lite"]["identity"] is True, (
+    "tier identity diverged (monorepo-lite cold)"
+)
+assert tiered["bytecode_vs_walk"] >= 3, (
+    "bytecode warm check below the 3x bar over walk: %.2f"
+    % tiered["bytecode_vs_walk"]
+)
+assert tiered["tier_counters_bytecode_leg"]["bytecode.executed"] > 0, (
+    "bytecode leg executed no programs"
+)
+assert tiered["tier_counters_bytecode_leg"]["compile.promoted"] > 0, (
+    "bytecode leg promoted no bodies"
+)
+print(
+    "tiered contract OK: warm exec walk=%.3fs compile=%.3fs "
+    "bytecode=%.3fs (bytecode x%.1f over walk), monorepo-lite cold "
+    "walk=%.2fs bytecode=%.2fs, %d promoted / %d executed / %d deopt, "
+    "lex x%.2f"
+    % (
+        tiered["kitchen_sink_warm_exec_cpu_s"]["walk"],
+        tiered["kitchen_sink_warm_exec_cpu_s"]["compile"],
+        tiered["kitchen_sink_warm_exec_cpu_s"]["bytecode"],
+        tiered["bytecode_vs_walk"],
+        tiered["monorepo_lite"]["cold_check_cpu_s"]["walk"],
+        tiered["monorepo_lite"]["cold_check_cpu_s"]["bytecode"],
+        tiered["tier_counters_bytecode_leg"]["compile.promoted"],
+        tiered["tier_counters_bytecode_leg"]["bytecode.executed"],
+        tiered["tier_counters_bytecode_leg"]["bytecode.deopt"],
+        tiered["lex"]["speedup"],
+    )
+)
 PYEOF
 
 # Remote-tier cross-process step (PR 9): a REAL cache-server process
@@ -543,6 +583,100 @@ finally:
     if daemon.poll() is None:
         daemon.kill()
         daemon.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
+# Bytecode tier step (PR 11): the three-tier differential identity
+# matrix live — walk/compile/bytecode reports over a generated
+# standalone project must be identical across OPERATOR_FORGE_CACHE
+# off/mem/disk × thread/process workers × JOBS 1/8, with the bytecode
+# legs actually executing promoted programs (the ≥3x warm bar is
+# enforced against the bench JSON above).
+echo "bytecode step: three-tier identity matrix (cache x workers x jobs)"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import contextlib
+import io
+import os
+import shutil
+import tempfile
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import compiler
+from operator_forge.gocheck.world import run_project_tests
+from operator_forge.perf import cache as pf_cache
+from operator_forge.perf import metrics, workers
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-bytecodestep-")
+out = os.path.join(tmp, "proj")
+config = os.path.join("tests", "fixtures", "standalone", "workload.yaml")
+try:
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--repo", "github.com/acme/tiered", "--output-dir", out,
+        ]) == 0
+        assert cli_main([
+            "create", "api", "--workload-config", config,
+            "--output-dir", out,
+        ]) == 0
+
+    def signature(results):
+        return [
+            (r.rel, r.code, r.ran, r.failures, r.skipped, r.error)
+            for r in results
+        ]
+
+    compiler.set_promote_after(0)  # every body exercises the ceiling
+    reference = None
+    legs = 0
+    for cache_mode in ("off", "mem", "disk"):
+        for backend, jobs in (
+            ("thread", "1"), ("thread", "8"), ("process", "8"),
+        ):
+            for tier in ("walk", "compile", "bytecode"):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(
+                        tmp, f"cache-{cache_mode}-{backend}-{jobs}-{tier}"
+                    ) if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                compiler.set_mode(tier)
+                workers.set_backend(backend)
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                got = signature(run_project_tests(out, include_e2e=True))
+                assert got, "no packages discovered"
+                if reference is None:
+                    reference = got
+                assert got == reference, (
+                    f"tier={tier} cache={cache_mode} workers={backend} "
+                    f"jobs={jobs} diverged"
+                )
+                legs += 1
+    compiler.flush_counters()
+    counts = metrics.counters_snapshot()
+    assert counts.get("bytecode.executed", 0) > 0, (
+        "bytecode legs executed no programs"
+    )
+    assert counts.get("compile.promoted", 0) > 0, (
+        "bytecode legs promoted no bodies"
+    )
+    print(
+        "bytecode step OK: %d legs identical (3 tiers x 3 cache modes "
+        "x 3 worker/jobs combos), %d promotions / %d program "
+        "executions / %d deopts"
+        % (
+            legs, counts.get("compile.promoted", 0),
+            counts.get("bytecode.executed", 0),
+            counts.get("bytecode.deopt", 0),
+        )
+    )
+finally:
+    compiler.set_mode(None)
+    compiler.set_promote_after(None)
+    workers.set_backend(None)
+    os.environ.pop("OPERATOR_FORGE_JOBS", None)
     shutil.rmtree(tmp, ignore_errors=True)
 PYEOF
 )

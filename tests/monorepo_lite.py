@@ -1,0 +1,172 @@
+"""monorepo-lite: a deterministic synthetic workload-collection family.
+
+The first slice of ROADMAP item 4 (monorepo-scale scenario corpus):
+one WorkloadCollection plus ~39 ComponentWorkloads (40 workloads by
+default), every file a pure function of the requested size — no
+randomness, no timestamps — seeded from the kitchen-sink/collection
+fixture shapes: Deployments with field markers, Services, ConfigMaps
+with collection-scoped markers, and a sprinkling of component
+dependencies.  The bench's ``tiered`` section uses it as the
+cold-compile leg, where per-body lowering/compile time actually
+dominates the check; tests use small sizes for shape coverage.
+
+Usage::
+
+    from monorepo_lite import write_monorepo_lite
+    config = write_monorepo_lite(dst_dir, workloads=40)
+    # config is the collection workload.yaml to feed `init`/`create api`
+"""
+
+from __future__ import annotations
+
+import os
+
+_COMPONENT_TEMPLATE = """\
+name: {name}
+kind: ComponentWorkload
+spec:
+  api:
+    group: mono
+    version: v1alpha1
+    kind: {kind}
+    clusterScoped: false
+  companionCliSubcmd:
+    name: {name}
+    description: Manage the {name} service
+  dependencies: [{dependencies}]
+  resources:
+  - {name}-deploy.yaml
+"""
+
+_DEPLOY_TEMPLATE = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {name}-server
+  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
+  namespace: mono-system
+spec:
+  replicas: {replicas}  # +operator-builder:field:name={camel}Replicas,default={replicas},type=int
+  selector:
+    matchLabels:
+      app: {name}
+  template:
+    metadata:
+      labels:
+        app: {name}
+    spec:
+      containers:
+      - name: {name}
+        # +operator-builder:field:name={camel}Image,type=string,default="registry.example.io/{name}:v1.{minor}.0"
+        image: registry.example.io/{name}:v1.{minor}.0
+        ports:
+        - containerPort: {port}
+        resources:
+          limits:
+            cpu: {cpu}m
+            memory: {mem}Mi
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}-svc
+  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
+  namespace: mono-system
+spec:
+  selector:
+    app: {name}
+  ports:
+  - port: 80
+    targetPort: {port}
+"""
+
+_CONFIG_EXTRA = """\
+---
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: {name}-config
+  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
+  namespace: mono-system
+data:
+  # +operator-builder:field:name={camel}LogLevel,type=string,default="info"
+  log-level: "info"
+  retries: "{retries}"
+"""
+
+_COLLECTION_TEMPLATE = """\
+name: mono
+kind: WorkloadCollection
+spec:
+  api:
+    domain: example.io
+    group: mono
+    version: v1alpha1
+    kind: MonoPlatform
+    clusterScoped: true
+  companionCliRootcmd:
+    name: monoctl
+    description: Manage the mono platform
+  componentFiles:
+{component_files}  resources:
+  - mono-ns.yaml
+"""
+
+_NS_YAML = """\
+apiVersion: v1
+kind: Namespace
+metadata:
+  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
+  name: mono-system
+"""
+
+
+def _camel(name: str) -> str:
+    return name[0].lower() + name[1:].replace("-", "")
+
+
+def write_monorepo_lite(dst: str, workloads: int = 40) -> str:
+    """Write the fixture family under *dst* (created if needed) and
+    return the path of the collection ``workload.yaml``.  *workloads*
+    counts the collection itself plus its components (minimum 2).
+    Byte-deterministic for a given size."""
+    if workloads < 2:
+        raise ValueError("monorepo-lite needs at least 2 workloads")
+    os.makedirs(dst, exist_ok=True)
+    components = workloads - 1
+    component_files = []
+    for i in range(components):
+        name = f"svc{i:02d}"
+        kind = f"Svc{i:02d}"
+        camel = _camel(kind)
+        # every 4th component depends on its predecessor — exercises
+        # the dependency surface without cycles
+        deps = f'"{f"svc{i - 1:02d}"}"' if (i % 4 == 3 and i > 0) else ""
+        component = _COMPONENT_TEMPLATE.format(
+            name=name, kind=kind, dependencies=deps,
+        )
+        deploy = _DEPLOY_TEMPLATE.format(
+            name=name, camel=camel,
+            replicas=(i % 5) + 1, minor=i % 10,
+            port=8000 + i, cpu=100 + 50 * (i % 4), mem=128 * ((i % 3) + 1),
+        )
+        if i % 3 == 0:
+            deploy += _CONFIG_EXTRA.format(
+                name=name, camel=camel, retries=(i % 7) + 1,
+            )
+        with open(os.path.join(dst, f"{name}-component.yaml"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(component)
+        with open(os.path.join(dst, f"{name}-deploy.yaml"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(deploy)
+        component_files.append(f"  - {name}-component.yaml\n")
+    with open(os.path.join(dst, "mono-ns.yaml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_NS_YAML)
+    config = os.path.join(dst, "workload.yaml")
+    with open(config, "w", encoding="utf-8") as fh:
+        fh.write(_COLLECTION_TEMPLATE.format(
+            component_files="".join(component_files),
+        ))
+    return config

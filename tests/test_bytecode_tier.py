@@ -1,0 +1,623 @@
+"""Tiered gocheck execution contract (PR 11 acceptance).
+
+The register-bytecode tier — profile-guided promotion over the closure
+compiler, a picklable flat Program encoding, threaded-step execution,
+manifest-carried cross-process hydration — may only ever change HOW a
+conformance report is produced, never WHAT it says.  Every test here
+compares full reports (codes, test names, failure messages) across the
+walk/compile/bytecode ladder, cache modes, worker backends, and the
+two bytecode execution backends; the vectorized lexer is pinned to the
+scalar reference token by token.
+"""
+
+import contextlib
+import io
+import os
+import shutil
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import bytecode, compiler
+from operator_forge.gocheck import cache as gcache
+from operator_forge.gocheck import tokens as gotokens
+from operator_forge.gocheck.world import run_project_tests
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import metrics
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+TIERS = ("walk", "compile", "bytecode")
+
+
+@pytest.fixture(scope="module")
+def standalone(tmp_path_factory) -> str:
+    """One generated standalone project shared by the module's
+    read-only tests."""
+    out = str(tmp_path_factory.mktemp("tiered") / "proj")
+    config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", "github.com/acme/tiered", "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _restore_tier_state():
+    yield
+    compiler.set_mode(None)
+    compiler.set_promote_after(None)
+
+
+def signature(results) -> list:
+    """Everything report-relevant except wall-clock seconds."""
+    return [
+        (r.rel, r.code, r.ran, r.failures, r.skipped, r.error)
+        for r in results
+    ]
+
+
+def write_construct_project(root) -> str:
+    """A small project exercising each construct of the bytecode
+    subset (and a few outside it) through executable tests."""
+    proj = str(root / "constructs")
+    pkg = os.path.join(proj, "pkg", "kitchen")
+    os.makedirs(pkg)
+    with open(os.path.join(proj, "go.mod"), "w") as fh:
+        fh.write("module example.com/constructs\n\ngo 1.19\n")
+    with open(os.path.join(pkg, "kitchen.go"), "w") as fh:
+        fh.write(CONSTRUCTS_GO)
+    with open(os.path.join(pkg, "kitchen_test.go"), "w") as fh:
+        fh.write(CONSTRUCTS_TEST_GO)
+    return proj
+
+
+CONSTRUCTS_GO = '''package kitchen
+
+import "fmt"
+
+type Box struct {
+\tName  string
+\tCount int
+}
+
+func (b Box) Label() string {
+\treturn fmt.Sprintf("%s=%d", b.Name, b.Count)
+}
+
+func Sum(limit int) int {
+\ttotal := 0
+\tfor i := 0; i < limit; i++ {
+\t\tif i%3 == 0 {
+\t\t\tcontinue
+\t\t}
+\t\tif i > 7 {
+\t\t\tbreak
+\t\t}
+\t\ttotal += i
+\t}
+\treturn total
+}
+
+func Classify(values []int) map[string]int {
+\tout := map[string]int{"even": 0, "odd": 0}
+\tfor _, v := range values {
+\t\tswitch v % 2 {
+\t\tcase 0:
+\t\t\tout["even"]++
+\t\tdefault:
+\t\t\tout["odd"]++
+\t\t}
+\t}
+\treturn out
+}
+
+func Describe(value interface{}) string {
+\t// type switches stay at the closure tier (a deopt case)
+\tswitch v := value.(type) {
+\tcase string:
+\t\treturn "string:" + v
+\tdefault:
+\t\treturn fmt.Sprintf("other:%v", v)
+\t}
+}
+
+func Pairs(m map[string]string) (int, bool) {
+\tvalue, ok := m["key"]
+\tif !ok {
+\t\treturn 0, false
+\t}
+\treturn len(value), true
+}
+
+func Apply(fn func(int) int, values []int) []int {
+\tout := []int{}
+\tfor _, v := range values {
+\t\tout = append(out, fn(v))
+\t}
+\treturn out
+}
+
+func Deferred() string {
+\ttrace := ""
+\tdefer func() {
+\t\ttrace = trace + "!"
+\t}()
+\ttrace = trace + "body"
+\treturn trace
+}
+
+func Varied() (string, int, float64) {
+\tvar name string
+\tvar count, extra int
+\ts := "go"
+\tcount = len(s) + extra
+\tname = s + "!"
+\tvalue := 1.5
+\tvalue *= 2
+\tcount++
+\treturn name, count, value
+}
+
+func Build() []Box {
+\tboxes := []Box{{Name: "a", Count: 1}, {Name: "b", Count: 2}}
+\tlabels := map[string]string{"kind": "box", "tier": "test"}
+\tif labels["kind"] == "box" {
+\t\tboxes = append(boxes, Box{Name: labels["tier"], Count: 3})
+\t}
+\treturn boxes
+}
+'''
+
+CONSTRUCTS_TEST_GO = '''package kitchen
+
+import "testing"
+
+func TestSum(t *testing.T) {
+\tif Sum(100) != 19 {
+\t\tt.Errorf("Sum(100) = %d, want 19", Sum(100))
+\t}
+}
+
+func TestClassify(t *testing.T) {
+\tgot := Classify([]int{1, 2, 3, 4, 5})
+\tif got["even"] != 2 || got["odd"] != 3 {
+\t\tt.Errorf("Classify = %v", got)
+\t}
+}
+
+func TestDescribe(t *testing.T) {
+\tif Describe("x") != "string:x" {
+\t\tt.Errorf("Describe(string) = %s", Describe("x"))
+\t}
+\tif Describe(7) != "other:7" {
+\t\tt.Errorf("Describe(int) = %s", Describe(7))
+\t}
+}
+
+func TestPairs(t *testing.T) {
+\tn, ok := Pairs(map[string]string{"key": "val"})
+\tif !ok || n != 3 {
+\t\tt.Errorf("Pairs = %d %v", n, ok)
+\t}
+\tn, ok = Pairs(map[string]string{})
+\tif ok || n != 0 {
+\t\tt.Errorf("Pairs(empty) = %d %v", n, ok)
+\t}
+}
+
+func TestApply(t *testing.T) {
+\tdoubled := Apply(func(v int) int { return v * 2 }, []int{1, 2})
+\tif len(doubled) != 2 || doubled[0] != 2 || doubled[1] != 4 {
+\t\tt.Errorf("Apply = %v", doubled)
+\t}
+}
+
+func TestDeferred(t *testing.T) {
+\tif Deferred() != "body" {
+\t\tt.Errorf("Deferred = %s", Deferred())
+\t}
+}
+
+func TestVaried(t *testing.T) {
+\tname, count, value := Varied()
+\tif name != "go!" || count != 3 || value != 3.0 {
+\t\tt.Errorf("Varied = %s %d %v", name, count, value)
+\t}
+}
+
+func TestBuild(t *testing.T) {
+\tboxes := Build()
+\tif len(boxes) != 3 || boxes[2].Label() != "test=3" {
+\t\tt.Errorf("Build = %v", boxes)
+\t}
+}
+'''
+
+
+class TestTierIdentity:
+    def test_per_construct_reports_identical(self, tmp_path):
+        """Every supported construct (and the deopt shapes) must
+        report identically across the three tiers, with promotion
+        forced so each body exercises its ceiling."""
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_promote_after(0)
+        reference = None
+        for tier in TIERS:
+            compiler.set_mode(tier)
+            got = signature(run_project_tests(proj))
+            assert got, "no packages discovered"
+            assert all(code == 0 for _rel, code, *_r in got), got
+            if reference is None:
+                reference = got
+            assert got == reference, f"diverged under {tier}"
+        compiler.flush_counters()
+        counts = metrics.counters_snapshot()
+        assert counts.get("bytecode.executed", 0) > 0
+        assert counts.get("compile.promoted", 0) > 0
+
+    def test_matrix_cache_modes_and_workers(self, standalone, tmp_path):
+        """The reduced in-suite matrix (commit-check runs the full
+        27-leg one): three tiers × one leg per cache mode, including a
+        process-pool leg."""
+        from operator_forge.perf import workers
+
+        compiler.set_promote_after(0)
+        reference = None
+        saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+        try:
+            for cache_mode, backend, jobs in (
+                ("off", "thread", "1"),
+                ("mem", "thread", "8"),
+                ("disk", "process", "8"),
+            ):
+                for tier in TIERS:
+                    perfcache.configure(
+                        mode=cache_mode,
+                        root=str(tmp_path / f"cache-{tier}")
+                        if cache_mode == "disk" else None,
+                    )
+                    perfcache.reset()
+                    compiler.set_mode(tier)
+                    workers.set_backend(backend)
+                    os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                    got = signature(
+                        run_project_tests(standalone, include_e2e=True)
+                    )
+                    if reference is None:
+                        reference = got
+                    assert got == reference, (
+                        f"tier={tier} cache={cache_mode} "
+                        f"workers={backend} diverged"
+                    )
+        finally:
+            workers.set_backend(None)
+            if saved_jobs is None:
+                os.environ.pop("OPERATOR_FORGE_JOBS", None)
+            else:
+                os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+
+    def test_seeded_break_killed_identically(self, standalone, tmp_path):
+        """A seeded logic regression (the mutation battery's shape)
+        must fail with the same test and message under every tier —
+        the bytecode tier cannot mask a real bug."""
+        proj = str(tmp_path / "broken")
+        shutil.copytree(standalone, proj)
+        path = os.path.join(proj, "pkg", "orchestrate", "ready.go")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                "return readyReplicas >= specReplicas, nil",
+                "return readyReplicas > specReplicas, nil",
+            ))
+        perfcache.configure(mode="off")
+        compiler.set_promote_after(0)
+        reports = {}
+        for tier in TIERS:
+            compiler.set_mode(tier)
+            reports[tier] = signature(run_project_tests(proj))
+        assert reports["walk"] == reports["compile"] == reports["bytecode"]
+        assert any(code == 1 for _rel, code, *_r in reports["bytecode"])
+
+    def test_channels_fail_identically(self, tmp_path):
+        """Out-of-subset user code (channels) surfaces the same
+        per-package error in all three tiers — bytecode deopts to the
+        closure tier, which deopts to walk."""
+        pkg = tmp_path / "chanproj" / "pkg" / "thing"
+        pkg.mkdir(parents=True)
+        (tmp_path / "chanproj" / "go.mod").write_text(
+            "module example.com/chanproj\n\ngo 1.19\n"
+        )
+        (pkg / "thing.go").write_text(
+            "package thing\n\n"
+            "func Pump() int {\n"
+            "\tch := make(chan int, 1)\n"
+            "\tch <- 1\n"
+            "\treturn <-ch\n"
+            "}\n"
+        )
+        (pkg / "thing_test.go").write_text(
+            "package thing\n\nimport \"testing\"\n\n"
+            "func TestPump(t *testing.T) {\n"
+            "\tif Pump() != 1 {\n"
+            "\t\tt.Errorf(\"pump\")\n"
+            "\t}\n"
+            "}\n"
+        )
+        perfcache.configure(mode="off")
+        compiler.set_promote_after(0)
+        reference = None
+        for tier in TIERS:
+            compiler.set_mode(tier)
+            got = signature(run_project_tests(str(tmp_path / "chanproj")))
+            if reference is None:
+                reference = got
+            assert got == reference, f"diverged under {tier}"
+
+
+class TestPromotionProfile:
+    def test_promote_threshold_honored(self, tmp_path):
+        """With a high threshold no body reaches the bytecode tier;
+        with threshold 0 every lowered body does."""
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(10_000)
+        before = metrics.counters_snapshot()
+        run_project_tests(proj)
+        compiler.flush_counters()
+        after = metrics.counters_snapshot()
+        assert after.get("compile.promoted", 0) == before.get(
+            "compile.promoted", 0
+        )
+        perfcache.reset()  # clears the registries and the profile
+        compiler.set_promote_after(0)
+        run_project_tests(proj)
+        compiler.flush_counters()
+        final = metrics.counters_snapshot()
+        assert final.get("compile.promoted", 0) > 0
+        assert final.get("bytecode.executed", 0) > 0
+
+    def test_compile_ceiling_never_builds_bytecode(self, tmp_path):
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("compile")
+        compiler.set_promote_after(0)
+        before = metrics.counters_snapshot()
+        run_project_tests(proj)
+        compiler.flush_counters()
+        after = metrics.counters_snapshot()
+        assert after.get("bytecode.executed", 0) == before.get(
+            "bytecode.executed", 0
+        )
+        assert after.get("compile.promoted", 0) == before.get(
+            "compile.promoted", 0
+        )
+
+    def test_deopt_counted_for_out_of_subset_bodies(self, tmp_path):
+        """Type-switch bodies stay at the closure tier and count as
+        deopts (never retried)."""
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        before = metrics.counters_snapshot()
+        got = signature(run_project_tests(proj))
+        assert all(code == 0 for _rel, code, *_r in got)
+        compiler.flush_counters()
+        after = metrics.counters_snapshot()
+        assert after.get("bytecode.deopt", 0) > before.get(
+            "bytecode.deopt", 0
+        )
+
+    def test_tier_report_surfaces_counters(self, tmp_path):
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        run_project_tests(proj)
+        report = metrics.tier_report()
+        assert report["mode"] == "bytecode"
+        assert report["bytecode.executed"] > 0
+        assert report["compile.promoted"] > 0
+
+    def test_serve_stats_exposes_tiers(self, tmp_path):
+        from operator_forge.serve.server import _handle
+
+        payload, keep = _handle({"op": "stats"}, str(tmp_path))
+        assert keep is True
+        assert "tiers" in payload
+        assert payload["tiers"]["mode"] in TIERS
+
+
+class TestCrossProcessHydration:
+    def test_programs_hydrate_without_relowering(
+        self, standalone, tmp_path
+    ):
+        """A bytecode run persists Programs into the gocheck.lower
+        manifests; after the in-process state is dropped (the cold-
+        process simulation), the next run reconstitutes executable
+        programs from the disk tier — compile.hydrated counts them,
+        nothing is re-lowered or re-promoted, and the report matches
+        the cache-off reference."""
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        run_project_tests(standalone, include_e2e=True)
+
+        perfcache.configure(mode="off")
+        reference = signature(run_project_tests(standalone))
+
+        # back to the populated disk tier, with a cold process's state:
+        # the include_e2e flag differs from the priming run, so the
+        # whole-report replay misses and suites actually execute
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        gcache._reset_identity()
+        before = metrics.counters_snapshot()
+        got = signature(run_project_tests(standalone))
+        compiler.flush_counters()
+        after = metrics.counters_snapshot()
+        delta = {
+            key: after.get(key, 0) - before.get(key, 0)
+            for key in ("compile.hydrated", "compile.promoted",
+                        "compile.lowered", "bytecode.executed")
+        }
+        assert got == reference, "hydrated run diverged"
+        assert delta["compile.hydrated"] > 0
+        assert delta["bytecode.executed"] > 0
+        assert delta["compile.promoted"] == 0
+        assert delta["compile.lowered"] == 0
+
+    def test_manifest_entries_carry_programs(self, tmp_path):
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        run_project_tests(proj)
+        compiler.flush_lowered()
+        cache = perfcache.get_cache()
+        found_program = 0
+        for sha in list(compiler._lowered_spans):
+            manifest = cache.get(
+                compiler._LOWER_STAGE, compiler._lower_key(sha)
+            )
+            if manifest is perfcache.MISS:
+                continue
+            for entry in manifest:
+                (lo, hi), prog = entry
+                assert isinstance(lo, int) and isinstance(hi, int)
+                if prog is not None:
+                    assert isinstance(prog, bytecode.Program)
+                    found_program += 1
+        assert found_program > 0, "no Programs persisted in manifests"
+
+    def test_program_pickle_roundtrip(self, tmp_path):
+        import pickle
+
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        run_project_tests(proj)
+        programs = [
+            prog
+            for per_sha in compiler._bc_programs.values()
+            for prog in per_sha.values()
+        ]
+        assert programs, "nothing promoted"
+        for prog in programs:
+            clone = pickle.loads(pickle.dumps(prog, 5))
+            assert clone == prog
+            assert clone._runner is None and clone._steps is None
+
+
+class TestExecutionBackends:
+    def test_threaded_matches_ladder(self, tmp_path):
+        """The threaded-step backend and the reference dispatch ladder
+        must execute every promoted program identically (same reports
+        over the construct corpus)."""
+        proj = write_construct_project(tmp_path)
+        perfcache.configure(mode="off")
+        compiler.set_mode("bytecode")
+        compiler.set_promote_after(0)
+        threaded = signature(run_project_tests(proj))
+        original = bytecode.execute
+
+        def ladder_execute(prog, ev, env):
+            return bytecode._execute_ladder(prog, ev, env)
+
+        bytecode.execute = ladder_execute
+        try:
+            perfcache.reset()
+            laddered = signature(run_project_tests(proj))
+        finally:
+            bytecode.execute = original
+        assert laddered == threaded
+
+
+class TestVectorizedLexer:
+    def test_corpus_token_streams_identical(self, standalone):
+        for dirpath, _dirnames, filenames in os.walk(standalone):
+            for name in sorted(filenames):
+                if not name.endswith(".go"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+                fast = gotokens.tokenize(text, path)
+                slow = gotokens._tokenize_scalar(text, path)
+                assert [
+                    (t.kind, t.value, t.line, t.col) for t in fast
+                ] == [
+                    (t.kind, t.value, t.line, t.col) for t in slow
+                ], path
+
+    @pytest.mark.parametrize("src", [
+        "x := 0x1p-2\n", "y := .5e+10i\n", "a := 1_000_000\n",
+        "b := 0b1010\n", "c := 0o777\n", "s := `raw\nmulti`\n",
+        's := "esc\\"q"\n', "r := '\\n'\n", "z := 5.\n",
+        "w := 5...\n", "v := x../*c*/y\n", "/* multi\nline */x\n",
+        "// trailing comment", "x // trailing comment",
+        "a<<=2\n&^=\n...\n<-\n", "x\n", "", "\n\n",
+        "p\u00e9ch\u00e9 := 1\n",  # non-ASCII: the scalar path, twice
+    ])
+    def test_tricky_shapes_identical(self, src):
+        fast = gotokens.tokenize(src)
+        slow = gotokens._tokenize_scalar(src)
+        assert [
+            (t.kind, t.value, t.line, t.col) for t in fast
+        ] == [(t.kind, t.value, t.line, t.col) for t in slow]
+
+    @pytest.mark.parametrize("src", [
+        "x := 0x\n", "x := 1e\n", "x := 1e+\n", "x := 0b2\n",
+        "x := 0x1.5\n", 's := "unterminated\n', 's := "unterminated',
+        "s := `unterminated", "r := '\\\n'\n", "/* unterminated",
+        "@\n", 'x := "a\\',
+    ])
+    def test_errors_identical(self, src):
+        fast = slow = None
+        with pytest.raises(gotokens.GoTokenError) as err_fast:
+            gotokens.tokenize(src)
+        fast = str(err_fast.value)
+        with pytest.raises(gotokens.GoTokenError) as err_slow:
+            gotokens._tokenize_scalar(src)
+        slow = str(err_slow.value)
+        assert fast == slow
+
+
+class TestMonorepoLite:
+    def test_deterministic_and_generable(self, tmp_path):
+        from monorepo_lite import write_monorepo_lite
+
+        config = write_monorepo_lite(str(tmp_path / "a"), workloads=5)
+        config2 = write_monorepo_lite(str(tmp_path / "b"), workloads=5)
+        for name in sorted(os.listdir(tmp_path / "a")):
+            with open(tmp_path / "a" / name) as fh_a, open(
+                tmp_path / "b" / name
+            ) as fh_b:
+                assert fh_a.read() == fh_b.read(), name
+        out = str(tmp_path / "proj")
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert cli_main([
+                "init", "--workload-config", config,
+                "--repo", "github.com/acme/mono", "--output-dir", out,
+            ]) == 0
+            assert cli_main([
+                "create", "api", "--workload-config", config,
+                "--output-dir", out,
+            ]) == 0
+        assert os.path.isfile(os.path.join(out, "go.mod"))
+        # the fixture family scales: 4 components -> 4 component APIs
+        apis = os.listdir(os.path.join(out, "apis", "mono", "v1alpha1"))
+        assert len([n for n in apis if n.endswith("_types.go")]) >= 4
+        assert config2  # both trees written

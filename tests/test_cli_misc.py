@@ -586,6 +586,22 @@ class TestBench:
         for mode_ok in batch["identity_by_cache_mode"].values():
             assert mode_ok is True
         assert batch["stages_cold_serial"]
+        # ... and the execution-tier ladder (PR 11): per-tier warm
+        # check execution with the ≥3x bytecode-vs-walk bar, the
+        # monorepo-lite cold leg, tier counters, and the lexer
+        # microbench
+        tiered = detail["tiered"]
+        assert tiered["identity"] is True
+        assert tiered["monorepo_lite"]["identity"] is True
+        assert tiered["bytecode_vs_walk"] >= 3
+        assert set(tiered["kitchen_sink_warm_exec_cpu_s"]) == {
+            "walk", "compile", "bytecode",
+        }
+        assert tiered["tier_counters_bytecode_leg"][
+            "bytecode.executed"
+        ] > 0
+        assert tiered["monorepo_lite"]["cold_check_cpu_s"]["walk"] > 0
+        assert tiered["lex"]["speedup"] > 0
 
 
 class TestEdit:

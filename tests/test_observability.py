@@ -322,7 +322,10 @@ class TestMetrics:
         metrics.counter("obs.cli").inc()
         assert cli_main(["stats", "--json"]) == 0
         report = json.loads(capsys.readouterr().out)
-        assert list(report) == ["cache", "graph", "metrics", "spans"]
+        assert list(report) == ["cache", "graph", "metrics", "spans",
+                                "tiers"]
+        assert report["tiers"]["mode"] in (None, "walk", "compile",
+                                           "bytecode")
         assert list(report["graph"]) == ["dirty", "reused", "recomputed"]
         assert report["metrics"]["counters"]["obs.cli"] == 1
         assert list(report["cache"]) == sorted(report["cache"])
