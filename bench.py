@@ -160,9 +160,11 @@ def _phase_summary(cpu_runs, wall_runs, loc) -> dict:
 
 def _result_signature(results) -> list:
     """Comparable essence of a run_project_tests report (timings are
-    measurement noise, everything else must be identical)."""
+    measurement noise, everything else — goroutine-leak sweep lines
+    included — must be identical)."""
     return [
-        (r.rel, r.code, r.ran, r.failures, r.skipped, r.error)
+        (r.rel, r.code, r.ran, r.failures, r.skipped, r.error,
+         getattr(r, "leaks", []))
         for r in results
     ]
 
@@ -490,6 +492,253 @@ def tiered_section(tmp: str, steady_tree: str) -> dict:
         "monorepo-lite cold = empty-cache run_project_tests where "
         "lowering dominates; bytecode ≥3x walk enforced on the warm "
         "leg",
+    }
+
+
+CONCURRENCY_STORM_TEST_GO = '''package orchestrate
+
+import (
+	"sync"
+	"testing"
+	"time"
+
+	"k8s.io/client-go/util/workqueue"
+)
+
+func TestReconcileStorm(t *testing.T) {
+	queue := make(chan string, 64)
+	stop := make(chan struct{})
+	var wg sync.WaitGroup
+	var mu sync.Mutex
+	state := map[string]string{}
+	for w := 0; w < 3; w++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			for {
+				select {
+				case key, ok := <-queue:
+					if !ok {
+						return
+					}
+					mu.Lock()
+					state[key] = "reconciled"
+					mu.Unlock()
+				case <-stop:
+					return
+				}
+			}
+		}()
+	}
+	names := []string{"obj-0", "obj-1", "obj-2", "obj-3"}
+	for round := 0; round < 4; round++ {
+		for _, name := range names {
+			queue <- name
+		}
+	}
+	time.Sleep(time.Second)
+	close(queue)
+	wg.Wait()
+	close(stop)
+	reconciled := 0
+	for _, s := range state {
+		if s == "reconciled" {
+			reconciled = reconciled + 1
+		}
+	}
+	if reconciled != 4 {
+		t.Fatalf("storm converged to %d reconciled, want 4", reconciled)
+	}
+}
+
+func TestWorkqueueDrain(t *testing.T) {
+	q := workqueue.New()
+	var wg sync.WaitGroup
+	var mu sync.Mutex
+	total := 0
+	wg.Add(1)
+	go func() {
+		defer wg.Done()
+		for {
+			item, shutdown := q.Get()
+			if shutdown {
+				return
+			}
+			mu.Lock()
+			total = total + 1
+			mu.Unlock()
+			q.Done(item)
+		}
+	}()
+	q.Add("a")
+	q.Add("b")
+	time.Sleep(time.Second)
+	q.ShutDown()
+	wg.Wait()
+	if total != 2 {
+		t.Fatalf("workqueue drained %d of 2", total)
+	}
+}
+'''
+
+
+def concurrency_section(tmp: str, standalone_steady: str) -> dict:
+    """The deterministic concurrency runtime (PR 12): storm-suite
+    execution cold (channels/goroutines actually running) vs warm
+    (content-validated replay), the tier × cache × jobs identity
+    matrix for a fixed scheduling seed, verdict identity across
+    distinct seeds, chaos legs (``sched.preempt`` scheduler
+    preemptions) byte-identical to the fault-free reference, and the
+    <1% micro-guard: channel-free suites execute ZERO planted
+    scheduler sites, bounded here by the measured per-site cost at the
+    densest (storm) suite."""
+    from operator_forge.gocheck import compiler
+    from operator_forge.gocheck import interp as ginterp
+    from operator_forge.gocheck.world import run_project_tests
+    from operator_forge.perf import faults
+
+    proj = os.path.join(tmp, "conc-proj")
+    shutil.copytree(standalone_steady, proj)
+    with open(os.path.join(proj, "pkg", "orchestrate",
+                           "zz_storm_test.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(CONCURRENCY_STORM_TEST_GO)
+
+    signature = _result_signature  # one report-identity definition
+
+    def verdicts(sig):
+        return [
+            (rel, code, sorted(ran), failures, skipped, error)
+            for rel, code, ran, failures, skipped, error, _leaks in sig
+        ]
+
+    saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+    disk_root = tempfile.mkdtemp(prefix="operator-forge-concbench-")
+    cold_cpu, warm_cpu = [], []
+    try:
+        ginterp.set_seed(0)
+        compiler.set_mode("bytecode")
+        os.environ["OPERATOR_FORGE_JOBS"] = "1"
+        ginterp._op_tally[0] = 0
+        for _ in range(CHECK_RUNS):
+            pf_cache.reset()
+            start = time.process_time()
+            cold_results = run_project_tests(proj)
+            cold_cpu.append(time.process_time() - start)
+        ops_per_run = ginterp._op_tally[0] / max(CHECK_RUNS, 1)
+        for _ in range(CHECK_RUNS):
+            start = time.process_time()
+            warm_results = run_project_tests(proj)
+            warm_cpu.append(time.process_time() - start)
+        cold_sig = signature(cold_results)
+        identical = cold_sig == signature(warm_results)
+        storm_ran = any(
+            "TestReconcileStorm" in r.ran and "TestWorkqueueDrain" in (
+                r.ran
+            )
+            for r in cold_results
+        )
+        suite_green = all(
+            r.code == 0 for r in cold_results if not r.skipped
+        )
+
+        # identity matrix: tier × cache × jobs, fixed seed, every leg
+        # cleared so it executes (never replays another leg's report)
+        guards = {}
+        for cache_mode in GUARD_MODES:
+            signatures = []
+            for leg, (tier, jobs) in enumerate((
+                ("walk", "1"), ("compile", "8"),
+                ("bytecode", "1"), ("bytecode", "8"),
+            )):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(disk_root, f"leg{leg}")
+                    if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                compiler.set_mode(tier)
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                signatures.append(signature(run_project_tests(proj)))
+            guards[cache_mode] = all(
+                sig == cold_sig for sig in signatures
+            )
+
+        # schedule-independence: a different seed, identical verdicts
+        compiler.set_mode("bytecode")
+        os.environ["OPERATOR_FORGE_JOBS"] = "1"
+        pf_cache.configure(mode="off")
+        pf_cache.reset()
+        ginterp.set_seed(11)
+        seed_verdicts_identical = verdicts(
+            signature(run_project_tests(proj))
+        ) == verdicts(cold_sig)
+
+        # chaos: seeded scheduler preemptions — alternate schedule,
+        # byte-identical report (cache off so the leg EXECUTES)
+        ginterp.set_seed(0)
+        pf_cache.reset()
+        reference_off = signature(run_project_tests(proj))
+        faults.reset()
+        faults.configure(
+            "sched.preempt@chan.send:5,sched.preempt@chan.select:3,"
+            "sched.preempt@wg.wait:1,sched.preempt@workqueue.get:2"
+        )
+        try:
+            pf_cache.reset()
+            chaos_sig = signature(run_project_tests(proj))
+            chaos_fired = len(faults.fired())
+        finally:
+            faults.configure(None)
+        chaos_identical = chaos_sig == reference_off == cold_sig
+
+        # the micro-guard: per-call cost of a planted scheduler site
+        # with no chaos spec, scaled by the storm suite's own site
+        # count — channel-free suites execute zero sites, so this
+        # bounds their overhead from above
+        sched = ginterp.Scheduler(seed=0)
+        n = 200_000
+        start = time.perf_counter()
+        for _ in range(n):
+            sched.fault_point("chan.send")
+        per_call = (time.perf_counter() - start) / n
+        cold_med = statistics.median(cold_cpu)
+        estimated = per_call * ops_per_run
+        fraction = estimated / cold_med if cold_med > 0 else 0.0
+    finally:
+        compiler.set_mode(None)
+        ginterp.set_seed(None)
+        if saved_jobs is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+        pf_cache.configure(mode="mem")
+        shutil.rmtree(disk_root, ignore_errors=True)
+
+    warm_med = statistics.median(warm_cpu)
+    return {
+        "fixture": "standalone + storm suite",
+        "runs": CHECK_RUNS,
+        "cold_cpu_s_median": round(cold_med, 4),
+        "warm_cpu_s_median": round(warm_med, 4),
+        "warm_speedup": round(
+            cold_med / warm_med if warm_med > 0 else 0.0, 2
+        ),
+        "warm_matches_cold": identical,
+        "storm_suite_ran": storm_ran,
+        "suite_green": suite_green,
+        "identity_by_cache_mode": guards,
+        "seed_verdicts_identical": seed_verdicts_identical,
+        "chaos_identical": chaos_identical,
+        "chaos_faults_injected": chaos_fired,
+        "sched_sites_per_cold_run": round(ops_per_run, 1),
+        "site_per_call_ns": round(per_call * 1e9, 1),
+        "site_fraction_of_cold": round(fraction, 6),
+        "site_overhead_ok": fraction < 0.01,
+        "headline": "cold = the storm suite EXECUTING (goroutines, "
+        "channels, select, workqueue) under the seeded deterministic "
+        "scheduler; warm = content-validated replay; channel-free "
+        "suites hit zero planted scheduler sites",
     }
 
 
@@ -1958,6 +2207,12 @@ def main() -> None:
         # check, tier counters, and the vectorized-lexer microbench
         tiered = tiered_section(tmp, steady["kitchen-sink"])
 
+        # the deterministic concurrency runtime: storm-suite cold vs
+        # warm, tier × cache × jobs identity for a fixed seed,
+        # cross-seed verdict identity, scheduler-preemption chaos
+        # identity, and the planted-site <1% micro-guard
+        concurrency = concurrency_section(tmp, steady["standalone"])
+
         loc = sum(fixture_loc.values())
         summary = {
             phase: _phase_summary(cpu[phase], wall[phase], loc)
@@ -2020,6 +2275,7 @@ def main() -> None:
                 "remote": remote,
                 "daemon": daemon,
                 "tiered": tiered,
+                "concurrency": concurrency,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
                 "up to ~15% (host scheduling/steal), and the host itself "
@@ -2218,6 +2474,52 @@ def main() -> None:
             print(
                 "tier attribution guard FAILED: the bytecode leg "
                 "executed no bytecode programs",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            not concurrency["storm_suite_ran"]
+            or not concurrency["suite_green"]
+        ):
+            print(
+                "concurrency guard FAILED: the storm suite did not run "
+                "green under the deterministic scheduler",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            not concurrency["warm_matches_cold"]
+            or not all(concurrency["identity_by_cache_mode"].values())
+        ):
+            print(
+                "concurrency identity guard FAILED: storm-suite reports "
+                "diverged across tier/cache/jobs legs for a fixed seed",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not concurrency["seed_verdicts_identical"]:
+            print(
+                "concurrency seed guard FAILED: distinct scheduling "
+                "seeds produced different verdicts",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            not concurrency["chaos_identical"]
+            or concurrency["chaos_faults_injected"] <= 0
+        ):
+            print(
+                "concurrency chaos guard FAILED: scheduler-preemption "
+                "legs diverged from the fault-free reference (or "
+                "injected nothing)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not concurrency["site_overhead_ok"]:
+            print(
+                "concurrency overhead guard FAILED: planted scheduler "
+                "sites exceed 1%% of the storm-suite cold run "
+                "(channel-free suites execute zero sites)",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -780,6 +780,8 @@ def cmd_test(args: argparse.Namespace) -> int:
             print(f"  --- FAIL: {name}")
             for msg in messages:
                 print(f"      {msg}")
+        for leak in getattr(res, "leaks", ()):
+            print(f"  leak: {leak}")
     if failed or any(not res.ok and not res.skipped for res in results):
         print("test: FAIL", file=sys.stderr)
         return 1

@@ -796,7 +796,12 @@ def _execute_ladder(prog: Program, ev, env):
         elif op == OP_DEFER:
             ev.defers.append((regs[ins[1]], regs[ins[2]]))
         elif op == OP_GO:
-            ev.interp.sched.spawn(ev.interp, regs[ins[1]], regs[ins[2]])
+            ev.interp.sched.spawn(
+                ev.interp, regs[ins[1]], regs[ins[2]],
+                site=I._spawn_site(
+                    ev.scan, ins[3] if len(ins) > 3 else 0
+                ),
+            )
         elif op == OP_CALLARGS:
             regs[ins[1]] = _build_args(
                 _bind_parts(consts[ins[2]], consts), ev, regs, env
@@ -923,8 +928,31 @@ class _Lower:
         return Program(code, tuple(self.consts), max(self._maxreg, 1), out)
 
     def program(self, lo: int, hi: int) -> Program:
+        self._reject_concurrency(lo, hi)
         self.stmts(lo, hi)
         return self._finish(None)
+
+    def _reject_concurrency(self, lo: int, hi: int) -> None:
+        """Channel-bearing bodies stay at the closure tier: the
+        bytecode subset does not model send/receive/select/make(chan)/
+        close suspension points, and a silent mis-lowering (walk's old
+        junk tolerance would read ``ch <- v`` as just ``ch``) is the
+        one failure mode the deopt ladder exists to prevent."""
+        toks = self.toks
+        for j in range(lo, hi):
+            t = toks[j]
+            if t.kind == OP and t.value == "<-":
+                raise Unsupported("chan op")
+            if t.kind == KEYWORD and t.value in ("chan", "select"):
+                raise Unsupported(t.value)
+            if (
+                t.kind == IDENT
+                and t.value == "close"
+                and j + 1 < hi
+                and toks[j + 1].kind == OP
+                and toks[j + 1].value == "("
+            ):
+                raise Unsupported("close")
 
     def _sub_program(self, lo: int, hi: int) -> Program:
         """A statement sub-program (func-literal body) with its own
@@ -1090,7 +1118,13 @@ class _Lower:
             j -= 1
         rcallee = self.expr_root(i + 1, j)
         rargs = self._call_args(j + 1, close)
-        self.emit(OP_GO if is_go else OP_DEFER, rcallee, rargs)
+        if is_go:
+            # operand 3: the spawn line — the runner rebuilds the spawn
+            # site from the executing scan's path (programs are shared
+            # per content hash; paths must bind at run time)
+            self.emit(OP_GO, rcallee, rargs, toks[i].line)
+        else:
+            self.emit(OP_DEFER, rcallee, rargs)
         return end
 
     # -- control clauses --------------------------------------------------
@@ -3197,9 +3231,13 @@ def _f_defer(ins, consts, pc):
 @_op_factory(OP_GO)
 def _f_go(ins, consts, pc):
     rcallee, rargs, nxt = ins[1], ins[2], pc + 1
+    line = ins[3] if len(ins) > 3 else 0
 
     def step(ev, regs, frame):
-        ev.interp.sched.spawn(ev.interp, regs[rcallee], regs[rargs])
+        ev.interp.sched.spawn(
+            ev.interp, regs[rcallee], regs[rargs],
+            site=I._spawn_site(ev.scan, line),
+        )
         return nxt
     return step
 

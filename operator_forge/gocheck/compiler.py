@@ -532,6 +532,8 @@ class _Compiler:
                 return self._stmt_for(i, hi)
             if v == "switch":
                 return self._stmt_switch(i, hi)
+            if v == "select":
+                return self._stmt_select(i, hi)
             if v == "continue":
                 def s_continue(ev, env):
                     raise _Continue()
@@ -611,13 +613,28 @@ class _Compiler:
                 if depth == 0:
                     break
             j -= 1
-        callee_fn = self.expr(i + 1, j)
+        if j == i + 2 and toks[i + 1].kind == IDENT and (
+            toks[i + 1].value == "close"
+        ):
+            # `defer close(ch)` / `go close(ch)`: close is a builtin,
+            # not a resolvable name — suspend a native callable (walk
+            # parity)
+            def callee_fn(ev, env):
+                sched = ev.interp.sched
+                return lambda ch: I._chan_close(sched, ch)
+        else:
+            callee_fn = self.expr(i + 1, j)
         args_fn = self._call_args(j + 1, close)
         if is_go:
+            line = toks[i].line
+
             def s_go(ev, env):
                 callee = callee_fn(ev, env)
                 args = args_fn(ev, env)
-                ev.interp.sched.spawn(ev.interp, callee, args)
+                ev.interp.sched.spawn(
+                    ev.interp, callee, args,
+                    site=I._spawn_site(ev.scan, line),
+                )
             return s_go, end
 
         def s_defer(ev, env):
@@ -736,6 +753,24 @@ class _Compiler:
                 iterable = iter_fn(ev, env)
                 if iterable is None:
                     iterable = []
+                if isinstance(iterable, I.GoChan):
+                    # `for v := range ch`: receive until closed (the
+                    # single name binds the VALUE, like Go)
+                    sched = ev.interp.sched
+                    while True:
+                        value, ok = I._chan_recv(sched, iterable)
+                        if not ok:
+                            break
+                        scope = _Env(env)
+                        if name0 is not None:
+                            scope.define(name0, value)
+                        try:
+                            body(ev, scope)
+                        except _Break:
+                            break
+                        except _Continue:
+                            continue
+                    return
                 seq = (
                     list(iterable.items()) if isinstance(iterable, dict)
                     else list(enumerate(iterable))
@@ -913,6 +948,104 @@ class _Compiler:
                     pass
         return s_switch, bhi + 1
 
+    def _stmt_select(self, i: int, hi: int):
+        """Compiled ``select``: case headers are parsed statically (op
+        kind, bind names, channel/value expressions); at runtime the
+        channel operands evaluate once in source order and the
+        scheduler's :func:`~operator_forge.gocheck.interp._select_run`
+        picks — byte-identical behavior to walk."""
+        toks = self.toks
+        j = i + 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "{"):
+            raise _CompileError("select clause")
+        blo, bhi = I._group_span(toks, j)
+        line = toks[i].line
+        compiled_cases = []   # (kind, ch_fn, value_fn, names, bind_op, body)
+        default_run = None
+        for exprs, slo, shi in self._switch_clauses(blo, bhi):
+            if exprs is None:
+                default_run = self.block(slo, shi)
+                continue
+            compiled_cases.append(
+                self._compile_select_case(exprs[0], exprs[1])
+                + (self.block(slo, shi),)
+            )
+
+        def s_select(ev, env):
+            site = I._spawn_site(ev.scan, line)
+            cases = []
+            for kind, ch_fn, value_fn, _names, _op, _body in (
+                compiled_cases
+            ):
+                ch = ch_fn(ev, env)
+                if kind == "recv":
+                    cases.append(("recv", ch))
+                else:
+                    cases.append(("send", ch, value_fn(ev, env)))
+            out_kind, idx, value, ok = I._select_run(
+                ev.interp.sched, cases, default_run is not None, site
+            )
+            scope = _Env(env)
+            if out_kind == "default":
+                body = default_run
+            else:
+                _kind, _ch_fn, _value_fn, names, bind_op, body = (
+                    compiled_cases[idx]
+                )
+                if names:
+                    for name, v in zip(names, (value, ok)):
+                        if bind_op == ":=":
+                            scope.define(name, v)
+                        else:
+                            ev._write_target(("name", name), v, scope)
+            try:
+                body(ev, scope)
+            except _Break:
+                pass
+        return s_select, bhi + 1
+
+    def _compile_select_case(self, lo: int, hi: int):
+        """Static mirror of walk's _select_case parse."""
+        toks = self.toks
+        depth = 0
+        arrow = None
+        bind = None
+        bind_op = None
+        for j in range(lo, hi):
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif depth == 0 and t.value == "<-" and arrow is None:
+                    arrow = j
+                elif depth == 0 and t.value in (":=", "=") and (
+                    bind is None
+                ):
+                    bind = j
+                    bind_op = t.value
+        if arrow is None:
+            raise _CompileError("select case")
+        if bind is not None and bind < arrow:
+            # plain-name targets only (walk parity: the fallback's walk
+            # execution raises the same unsupported-target error)
+            if any(
+                not (
+                    t.kind == IDENT
+                    or (t.kind == OP and t.value == ",")
+                )
+                for t in toks[lo:bind]
+            ):
+                raise _CompileError("select case target")
+            names = [t.value for t in toks[lo:bind] if t.kind == IDENT]
+            return ("recv", self.expr(arrow + 1, hi), None, names,
+                    bind_op)
+        if arrow == lo:
+            return ("recv", self.expr(arrow + 1, hi), None, [], None)
+        return ("send", self.expr(lo, arrow), self.expr(arrow + 1, hi),
+                None, None)
+
     def _compile_type_switch(self, segments, brace, ts):
         toks = self.toks
         init_step = None
@@ -1026,6 +1159,7 @@ class _Compiler:
         depth = 0
         op_at = None
         op_val = None
+        arrow_at = None
         for j in range(i, end):
             t = toks[j]
             if t.kind == OP:
@@ -1033,6 +1167,8 @@ class _Compiler:
                     depth += 1
                 elif t.value in ")]}":
                     depth -= 1
+                elif depth == 0 and t.value == "<-" and arrow_at is None:
+                    arrow_at = j
                 elif depth == 0 and t.value in (
                     ":=", "=", "+=", "-=", "*=", "/=", "|=", "&=", "%=",
                 ):
@@ -1040,6 +1176,16 @@ class _Compiler:
                     op_val = t.value
                     break
         if op_at is None:
+            # `ch <- v`: a send statement (walk parity; an arrow at i
+            # is a bare receive expression statement)
+            if arrow_at is not None and arrow_at > i:
+                ch_fn = self.expr(i, arrow_at)
+                value_fn = self.expr(arrow_at + 1, end)
+
+                def s_send(ev, env):
+                    ch = ch_fn(ev, env)
+                    I._chan_send(ev.interp.sched, ch, value_fn(ev, env))
+                return s_send, end
             if (
                 end - 2 >= i
                 and toks[end - 1].kind == OP
@@ -1058,32 +1204,43 @@ class _Compiler:
             def s_expr(ev, env):
                 fn(ev, env)
             return s_expr, end
-        rhs_fns = [
-            self.expr(slo, shi)
-            for slo, shi in I._split_commas(toks, op_at + 1, end)
-        ]
+        rhs_spans = I._split_commas(toks, op_at + 1, end)
         target_cs = [
             self._compile_target(slo, shi)
             for slo, shi in I._split_commas(toks, i, op_at)
         ]
-        comma_ok = (
-            self._compile_comma_ok(op_at + 1, end)
-            if len(target_cs) == 2 else None
-        )
         n_targets = len(target_cs)
+        if (
+            len(rhs_spans) == 1
+            and n_targets == 2
+            and toks[rhs_spans[0][0]].kind == OP
+            and toks[rhs_spans[0][0]].value == "<-"
+        ):
+            # `v, ok := <-ch`: receive ONCE, yield the comma-ok pair
+            ch_fn = self.expr(rhs_spans[0][0] + 1, rhs_spans[0][1])
 
-        def eval_values(ev, env):
-            values = [fn(ev, env) for fn in rhs_fns]
-            if (
-                n_targets == 2
-                and len(values) == 1
-                and not isinstance(values[0], tuple)
-                and comma_ok is not None
-            ):
-                pair = comma_ok(ev, env)
-                if pair is not None:
-                    values = list(pair)
-            return _expand(values, n_targets)
+            def eval_values(ev, env):
+                ch = ch_fn(ev, env)
+                return list(I._chan_recv(ev.interp.sched, ch))
+        else:
+            rhs_fns = [self.expr(slo, shi) for slo, shi in rhs_spans]
+            comma_ok = (
+                self._compile_comma_ok(op_at + 1, end)
+                if n_targets == 2 else None
+            )
+
+            def eval_values(ev, env):
+                values = [fn(ev, env) for fn in rhs_fns]
+                if (
+                    n_targets == 2
+                    and len(values) == 1
+                    and not isinstance(values[0], tuple)
+                    and comma_ok is not None
+                ):
+                    pair = comma_ok(ev, env)
+                    if pair is not None:
+                        values = list(pair)
+                return _expand(values, n_targets)
 
         if op_val == ":=":
             def s_define(ev, env):
@@ -1273,6 +1430,13 @@ class _Compiler:
         toks = self.toks
         t = toks[lo]
         if t.kind == OP:
+            if t.value == "<-":
+                sub_fn, pos = self.unary(lo + 1, hi)
+
+                def run_recv(ev, env):
+                    ch = sub_fn(ev, env)
+                    return I._chan_recv(ev.interp.sched, ch)[0]
+                return run_recv, pos
             if t.value == "!":
                 sub_fn, pos = self.unary(lo + 1, hi)
 
@@ -1515,16 +1679,27 @@ class _Compiler:
         )
         if has_call and name in (
             "len", "cap", "append", "panic", "string", "new", "make",
+            "close",
         ) or (has_call and name in I._NUMERIC_CONVERSIONS):
             end = _bounded_group_end(toks, lo + 1, hi)
             glo, ghi = lo + 2, end - 1
             if name in ("len", "cap"):
                 arg_fn = self.expr(glo, ghi)
+                want_cap = name == "cap"
 
                 def run_len(ev, env):
                     arg = arg_fn(ev, env)
+                    if isinstance(arg, I.GoChan):
+                        return arg.capacity if want_cap else len(arg.buf)
                     return 0 if arg is None else len(arg)
                 return run_len, end
+            if name == "close":
+                arg_fn = self.expr(glo, ghi)
+
+                def run_close(ev, env):
+                    I._chan_close(ev.interp.sched, arg_fn(ev, env))
+                    return None
+                return run_close, end
             if name == "append":
                 args_fn = self._call_args(glo, ghi)
 
@@ -1575,6 +1750,21 @@ class _Compiler:
                 def run_make_map(ev, env):
                     return {}
                 return run_make_map, end
+            if (
+                glo < ghi
+                and toks[glo].kind == KEYWORD
+                and toks[glo].value == "chan"
+            ):
+                spans = I._split_commas(toks, glo, ghi)
+                cap_fn = (
+                    self.expr(spans[1][0], spans[1][1])
+                    if len(spans) > 1 else None
+                )
+
+                def run_make_chan(ev, env):
+                    capacity = 0 if cap_fn is None else cap_fn(ev, env)
+                    return I.GoChan(ev.interp.sched, capacity)
+                return run_make_chan, end
 
             def run_make_slice(ev, env):
                 return []

@@ -1,0 +1,283 @@
+"""Envtest-style in-process fake API server: deterministic event
+storms for emitted reconcile loops.
+
+The reference's CI runs the generated project's suites against a real
+envtest apiserver (sigs.k8s.io/controller-runtime/pkg/envtest) and the
+e2e suite against a kind cluster; the failure domain that setup
+actually exercises — concurrent event storms hitting a reconcile loop
+— was unreachable here until the interpreter could execute real
+concurrency.  With the deterministic scheduler in place
+(:class:`~operator_forge.gocheck.interp.Scheduler`), this module opens
+that scenario space:
+
+- :class:`StormRunner` — drives deterministic create/update/delete
+  bursts against an :class:`~operator_forge.gocheck.world.EnvtestWorld`
+  fake cluster, interleaved with reconcile pumping on the virtual
+  clock, recording a comparable journal.  One seed == one storm, byte
+  for byte.
+- :func:`maybe_conflict` — the ``envtest.conflict`` chaos site: a
+  client ``Update``/``Patch`` returns an apiserver optimistic-lock
+  conflict on the spec'd hit, exercising requeue-on-conflict; the
+  retry converges, so chaos reports stay byte-identical to fault-free
+  references (the PR 7 contract).
+- :func:`fire_storm` — the ``envtest.storm`` chaos site: the reconcile
+  pump injects a full resync (every live workload requeued) on the
+  spec'd hit; reconcilers are idempotent, so the report again must not
+  change.
+- :func:`_workqueue_module` — the ``k8s.io/client-go/util/workqueue``
+  surface (Add/Get/Done/ShutDown with client-go's dirty/processing
+  dedup), blocking through the deterministic scheduler, so emitted
+  worker loops run the real workqueue protocol.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from .interp import GoError, current_seed
+
+
+def conflict_error(kind: str, name: str) -> GoError:
+    """The apiserver's optimistic-concurrency failure, the shape
+    ``apierrors.IsConflict`` recognizes."""
+    err = GoError(
+        f'Operation cannot be fulfilled on {kind} "{name}": the object '
+        "has been modified; please apply your changes to the latest "
+        "version and try again"
+    )
+    err.conflict = True
+    return err
+
+
+def maybe_conflict(site: str, kind: str, name: str):
+    """Planted at the fake client's Update/Patch: when the chaos spec
+    names this hit (``envtest.conflict@envtest.update:n``), the write
+    is refused with a conflict — the reconciler's requeue path retries
+    and converges, keeping the final report byte-identical."""
+    from ..perf import faults
+
+    if faults.fire(site, "envtest.conflict"):
+        return conflict_error(kind, name)
+    return None
+
+
+def fire_storm(world) -> None:
+    """Planted at the reconcile pump: when the chaos spec names this
+    hit (``envtest.storm@envtest.pump:n``), every live workload is
+    requeued — a full informer resync storm.  Reconcilers are
+    idempotent, so the extra passes change nothing observable."""
+    from ..perf import faults
+
+    if faults.fire("envtest.pump", "envtest.storm"):
+        for (kind, ns, name) in list(world.client.workloads):
+            world.enqueue(kind, ns, name)
+
+
+class StormRunner:
+    """Deterministic create/update/delete bursts against one world.
+
+    The op sequence is a pure function of ``(seed, objects, rounds)``;
+    the scheduler's virtual clock paces the pump, so the journal — ops,
+    per-op errors, reconcile tallies, final cluster digest — is a
+    deterministic fingerprint suitable for byte-identity assertions
+    across tiers, cache modes, workers, and chaos specs."""
+
+    def __init__(self, world, seed: int | None = None):
+        self.world = world
+        self.seed = current_seed() if seed is None else int(seed)
+        self.journal: list = []
+        self.reconciles = 0  # informational; never part of identity
+
+    def _pump(self, ns: int) -> None:
+        self.world.runtime.sched.sleep(ns)
+
+    def run(self, sample_cr: dict, objects: int = 3,
+            rounds: int = 3) -> list:
+        """Drive the storm: a create burst, ``rounds`` seeded update
+        bursts (replica wobble), a delete burst, then drain.  Returns
+        the journal."""
+        import random
+
+        from .world import EnvtestWorld
+
+        assert isinstance(self.world, EnvtestWorld)
+        rng = random.Random(self.seed * 1000003 + 17)
+        client = self.world.client
+        runtime = self.world.runtime
+        second = 1000 * 1000 * 1000
+        names = [f"storm-{i}" for i in range(objects)]
+
+        def note(op, name, err):
+            self.journal.append(
+                (op, name, err.msg if isinstance(err, GoError) else None)
+            )
+
+        def retry_on_conflict(fn):
+            # client-go's retry.RetryOnConflict: an optimistic-lock
+            # refusal is re-issued, so an injected `envtest.conflict`
+            # converges and the journal stays byte-identical to the
+            # fault-free reference (the PR 7 chaos contract)
+            err = fn()
+            for _attempt in range(5):
+                if not (
+                    isinstance(err, GoError)
+                    and getattr(err, "conflict", False)
+                ):
+                    return err
+                err = fn()
+            return err
+
+        created = {}
+        for name in names:
+            cr = copy.deepcopy(sample_cr)
+            cr.setdefault("metadata", {})["name"] = name
+            obj = runtime.decode_cr(cr)
+            note("create", name, client.Create(None, obj))
+            created[name] = obj
+        self._pump(2 * second)
+
+        for _round in range(rounds):
+            for name in names:
+                obj = created[name]
+                spec = obj.fields.get("Spec")
+                if spec is not None and hasattr(spec, "fields"):
+                    for field in spec.fields.values():
+                        if hasattr(field, "fields") and (
+                            "Replicas" in field.fields
+                        ):
+                            field.fields["Replicas"] = rng.randrange(1, 5)
+                            break
+                note(
+                    "update", name,
+                    retry_on_conflict(lambda o=obj: client.Update(None, o)),
+                )
+            self._pump(2 * second)
+
+        for name in names:
+            note("delete", name, client.Delete(None, created[name]))
+        self._pump(3 * second)
+
+        # convergent final state only: requeue storms and conflict
+        # retries change HOW the cluster got here, never what is here
+        self.journal.append(("children", sorted(client.children)))
+        self.journal.append(("workloads", sorted(client.workloads)))
+        for key in sorted(client.workloads):
+            status = client.workloads[key].fields.get("Status")
+            created_flag = (
+                status.fields.get("Created")
+                if status is not None and hasattr(status, "fields")
+                else None
+            )
+            self.journal.append(("status", key, created_flag))
+        self.reconciles = len(self.world.reconcile_log)
+        return self.journal
+
+
+# ---------------------------------------------------------------------------
+# k8s.io/client-go/util/workqueue
+
+
+def _workqueue_module(sched):
+    """The workqueue surface emitted worker loops touch, with
+    client-go's exact dedup protocol (dirty/processing sets: an Add
+    while processing re-queues at Done) and scheduler-blocking Get."""
+
+    class _Queue:
+        def __init__(self, name: str = ""):
+            self.name = name
+            self.queue: list = []
+            self.dirty: set = set()
+            self.processing: set = set()
+            self.shutting = False
+            self.waiters: list = []
+
+        # -- client-go Interface ----------------------------------------
+
+        def Add(self, item):
+            if self.shutting:
+                return None
+            if item in self.dirty:
+                return None
+            self.dirty.add(item)
+            if item in self.processing:
+                return None
+            self.queue.append(item)
+            if self.waiters:
+                sched.unblock(self.waiters.pop(0))
+                sched.progress()
+            return None
+
+        def Len(self):
+            return len(self.queue)
+
+        def Get(self):
+            sched.fault_point("workqueue.get")
+            while not self.queue:
+                if self.shutting:
+                    return (None, True)
+                self.waiters.append(sched.current)
+                sched.block("workqueue get")
+            item = self.queue.pop(0)
+            self.processing.add(item)
+            self.dirty.discard(item)
+            return (item, False)
+
+        def Done(self, item):
+            self.processing.discard(item)
+            if item in self.dirty and item not in self.queue:
+                self.queue.append(item)
+                if self.waiters:
+                    sched.unblock(self.waiters.pop(0))
+                    sched.progress()
+            return None
+
+        def ShutDown(self):
+            self.shutting = True
+            for w in self.waiters:
+                sched.unblock(w)
+            self.waiters.clear()
+            sched.progress()
+            return None
+
+        def ShuttingDown(self):
+            return self.shutting
+
+        # -- rate-limiting veneer (deterministic: no real clocks) -------
+
+        def AddRateLimited(self, item):
+            return self.Add(item)
+
+        def AddAfter(self, item, duration):
+            return self.Add(item)
+
+        def Forget(self, item):
+            return None
+
+        def NumRequeues(self, item):
+            return 0
+
+    class _Module:
+        Interface = _Queue
+        RateLimitingInterface = _Queue
+
+        @staticmethod
+        def New():
+            return _Queue()
+
+        @staticmethod
+        def NewNamed(name):
+            return _Queue(name)
+
+        @staticmethod
+        def NewRateLimitingQueue(rate_limiter=None):
+            return _Queue()
+
+        @staticmethod
+        def NewRateLimitingQueueWithConfig(rate_limiter=None, config=None):
+            return _Queue()
+
+        @staticmethod
+        def DefaultControllerRateLimiter():
+            return "workqueue.DefaultControllerRateLimiter"
+
+    return _Module()

@@ -643,6 +643,12 @@ class _ApiErrorsModule:
             err, "already_exists", False
         )
 
+    @staticmethod
+    def IsConflict(err):
+        return isinstance(err, GoError) and getattr(
+            err, "conflict", False
+        )
+
 
 def _meta_carrier(obj):
     """The value carrying an object's metav1 accessors: the object
@@ -834,32 +840,910 @@ class _ContextModule:
         return (ctx, cancel)
 
 
+class GoroutineExit(BaseException):
+    """Internal: unwinds a killed (leaked/abandoned) goroutine's thread
+    without running interpreted code.  Derives BaseException and is
+    re-raised verbatim by the call machinery, so defers do NOT run —
+    matching Go, where leaked goroutines never unwind at process
+    exit."""
+
+
+class GoDeadlock(GoInterpError):
+    """All goroutines asleep — the Go runtime's fatal deadlock, as a
+    deterministic diagnostic naming every blocked goroutine, its block
+    reason, and its spawn site."""
+
+
+_forced_seed = [None]
+
+
+def current_seed() -> int:
+    """The scheduling seed: ``OPERATOR_FORGE_GOCHECK_SEED`` (default 0,
+    the canonical FIFO schedule), overridable programmatically for the
+    identity matrices via :func:`set_seed`.  One seed == one canonical
+    schedule; distinct seeds must produce identical *verdicts* for any
+    correctly synchronized suite."""
+    if _forced_seed[0] is not None:
+        return _forced_seed[0]
+    import os as _os
+
+    raw = _os.environ.get("OPERATOR_FORGE_GOCHECK_SEED", "").strip()
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def set_seed(value=None) -> None:
+    """Programmatic seed override (``None`` restores env selection)."""
+    _forced_seed[0] = None if value is None else int(value)
+
+
+def _spawn_site(scan, line) -> str:
+    """Deterministic spawn-site label: the file's base name plus the
+    ``go`` statement's line.  Base name, not the full path, so reports
+    stay byte-identical across scratch directories and cache replays."""
+    import os as _os
+
+    path = getattr(scan, "path", None) or "<go>"
+    return f"{_os.path.basename(path)}:{line}"
+
+
+class _Goroutine:
+    """One flow of an interpreted program.  Goroutine 0 ("main") is
+    whatever harness thread called into the interpreter; spawned
+    goroutines each park on a daemon thread and run only when the
+    scheduler hands them the single execution token."""
+
+    __slots__ = (
+        "gid", "site", "callee", "args", "interp", "event", "thread",
+        "state", "reason", "killed", "wake_error", "send_value",
+        "send_done", "recv_box", "select_token",
+    )
+
+    def __init__(self, gid, site, callee=None, args=None, interp=None):
+        import threading
+
+        self.gid = gid
+        self.site = site
+        self.callee = callee
+        self.args = args
+        self.interp = interp
+        self.event = threading.Event()
+        self.thread = None
+        self.state = "runnable"   # runnable | running | blocked | done
+        self.reason = None
+        self.killed = False
+        self.wake_error = None
+        self.send_value = None
+        self.send_done = False
+        self.recv_box = None
+        self.select_token = None
+
+
+#: consecutive select-default spins (with no scheduler progress in
+#: between) before the busy-loop diagnostic fires
+_SPIN_LIMIT = 4096
+
+#: lock-free tally of planted scheduler fault sites hit (bench's
+#: overhead micro-guard reads and zeroes it; same acceptable-race
+#: visibility contract as compiler._reused_pending)
+_op_tally = [0]
+
+
 class Scheduler:
-    """Cooperative concurrency for one interpreted program: a fake
-    monotonic clock plus a run queue for ``go`` statements.  Goroutines
-    run when the current flow yields (``time.Sleep``); after the queue
-    drains, registered hooks fire — the envtest-world fake uses one to
-    pump reconcile requests, playing the role controller-runtime's
-    workqueue threads play under a real ``mgr.Start``."""
+    """Deterministic cooperative concurrency for one interpreted
+    program: a fake monotonic clock, real suspendable goroutines (one
+    parked daemon thread each, exactly one running at a time), and a
+    seeded scheduler (``OPERATOR_FORGE_GOCHECK_SEED``) that picks the
+    next runnable flow — seed 0 is strict FIFO round-robin, any other
+    seed drives a seeded RNG, and either way one seed means one
+    canonical schedule, byte for byte.
 
-    def __init__(self):
+    Blocked-goroutine bookkeeping gives deadlock detection for free
+    (:class:`GoDeadlock` lists every sleeper with its block reason and
+    spawn site) and the end-of-suite :meth:`sweep` reports goroutine
+    leaks with their spawn sites.  Registered hooks fire at every
+    yield point — the envtest-world fake uses one to pump reconcile
+    requests, playing the role controller-runtime's workqueue threads
+    play under a real ``mgr.Start``."""
+
+    def __init__(self, seed=None):
+        import random
+
         self.now_ns = 0
-        self.queue: list = []   # (interp, callee, args)
         self.hooks: list = []   # callables(scheduler)
+        self.seed = current_seed() if seed is None else int(seed)
+        self.rng = random.Random(self.seed) if self.seed else None
+        self.main = _Goroutine(0, "main")
+        self.main.state = "running"
+        self.current = self.main
+        self.goroutines: list = [self.main]
+        self.runq: list = []       # runnable goroutines, pick order
+        self.timers: list = []     # [due_ns, seq, GoChan]
+        self._timer_seq = 0
+        self.failures: list = []   # (spawn site, message)
+        self.spawned = 0
+        self.deadlocks = 0
+        self.leaked = 0
+        self._progress_tick = 0
+        self._spin: dict = {}      # select site -> (count, tick)
+        self._sweeping = False
 
-    def spawn(self, interp, callee, args):
-        self.queue.append((interp, callee, args))
+    # -- fault plumbing (sched.preempt) ---------------------------------
+
+    def fault_point(self, site: str) -> None:
+        """A planted ``sched.preempt`` site: when the chaos spec names
+        this hit, the current flow yields to the seeded pick — the
+        schedule changes, the report must not.  Channel-free suites
+        execute zero of these sites (the <1% micro-guard's premise)."""
+        from ..perf import faults
+
+        _op_tally[0] += 1
+        if faults.fire(site, "sched.preempt"):
+            self.yield_now()
+
+    def progress(self) -> None:
+        self._progress_tick += 1
+
+    # -- spawning --------------------------------------------------------
+
+    def spawn(self, interp, callee, args, site=None):
+        g = _Goroutine(
+            len(self.goroutines), site or "<go>", callee, list(args),
+            interp,
+        )
+        self.goroutines.append(g)
+        self.runq.append(g)
+        self.spawned += 1
+        from ..perf import metrics
+
+        metrics.counter("sched.goroutines").inc()
+        self.fault_point("go.spawn")
+        return g
+
+    def _dispatch(self, g: _Goroutine) -> None:
+        """Hand the execution token to *g* (starting its thread on
+        first dispatch).  The caller must have set ``self.current``."""
+        g.state = "running"
+        if g.thread is None and g is not self.main:
+            import threading
+
+            g.thread = threading.Thread(
+                target=self._thread_main, args=(g,),
+                name=f"goroutine-{g.gid}", daemon=True,
+            )
+            g.thread.start()
+        g.event.set()
+
+    def _thread_main(self, g: _Goroutine) -> None:
+        try:
+            self._park(g)
+        except GoroutineExit:
+            self._finish(g)
+            return
+        try:
+            g.interp.call_value(g.callee, *g.args)
+        except GoroutineExit:
+            pass
+        except GoPanic as exc:
+            self.failures.append((g.site, f"panic: {_go_repr(exc.value)}"))
+        except GoExit as exc:
+            self.failures.append((g.site, f"os.Exit({exc.code})"))
+        except Exception as exc:
+            self.failures.append((g.site, str(exc) or type(exc).__name__))
+        self._finish(g)
+
+    def _park(self, g: _Goroutine) -> None:
+        """Wait until another flow hands *g* the token; raises when the
+        wake carries a kill or a deliverable error (deadlock)."""
+        g.event.wait()
+        g.event.clear()
+        if g.killed:
+            raise GoroutineExit()
+        if g.wake_error is not None:
+            err, g.wake_error = g.wake_error, None
+            raise err
+
+    def _pick(self):
+        if not self.runq:
+            return None
+        idx = 0 if self.rng is None else self.rng.randrange(len(self.runq))
+        return self.runq.pop(idx)
+
+    def _finish(self, g: _Goroutine) -> None:
+        g.state = "done"
+        self.progress()
+        nxt = self._pick()
+        if nxt is None and not self._sweeping and (
+            self._fire_due_or_next_timer()
+        ):
+            nxt = self._pick()
+        if nxt is not None:
+            self.current = nxt
+            self._dispatch(nxt)
+            return
+        # nothing runnable: the main flow must be blocked (it cannot be
+        # running — g held the token).  During a sweep that is the
+        # expected handover; otherwise every live flow is asleep.
+        if self.main.state == "blocked":
+            if not self._sweeping:
+                self.main.wake_error = self._deadlock_error()
+            self.current = self.main
+            self.main.state = "running"
+            self.main.event.set()
+
+    # -- yielding and blocking -------------------------------------------
+
+    def yield_now(self) -> None:
+        """Cooperative yield: the current flow joins the run queue and
+        the seeded pick decides who goes next (round-robin at seed 0)."""
+        if not self.runq:
+            return
+        me = self.current
+        me.state = "runnable"
+        self.runq.append(me)
+        nxt = self._pick()
+        if nxt is me:
+            me.state = "running"
+            return
+        self.current = nxt
+        self._dispatch(nxt)
+        self._park(me)
+
+    def block(self, reason: str) -> None:
+        """Park the current flow until some other flow unblocks it.
+        With no runnable flow and no pending timer, every goroutine is
+        asleep: the deterministic deadlock diagnostic raises here."""
+        me = self.current
+        if me.killed:
+            raise GoroutineExit()
+        me.state = "blocked"
+        me.reason = reason
+        try:
+            while True:
+                if self.runq:
+                    nxt = self._pick()
+                    if nxt is me:
+                        return
+                    self.current = nxt
+                    self._dispatch(nxt)
+                    self._park(me)
+                    return
+                if self._fire_due_or_next_timer():
+                    if me.state != "blocked":
+                        # the timer delivery unblocked us; reclaim the
+                        # token (we are in the run queue)
+                        self.runq.remove(me)
+                        return
+                    continue
+                self._deadlock(me)
+        finally:
+            me.state = "running"
+            me.reason = None
+
+    def unblock(self, g: _Goroutine) -> None:
+        """Mark *g* runnable (idempotent: a select parked in several
+        queues may be woken through more than one of them)."""
+        if g.state == "blocked":
+            g.state = "runnable"
+            self.runq.append(g)
+            self.progress()
+
+    # -- deadlock / leak diagnostics -------------------------------------
+
+    def _blocked_goroutines(self) -> list:
+        return [
+            g for g in self.goroutines
+            if g.state == "blocked" and not g.killed
+        ]
+
+    def _deadlock_error(self) -> "GoDeadlock":
+        lines = ["fatal error: all goroutines are asleep - deadlock!"]
+        for g in self._blocked_goroutines():
+            where = "main" if g is self.main else f"spawned at {g.site}"
+            lines.append(
+                f"goroutine {g.gid} [{g.reason or 'blocked'}] {where}"
+            )
+        return GoDeadlock("\n".join(lines))
+
+    def _deadlock(self, me: _Goroutine):
+        self.deadlocks += 1
+        from ..perf import metrics
+
+        metrics.counter("sched.deadlocks").inc()
+        raise self._deadlock_error()
+
+    def note_select_spin(self, site: str) -> None:
+        """Called when a ``select`` takes its ``default`` branch: the
+        per-site counter resets whenever the scheduler makes progress,
+        so only a genuine busy loop — defaults spinning with nothing
+        else able to advance — trips the diagnostic."""
+        count, tick = self._spin.get(site, (0, self._progress_tick))
+        if tick != self._progress_tick:
+            count = 0
+        count += 1
+        self._spin[site] = (count, self._progress_tick)
+        if count > _SPIN_LIMIT:
+            raise GoInterpError(
+                f"select default busy loop at {site}: "
+                f"{_SPIN_LIMIT} consecutive default picks with no "
+                "scheduler progress"
+            )
+
+    def take_failures(self) -> list:
+        """Drain goroutine failures — each ``(spawn site, message)`` —
+        so the suite runner attributes them to the goroutine itself,
+        not to whatever test happened to hold the token."""
+        out, self.failures = self.failures, []
+        return out
+
+    def sweep(self) -> list:
+        """End-of-suite leak sweep: every goroutine still alive is
+        reported ``goroutine <gid> [<state/reason>] spawned at <site>``
+        and its thread is unwound (no defers, like Go's process exit).
+        Returns the deterministic leak report lines."""
+        leaked = [
+            g for g in self.goroutines
+            if g is not self.main and g.state != "done"
+        ]
+        reports = []
+        for g in leaked:
+            status = g.reason if g.state == "blocked" else g.state
+            reports.append(
+                f"goroutine {g.gid} [{status}] spawned at {g.site}"
+            )
+        if not leaked:
+            return reports
+        self.leaked += len(leaked)
+        from ..perf import metrics
+
+        metrics.counter("sched.leaked").inc(len(leaked))
+        self._sweeping = True
+        try:
+            # pull every leaked flow out of the run queue first, so a
+            # kill's handover can never dispatch another leaked flow
+            for g in leaked:
+                g.killed = True
+                if g in self.runq:
+                    self.runq.remove(g)
+            for g in leaked:
+                self._kill(g)
+        finally:
+            self._sweeping = False
+        return reports
+
+    def _kill(self, g: _Goroutine) -> None:
+        g.killed = True
+        if g in self.runq:
+            self.runq.remove(g)
+        if g.thread is None:
+            g.state = "done"
+            return
+        if g.state == "done":
+            return
+        # hand the dying thread the token so it unwinds synchronously;
+        # _finish returns the token here (main parks as "blocked")
+        me = self.current
+        me.state = "blocked"
+        me.reason = "sweep"
+        self.current = g
+        g.event.set()
+        self._park(me)
+        me.state = "running"
+        me.reason = None
+
+    # -- clock, timers, hooks --------------------------------------------
+
+    def add_timer(self, delay_ns, ch) -> None:
+        self._timer_seq += 1
+        self.timers.append(
+            [self.now_ns + max(int(delay_ns), 0), self._timer_seq, ch]
+        )
+
+    def _fire_timer(self, entry) -> None:
+        due, _seq, ch = entry
+        if due > self.now_ns:
+            self.now_ns = due
+        self.progress()
+        if isinstance(ch, GoChan):
+            ch._timer_deliver(_GoTime(self.now_ns))
+
+    def _fire_due_or_next_timer(self) -> bool:
+        """With nothing runnable, advance the virtual clock to the
+        earliest pending timer and deliver it (discrete-event step).
+        Returns whether a timer fired."""
+        if not self.timers:
+            return False
+        self.timers.sort(key=lambda e: (e[0], e[1]))
+        self._fire_timer(self.timers.pop(0))
+        return True
+
+    def _fire_due_timers(self) -> None:
+        while self.timers:
+            self.timers.sort(key=lambda e: (e[0], e[1]))
+            if self.timers[0][0] > self.now_ns:
+                return
+            self._fire_timer(self.timers.pop(0))
+
+    def drain(self) -> None:
+        """Give every other runnable goroutine the token until each has
+        blocked or finished (the deterministic quiescence step)."""
+        while self.runq:
+            self.yield_now()
 
     def yield_point(self):
-        while self.queue:
-            interp, callee, args = self.queue.pop(0)
-            interp.call_value(callee, *args)
+        self._fire_due_timers()
+        self.drain()
         for hook in list(self.hooks):
             hook(self)
 
     def sleep(self, duration_ns):
         self.now_ns += max(int(duration_ns), 0)
         self.yield_point()
+
+
+# -- channels ---------------------------------------------------------------
+
+
+def _claim(queue):
+    """Pop the first eligible waiter: direct waiters always, a parked
+    select only while its token is uncommitted."""
+    while queue:
+        g = queue.pop(0)
+        tok = g.select_token
+        if tok is not None and tok["done"]:
+            continue  # already committed through another channel
+        return g
+    return None
+
+
+def _has_waiter(queue) -> bool:
+    return any(
+        g.select_token is None or not g.select_token["done"]
+        for g in queue
+    )
+
+
+def _commit_recv(r: _Goroutine, ch, value, ok) -> None:
+    tok = r.select_token
+    if tok is None:
+        r.recv_box = (value, ok)
+    else:
+        tok["done"] = True
+        tok["chan"] = ch
+        tok["dir"] = "recv"
+        tok["value"] = (value, ok)
+
+
+def _commit_send(s: _Goroutine, ch):
+    """Take a parked sender's value, committing it."""
+    tok = s.select_token
+    if tok is None:
+        s.send_done = True
+        return s.send_value
+    tok["done"] = True
+    tok["chan"] = ch
+    tok["dir"] = "send"
+    return tok["sends"][id(ch)]
+
+
+class GoChan:
+    """A Go channel over the deterministic scheduler: unbuffered
+    rendezvous or a bounded FIFO buffer, ``close`` semantics included
+    (drain-then-zero receives, panic on send/re-close).  Waiter queues
+    are strict FIFO; which *goroutine* runs next is the scheduler's
+    seeded decision."""
+
+    __slots__ = ("sched", "capacity", "buf", "closed", "sendq", "recvq")
+
+    def __init__(self, sched: Scheduler, capacity: int = 0):
+        self.sched = sched
+        self.capacity = max(int(capacity or 0), 0)
+        self.buf: list = []
+        self.closed = False
+        self.sendq: list = []
+        self.recvq: list = []
+
+    def __len__(self):
+        return len(self.buf)
+
+    # -- operations ------------------------------------------------------
+
+    def _send_once(self, value) -> bool:
+        """One non-blocking send attempt (never yields): panics on a
+        closed channel, else delivers to a waiting receiver or a free
+        buffer slot, else reports False."""
+        sched = self.sched
+        if self.closed:
+            raise GoPanic("send on closed channel")
+        r = _claim(self.recvq)
+        if r is not None:
+            _commit_recv(r, self, value, True)
+            sched.unblock(r)
+            sched.progress()
+            return True
+        if self.capacity and len(self.buf) < self.capacity:
+            self.buf.append(value)
+            sched.progress()
+            return True
+        return False
+
+    def _recv_once(self):
+        """One non-blocking receive attempt (never yields): a (value,
+        ok) box, or None when nothing is deliverable yet."""
+        sched = self.sched
+        if self.buf:
+            value = self.buf.pop(0)
+            s = _claim(self.sendq)
+            if s is not None:
+                # a parked sender refills the freed buffer slot
+                self.buf.append(_commit_send(s, self))
+                sched.unblock(s)
+            sched.progress()
+            return (value, True)
+        s = _claim(self.sendq)
+        if s is not None:
+            value = _commit_send(s, self)
+            sched.unblock(s)
+            sched.progress()
+            return (value, True)
+        if self.closed:
+            return (None, False)
+        return None
+
+    def send(self, value) -> None:
+        sched = self.sched
+        sched.fault_point("chan.send")
+        while True:
+            if self._send_once(value):
+                return
+            g = sched.current
+            g.send_value = value
+            g.send_done = False
+            self.sendq.append(g)
+            sched.block("chan send")
+            if g.send_done:
+                return
+            # woken without a taker: the channel was closed under us
+            # (the loop's _send_once then raises the send panic)
+
+    def recv(self):
+        sched = self.sched
+        sched.fault_point("chan.recv")
+        while True:
+            box = self._recv_once()
+            if box is not None:
+                return box
+            g = sched.current
+            g.recv_box = None
+            self.recvq.append(g)
+            sched.block("chan receive")
+            if g.recv_box is not None:
+                box, g.recv_box = g.recv_box, None
+                return box
+            # woken by close: loop re-checks (drains buf first)
+
+    def close(self) -> None:
+        if self.closed:
+            raise GoPanic("close of closed channel")
+        self.closed = True
+        sched = self.sched
+        for r in list(self.recvq):
+            sched.unblock(r)
+        self.recvq.clear()
+        for s in list(self.sendq):
+            sched.unblock(s)
+        self.sendq.clear()
+        sched.progress()
+
+    # -- select readiness ------------------------------------------------
+
+    def recv_ready(self) -> bool:
+        return bool(self.buf) or self.closed or _has_waiter(self.sendq)
+
+    def send_ready(self) -> bool:
+        if self.closed:
+            return True  # chosen, then panics — Go semantics
+        if _has_waiter(self.recvq):
+            return True
+        return bool(self.capacity) and len(self.buf) < self.capacity
+
+    def _timer_deliver(self, value) -> None:
+        r = _claim(self.recvq)
+        if r is not None:
+            _commit_recv(r, self, value, True)
+            self.sched.unblock(r)
+            return
+        self.buf.append(value)
+
+
+def _chan_send(sched: Scheduler, ch, value) -> None:
+    if ch is None:
+        sched.block("chan send (nil channel)")  # blocks forever
+        raise GoInterpError("send on nil channel resumed")
+    if not isinstance(ch, GoChan):
+        raise GoInterpError(f"send on non-channel {type(ch).__name__}")
+    ch.send(value)
+
+
+def _chan_recv(sched: Scheduler, ch):
+    if ch is None:
+        sched.block("chan receive (nil channel)")  # blocks forever
+        raise GoInterpError("receive on nil channel resumed")
+    if not isinstance(ch, GoChan):
+        raise GoInterpError(
+            f"receive from non-channel {type(ch).__name__}"
+        )
+    return ch.recv()
+
+
+def _chan_close(sched: Scheduler, ch) -> None:
+    if ch is None:
+        raise GoPanic("close of nil channel")
+    if not isinstance(ch, GoChan):
+        raise GoInterpError(f"close of non-channel {type(ch).__name__}")
+    ch.close()
+
+
+def _select_run(sched: Scheduler, cases, has_default: bool, site: str):
+    """Execute one ``select``: *cases* are ``("recv", ch)`` /
+    ``("send", ch, value)`` with channel operands already evaluated (in
+    source order, like Go).  Returns ``("recv", idx, value, ok)``,
+    ``("send", idx, None, None)`` or ``("default", -1, None, None)``.
+    Ready-case choice is the seed's: source order at seed 0, seeded
+    RNG otherwise."""
+    sched.fault_point("chan.select")
+    while True:
+        ready = []
+        for idx, case in enumerate(cases):
+            ch = case[1]
+            if not isinstance(ch, GoChan):
+                continue  # nil channels never become ready
+            if case[0] == "recv":
+                if ch.recv_ready():
+                    ready.append(idx)
+            elif ch.send_ready():
+                ready.append(idx)
+        if ready:
+            idx = ready[0] if sched.rng is None else sched.rng.choice(ready)
+            case = cases[idx]
+            # perform the committed op NON-blockingly: the select must
+            # never end up parked on a single channel (a preemption
+            # between the readiness scan and the op would otherwise
+            # abandon the other cases); a stolen readiness re-scans
+            if case[0] == "recv":
+                box = case[1]._recv_once()
+                if box is None:
+                    continue
+                return ("recv", idx, box[0], box[1])
+            if case[1]._send_once(case[2]):
+                return ("send", idx, None, None)
+            continue
+        if has_default:
+            sched.note_select_spin(site)
+            sched.yield_now()
+            return ("default", -1, None, None)
+        live = [c for c in cases if isinstance(c[1], GoChan)]
+        g = sched.current
+        if not live:
+            sched.block(f"select (no cases) at {site}")  # blocks forever
+            continue
+        tok = {"done": False, "chan": None, "dir": None, "value": None,
+               "sends": {}}
+        g.select_token = tok
+        registered = set()  # (direction, chan id): one queue entry per
+        for case in live:
+            ch = case[1]
+            if case[0] == "recv":
+                if ("recv", id(ch)) in registered:
+                    continue
+                registered.add(("recv", id(ch)))
+                ch.recvq.append(g)
+            else:
+                if ("send", id(ch)) in registered:
+                    # duplicate send cases on one channel: register the
+                    # FIRST case's value only, so the value a receiver
+                    # observes always agrees with the case branch the
+                    # post-wake scan (first match) executes
+                    continue
+                registered.add(("send", id(ch)))
+                tok["sends"][id(ch)] = case[2]
+                ch.sendq.append(g)
+        try:
+            sched.block("select")
+        finally:
+            g.select_token = None
+            for case in live:
+                queue = case[1].recvq if case[0] == "recv" else (
+                    case[1].sendq
+                )
+                try:
+                    queue.remove(g)
+                except ValueError:
+                    pass
+        if tok["done"]:
+            committed = tok["chan"]
+            direction = tok["dir"]
+            for idx, case in enumerate(cases):
+                if case[1] is committed and (
+                    ("recv" if case[0] == "recv" else "send") == direction
+                ):
+                    if direction == "recv":
+                        value, ok = tok["value"]
+                        return ("recv", idx, value, ok)
+                    return ("send", idx, None, None)
+        # woken uncommitted (a close): loop re-checks readiness
+
+
+# -- sync -------------------------------------------------------------------
+
+
+class _WaitGroupBase:
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.counter = 0
+        self.waiters: list = []
+
+    def Add(self, delta):
+        self.counter += int(delta)
+        if self.counter < 0:
+            raise GoPanic("sync: negative WaitGroup counter")
+        if self.counter == 0 and self.waiters:
+            for w in self.waiters:
+                self.sched.unblock(w)
+            self.waiters.clear()
+            self.sched.progress()
+
+    def Done(self):
+        self.Add(-1)
+
+    def Wait(self):
+        self.sched.fault_point("wg.wait")
+        while self.counter > 0:
+            self.waiters.append(self.sched.current)
+            self.sched.block("sync.WaitGroup.Wait")
+
+
+class _MutexBase:
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.holder = None
+        self.waiters: list = []
+
+    def Lock(self):
+        self.sched.fault_point("mutex.lock")
+        me = self.sched.current
+        while self.holder is not None:
+            self.waiters.append(me)
+            self.sched.block("sync.Mutex.Lock")
+        self.holder = me
+
+    def TryLock(self):
+        if self.holder is not None:
+            return False
+        self.holder = self.sched.current
+        return True
+
+    def Unlock(self):
+        if self.holder is None:
+            raise GoPanic("sync: unlock of unlocked mutex")
+        self.holder = None
+        if self.waiters:
+            self.sched.unblock(self.waiters.pop(0))
+            self.sched.progress()
+
+
+class _RWMutexBase:
+    """Writer-priority is NOT modeled; readers and the writer exclude
+    each other exactly, which is what the emitted suites assert."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.readers = 0
+        self.holder = None
+        self.waiters: list = []
+
+    def _wake_all(self):
+        for w in self.waiters:
+            self.sched.unblock(w)
+        self.waiters.clear()
+        self.sched.progress()
+
+    def Lock(self):
+        self.sched.fault_point("mutex.lock")
+        me = self.sched.current
+        while self.holder is not None or self.readers:
+            self.waiters.append(me)
+            self.sched.block("sync.RWMutex.Lock")
+        self.holder = me
+
+    def Unlock(self):
+        if self.holder is None:
+            raise GoPanic("sync: unlock of unlocked RWMutex")
+        self.holder = None
+        if self.waiters:
+            self._wake_all()
+
+    def RLock(self):
+        while self.holder is not None:
+            self.waiters.append(self.sched.current)
+            self.sched.block("sync.RWMutex.RLock")
+        self.readers += 1
+
+    def RUnlock(self):
+        if self.readers <= 0:
+            raise GoPanic("sync: RUnlock of unlocked RWMutex")
+        self.readers -= 1
+        if self.readers == 0 and self.waiters:
+            self._wake_all()
+
+
+class _OnceBase:
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.done = False
+        self._running = False
+        self._waiters: list = []
+
+    def Do(self, fn):
+        if self.done:
+            return
+        if self._running:
+            # Go semantics: later callers BLOCK until the first Do
+            # invocation completes (panic included — Once is then done)
+            while not self.done:
+                self._waiters.append(self.sched.current)
+                self.sched.block("sync.Once.Do")
+            return
+        self._running = True
+        try:
+            owner = getattr(getattr(fn, "scan", None), "interp", None)
+            if owner is not None:
+                owner.call_value(fn)
+            elif callable(fn):
+                fn()
+        finally:
+            self.done = True
+            self._running = False
+            if self._waiters:
+                for w in self._waiters:
+                    self.sched.unblock(w)
+                self._waiters.clear()
+                self.sched.progress()
+
+
+def _sync_module(sched: Scheduler):
+    """The ``sync`` package bound to one scheduler.  Types are real
+    Python classes (``var mu sync.Mutex`` zero values and
+    ``sync.WaitGroup{}`` composites both construct through them), each
+    capturing the program's scheduler."""
+
+    class WaitGroup(_WaitGroupBase):
+        def __init__(self):
+            _WaitGroupBase.__init__(self, sched)
+
+    class Mutex(_MutexBase):
+        def __init__(self):
+            _MutexBase.__init__(self, sched)
+
+    class RWMutex(_RWMutexBase):
+        def __init__(self):
+            _RWMutexBase.__init__(self, sched)
+
+    class Once(_OnceBase):
+        def __init__(self):
+            _OnceBase.__init__(self, sched)
+
+    class _SyncModule:
+        pass
+
+    mod = _SyncModule()
+    mod.WaitGroup = WaitGroup
+    mod.Mutex = Mutex
+    mod.RWMutex = RWMutex
+    mod.Once = Once
+    return mod
 
 
 class _GoTime:
@@ -911,6 +1795,15 @@ class _TimeModule:
 
     def Since(self, t):
         return self.sched.now_ns - t.ns
+
+    def After(self, d):
+        """A timer channel on the virtual clock: delivered when the
+        scheduler would otherwise idle (discrete-event step), so
+        ``select { case <-time.After(...) }`` timeouts are
+        deterministic."""
+        ch = GoChan(self.sched, capacity=1)
+        self.sched.add_timer(d, ch)
+        return ch
 
 
 class _OsModule:
@@ -1872,9 +2765,13 @@ class _CtrlModule:
 
 def default_natives(sched: "Scheduler | None" = None) -> dict:
     """Native modules keyed by import path."""
+    from .envtest import _workqueue_module
+
     if sched is None:
         sched = Scheduler()
     return {
+        "sync": _sync_module(sched),
+        "k8s.io/client-go/util/workqueue": _workqueue_module(sched),
         "os": _OsModule,
         "path/filepath": _FilepathModule,
         "flag": _FlagModule,
@@ -2152,6 +3049,8 @@ class Interp:
             return ret.values
         except GoExit:
             raise  # os.Exit skips defers, matching Go
+        except GoroutineExit:
+            raise  # a killed (leaked) goroutine unwinds without defers
         except BaseException:
             ev.run_defers()
             raise
@@ -2303,6 +3202,8 @@ class _Eval:
                 return self._stmt_for(toks, i, hi, env)
             if t.value == "switch":
                 return self._stmt_switch(toks, i, hi, env)
+            if t.value == "select":
+                return self._stmt_select(toks, i, hi, env)
             if t.value == "continue":
                 raise _Continue()
             if t.value == "break":
@@ -2339,10 +3240,21 @@ class _Eval:
                 if depth == 0:
                     break
             j -= 1
-        callee = self._eval_range(toks, i + 1, j, env)
+        if j == i + 2 and toks[i + 1].kind == IDENT and (
+            toks[i + 1].value == "close"
+        ):
+            # `defer close(ch)` / `go close(ch)`: close is a builtin,
+            # not a resolvable name — suspend it as a native callable
+            sched = self.interp.sched
+            callee = lambda ch: _chan_close(sched, ch)  # noqa: E731
+        else:
+            callee = self._eval_range(toks, i + 1, j, env)
         args = self._call_args(toks, j + 1, close, env)
         if is_go:
-            self.interp.sched.spawn(self.interp, callee, args)
+            self.interp.sched.spawn(
+                self.interp, callee, args,
+                site=_spawn_site(self.scan, toks[i].line),
+            )
         else:
             self.defers.append((callee, args))
         return end
@@ -2478,6 +3390,24 @@ class _Eval:
             iterable = self._eval_range(toks, flat + 1, hi_s, env)
             if iterable is None:
                 iterable = []
+            if isinstance(iterable, GoChan):
+                # `for v := range ch`: receive until the channel closes
+                # (the single name binds the VALUE, like Go)
+                sched = self.interp.sched
+                while True:
+                    value, ok = _chan_recv(sched, iterable)
+                    if not ok:
+                        break
+                    scope = Env(env)
+                    if names:
+                        scope.define(names[0], value)
+                    try:
+                        self.exec_block(toks, blo, bhi, scope)
+                    except _Break:
+                        break
+                    except _Continue:
+                        continue
+                return after
             seq = (
                 list(iterable.items()) if isinstance(iterable, dict)
                 else list(enumerate(iterable))
@@ -2697,6 +3627,98 @@ class _Eval:
                 pass
         return bhi + 1
 
+    def _stmt_select(self, toks, i, hi, env) -> int:
+        """``select``: channel operands (and send values) evaluate once
+        in source order, the scheduler picks among ready cases (source
+        order at seed 0, seeded RNG otherwise), ``default`` runs when
+        nothing is ready, and with no default the flow parks in every
+        case's queue until one commits."""
+        j = i + 1
+        if not (j < hi and toks[j].kind == OP and toks[j].value == "{"):
+            raise GoInterpError("unsupported select clause")
+        blo, bhi = _group_span(toks, j)
+        clauses = self._switch_clauses(toks, blo, bhi)
+        site = _spawn_site(self.scan, toks[i].line)
+        cases = []      # scheduler cases, non-default source order
+        handlers = []   # (bind_names, bind_op, slo, shi) aligned
+        default_body = None
+        for exprs, slo, shi in clauses:
+            if exprs is None:
+                default_body = (slo, shi)
+                continue
+            kind, ch, value, names, bind_op = self._select_case(
+                toks, exprs[0], exprs[1], env
+            )
+            cases.append(
+                ("recv", ch) if kind == "recv" else ("send", ch, value)
+            )
+            handlers.append((names, bind_op, slo, shi))
+        out_kind, idx, value, ok = _select_run(
+            self.interp.sched, cases, default_body is not None, site
+        )
+        scope = Env(env)
+        if out_kind == "default":
+            body = default_body
+        else:
+            names, bind_op, slo, shi = handlers[idx]
+            body = (slo, shi)
+            if names:
+                for name, v in zip(names, (value, ok)):
+                    if bind_op == ":=":
+                        scope.define(name, v)
+                    else:
+                        self._write_target(("name", name), v, scope)
+        try:
+            self.exec_block(toks, body[0], body[1], scope)
+        except _Break:
+            pass
+        return bhi + 1
+
+    def _select_case(self, toks, lo, hi, env):
+        """Parse-and-evaluate one select case header:
+        ``[v[, ok] :=|= ] <-ch`` or ``ch <- expr``."""
+        depth = 0
+        arrow = None
+        bind = None
+        bind_op = None
+        for j in range(lo, hi):
+            t = toks[j]
+            if t.kind == OP:
+                if t.value in "([{":
+                    depth += 1
+                elif t.value in ")]}":
+                    depth -= 1
+                elif depth == 0 and t.value == "<-" and arrow is None:
+                    arrow = j
+                elif depth == 0 and t.value in (":=", "=") and (
+                    bind is None
+                ):
+                    bind = j
+                    bind_op = t.value
+        if arrow is None:
+            raise GoInterpError("unsupported select case")
+        if bind is not None and bind < arrow:
+            # binding targets must be plain names (possibly blank);
+            # anything else (`x.f = <-ch`) is outside the subset and
+            # must fail loudly, never silently clobber a bare name
+            if any(
+                not (
+                    t.kind == IDENT
+                    or (t.kind == OP and t.value == ",")
+                )
+                for t in toks[lo:bind]
+            ):
+                raise GoInterpError("unsupported select case target")
+            names = [t.value for t in toks[lo:bind] if t.kind == IDENT]
+            ch = self._eval_range(toks, arrow + 1, hi, env)
+            return ("recv", ch, None, names, bind_op)
+        if arrow == lo:
+            ch = self._eval_range(toks, arrow + 1, hi, env)
+            return ("recv", ch, None, [], None)
+        ch = self._eval_range(toks, lo, arrow, env)
+        value = self._eval_range(toks, arrow + 1, hi, env)
+        return ("send", ch, value, None, None)
+
     def _clause_start(self, toks, blo, j) -> int:
         """Whether toks[j] begins a statement directly in the switch
         body (depth 0 from blo)."""
@@ -2777,6 +3799,8 @@ class _Eval:
             return lambda: []
         if toks and toks[0].kind == KEYWORD and toks[0].value == "map":
             return lambda: {}
+        if toks and toks[0].kind == KEYWORD and toks[0].value == "chan":
+            return None  # nil channel (blocks forever, like Go)
         # a qualified struct type (shopv1alpha1.BookStore) or a native
         # class: construct its zero value through the resolved type
         resolved = self._resolve_type_value(type_span)
@@ -2792,10 +3816,12 @@ class _Eval:
 
     def _simple_stmt(self, toks, i, hi, env) -> int:
         end = self._stmt_end(toks, i, hi)
-        # find top-level assignment operator
+        # find top-level assignment operator (and any top-level `<-`,
+        # which — with no assignment op — makes this a send statement)
         depth = 0
         op_at = None
         op_val = None
+        arrow_at = None
         for j in range(i, end):
             t = toks[j]
             if t.kind == OP:
@@ -2803,6 +3829,8 @@ class _Eval:
                     depth += 1
                 elif t.value in ")]}":
                     depth -= 1
+                elif depth == 0 and t.value == "<-" and arrow_at is None:
+                    arrow_at = j
                 elif depth == 0 and t.value in (
                     ":=", "=", "+=", "-=", "*=", "/=", "|=", "&=", "%=",
                 ):
@@ -2810,6 +3838,13 @@ class _Eval:
                     op_val = t.value
                     break
         if op_at is None:
+            # `ch <- v`: a send statement (an arrow at i is a bare
+            # receive expression statement, handled by unary)
+            if arrow_at is not None and arrow_at > i:
+                ch = self._eval_range(toks, i, arrow_at, env)
+                value = self._eval_range(toks, arrow_at + 1, end, env)
+                _chan_send(self.interp.sched, ch, value)
+                return end
             # expression statement or ++/--
             if end - 2 >= i and toks[end - 1].kind == OP and toks[end - 1].value in ("++", "--"):
                 target = self._parse_targets(toks, i, end - 1, env)[0]
@@ -2819,7 +3854,7 @@ class _Eval:
                 return end
             self._eval_range(toks, i, end, env)
             return end
-        values = self._expr_list(toks, op_at + 1, end, env)
+        values = self._rhs_values(toks, i, op_at, end, env)
         targets = self._parse_targets(toks, i, op_at, env)
         if (
             len(targets) == 2
@@ -2846,6 +3881,26 @@ class _Eval:
         for target, value in zip(targets, values):
             self._write_target(target, value, env)
         return end
+
+    def _rhs_values(self, toks, lo, op_at, end, env):
+        """Assignment right-hand sides.  A two-target `v, ok := <-ch`
+        receives ONCE and yields the comma-ok pair; everything else is
+        the plain expression list (a single-target `<-ch` receives
+        through the unary path)."""
+        spans = _split_commas(toks, op_at + 1, end)
+        if (
+            len(spans) == 1
+            and toks[spans[0][0]].kind == OP
+            and toks[spans[0][0]].value == "<-"
+            and len(_split_commas(toks, lo, op_at)) == 2
+        ):
+            ch = self._eval_range(
+                toks, spans[0][0] + 1, spans[0][1], env
+            )
+            return list(_chan_recv(self.interp.sched, ch))
+        return [
+            self._eval_range(toks, slo, shi, env) for slo, shi in spans
+        ]
 
     def _comma_ok(self, toks, lo, hi, env):
         """`v, ok := m[k]` — a two-value map read; returns (value, ok)
@@ -3051,6 +4106,10 @@ class _Eval:
     def unary(self, toks, pos):
         t = toks[pos]
         if t.kind == OP:
+            if t.value == "<-":
+                ch, pos = self.unary(toks, pos + 1)
+                value, _ok = _chan_recv(self.interp.sched, ch)
+                return value, pos
             if t.value == "!":
                 value, pos = self.unary(toks, pos + 1)
                 return not _truthy(value), pos
@@ -3351,7 +4410,16 @@ class _Eval:
             if name in ("len", "cap") and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 arg = self._eval_range(toks, lo, hi, self.env)
+                if isinstance(arg, GoChan):
+                    return (
+                        arg.capacity if name == "cap" else len(arg.buf)
+                    ), hi + 1
                 return (0 if arg is None else len(arg)), hi + 1
+            if name == "close" and _next_is(toks, pos + 1, "("):
+                lo, hi = _group_span(toks, pos + 1)
+                arg = self._eval_range(toks, lo, hi, self.env)
+                _chan_close(self.interp.sched, arg)
+                return None, hi + 1
             if name == "append" and _next_is(toks, pos + 1, "("):
                 lo, hi = _group_span(toks, pos + 1)
                 # _call_args so `append(a, b...)` splats b's elements
@@ -3384,6 +4452,16 @@ class _Eval:
                 inner = toks[lo:hi]
                 if inner and inner[0].kind == KEYWORD and inner[0].value == "map":
                     return {}, hi + 1
+                if inner and inner[0].kind == KEYWORD and (
+                    inner[0].value == "chan"
+                ):
+                    spans = _split_commas(toks, lo, hi)
+                    capacity = 0
+                    if len(spans) > 1:
+                        capacity = self._eval_range(
+                            toks, spans[1][0], spans[1][1], self.env
+                        )
+                    return GoChan(self.interp.sched, capacity), hi + 1
                 return [], hi + 1
             value = self.lookup(name, self.env)
             return value, pos + 1
@@ -3515,6 +4593,8 @@ class _Eval:
                 return ret.values
             except GoExit:
                 raise
+            except GoroutineExit:
+                raise  # killed goroutine: no defers, like Go's exit
             except BaseException:
                 ev.run_defers()
                 raise

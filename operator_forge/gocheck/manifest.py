@@ -571,6 +571,23 @@ MANIFEST: dict[str, dict] = {
         },
         "values": {"NoWarnings", "WarningLogger"},
     },
+    "k8s.io/client-go/util/workqueue": {
+        "closed": False,
+        "funcs": {
+            "New": (0, 0),
+            "NewNamed": (1, 1),
+            "NewRateLimitingQueue": (1, 1),
+            "NewRateLimitingQueueWithConfig": (2, 2),
+            "DefaultControllerRateLimiter": (0, 0),
+        },
+        "types": {
+            "Interface": None,
+            "RateLimitingInterface": None,
+            "RateLimiter": None,
+            "Type": None,
+        },
+        "values": set(),
+    },
     "k8s.io/client-go/tools/record": {
         "closed": False,
         "funcs": {

@@ -207,6 +207,21 @@ STD_MANIFEST: dict[str, dict] = {
         "types": {"Buffer": None, "Reader": None},
         "values": {"ErrTooLarge", "MinRead"},
     },
+    "sync": {
+        "closed": True,
+        "funcs": {
+            "OnceFunc": (1, 1), "OnceValue": (1, 1), "OnceValues": (1, 1),
+        },
+        "types": {
+            "WaitGroup": None, "Mutex": None, "RWMutex": None,
+            "Once": None, "Map": None, "Cond": None, "Locker": None,
+            "Pool": None,
+        },
+        "values": set(),
+        "param_kinds": {
+            "OnceFunc": ("func",),
+        },
+    },
     "context": {
         "closed": True,
         "funcs": {

@@ -36,6 +36,7 @@ import os
 
 import yaml
 
+from . import envtest
 from .gopkg import ProjectRuntime
 from .interp import (
     BUILTIN_KINDS,
@@ -167,6 +168,11 @@ class FakeClusterClient:
     def Patch(self, ctx, resource, *opts):
         key = (resource.Object.get("kind"), resource.GetNamespace(),
                resource.GetName())
+        conflict = envtest.maybe_conflict(
+            "envtest.patch", key[0] or "", key[2] or ""
+        )
+        if conflict is not None:
+            return conflict
         merged = copy.deepcopy(resource.Object)
         prior = self.children.get(key)
         if prior and "status" in prior:
@@ -210,6 +216,11 @@ class FakeClusterClient:
         world = getattr(self, "world", None)
         if isinstance(obj, GoStruct) and not hasattr(obj, "Object"):
             key = (obj.tname, obj.GetNamespace(), obj.GetName())
+            conflict = envtest.maybe_conflict(
+                "envtest.update", key[0], key[2]
+            )
+            if conflict is not None:
+                return conflict
             stored = self.workloads.get(key)
             if stored is None:
                 return GoError(f"{obj.tname} not found", not_found=True)
@@ -400,12 +411,14 @@ class GoTestM:
         self.suite = suite
         self.ran: list = []
         self.failures: list = []
+        self.leaks: list = []      # end-of-suite goroutine leak sweep
         self.on_test = None        # callable(name, passed): -v result
         self.on_test_start = None  # callable(name): -v '=== RUN' line
 
     def Run(self):
         code = 0
         fmt_native = self.suite.world.runtime.natives.get("fmt")
+        sched = self.suite.world.runtime.sched
         for name in self.suite.test_names:
             if fmt_native is not None:
                 fmt_native.out.clear()  # bound print accumulation
@@ -417,6 +430,16 @@ class GoTestM:
                 self.suite.interp.call(name, t)
             except GoTestFailure:
                 pass
+            # goroutine error attribution: a panic inside a spawned
+            # goroutine is the GOROUTINE's failure, reported against
+            # the test that owned the scheduler when it surfaced and
+            # tagged with the spawn site — never blamed on whatever
+            # flow happened to hit the yield point
+            for site, msg in sched.take_failures():
+                t.failed = True
+                t.messages.append(
+                    f"goroutine (spawned at {site}): {msg}"
+                )
             self.ran.append(name)
             if t.failed:
                 code = 1
@@ -795,6 +818,10 @@ class EnvtestWorld:
         return None
 
     def _pump(self, sched):
+        # the envtest.storm chaos site: a spec'd hit injects a full
+        # resync (every live workload requeued); reconcilers are
+        # idempotent, so chaos reports stay byte-identical
+        envtest.fire_storm(self)
         progressed = True
         while progressed:
             progressed = False
@@ -879,17 +906,31 @@ class EmittedSuite:
                 ]
 
     def run(self, on_test=None, on_test_start=None) -> tuple:
-        """Execute TestMain; returns (exit_code, m)."""
+        """Execute TestMain; returns (exit_code, m).  After the last
+        test, the scheduler's end-of-suite sweep reports (and unwinds)
+        leaked goroutines with their spawn sites — ``m.leaks``."""
         m = GoTestM(self)
         m.on_test = on_test
         m.on_test_start = on_test_start
-        if "TestMain" not in self.interp.funcs:
-            return (m.Run(), m)
+        sched = self.world.runtime.sched
         try:
-            self.interp.call("TestMain", m)
-        except GoExit as exc:
-            return (exc.code, m)
-        return (1 if m.failures else 0, m)
+            if "TestMain" not in self.interp.funcs:
+                code = m.Run()
+            else:
+                try:
+                    self.interp.call("TestMain", m)
+                    code = 1 if m.failures else 0
+                except GoExit as exc:
+                    code = exc.code
+        finally:
+            # even a faulted suite unwinds its parked goroutine threads
+            m.leaks = sched.sweep()
+        for site, msg in sched.take_failures():
+            # a goroutine failure surfacing outside any test (TestMain
+            # setup/teardown): the suite still fails, spawn-site tagged
+            m.failures.append((f"goroutine@{site}", [msg]))
+            code = code or 1
+        return (code, m)
 
 
 # ---------------------------------------------------------------------------
@@ -901,7 +942,7 @@ class SuiteResult:
 
     def __init__(self, rel: str, code: int = 0, ran=None, failures=None,
                  skipped: bool = False, error: str = "",
-                 seconds: float = 0.0):
+                 seconds: float = 0.0, leaks=None):
         self.rel = rel
         self.code = code
         self.ran = ran or []
@@ -909,6 +950,9 @@ class SuiteResult:
         self.skipped = skipped
         self.error = error
         self.seconds = seconds
+        # deterministic goroutine-leak report lines from the suite's
+        # end-of-run scheduler sweep (spawn-site tagged)
+        self.leaks = leaks or []
 
     @property
     def ok(self) -> bool:
@@ -1085,6 +1129,8 @@ def run_project_tests(root: str, include_e2e: bool = False,
     from . import cache as gocheck_cache
     from . import compiler
 
+    from .interp import current_seed
+
     key = None
     state = None
     if gocheck_cache.replay_enabled():  # off mode: skip the tree hash
@@ -1092,6 +1138,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
         key = gocheck_cache.check_key(
             root, files=state, include_e2e=include_e2e,
             run_filter=run_filter or "", mode=compiler.mode(),
+            seed=current_seed(),
         )
         cached = gocheck_cache.check_get(key)
         if cached is not None:
@@ -1124,6 +1171,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
             return SuiteResult(
                 rel, code=code, ran=m.ran, failures=m.failures,
                 seconds=_time.perf_counter() - started,
+                leaks=m.leaks,
             )
         except BrokenPipeError:
             raise  # the -v reader went away; let the CLI exit quietly
@@ -1160,6 +1208,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
             pkg_key = (
                 "check.pkg", gocheck_cache._SCHEMA, _version, root,
                 root_abs, rel, bool(include_e2e), run_filter or "", mode,
+                current_seed(),
             )
             live: list = []
 

@@ -49,6 +49,21 @@ kind                 planted site           effect when fired
 ``remote.hang``      ``remote``             the remote fetch sleeps past the
                                             read deadline (hung server:
                                             deadline-then-degrade path)
+``sched.preempt``    gocheck scheduler ops  the current goroutine yields to
+                     (``chan.send``,        the seeded pick at that hit — an
+                     ``chan.recv``,         alternate deterministic schedule;
+                     ``chan.select``,       suite reports must not change
+                     ``wg.wait``,
+                     ``mutex.lock``,
+                     ``workqueue.get``,
+                     ``go.spawn``)
+``envtest.conflict`` ``envtest.update`` /   the fake apiserver refuses the
+                     ``envtest.patch``      write with an optimistic-lock
+                                            conflict (requeue-on-conflict
+                                            path; the retry converges)
+``envtest.storm``    ``envtest.pump``       the reconcile pump injects a full
+                                            resync — every live workload
+                                            requeued (idempotence path)
 ===================  =====================  ================================
 
 Hit counters are per-process: forked pool workers restart from zero
@@ -87,6 +102,9 @@ KINDS = (
     "remote.unreachable",
     "remote.corrupt",
     "remote.hang",
+    "sched.preempt",
+    "envtest.conflict",
+    "envtest.storm",
 )
 
 

@@ -332,6 +332,7 @@ def tier_report() -> dict:
     for name in (
         "compile.lowered", "compile.promoted", "compile.hydrated",
         "compile.reused", "bytecode.executed", "bytecode.deopt",
+        "sched.goroutines", "sched.leaked", "sched.deadlocks",
     ):
         out[name] = counts.get(name, 0)
     return out

@@ -371,6 +371,53 @@ print(
         tiered["lex"]["speedup"],
     )
 )
+
+# concurrency runtime (PR 12): the storm suite (goroutines, channels,
+# select, workqueue under the seeded deterministic scheduler) must run
+# green; reports must be byte-identical across tier/cache/jobs legs for
+# a fixed seed; distinct seeds must agree on verdicts; the scheduler-
+# preemption chaos legs must match the fault-free reference; and the
+# planted scheduler sites stay under the 1% micro-bar (channel-free
+# suites execute zero of them).
+concurrency = detail["concurrency"]
+assert concurrency["storm_suite_ran"] is True, "storm suite did not run"
+assert concurrency["suite_green"] is True, "storm suite not green"
+assert concurrency["warm_matches_cold"] is True, (
+    "concurrency warm replay diverged"
+)
+for cache_mode, ok in concurrency["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"concurrency identity failed (cache={cache_mode})"
+    )
+assert concurrency["seed_verdicts_identical"] is True, (
+    "distinct scheduling seeds changed verdicts"
+)
+assert concurrency["chaos_identical"] is True, (
+    "scheduler-preemption chaos leg diverged from fault-free reference"
+)
+assert concurrency["chaos_faults_injected"] > 0, (
+    "concurrency chaos legs injected no preemptions"
+)
+assert concurrency["site_overhead_ok"] is True, (
+    "planted scheduler-site overhead %.4f%% of the storm cold run"
+    % (concurrency["site_fraction_of_cold"] * 100)
+)
+print(
+    "concurrency contract OK: storm cold=%.3fs warm=%.3fs (x%.1f), "
+    "identity clean in %d cache modes, %d preemptions injected "
+    "byte-identically, sites %.0fns/call (%.4f%% of cold, %g "
+    "sites/run; channel-free suites hit zero)"
+    % (
+        concurrency["cold_cpu_s_median"],
+        concurrency["warm_cpu_s_median"],
+        concurrency["warm_speedup"],
+        len(concurrency["identity_by_cache_mode"]),
+        concurrency["chaos_faults_injected"],
+        concurrency["site_per_call_ns"],
+        concurrency["site_fraction_of_cold"] * 100,
+        concurrency["sched_sites_per_cold_run"],
+    )
+)
 PYEOF
 
 # Remote-tier cross-process step (PR 9): a REAL cache-server process
@@ -677,6 +724,157 @@ finally:
     compiler.set_promote_after(None)
     workers.set_backend(None)
     os.environ.pop("OPERATOR_FORGE_JOBS", None)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
+# Concurrency determinism step (PR 12): the channel/envtest storm
+# suite live at 3 scheduling seeds × walk/compile/bytecode × cache
+# off/mem/disk — per-seed reports must be byte-identical across every
+# tier/cache leg, distinct seeds must produce identical VERDICTS
+# (schedule-independence of passing suites), envtest chaos kinds
+# (conflict + resync storm) must leave the storm journal byte-identical
+# to the fault-free reference, and the scheduler counters must surface
+# in metrics.tier_report() (the serve `stats` payload).
+echo "concurrency step: seed x tier x cache identity matrix"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import contextlib
+import io
+import os
+import shutil
+import tempfile
+
+import yaml
+
+from bench import CONCURRENCY_STORM_TEST_GO
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import compiler
+from operator_forge.gocheck import interp as ginterp
+from operator_forge.gocheck.envtest import StormRunner
+from operator_forge.gocheck.world import EnvtestWorld, run_project_tests
+from operator_forge.perf import cache as pf_cache
+from operator_forge.perf import faults, metrics
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-concstep-")
+out = os.path.join(tmp, "proj")
+config = os.path.join("tests", "fixtures", "standalone", "workload.yaml")
+try:
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main([
+            "init", "--workload-config", config,
+            "--repo", "github.com/acme/conc", "--output-dir", out,
+        ]) == 0
+        assert cli_main([
+            "create", "api", "--workload-config", config,
+            "--output-dir", out,
+        ]) == 0
+    with open(os.path.join(out, "pkg", "orchestrate",
+                           "zz_storm_test.go"), "w") as fh:
+        fh.write(CONCURRENCY_STORM_TEST_GO)
+
+    def signature(results):
+        return [
+            (r.rel, r.code, r.ran, r.failures, r.skipped, r.error,
+             r.leaks)
+            for r in results
+        ]
+
+    def verdicts(sig):
+        return [
+            (rel, code, sorted(ran), failures, skipped, error)
+            for rel, code, ran, failures, skipped, error, _l in sig
+        ]
+
+    compiler.set_promote_after(0)
+    per_seed = {}
+    legs = 0
+    for seed in (0, 3, 11):
+        ginterp.set_seed(seed)
+        for cache_mode in ("off", "mem", "disk"):
+            for tier in ("walk", "compile", "bytecode"):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(
+                        tmp, f"cache-{seed}-{cache_mode}-{tier}"
+                    ) if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                compiler.set_mode(tier)
+                got = signature(run_project_tests(out))
+                assert all(
+                    r[1] == 0 for r in got if not r[4]
+                ), f"storm suite not green (seed={seed} tier={tier})"
+                if seed not in per_seed:
+                    per_seed[seed] = got
+                assert got == per_seed[seed], (
+                    f"seed={seed} cache={cache_mode} tier={tier} "
+                    "diverged from the seed's canonical report"
+                )
+                legs += 1
+    base = verdicts(per_seed[0])
+    for seed, sig in per_seed.items():
+        assert verdicts(sig) == base, (
+            f"seed {seed} changed verdicts (schedule-dependence!)"
+        )
+
+    # envtest chaos: conflict + resync storm against the real emitted
+    # reconciler must converge to the fault-free journal
+    compiler.set_mode("bytecode")
+    pf_cache.configure(mode="off")
+    ginterp.set_seed(0)
+
+    def storm_world():
+        world = EnvtestWorld(out)
+        world.env_started = True
+        world.simulate_cluster = True
+        world.install_crds(os.path.join(out, "config", "crd", "bases"))
+        world.start_operator()
+        return world
+
+    samples = os.path.join(out, "config", "samples")
+    sample_path = [
+        os.path.join(samples, f) for f in sorted(os.listdir(samples))
+        if f != "kustomization.yaml" and "required" not in f
+    ][0]
+    with open(sample_path) as fh:
+        sample = yaml.safe_load(fh)
+    reference = StormRunner(storm_world(), seed=0).run(
+        sample, objects=3, rounds=2
+    )
+    faults.reset()
+    faults.configure(
+        "envtest.conflict@envtest.update:2,envtest.storm@envtest.pump:3"
+    )
+    try:
+        chaos = StormRunner(storm_world(), seed=0).run(
+            sample, objects=3, rounds=2
+        )
+        fired = {k for k, _s, _n in faults.fired()}
+    finally:
+        faults.configure(None)
+    assert chaos == reference, "envtest chaos journal diverged"
+    assert fired == {"envtest.conflict", "envtest.storm"}, fired
+
+    report = metrics.tier_report()
+    for key in ("sched.goroutines", "sched.leaked", "sched.deadlocks"):
+        assert key in report, f"{key} missing from tier_report/stats"
+    assert report["sched.goroutines"] > 0, "no goroutines attributed"
+    print(
+        "concurrency step OK: %d legs identical (3 seeds x 3 tiers x "
+        "3 cache modes), verdicts seed-independent, envtest chaos "
+        "journal byte-identical (%s), %d goroutines / %d leaked / %d "
+        "deadlocks in stats"
+        % (
+            legs, ",".join(sorted(fired)),
+            report["sched.goroutines"], report["sched.leaked"],
+            report["sched.deadlocks"],
+        )
+    )
+finally:
+    compiler.set_mode(None)
+    compiler.set_promote_after(None)
+    ginterp.set_seed(None)
+    pf_cache.configure(mode="mem")
     shutil.rmtree(tmp, ignore_errors=True)
 PYEOF
 )

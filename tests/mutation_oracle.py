@@ -388,6 +388,42 @@ def orchestrate_fingerprint(pkg_dir: str) -> list:
             return "absent"
         return interp.call_value(fn, GoStruct("Event", {}))
 
+    def apply_resource(fail=None, conflict=False, ns="default"):
+        # ApplyResource's server-side-apply path, error branches
+        # included: a failing Patch must surface (not be swallowed)
+        # and a conflict must wrap with the conflict message
+        workload = conformance._OwnerWorkload(ns="default")
+        resource = conformance._UnstructuredModule.Unstructured()
+        resource.Object = {
+            "kind": "Deployment",
+            "metadata": {"namespace": ns, "name": "child"},
+        }
+        err = None
+        if fail is not None:
+            err = GoError(fail)
+            err.conflict = conflict
+
+        class PatchReconciler(conformance.FakeReconciler):
+            def __init__(self):
+                super().__init__()
+                self.patched = []
+
+            def Patch(self, ctx, obj, *opts):
+                self.patched.append(obj.GetName())
+                return err
+
+            def GetScheme(self):
+                return "scheme"
+
+            def GetFieldManager(self):
+                return "manager"
+
+        rec = PatchReconciler()
+        req = GoStruct("Request", {"Context": None, "Workload": workload})
+        out = interp.call("ApplyResource", rec, req, resource)
+        return (out, rec.patched, resource.GetOwnerReferences(),
+                resource.GetAnnotations(), resource.GetLabels())
+
     run = []
     for name, kind, obj, _want in conformance.READY_CASES:
         run.append((
@@ -533,6 +569,13 @@ def orchestrate_fingerprint(pkg_dir: str) -> list:
         ("owner-label",
          lambda: interp.call("OwnerLabel", conformance._OwnerWorkload())),
         ("mark-owned", mark_and_check),
+        ("apply-ok", apply_resource),
+        ("apply-fail", lambda: apply_resource(fail="patch denied")),
+        ("apply-conflict",
+         lambda: apply_resource(fail="object was modified",
+                                conflict=True)),
+        ("apply-cross-ns",
+         lambda: apply_resource(ns="other-ns")),
         ("finalizer-lifecycle", finalizer_lifecycle),
         ("teardown-cross-ns",
          lambda: teardown([("other-ns", "x", True, True)])),
@@ -1087,6 +1130,187 @@ def kill_stats(entries) -> tuple[int, int, float]:
     killed = sum(1 for _m, verdict in entries if verdict is not None)
     total = len(entries)
     return killed, total, (killed / total if total else 1.0)
+
+
+# -- concurrency kill oracles (PR 12) --------------------------------------
+#
+# One realistic concurrency regression per construct, each killed
+# deterministically by the runtime's own diagnostics (ROADMAP item 3):
+# a dropped workqueue item (non-blocking send under backpressure), a
+# goroutine leak on a missed stop-channel close, and a select-default
+# busy loop.  The harness is a worker-loop package executed under the
+# deterministic scheduler; for a fixed seed the kill reproduces byte
+# for byte.
+
+CONCURRENCY_HARNESS_GO = '''package worker
+
+import (
+	"sync"
+	"time"
+)
+
+// Drain fans items through two workers until the queue closes; the
+// stop channel covers early-shutdown paths.
+func Drain(items []string) []string {
+	queue := make(chan string, 2)
+	stop := make(chan struct{})
+	log := []string{}
+	var mu sync.Mutex
+	var wg sync.WaitGroup
+	for w := 0; w < 2; w++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			for {
+				select {
+				case item, ok := <-queue:
+					if !ok {
+						return
+					}
+					mu.Lock()
+					log = append(log, item)
+					mu.Unlock()
+				case <-stop:
+					return
+				}
+			}
+		}()
+	}
+	for _, item := range items {
+		queue <- item
+	}
+	close(queue)
+	wg.Wait()
+	close(stop)
+	return log
+}
+
+// Counter drains tick events until stop closes, reporting the total.
+func Counter() int {
+	ticks := make(chan int, 4)
+	stop := make(chan struct{})
+	done := make(chan int, 1)
+	go func() {
+		count := 0
+		for {
+			select {
+			case <-ticks:
+				count++
+			case <-stop:
+				done <- count
+				return
+			}
+		}
+	}()
+	ticks <- 1
+	ticks <- 1
+	close(stop)
+	return <-done
+}
+
+// StopWatcher spawns a shutdown listener and signals it on stop.
+func StopWatcher() bool {
+	stop := make(chan struct{})
+	exited := make(chan bool, 1)
+	go func() {
+		<-stop
+		exited <- true
+	}()
+	close(stop)
+	select {
+	case v := <-exited:
+		return v
+	case <-time.After(time.Second):
+		return false
+	}
+}
+'''
+
+CONCURRENCY_MUTANTS = [
+    {
+        "construct": "workqueue-drop",
+        "detail": "the blocking enqueue regressed to a non-blocking "
+                  "send: items are silently dropped whenever the "
+                  "queue backs up",
+        "replacements": [(
+            "\t\tqueue <- item\n",
+            "\t\tselect {\n"
+            "\t\tcase queue <- item:\n"
+            "\t\tdefault:\n"
+            "\t\t}\n",
+        )],
+        "killed_by": "fingerprint",
+    },
+    {
+        "construct": "goroutine-leak",
+        "detail": "the stop-channel close was dropped: the shutdown "
+                  "listener parks forever and the end-of-suite sweep "
+                  "reports it with its spawn site",
+        "replacements": [(
+            "\tclose(stop)\n\tselect {\n\tcase v := <-exited:\n",
+            "\tselect {\n\tcase v := <-exited:\n",
+        )],
+        "killed_by": "leak",
+    },
+    {
+        "construct": "select-busy-loop",
+        "detail": "the blocking stop case regressed to a default "
+                  "poll: the worker spins instead of parking, caught "
+                  "by the scheduler's no-progress diagnostic",
+        "replacements": [(
+            "\t\t\tcase <-stop:\n"
+            "\t\t\t\tdone <- count\n"
+            "\t\t\t\treturn\n",
+            "\t\t\tdefault:\n",
+        )],
+        "killed_by": "busy-loop",
+    },
+]
+
+
+def run_concurrency_harness(src: str) -> tuple:
+    """(fingerprint, leaks, diagnostics) for one harness source under
+    the deterministic scheduler — the concurrency battery's verdict
+    input.  Diagnostics collect interpreter errors (deadlock, busy
+    loop) and spawn-site-tagged goroutine failures; leaks are the
+    end-of-run sweep lines."""
+    from operator_forge.gocheck.interp import GoInterpError, Interp
+
+    interp = Interp()
+    interp.load_source(src, "worker.go")
+    fingerprint = []
+    diagnostics = []
+    for label, call in (
+        ("drain", lambda: interp.call(
+            "Drain", ["a", "b", "c", "d", "e", "f"]
+        )),
+        ("counter", lambda: interp.call("Counter")),
+        ("watcher", lambda: interp.call("StopWatcher")),
+    ):
+        try:
+            fingerprint.append((label, _freeze(call())))
+        except GoInterpError as exc:
+            fingerprint.append((label, f"!{type(exc).__name__}"))
+            diagnostics.append(str(exc))
+    for site, msg in interp.sched.take_failures():
+        diagnostics.append(f"{site}: {msg}")
+    leaks = tuple(interp.sched.sweep())
+    return (tuple(fingerprint), leaks, tuple(diagnostics))
+
+
+def concurrency_kill_verdict(baseline: tuple, mutated: tuple) -> str | None:
+    """Which diagnostic killed the mutant: ``fingerprint``, ``leak``,
+    ``busy-loop``, ``deadlock`` — or None for a survivor."""
+    fingerprint, leaks, diagnostics = mutated
+    if leaks:
+        return "leak"
+    if any("select default busy loop" in d for d in diagnostics):
+        return "busy-loop"
+    if any("deadlock" in d for d in diagnostics):
+        return "deadlock"
+    if fingerprint != baseline[0]:
+        return "fingerprint"
+    return None
 
 
 # -- analyzer kill oracles (PR 4) ------------------------------------------

@@ -19,6 +19,7 @@ the CRD bases makes TestMain panic through ErrorIfCRDPathMissing.
 """
 
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -268,12 +269,69 @@ class TestCLITestCommand:
 
         assert cli_main(["test", str(tmp_path / "nope")]) == 1
 
+    def test_channel_suite_passes_across_tiers(
+        self, standalone, tmp_path, capsys
+    ):
+        # the concurrency runtime: a channel-using emitted test RUNS
+        # and passes — identically under every execution tier (the
+        # bytecode ceiling deopts the channel body to the closure tier)
+        from operator_forge.cli.main import main as cli_main
+        from operator_forge.gocheck import compiler
+
+        proj = str(tmp_path / "proj")
+        shutil.copytree(standalone, proj)
+        with open(os.path.join(proj, "pkg", "orchestrate",
+                               "zz_channels_test.go"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(
+                "package orchestrate\n\n"
+                'import (\n\t"sync"\n\t"testing"\n)\n\n'
+                "func TestUsesChannels(t *testing.T) {\n"
+                "\tch := make(chan int, 1)\n"
+                "\tch <- 1\n"
+                "\tif <-ch != 1 {\n"
+                '\t\tt.Fatal("channel")\n'
+                "\t}\n"
+                "\tdone := make(chan struct{})\n"
+                "\tvar wg sync.WaitGroup\n"
+                "\twg.Add(1)\n"
+                "\tgo func() {\n"
+                "\t\tdefer wg.Done()\n"
+                "\t\tch <- 2\n"
+                "\t}()\n"
+                "\tif <-ch != 2 {\n"
+                '\t\tt.Fatal("goroutine send")\n'
+                "\t}\n"
+                "\twg.Wait()\n"
+                "\tclose(done)\n"
+                "\tselect {\n"
+                "\tcase <-done:\n"
+                "\tdefault:\n"
+                '\t\tt.Fatal("closed channel not ready")\n'
+                "\t}\n"
+                "}\n"
+            )
+        outputs = {}
+        for tier in ("walk", "compile", "bytecode"):
+            compiler.set_mode(tier)
+            try:
+                assert cli_main(["test", proj]) == 0, tier
+            finally:
+                compiler.set_mode(None)
+            out = capsys.readouterr().out
+            assert "ok    pkg/orchestrate" in out, (tier, out)
+            outputs[tier] = re.sub(r"\d+\.\d+s", "<t>", out)
+        assert outputs["walk"] == outputs["compile"] == (
+            outputs["bytecode"]
+        )
+
     def test_interpreter_fault_reports_fail_not_traceback(
         self, standalone, tmp_path, capsys
     ):
         # code outside the interpreter subset (or any internal fault)
         # must surface as a per-package FAIL with exit 1 — never a
-        # Python traceback
+        # Python traceback.  goto is the narrowed pin now that the
+        # channel subset executes.
         from operator_forge.cli.main import main as cli_main
 
         proj = str(tmp_path / "proj")
@@ -284,11 +342,12 @@ class TestCLITestCommand:
             fh.write(
                 "package orchestrate\n\n"
                 'import "testing"\n\n'
-                "func TestUsesChannels(t *testing.T) {\n"
-                "\tch := make(chan int, 1)\n"
-                "\tch <- 1\n"
-                "\tif <-ch != 1 {\n"
-                '\t\tt.Fatal("channel")\n'
+                "func TestUsesGoto(t *testing.T) {\n"
+                "\ti := 0\n"
+                "loop:\n"
+                "\ti++\n"
+                "\tif i < 3 {\n"
+                "\t\tgoto loop\n"
                 "\t}\n"
                 "}\n"
             )
