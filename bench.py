@@ -2057,6 +2057,376 @@ def daemon_section(tmp: str) -> dict:
     }
 
 
+def fleet_section(tmp: str, stage_totals_cold: dict,
+                  cold_cpu_med: float, runs: int) -> dict:
+    """The fleet coordinator benchmark (PR 14): M simulated tenants
+    over K REAL daemon subprocesses on this host —
+
+    - **throughput scaling** — the same tenant load (cache-off vets of
+      disjoint trees, so every request is real CPU) through the
+      coordinator at K=1 vs K=4 daemons; the fleet must clear >=2x the
+      single daemon (GIL-bound processes: more daemons = more cores);
+    - **kill-one-daemon recovery identity** — SIGKILL of a busy daemon
+      mid-batch: every tenant's generation chain must still succeed
+      with trees byte-identical to its cache-off serial in-process
+      recompute (idempotent re-dispatch + fresh-root fencing);
+    - **tenant fairness** — the PR 10 methodology at fleet level: a
+      1-job probe tenant's p99 while a heavy batch tenant runs stays
+      within FAIRNESS_BOUND of its solo p99;
+    - **fault-free overhead** — the three planted fleet sites
+      (dispatch/lease/route) cost <1% of a cold codegen run when no
+      spec is configured, measured like the chaos micro-guard."""
+    import contextlib
+    import io
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import threading
+
+    from operator_forge.perf import faults as pf_faults
+    from operator_forge.perf import metrics as pf_metrics
+    from operator_forge.serve.batch import run_batch
+    from operator_forge.serve.daemon import DaemonClient
+    from operator_forge.serve.fleet import FleetCoordinator
+    from operator_forge.serve.jobs import jobs_from_specs
+
+    # fault-free fast path of the new planted sites
+    pf_faults.configure(None)
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        pf_faults.fire("dispatch", "fleet.daemon_crash")
+    per_call = (time.perf_counter() - start) / n
+    total_calls = sum(d["calls"] for d in stage_totals_cold.values())
+    calls_per_run = total_calls / max(runs, 1)
+    fraction = (
+        per_call * calls_per_run / cold_cpu_med
+        if cold_cpu_med > 0 else 0.0
+    )
+
+    # 8 concurrent tenants in BOTH modes: with fewer, the K=4 leg is
+    # latency-bound by per-request service time (each tenant's
+    # requests are sequential) and the scaling ratio measures client
+    # concurrency, not the fleet
+    tenants = 8
+    requests_per_tenant = 2 if FAST else 3
+    config_dir = os.path.join(FIXTURES, "standalone")
+    cfg = os.path.join(config_dir, "workload.yaml")
+
+    trees = []
+    for i in range(tenants):
+        tree = os.path.join(tmp, f"fleet-tenant-{i}")
+        with contextlib.redirect_stdout(io.StringIO()):
+            generate("standalone", f"github.com/bench/tenant{i}", tree)
+            generate("standalone", f"github.com/bench/tenant{i}", tree)
+        trees.append(tree)
+
+    # the reference bytes every fleet response must reproduce: local
+    # cache-off serial vets
+    pf_cache.configure(mode="off")
+    reference = {}
+    try:
+        for tree in trees:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                assert cli_main(["vet", tree]) == 0
+            reference[tree] = buf.getvalue()
+    finally:
+        pf_cache.configure(mode="mem")
+
+    coordinator = FleetCoordinator(
+        "unix:" + os.path.join(tmp, "fleet-bench.sock")
+    )
+    coordinator.start()
+    procs = []
+    mismatches: list = []
+
+    def spawn_daemon(index: int):
+        sock = os.path.join(tmp, f"fleet-bench-d{index}.sock")
+        env = dict(os.environ)
+        env.pop("OPERATOR_FORGE_FAULTS", None)
+        env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+        env.update({
+            # cache off: every vet is real CPU, so the scaling leg
+            # measures the fleet, not replay; capacity 2 so affinity
+            # saturates quickly and work-stealing spreads the load
+            "OPERATOR_FORGE_CACHE": "off",
+            "OPERATOR_FORGE_WORKERS": "thread",
+            "OPERATOR_FORGE_JOBS": "2",
+            "OPERATOR_FORGE_DAEMON_WORKERS": "2",
+        })
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "operator_forge.cli.main",
+             "daemon", "--listen", sock,
+             "--fleet", coordinator.address()],
+            env=env, stderr=subprocess.DEVNULL,
+        )
+        procs.append((proc, sock))
+        return proc
+
+    def wait_members(count: int) -> None:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if len(coordinator._stats_payload()["members"]) == count:
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"fleet never reached {count} member(s)")
+
+    def drive_level(requests=None) -> dict:
+        latencies: list = []
+        lock = threading.Lock()
+        failures: list = []
+        per_tenant = (
+            requests_per_tenant if requests is None else requests
+        )
+
+        def run_tenant(i):
+            tree = trees[i]
+            try:
+                with DaemonClient(coordinator.address()) as client:
+                    for _ in range(per_tenant):
+                        t0 = time.perf_counter()
+                        resp = client.request(
+                            {"command": "vet", "path": tree,
+                             "id": f"t{i}"}
+                        )
+                        dt = time.perf_counter() - t0
+                        with lock:
+                            latencies.append(dt)
+                            if (
+                                resp.get("rc") != 0
+                                or resp.get("stdout")
+                                != reference[tree]
+                            ):
+                                mismatches.append((tree, resp))
+            except Exception as exc:  # noqa: BLE001 - recorded
+                with lock:
+                    failures.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=run_tenant, args=(i,))
+            for i in range(tenants)
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - start
+        assert not failures, failures[:3]
+        total = tenants * per_tenant
+        return {
+            "jobs": total,
+            "wall_s": round(wall, 4),
+            "jobs_per_s": round(total / wall if wall > 0 else 0.0, 2),
+            "p50_ms": round(_pct(latencies, 50) * 1000, 3),
+            "p99_ms": round(_pct(latencies, 99) * 1000, 3),
+        }
+
+    try:
+        spawn_daemon(0)
+        wait_members(1)
+        # one untimed priming round per level: routing (affinity
+        # establishment, first-steal spread) settles OUTSIDE the timed
+        # window, mirroring the chaos section's untimed pool warm-up
+        drive_level(requests=1)
+        level_1 = drive_level()
+        for i in range(1, 4):
+            spawn_daemon(i)
+        wait_members(4)
+        drive_level(requests=1)
+        level_4 = drive_level()
+        scaling = (
+            level_4["jobs_per_s"] / level_1["jobs_per_s"]
+            if level_1["jobs_per_s"] else 0.0
+        )
+
+        # kill-one-daemon recovery identity: tenant chains in flight,
+        # SIGKILL whichever daemon holds one, every tree must match
+        # its cache-off serial in-process recompute
+        kill_tenants = 2 if FAST else 4
+        pf_cache.configure(mode="off")
+        kill_refs = {}
+        try:
+            for i in range(kill_tenants):
+                ref_out = os.path.join(tmp, f"fleet-kill-ref-{i}")
+                results = run_batch(jobs_from_specs([
+                    {"command": "init", "workload_config": cfg,
+                     "output_dir": ref_out,
+                     "repo": f"github.com/bench/kill{i}"},
+                    {"command": "create-api", "workload_config": cfg,
+                     "output_dir": ref_out},
+                    {"command": "vet", "path": ref_out},
+                ], tmp))
+                assert all(r.ok for r in results)
+                kill_refs[i] = tree_digest(ref_out)
+        finally:
+            pf_cache.configure(mode="mem")
+        counters_before = {
+            name: pf_metrics.counter(name).value()
+            for name in ("fleet.evictions", "fleet.redispatches",
+                         "fleet.jobs_quarantined")
+        }
+        outcomes: dict = {}
+
+        def kill_tenant(i):
+            out = os.path.join(tmp, f"fleet-kill-live-{i}")
+            with DaemonClient(coordinator.address()) as client:
+                outcomes[i] = (out, client.request({
+                    "op": "batch", "id": f"kill-{i}",
+                    "jobs": [
+                        {"command": "init", "workload_config": cfg,
+                         "output_dir": out,
+                         "repo": f"github.com/bench/kill{i}"},
+                        {"command": "create-api",
+                         "workload_config": cfg, "output_dir": out},
+                        {"command": "vet", "path": out},
+                    ],
+                }))
+
+        threads = [
+            threading.Thread(target=kill_tenant, args=(i,))
+            for i in range(kill_tenants)
+        ]
+        for t in threads:
+            t.start()
+        by_addr = {sock: proc for proc, sock in procs}
+        victim = None
+        deadline = time.monotonic() + 60
+        while victim is None and time.monotonic() < deadline:
+            for m in coordinator._stats_payload()["members"].values():
+                if m["in_flight"]:
+                    victim = by_addr.get(m["addr"])
+                    break
+            time.sleep(0.01)
+        assert victim is not None, "no in-flight dispatch to kill"
+        victim.send_signal(_signal.SIGKILL)
+        for t in threads:
+            t.join(600)
+        kill_ok = True
+        for i in range(kill_tenants):
+            out, resp = outcomes[i]
+            if not resp.get("ok") or tree_digest(out) != kill_refs[i]:
+                kill_ok = False
+        recovered = {
+            name: pf_metrics.counter(name).value()
+            - counters_before[name]
+            for name in counters_before
+        }
+
+        # tenant fairness (PR 10 methodology at fleet level): a probe
+        # tenant's p99 while a heavy batch tenant runs
+        probe_tree = trees[0]
+
+        def probe(count) -> list:
+            out = []
+            with DaemonClient(coordinator.address()) as client:
+                for _ in range(count):
+                    t0 = time.perf_counter()
+                    resp = client.request(
+                        {"command": "vet", "path": probe_tree,
+                         "id": "probe"}
+                    )
+                    out.append(time.perf_counter() - t0)
+                    if resp.get("stdout") != reference[probe_tree]:
+                        mismatches.append((probe_tree, resp))
+                    time.sleep(0.01)
+            return out
+
+        solo = probe(4 if FAST else 10)
+        heavy_specs = []
+        for i, tree in enumerate(trees):
+            heavy_specs.append(
+                {"command": "vet", "path": tree, "id": f"heavy-{i}"}
+            )
+        heavy_specs = heavy_specs * (2 if FAST else 3)
+        for i, spec in enumerate(heavy_specs):
+            spec = dict(spec)
+            spec["id"] = f"h{i}"
+            heavy_specs[i] = spec
+        done = threading.Event()
+        heavy_outcome: dict = {}
+
+        def heavy():
+            try:
+                with DaemonClient(coordinator.address()) as client:
+                    heavy_outcome["resp"] = client.request(
+                        {"op": "batch", "id": "heavy",
+                         "jobs": heavy_specs}
+                    )
+            finally:
+                done.set()
+
+        heavy_thread = threading.Thread(target=heavy)
+        heavy_thread.start()
+        contended: list = []
+        with DaemonClient(coordinator.address()) as client:
+            while not done.is_set() and len(contended) < 200:
+                t0 = time.perf_counter()
+                resp = client.request(
+                    {"command": "vet", "path": probe_tree,
+                     "id": "probe-c"}
+                )
+                contended.append(time.perf_counter() - t0)
+                if resp.get("stdout") != reference[probe_tree]:
+                    mismatches.append((probe_tree, resp))
+                time.sleep(0.01)
+        heavy_thread.join(600)
+        assert heavy_outcome.get("resp", {}).get("ok"), (
+            f"heavy tenant failed: {heavy_outcome.get('resp')}"
+        )
+        solo_p99 = _pct(solo, 99)
+        contended_p99 = _pct(contended, 99) if contended else solo_p99
+        ratio = contended_p99 / solo_p99 if solo_p99 > 0 else 1.0
+    finally:
+        coordinator.stop()
+        for proc, _sock in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _sock in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        pf_cache.configure(mode="mem")
+
+    return {
+        "fixture": "standalone",
+        "tenants": tenants,
+        "daemons": 4,
+        "levels": {"1": level_1, "4": level_4},
+        "single_daemon_jobs_per_s": level_1["jobs_per_s"],
+        "fleet_jobs_per_s": level_4["jobs_per_s"],
+        "scaling_x": round(scaling, 2),
+        "identity": not mismatches,
+        "kill_recovery": {
+            "tenants": kill_tenants,
+            "ok": kill_ok,
+            "evictions": recovered["fleet.evictions"],
+            "redispatches": recovered["fleet.redispatches"],
+            "quarantined": recovered["fleet.jobs_quarantined"],
+        },
+        "fairness": {
+            "solo_p99_ms": round(solo_p99 * 1000, 3),
+            "contended_p99_ms": round(contended_p99 * 1000, 3),
+            "contended_samples": len(contended),
+            "ratio": round(ratio, 2),
+            "bound": FAIRNESS_BOUND,
+            "ok": ratio <= FAIRNESS_BOUND,
+        },
+        "disabled_per_call_ns": round(per_call * 1e9, 1),
+        "disabled_fraction_of_cold": round(fraction, 6),
+        "disabled_ok": fraction < 0.01,
+        "headline": "M tenants of cache-off vets over K real daemon "
+        "subprocesses through the coordinator; scaling = K=4 jobs/s "
+        "over K=1; kill = SIGKILL of a busy daemon mid generation "
+        "chain with tree digests vs the cache-off serial in-process "
+        "recompute; fairness = a 1-job probe tenant against a heavy "
+        "batch tenant",
+    }
+
+
 def main() -> None:
     import io
     import contextlib
@@ -2202,6 +2572,14 @@ def main() -> None:
         # clients, warm-daemon vs cold-serial bar, fairness guard
         daemon = daemon_section(tmp)
 
+        # the fleet coordinator: K real daemon subprocesses behind the
+        # scheduler — throughput scaling, kill-one-daemon recovery
+        # identity, tenant fairness, fault-site overhead
+        fleet = fleet_section(
+            tmp, stage_totals["cold"],
+            statistics.median(cpu["cold"]), MEASURED_RUNS,
+        )
+
         # the execution-tier ladder: per-tier warm check execution on
         # kitchen-sink (≥3x bytecode vs walk), monorepo-lite cold
         # check, tier counters, and the vectorized-lexer microbench
@@ -2274,6 +2652,7 @@ def main() -> None:
                 "chaos": chaos,
                 "remote": remote,
                 "daemon": daemon,
+                "fleet": fleet,
                 "tiered": tiered,
                 "concurrency": concurrency,
                 "noise_floor": "within one invocation the CPU median "
@@ -2450,6 +2829,50 @@ def main() -> None:
                     daemon["fairness"]["ratio"],
                     daemon["fairness"]["bound"],
                 ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if fleet["scaling_x"] < 2:
+            print(
+                "fleet scaling guard FAILED: K=4 daemons below the 2x "
+                "bar over a single daemon: %.2f" % fleet["scaling_x"],
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not fleet["identity"]:
+            print(
+                "fleet identity guard FAILED: a tenant's response "
+                "diverged from the cache-off serial recompute",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not fleet["kill_recovery"]["ok"] or (
+            fleet["kill_recovery"]["evictions"] <= 0
+        ):
+            print(
+                "fleet kill-recovery guard FAILED: SIGKILL of a busy "
+                "daemon broke a tenant (or evicted nothing): %r"
+                % fleet["kill_recovery"],
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not fleet["fairness"]["ok"]:
+            print(
+                "fleet fairness guard FAILED: contended p99 %.1fms vs "
+                "solo p99 %.1fms (ratio %.1f > bound %.0f)"
+                % (
+                    fleet["fairness"]["contended_p99_ms"],
+                    fleet["fairness"]["solo_p99_ms"],
+                    fleet["fairness"]["ratio"],
+                    fleet["fairness"]["bound"],
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not fleet["disabled_ok"]:
+            print(
+                "fleet fault-site overhead guard FAILED: fault-free "
+                "fleet sites exceed 1%% of the cold codegen path",
                 file=sys.stderr,
             )
             sys.exit(1)

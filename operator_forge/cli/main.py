@@ -541,7 +541,7 @@ _operator_forge() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     case "$prev" in
         operator-forge)
-            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace" -- "$cur"));;
+            COMPREPLY=($(compgen -W "init create edit init-config update completion version preview validate vet test batch serve daemon connect fleet fleet-status watch cache cache-server stats explain trace" -- "$cur"));;
         create)
             COMPREPLY=($(compgen -W "api webhook" -- "$cur"));;
         init-config)
@@ -560,12 +560,12 @@ complete -F _operator_forge operator-forge
 """
 
 _ZSH_COMPLETION = """#compdef operator-forge
-_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace)' '*: :_files'
+_arguments '1: :(init create edit init-config update completion version preview validate vet test batch serve daemon connect fleet fleet-status watch cache cache-server stats explain trace)' '*: :_files'
 """
 
 _FISH_COMPLETION = """# fish completion for operator-forge
 complete -c operator-forge -f -n __fish_use_subcommand \
-    -a 'init create edit init-config update completion version preview validate vet test batch serve daemon connect watch cache cache-server stats explain trace'
+    -a 'init create edit init-config update completion version preview validate vet test batch serve daemon connect fleet fleet-status watch cache cache-server stats explain trace'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from create' -a 'api webhook'
 complete -c operator-forge -f -n '__fish_seen_subcommand_from init-config' \
     -a 'standalone collection component'
@@ -826,7 +826,75 @@ def cmd_daemon(args: argparse.Namespace) -> int:
     Bazel-server analogue."""
     from ..serve.daemon import serve_daemon
 
-    return serve_daemon(args.listen, clients=args.clients)
+    return serve_daemon(
+        args.listen, clients=args.clients, fleet=args.fleet
+    )
+
+
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """`fleet`: the fault-tolerant coordinator over N daemons — daemons
+    register with heartbeat leases (one missed lease: suspect; two:
+    evicted), client jobs route by project-namespace affinity with
+    work-stealing for cold trees, an in-flight submission whose daemon
+    dies is re-dispatched idempotently to a healthy one (bounded
+    deterministic retry, then in-process quarantine), and SIGTERM
+    drains every daemon, answers queued clients busy, and exits 0.
+    The Bazel --remote_executor analogue."""
+    from ..serve.fleet import serve_fleet
+
+    return serve_fleet(
+        args.listen, lease=args.lease, clients=args.clients
+    )
+
+
+def cmd_fleet_status(args: argparse.Namespace) -> int:
+    """`fleet-status`: the fleet observability surface — per-daemon
+    lease age, in-flight jobs, degrade gauges, and the eviction/
+    re-dispatch counters — from a running coordinator's stats op, in
+    stable key order.  With --json, that fleet surface as one JSON
+    object (the full stats document is available from the `stats` op
+    via `connect`)."""
+    import json as _json
+
+    from ..serve.fleet import fleet_status
+
+    try:
+        stats = fleet_status(args.addr)
+    except (OSError, ConnectionError) as exc:
+        print(f"error: coordinator at {args.addr}: {exc}",
+              file=sys.stderr)
+        return 1
+    fleet = stats.get("fleet")
+    if args.json:
+        print(_json.dumps(stats if fleet is None else fleet))
+        return 0 if fleet is not None else 1
+    if fleet is None:
+        print("error: no fleet surface in the stats payload "
+              "(is this a coordinator?)", file=sys.stderr)
+        return 1
+    print(
+        f"fleet: {fleet['listen']} lease={fleet['lease_s']:g}s "
+        f"members={len(fleet['members'])} "
+        f"queued={fleet['queued_requests']} "
+        f"affinities={fleet['affinities']}"
+    )
+    for member_id, m in fleet["members"].items():
+        print(
+            f"  {member_id}  {m['addr']}  {m['state']}"
+            f"{' degraded' if m['degraded'] else ''}  "
+            f"lease_age={m['lease_age_s']:.2f}s  "
+            f"in_flight={m['in_flight']}/{m['capacity']}  "
+            f"queued={m['queued']}  dispatched={m['dispatched']}"
+        )
+    counters = fleet["counters"]
+    print(
+        "  counters: "
+        + " ".join(
+            f"{name.split('.', 1)[1]}={counters[name]}"
+            for name in sorted(counters)
+        )
+    )
+    return 0
 
 
 def cmd_connect(args: argparse.Namespace) -> int:
@@ -1349,7 +1417,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="concurrent-connection ceiling (default: "
              "OPERATOR_FORGE_DAEMON_CLIENTS, 64)",
     )
+    p_daemon.add_argument(
+        "--fleet", default=None, metavar="ADDR",
+        help="register with (and heartbeat to) the fleet coordinator "
+             "at this address; re-registers automatically across "
+             "coordinator restarts",
+    )
     p_daemon.set_defaults(func=cmd_daemon)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="coordinate a fleet of daemons: heartbeat-leased "
+             "membership, project-affinity routing with work-stealing, "
+             "idempotent re-dispatch when a daemon dies mid-run, and "
+             "fleet-wide SIGTERM drain",
+    )
+    p_fleet.add_argument(
+        "--listen", required=True, metavar="ADDR",
+        help="unix:/path/to.sock (or any path) for a unix socket, "
+             "host:port for TCP (port 0 picks a free port)",
+    )
+    p_fleet.add_argument(
+        "--lease", type=float, default=None, metavar="S",
+        help="heartbeat lease seconds (default: "
+             "OPERATOR_FORGE_FLEET_LEASE_S, 5); one missed lease marks "
+             "a daemon suspect, a second evicts it",
+    )
+    p_fleet.add_argument(
+        "--clients", type=int, default=None, metavar="N",
+        help="concurrent-connection ceiling (default: "
+             "OPERATOR_FORGE_FLEET_CLIENTS, 128)",
+    )
+    p_fleet.set_defaults(func=cmd_fleet)
+
+    p_fleet_status = sub.add_parser(
+        "fleet-status",
+        help="one stats round trip to a running coordinator: "
+             "per-daemon lease age, in-flight load, degrade flags, and "
+             "the eviction/re-dispatch counters",
+    )
+    p_fleet_status.add_argument(
+        "--addr", required=True, metavar="ADDR",
+        help="the coordinator's listen address (unix:/path or "
+             "host:port)",
+    )
+    p_fleet_status.add_argument(
+        "--json", action="store_true",
+        help="print the fleet surface (members, lease ages, counters) "
+             "as one JSON object in stable key order",
+    )
+    p_fleet_status.set_defaults(func=cmd_fleet_status)
 
     p_connect = sub.add_parser(
         "connect",

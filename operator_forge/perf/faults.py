@@ -64,6 +64,20 @@ kind                 planted site           effect when fired
 ``envtest.storm``    ``envtest.pump``       the reconcile pump injects a full
                                             resync — every live workload
                                             requeued (idempotence path)
+``fleet.daemon_crash`` ``dispatch``         the fleet coordinator's dispatch
+                                            connection is severed after the
+                                            job was sent but before its
+                                            response is read (daemon host
+                                            death mid-run: re-dispatch path)
+``fleet.heartbeat_lost`` ``lease``          one received heartbeat is dropped
+                                            without refreshing the daemon's
+                                            lease (lost packet: the lease
+                                            ages toward suspect; the next
+                                            beat recovers it)
+``fleet.dispatch_hang`` ``route``           the dispatch to the routed daemon
+                                            sleeps past the fleet dispatch
+                                            deadline (hung daemon:
+                                            deadline-then-re-dispatch path)
 ===================  =====================  ================================
 
 Hit counters are per-process: forked pool workers restart from zero
@@ -105,6 +119,9 @@ KINDS = (
     "sched.preempt",
     "envtest.conflict",
     "envtest.storm",
+    "fleet.daemon_crash",
+    "fleet.heartbeat_lost",
+    "fleet.dispatch_hang",
 )
 
 

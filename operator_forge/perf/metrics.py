@@ -43,6 +43,11 @@ _counters: dict = {}
 _gauges: dict = {}
 _callback_gauges: dict = {}
 _histograms: dict = {}
+#: named payload providers merged into the full observability report —
+#: the daemon registers its session surface, the fleet coordinator its
+#: member table — so `operator-forge stats` and the serve ``stats`` op
+#: render one document without this module knowing either subsystem
+_stats_sources: dict = {}
 
 
 def _new_lock_after_fork() -> None:
@@ -215,6 +220,36 @@ def unregister_gauge(name: str) -> None:
         _callback_gauges.pop(name, None)
 
 
+def register_stats_source(name: str, fn) -> None:
+    """``fn()`` is called per stats render and its result becomes the
+    report's ``name`` key (the daemon's per-session queue surface, the
+    fleet coordinator's per-daemon lease/in-flight table).  Shared by
+    the serve ``stats`` op and ``operator-forge stats``/`fleet-status`,
+    so a registered surface appears on every stats transport at once."""
+    with _lock:
+        _stats_sources[name] = fn
+
+
+def unregister_stats_source(name: str) -> None:
+    with _lock:
+        _stats_sources.pop(name, None)
+
+
+def stats_sources() -> dict:
+    """The registered source payloads, rendered now, in stable (sorted
+    name) order; a source that raises is skipped — a stats render must
+    never fail because one subsystem's snapshot did."""
+    with _lock:
+        sources = dict(_stats_sources)
+    out = {}
+    for name in sorted(sources):
+        try:
+            out[name] = sources[name]()
+        except Exception:
+            pass
+    return out
+
+
 def histogram(name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
     with _lock:
         inst = _histograms.get(name)
@@ -250,6 +285,7 @@ def reset() -> None:
         _gauges.clear()
         _callback_gauges.clear()
         _histograms.clear()
+        _stats_sources.clear()
 
 
 def snapshot() -> dict:
@@ -346,10 +382,14 @@ def report() -> dict:
     from . import spans
     from .depgraph import GRAPH
 
-    return {
+    out = {
         "cache": cache_report(),
         "graph": GRAPH.counters(),
         "metrics": snapshot(),
         "spans": spans.snapshot(),
         "tiers": tier_report(),
     }
+    # registered subsystem surfaces (daemon sessions, fleet members)
+    # ride along as extra top-level keys, sorted after the fixed five
+    out.update(stats_sources())
+    return out

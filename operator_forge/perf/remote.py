@@ -78,6 +78,7 @@ _BACKOFF_S = 0.05
 DEFAULT_TIMEOUT_S = 2.0
 DEFAULT_RETRIES = 1
 DEFAULT_QUEUE_DEPTH = 256
+DEFAULT_IDLE_S = 300.0
 
 _OPS = (b"G", b"P", b"H")
 
@@ -104,6 +105,22 @@ def queue_depth() -> int:
     return env_number(
         "OPERATOR_FORGE_REMOTE_QUEUE", DEFAULT_QUEUE_DEPTH,
         cast=int, minimum=1,
+    )
+
+
+def idle_timeout_s() -> float:
+    """Server-side idle read deadline per connection
+    (``OPERATOR_FORGE_CACHE_SERVER_IDLE_S``, default 300s; <= 0
+    disables).  A client that connects and goes silent previously held
+    its handler thread forever — a slow but unbounded leak under
+    connection churn; past the deadline the server answers the
+    standard ``E`` response and closes that one connection.  The
+    default is generous: a healthy client's requests are milliseconds
+    apart, and a client whose pooled connection is idle-closed simply
+    reconnects on its next round trip (the bounded-retry path)."""
+    return env_number(
+        "OPERATOR_FORGE_CACHE_SERVER_IDLE_S", DEFAULT_IDLE_S,
+        minimum=None,
     )
 
 
@@ -344,10 +361,29 @@ class CacheServer:
     def _serve_conn(self, conn) -> None:
         from . import metrics
 
+        idle = idle_timeout_s()
+        if idle > 0:
+            # the idle read deadline: a silent client must not hold
+            # this handler thread forever (it also bounds a peer
+            # trickling one frame byte-by-byte)
+            try:
+                conn.settimeout(idle)
+            except OSError:
+                pass
         try:
             while not self._closing:
                 try:
                     body = _recv_frame(conn)
+                except socket.timeout:
+                    # idle past the deadline: answer once with the
+                    # standard error response, close THIS connection
+                    metrics.counter("cache_server.idle_closed").inc()
+                    self._respond_error(
+                        conn,
+                        f"idle connection closed after {idle:g}s "
+                        "without a complete frame",
+                    )
+                    return
                 except ConnectionError:
                     return  # clean EOF or torn frame: drop the conn
                 except ProtocolError as exc:

@@ -22,7 +22,7 @@ import time
 
 from ..perf import n_jobs, spans, workers
 from .jobs import BatchManifestError, Job, load_manifest  # noqa: F401
-from .runner import run_group
+from .runner import record_fenceable_roots, run_group
 
 
 def _overlaps(a: str, b: str) -> bool:
@@ -103,6 +103,10 @@ def run_batch(jobs) -> list:
             for root in job.writes():
                 if root not in fresh_roots and not os.path.isdir(root):
                     fresh_roots.append(root)
+        # created-from-absent roots become eligible for the fleet's
+        # fence reset: the fence may only ever delete what some run in
+        # this process brought into existence
+        record_fenceable_roots(fresh_roots)
         payloads.append((group, fresh_roots))
     with spans.span("serve.batch"):
         per_group = workers.map_ordered(

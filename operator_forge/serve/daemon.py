@@ -143,6 +143,21 @@ def _request_roots(req: dict, base_dir: str) -> tuple:
     take the locks, and spec normalization is path arithmetic, far
     below one job's tree-state snapshot cost."""
     op = req.get("op") or ("job" if "command" in req else None)
+    if op == "fence":
+        # the fleet's zombie fence: its roots are WRITE-locked so the
+        # request queues behind any in-flight (or abandoned-but-still-
+        # running) request touching those trees, and its reset runs
+        # only once they are quiet
+        roots = req.get("roots")
+        reset = req.get("reset") or []
+        if not isinstance(roots, list) or not isinstance(reset, list):
+            return (), ()
+        try:
+            return (), tuple(sorted({
+                os.path.abspath(str(p)) for p in list(roots) + list(reset)
+            }))
+        except (TypeError, ValueError):
+            return (), ()
     if op == "job":
         specs = [
             req.get("job") if "job" in req
@@ -234,11 +249,24 @@ class _PathLocks:
 
 
 class ForgeDaemon:
-    """The multi-client daemon: listener + sessions + fair scheduler."""
+    """The multi-client daemon: listener + sessions + fair scheduler.
 
-    def __init__(self, listen: str, clients=None):
+    With ``fleet`` set (a coordinator address), the daemon additionally
+    maintains a *fleet link*: one background connection to the
+    coordinator that registers this daemon (address + capacity) and
+    then heartbeats on a fraction of the fleet lease interval, carrying
+    the coordinator's placement signal — in-flight count, queued
+    requests, and the PR 7 ``workers.degraded`` flag.  The link is
+    self-healing: a coordinator restart (or dropped connection) is
+    re-registered with bounded deterministic backoff, and the daemon
+    keeps serving its direct clients throughout — fleet membership is
+    additive, never load-bearing for local correctness."""
+
+    def __init__(self, listen: str, clients=None, fleet: str = None):
         self.spec = parse_listen(listen)
         self._max_clients = clients if clients else max_clients()
+        self.fleet_addr = fleet
+        self._fleet_thread = None
         self.base_dir = os.getcwd()
         self._listener = None
         self._accept_thread = None
@@ -316,6 +344,12 @@ class ForgeDaemon:
                 name="daemon-maintenance",
             )
             self._maintenance.start()
+        if self.fleet_addr:
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_link_loop, daemon=True,
+                name="daemon-fleet-link",
+            )
+            self._fleet_thread.start()
 
     def start(self) -> None:
         """Bind and accept on a background thread (tests, bench).  The
@@ -558,6 +592,75 @@ class ForgeDaemon:
                 server.request_shutdown()
                 self.stop()
 
+    # -- fleet link ------------------------------------------------------
+
+    def _fleet_load(self) -> tuple:
+        """(in_flight, queued) — the heartbeat's load snapshot."""
+        with self._cond:
+            in_flight = sum(1 for s in self._sessions if s.busy)
+            return in_flight, self._queued
+
+    def _fleet_link_loop(self) -> None:
+        """Register with the coordinator, then heartbeat at a third of
+        the lease interval (two beats fit inside one lease, so a single
+        dropped packet cannot mark a healthy daemon suspect).  Any
+        transport failure tears the link down and re-registers with
+        capped deterministic backoff; local serving is unaffected."""
+        from ..perf import workers
+        from .fleet import lease_seconds
+
+        interval = max(0.05, lease_seconds() / 3.0)
+        client = None
+        member_id = None
+        backoff = 0
+        while not self._stop_event.is_set():
+            try:
+                if client is None:
+                    client = DaemonClient(self.fleet_addr)
+                    ack = client.request({
+                        "op": "fleet.register",
+                        "addr": self.address(),
+                        "capacity": daemon_workers(),
+                    })
+                    if not ack.get("ok"):
+                        raise ConnectionError(
+                            ack.get("error", "registration refused")
+                        )
+                    member_id = ack.get("member")
+                    # the coordinator's lease is authoritative: a
+                    # coordinator started with --lease (or different
+                    # env) would otherwise suspect/evict daemons
+                    # beating on their own env-derived cadence
+                    lease = ack.get("lease_s")
+                    if isinstance(lease, (int, float)) and lease > 0:
+                        interval = max(0.05, float(lease) / 3.0)
+                    metrics.counter("daemon.fleet_registrations").inc()
+                    backoff = 0
+                in_flight, queued = self._fleet_load()
+                ack = client.request({
+                    "op": "fleet.heartbeat",
+                    "member": member_id,
+                    "in_flight": in_flight,
+                    "queued": queued,
+                    "degraded": bool(
+                        workers.pool_state()["degraded"]
+                    ),
+                })
+                if not ack.get("ok"):
+                    raise ConnectionError(
+                        ack.get("error", "heartbeat refused")
+                    )
+            except (OSError, ConnectionError, ValueError):
+                if client is not None:
+                    client.close()
+                client = None
+                member_id = None
+                backoff = min(backoff + 1, 5)  # capped, deterministic
+            if self._stop_event.wait(interval * (1 + backoff)):
+                break
+        if client is not None:
+            client.close()
+
     # -- maintenance -----------------------------------------------------
 
     def _maintenance_loop(self) -> None:
@@ -621,6 +724,10 @@ class ForgeDaemon:
         thread = self._accept_thread
         if thread is not None and thread is not current:
             thread.join(5.0)
+        thread = self._fleet_thread
+        if thread is not None and thread is not current:
+            # _on_drain set _stop_event, which breaks the beat wait
+            thread.join(5.0)
         if self.spec[0] == "unix":
             try:
                 os.unlink(self.spec[1])
@@ -634,17 +741,20 @@ class ForgeDaemon:
         self._stop_done.set()
 
 
-def serve_daemon(listen: str, clients=None) -> int:
+def serve_daemon(listen: str, clients=None, fleet: str = None) -> int:
     """The ``operator-forge daemon`` entry point: bind, print one
     status line on stderr, serve until SIGTERM/SIGINT (or a client's
-    shutdown op), then drain and exit 0."""
+    shutdown op), then drain and exit 0.  With ``fleet`` set, the
+    daemon registers with (and heartbeats to) that coordinator."""
     import sys
 
-    daemon = ForgeDaemon(listen, clients=clients)
+    daemon = ForgeDaemon(listen, clients=clients, fleet=fleet)
     daemon._bind()
     print(
         f"daemon: listening on {daemon.address()} "
-        f"(max {daemon._max_clients} clients)",
+        f"(max {daemon._max_clients} clients"
+        + (f", fleet {fleet}" if fleet else "")
+        + ")",
         file=sys.stderr, flush=True,
     )
     installed = []
@@ -680,26 +790,82 @@ def serve_daemon(listen: str, clients=None) -> int:
 # -- client ----------------------------------------------------------------
 
 
+#: deterministic backoff step between client reconnect attempts
+_CLIENT_BACKOFF_S = 0.05
+
+
+def client_retries() -> int:
+    """Bounded reconnect budget for :class:`DaemonClient`
+    (``OPERATOR_FORGE_DAEMON_RETRIES``, default 2): how many extra
+    connect (or reconnect-and-resend) attempts a client makes before a
+    transport failure surfaces.  The same knob pattern as the remote
+    tier's ``OPERATOR_FORGE_REMOTE_RETRIES``."""
+    return env_number(
+        "OPERATOR_FORGE_DAEMON_RETRIES", 2, cast=int
+    )
+
+
 class DaemonClient:
     """One connection to a running daemon.  Requests go out as JSON
     lines; responses come back one JSON object per line, each echoing
     the request's ``id`` (``busy`` rejections may arrive ahead of an
     earlier queued request's answer — correlate by id when
-    pipelining)."""
+    pipelining).
 
-    def __init__(self, addr: str, timeout=None):
-        spec = parse_listen(addr)
+    The transport self-heals across a daemon bounce: the initial
+    connect retries with bounded deterministic backoff
+    (``OPERATOR_FORGE_DAEMON_RETRIES`` × ``0.05s*attempt``), and
+    :meth:`request` — on a connect/read failure mid-round-trip —
+    reconnects and re-sends within the same budget.  Re-sending is safe
+    because every job is idempotent by construction (deterministic ids,
+    content-keyed replay): a re-submitted job either replays its
+    recorded result or recomputes the identical bytes.  The raw relay
+    surface (:meth:`send_line`/:meth:`read_line`) never retries — a
+    pass-through (``operator-forge connect``) must see the real stream."""
+
+    def __init__(self, addr: str, timeout=None, retries=None):
+        self._addr = addr
+        self._timeout = timeout
+        self._retries = (
+            client_retries() if retries is None else max(0, int(retries))
+        )
+        self._sock = None
+        self._reader = None
+        self._connect_with_retry()
+
+    def _connect_once(self) -> None:
+        spec = parse_listen(self._addr)
         if spec[0] == "unix":
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            if timeout:
-                sock.settimeout(timeout)
-            sock.connect(spec[1])
+            if self._timeout:
+                sock.settimeout(self._timeout)
+            try:
+                sock.connect(spec[1])
+            except BaseException:
+                sock.close()
+                raise
         else:
             sock = socket.create_connection(
-                (spec[1], spec[2]), timeout=timeout
+                (spec[1], spec[2]), timeout=self._timeout
             )
         self._sock = sock
         self._reader = sock.makefile("r", encoding="utf-8")
+
+    def _connect_with_retry(self) -> None:
+        budget = self._retries + 1
+        for attempt in range(budget):
+            if attempt:
+                time.sleep(_CLIENT_BACKOFF_S * attempt)  # deterministic
+            try:
+                self._connect_once()
+                return
+            except (OSError, ConnectionError):
+                if attempt + 1 >= budget:
+                    raise
+
+    def _reconnect(self) -> None:
+        self.close()
+        self._connect_once()
 
     def send(self, payload: dict) -> None:
         self._sock.sendall(
@@ -741,12 +907,62 @@ class DaemonClient:
             pass
 
     def request(self, payload: dict) -> dict:
-        """One round trip (non-streaming ops)."""
-        self.send(payload)
-        response = self.read()
-        if response is None:
-            raise ConnectionError("daemon closed the connection")
-        return response
+        """One round trip (non-streaming ops), surviving a daemon
+        bounce: a connect/read failure mid-round-trip reconnects with
+        bounded deterministic backoff and re-sends (jobs are
+        idempotent — see the class docstring), so ``batch --addr``
+        outlives a coordinator-initiated daemon restart."""
+        budget = self._retries + 1
+        last = None
+        for attempt in range(budget):
+            if attempt:
+                time.sleep(_CLIENT_BACKOFF_S * attempt)  # deterministic
+                try:
+                    self._reconnect()
+                except (OSError, ConnectionError) as exc:
+                    last = exc
+                    continue
+            try:
+                self.send(payload)
+                response = self.read()
+                # correlate by id when the request carries one: an
+                # unsolicited line (a drained-shutdown notice buffered
+                # before a bounce) must never be mistaken for this
+                # request's answer.  Bounded: a flood of unrelated
+                # lines is a protocol violation, not a wait-forever
+                want = payload.get("id")
+                skips = 0
+                while (
+                    want is not None and response is not None
+                    and response.get("id") != want and skips < 64
+                ):
+                    response = self.read()
+                    skips += 1
+                if (
+                    want is not None and response is not None
+                    and response.get("id") != want
+                ):
+                    # 64 unrelated lines without our answer is a
+                    # protocol violation — surface it as a transport
+                    # failure (the bounded reconnect gets a clean
+                    # buffer) rather than handing the caller some
+                    # other request's payload
+                    raise ConnectionError(
+                        "protocol violation: no response matching "
+                        f"id {want!r} within 64 lines"
+                    )
+            except (OSError, ConnectionError, ValueError) as exc:
+                # ValueError covers a line torn mid-JSON by the dying
+                # daemon; the re-sent request reads a whole fresh line
+                last = exc
+                continue
+            if response is not None:
+                return response
+            last = ConnectionError("daemon closed the connection")
+        raise ConnectionError(
+            f"daemon at {self._addr}: {last} "
+            f"(after {budget} attempt(s))"
+        )
 
     def stream(self, payload: dict):
         """Send a streaming op (watch) and yield every response line
@@ -761,14 +977,16 @@ class DaemonClient:
                 return
 
     def close(self) -> None:
-        try:
-            self._reader.close()
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self

@@ -1,0 +1,1277 @@
+"""``operator-forge fleet`` — the fault-tolerant fleet coordinator.
+
+PR 9 gave the fleet a shared artifact tier (the remote cache), PR 10
+gave one host a multi-client daemon.  This module is the missing
+production piece between them — the Bazel-remote-execution-shaped
+scheduler: N daemons register with one coordinator, client jobs route
+by project-namespace affinity (warm per-tree caches) with work-stealing
+for cold trees, and every health decision is lease-driven so killing
+any daemon mid-batch is invisible to clients and provably
+byte-identical to a local cache-off recompute.
+
+Architecture:
+
+- **membership by lease** — a daemon started with ``--fleet <addr>``
+  opens one registration connection, sends ``fleet.register`` (its own
+  listen address + capacity), then heartbeats at a third of
+  ``OPERATOR_FORGE_FLEET_LEASE_S``.  Each beat carries the placement
+  signal: in-flight count, queued requests, and the PR 7
+  ``workers.degraded`` flag.  A lease that ages past one interval marks
+  the daemon *suspect* (deprioritized for placement); past two, it is
+  *evicted* — as is a daemon whose registration connection drops.  A
+  recovered daemon simply re-registers;
+- **routing** — a submission's affinity key is the hash of its target
+  trees (the same ``serve.job.<hash>`` project namespaces PR 10
+  partitions replay records by), so repeat work over one tree lands on
+  the daemon whose mem-tier already holds that tree's records.  Cold
+  keys (and keys whose preferred daemon is suspect, degraded, or at
+  capacity) *work-steal*: the least-loaded healthy daemon takes them,
+  deterministically (load, then member id).  Submissions whose trees
+  overlap an in-flight dispatch are forced onto that dispatch's daemon,
+  where the PR 10 path locks serialize them — the fleet-level analogue
+  of the daemon's cross-session conflict rule;
+- **re-dispatch** — submissions are idempotent: deterministic job ids
+  (PR 3's manifest model, :func:`~operator_forge.serve.jobs.specs_key`)
+  over content-keyed replay mean re-running a submission reproduces its
+  bytes.  So when a daemon dies mid-run (connection severed, read
+  deadline tripped), the coordinator resets any output root that did
+  not exist at admission (the PR 7 crash-retry rule: scaffolding's
+  preserve-on-exists semantics must never adopt a dead attempt's
+  partial tree) and re-dispatches to a healthy daemon, with bounded
+  deterministic retry/backoff (``OPERATOR_FORGE_FLEET_RETRIES`` ×
+  0.05s·attempt).  The reset is *fenced* by a liveness probe: a member
+  that still answers a fresh ping after its dispatch failed (a severed
+  connection, not a dead host) may harbor a zombie writer, so its
+  retry pins the same daemon behind a ``fence`` op — the fence
+  write-locks the submission's trees (queueing behind the zombie's
+  path locks) and resets the fresh roots server-side once they are
+  quiet; only a probe-dead member's retry resets locally and
+  re-routes.  A submission that exhausts the budget is
+  *quarantined*: executed once in-process by the coordinator itself
+  (mirroring the workers layer's poison-task quarantine-to-thread), so
+  a job that kills every daemon it touches still completes without
+  ricocheting through the fleet forever.  A daemon's ``busy`` answer
+  is backpressure, not failure: retried within the same budget, then
+  propagated to the client;
+- **chaos sites** — ``fleet.daemon_crash@dispatch`` (the dispatch
+  connection severed after the job is sent), ``fleet.heartbeat_lost@
+  lease`` (a received beat dropped without refreshing the lease), and
+  ``fleet.dispatch_hang@route`` (the dispatch sleeps past the
+  ``OPERATOR_FORGE_FLEET_DISPATCH_S`` deadline) extend
+  :mod:`operator_forge.perf.faults`; every one is recoverable, so
+  chaos runs — including SIGKILL of a real daemon subprocess mid-batch
+  — must stay byte-identical to a cache-off serial recompute (bench
+  ``fleet`` section + the commit-check live-fleet step);
+- **drain** — SIGTERM/SIGINT (or a client's ``shutdown`` op) ride the
+  one shared :func:`~operator_forge.serve.server.request_shutdown`
+  machinery: the listener closes, in-flight dispatches finish and are
+  answered, *queued* clients are answered ``busy`` with a
+  ``retry_after`` hint (never silently dropped), every registered
+  daemon is sent a ``shutdown`` op and drains, every session gets the
+  final drained-shutdown line, and the coordinator exits 0.
+
+Observability: the coordinator registers a ``fleet`` stats source
+(per-daemon lease age, in-flight, degrade flag, dispatch/eviction/
+re-dispatch counters, stable key order) surfaced by the serve ``stats``
+op, ``operator-forge stats``, and ``operator-forge fleet-status``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+
+from ..perf import env_number, faults, metrics, spans
+from ..perf.remote import parse_listen
+from . import server
+from .batch import _overlaps, run_batch
+from .daemon import DaemonClient
+from .jobs import BatchManifestError, jobs_from_specs, specs_key
+from .runner import _scope_label, run_job
+from .server import dispatch_request
+from .session import CONNECT_RETRY_AFTER_S, Session
+
+DEFAULT_LEASE_S = 5.0
+DEFAULT_RETRIES = 2
+DEFAULT_MAX_CLIENTS = 128
+DEFAULT_GLOBAL_QUEUE = 256
+#: deterministic backoff step between re-dispatch attempts (seconds)
+_BACKOFF_S = 0.05
+
+
+def lease_seconds() -> float:
+    """The heartbeat lease interval (``OPERATOR_FORGE_FLEET_LEASE_S``,
+    default 5s): a daemon whose lease ages past one interval is
+    suspect, past two is evicted.  Daemons beat at a third of it, so a
+    single dropped beat can never mark a healthy daemon suspect."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_LEASE_S", DEFAULT_LEASE_S, minimum=0.2
+    )
+
+
+def fleet_retries() -> int:
+    """Bounded re-dispatch budget per submission
+    (``OPERATOR_FORGE_FLEET_RETRIES``, default 2): how many times a
+    failed dispatch moves to another daemon before the submission is
+    quarantined to in-process execution."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_RETRIES", DEFAULT_RETRIES, cast=int
+    )
+
+
+def dispatch_timeout() -> float:
+    """Read deadline per dispatch round trip
+    (``OPERATOR_FORGE_FLEET_DISPATCH_S``; 0 or unset disables).  Off by
+    default: a dead daemon is detected by its connection dropping, and
+    a legitimate cold batch can run long — enable it to also catch
+    *hung* daemons (the ``fleet.dispatch_hang`` path)."""
+    return env_number("OPERATOR_FORGE_FLEET_DISPATCH_S", 0.0)
+
+
+def fleet_workers() -> int:
+    """Coordinator dispatcher-thread count
+    (``OPERATOR_FORGE_FLEET_WORKERS``; default 8).  Dispatchers mostly
+    wait on daemon round trips, so the default is wider than the
+    daemon's CPU-bound dispatcher pool."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_WORKERS", 8, cast=int, minimum=1
+    )
+
+
+def max_clients() -> int:
+    """Concurrent-connection ceiling (``OPERATOR_FORGE_FLEET_CLIENTS``,
+    default 128; daemon registration connections count too)."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_CLIENTS", DEFAULT_MAX_CLIENTS,
+        cast=int, minimum=1,
+    )
+
+
+def global_queue_depth() -> int:
+    """Coordinator-wide admission bound (``OPERATOR_FORGE_FLEET_QUEUE``,
+    default 256)."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_QUEUE", DEFAULT_GLOBAL_QUEUE,
+        cast=int, minimum=1,
+    )
+
+
+def session_queue_depth() -> int:
+    # the per-session bound is a transport property, not a fleet one:
+    # share the daemon's knob
+    from .daemon import session_queue_depth as daemon_depth
+
+    return daemon_depth()
+
+
+def _hang_seconds() -> float:
+    """How long an injected ``fleet.dispatch_hang`` sleeps — the same
+    ``OPERATOR_FORGE_FAULT_HANG_S`` knob the workers layer uses."""
+    return env_number("OPERATOR_FORGE_FAULT_HANG_S", 30.0)
+
+
+class _Member:
+    """One registered daemon: its lease, load, and dispatch state."""
+
+    __slots__ = (
+        "id", "addr", "capacity", "session", "registered_at",
+        "last_beat", "suspect", "degraded", "queued",
+        "reported_in_flight", "in_flight", "dispatched",
+        "active_roots",
+    )
+
+    def __init__(self, member_id: str, addr: str, capacity: int,
+                 session):
+        self.id = member_id
+        self.addr = addr
+        self.capacity = max(1, capacity)
+        self.session = session
+        now = time.monotonic()
+        self.registered_at = now
+        self.last_beat = now
+        self.suspect = False
+        self.degraded = False
+        self.queued = 0
+        self.reported_in_flight = 0
+        self.in_flight = 0       # coordinator-side dispatch count
+        self.dispatched = 0      # lifetime submissions routed here
+        self.active_roots = []   # [(reads, writes)] per live dispatch
+
+
+def _conflicts(reads, writes, held_reads, held_writes) -> bool:
+    """The batch scheduler's conflict rule over two root sets: my
+    writes against everything held, my reads against held writes."""
+    for w in writes:
+        for other in held_reads + held_writes:
+            if _overlaps(w, other):
+                return True
+    for r in reads:
+        for other in held_writes:
+            if _overlaps(r, other):
+                return True
+    return False
+
+
+class FleetCoordinator:
+    """The coordinator: listener + sessions + health-driven scheduler."""
+
+    def __init__(self, listen: str, lease: float = None, clients=None):
+        self.spec = parse_listen(listen)
+        self._lease = lease
+        self._max_clients = clients if clients else max_clients()
+        self.base_dir = os.getcwd()
+        self._listener = None
+        self._accept_thread = None
+        self._dispatchers: list = []
+        self._monitor = None
+        self._stop_event = threading.Event()
+        self._cond = threading.Condition()
+        self._sessions: list = []
+        self._queued = 0        # pending client requests, under _cond
+        self._rr = 0            # round-robin cursor, under _cond
+        self._next_sid = 0
+        self._member_seq = 0
+        self._members: dict = {}   # member id -> _Member
+        self._affinity: dict = {}  # namespace label -> member id
+        #: (reads, writes) of quarantined submissions running
+        #: IN-PROCESS right now — consulted by _route's overlap check,
+        #: or a daemon could be handed a tree the coordinator itself
+        #: is still writing
+        self._local_roots: list = []
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        self._stop_done = threading.Event()
+
+    def lease_s(self) -> float:
+        return self._lease if self._lease else lease_seconds()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def address(self) -> str:
+        if self.spec[0] == "unix":
+            return self.spec[1]
+        host, port = self._listener.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def _bind(self) -> None:
+        if self.spec[0] == "unix":
+            path = self.spec[1]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.spec[1], self.spec[2]))
+        sock.listen(min(128, self._max_clients * 2))
+        # the accept loop wakes on its own to observe the drain flag
+        # (close/shutdown do not reliably break a blocked AF_UNIX
+        # accept — the daemon's listener carries the same note)
+        sock.settimeout(0.5)
+        self._listener = sock
+
+    def _boot(self) -> None:
+        spans.enable(True)
+        server._drain.clear()
+        self._stop_event.clear()
+        server.on_drain(self._on_drain)
+        server.register_stats_source("fleet", self._stats_payload)
+        metrics.register_gauge(
+            "fleet.members", lambda: len(self._members)
+        )
+        metrics.register_gauge(
+            "fleet.queued_requests", lambda: self._queued
+        )
+        for i in range(fleet_workers()):
+            thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"fleet-dispatch-{i}",
+            )
+            thread.start()
+            self._dispatchers.append(thread)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="fleet-monitor",
+        )
+        self._monitor.start()
+
+    def start(self) -> None:
+        """Bind and accept on a background thread (tests, bench); the
+        CLI uses :meth:`serve_forever`."""
+        if self._listener is None:
+            self._bind()
+        self._boot()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="fleet-accept",
+        )
+        self._accept_thread.start()
+
+    def serve_forever(self) -> None:
+        if self._listener is None:
+            self._bind()
+        self._boot()
+        self._accept_loop()
+
+    def _on_drain(self) -> None:
+        # may run in signal-handler context: tiny and non-blocking
+        try:
+            self._listener.close()
+        except (OSError, AttributeError):
+            pass
+        self._stop_event.set()
+        if self._cond.acquire(blocking=False):
+            try:
+                self._cond.notify_all()
+            finally:
+                self._cond.release()
+
+    def _accept_loop(self) -> None:
+        while not server.draining():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: draining
+            conn.settimeout(None)
+            if server.draining():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            with self._cond:
+                active = len(self._sessions)
+            if active >= self._max_clients:
+                metrics.counter("fleet.busy_rejections").inc()
+                payload = server._error(
+                    f"fleet coordinator at its {self._max_clients}-"
+                    "connection capacity", kind="busy",
+                )
+                payload["retry_after"] = CONNECT_RETRY_AFTER_S
+                try:
+                    conn.sendall(
+                        (json.dumps(payload) + "\n").encode("utf-8")
+                    )
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            with self._cond:
+                self._next_sid += 1
+                session = Session(self, conn, f"f{self._next_sid}")
+                session.member_id = None  # set by fleet.register
+                self._sessions.append(session)
+            session.start()
+
+    # -- membership (reader threads) -------------------------------------
+
+    def _register_member(self, session: Session, req: dict) -> None:
+        addr = str(req.get("addr") or "").strip()
+        req_id = req.get("id")
+        if not addr:
+            self._answer(session, server._error(
+                "fleet.register: addr is required", req_id))
+            return
+        try:
+            capacity = int(req.get("capacity") or 1)
+        except (TypeError, ValueError):
+            capacity = 1
+        with self._cond:
+            # a daemon bounce re-registers on the same address: the
+            # stale entry is replaced (its affinities clear with it)
+            for stale in [
+                m for m in self._members.values() if m.addr == addr
+            ]:
+                self._evict_locked(stale, counted=False)
+            self._member_seq += 1
+            member = _Member(
+                f"d{self._member_seq}", addr, capacity, session
+            )
+            self._members[member.id] = member
+            session.member_id = member.id
+        metrics.counter("fleet.registrations").inc()
+        self._answer(session, {
+            "ok": True, "op": "fleet.register", "member": member.id,
+            "lease_s": self.lease_s(),
+            **({"id": req_id} if req_id is not None else {}),
+        })
+
+    def _heartbeat(self, session: Session, req: dict) -> None:
+        req_id = req.get("id")
+        with self._cond:
+            member = self._members.get(session.member_id or "")
+        if member is None:
+            # evicted (or never registered): tell the daemon so its
+            # link re-registers instead of beating into the void
+            self._answer(session, server._error(
+                "fleet.heartbeat: not a registered member "
+                "(re-register)", req_id))
+            return
+        metrics.counter("fleet.heartbeats").inc()
+        if faults.fire("lease", "fleet.heartbeat_lost"):
+            # the beat is "lost on the wire": acknowledged but the
+            # lease is NOT refreshed, so it ages toward suspect; the
+            # next (un-dropped) beat recovers it
+            self._answer(session, {
+                "ok": True, "op": "fleet.heartbeat",
+                **({"id": req_id} if req_id is not None else {}),
+            })
+            return
+        with self._cond:
+            member.last_beat = time.monotonic()
+            if member.suspect:
+                member.suspect = False
+                metrics.counter("fleet.recoveries").inc()
+            member.queued = int(req.get("queued") or 0)
+            member.reported_in_flight = int(req.get("in_flight") or 0)
+            member.degraded = bool(req.get("degraded"))
+        self._answer(session, {
+            "ok": True, "op": "fleet.heartbeat",
+            **({"id": req_id} if req_id is not None else {}),
+        })
+
+    def _evict_locked(self, member: _Member, counted=True) -> None:
+        """Remove a member (caller holds ``_cond``): its affinities
+        clear so future routing re-decides, and any in-flight dispatch
+        to it will fail on its own connection and re-dispatch."""
+        self._members.pop(member.id, None)
+        for key in [
+            k for k, v in self._affinity.items() if v == member.id
+        ]:
+            del self._affinity[key]
+        if member.session is not None:
+            member.session.member_id = None
+        if counted:
+            metrics.counter("fleet.evictions").inc()
+
+    def _monitor_loop(self) -> None:
+        while True:
+            lease = self.lease_s()
+            if self._stop_event.wait(max(0.05, lease / 4.0)):
+                return
+            now = time.monotonic()
+            with self._cond:
+                for member in list(self._members.values()):
+                    age = now - member.last_beat
+                    if age > 2 * lease:
+                        # second missed lease: evicted.  In-flight
+                        # dispatches to it fail over on their own
+                        self._evict_locked(member)
+                    elif age > lease and not member.suspect:
+                        member.suspect = True
+                        metrics.counter("fleet.suspects").inc()
+                self._cond.notify_all()
+
+    # -- admission (reader threads) --------------------------------------
+
+    def _enqueue(self, session: Session, req: dict) -> None:
+        op = req.get("op")
+        if op == "fleet.register":
+            self._register_member(session, req)
+            return
+        if op == "fleet.heartbeat":
+            self._heartbeat(session, req)
+            return
+        rejected = None
+        with self._cond:
+            if server.draining():
+                rejected = "fleet coordinator is draining"
+            elif len(session.queue) >= session_queue_depth():
+                rejected = (
+                    f"session queue full "
+                    f"({session_queue_depth()} pending)"
+                )
+            elif self._queued >= global_queue_depth():
+                rejected = (
+                    f"admission queue full "
+                    f"({global_queue_depth()} pending)"
+                )
+            else:
+                session.queue.append((req, time.monotonic()))
+                self._queued += 1
+                metrics.counter("fleet.requests").inc()
+                self._cond.notify()
+        if rejected is not None:
+            session.reject_busy(req, rejected)
+
+    def _reader_finished(self, session: Session) -> None:
+        if session.member_id is not None:
+            # the registration connection dropped: the daemon process
+            # is gone (or cut off) — evict now rather than waiting two
+            # lease intervals for the lease to age out
+            with self._cond:
+                member = self._members.get(session.member_id)
+                if member is not None:
+                    self._evict_locked(member)
+        with self._cond:
+            self._cond.notify_all()
+        self._maybe_close(session)
+
+    def _maybe_close(self, session: Session) -> None:
+        with self._cond:
+            done = session.read_done and not session.busy and (
+                not session.queue or session.dead.is_set()
+            )
+            if done:
+                if session.queue:
+                    metrics.counter("serve.requests_abandoned").inc(
+                        len(session.queue)
+                    )
+                    self._queued -= len(session.queue)
+                    session.queue.clear()
+                if session in self._sessions:
+                    self._sessions.remove(session)
+                else:
+                    done = False
+        if done:
+            session.close()
+
+    # -- the scheduler ---------------------------------------------------
+
+    def _next_work(self):
+        with self._cond:
+            while True:
+                if server.draining():
+                    return None
+                n = len(self._sessions)
+                for offset in range(n):
+                    index = (self._rr + 1 + offset) % n
+                    session = self._sessions[index]
+                    if session.busy or not session.queue:
+                        continue
+                    if session.dead.is_set():
+                        continue
+                    self._rr = index
+                    req, waited = session.pop_request()
+                    self._queued -= 1
+                    session.busy = True
+                    return session, req, waited
+                self._cond.wait(0.5)
+
+    def _answer(self, session: Session, payload: dict) -> None:
+        try:
+            session.respond(payload)
+        except server._AbandonedRequest:
+            metrics.counter("serve.requests_abandoned").inc()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            work = self._next_work()
+            if work is None:
+                return
+            session, req, waited = work
+            metrics.histogram("fleet.queue_wait.seconds").observe(
+                waited
+            )
+            keep_going = True
+            try:
+                op = req.get("op") or (
+                    "job" if "command" in req else None
+                )
+                if session.dead.is_set():
+                    metrics.counter("serve.requests_abandoned").inc()
+                elif op in ("job", "batch"):
+                    with spans.span(f"fleet:{op}"):
+                        self._forward(session, req, op)
+                elif op in ("watch", "explain"):
+                    self._answer(session, server._error(
+                        f"op {op!r} is not routed by the fleet "
+                        "coordinator; connect to a daemon directly",
+                        req.get("id"),
+                    ))
+                else:
+                    keep_going = dispatch_request(
+                        req, self.base_dir, session.out_lock,
+                        session.respond_locked, 0.0,
+                    )
+            finally:
+                with self._cond:
+                    session.busy = False
+                    session.requests_total += 1
+                    self._cond.notify_all()
+            self._maybe_close(session)
+            if not keep_going:
+                # a client's shutdown op drains the WHOLE fleet
+                server.request_shutdown()
+                self.stop()
+
+    # -- routing ---------------------------------------------------------
+
+    def _route(self, affinity_key: str, reads, writes, excluded):
+        """Pick (and charge) a member for one dispatch attempt, or
+        ``None`` when no member is routable.  Caller releases via
+        :meth:`_release`.  Deterministic: overlap-forced first (trees
+        already in flight stay on their daemon, whose path locks
+        serialize them), then healthy affinity, then the least-loaded
+        healthy candidate (work-stealing), ties broken by member id."""
+        with self._cond:
+            # a quarantined submission running in-process holds its
+            # trees too: overlapping work must wait, not route
+            for held_reads, held_writes in self._local_roots:
+                if _conflicts(reads, writes, held_reads, held_writes):
+                    return None
+            # a submission overlapping an in-flight dispatch MUST land
+            # on that dispatch's member — two daemons writing one tree
+            # would bypass every path lock in the system
+            for member in sorted(
+                self._members.values(), key=lambda m: m.id
+            ):
+                for held_reads, held_writes in member.active_roots:
+                    if _conflicts(reads, writes,
+                                  held_reads, held_writes):
+                        if member.id in excluded:
+                            return None  # its attempt failed: let the
+                            # re-dispatch loop back off and re-route
+                        return self._charge_locked(
+                            member, affinity_key, reads, writes
+                        )
+            candidates = [
+                m for m in self._members.values()
+                if m.id not in excluded
+            ]
+            if not candidates:
+                return None
+            preferred = self._members.get(
+                self._affinity.get(affinity_key, "")
+            )
+            if (
+                preferred is not None
+                and preferred.id not in excluded
+                and not preferred.suspect
+                and not preferred.degraded
+                and preferred.in_flight < preferred.capacity
+            ):
+                chosen = preferred
+            else:
+                # work-stealing: a degraded daemon sheds load before
+                # it fails, a suspect one is routed only as last
+                # resort, the least-loaded healthy member wins
+                chosen = min(candidates, key=lambda m: (
+                    m.suspect, m.degraded,
+                    m.in_flight + m.queued, m.id,
+                ))
+                if preferred is not None and chosen is not preferred:
+                    metrics.counter("fleet.steals").inc()
+            return self._charge_locked(
+                chosen, affinity_key, reads, writes
+            )
+
+    def _charge_locked(self, member: _Member, affinity_key: str,
+                       reads, writes) -> _Member:
+        self._affinity[affinity_key] = member.id
+        member.in_flight += 1
+        member.dispatched += 1
+        member.active_roots.append((reads, writes))
+        return member
+
+    def _release(self, member: _Member, reads, writes) -> None:
+        with self._cond:
+            member.in_flight = max(0, member.in_flight - 1)
+            try:
+                member.active_roots.remove((reads, writes))
+            except ValueError:
+                pass
+            self._cond.notify_all()
+
+    # -- dispatch --------------------------------------------------------
+
+    def _forward(self, session: Session, req: dict, op: str) -> None:
+        req_id = req.get("id")
+        if op == "job":
+            specs = [
+                req.get("job") if "job" in req
+                else {k: v for k, v in req.items() if k != "op"}
+            ]
+        else:
+            specs = req.get("jobs")
+        try:
+            jobs = jobs_from_specs(specs, self.base_dir)
+        except BatchManifestError as exc:
+            self._answer(session, server._error(str(exc), req_id))
+            return
+        key = specs_key(jobs)
+        affinity_key = _scope_label(
+            tuple(sorted({job.target() for job in jobs}))
+        )
+        reads = tuple(sorted({
+            root for job in jobs for root in job.reads()
+        }))
+        writes = tuple(sorted({
+            root for job in jobs for root in job.writes()
+        }))
+        # the crash-retry rule (PR 7): output roots absent at admission
+        # are reset before any RE-dispatch, so a dead daemon's partial
+        # tree is never adopted by preserve-on-exists scaffolding
+        fresh_roots = [
+            root for root in writes if not os.path.isdir(root)
+        ]
+        if op == "job":
+            forward_req = {"op": "job", "job": jobs[0].to_spec()}
+        else:
+            forward_req = {
+                "op": "batch",
+                "jobs": [job.to_spec() for job in jobs],
+            }
+        forward_req["id"] = key  # the idempotency key travels with it
+
+        budget = fleet_retries()
+        excluded: set = set()
+        attempt = 0
+        pinned = None       # re-dispatch target forced by fencing
+        need_fence = False  # the pinned member must fence first
+        reset_next = True   # whether the next retry resets fresh roots
+        dispatch_failed = False  # a dispatch died with work possibly
+        #                          half-run (vs pure busy backpressure)
+        busy_response = None     # the last busy answer, for honest
+        #                          propagation when nothing ever failed
+        started = time.perf_counter()
+        while True:
+            if attempt:
+                time.sleep(_BACKOFF_S * attempt)  # deterministic
+                if reset_next:
+                    for root in fresh_roots:
+                        shutil.rmtree(root, ignore_errors=True)
+            reset_next = True
+            member = None
+            if pinned is not None:
+                stale = pinned
+                pinned = None
+                with self._cond:
+                    live = self._members.get(stale.id)
+                    if live is not None:
+                        self._charge_locked(
+                            live, affinity_key, reads, writes
+                        )
+                        member = live
+                if member is None:
+                    # the pinned daemon was evicted between the probe
+                    # and this retry — the zombie question is still
+                    # open, so the fence runs against its last known
+                    # address anyway: success means the roots were
+                    # reset behind its path locks and ANY daemon may
+                    # take the retry; a dead daemon fails both fence
+                    # and probe, restoring the safe local-reset path;
+                    # an alive-but-unfenceable one burns a bounded
+                    # attempt
+                    if self._fence_member(stale, reads, writes,
+                                          fresh_roots):
+                        need_fence = False
+                    elif self._probe_member(stale):
+                        if attempt >= budget:
+                            self._quarantine(
+                                session, req_id, op, jobs,
+                                fresh_roots, reads=reads,
+                                writes=writes, last_member=stale,
+                            )
+                            return
+                        attempt += 1
+                        reset_next = False
+                        pinned = stale
+                        need_fence = True
+                        continue
+                    else:
+                        for root in fresh_roots:
+                            shutil.rmtree(root, ignore_errors=True)
+                        need_fence = False
+            if member is None:
+                need_fence = False
+                member = self._route(affinity_key, reads, writes,
+                                     excluded)
+            if member is None:
+                if not self._members:
+                    if dispatch_failed:
+                        # a dispatch already died (and may have
+                        # half-run): the client's tree state is OURS
+                        # to finish — quarantine, never bounce the
+                        # mess back as busy
+                        self._quarantine(session, req_id, op, jobs,
+                                         fresh_roots, reads=reads,
+                                         writes=writes)
+                        return
+                    payload = server._error(
+                        "no daemons registered with the fleet; retry",
+                        req_id, kind="busy",
+                    )
+                    payload["retry_after"] = CONNECT_RETRY_AFTER_S
+                    self._answer(session, payload)
+                    return
+                if attempt >= budget:
+                    if not dispatch_failed and busy_response is not None:
+                        # only backpressure happened: nothing half-ran,
+                        # so the honest answer is busy, not a local run
+                        # that bypasses the fleet's admission control
+                        busy_response["id"] = req_id
+                        if req_id is None:
+                            busy_response.pop("id", None)
+                        self._answer(session, busy_response)
+                        return
+                    self._quarantine(session, req_id, op, jobs,
+                                     fresh_roots, reads=reads,
+                                     writes=writes)
+                    return
+                # members exist but every one is excluded (a lone
+                # daemon whose dispatch failed, possibly transiently):
+                # clear the exclusions so the next bounded attempt may
+                # retry it rather than quarantining early
+                excluded.clear()
+                attempt += 1
+                continue
+            if need_fence:
+                # the previous attempt may still be running on this
+                # member as a zombie: the fence queues behind its path
+                # locks and resets the fresh roots server-side, so the
+                # retry below starts from the same tree state a first
+                # dispatch would have
+                need_fence = False
+                if not self._fence_member(member, reads, writes,
+                                          fresh_roots):
+                    self._release(member, reads, writes)
+                    if attempt >= budget:
+                        self._quarantine(session, req_id, op, jobs,
+                                         fresh_roots, reads=reads,
+                                         writes=writes,
+                                         last_member=member)
+                        return
+                    if self._probe_member(member):
+                        pinned = member
+                        need_fence = True
+                        reset_next = False  # the zombie may still live
+                    else:
+                        with self._cond:
+                            live = self._members.get(member.id)
+                            if live is not None:
+                                self._evict_locked(live)
+                        excluded.add(member.id)
+                    attempt += 1
+                    continue
+            hung = faults.fire("route", "fleet.dispatch_hang")
+            try:
+                if hung:
+                    # a hung daemon: the dispatch sleeps past the
+                    # configured deadline, then the deadline verdict
+                    # drives the normal re-dispatch path
+                    deadline = dispatch_timeout() or _hang_seconds()
+                    time.sleep(min(deadline, _hang_seconds()))
+                    raise socket.timeout(
+                        "injected fault: fleet.dispatch_hang@route"
+                    )
+                response = self._dispatch_once(member, forward_req)
+            except (OSError, ConnectionError, ValueError):
+                # the dispatch failed with the submission possibly
+                # mid-run.  The fencing decision is a fresh liveness
+                # probe of the member:
+                #
+                # - DEAD (connect refused): the host is gone — no
+                #   writer can still touch the output trees, so the
+                #   retry resets the fresh roots and re-routes to a
+                #   healthy daemon (the SIGKILL recovery path);
+                # - ALIVE (a severed connection or a tripped dispatch
+                #   deadline, not a dead host): the submission may
+                #   STILL BE RUNNING there as a zombie writer, so
+                #   resetting roots here would race it.  The retry
+                #   pins the SAME daemon behind a fence op: the fence
+                #   write-locks the submission's trees (queueing
+                #   behind the zombie's path locks) and performs the
+                #   fresh-root reset server-side once they are quiet —
+                #   then the re-dispatch starts from first-attempt
+                #   tree state, race-free.
+                self._release(member, reads, writes)
+                dispatch_failed = True
+                if attempt >= budget:
+                    self._quarantine(session, req_id, op, jobs,
+                                     fresh_roots, reads=reads,
+                                     writes=writes, last_member=member)
+                    return
+                if self._probe_member(member):
+                    pinned = member
+                    need_fence = True
+                    reset_next = False  # the fence resets, serialized
+                    with self._cond:
+                        live = self._members.get(member.id)
+                        if live is not None and not live.suspect:
+                            live.suspect = True
+                            metrics.counter("fleet.suspects").inc()
+                else:
+                    with self._cond:
+                        live = self._members.get(member.id)
+                        if live is not None:
+                            self._evict_locked(live)
+                    excluded.add(member.id)
+                attempt += 1
+                metrics.counter("fleet.redispatches").inc()
+                continue
+            self._release(member, reads, writes)
+            if (
+                response.get("ok") is False
+                and response.get("error_kind") == "busy"
+            ):
+                # backpressure, not failure: the daemon is alive but
+                # full — retry within the budget, then propagate the
+                # busy answer honestly.  The busy member is EXCLUDED
+                # for the remaining attempts (not evicted): the failed
+                # attempt's _charge_locked just rewrote the affinity
+                # entry to point at it, and its heartbeat-reported
+                # queue depth refreshes far slower than the retry
+                # backoff, so without the exclusion every retry would
+                # re-route straight back to the one full daemon while
+                # idle siblings sit unused (with a single member, the
+                # all-excluded branch above clears the set and retries
+                # it anyway, still bounded)
+                if attempt >= budget:
+                    response["id"] = req_id
+                    if req_id is None:
+                        response.pop("id", None)
+                    self._answer(session, response)
+                    return
+                busy_response = response
+                excluded.add(member.id)
+                attempt += 1
+                metrics.counter("fleet.busy_retries").inc()
+                continue
+            break
+        metrics.histogram("fleet.dispatch.seconds").observe(
+            time.perf_counter() - started
+        )
+        metrics.counter("fleet.dispatches").inc()
+        if req_id is not None:
+            response["id"] = req_id
+        else:
+            response.pop("id", None)
+        self._answer(session, response)
+
+    def _probe_member(self, member: _Member) -> bool:
+        """The fencing probe: is the daemon at ``member.addr`` alive
+        right now?  A fresh connect + ping with a short deadline — the
+        answer decides whether a failed dispatch's retry may reset
+        output roots and re-route (dead: nothing can still be writing)
+        or must pin the same daemon without a reset (alive: a zombie
+        writer may still hold the trees, and only that daemon's path
+        locks can serialize the retry behind it)."""
+        try:
+            client = DaemonClient(
+                member.addr, timeout=min(2.0, self.lease_s()),
+                retries=0,
+            )
+        except (OSError, ConnectionError):
+            return False
+        try:
+            return bool(client.request({"op": "ping"}).get("ok"))
+        except (OSError, ConnectionError, ValueError):
+            return False
+        finally:
+            client.close()
+
+    def _fence_member(self, member: _Member, reads, writes,
+                      fresh_roots) -> bool:
+        """Run the zombie fence on ``member``: a ``fence`` op whose
+        roots cover the submission's trees.  The daemon write-locks
+        them (waiting out any zombie writer) and resets the fresh
+        roots under the lock.  ``False`` when the fence could not run
+        (transport gone, or the zombie outlived the daemon's bounded
+        lock wait and the fence answered busy) — the caller decides
+        between another bounded attempt and quarantine."""
+        try:
+            client = DaemonClient(member.addr, timeout=90.0, retries=0)
+        except (OSError, ConnectionError):
+            return False
+        try:
+            response = client.request({
+                "op": "fence",
+                "roots": list(reads) + list(writes),
+                "reset": list(fresh_roots),
+                "id": "fence",
+            })
+            return response.get("ok") is True
+        except (OSError, ConnectionError, ValueError):
+            return False
+        finally:
+            client.close()
+
+    def _dispatch_once(self, member: _Member, forward_req: dict):
+        """One dispatch round trip to a member daemon.  Raises on any
+        transport failure (the caller's re-dispatch loop owns
+        recovery); a fresh connection per dispatch keeps failure
+        semantics crisp — a dead daemon is an immediate connect or
+        read error, never a stale pooled socket."""
+        timeout = dispatch_timeout() or None
+        client = DaemonClient(member.addr, timeout=timeout, retries=0)
+        try:
+            client.send(forward_req)
+            if faults.fire("dispatch", "fleet.daemon_crash"):
+                # the daemon "dies" after the job was sent but before
+                # its response is read — the exact mid-run crash shape
+                # SIGKILL produces; the submission's idempotency is
+                # what makes the re-dispatch safe
+                raise ConnectionError(
+                    "injected fault: fleet.daemon_crash@dispatch"
+                )
+            response = client.read()
+            if response is None:
+                raise ConnectionError("daemon closed mid-dispatch")
+            return response
+        finally:
+            client.close()
+
+    def _quarantine(self, session: Session, req_id, op: str, jobs,
+                    fresh_roots, reads=(), writes=(),
+                    last_member=None) -> None:
+        """The poison-submission backstop, mirroring the workers
+        layer's quarantine-to-thread: a submission that exhausted its
+        re-dispatch budget runs ONCE in-process, so it completes (or
+        fails on its own merits) without taking more daemons with it.
+
+        Before the local run, the zombie question is settled one last
+        time: if the final failed dispatch's daemon may still be alive
+        (``last_member``), the fence runs against it — success means
+        the trees are quiet and the roots already reset server-side; a
+        failed fence against a still-alive daemon gets one lease-long
+        grace period (a genuinely wedged writer is the one residual
+        race a coordinator without kill authority cannot close, so it
+        is bounded and documented rather than ignored)."""
+        metrics.counter("fleet.jobs_quarantined").inc(len(jobs))
+        fenced = False
+        if last_member is not None:
+            fenced = self._fence_member(
+                last_member, reads, writes, fresh_roots
+            )
+            if not fenced and self._probe_member(last_member):
+                time.sleep(self.lease_s())
+        # bounded wait for overlapping in-flight dispatches (and
+        # sibling quarantines) to clear, then HOLD the trees in
+        # _local_roots so _route refuses to hand them to a daemon
+        # while the local run writes them
+        hold = (reads, writes)
+        deadline = time.monotonic() + self.lease_s()
+        with self._cond:
+            while time.monotonic() < deadline:
+                if not any(
+                    _conflicts(reads, writes, held_r, held_w)
+                    for held_r, held_w in [
+                        roots
+                        for m in self._members.values()
+                        for roots in m.active_roots
+                    ] + self._local_roots
+                ):
+                    break
+                self._cond.wait(0.1)
+            self._local_roots.append(hold)
+        try:
+            if not fenced:
+                for root in fresh_roots:
+                    shutil.rmtree(root, ignore_errors=True)
+            started = time.perf_counter()
+            if op == "job":
+                response = run_job(jobs[0]).to_dict()
+                response["op"] = "job"
+            else:
+                results = run_batch(jobs)
+                response = {
+                    "ok": all(r.ok for r in results),
+                    "op": "batch",
+                    "results": [r.to_dict() for r in results],
+                    "cached": sum(1 for r in results if r.cached),
+                    "seconds": round(
+                        time.perf_counter() - started, 4
+                    ),
+                }
+        finally:
+            with self._cond:
+                try:
+                    self._local_roots.remove(hold)
+                except ValueError:
+                    pass
+                self._cond.notify_all()
+        if req_id is not None:
+            response["id"] = req_id
+        self._answer(session, response)
+
+    # -- stats -----------------------------------------------------------
+
+    def _stats_payload(self) -> dict:
+        now = time.monotonic()
+        with self._cond:
+            members = {
+                m.id: {
+                    "addr": m.addr,
+                    "capacity": m.capacity,
+                    "degraded": bool(m.degraded),
+                    "dispatched": m.dispatched,
+                    "in_flight": m.in_flight,
+                    "lease_age_s": round(
+                        max(0.0, now - m.last_beat), 3
+                    ),
+                    "queued": m.queued,
+                    "state": "suspect" if m.suspect else "healthy",
+                }
+                for m in self._members.values()
+            }
+            queued = self._queued
+            affinities = len(self._affinity)
+        return {
+            "affinities": affinities,
+            "counters": {
+                name: metrics.counter(name).value()
+                for name in (
+                    "fleet.busy_retries", "fleet.dispatches",
+                    "fleet.evictions", "fleet.heartbeats",
+                    "fleet.jobs_quarantined", "fleet.recoveries",
+                    "fleet.redispatches", "fleet.registrations",
+                    "fleet.steals", "fleet.suspects",
+                )
+            },
+            "lease_s": self.lease_s(),
+            "listen": self.address(),
+            "members": {k: members[k] for k in sorted(members)},
+            "queued_requests": queued,
+        }
+
+    # -- teardown --------------------------------------------------------
+
+    def _drain_member(self, member: _Member) -> None:
+        """Ask one daemon to drain (the coordinator-initiated bounce):
+        its shutdown op finishes in-flight work, answers every session,
+        and exits 0 — the daemon-side machinery PR 10 shipped."""
+        try:
+            client = DaemonClient(member.addr, timeout=60.0, retries=0)
+        except (OSError, ConnectionError):
+            return  # already gone
+        try:
+            client.send({"op": "shutdown"})
+            # the ack, then the drained line; either may be cut short
+            # if the daemon wins the race to close
+            client.read()
+            client.read()
+        except (OSError, ConnectionError, ValueError):
+            pass
+        finally:
+            client.close()
+
+    def stop(self) -> None:
+        """Drain and tear down (idempotent): in-flight dispatches
+        finish and are answered, queued clients are answered ``busy``
+        with retry_after, every registered daemon is drained, every
+        session gets the final drained-shutdown line, exit 0."""
+        with self._stop_lock:
+            if self._stopped:
+                self._stop_done.wait(120.0)
+                return
+            self._stopped = True
+        server.request_shutdown()  # idempotent; runs _on_drain once
+        current = threading.current_thread()
+        for thread in self._dispatchers:
+            if thread is not current:
+                thread.join(120.0)
+        with self._cond:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+            queued = [
+                (session, req)
+                for session in sessions
+                for (req, _t) in session.queue
+            ]
+            for session in sessions:
+                session.queue.clear()
+            self._queued = 0
+            members = list(self._members.values())
+            self._members.clear()
+            self._affinity.clear()
+        # the drain promise: a queued client is ANSWERED, never
+        # silently dropped — busy + retry_after, the same shape
+        # admission control uses
+        for session, req in queued:
+            session.reject_busy(req, "fleet coordinator is draining")
+        drainers = [
+            threading.Thread(
+                target=self._drain_member, args=(member,), daemon=True,
+            )
+            for member in members
+        ]
+        for thread in drainers:
+            thread.start()
+        for thread in drainers:
+            thread.join(90.0)
+        for session in sessions:
+            try:
+                session.respond(
+                    {"ok": True, "op": "shutdown", "drained": True}
+                )
+            except Exception:
+                pass
+            session.close()
+        thread = self._accept_thread
+        if thread is not None and thread is not current:
+            thread.join(5.0)
+        thread = self._monitor
+        if thread is not None and thread is not current:
+            thread.join(5.0)
+        if self.spec[0] == "unix":
+            try:
+                os.unlink(self.spec[1])
+            except OSError:
+                pass
+        server.remove_drain_callback(self._on_drain)
+        server.unregister_stats_source("fleet")
+        metrics.unregister_gauge("fleet.members")
+        metrics.unregister_gauge("fleet.queued_requests")
+        self._stop_done.set()
+
+
+def serve_fleet(listen: str, lease: float = None, clients=None) -> int:
+    """The ``operator-forge fleet`` entry point: bind, print one status
+    line on stderr, coordinate until SIGTERM/SIGINT (or a client's
+    shutdown op), then drain the whole fleet and exit 0."""
+    import sys
+
+    coordinator = FleetCoordinator(listen, lease=lease, clients=clients)
+    coordinator._bind()
+    print(
+        f"fleet: coordinating on {coordinator.address()} "
+        f"(lease {coordinator.lease_s():g}s)",
+        file=sys.stderr, flush=True,
+    )
+    installed = []
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                installed.append((
+                    signum,
+                    signal.signal(signum, server.request_shutdown),
+                ))
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+    try:
+        coordinator.serve_forever()
+    except server._DrainSignal:
+        pass  # signal broke the blocked accept: drain below
+    finally:
+        coordinator.stop()
+        if installed:
+            import signal
+
+            for signum, previous in installed:
+                try:
+                    signal.signal(signum, previous)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+    print("fleet: drained, exiting", file=sys.stderr, flush=True)
+    return 0
+
+
+def fleet_status(addr: str):
+    """One ``stats`` round trip to a coordinator (or daemon), returning
+    the full stats payload — the ``fleet-status`` CLI's data source."""
+    with DaemonClient(addr) as client:
+        return client.request({"op": "stats"})

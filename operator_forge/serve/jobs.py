@@ -227,6 +227,24 @@ def jobs_from_specs(specs, base_dir: str) -> list:
     return jobs
 
 
+def specs_key(jobs) -> str:
+    """Deterministic 16-hex identity of a normalized job list — the
+    idempotency key the fleet coordinator tracks submissions by.  Two
+    submissions of the same manifest (same commands, same resolved
+    paths, same ids) share one key, so a coordinator that re-dispatches
+    an in-flight submission after a daemon death is provably re-running
+    *the same* work, and the content-keyed replay layer underneath
+    guarantees the re-run is byte-identical."""
+    import hashlib
+    import json
+
+    payload = json.dumps(
+        [job.to_spec() for job in jobs], sort_keys=True,
+        separators=(",", ":"),
+    )
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:16]
+
+
 def load_manifest(path: str) -> list:
     """Parse a manifest file into validated jobs (paths resolved
     against the manifest's directory)."""

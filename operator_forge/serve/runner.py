@@ -89,6 +89,43 @@ def _job_stage(job: Job) -> str:
         return _STAGE
     return f"{_STAGE}.{_scope_label((job.target(),))}"
 
+# -- fenceable roots (the fleet's zombie fence) ---------------------------
+#
+# The ``fence`` op resets output roots so a re-dispatched submission
+# starts from first-attempt tree state — but an op that rmtrees
+# caller-supplied paths must be CONTAINED: before this registry, any
+# connected client could delete any directory the daemon user can
+# remove, when no other serve op can delete anything (scaffolding is
+# preserve-on-exists).  The fence may only reset a root this process
+# itself observed being created from absence — exactly the set the
+# fleet's crash-retry rule resets (roots absent at admission) — so a
+# pre-existing tree can never become deletable through the protocol.
+
+_FENCEABLE_MAX = 4096  # FIFO-bounded; far above any live fleet's churn
+
+_fenceable_lock = threading.Lock()
+_fenceable: dict = {}  # abspath -> True, insertion-ordered
+
+
+def record_fenceable_roots(roots) -> None:
+    """Record output roots that were ABSENT when their job/batch
+    started executing here (called by the batch scheduler and the
+    serve job path before any write lands)."""
+    with _fenceable_lock:
+        for root in roots:
+            path = os.path.abspath(root)
+            _fenceable.pop(path, None)
+            _fenceable[path] = True
+        while len(_fenceable) > _FENCEABLE_MAX:
+            del _fenceable[next(iter(_fenceable))]
+
+
+def is_fenceable_root(path: str) -> bool:
+    """Whether the fence op may reset ``path`` (see above)."""
+    with _fenceable_lock:
+        return os.path.abspath(path) in _fenceable
+
+
 #: bounded deterministic retry for exceptions that escape a job's own
 #: error handling (``OPERATOR_FORGE_JOB_RETRIES``) — a job that *fails*
 #: (nonzero rc) is a result and is never retried; a job that *raises*

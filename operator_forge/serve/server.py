@@ -208,21 +208,13 @@ def _classify(exc: BaseException) -> str:
     return "internal"
 
 
-#: extra top-level keys merged into every ``stats`` response — the
-#: daemon registers its session/queue surface here so the one shared
-#: stats op reports it without the server module knowing the daemon
-_STATS_SOURCES: dict = {}
-
-
-def register_stats_source(name: str, fn) -> None:
-    """``fn()`` is called per ``stats`` request and its result becomes
-    the response's ``name`` key (the daemon's per-session queue-depth /
-    active-session surface)."""
-    _STATS_SOURCES[name] = fn
-
-
-def unregister_stats_source(name: str) -> None:
-    _STATS_SOURCES.pop(name, None)
+# extra top-level keys merged into every ``stats`` response — the
+# daemon registers its session/queue surface, the fleet coordinator its
+# member table.  The registry itself lives in perf.metrics so the SAME
+# surfaces appear in `operator-forge stats` / `fleet-status`; these
+# aliases keep the serve-layer spelling both transports already use.
+register_stats_source = metrics.register_stats_source
+unregister_stats_source = metrics.unregister_stats_source
 
 
 def _count_error(payload: dict) -> None:
@@ -280,11 +272,7 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
             "tiers": metrics.tier_report(),
             "workers": workers.pool_state(),
         }
-        for name, fn in sorted(_STATS_SOURCES.items()):
-            try:
-                payload[name] = fn()
-            except Exception:
-                pass  # a stats source must never fail the stats op
+        payload.update(metrics.stats_sources())
         return (payload, True)
     if op == "explain":
         import os as _os
@@ -385,11 +373,56 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
         )
         return ({"ok": True, "op": "watch", "done": True,
                  "cycles": ran}, True)
+    if op == "fence":
+        # the fleet coordinator's zombie fence (PR 14): on the daemon
+        # transport this request's `roots`+`reset` are write-locked by
+        # the cross-session path locks BEFORE this handler runs, so by
+        # the time we execute, no in-flight (or deadline-abandoned
+        # zombie) request can still be writing any of these trees —
+        # and the reset of a dead re-dispatch attempt's fresh output
+        # roots happens race-free on the daemon that owns them.  On
+        # the stdio transport requests are serial, so the property is
+        # trivial.  Deletion is CONTAINED: only roots this process
+        # observed being created from absence (the fenceable-root
+        # registry) may be reset — no other serve op can delete
+        # anything, and the fence must not hand arbitrary clients
+        # rmtree of pre-existing trees.
+        import shutil as _shutil
+
+        from .runner import is_fenceable_root
+
+        roots = req.get("roots")
+        reset = req.get("reset") or []
+        if not isinstance(roots, list) or not isinstance(reset, list):
+            return (_error(
+                "fence: roots and reset must be lists of paths",
+                req_id), True)
+        removed = 0
+        skipped = 0
+        for root in reset:
+            path = str(root)
+            if not os.path.isabs(path):
+                path = os.path.normpath(os.path.join(base_dir, path))
+            if not os.path.isdir(path):
+                continue  # nothing to reset
+            if not is_fenceable_root(path):
+                skipped += 1
+                continue
+            _shutil.rmtree(path, ignore_errors=True)
+            removed += 1
+        return ({"ok": True, "op": "fence", "reset": removed,
+                 "skipped": skipped}, True)
     if op == "job":
+        from .runner import record_fenceable_roots
+
         spec = req.get("job") if "job" in req else {
             k: v for k, v in req.items() if k not in ("op",)
         }
         jobs = jobs_from_specs([spec], base_dir)
+        record_fenceable_roots([
+            root for root in jobs[0].writes()
+            if not os.path.isdir(root)
+        ])
         result = run_job(jobs[0]).to_dict()
         result["op"] = "job"
         return (result, True)

@@ -4,8 +4,12 @@ A :class:`Session` owns exactly one accepted socket.  Its reader thread
 speaks newline-JSON — the same one-object-per-line protocol the stdio
 ``serve`` loop reads, so a client can pipe the identical request stream
 at either transport — and enqueues parsed requests into the session's
-bounded queue for the daemon's fair scheduler
-(:mod:`operator_forge.serve.daemon`) to dispatch.  Responses are
+bounded queue for the owner's fair scheduler to dispatch.  The owner is
+whichever socket server accepted the connection — the multi-client
+daemon (:mod:`operator_forge.serve.daemon`) or the fleet coordinator
+(:mod:`operator_forge.serve.fleet`); both provide the same
+``_enqueue(session, req)`` / ``_reader_finished(session)`` admission
+surface, so one session implementation serves both listeners.  Responses are
 written back one JSON line each, serialized by a per-session lock so a
 streaming op's cycle lines can never interleave with a sibling
 request's answer.
@@ -66,6 +70,9 @@ class Session:
     bounded request queue)."""
 
     def __init__(self, daemon, conn, session_id: str):
+        # the owner: a ForgeDaemon or a FleetCoordinator (both provide
+        # _enqueue/_reader_finished); the historical attribute name is
+        # kept — every call site reads session.daemon
         self.daemon = daemon
         self.conn = conn
         self.id = session_id

@@ -332,6 +332,55 @@ print(
     )
 )
 
+# fleet coordinator (PR 14): K=4 real daemon subprocesses behind the
+# scheduler must clear 2x a single daemon on disjoint-tree tenant
+# load, SIGKILL of a busy daemon mid generation chain must recover
+# byte-identically (with at least one eviction recorded), the tenant
+# fairness guard must hold, and the planted fleet sites stay under the
+# 1% fault-free micro-bar.
+fleet = detail["fleet"]
+assert fleet["scaling_x"] >= 2, (
+    "fleet K=4 below the 2x bar over a single daemon: %.2f"
+    % fleet["scaling_x"]
+)
+assert fleet["identity"] is True, (
+    "a fleet tenant's response diverged from the cache-off serial "
+    "recompute"
+)
+assert fleet["kill_recovery"]["ok"] is True, (
+    "kill-one-daemon recovery broke a tenant: %r" % fleet["kill_recovery"]
+)
+assert fleet["kill_recovery"]["evictions"] > 0, (
+    "the SIGKILL leg evicted no daemon"
+)
+assert fleet["fairness"]["ok"] is True, (
+    "fleet fairness guard failed: contended p99 %.1fms vs solo %.1fms"
+    % (fleet["fairness"]["contended_p99_ms"],
+       fleet["fairness"]["solo_p99_ms"])
+)
+assert fleet["disabled_ok"] is True, (
+    "fault-free fleet-site overhead %.4f%% of the cold path"
+    % (fleet["disabled_fraction_of_cold"] * 100)
+)
+print(
+    "fleet contract OK: K=1 %.1f -> K=4 %.1f jobs/s (x%.1f), kill "
+    "recovery clean (%d evictions / %d re-dispatches / %d "
+    "quarantined), fairness ratio %.1f (bound %.0f), sites "
+    "%.0fns/call (%.4f%% of cold)"
+    % (
+        fleet["single_daemon_jobs_per_s"],
+        fleet["fleet_jobs_per_s"],
+        fleet["scaling_x"],
+        fleet["kill_recovery"]["evictions"],
+        fleet["kill_recovery"]["redispatches"],
+        fleet["kill_recovery"]["quarantined"],
+        fleet["fairness"]["ratio"],
+        fleet["fairness"]["bound"],
+        fleet["disabled_per_call_ns"],
+        fleet["disabled_fraction_of_cold"] * 100,
+    )
+)
+
 # tiered execution (PR 11): walk/compile/bytecode reports must be
 # identical on kitchen-sink (the bench also re-checks the matrix in
 # check_section's five tier×jobs legs per cache mode) and on the
@@ -634,6 +683,203 @@ finally:
 PYEOF
 )
 
+# Fleet step (PR 14): a REAL coordinator process + 3 REAL daemon
+# subprocesses serve 8 concurrent client PROCESSES (batch --addr
+# against the coordinator) on distinct projects; one daemon is
+# SIGKILLed mid-batch; every client's output trees and normalized
+# results must match its own cache-off serial recompute; then SIGTERM
+# to the coordinator must drain the whole fleet — coordinator exit 0
+# with the drained line, and every surviving daemon drained to its own
+# exit 0.
+echo "fleet contract: kill-one-daemon recovery through a live coordinator"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from bench import tree_digest
+from operator_forge.perf import cache as pf_cache
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.daemon import DaemonClient
+from operator_forge.serve.jobs import jobs_from_specs
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-fleetstep-")
+coord_sock = os.path.join(tmp, "coord.sock")
+fixture = os.path.join("tests", "fixtures", "standalone")
+N = 8
+K = 3
+
+
+def specs_for(i, flavor):
+    cfg = os.path.abspath(os.path.join(tmp, f"cfg-{i}", "workload.yaml"))
+    out = os.path.join(tmp, flavor, f"client-{i}", "out")
+    return [
+        {"command": "init", "workload_config": cfg, "output_dir": out,
+         "repo": f"github.com/acme/client{i}"},
+        {"command": "create-api", "workload_config": cfg,
+         "output_dir": out},
+        {"command": "vet", "path": out},
+    ], out
+
+
+def norm(text, out):
+    return re.sub(r"\d+\.\d+s", "<t>", text.replace(out, "<out>"))
+
+
+env = dict(os.environ)
+env.pop("OPERATOR_FORGE_FAULTS", None)
+env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+coordinator = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "fleet",
+     "--listen", coord_sock],
+    env=env, stderr=subprocess.PIPE, text=True,
+)
+daemons = []
+try:
+    for i in range(N):
+        shutil.copytree(fixture, os.path.join(tmp, f"cfg-{i}"))
+    for _ in range(400):
+        if os.path.exists(coord_sock):
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit("coordinator did not bind its socket")
+    for k in range(K):
+        sock = os.path.join(tmp, f"daemon-{k}.sock")
+        daemons.append((subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "daemon",
+             "--listen", sock, "--fleet", coord_sock],
+            env=env, stderr=subprocess.PIPE, text=True,
+        ), sock))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            with DaemonClient(coord_sock) as probe:
+                stats = probe.request({"op": "stats", "id": "s"})
+            if len(stats["fleet"]["members"]) == K:
+                break
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.1)
+    else:
+        raise SystemExit("daemons never registered with the fleet")
+
+    # the cache-off serial reference, one tree per client
+    pf_cache.configure(mode="off")
+    refs = {}
+    for i in range(N):
+        specs, out = specs_for(i, "ref")
+        results = run_batch(jobs_from_specs(specs, tmp))
+        assert all(r.ok for r in results), f"reference {i} failed"
+        refs[i] = (
+            tree_digest(out),
+            [(r.command, r.rc, norm(r.stdout, out)) for r in results],
+        )
+    pf_cache.configure(mode="mem")
+
+    # 8 concurrent CLIENT PROCESSES batching through the COORDINATOR
+    clients = []
+    for i in range(N):
+        specs, out = specs_for(i, "live")
+        manifest = os.path.join(tmp, f"jobs-{i}.yaml")
+        with open(manifest, "w") as fh:
+            json.dump({"jobs": specs}, fh)  # JSON is valid YAML
+        clients.append((i, out, subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "batch",
+             "--addr", coord_sock, "--manifest", manifest, "--json"],
+            env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )))
+
+    # SIGKILL one daemon once the fleet has work in flight
+    by_addr = {sock: proc for proc, sock in daemons}
+    victim = None
+    deadline = time.monotonic() + 120
+    while victim is None and time.monotonic() < deadline:
+        try:
+            with DaemonClient(coord_sock) as probe:
+                stats = probe.request({"op": "stats", "id": "v"})
+            for m in stats["fleet"]["members"].values():
+                if m["in_flight"]:
+                    victim = by_addr[m["addr"]]
+                    break
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.05)
+    assert victim is not None, "no in-flight dispatch to kill"
+    victim.send_signal(signal.SIGKILL)
+
+    for i, out, proc in clients:
+        stdout, stderr = proc.communicate(timeout=600)
+        assert proc.returncode == 0, f"client {i} failed: {stderr}"
+        lines = [json.loads(l) for l in stdout.strip().splitlines()]
+        got = [
+            (l["command"], l["rc"], norm(l["stdout"], out))
+            for l in lines[:-1]
+        ]
+        ref_digest, ref_results = refs[i]
+        assert got == ref_results, f"client {i} results diverged"
+        assert tree_digest(out) == ref_digest, (
+            f"client {i} tree diverged from its cache-off serial "
+            "recompute (daemon SIGKILL mid-batch)"
+        )
+
+    with DaemonClient(coord_sock) as probe:
+        counters = probe.request(
+            {"op": "stats", "id": "c"}
+        )["fleet"]["counters"]
+    assert counters["fleet.evictions"] >= 1, counters
+    assert (
+        counters["fleet.redispatches"]
+        + counters["fleet.jobs_quarantined"]
+    ) >= 1, counters
+
+    # SIGTERM drains the whole fleet: coordinator exits 0 drained,
+    # and every SURVIVING daemon is drained to its own exit 0
+    coordinator.send_signal(signal.SIGTERM)
+    rc = coordinator.wait(timeout=120)
+    stderr = coordinator.stderr.read()
+    assert rc == 0, f"coordinator exit {rc}: {stderr}"
+    assert "drained" in stderr, f"no coordinator drain line: {stderr}"
+    survivors = 0
+    for proc, _sock in daemons:
+        if proc is victim:
+            proc.wait(timeout=10)
+            continue
+        rc = proc.wait(timeout=120)
+        stderr = proc.stderr.read()
+        assert rc == 0, f"daemon exit {rc}: {stderr}"
+        assert "drained" in stderr, f"no daemon drain line: {stderr}"
+        survivors += 1
+    print(
+        "fleet step OK: %d clients byte-identical through a %d-daemon "
+        "fleet with one SIGKILLed mid-batch (%d evictions, %d "
+        "re-dispatches, %d quarantined), SIGTERM drained coordinator "
+        "+ %d surviving daemons to exit 0"
+        % (
+            N, K, counters["fleet.evictions"],
+            counters["fleet.redispatches"],
+            counters["fleet.jobs_quarantined"], survivors,
+        )
+    )
+finally:
+    for proc, _sock in daemons:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    if coordinator.poll() is None:
+        coordinator.kill()
+        coordinator.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
 # Bytecode tier step (PR 11): the three-tier differential identity
 # matrix live — walk/compile/bytecode reports over a generated
 # standalone project must be identical across OPERATOR_FORGE_CACHE
@@ -879,14 +1125,14 @@ finally:
 PYEOF
 )
 
-# Completions must offer the daemon-era verbs.
-for verb in daemon connect; do
+# Completions must offer the daemon- and fleet-era verbs.
+for verb in daemon connect fleet fleet-status; do
     if ! (cd "$repo_root" && "${PYTHON:-python3}" -m operator_forge.cli.main completion bash | grep -q "$verb"); then
         echo "completions missing '$verb'" >&2
         exit 1
     fi
 done
-echo "completions OK: daemon/connect present"
+echo "completions OK: daemon/connect/fleet/fleet-status present"
 
 # Analyzer zero-findings gate over the reference corpus (when the
 # checkout is mounted): the corpus compiles, so every analyzer —

@@ -586,6 +586,16 @@ class TestBench:
         for mode_ok in batch["identity_by_cache_mode"].values():
             assert mode_ok is True
         assert batch["stages_cold_serial"]
+        # ... the fleet coordinator section (PR 14): K=4 real daemons
+        # ≥2x a single daemon, kill-one-daemon recovery identity with
+        # at least one eviction, tenant fairness, fault-site overhead
+        fleet = detail["fleet"]
+        assert fleet["scaling_x"] >= 2
+        assert fleet["identity"] is True
+        assert fleet["kill_recovery"]["ok"] is True
+        assert fleet["kill_recovery"]["evictions"] > 0
+        assert fleet["fairness"]["ok"] is True
+        assert fleet["disabled_ok"] is True
         # ... and the execution-tier ladder (PR 11): per-tier warm
         # check execution with the ≥3x bytecode-vs-walk bar, the
         # monorepo-lite cold leg, tier counters, and the lexer

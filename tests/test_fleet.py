@@ -1,0 +1,887 @@
+"""Fault-tolerant fleet coordinator (PR 14 acceptance).
+
+The fleet may only ever change WHERE a submission runs — routed by
+project affinity across N daemon processes instead of one — never WHAT
+it produces: killing any daemon mid-batch must be invisible to clients
+(idempotent re-dispatch) and byte-identical to a cache-off serial
+recompute, across cache modes × worker backends.  Health is
+lease-driven (missed lease: suspect; second miss or a dropped
+registration connection: evicted), degraded daemons shed load before
+they fail, a poison submission quarantines to in-process execution
+after its re-dispatch budget, and a coordinator SIGTERM drains every
+daemon, answers queued clients busy, and exits 0 with no client left
+unanswered.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import faults, metrics, workers
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.daemon import DaemonClient, ForgeDaemon
+from operator_forge.serve.fleet import FleetCoordinator
+from operator_forge.serve.jobs import jobs_from_specs, specs_key
+
+from test_perf_cache import FIXTURES, assert_identical_trees
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _config_copy(base: str, name: str) -> str:
+    dst = os.path.join(base, f"cfg-{name}")
+    if not os.path.isdir(dst):
+        shutil.copytree(os.path.join(FIXTURES, "standalone"), dst)
+    return os.path.join(dst, "workload.yaml")
+
+
+def _chain_specs(config: str, out_dir: str) -> list:
+    return [
+        {"command": "init", "workload_config": config,
+         "output_dir": out_dir, "repo": "github.com/acme/app"},
+        {"command": "create-api", "workload_config": config,
+         "output_dir": out_dir},
+        {"command": "vet", "path": out_dir},
+    ]
+
+
+def _start_coordinator(tmp_path, **kwargs) -> FleetCoordinator:
+    coordinator = FleetCoordinator(
+        f"unix:{tmp_path}/fleet-{time.monotonic_ns()}.sock", **kwargs
+    )
+    coordinator.start()
+    return coordinator
+
+
+def _spawn_daemon(tmp_path, coordinator, name: str, extra_env=None):
+    """A REAL daemon subprocess registered with the coordinator — the
+    fleet's unit of failure is a process, so fleet tests kill real
+    ones."""
+    sock = str(tmp_path / f"{name}.sock")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+    env.pop("OPERATOR_FORGE_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "operator_forge.cli.main", "daemon",
+         "--listen", sock, "--fleet", coordinator.address()],
+        cwd=str(tmp_path), env=env, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if os.path.exists(sock):
+            return proc, sock
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError(f"daemon did not bind: {proc.stderr.read()}")
+
+
+def _wait_for(predicate, timeout=15.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def _wait_members(coordinator, n: int):
+    _wait_for(
+        lambda: len(coordinator._stats_payload()["members"]) == n,
+        message=f"{n} registered member(s)",
+    )
+
+
+def _reap(*procs):
+    for proc in procs:
+        if proc and proc.poll() is None:
+            proc.kill()
+        if proc:
+            proc.wait(timeout=10)
+
+
+class TestIdempotentSubmissionKeys:
+    def test_specs_key_deterministic_and_content_sensitive(self, tmp_path):
+        base = str(tmp_path)
+        specs = _chain_specs(
+            _config_copy(base, "k"), os.path.join(base, "out")
+        )
+        a = specs_key(jobs_from_specs(specs, base))
+        b = specs_key(jobs_from_specs(list(specs), base))
+        assert a == b and len(a) == 16
+        other = _chain_specs(
+            _config_copy(base, "k"), os.path.join(base, "out2")
+        )
+        assert specs_key(jobs_from_specs(other, base)) != a
+
+
+class TestMembership:
+    def test_register_heartbeat_status_surfaces(self, tmp_path, capsys):
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as member:
+                ack = member.request({
+                    "op": "fleet.register",
+                    "addr": "/nowhere/fake.sock", "capacity": 3,
+                })
+                assert ack["ok"] and ack["member"] == "d1"
+                assert ack["lease_s"] > 0
+                beat = member.request({
+                    "op": "fleet.heartbeat", "member": "d1",
+                    "in_flight": 1, "queued": 2, "degraded": True,
+                })
+                assert beat["ok"]
+                with DaemonClient(coordinator.address()) as client:
+                    stats = client.request({"op": "stats"})
+                fleet = stats["fleet"]
+                assert list(fleet) == [
+                    "affinities", "counters", "lease_s", "listen",
+                    "members", "queued_requests",
+                ]
+                entry = fleet["members"]["d1"]
+                assert entry == {
+                    "addr": "/nowhere/fake.sock", "capacity": 3,
+                    "degraded": True, "dispatched": 0, "in_flight": 0,
+                    "lease_age_s": entry["lease_age_s"],
+                    "queued": 2, "state": "healthy",
+                }
+                assert entry["lease_age_s"] < coordinator.lease_s()
+                assert fleet["counters"]["fleet.registrations"] == 1
+                assert fleet["counters"]["fleet.heartbeats"] == 1
+                # the CLI surface reads the same payload
+                from operator_forge.cli.main import main as cli_main
+
+                assert cli_main([
+                    "fleet-status", "--addr", coordinator.address(),
+                    "--json",
+                ]) == 0
+                out = json.loads(capsys.readouterr().out)
+                assert "d1" in out["members"]
+                assert cli_main([
+                    "fleet-status", "--addr", coordinator.address(),
+                ]) == 0
+                human = capsys.readouterr().out
+                assert "d1" in human and "degraded" in human
+        finally:
+            coordinator.stop()
+
+    def test_missed_lease_suspect_then_evict(self, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_LEASE_S", "0.3")
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as member:
+                ack = member.request({
+                    "op": "fleet.register", "addr": "/nowhere/a.sock",
+                })
+                assert ack["ok"]
+                # the connection stays OPEN but the beats stop: the
+                # lease ages — one interval marks suspect, two evict
+                _wait_for(
+                    lambda: coordinator._stats_payload()["members"]
+                    .get("d1", {}).get("state") == "suspect",
+                    message="member marked suspect",
+                )
+                _wait_for(
+                    lambda: not coordinator._stats_payload()["members"],
+                    message="member evicted",
+                )
+                assert metrics.counter("fleet.suspects").value() >= 1
+                assert metrics.counter("fleet.evictions").value() >= 1
+                # a beat from the evicted member is refused so its
+                # link re-registers
+                stale = member.request({"op": "fleet.heartbeat"})
+                assert stale["ok"] is False
+                assert "re-register" in stale["error"]
+                ack = member.request({
+                    "op": "fleet.register", "addr": "/nowhere/a.sock",
+                })
+                assert ack["ok"] and ack["member"] == "d2"
+        finally:
+            coordinator.stop()
+
+    def test_dropped_registration_connection_evicts(self, tmp_path):
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            member = DaemonClient(coordinator.address())
+            assert member.request({
+                "op": "fleet.register", "addr": "/nowhere/b.sock",
+            })["ok"]
+            member.close()  # the daemon process is gone
+            _wait_for(
+                lambda: not coordinator._stats_payload()["members"],
+                message="dropped-connection eviction",
+            )
+            assert metrics.counter("fleet.evictions").value() >= 1
+        finally:
+            coordinator.stop()
+
+    def test_heartbeat_lost_fault_ages_lease(self, tmp_path):
+        coordinator = _start_coordinator(tmp_path)
+        faults.configure("fleet.heartbeat_lost@lease:2")
+        try:
+            with DaemonClient(coordinator.address()) as member:
+                assert member.request({
+                    "op": "fleet.register", "addr": "/nowhere/c.sock",
+                })["ok"]
+                assert member.request({"op": "fleet.heartbeat"})["ok"]
+                time.sleep(0.3)
+                # the second beat is dropped on the floor: acknowledged
+                # but the lease is NOT refreshed
+                assert member.request({"op": "fleet.heartbeat"})["ok"]
+                age = coordinator._stats_payload()["members"]["d1"][
+                    "lease_age_s"
+                ]
+                assert age >= 0.25, age
+                assert ("fleet.heartbeat_lost", "lease", 2) in (
+                    faults.fired()
+                )
+                # the next (un-dropped) beat refreshes it
+                assert member.request({"op": "fleet.heartbeat"})["ok"]
+                age = coordinator._stats_payload()["members"]["d1"][
+                    "lease_age_s"
+                ]
+                assert age < 0.25, age
+        finally:
+            faults.configure(None)
+            coordinator.stop()
+
+
+class TestRouting:
+    def test_affinity_then_steal_from_saturated_member(
+        self, tmp_path, monkeypatch
+    ):
+        """Repeat work over one tree sticks to its daemon (warm
+        namespace affinity); when that daemon is at capacity, a
+        different tree's work steals to the other daemon."""
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "route")
+        tree_a = os.path.join(base, "out-a")
+        tree_b = os.path.join(base, "out-b")
+        coordinator = _start_coordinator(tmp_path)
+        d1 = d2 = None
+        try:
+            # capacity-1 daemons so saturation is reachable with one
+            # in-flight submission
+            d1, _ = _spawn_daemon(
+                tmp_path, coordinator, "route-d1",
+                {"OPERATOR_FORGE_DAEMON_WORKERS": "1"},
+            )
+            _wait_members(coordinator, 1)
+            d2, _ = _spawn_daemon(
+                tmp_path, coordinator, "route-d2",
+                {"OPERATOR_FORGE_DAEMON_WORKERS": "1"},
+            )
+            _wait_members(coordinator, 2)
+
+            with DaemonClient(coordinator.address()) as client:
+                # build both trees through the fleet; establish
+                # affinity for tree_b while the fleet is idle
+                for tree, rid in ((tree_a, "a"), (tree_b, "b")):
+                    resp = client.request({
+                        "op": "batch", "id": rid,
+                        "jobs": _chain_specs(config, tree),
+                    })
+                    assert resp["ok"], resp
+                payload = coordinator._stats_payload()
+                idle_owner = payload["members"]["d1"]
+                assert idle_owner["dispatched"] >= 2  # both landed on d1
+                before_steals = payload["counters"]["fleet.steals"]
+
+                # repeat vet over tree_a: affinity keeps it on d1
+                resp = client.request(
+                    {"command": "vet", "path": tree_a, "id": "a2"}
+                )
+                assert resp["rc"] == 0
+                assert coordinator._stats_payload()["members"]["d1"][
+                    "dispatched"
+                ] >= 3
+
+                # saturate d1 with a long-running generation over
+                # tree_a, then submit tree_b work: its preferred
+                # member (d1) is at capacity, so it must steal to d2
+                outcome = {}
+
+                def occupy():
+                    with DaemonClient(coordinator.address()) as c:
+                        outcome["resp"] = c.request({
+                            "op": "batch", "id": "occupy",
+                            "jobs": _chain_specs(
+                                config, os.path.join(base, "out-slow")
+                            ),
+                        })
+
+                holder = threading.Thread(target=occupy)
+                holder.start()
+                _wait_for(
+                    lambda: any(
+                        m["in_flight"]
+                        for m in coordinator._stats_payload()[
+                            "members"].values()
+                    ),
+                    message="occupier in flight",
+                )
+                resp = client.request(
+                    {"command": "vet", "path": tree_b, "id": "b2"}
+                )
+                assert resp["rc"] == 0
+                holder.join(120)
+                assert outcome["resp"]["ok"], outcome["resp"]
+                payload = coordinator._stats_payload()
+                assert payload["counters"]["fleet.steals"] > (
+                    before_steals
+                )
+                assert payload["members"]["d2"]["dispatched"] >= 1
+        finally:
+            coordinator.stop()
+            _reap(d1, d2)
+
+    def test_no_members_answers_busy(self, tmp_path):
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as client:
+                resp = client.request(
+                    {"command": "vet", "path": str(tmp_path),
+                     "id": "x"}
+                )
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "busy"
+                assert resp["retry_after"] > 0
+                assert "no daemons" in resp["error"]
+        finally:
+            coordinator.stop()
+
+    def test_watch_is_refused_with_guidance(self, tmp_path):
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as client:
+                resp = client.request({
+                    "op": "watch", "id": "w",
+                    "jobs": [{"command": "vet", "path": str(tmp_path)}],
+                })
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "bad_request"
+                assert "connect to a daemon" in resp["error"]
+        finally:
+            coordinator.stop()
+
+
+class TestKillRecoveryIdentity:
+    """The acceptance matrix: SIGKILL of a real daemon subprocess
+    mid-batch re-dispatches its in-flight submissions and every
+    client's result is byte-identical to the cache-off serial
+    recompute, across OPERATOR_FORGE_CACHE=off/mem/disk ×
+    thread/process workers."""
+
+    @pytest.mark.parametrize("mode", ["off", "mem", "disk"])
+    @pytest.mark.parametrize("backend", ["thread", "process"])
+    def test_sigkill_mid_batch_matrix(self, mode, backend, tmp_path,
+                                      monkeypatch):
+        base = str(tmp_path)
+        config = _config_copy(base, "kill")
+
+        # reference: cache-off serial, in-process (no fleet)
+        perfcache.configure(mode="off")
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        workers.set_backend("thread")
+        refs = {}
+        for name in ("p0", "p1"):
+            ref = os.path.join(base, "ref", name)
+            results = run_batch(
+                jobs_from_specs(_chain_specs(config, ref), base)
+            )
+            assert all(r.ok for r in results)
+            refs[name] = ref
+        perfcache.configure(mode="mem")
+
+        daemon_env = {
+            "OPERATOR_FORGE_CACHE": mode,
+            "OPERATOR_FORGE_WORKERS": backend,
+            "OPERATOR_FORGE_JOBS": "4",
+        }
+        if mode == "disk":
+            daemon_env["OPERATOR_FORGE_CACHE_DIR"] = os.path.join(
+                base, "fleet-cache"
+            )
+        coordinator = _start_coordinator(tmp_path)
+        d1 = d2 = None
+        try:
+            d1, s1 = _spawn_daemon(
+                tmp_path, coordinator, "kill-d1", daemon_env
+            )
+            _wait_members(coordinator, 1)
+            d2, s2 = _spawn_daemon(
+                tmp_path, coordinator, "kill-d2", daemon_env
+            )
+            _wait_members(coordinator, 2)
+            by_addr = {s1: d1, s2: d2}
+
+            outcomes = {}
+
+            def drive(name):
+                out = os.path.join(base, "live", name)
+                with DaemonClient(coordinator.address()) as client:
+                    outcomes[name] = (out, client.request({
+                        "op": "batch", "id": name,
+                        "jobs": _chain_specs(config, out),
+                    }))
+
+            threads = [
+                threading.Thread(target=drive, args=(name,))
+                for name in ("p0", "p1")
+            ]
+            for t in threads:
+                t.start()
+            # SIGKILL whichever daemon holds an in-flight dispatch —
+            # a real mid-batch host death, not a clean shutdown
+            victim = {}
+
+            def find_victim():
+                for mid, m in coordinator._stats_payload()[
+                    "members"
+                ].items():
+                    if m["in_flight"]:
+                        victim["proc"] = by_addr[m["addr"]]
+                        return True
+                return False
+
+            _wait_for(find_victim, message="an in-flight dispatch")
+            victim["proc"].kill()
+            for t in threads:
+                t.join(180)
+            for name in ("p0", "p1"):
+                out, resp = outcomes[name]
+                assert resp["ok"], (name, resp)
+                assert [r["rc"] for r in resp["results"]] == [0, 0, 0]
+                assert_identical_trees(refs[name], out)
+            counters = coordinator._stats_payload()["counters"]
+            assert counters["fleet.evictions"] >= 1
+            assert (
+                counters["fleet.redispatches"]
+                + counters["fleet.jobs_quarantined"]
+            ) >= 1, counters
+        finally:
+            coordinator.stop()
+            _reap(d1, d2)
+
+
+class TestChaosFaults:
+    def test_daemon_crash_fault_redispatches_identically(
+        self, tmp_path, monkeypatch
+    ):
+        """``fleet.daemon_crash@dispatch``: the dispatch connection is
+        severed after the submission was sent — the daemon may have
+        run it — and the idempotent re-dispatch must converge to the
+        cache-off serial bytes."""
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "crash")
+        ref = os.path.join(base, "ref-out")
+        perfcache.configure(mode="off")
+        results = run_batch(
+            jobs_from_specs(_chain_specs(config, ref), base)
+        )
+        assert all(r.ok for r in results)
+        perfcache.configure(mode="mem")
+
+        coordinator = _start_coordinator(tmp_path)
+        d1 = None
+        try:
+            d1, _ = _spawn_daemon(tmp_path, coordinator, "crash-d1")
+            _wait_members(coordinator, 1)
+            faults.configure("fleet.daemon_crash@dispatch:1")
+            out = os.path.join(base, "live-out")
+            with DaemonClient(coordinator.address()) as client:
+                resp = client.request({
+                    "op": "batch", "id": "c",
+                    "jobs": _chain_specs(config, out),
+                })
+            assert resp["ok"], resp
+            assert ("fleet.daemon_crash", "dispatch", 1) in (
+                faults.fired()
+            )
+            assert metrics.counter("fleet.redispatches").value() >= 1
+            assert_identical_trees(ref, out)
+        finally:
+            faults.configure(None)
+            coordinator.stop()
+            _reap(d1)
+
+    def test_dispatch_hang_fault_trips_deadline(self, tmp_path,
+                                                monkeypatch):
+        """``fleet.dispatch_hang@route``: the dispatch sleeps past the
+        configured deadline; the timeout verdict drives the same
+        re-dispatch path a crash does."""
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_DISPATCH_S", "0.4")
+        monkeypatch.setenv("OPERATOR_FORGE_FAULT_HANG_S", "1")
+        perfcache.configure(mode="mem")
+        coordinator = _start_coordinator(tmp_path)
+        d1 = None
+        try:
+            d1, _ = _spawn_daemon(tmp_path, coordinator, "hang-d1")
+            _wait_members(coordinator, 1)
+            faults.configure("fleet.dispatch_hang@route:1")
+            with DaemonClient(coordinator.address()) as client:
+                resp = client.request({"op": "ping", "id": "p"})
+                assert resp["ok"]  # control ops bypass routing
+                resp = client.request(
+                    {"command": "vet", "path": str(tmp_path / "cfg-x"),
+                     "id": "v"}
+                )
+            # the vet itself fails (no such project) but it was
+            # ROUTED: rc is a result, the hang was recovered
+            assert "rc" in resp, resp
+            assert ("fleet.dispatch_hang", "route", 1) in (
+                faults.fired()
+            )
+            assert metrics.counter("fleet.redispatches").value() >= 1
+        finally:
+            faults.configure(None)
+            coordinator.stop()
+            _reap(d1)
+
+    def test_poison_submission_quarantines_in_process(
+        self, tmp_path, monkeypatch
+    ):
+        """A submission whose every dispatch fails (here: a member
+        registered at a dead address) exhausts its budget and runs
+        in-process — the fleet analogue of workers.py's poison-task
+        quarantine — still returning the correct result."""
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "poison")
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as member:
+                assert member.request({
+                    "op": "fleet.register",
+                    "addr": str(tmp_path / "dead.sock"),
+                })["ok"]
+                out = os.path.join(base, "q-out")
+                with DaemonClient(coordinator.address()) as client:
+                    resp = client.request({
+                        "op": "batch", "id": "q",
+                        "jobs": _chain_specs(config, out),
+                    })
+                assert resp["ok"], resp
+                assert os.path.exists(os.path.join(out, "PROJECT"))
+                assert metrics.counter(
+                    "fleet.jobs_quarantined"
+                ).value() >= 3
+        finally:
+            coordinator.stop()
+
+
+class TestFenceContainment:
+    """The fence op deletes ONLY roots the daemon itself observed
+    being created from absence — no serve op may delete a pre-existing
+    tree, whatever a client sends."""
+
+    def test_fence_cannot_delete_preexisting_tree(self, tmp_path):
+        daemon = ForgeDaemon(f"unix:{tmp_path}/fence.sock")
+        daemon.start()
+        victim = tmp_path / "precious"
+        victim.mkdir()
+        (victim / "data.txt").write_text("keep me")
+        try:
+            with DaemonClient(daemon.address()) as client:
+                resp = client.request({
+                    "op": "fence", "id": "f",
+                    "roots": [str(victim)], "reset": [str(victim)],
+                })
+                assert resp["ok"] is True
+                assert resp["reset"] == 0
+                assert resp["skipped"] == 1
+            assert (victim / "data.txt").read_text() == "keep me"
+        finally:
+            daemon.stop()
+
+    def test_fence_resets_created_from_absent_root(self, tmp_path):
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "fence")
+        out = os.path.join(base, "fence-out")
+        daemon = ForgeDaemon(f"unix:{tmp_path}/fence2.sock")
+        daemon.start()
+        try:
+            with DaemonClient(daemon.address()) as client:
+                job = client.request({
+                    "id": "j", "command": "init",
+                    "workload_config": config, "output_dir": out,
+                    "repo": "github.com/acme/app",
+                })
+                assert job["rc"] == 0
+                resp = client.request({
+                    "op": "fence", "id": "f",
+                    "roots": [out], "reset": [out],
+                })
+                assert resp["ok"] is True and resp["reset"] == 1
+            assert not os.path.exists(out)
+        finally:
+            daemon.stop()
+
+
+class TestDrain:
+    def test_client_shutdown_op_drains_coordinator(self, tmp_path):
+        coordinator = _start_coordinator(tmp_path)
+        try:
+            with DaemonClient(coordinator.address()) as client:
+                down = client.request({"op": "shutdown"})
+                assert down["ok"] and down["op"] == "shutdown"
+                assert client.read() == {
+                    "ok": True, "op": "shutdown", "drained": True,
+                }
+                assert client.read() is None
+        finally:
+            coordinator.stop()
+
+    def test_drain_answers_queued_clients_busy(self, tmp_path,
+                                               monkeypatch):
+        """The drain promise: the in-flight submission finishes and is
+        answered; a QUEUED one is answered busy with retry_after —
+        never silently dropped."""
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_WORKERS", "1")
+        perfcache.configure(mode="mem")
+        base = str(tmp_path)
+        config = _config_copy(base, "drain")
+        coordinator = _start_coordinator(tmp_path)
+        d1 = None
+        try:
+            d1, _ = _spawn_daemon(tmp_path, coordinator, "drain-d1")
+            _wait_members(coordinator, 1)
+            in_flight_out = os.path.join(base, "in-flight-out")
+            answers = {}
+
+            def heavy():
+                with DaemonClient(coordinator.address()) as c:
+                    answers["heavy"] = c.request({
+                        "op": "batch", "id": "heavy",
+                        "jobs": _chain_specs(config, in_flight_out),
+                    })
+
+            holder = threading.Thread(target=heavy)
+            holder.start()
+            _wait_for(
+                lambda: any(
+                    m["in_flight"]
+                    for m in coordinator._stats_payload()[
+                        "members"].values()
+                ),
+                message="heavy submission in flight",
+            )
+            queued_client = DaemonClient(coordinator.address())
+            queued_client.send(
+                {"command": "vet", "path": in_flight_out, "id": "q"}
+            )
+            _wait_for(
+                lambda: coordinator._stats_payload()[
+                    "queued_requests"] >= 1,
+                message="request queued behind the one dispatcher",
+            )
+            stopper = threading.Thread(target=coordinator.stop)
+            stopper.start()
+            lines = []
+            while True:
+                resp = queued_client.read()
+                if resp is None:
+                    break
+                lines.append(resp)
+            queued_client.close()
+            holder.join(180)
+            stopper.join(180)
+            assert answers["heavy"]["ok"], answers["heavy"]
+            queued_answer = [
+                line for line in lines if line.get("id") == "q"
+            ]
+            assert queued_answer, lines
+            assert queued_answer[0]["error_kind"] == "busy"
+            assert queued_answer[0]["retry_after"] > 0
+            assert lines[-1] == {
+                "ok": True, "op": "shutdown", "drained": True,
+            }
+            # the coordinator-initiated bounce drained the daemon too
+            assert d1.wait(timeout=60) == 0
+        finally:
+            coordinator.stop()
+            _reap(d1)
+
+    def test_sigterm_drains_whole_fleet_subprocess(self, tmp_path):
+        """SIGTERM to a real coordinator process: exit 0 with the
+        drained line, and every registered daemon is drained to its
+        own exit 0."""
+        coord_sock = str(tmp_path / "coord.sock")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT
+        coordinator = subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "fleet",
+             "--listen", coord_sock],
+            cwd=str(tmp_path), env=env,
+            stderr=subprocess.PIPE, text=True,
+        )
+        daemons = []
+        try:
+            _wait_for(
+                lambda: os.path.exists(coord_sock),
+                message="coordinator bound",
+            )
+            for i in range(2):
+                sock = str(tmp_path / f"term-d{i}.sock")
+                daemons.append(subprocess.Popen(
+                    [sys.executable, "-m", "operator_forge.cli.main",
+                     "daemon", "--listen", sock,
+                     "--fleet", coord_sock],
+                    cwd=str(tmp_path), env=env,
+                    stderr=subprocess.PIPE, text=True,
+                ))
+
+            def registered():
+                try:
+                    with DaemonClient(coord_sock) as c:
+                        stats = c.request({"op": "stats", "id": "s"})
+                    return len(stats["fleet"]["members"]) == 2
+                except (OSError, ConnectionError):
+                    return False
+
+            _wait_for(registered, message="both daemons registered")
+            coordinator.send_signal(signal.SIGTERM)
+            rc = coordinator.wait(timeout=60)
+            stderr = coordinator.stderr.read()
+            assert rc == 0, stderr
+            assert "drained" in stderr
+            for proc in daemons:
+                rc = proc.wait(timeout=60)
+                stderr = proc.stderr.read()
+                assert rc == 0, stderr
+                assert "drained" in stderr
+        finally:
+            _reap(coordinator, *daemons)
+
+
+class TestFleetIdentity:
+    def test_two_tenants_match_cacheoff_serial(self, tmp_path,
+                                               monkeypatch):
+        """Two concurrent tenants through the fleet (no faults): every
+        tree byte-identical to the cache-off serial recompute, and the
+        daemon-side project namespaces do the serving."""
+        base = str(tmp_path)
+        config = _config_copy(base, "ident")
+        perfcache.configure(mode="off")
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+        workers.set_backend("thread")
+        refs = {}
+        for name in ("t0", "t1"):
+            ref = os.path.join(base, "ref", name)
+            results = run_batch(
+                jobs_from_specs(_chain_specs(config, ref), base)
+            )
+            assert all(r.ok for r in results)
+            refs[name] = ref
+        perfcache.configure(mode="mem")
+        workers.set_backend(None)
+        monkeypatch.delenv("OPERATOR_FORGE_JOBS")
+
+        coordinator = _start_coordinator(tmp_path)
+        d1 = d2 = None
+        try:
+            d1, _ = _spawn_daemon(tmp_path, coordinator, "ident-d1")
+            _wait_members(coordinator, 1)
+            d2, _ = _spawn_daemon(tmp_path, coordinator, "ident-d2")
+            _wait_members(coordinator, 2)
+            outcomes = {}
+
+            def drive(name):
+                out = os.path.join(base, "live", name)
+                with DaemonClient(coordinator.address()) as client:
+                    # chain, then a repeat vet that replays warm
+                    outcomes[name] = (out, client.request({
+                        "op": "batch", "id": name,
+                        "jobs": _chain_specs(config, out),
+                    }), client.request(
+                        {"command": "vet", "path": out,
+                         "id": f"{name}-again"}
+                    ))
+
+            threads = [
+                threading.Thread(target=drive, args=(name,))
+                for name in ("t0", "t1")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            for name in ("t0", "t1"):
+                out, batch_resp, vet_resp = outcomes[name]
+                assert batch_resp["ok"], batch_resp
+                assert vet_resp["rc"] == 0, vet_resp
+                assert vet_resp["stdout"] == (
+                    batch_resp["results"][-1]["stdout"]
+                )
+                assert_identical_trees(refs[name], out)
+        finally:
+            coordinator.stop()
+            _reap(d1, d2)
+
+
+class TestDaemonClientReconnect:
+    def test_request_survives_daemon_bounce(self, tmp_path):
+        """A daemon restart on the same address strands no client: the
+        next request reconnects with bounded deterministic backoff and
+        re-sends (idempotent), instead of surfacing a raw socket
+        error."""
+        sock = f"unix:{tmp_path}/bounce.sock"
+        first = ForgeDaemon(sock)
+        first.start()
+        client = DaemonClient(first.address())
+        try:
+            assert client.request({"op": "ping", "id": "a"})["ok"]
+            first.stop()  # the bounce: drained line + closed socket
+            second = ForgeDaemon(sock)
+            second.start()
+            try:
+                resp = client.request({"op": "ping", "id": "b"})
+                assert resp["ok"] and resp["id"] == "b"
+            finally:
+                second.stop()
+        finally:
+            client.close()
+
+    def test_connect_retries_while_daemon_binds_late(self, tmp_path):
+        """The connect path retries too: a client racing a daemon that
+        has not bound yet succeeds within the backoff budget."""
+        sock_path = str(tmp_path / "late.sock")
+        daemon_box = {}
+
+        def bind_late():
+            time.sleep(0.08)
+            daemon_box["d"] = ForgeDaemon(f"unix:{sock_path}")
+            daemon_box["d"].start()
+
+        late = threading.Thread(target=bind_late)
+        late.start()
+        try:
+            client = DaemonClient(sock_path, retries=4)
+            try:
+                assert client.request({"op": "ping", "id": "l"})["ok"]
+            finally:
+                client.close()
+        finally:
+            late.join(10)
+            if "d" in daemon_box:
+                daemon_box["d"].stop()
+
+    def test_exhausted_budget_raises_connection_error(self, tmp_path):
+        with pytest.raises((OSError, ConnectionError)):
+            DaemonClient(str(tmp_path / "nothing.sock"), retries=1)

@@ -599,3 +599,73 @@ class TestServeStatsRemote:
         assert response["remote"]["configured"] is True
         assert response["remote"]["degraded"] is False
         assert "queue_pending" in response["remote"]
+
+
+class TestIdleConnections:
+    """The idle-connection leak fix: a client that connects and goes
+    silent must not hold its handler thread forever — past the idle
+    read deadline the server answers the standard E response and
+    closes that ONE connection, leaving siblings and the listener
+    untouched."""
+
+    def test_silent_connection_closed_after_idle_deadline(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_SERVER_IDLE_S", "0.3")
+        srv = remote.CacheServer(
+            "unix:" + str(tmp_path / "idle.sock"),
+            root=str(tmp_path / "idle-store"),
+        )
+        srv.start()
+        before = _counter("cache_server.idle_closed")
+        try:
+            silent = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            silent.settimeout(5.0)
+            silent.connect(srv.spec[1])
+            try:
+                # the silent peer sends NOTHING: the idle deadline
+                # answers E and closes the connection
+                response = remote._recv_frame(silent)
+                assert response[:1] == b"E"
+                assert b"idle" in response
+                assert silent.recv(1) == b""  # closed behind the E
+                # ...while the listener and fresh connections live on
+                active = socket.socket(
+                    socket.AF_UNIX, socket.SOCK_STREAM
+                )
+                active.settimeout(5.0)
+                active.connect(srv.spec[1])
+                try:
+                    remote._send_frame(active, b"H")
+                    assert remote._recv_frame(active) == b"P"
+                finally:
+                    active.close()
+            finally:
+                silent.close()
+            assert _counter("cache_server.idle_closed") == before + 1
+        finally:
+            srv.stop()
+
+    def test_idle_deadline_disabled_by_nonpositive_knob(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_CACHE_SERVER_IDLE_S", "0")
+        assert remote.idle_timeout_s() == 0
+        srv = remote.CacheServer(
+            "unix:" + str(tmp_path / "noidle.sock"),
+            root=str(tmp_path / "noidle-store"),
+        )
+        srv.start()
+        try:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(0.6)
+            sock.connect(srv.spec[1])
+            try:
+                # no idle deadline: nothing arrives (the CLIENT's own
+                # timeout trips instead of a server close)
+                with pytest.raises(socket.timeout):
+                    remote._recv_frame(sock)
+            finally:
+                sock.close()
+        finally:
+            srv.stop()
