@@ -1148,15 +1148,108 @@ def telemetry_section(tmp: str, steady_tree: str,
     explain_identity = len(outputs) == 1
     first_line = next(iter(outputs)).splitlines()[1] if outputs else ""
 
+    # flight-recorder disabled-path micro-guard (PR 15): a disarmed
+    # anomaly() is the planted-site cost every error path now carries —
+    # it must stay in span-noop territory
+    from operator_forge.perf import flight
+
+    flight.disarm()
+    k = 200_000
+    start = time.perf_counter()
+    for _ in range(k):
+        flight.anomaly("bench.noop", None)
+    flight_per_call = (time.perf_counter() - start) / k
+
+    # distributed-trace linkage (PR 15): a traced submission through a
+    # real in-process daemon with PROCESS pool workers must come back
+    # as ONE connected timeline — every daemon- and worker-side span
+    # transitively parented to the client's root span, worker pids
+    # distinct from the client's
+    from operator_forge.perf import workers as pf_workers
+    from operator_forge.serve.daemon import DaemonClient, ForgeDaemon
+
+    dist_trees = []
+    with contextlib.redirect_stdout(io.StringIO()):
+        for i in range(2):
+            out_dir = os.path.join(tmp, f"dtrace-{i}")
+            generate(fixture, "github.com/bench/dtrace", out_dir)
+            dist_trees.append(out_dir)
+    pf_cache.configure(mode="mem")
+    pf_cache.reset()
+    pf_workers.set_backend("process")
+    saved_jobs2 = os.environ.get("OPERATOR_FORGE_JOBS")
+    os.environ["OPERATOR_FORGE_JOBS"] = "4"
+    daemon = ForgeDaemon(
+        f"unix:{os.path.join(tmp, 'bench-dtrace.sock')}"
+    )
+    daemon.start()
+    try:
+        spans.enable_tracing(True)
+        spans.clear_events()
+        with spans.span("bench.dtrace.client"):
+            with DaemonClient(daemon.address()) as client:
+                response = client.request({"op": "batch", "jobs": [
+                    {"command": "vet", "path": dist_trees[0],
+                     "id": "bd0"},
+                    {"command": "vet", "path": dist_trees[1],
+                     "id": "bd1"},
+                ], "id": "bench-dtrace"})
+        assert response.get("ok"), response
+        dist_events = spans.drain_events()
+    finally:
+        daemon.stop()
+        spans.enable_tracing(None)
+        pf_workers.set_backend(None)
+        if saved_jobs2 is None:
+            os.environ.pop("OPERATOR_FORGE_JOBS", None)
+        else:
+            os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs2
+    verdict = spans.trace_connectivity(dist_events)
+    remote_names = {
+        e["name"] for e in dist_events
+        if isinstance(e["args"]["id"], str)
+    }
+    distributed_ok = bool(
+        verdict["ok"]
+        and "serve:batch" in remote_names
+        and any(n.startswith("serve.job:") for n in remote_names)
+    )
+
+    # per-tenant SLO telemetry: the jobs above were served under the
+    # daemon's project scoping, so the registry now carries one SLO
+    # entry per tenant tree with the fixed field set
+    from operator_forge.perf import metrics
+
+    slo = metrics.slo_report()
+    slo_fields = ["count", "deadline_misses", "max", "p50", "p99",
+                  "p999"]
+    slo_ok = bool(
+        len(slo) >= 2
+        and all(list(entry) == slo_fields for entry in slo.values())
+        and list(slo) == sorted(slo)
+    )
+
     return {
         "disabled_per_call_ns": round(per_call_off * 1e9, 1),
         "disabled_calls_per_cold_run": round(calls_per_run, 1),
         "disabled_fraction_of_cold": round(fraction, 6),
         "disabled_ok": fraction < 0.01,
         "enabled_per_call_ns": round(per_call_on * 1e9, 1),
+        # the flight-recorder planted sites live on error paths (hit
+        # counts near zero fault-free), so the honest guard is the
+        # per-call disarmed cost staying in span-noop territory
+        "flight_disabled_per_call_ns": round(flight_per_call * 1e9, 1),
+        "flight_disabled_ok": flight_per_call < per_call_off * 50 + 2e-6,
         "identity_telemetry_on_off": identical,
         "identity_fixture": fixture,
         "trace_events_one_generation": trace_events,
+        "distributed_ok": distributed_ok,
+        "distributed_events": verdict["events"],
+        "distributed_pids": len(verdict["pids"]),
+        "distributed_orphans": len(verdict["orphans"]),
+        "slo_ok": slo_ok,
+        "slo_tenants": len(slo),
+        "slo_fields": slo_fields,
         "explain_identity": explain_identity,
         "explain_legs": legs,
         "explain_file": rel.replace(os.sep, "/"),
@@ -1164,7 +1257,9 @@ def telemetry_section(tmp: str, steady_tree: str,
         "headline": "disabled = no-op closure path (<1% of cold "
         "codegen enforced); enabled-path per-call cost is reported, "
         "not gated — it is host-noise sensitive like every timing "
-        "here (see noise_floor)",
+        "here (see noise_floor); distributed_ok asserts one connected "
+        "client->daemon->worker timeline; slo_ok asserts per-tenant "
+        "p50/p99/p999 + deadline-miss keys in stable order",
     }
 
 
@@ -2731,6 +2826,29 @@ def main() -> None:
                 "telemetry identity guard FAILED: tracing-on "
                 "generation/vet/test diverged from the telemetry-off "
                 "run",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not telemetry["distributed_ok"]:
+            print(
+                "distributed trace guard FAILED: a traced daemon "
+                "submission did not come back as one connected "
+                "client->daemon->worker timeline "
+                f"({telemetry['distributed_orphans']} orphan(s))",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not telemetry["slo_ok"]:
+            print(
+                "SLO telemetry guard FAILED: per-tenant histograms "
+                "missing, malformed, or unstable key order",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not telemetry["flight_disabled_ok"]:
+            print(
+                "flight recorder overhead guard FAILED: a disarmed "
+                "anomaly site costs more than the span-noop budget",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -980,6 +980,12 @@ def cmd_cache_gc(args: argparse.Namespace) -> int:
         # disk; gc reports them (and --purge-quarantine reclaims them)
         "quarantine_entries": summary["quarantine_entries"],
         "quarantine_bytes": summary["quarantine_bytes"],
+        # flight-recorder capsules share the cache dir's budget: the
+        # sweep removes expired ones and reports what remains
+        "flight_entries": summary["flight_entries"],
+        "flight_bytes": summary["flight_bytes"],
+        "flight_removed": summary["flight_removed"],
+        "flight_bytes_reclaimed": summary["flight_bytes_reclaimed"],
     }
     if purged is not None:
         out["quarantine_purged_entries"] = purged["entries_removed"]
@@ -1029,18 +1035,39 @@ def cmd_cache_verify(args: argparse.Namespace) -> int:
 
 
 def cmd_stats(args: argparse.Namespace) -> int:
-    """`stats`: the observability surface of this process — per-
-    namespace cache hit/miss attribution, dependency-graph counters,
-    the metrics registry (counters, gauges, p50/p99 latency
-    histograms), and the span table — in stable key order.  A one-shot
-    CLI process reports its own (mostly cold) state; the same document
-    is what a resident `serve` process answers to the `stats` op, where
-    the numbers accumulate across requests."""
+    """`stats`: the observability surface — per-namespace cache
+    hit/miss attribution, dependency-graph counters, the metrics
+    registry (counters, gauges, p50/p99 latency histograms),
+    per-tenant SLO telemetry, and the span table — in stable key
+    order.  By default the surface of THIS process (a one-shot CLI
+    reports its own, mostly cold, state); with --addr the same `stats`
+    op is asked of a running daemon/fleet coordinator, whose numbers
+    accumulate across requests — before this flag, `operator-forge
+    stats` next to a busy daemon reported an empty registry."""
     import json as _json
 
     from ..perf import metrics
 
-    report = metrics.report()
+    if args.addr:
+        from ..serve.daemon import DaemonClient
+
+        try:
+            with DaemonClient(args.addr) as client:
+                report = client.request({"op": "stats", "id": "stats"})
+        except (OSError, ConnectionError) as exc:
+            print(f"error: server at {args.addr}: {exc}",
+                  file=sys.stderr)
+            return 1
+        if report.get("ok") is False:
+            print(f"error: server at {args.addr}: "
+                  f"{report.get('error')}", file=sys.stderr)
+            return 1
+        # the serve stats op and metrics.report() share the same keys;
+        # drop the protocol envelope so both paths render identically
+        for key in ("ok", "op", "id", "seconds"):
+            report.pop(key, None)
+    else:
+        report = metrics.report()
     if args.json:
         print(_json.dumps(report))
         return 0
@@ -1070,6 +1097,16 @@ def cmd_stats(args: argparse.Namespace) -> int:
             tiers.get("bytecode.deopt", 0),
         )
     )
+    slo = report.get("slo") or {}
+    if slo:
+        print("slo tenants:")
+        for tenant, entry in slo.items():
+            print(
+                f"  {tenant}: count={entry['count']} "
+                f"p50={entry['p50']} p99={entry['p99']} "
+                f"p999={entry['p999']} "
+                f"deadline_misses={entry['deadline_misses']}"
+            )
     snap = report["metrics"]
     for name, value in snap["counters"].items():
         print(f"counter {name}: {value}")
@@ -1572,12 +1609,19 @@ def build_parser() -> argparse.ArgumentParser:
         "stats",
         help="report the observability surface: cache hit/miss "
              "attribution, graph counters, metrics (p50/p99 "
-             "histograms), and the span table",
+             "histograms), per-tenant SLO telemetry, and the span "
+             "table",
     )
     p_stats.add_argument(
         "--json", action="store_true",
         help="emit the full report as one JSON object (stable key "
              "order) instead of the human summary",
+    )
+    p_stats.add_argument(
+        "--addr", default="", metavar="ADDR",
+        help="query a running daemon/fleet coordinator at this "
+             "address (unix:/path or host:port) over the stats op "
+             "instead of reporting this process's registry",
     )
     p_stats.set_defaults(func=cmd_stats)
 
@@ -1674,10 +1718,11 @@ def main(argv: list[str] | None = None) -> int:
         with _depth_lock:
             _main_depth[0] -= 1
             outermost = _main_depth[0] == 0
-        trace_path = os.environ.get("OPERATOR_FORGE_TRACE", "").strip()
-        if outermost and trace_path and not spans.trace_export_suppressed():
-            n = spans.write_chrome_trace(trace_path)
-            print(f"trace: {n} events -> {trace_path}", file=sys.stderr)
+        if outermost:
+            # env-path resolution, worker suppression, and the
+            # announce line all live in spans.export_env_trace (the
+            # drain-path hooks call the same helper)
+            spans.export_env_trace()
         # a profiled run that fails still reports the work it did
         if os.environ.get("OPERATOR_FORGE_PROFILE", "") not in ("", "0"):
             spans.report(sys.stderr)

@@ -368,6 +368,14 @@ class ContentCache:
                 return False  # unmovable AND unremovable: still in place
         metrics.counter("cache.quarantined").inc()
         self._count(stage, "quarantined")
+        from . import flight
+
+        # a quarantined entry means on-disk damage happened under this
+        # process: capture the ring around the detection
+        flight.anomaly(
+            "cache.quarantine",
+            {"stage": stage, "entry": os.path.basename(path)},
+        )
         return True
 
     def _corrupt_entry(self, stage: str, key: str) -> None:
@@ -617,6 +625,17 @@ class ContentCache:
             metrics.counter("cache.evictions").inc(removed)
             metrics.counter("cache.bytes_reclaimed").inc(freed)
         quarantine = self.quarantine_stats()
+        # flight-recorder capsules share the cache dir's budget: every
+        # gc reports their footprint and sweeps the expired ones (past
+        # their TTL, or beyond the keep budget), so the recorder can
+        # never grow unbounded even after its owning server died
+        from . import flight
+
+        # the recorder's own override resolution applies (env or
+        # programmatic dir wins); this store's root is only the default
+        capsules = flight.sweep(
+            default_base=os.path.join(root, "flight")
+        )
         return {
             "entries_removed": removed,
             "bytes_reclaimed": freed,
@@ -626,6 +645,10 @@ class ContentCache:
             # consumers see the whole footprint, not just the store
             "quarantine_entries": quarantine["entries"],
             "quarantine_bytes": quarantine["bytes"],
+            "flight_entries": capsules["entries"],
+            "flight_bytes": capsules["bytes"],
+            "flight_removed": capsules["removed"],
+            "flight_bytes_reclaimed": capsules["bytes_reclaimed"],
             "entries": len(entries),
             "max_bytes": limit,
             "removed": removed,

@@ -78,6 +78,10 @@ kind                 planted site           effect when fired
                                             sleeps past the fleet dispatch
                                             deadline (hung daemon:
                                             deadline-then-re-dispatch path)
+``flight.write_error`` ``capsule``          the flight-recorder capsule write
+                                            raises (full/readonly disk): the
+                                            recorder must count and carry
+                                            on, never take the server down
 ===================  =====================  ================================
 
 Hit counters are per-process: forked pool workers restart from zero
@@ -122,6 +126,7 @@ KINDS = (
     "fleet.daemon_crash",
     "fleet.heartbeat_lost",
     "fleet.dispatch_hang",
+    "flight.write_error",
 )
 
 

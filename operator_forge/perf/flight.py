@@ -1,0 +1,551 @@
+"""Anomaly flight recorder: post-mortems without pre-arranged tracing.
+
+PR 6's ``operator-forge trace`` answers "where did the time go?" — but
+only if the process was wrapped in advance, and only if it lives to
+export.  A long-running daemon or fleet coordinator that gets killed
+mid-request, or that quietly absorbs deadline abandonments and lock
+timeouts for hours, leaves nothing.  This module is the black box: the
+always-on bounded trace ring (servers enable event tracing for their
+lifetime) is snapshotted to an **HMAC-signed on-disk capsule** —
+
+- whenever an **anomaly** fires: request deadline abandonment, a
+  busy/lock-timeout rejection, client disconnect mid-request, worker
+  poison-task quarantine, cache entry quarantine, daemon
+  suspect/evict, fleet re-dispatch (each site calls :func:`anomaly`,
+  which is a two-comparison no-op when the recorder is disarmed);
+- **periodically** (``OPERATOR_FORGE_FLIGHT_S``, default 5s): a
+  rolling per-pid capsule refreshed whenever the ring has grown, so a
+  SIGKILL — which by definition cannot run an exit hook — still leaves
+  the last few seconds of spans on disk;
+- at **drain** (:func:`flush` with ``final=True``): the clean-shutdown
+  export the daemon/fleet teardown calls.
+
+Capsules live under ``OPERATOR_FORGE_FLIGHT_DIR`` (default:
+``<cache root>/flight/``, inside the cache dir's budget — ``cache gc``
+reports and sweeps them, so the recorder can never grow unbounded) and
+are bounded by ``OPERATOR_FORGE_FLIGHT_KEEP`` (default 16, oldest
+removed first).  Each capsule is a two-line file: a JSON header
+carrying an HMAC-SHA256 signature under the PR 1 per-user cache key,
+then the canonical-JSON body (anomaly log + ring snapshot + process
+metadata).  :func:`verify_capsule` authenticates before trusting —
+the same client-side-verification trust model as the disk cache and
+the remote tier.
+
+Anomaly *recording* is decoupled from capsule *writing*: sites may
+fire while holding scheduler locks, so :func:`anomaly` only appends to
+a bounded in-memory log and wakes the recorder thread; all file I/O
+happens there (or in an explicit :func:`flush`).  Capsule writes are
+debounced (at most one anomaly capsule per
+``OPERATOR_FORGE_FLIGHT_DEBOUNCE_S``, default 1s) so an anomaly storm
+costs one snapshot, not one file per event.  A write failure is
+counted (``flight.write_errors``), never raised — telemetry must not
+fail the command — and the ``flight.write_error@capsule`` chaos kind
+proves that path deterministically.
+
+The live ring is also served on demand: the ``trace-dump`` serve op
+returns :func:`dump` (ring snapshot + anomaly log) from a running
+serve/daemon/fleet process, no kill required.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from . import env_number
+
+#: capsule format marker (header key ``fmt``)
+FORMAT = "operator-forge-flight-v1"
+
+DEFAULT_KEEP = 16
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_DEBOUNCE_S = 1.0
+#: bounded in-memory anomaly log (newest kept)
+ANOMALY_LOG_MAX = 256
+
+_lock = threading.Lock()
+_armed = [False]
+_dir_override = [None]
+_anomalies: collections.deque = collections.deque(maxlen=ANOMALY_LOG_MAX)
+_pending = [0]            # anomalies not yet captured in a capsule
+_last_write = [0.0]       # monotonic time of the last anomaly capsule
+_seq = [0]                # capsule sequence number (per process)
+_wake = threading.Event()
+_thread = [None]
+_stop = threading.Event()
+
+
+def _reset_after_fork() -> None:
+    # a forked pool worker is not a server: it must neither inherit a
+    # recorder thread (fork drops threads anyway) nor keep writing the
+    # parent's capsules
+    global _lock
+    _lock = threading.Lock()
+    _armed[0] = False
+    _thread[0] = None
+    _anomalies.clear()
+    _pending[0] = 0
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)
+
+
+# -- knobs -----------------------------------------------------------------
+
+
+def flight_dir() -> str:
+    """Where capsules land: ``OPERATOR_FORGE_FLIGHT_DIR``, programmatic
+    override, else ``<cache root>/flight`` — inside the cache
+    directory so the existing budget machinery (``cache gc``) owns the
+    footprint."""
+    if _dir_override[0] is not None:
+        return _dir_override[0]
+    raw = os.environ.get("OPERATOR_FORGE_FLIGHT_DIR", "").strip()
+    if raw:
+        return raw
+    from . import cache as pf_cache
+
+    return os.path.join(pf_cache.get_cache().root(), "flight")
+
+
+def keep_budget() -> int:
+    """Max capsules kept on disk (``OPERATOR_FORGE_FLIGHT_KEEP``,
+    default 16; oldest removed first).  The rolling periodic capsule
+    rewrites one file per pid, so it consumes a single slot."""
+    return env_number(
+        "OPERATOR_FORGE_FLIGHT_KEEP", DEFAULT_KEEP, cast=int, minimum=1
+    )
+
+
+def interval_s() -> float:
+    """Periodic-export cadence (``OPERATOR_FORGE_FLIGHT_S``, default
+    5s; <= 0 disables the rolling capsule, anomaly capsules still
+    write)."""
+    return env_number(
+        "OPERATOR_FORGE_FLIGHT_S", DEFAULT_INTERVAL_S, minimum=None
+    )
+
+
+def debounce_s() -> float:
+    """Minimum gap between anomaly capsules
+    (``OPERATOR_FORGE_FLIGHT_DEBOUNCE_S``, default 1s)."""
+    return env_number(
+        "OPERATOR_FORGE_FLIGHT_DEBOUNCE_S", DEFAULT_DEBOUNCE_S,
+        minimum=0.0,
+    )
+
+
+def capsule_events() -> int:
+    """How many ring events (the newest) one capsule snapshots
+    (``OPERATOR_FORGE_FLIGHT_EVENTS``, default 2048).  A busy daemon's
+    FULL ring is ~100k events ≈ tens of MB of canonical JSON — writing
+    that every rolling tick would burn a core on serialization and
+    stream tens of MB to disk for the process's whole lifetime; a
+    post-mortem wants the last few seconds, and 2048 spans IS several
+    seconds of even a very hot server."""
+    return env_number(
+        "OPERATOR_FORGE_FLIGHT_EVENTS", 2048, cast=int, minimum=16
+    )
+
+
+def configure(directory=None) -> None:
+    """Programmatic capsule-directory override (tests, bench legs);
+    ``None`` restores env/default selection."""
+    _dir_override[0] = directory
+
+
+def armed() -> bool:
+    return _armed[0]
+
+
+# -- anomaly sites ---------------------------------------------------------
+
+
+def anomaly(kind: str, detail=None) -> None:
+    """Record one anomaly.  Disarmed (every non-server process), this
+    is a single list-index check — the planted sites ride the same
+    <1% disabled-path budget as the span sites.  Armed, it appends to
+    the bounded log, stamps an instant marker into the trace ring
+    (joining the request's connectivity graph when a trace context is
+    active), counts ``flight.anomalies``, and wakes the recorder
+    thread to write a debounced capsule."""
+    if not _armed[0]:
+        return
+    from . import metrics, spans
+
+    entry = {
+        "kind": kind,
+        "detail": detail,
+        "t": round(time.time(), 3),
+    }
+    with _lock:
+        _anomalies.append(entry)
+        _pending[0] += 1
+    metrics.counter("flight.anomalies").inc()
+    metrics.counter(f"flight.anomaly.{kind}").inc()
+    spans.instant(f"anomaly:{kind}", args=(
+        dict(detail) if isinstance(detail, dict) else
+        ({"detail": detail} if detail is not None else None)
+    ))
+    _wake.set()
+
+
+def anomaly_log() -> list:
+    """The bounded in-memory anomaly log, oldest first."""
+    with _lock:
+        return list(_anomalies)
+
+
+def dump() -> dict:
+    """The live flight surface (the ``trace-dump`` op's payload): the
+    current ring snapshot plus the anomaly log — the same data a
+    capsule would persist, served from the running process."""
+    from . import spans
+
+    return {
+        "anomalies": anomaly_log(),
+        "armed": _armed[0],
+        "events": spans.events_snapshot(),
+        "pid": os.getpid(),
+    }
+
+
+# -- capsules --------------------------------------------------------------
+
+
+def _capsule_doc(kind: str) -> dict:
+    from .. import __version__
+    from . import spans
+
+    events = spans.events_snapshot()
+    budget = capsule_events()
+    return {
+        "anomalies": anomaly_log(),
+        # the newest tail only (see capsule_events): bounded
+        # serialization cost and capsule size however full the ring is
+        "events": events[-budget:],
+        "events_dropped": max(0, len(events) - budget),
+        "kind": kind,
+        "pid": os.getpid(),
+        "version": __version__,
+        "written_at": round(time.time(), 3),
+    }
+
+
+def _write_capsule(kind: str, path: str) -> bool:
+    """Serialize, sign, and atomically publish one capsule.  Never
+    raises: a recorder that cannot write must not take the server (or
+    the anomaly site) down with it."""
+    from . import cache as pf_cache
+    from . import faults, metrics
+
+    try:
+        if faults.should_fire("flight.write_error", "capsule"):
+            raise OSError("injected fault: flight.write_error@capsule")
+        body = json.dumps(
+            _capsule_doc(kind), sort_keys=True, separators=(",", ":")
+        ).encode("utf-8")
+        key = pf_cache._load_hmac_key()
+        header = {
+            "fmt": FORMAT,
+            "sig": (
+                pf_cache._sign(key, body).hex() if key is not None
+                else ""
+            ),
+        }
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as handle:
+            handle.write(json.dumps(header, sort_keys=True).encode())
+            handle.write(b"\n")
+            handle.write(body)
+        os.replace(tmp, path)
+    except (OSError, ValueError, TypeError):
+        metrics.counter("flight.write_errors").inc()
+        return False
+    metrics.counter("flight.capsules").inc()
+    return True
+
+
+def _sanitize(kind: str) -> str:
+    return "".join(c if c.isalnum() or c in "._" else "-" for c in kind)
+
+
+def _enforce_keep(base: str) -> None:
+    try:
+        names = [
+            n for n in os.listdir(base)
+            if n.startswith("capsule-") and n.endswith(".json")
+        ]
+    except OSError:
+        return
+    budget = keep_budget()
+    if len(names) <= budget:
+        return
+    stamped = []
+    for name in names:
+        try:
+            stamped.append(
+                (os.stat(os.path.join(base, name)).st_mtime_ns, name)
+            )
+        except OSError:
+            continue
+    for _mtime, name in sorted(stamped)[: max(0, len(stamped) - budget)]:
+        try:
+            os.remove(os.path.join(base, name))
+        except OSError:
+            pass
+
+
+def _write_anomaly_capsule(kind: str) -> bool:
+    base = flight_dir()
+    with _lock:
+        _seq[0] += 1
+        seq = _seq[0]
+        _pending[0] = 0
+        _last_write[0] = time.monotonic()
+    path = os.path.join(
+        base, f"capsule-{os.getpid()}-{seq:04d}-{_sanitize(kind)}.json"
+    )
+    ok = _write_capsule(kind, path)
+    if ok:
+        _enforce_keep(base)
+    return ok
+
+
+def _write_rolling_capsule() -> bool:
+    # one rolling file per pid, refreshed in place: the SIGKILL
+    # survivor.  It rewrites rather than accumulates, so it takes one
+    # keep-budget slot forever
+    path = os.path.join(flight_dir(), f"capsule-{os.getpid()}-ring.json")
+    return _write_capsule("periodic", path)
+
+
+def flush(final: bool = False) -> bool:
+    """Write pending anomalies (and, with ``final``, a drain capsule)
+    synchronously — the teardown hook, also handy for tests.  Returns
+    whether anything was written."""
+    wrote = False
+    with _lock:
+        pending = _pending[0]
+    if pending:
+        kind = "anomaly"
+        log = anomaly_log()
+        if log:
+            kind = log[-1]["kind"]
+        wrote = _write_anomaly_capsule(kind) or wrote
+    if final and _armed[0]:
+        from . import spans
+
+        if spans.events_snapshot():
+            wrote = _write_anomaly_capsule("drain") or wrote
+    return wrote
+
+
+# -- capsule reading --------------------------------------------------------
+
+
+def read_capsule(path: str) -> tuple:
+    """``(authenticated, doc)`` for a capsule file.  ``authenticated``
+    is True only when the body verifies against the local HMAC key
+    (the same trust rule as the disk cache: bytes from disk are
+    claims, the signature is the proof).  Raises ``OSError`` /
+    ``ValueError`` on an unreadable or structurally broken file."""
+    import hmac as _hmac
+
+    from . import cache as pf_cache
+
+    with open(path, "rb") as handle:
+        raw = handle.read()
+    head, sep, body = raw.partition(b"\n")
+    if not sep:
+        raise ValueError(f"{path}: not a flight capsule (no header)")
+    header = json.loads(head.decode("utf-8"))
+    if header.get("fmt") != FORMAT:
+        raise ValueError(f"{path}: not a flight capsule")
+    doc = json.loads(body.decode("utf-8"))
+    key = pf_cache._load_hmac_key()
+    sig = header.get("sig", "")
+    authenticated = bool(
+        key is not None and sig
+        and _hmac.compare_digest(
+            bytes.fromhex(sig), pf_cache._sign(key, body)
+        )
+    )
+    return authenticated, doc
+
+
+def verify_capsule(path: str) -> bool:
+    """Whether ``path`` is a structurally valid, HMAC-authenticated
+    capsule (never raises)."""
+    try:
+        authenticated, _doc = read_capsule(path)
+    except (OSError, ValueError, TypeError):
+        return False
+    return authenticated
+
+
+def capsule_ttl_s() -> float:
+    """How long a capsule stays relevant before ``cache gc`` sweeps it
+    (``OPERATOR_FORGE_FLIGHT_TTL_S``, default 7 days)."""
+    return env_number(
+        "OPERATOR_FORGE_FLIGHT_TTL_S", 7 * 24 * 3600.0, minimum=0.0
+    )
+
+
+def sweep(default_base=None) -> dict:
+    """The ``cache gc`` hook: report the capsule footprint and remove
+    *expired* capsules — older than :func:`capsule_ttl_s`, or beyond
+    the :func:`keep_budget` (oldest first) — so the recorder can never
+    grow unbounded even if the owning server died before its own
+    enforcement ran.  ``default_base`` is only the fallback when no
+    env/programmatic override is set (``cache gc`` passes ``<its
+    root>/flight`` so a root-overridden store sweeps its own capsules)
+    — the override resolution itself lives HERE, in one place.
+    Returns ``{"entries", "bytes", "removed", "bytes_reclaimed"}``
+    (post-sweep footprint)."""
+    if _dir_override[0] is not None:
+        base = _dir_override[0]
+    elif os.environ.get("OPERATOR_FORGE_FLIGHT_DIR", "").strip():
+        base = os.environ["OPERATOR_FORGE_FLIGHT_DIR"].strip()
+    elif default_base is not None:
+        base = default_base
+    else:
+        base = flight_dir()
+    try:
+        names = [
+            n for n in os.listdir(base)
+            if n.startswith("capsule-") and n.endswith(".json")
+        ]
+    except OSError:
+        names = []
+    stamped = []
+    for name in names:
+        path = os.path.join(base, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        stamped.append((st.st_mtime, st.st_size, path))
+    stamped.sort()
+    ttl = capsule_ttl_s()
+    cutoff = time.time() - ttl
+    budget = keep_budget()
+    overflow = max(0, len(stamped) - budget)
+    removed = 0
+    freed = 0
+    survivors_entries = 0
+    survivors_bytes = 0
+    for i, (mtime, size, path) in enumerate(stamped):
+        expired = mtime < cutoff or i < overflow
+        if expired:
+            try:
+                os.remove(path)
+            except OSError:
+                survivors_entries += 1
+                survivors_bytes += size
+                continue
+            removed += 1
+            freed += size
+        else:
+            survivors_entries += 1
+            survivors_bytes += size
+    return {
+        "entries": survivors_entries,
+        "bytes": survivors_bytes,
+        "removed": removed,
+        "bytes_reclaimed": freed,
+    }
+
+
+# -- the recorder thread ----------------------------------------------------
+
+
+def _recorder_loop() -> None:
+    from . import spans
+
+    last_seq = -1
+    while True:
+        interval = interval_s()
+        timeout = interval if interval > 0 else 3600.0
+        with _lock:
+            pending = _pending[0]
+            since_last = time.monotonic() - _last_write[0]
+        if pending:
+            remaining = debounce_s() - since_last
+            if remaining <= 0:
+                flush()
+                continue
+            # a debounce-deferred anomaly must not wait out the whole
+            # periodic interval (or, with the periodic export disabled,
+            # the next anomaly) — wake exactly when its window expires
+            timeout = min(timeout, remaining)
+        _wake.wait(timeout)
+        _wake.clear()
+        if _stop.is_set():
+            return
+        if not _armed[0]:
+            continue
+        with _lock:
+            pending = _pending[0]
+            since_last = time.monotonic() - _last_write[0]
+        if pending and since_last >= debounce_s():
+            flush()
+            continue
+        if interval > 0:
+            # churn is detected by the append counter, not the ring
+            # length — a saturated ring's length is pinned at maxlen
+            # while its contents keep turning over, and the rolling
+            # capsule exists precisely for the last few seconds before
+            # a SIGKILL
+            seq = spans.event_seq()
+            if seq and seq != last_seq:
+                last_seq = seq
+                _write_rolling_capsule()
+
+
+def arm(directory=None) -> None:
+    """Turn the recorder on (servers call this at boot): anomaly sites
+    go live and the periodic recorder thread starts.  Idempotent."""
+    if directory is not None:
+        configure(directory)
+    _armed[0] = True
+    thread = _thread[0]
+    if thread is None or not thread.is_alive():
+        _stop.clear()
+        thread = threading.Thread(
+            target=_recorder_loop, daemon=True, name="flight-recorder",
+        )
+        _thread[0] = thread
+        thread.start()
+
+
+def disarm(final: bool = False) -> None:
+    """Turn the recorder off (server teardown; ``final`` writes the
+    drain capsule first).  Idempotent; the thread is woken so it can
+    observe the stop flag and retire."""
+    if final and _armed[0]:
+        flush(final=True)
+    _armed[0] = False
+    _stop.set()
+    _wake.set()
+    thread = _thread[0]
+    if thread is not None and thread is not threading.current_thread():
+        thread.join(2.0)
+    _thread[0] = None
+
+
+def reset() -> None:
+    """Test hygiene: disarm, drop the log and overrides."""
+    disarm()
+    with _lock:
+        _anomalies.clear()
+        _pending[0] = 0
+        _last_write[0] = 0.0
+    _stop.clear()
+    _wake.clear()
+    configure(None)

@@ -350,6 +350,116 @@ def cache_report() -> dict:
     return out
 
 
+#: overflow tenant label once the cardinality cap is hit
+SLO_OVERFLOW = "overflow"
+
+
+def _slo_tenant_cap() -> int:
+    """Max distinct SLO tenants tracked (``OPERATOR_FORGE_SLO_TENANTS``,
+    default 64).  Tenants are hashes of served target paths, so a
+    long-lived daemon fed ever-new directories (CI runs with per-run
+    temp outputs) would otherwise grow the registry — and every stats/
+    capsule payload — without bound; tenant #cap+1 onward aggregates
+    under the ``overflow`` label instead."""
+    from . import env_number
+
+    return env_number(
+        "OPERATOR_FORGE_SLO_TENANTS", 64, cast=int, minimum=1
+    )
+
+
+def _slo_key(tenant: str) -> str:
+    """Route a tenant label through the cardinality cap: an already-
+    tracked tenant keeps its slot, a new one past the cap lands in
+    ``overflow``.  Tracked means a histogram OR a miss counter — a
+    tenant whose every request was deadline-abandoned has only the
+    counter, and it must consume a slot like any other (slo_report
+    emits a row per miss counter too)."""
+    with _lock:
+        if (
+            f"slo.{tenant}.seconds" in _histograms
+            or f"slo.{tenant}.deadline_misses" in _counters
+        ):
+            return tenant
+        tracked = {
+            n[len("slo."):-len(".seconds")]
+            for n in _histograms
+            if n.startswith("slo.") and n.endswith(".seconds")
+        } | {
+            n[len("slo."):-len(".deadline_misses")]
+            for n in _counters
+            if n.startswith("slo.") and n.endswith(".deadline_misses")
+        }
+    return (
+        tenant if len(tracked) < _slo_tenant_cap() else SLO_OVERFLOW
+    )
+
+
+def observe_slo(tenant: str, seconds: float) -> None:
+    """Record one request's latency for a tenant (the ``serve.job.
+    <tree-hash>`` project-namespace label the daemon partitions replay
+    records by) — feeds :func:`slo_report`'s per-tenant histograms.
+    Cardinality-bounded: see :func:`_slo_tenant_cap`."""
+    histogram(f"slo.{_slo_key(tenant)}.seconds").observe(seconds)
+
+
+def count_deadline_miss(tenant: str) -> None:
+    """One deadline-abandoned request charged to its tenant (same
+    cardinality routing as :func:`observe_slo`)."""
+    counter(f"slo.{_slo_key(tenant)}.deadline_misses").inc()
+
+
+def slo_report() -> dict:
+    """Per-tenant SLO telemetry in stable key order: for every tenant
+    with an ``slo.<tenant>.seconds`` histogram, the request count,
+    interpolated p50/p99/p999, observed max, and the deadline-miss
+    counter.  Tenants are the daemon's project-namespace labels, so
+    ``stats`` / ``fleet-status --json`` / the bench ``slo`` leg all
+    attribute latency to the same keys the cache partitions by."""
+    with _lock:
+        hists = {
+            name: inst for name, inst in _histograms.items()
+            if name.startswith("slo.") and name.endswith(".seconds")
+        }
+        misses = {
+            name: c._value for name, c in _counters.items()
+            if name.startswith("slo.")
+            and name.endswith(".deadline_misses")
+        }
+    out: dict = {}
+    for name in sorted(hists):
+        tenant = name[len("slo."):-len(".seconds")]
+        hist = hists[name]
+        summary = hist.summary()
+        out[tenant] = {
+            "count": summary["count"],
+            "deadline_misses": misses.get(
+                f"slo.{tenant}.deadline_misses", 0
+            ),
+            "max": summary["max"],
+            "p50": summary["p50"],
+            "p99": summary["p99"],
+            "p999": (
+                round(hist.quantile(0.999), 6)
+                if summary["count"] else None
+            ),
+        }
+    # a tenant can miss deadlines without ever completing a request
+    # (every attempt abandoned): it must still appear, not vanish
+    for name in sorted(misses):
+        tenant = name[len("slo."):-len(".deadline_misses")]
+        if tenant not in out:
+            out[tenant] = {
+                "count": 0,
+                "deadline_misses": misses[name],
+                "max": 0.0,
+                "p50": None,
+                "p99": None,
+                "p999": None,
+            }
+    return {tenant: out[tenant] for tenant in sorted(out)}
+
+
 def tier_report() -> dict:
     """Execution-tier attribution (PR 11): the gocheck tier ceiling and
     the ladder counters — bodies lowered to closures, promoted to
@@ -386,10 +496,11 @@ def report() -> dict:
         "cache": cache_report(),
         "graph": GRAPH.counters(),
         "metrics": snapshot(),
+        "slo": slo_report(),
         "spans": spans.snapshot(),
         "tiers": tier_report(),
     }
     # registered subsystem surfaces (daemon sessions, fleet members)
-    # ride along as extra top-level keys, sorted after the fixed five
+    # ride along as extra top-level keys, sorted after the fixed six
     out.update(stats_sources())
     return out

@@ -707,7 +707,15 @@ def fetch(stage: str, key: str):
     injected = faults.fire(
         "remote", "remote.unreachable", "remote.corrupt", "remote.hang"
     )
-    response = _request(_pack_entry(b"G", stage, key), injected)
+    # a span around the round trip: the remote tier's latency joins a
+    # traced request's timeline (and, inside a daemon handling a
+    # distributed-trace request, its segment) — the cache server
+    # itself stays span-free, its whole visible cost IS this round
+    # trip.  One attr lookup when telemetry is off.
+    from . import spans
+
+    with spans.span("remote.get", args={"stage": stage}):
+        response = _request(_pack_entry(b"G", stage, key), injected)
     if response is None:
         return None
     status, payload = response[:1], response[1:]
@@ -820,32 +828,39 @@ def _flush_loop() -> None:
                 data = pf_cache._sign(signing_key, blob) + blob
                 sent = False
                 budget = retries() + 1
-                for attempt in range(budget):
-                    if attempt:
-                        time.sleep(_BACKOFF_S * attempt)
-                    try:
-                        if sock is None:
-                            sock = _connect()
-                        _send_frame(
-                            sock, _pack_entry(b"P", stage, key, data)
-                        )
-                        response = _recv_frame(sock)
-                    except (OSError, ProtocolError) as exc:
-                        metrics.counter("cache.remote_errors").inc()
-                        last = f"{type(exc).__name__}: {exc}"
-                        if sock is not None:
-                            try:
-                                sock.close()
-                            except OSError:
-                                pass
-                            sock = None
-                        continue
-                    if response[:1] == b"O":
-                        metrics.counter("cache.remote_puts").inc()
-                        sent = True
-                    else:
-                        metrics.counter("cache.remote_errors").inc()
-                    break
+                from . import spans
+
+                # the flusher runs decoupled from any request, so the
+                # span is untagged — it lands in the flight ring (and a
+                # trace-wrapped process's timeline), attributing
+                # write-behind latency without joining a segment
+                with spans.span("remote.put", args={"stage": stage}):
+                    for attempt in range(budget):
+                        if attempt:
+                            time.sleep(_BACKOFF_S * attempt)
+                        try:
+                            if sock is None:
+                                sock = _connect()
+                            _send_frame(
+                                sock, _pack_entry(b"P", stage, key, data)
+                            )
+                            response = _recv_frame(sock)
+                        except (OSError, ProtocolError) as exc:
+                            metrics.counter("cache.remote_errors").inc()
+                            last = f"{type(exc).__name__}: {exc}"
+                            if sock is not None:
+                                try:
+                                    sock.close()
+                                except OSError:
+                                    pass
+                                sock = None
+                            continue
+                        if response[:1] == b"O":
+                            metrics.counter("cache.remote_puts").inc()
+                            sent = True
+                        else:
+                            metrics.counter("cache.remote_errors").inc()
+                        break
                 if not sent and sock is None:
                     # transport-level exhaustion: the tier degrades and
                     # the remaining backlog drains as drops

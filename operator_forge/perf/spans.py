@@ -32,6 +32,25 @@ under 1% of the codegen pipeline).  The swap happens whenever the
 enable state changes (:func:`enable`, :func:`enable_tracing`,
 :func:`use_env`, :func:`refresh`); code that mutates the env vars
 mid-process must call :func:`refresh` (the process-pool workers do).
+
+Distributed tracing (PR 15): a request that crosses a process boundary
+carries a **trace context** — a W3C-traceparent-shaped pair of trace id
+and parent span id, derived *deterministically* from the request's own
+id (:func:`rpc_context`; never wall-clock randomness).  The receiving
+server adopts the context for the request's lifetime
+(:func:`remote_segment`): every span recorded under it is tagged with
+the trace id and renders its span/parent ids inside a per-request
+*segment namespace* (``<segment>:<n>``), so ids from different
+processes can never collide, and the segment's top-level spans parent
+directly onto the caller's span id.  When the request finishes, the
+server drains exactly its segment's events (:func:`drain_trace`) and
+ships them back on the response — the same drain-and-merge contract the
+process-pool workers have used since PR 6 — so the original client's
+ring holds ONE connected timeline from CLI keystroke to pool-worker
+instruction (:func:`trace_connectivity` is the graph check the tests
+and commit-check assert).  Thread fan-out propagates the context via
+:func:`current_context`/:func:`adopt_context` (``perf.parallel_map``
+and the workers backends do this automatically).
 """
 
 from __future__ import annotations
@@ -56,6 +75,7 @@ DEFAULT_RING = 100_000
 
 _ids = itertools.count(1)  # span ids; next() is GIL-atomic
 _span_stack = threading.local()  # per-thread open-span id stack
+_trace_ctx = threading.local()  # per-thread adopted trace context
 # cached: getpid() is a syscall (tens of µs under sandboxed kernels)
 # and the pid only changes at fork, where the hook below refreshes it
 _PID = os.getpid()
@@ -71,6 +91,31 @@ def _ring_capacity() -> int:
 
 
 _events: collections.deque = collections.deque(maxlen=DEFAULT_RING)
+#: monotonically increasing count of events ever appended — lets the
+#: flight recorder detect churn even when the FULL ring's length no
+#: longer changes (a saturated deque stays at maxlen forever)
+_seq = [0]
+#: per-trace shipping queues: a trace-tagged event is bucketed here at
+#: append time (in ADDITION to the ring, which keeps its copy for the
+#: flight recorder), so :func:`drain_trace` pops O(own events) instead
+#: of scanning a saturated 100k ring under the lock per traced request
+_trace_buckets: dict = {}  # trace id -> [events], insertion-ordered
+_BUCKETS_MAX = 256  # orphaned traces (abandoned requests) FIFO-evict
+
+
+def _bucket_locked(event) -> None:
+    trace = event["args"].get("trace")
+    if trace is None:
+        return
+    bucket = _trace_buckets.get(trace)
+    if bucket is None:
+        while len(_trace_buckets) >= _BUCKETS_MAX:
+            del _trace_buckets[next(iter(_trace_buckets))]
+        bucket = _trace_buckets[trace] = []
+    bucket.append(event)
+    cap = _events.maxlen or DEFAULT_RING
+    if len(bucket) > cap:
+        del bucket[0]
 
 
 def _env_enabled() -> bool:
@@ -140,6 +185,7 @@ def reset() -> None:
 def clear_events() -> None:
     with _lock:
         _events.clear()
+        _trace_buckets.clear()
 
 
 def _clear_events_after_fork() -> None:
@@ -151,9 +197,14 @@ def _clear_events_after_fork() -> None:
     _PID = os.getpid()
     _lock = threading.Lock()
     _events.clear()
+    _trace_buckets.clear()
+    _seq[0] = 0
     stack = getattr(_span_stack, "ids", None)
     if stack:
         stack.clear()
+    # a forked worker must not inherit the forking thread's adopted
+    # trace context: its tasks ship their own (pid-suffixed) segment
+    _trace_ctx.value = None
 
 
 if hasattr(os, "register_at_fork"):
@@ -223,9 +274,21 @@ class _TraceSpan:
         record(self.name, elapsed)
         # span linkage is authoritative: user args never clobber it
         event_args = dict(self.args) if self.args else {}
-        event_args["id"] = self.sid
-        event_args["parent"] = self.parent
-        _events.append({
+        ctx = getattr(_trace_ctx, "value", None)
+        if ctx is None:
+            event_args["id"] = self.sid
+            event_args["parent"] = self.parent
+        else:
+            # inside an adopted trace context: ids render in the
+            # request's segment namespace (collision-free across
+            # processes) and the segment's local roots parent onto the
+            # caller's span id, so the merged timeline stays one tree
+            event_args["id"] = f"{ctx.seg}:{self.sid}"
+            event_args["parent"] = (
+                f"{ctx.seg}:{self.parent}" if self.parent else ctx.base
+            )
+            event_args["trace"] = ctx.trace
+        event = {
             "name": self.name,
             "ph": "X",
             "pid": _PID,
@@ -233,7 +296,15 @@ class _TraceSpan:
             "ts": round(self.start * 1e6, 1),
             "dur": round(elapsed * 1e6, 1),
             "args": event_args,
-        })
+        }
+        # appends share the ring lock with every reader: snapshot/drain
+        # iterate the deque, and a lock-free append concurrent with
+        # that iteration raises RuntimeError (deque mutated) — a traced
+        # request would error instead of answering
+        with _lock:
+            _events.append(event)
+            _seq[0] += 1
+            _bucket_locked(event)
         return False
 
 
@@ -258,13 +329,26 @@ def events_snapshot() -> list:
         return list(_events)
 
 
+def event_seq() -> int:
+    """How many events have EVER been appended to this process's ring
+    — the flight recorder's churn signal (a saturated ring's length is
+    pinned at maxlen, so length alone cannot detect new activity)."""
+    with _lock:
+        return _seq[0]
+
+
 def drain_events() -> list:
     """Pop and return every buffered event (the worker-side shipping
     primitive: each process-pool task drains its ring into the sealed
-    result so the parent can merge one timeline)."""
+    result so the parent can merge one timeline).  The per-trace
+    shipping buckets empty with it — they only ever hold copies of
+    ring events, and a pool worker (which ships THIS way, never via
+    :func:`drain_trace`) would otherwise retain every tagged copy for
+    its lifetime."""
     with _lock:
         out = list(_events)
         _events.clear()
+        _trace_buckets.clear()
     return out
 
 
@@ -275,6 +359,243 @@ def ingest_events(events) -> None:
         return
     with _lock:
         _events.extend(events)
+        _seq[0] += len(events)
+        # a server ingesting a child's shipped segment must be able to
+        # drain it onward (coordinator -> client): tagged events join
+        # their trace's shipping bucket too
+        for event in events:
+            _bucket_locked(event)
+
+
+# -- distributed trace context ---------------------------------------------
+
+
+class _TraceCtx:
+    """An adopted trace context: the trace id every span tags, the
+    segment namespace its ids render in, and the caller-side span id
+    the segment's local roots parent onto."""
+
+    __slots__ = ("trace", "seg", "base")
+
+    def __init__(self, trace: str, seg: str, base):
+        self.trace = trace
+        self.seg = seg
+        self.base = base
+
+    def as_tuple(self) -> tuple:
+        return (self.trace, self.seg, self.base)
+
+
+def _derive_trace_id(key) -> str:
+    """A trace id from a request id — deterministic (same request id,
+    same trace id, byte for byte), never entropy."""
+    import hashlib
+
+    return hashlib.sha256(
+        ("operator-forge-trace:" + repr(key)).encode("utf-8")
+    ).hexdigest()[:16]
+
+
+def _derive_segment(trace: str, parent, label: str) -> str:
+    """A segment namespace for one adopted request: deterministic in
+    (trace id, caller span, role label) with the pid folded in so two
+    servers adopting the same dispatch (a re-dispatched fleet
+    submission) can never emit colliding span ids."""
+    import hashlib
+
+    return hashlib.sha256(
+        f"{trace}|{parent}|{label}|{os.getpid()}".encode("utf-8")
+    ).hexdigest()[:10]
+
+
+def _render_current(ctx, stack):
+    """The calling thread's innermost open span id, rendered in the
+    active namespace (``ctx`` may be None)."""
+    if ctx is not None:
+        return f"{ctx.seg}:{stack[-1]}" if stack else ctx.base
+    return stack[-1] if stack else 0
+
+
+def current_context():
+    """The calling thread's trace context as a plain tuple — with
+    ``base`` re-anchored to the thread's innermost open span, so a
+    fan-out layer (``parallel_map``, the workers backends) that hands
+    this to its worker threads parents their spans under the span that
+    submitted the work.  ``None`` when no context is adopted."""
+    ctx = getattr(_trace_ctx, "value", None)
+    if ctx is None:
+        return None
+    stack = getattr(_span_stack, "ids", None)
+    return (ctx.trace, ctx.seg, _render_current(ctx, stack or []))
+
+
+def adopt_context(ctx) -> None:
+    """Install (or with ``None`` clear) a propagated trace context on
+    the calling thread.  ``ctx`` is the tuple :func:`current_context`
+    returns — possibly with a worker-specific segment suffix (the
+    process-pool workers append ``.p<pid>`` so their local span
+    counters cannot collide with the parent's)."""
+    _trace_ctx.value = None if ctx is None else _TraceCtx(*ctx)
+
+
+@contextmanager
+def remote_segment(trace: str, parent, label: str = "serve"):
+    """Adopt an incoming request's trace context for the duration of
+    its handler: spans recorded inside are tagged with ``trace``,
+    namespaced under a fresh deterministic segment, and parented onto
+    the caller's ``parent`` span id.  Used by every server transport
+    (stdio serve, daemon sessions, the fleet coordinator)."""
+    previous = getattr(_trace_ctx, "value", None)
+    _trace_ctx.value = _TraceCtx(
+        str(trace), _derive_segment(str(trace), parent, label), parent
+    )
+    try:
+        yield
+    finally:
+        _trace_ctx.value = previous
+
+
+def context_bound(fn):
+    """Bind the calling thread's trace context onto ``fn`` for
+    execution on another thread — the ONE capture-adopt-clear wrapper
+    every thread fan-out layer shares (``perf.parallel_map`` and the
+    workers thread backend), so propagation semantics cannot drift
+    between them.  Returns ``fn`` unchanged when no context is
+    active."""
+    ctx = current_context()
+    if ctx is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        adopt_context(ctx)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            adopt_context(None)
+
+    return bound
+
+
+def rpc_context(key=None):
+    """The trace-context payload an outgoing request should carry
+    (``{"id": <trace>, "parent": <span id>}``), or ``None`` when
+    tracing is off.  Inside an adopted context the trace id is
+    inherited; at the root (the traced CLI client) a new trace id is
+    derived deterministically from ``key`` — pass the request's own id
+    (a batch submission key, a job id) so re-sends of an idempotent
+    request belong to the same trace."""
+    if not _trace_active:
+        return None
+    ctx = getattr(_trace_ctx, "value", None)
+    stack = getattr(_span_stack, "ids", None) or []
+    if ctx is not None:
+        return {"id": ctx.trace, "parent": _render_current(ctx, stack)}
+    trace = _derive_trace_id(key if key is not None else next(_ids))
+    return {"id": trace, "parent": stack[-1] if stack else 0}
+
+
+def parse_trace_field(req: dict):
+    """Validate a request's ``trace`` field into ``(trace_id, parent)``
+    or ``None`` — servers must never crash on a malformed context (it
+    is telemetry, not payload)."""
+    raw = req.get("trace")
+    if not isinstance(raw, dict):
+        return None
+    trace = raw.get("id")
+    if not isinstance(trace, str) or not trace:
+        return None
+    parent = raw.get("parent")
+    if not isinstance(parent, (str, int)):
+        parent = 0
+    return (trace, parent)
+
+
+def drain_trace(trace: str) -> list:
+    """Pop and return every buffered event tagged with ``trace`` (in
+    emit order) — the server-side shipping primitive: a request's
+    segment travels back on its response without stealing concurrent
+    requests' spans.  The drain pops the trace's *shipping bucket*
+    (O(the segment's own events), never an O(ring) scan — a saturated
+    server ring would otherwise serialize every traced response on a
+    100k-element walk under the lock); the RING keeps its copies, so
+    the flight recorder and ``trace-dump`` still see what the server
+    did for traced requests after they were answered."""
+    with _lock:
+        return _trace_buckets.pop(trace, [])
+
+
+def instant(name: str, args=None) -> None:
+    """Record a zero-duration marker event (Chrome ``i`` phase) into
+    the ring — request admission markers, anomaly stamps.  Cheap no-op
+    when tracing is off.  Carries the same id/parent/trace linkage as a
+    span, so markers join the connectivity graph."""
+    if not _trace_active:
+        return
+    stack = getattr(_span_stack, "ids", None) or []
+    ctx = getattr(_trace_ctx, "value", None)
+    sid = next(_ids)
+    event_args = dict(args) if args else {}
+    if ctx is None:
+        event_args["id"] = sid
+        event_args["parent"] = stack[-1] if stack else 0
+    else:
+        event_args["id"] = f"{ctx.seg}:{sid}"
+        event_args["parent"] = _render_current(ctx, stack)
+        event_args["trace"] = ctx.trace
+    event = {
+        "name": name,
+        "ph": "i",
+        "s": "t",
+        "pid": _PID,
+        "tid": threading.get_ident(),
+        "ts": round(time.perf_counter() * 1e6, 1),
+        "args": event_args,
+    }
+    with _lock:  # see _TraceSpan.__exit__: readers iterate under it
+        _events.append(event)
+        _seq[0] += 1
+        _bucket_locked(event)
+
+
+def trace_connectivity(events) -> dict:
+    """The acceptance check for a merged distributed timeline: every
+    event must be transitively parented to a root span (``parent`` 0).
+    Returns ``{"ok", "events", "roots", "orphans", "pids"}`` —
+    ``orphans`` lists (name, id, dangling ancestor parent) triples for
+    diagnosis; ``pids`` is the set of processes contributing spans."""
+    ids = {}
+    for event in events:
+        eid = event["args"].get("id")
+        if eid is not None:
+            ids[eid] = event["args"].get("parent", 0)
+    roots = 0
+    orphans = []
+    for event in events:
+        eid = event["args"].get("id")
+        if eid is None:
+            orphans.append((event.get("name"), None, None))
+            continue
+        parent = ids.get(eid, 0)
+        if parent == 0:
+            roots += 1
+            continue
+        seen = set()
+        while parent != 0:
+            if parent not in ids:
+                orphans.append((event.get("name"), eid, parent))
+                break
+            if parent in seen:  # a cycle is as broken as a dangle
+                orphans.append((event.get("name"), eid, parent))
+                break
+            seen.add(parent)
+            parent = ids[parent]
+    return {
+        "ok": not orphans and bool(ids),
+        "events": len(events),
+        "roots": roots,
+        "orphans": orphans[:16],
+        "pids": sorted({e.get("pid") for e in events}),
+    }
 
 
 _export_suppressed = False
@@ -293,6 +614,15 @@ def trace_export_suppressed() -> bool:
     return _export_suppressed
 
 
+def _id_sort_key(eid):
+    # local span ids are ints, remote-segment ids are strings; the sort
+    # key must be type-stable (a ts tie between the two would otherwise
+    # raise) while keeping the historical int ordering
+    if isinstance(eid, int):
+        return (0, eid, "")
+    return (1, 0, str(eid))
+
+
 def chrome_trace() -> dict:
     """The buffered events as a Chrome trace-event JSON object
     (``chrome://tracing`` / Perfetto's legacy JSON format).  Events are
@@ -300,7 +630,7 @@ def chrome_trace() -> dict:
     buffer are byte-identical."""
     events = sorted(
         events_snapshot(),
-        key=lambda e: (e["ts"], e["args"].get("id", 0)),
+        key=lambda e: (e["ts"], _id_sort_key(e["args"].get("id", 0))),
     )
     return {
         "traceEvents": events,
@@ -326,6 +656,25 @@ def write_chrome_trace(path: str) -> int:
         print(f"trace: cannot write {path}: {exc}", file=sys.stderr)
         return 0
     return len(trace["traceEvents"])
+
+
+def export_env_trace(announce: bool = True):
+    """Write the ``OPERATOR_FORGE_TRACE`` file NOW, if the env var is
+    set and export is not worker-suppressed — the drain-path hook: a
+    long-running daemon/fleet exiting through the drain machinery must
+    not depend on unwinding all the way out of the outermost ``main()``
+    to persist its timeline (and a re-export at that outer exit just
+    rewrites a superset of the same file).  Returns the event count, or
+    ``None`` when no export was configured."""
+    import sys
+
+    path = os.environ.get("OPERATOR_FORGE_TRACE", "").strip()
+    if not path or _export_suppressed:
+        return None
+    n = write_chrome_trace(path)
+    if announce:
+        print(f"trace: {n} events -> {path}", file=sys.stderr)
+    return n
 
 
 # -- aggregate access ------------------------------------------------------

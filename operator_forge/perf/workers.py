@@ -122,11 +122,15 @@ _warned_once = [False]
 
 
 def _degrade(reason: str) -> None:
-    from . import metrics
+    from . import flight, metrics
 
     _degraded["active"] = True
     _degraded["reason"] = reason
     metrics.counter("worker.degraded").inc()
+    # every degradation (poison-task quarantine included) is a flight
+    # anomaly: the ring around the moment the pool died is exactly
+    # what a post-mortem needs
+    flight.anomaly("worker.degraded", {"reason": reason})
     # conftest's metrics.reset() drops registrations, so (re)register
     # lazily at the moment the gauge becomes meaningful
     metrics.register_gauge(
@@ -240,6 +244,10 @@ def _task_config() -> dict:
         # shipping alone would miss it, and a worker forked mid-trace
         # would otherwise keep its fork-time state forever
         "trace": spans._trace_forced,
+        # the submitting thread's trace context: a traced request's
+        # pool tasks emit inside its segment (the worker suffixes its
+        # pid so two children's span counters cannot collide)
+        "trace_ctx": spans.current_context(),
         # the programmatic fault-spec override (bench legs, tests) —
         # env shipping alone would miss it
         "faults": faults.forced_spec(),
@@ -277,6 +285,12 @@ def _apply_config(cfg: dict) -> None:
     # file themselves — their events ship back in each sealed result
     spans.suppress_trace_export(True)
     spans.enable_tracing(cfg["trace"])
+    ctx = cfg.get("trace_ctx")
+    if ctx is not None:
+        trace, seg, base = ctx
+        spans.adopt_context((trace, f"{seg}.p{os.getpid()}", base))
+    else:
+        spans.adopt_context(None)
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
     compiler.set_promote_after(cfg.get("gocheck_promote"))
@@ -695,6 +709,10 @@ def _exit_map() -> None:
 
 
 def _thread_map(fn, items, jobs: int) -> list:
+    # distributed tracing: the submitting thread's adopted trace
+    # context travels onto the pool threads, so a traced request's
+    # fan-out spans stay inside its segment (no context = no wrap)
+    fn = spans.context_bound(fn)
     pool = _thread_pool(jobs)
     active = _enter_map()
     try:

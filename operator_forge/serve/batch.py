@@ -134,12 +134,17 @@ def cmd_batch(manifest_path: str, json_lines: bool = False,
         # manifest is loaded (and its paths resolved) locally, shipped
         # as one batch op, and the daemon's warm caches do the work
         from .daemon import DaemonClient
+        from .jobs import specs_key
 
         try:
             with DaemonClient(addr) as client:
                 response = client.request({
                     "op": "batch",
                     "jobs": [job.to_spec() for job in jobs],
+                    # the deterministic submission key doubles as the
+                    # correlation id AND (under `operator-forge trace`)
+                    # the seed the distributed trace id derives from
+                    "id": specs_key(jobs),
                 })
         except (OSError, ConnectionError) as exc:
             print(f"error: daemon at {addr}: {exc}", file=sys.stderr)

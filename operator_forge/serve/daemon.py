@@ -60,12 +60,12 @@ import threading
 import time
 
 from ..perf import cache as pf_cache
-from ..perf import env_number, metrics, n_jobs
+from ..perf import env_number, flight, metrics, n_jobs, spans
 from ..perf.remote import parse_listen
 from . import runner
 from . import server
 from .batch import _overlaps
-from .jobs import BatchManifestError, jobs_from_specs
+from .jobs import BatchManifestError, jobs_from_specs, specs_from_request
 from .server import dispatch_request, request_timeout
 from .session import CONNECT_RETRY_AFTER_S, Session
 
@@ -158,14 +158,8 @@ def _request_roots(req: dict, base_dir: str) -> tuple:
             }))
         except (TypeError, ValueError):
             return (), ()
-    if op == "job":
-        specs = [
-            req.get("job") if "job" in req
-            else {k: v for k, v in req.items() if k not in ("op",)}
-        ]
-    elif op in ("batch", "watch"):
-        specs = req.get("jobs")
-    else:
+    specs = specs_from_request(req)
+    if specs is None:
         return (), ()
     try:
         jobs = jobs_from_specs(specs, base_dir)
@@ -313,11 +307,10 @@ class ForgeDaemon:
         self._listener = sock
 
     def _boot(self) -> None:
-        # per-request serve:* spans are part of the stats contract,
-        # exactly like the stdio loop
-        from ..perf import spans
-
-        spans.enable(True)
+        # per-request serve:* spans, the always-on event ring (the
+        # flight recorder's black box + the distributed-trace segment
+        # source), refcounted with any sibling in-process server
+        server.retain_server_telemetry()
         server._drain.clear()
         self._stop_event.clear()
         server.on_drain(self._on_drain)
@@ -556,6 +549,10 @@ class ForgeDaemon:
                         # not clear in time: backpressure, not an
                         # indefinitely parked dispatcher
                         metrics.counter("daemon.lock_timeouts").inc()
+                        flight.anomaly("daemon.lock_timeout", {
+                            "session": session.id,
+                            "op": req.get("op"),
+                        })
                         session.reject_busy(
                             req,
                             "a conflicting request holds the target "
@@ -738,6 +735,9 @@ class ForgeDaemon:
         metrics.unregister_gauge("daemon.active_sessions")
         metrics.unregister_gauge("daemon.queued_requests")
         runner.set_project_scoping(False)
+        # persist the black box + timeline; the process-global state
+        # is released only when no sibling server remains
+        server.release_server_telemetry()
         self._stop_done.set()
 
 
@@ -906,12 +906,48 @@ class DaemonClient:
         except OSError:
             pass
 
+    #: ops that carry a distributed-trace context when the CLIENT is
+    #: tracing — the submissions whose server-side work belongs on the
+    #: client's timeline (control ops like ping/heartbeat stay bare)
+    _TRACED_OPS = ("job", "batch", "watch")
+
+    def _attach_trace(self, payload: dict) -> None:
+        """Stamp an outgoing request with this process's trace context
+        (no-op unless tracing is enabled here and the op is traced).
+        The trace id derives deterministically from the request's own
+        id, so an idempotent re-send rejoins the same trace."""
+        if payload.get("op") not in self._TRACED_OPS:
+            return
+        if "trace" in payload:
+            return  # the caller (the fleet coordinator) already did
+        ctx = spans.rpc_context(payload.get("id"))
+        if ctx is not None:
+            payload["trace"] = ctx
+
+    @staticmethod
+    def _ingest_trace(response) -> None:
+        """Merge a response's shipped span segment into this process's
+        ring (the socket-boundary drain-and-merge).  Events this
+        process itself produced are skipped: with an in-process server
+        (embedded daemon, tests, bench) the ring RETAINS the drained
+        segment's copies, and re-ingesting them would duplicate every
+        server span in the timeline."""
+        if not isinstance(response, dict):
+            return
+        events = response.pop("trace_events", None)
+        if events:
+            own = os.getpid()
+            spans.ingest_events(
+                [e for e in events if e.get("pid") != own]
+            )
+
     def request(self, payload: dict) -> dict:
         """One round trip (non-streaming ops), surviving a daemon
         bounce: a connect/read failure mid-round-trip reconnects with
         bounded deterministic backoff and re-sends (jobs are
         idempotent — see the class docstring), so ``batch --addr``
         outlives a coordinator-initiated daemon restart."""
+        self._attach_trace(payload)
         budget = self._retries + 1
         last = None
         for attempt in range(budget):
@@ -957,6 +993,7 @@ class DaemonClient:
                 last = exc
                 continue
             if response is not None:
+                self._ingest_trace(response)
                 return response
             last = ConnectionError("daemon closed the connection")
         raise ConnectionError(
@@ -967,11 +1004,13 @@ class DaemonClient:
     def stream(self, payload: dict):
         """Send a streaming op (watch) and yield every response line
         until the terminal one (``done`` or an error)."""
+        self._attach_trace(payload)
         self.send(payload)
         while True:
             response = self.read()
             if response is None:
                 return
+            self._ingest_trace(response)
             yield response
             if response.get("done") or response.get("ok") is False:
                 return

@@ -85,12 +85,17 @@ import socket
 import threading
 import time
 
-from ..perf import env_number, faults, metrics, spans
+from ..perf import env_number, faults, flight, metrics, spans
 from ..perf.remote import parse_listen
 from . import server
 from .batch import _overlaps, run_batch
 from .daemon import DaemonClient
-from .jobs import BatchManifestError, jobs_from_specs, specs_key
+from .jobs import (
+    BatchManifestError,
+    jobs_from_specs,
+    specs_from_request,
+    specs_key,
+)
 from .runner import _scope_label, run_job
 from .server import dispatch_request
 from .session import CONNECT_RETRY_AFTER_S, Session
@@ -278,7 +283,10 @@ class FleetCoordinator:
         self._listener = sock
 
     def _boot(self) -> None:
-        spans.enable(True)
+        # spans + the always-on event ring (the flight recorder's
+        # black box, and where daemon-shipped segments land before the
+        # client drains them), refcounted with any embedded daemon
+        server.retain_server_telemetry()
         server._drain.clear()
         self._stop_event.clear()
         server.on_drain(self._on_drain)
@@ -454,6 +462,11 @@ class FleetCoordinator:
             member.session.member_id = None
         if counted:
             metrics.counter("fleet.evictions").inc()
+            # a lost daemon is exactly the moment a post-mortem wants
+            # the ring for (anomaly() never blocks: _cond is held here)
+            flight.anomaly("fleet.evict", {
+                "member": member.id, "addr": member.addr,
+            })
 
     def _monitor_loop(self) -> None:
         while True:
@@ -471,6 +484,10 @@ class FleetCoordinator:
                     elif age > lease and not member.suspect:
                         member.suspect = True
                         metrics.counter("fleet.suspects").inc()
+                        flight.anomaly("fleet.suspect", {
+                            "member": member.id, "addr": member.addr,
+                            "lease_age_s": round(age, 3),
+                        })
                 self._cond.notify_all()
 
     # -- admission (reader threads) --------------------------------------
@@ -582,8 +599,34 @@ class FleetCoordinator:
                 if session.dead.is_set():
                     metrics.counter("serve.requests_abandoned").inc()
                 elif op in ("job", "batch"):
-                    with spans.span(f"fleet:{op}"):
-                        self._forward(session, req, op)
+                    # a traced submission adopts its context for the
+                    # whole routing lifetime: the coordinator's own
+                    # spans, the daemon's shipped segment, and any
+                    # quarantined local run all land in one trace.
+                    # The answer is written AFTER the routing spans
+                    # close — the segment drain must include the
+                    # `fleet:{op}` span itself (it is the parent the
+                    # daemon's shipped segment hangs from; shipping
+                    # from inside it would orphan the daemon's spans
+                    # in the merged timeline)
+                    tctx = spans.parse_trace_field(req)
+                    if tctx is not None and spans.trace_enabled():
+                        with spans.remote_segment(
+                            tctx[0], tctx[1], "fleet"
+                        ):
+                            with spans.span(f"fleet:{op}"):
+                                response = self._forward(
+                                    req, op
+                                )
+                        if response is not None:
+                            response["trace_events"] = (
+                                spans.drain_trace(tctx[0])
+                            )
+                    else:
+                        with spans.span(f"fleet:{op}"):
+                            response = self._forward(req, op)
+                    if response is not None:
+                        self._answer(session, response)
                 elif op in ("watch", "explain"):
                     self._answer(session, server._error(
                         f"op {op!r} is not routed by the fleet "
@@ -686,20 +729,18 @@ class FleetCoordinator:
 
     # -- dispatch --------------------------------------------------------
 
-    def _forward(self, session: Session, req: dict, op: str) -> None:
+    def _forward(self, req: dict, op: str):
+        """Route one submission; returns the FINAL response dict (the
+        dispatch loop answers it after the routing spans close, so a
+        traced submission's drained segment includes the ``fleet:op``
+        span the daemon segments hang from), or ``None`` when nothing
+        should be sent."""
         req_id = req.get("id")
-        if op == "job":
-            specs = [
-                req.get("job") if "job" in req
-                else {k: v for k, v in req.items() if k != "op"}
-            ]
-        else:
-            specs = req.get("jobs")
+        specs = specs_from_request(req)
         try:
             jobs = jobs_from_specs(specs, self.base_dir)
         except BatchManifestError as exc:
-            self._answer(session, server._error(str(exc), req_id))
-            return
+            return server._error(str(exc), req_id)
         key = specs_key(jobs)
         affinity_key = _scope_label(
             tuple(sorted({job.target() for job in jobs}))
@@ -724,6 +765,12 @@ class FleetCoordinator:
                 "jobs": [job.to_spec() for job in jobs],
             }
         forward_req["id"] = key  # the idempotency key travels with it
+        if spans.current_context() is not None:
+            # a traced submission (the dispatch loop adopted its
+            # segment): the child context makes the daemon's segment
+            # parent onto the coordinator's current routing span, so
+            # the merged timeline reads client -> coordinator -> daemon
+            forward_req["trace"] = spans.rpc_context(key)
 
         budget = fleet_retries()
         excluded: set = set()
@@ -769,12 +816,11 @@ class FleetCoordinator:
                         need_fence = False
                     elif self._probe_member(stale):
                         if attempt >= budget:
-                            self._quarantine(
-                                session, req_id, op, jobs,
-                                fresh_roots, reads=reads,
-                                writes=writes, last_member=stale,
+                            return self._quarantine(
+                                req_id, op, jobs, fresh_roots,
+                                reads=reads, writes=writes,
+                                last_member=stale,
                             )
-                            return
                         attempt += 1
                         reset_next = False
                         pinned = stale
@@ -795,17 +841,16 @@ class FleetCoordinator:
                         # half-run): the client's tree state is OURS
                         # to finish — quarantine, never bounce the
                         # mess back as busy
-                        self._quarantine(session, req_id, op, jobs,
-                                         fresh_roots, reads=reads,
-                                         writes=writes)
-                        return
+                        return self._quarantine(
+                            req_id, op, jobs, fresh_roots,
+                            reads=reads, writes=writes,
+                        )
                     payload = server._error(
                         "no daemons registered with the fleet; retry",
                         req_id, kind="busy",
                     )
                     payload["retry_after"] = CONNECT_RETRY_AFTER_S
-                    self._answer(session, payload)
-                    return
+                    return payload
                 if attempt >= budget:
                     if not dispatch_failed and busy_response is not None:
                         # only backpressure happened: nothing half-ran,
@@ -814,12 +859,11 @@ class FleetCoordinator:
                         busy_response["id"] = req_id
                         if req_id is None:
                             busy_response.pop("id", None)
-                        self._answer(session, busy_response)
-                        return
-                    self._quarantine(session, req_id, op, jobs,
-                                     fresh_roots, reads=reads,
-                                     writes=writes)
-                    return
+                        return busy_response
+                    return self._quarantine(
+                        req_id, op, jobs, fresh_roots,
+                        reads=reads, writes=writes,
+                    )
                 # members exist but every one is excluded (a lone
                 # daemon whose dispatch failed, possibly transiently):
                 # clear the exclusions so the next bounded attempt may
@@ -838,11 +882,11 @@ class FleetCoordinator:
                                           fresh_roots):
                     self._release(member, reads, writes)
                     if attempt >= budget:
-                        self._quarantine(session, req_id, op, jobs,
-                                         fresh_roots, reads=reads,
-                                         writes=writes,
-                                         last_member=member)
-                        return
+                        return self._quarantine(
+                            req_id, op, jobs, fresh_roots,
+                            reads=reads, writes=writes,
+                            last_member=member,
+                        )
                     if self._probe_member(member):
                         pinned = member
                         need_fence = True
@@ -889,10 +933,11 @@ class FleetCoordinator:
                 self._release(member, reads, writes)
                 dispatch_failed = True
                 if attempt >= budget:
-                    self._quarantine(session, req_id, op, jobs,
-                                     fresh_roots, reads=reads,
-                                     writes=writes, last_member=member)
-                    return
+                    return self._quarantine(
+                        req_id, op, jobs, fresh_roots,
+                        reads=reads, writes=writes,
+                        last_member=member,
+                    )
                 if self._probe_member(member):
                     pinned = member
                     need_fence = True
@@ -910,6 +955,10 @@ class FleetCoordinator:
                     excluded.add(member.id)
                 attempt += 1
                 metrics.counter("fleet.redispatches").inc()
+                flight.anomaly("fleet.redispatch", {
+                    "member": member.id, "op": op,
+                    "submission": key, "attempt": attempt,
+                })
                 continue
             self._release(member, reads, writes)
             if (
@@ -932,23 +981,25 @@ class FleetCoordinator:
                     response["id"] = req_id
                     if req_id is None:
                         response.pop("id", None)
-                    self._answer(session, response)
-                    return
+                    return response
                 busy_response = response
                 excluded.add(member.id)
                 attempt += 1
                 metrics.counter("fleet.busy_retries").inc()
                 continue
             break
-        metrics.histogram("fleet.dispatch.seconds").observe(
-            time.perf_counter() - started
-        )
+        elapsed = time.perf_counter() - started
+        metrics.histogram("fleet.dispatch.seconds").observe(elapsed)
         metrics.counter("fleet.dispatches").inc()
+        # per-tenant SLO at the fleet edge: the affinity key IS the
+        # project-namespace label, so coordinator latency and daemon
+        # cache attribution key on the same tenants
+        metrics.observe_slo(affinity_key, elapsed)
         if req_id is not None:
             response["id"] = req_id
         else:
             response.pop("id", None)
-        self._answer(session, response)
+        return response
 
     def _probe_member(self, member: _Member) -> bool:
         """The fencing probe: is the daemon at ``member.addr`` alive
@@ -1019,13 +1070,25 @@ class FleetCoordinator:
             response = client.read()
             if response is None:
                 raise ConnectionError("daemon closed mid-dispatch")
+            if isinstance(response, dict):
+                # the daemon's shipped span segment lands in OUR ring
+                # (tagged with the submission's trace), to be drained
+                # into the client's response by the caller.  Own-pid
+                # events are skipped: an in-process daemon's segment
+                # copies are already retained in this ring
+                events = response.pop("trace_events", None)
+                if events:
+                    own = os.getpid()
+                    spans.ingest_events(
+                        [e for e in events if e.get("pid") != own]
+                    )
             return response
         finally:
             client.close()
 
-    def _quarantine(self, session: Session, req_id, op: str, jobs,
+    def _quarantine(self, req_id, op: str, jobs,
                     fresh_roots, reads=(), writes=(),
-                    last_member=None) -> None:
+                    last_member=None) -> dict:
         """The poison-submission backstop, mirroring the workers
         layer's quarantine-to-thread: a submission that exhausted its
         re-dispatch budget runs ONCE in-process, so it completes (or
@@ -1040,6 +1103,10 @@ class FleetCoordinator:
         race a coordinator without kill authority cannot close, so it
         is bounded and documented rather than ignored)."""
         metrics.counter("fleet.jobs_quarantined").inc(len(jobs))
+        flight.anomaly("fleet.quarantine", {
+            "op": op, "jobs": len(jobs),
+            "last_member": getattr(last_member, "id", None),
+        })
         fenced = False
         if last_member is not None:
             fenced = self._fence_member(
@@ -1094,7 +1161,7 @@ class FleetCoordinator:
                 self._cond.notify_all()
         if req_id is not None:
             response["id"] = req_id
-        self._answer(session, response)
+        return response
 
     # -- stats -----------------------------------------------------------
 
@@ -1134,6 +1201,7 @@ class FleetCoordinator:
             "listen": self.address(),
             "members": {k: members[k] for k in sorted(members)},
             "queued_requests": queued,
+            "slo": metrics.slo_report(),
         }
 
     # -- teardown --------------------------------------------------------
@@ -1224,6 +1292,9 @@ class FleetCoordinator:
         server.unregister_stats_source("fleet")
         metrics.unregister_gauge("fleet.members")
         metrics.unregister_gauge("fleet.queued_requests")
+        # persist the black box + timeline; global state released only
+        # when no sibling server remains (see server.py)
+        server.release_server_telemetry()
         self._stop_done.set()
 
 

@@ -170,6 +170,33 @@ def _resolve(base_dir: str, value: str) -> str:
     return os.path.normpath(os.path.join(base_dir, value))
 
 
+#: serve-request envelope keys that are protocol metadata, never part
+#: of an inlined job spec — strip them in ONE place (a key added here
+#: is honored by every transport's spec extraction at once)
+ENVELOPE_KEYS = ("op", "trace")
+
+
+def specs_from_request(req: dict):
+    """The raw job-spec list a serve request carries: the ``job`` key
+    (or the spec inlined beside ``op``) for the job op, the ``jobs``
+    list for batch/watch, ``None`` for every other op.  Shared by the
+    stdio/daemon/fleet dispatchers, the daemon's path-lock root
+    derivation, and the SLO tenant attribution, so the envelope-key
+    strip can't drift between them."""
+    op = req.get("op") or ("job" if "command" in req else None)
+    if op == "job":
+        return [
+            req.get("job") if "job" in req
+            else {
+                k: v for k, v in req.items()
+                if k not in ENVELOPE_KEYS
+            }
+        ]
+    if op in ("batch", "watch"):
+        return req.get("jobs")
+    return None
+
+
 def jobs_from_specs(specs, base_dir: str) -> list:
     """Normalize a list of spec mappings into :class:`Job` objects,
     validating commands, required fields, and id uniqueness."""

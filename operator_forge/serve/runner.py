@@ -259,6 +259,9 @@ def run_job(job: Job) -> JobResult:
             rc, stdout, stderr = hit
             metrics.counter("serve.jobs_replayed").inc()
             metrics.histogram("serve.job.seconds").observe(0.0)
+            # a replayed request still served a tenant: SLO latency is
+            # what the client experienced, cache hit or not
+            metrics.observe_slo(_scope_label((job.target(),)), 0.0)
             return JobResult(
                 id=job.id, command=job.command, rc=rc, stdout=stdout,
                 stderr=stderr, seconds=0.0, cached=True, index=job.index,
@@ -306,6 +309,13 @@ def run_job(job: Job) -> JobResult:
                     metrics.counter("serve.job.retries").inc()
                     time.sleep(0.01 * attempt)  # deterministic backoff
                     continue
+                if isinstance(exc, TimeoutError):
+                    # the workers layer's verdict: this job blew its
+                    # task deadline on every attempt — an SLO deadline
+                    # miss charged to the tenant it was serving
+                    metrics.count_deadline_miss(
+                        _scope_label((job.target(),))
+                    )
                 err_buf.write(f"internal error: {exc}\n")
                 rc = 1
                 break
@@ -316,6 +326,7 @@ def run_job(job: Job) -> JobResult:
     )
     metrics.counter("serve.jobs_executed").inc()
     metrics.histogram("serve.job.seconds").observe(result.seconds)
+    metrics.observe_slo(_scope_label((job.target(),)), result.seconds)
     if key is not None and rc == 0:
         out_root = _out_root(job)
         post_out = _tree_state(out_root) if out_root else ()
@@ -380,8 +391,9 @@ def run_group(group) -> list:
         hit = cache.get(group_stage, key)
         if hit is not pf_cache.MISS:
             metrics.counter("serve.jobs_replayed").inc(len(group))
-            for _ in group:
+            for job in group:
                 metrics.histogram("serve.job.seconds").observe(0.0)
+                metrics.observe_slo(_scope_label((job.target(),)), 0.0)
             return [
                 JobResult(
                     id=job.id, command=job.command, rc=rc,

@@ -36,12 +36,25 @@ the response, never interleaved with the protocol stream):
   structurally — byte-identical across cache modes and worker
   backends).  With ``changed`` omitted, the last ``watch`` cycle's
   recorded change set answers "why did the last cycle recompute?";
+- ``{"op": "trace-dump"}`` — the flight recorder's on-demand surface:
+  the live trace-event ring plus the bounded anomaly log (see
+  :mod:`operator_forge.perf.flight`), from a running process with no
+  kill and no pre-arranged ``trace`` wrapper;
 - ``{"op": "shutdown"}`` — acknowledge and exit 0 (EOF does the same).
 
 Malformed lines answer ``{"ok": false, "error": ..., "error_kind":
 ...}`` and the loop continues; a request's ``id`` is echoed in its
 response so pipelined clients can correlate.  Relative job paths
 resolve against the server's working directory.
+
+Distributed tracing (PR 15): a request may carry ``"trace": {"id":
+<trace id>, "parent": <span id>}`` — the handler's spans are then
+recorded inside that trace's segment and shipped back on the response
+as ``trace_events`` (the final line, for streaming ops), so a traced
+client merges every server's work into one connected timeline.
+:class:`~operator_forge.serve.daemon.DaemonClient` stamps and ingests
+this automatically for ``job``/``batch``/``watch`` when the client
+process is tracing.
 
 Robustness (PR 7):
 
@@ -93,10 +106,10 @@ import threading
 import time
 
 from .. import __version__
-from ..perf import env_number, metrics, spans
+from ..perf import env_number, flight, metrics, spans
 from ..perf.depgraph import GRAPH
 from .batch import run_batch
-from .jobs import BatchManifestError, jobs_from_specs
+from .jobs import BatchManifestError, jobs_from_specs, specs_from_request
 from .runner import run_job
 
 #: error taxonomy: why did a request fail?
@@ -217,6 +230,47 @@ register_stats_source = metrics.register_stats_source
 unregister_stats_source = metrics.unregister_stats_source
 
 
+# -- server telemetry lifecycle --------------------------------------------
+#
+# Spans enablement and the flight recorder are PROCESS-global; a
+# process can host several servers at once (a FleetCoordinator plus
+# embedded ForgeDaemons — the test and bench topology).  Teardown is
+# therefore refcounted: the first boot turns the always-on ring and
+# recorder on, and only the LAST teardown turns them off — a daemon
+# stopping must not dark the still-running coordinator's black box.
+
+_telemetry_lock = threading.Lock()
+_telemetry_refs = [0]
+
+
+def retain_server_telemetry() -> None:
+    """One server booted: per-request spans are part of the stats
+    contract, the event ring is the flight recorder's black box and
+    the source distributed-trace segments drain from."""
+    with _telemetry_lock:
+        _telemetry_refs[0] += 1
+    spans.enable(True)
+    spans.enable_tracing(True)
+    flight.arm()
+
+
+def release_server_telemetry() -> None:
+    """One server drained: persist ITS black box and (env-configured)
+    timeline now — a drained server must not depend on unwinding out
+    of the outermost ``main()`` to write either — and release the
+    process-global state only when no sibling server remains."""
+    with _telemetry_lock:
+        _telemetry_refs[0] = max(0, _telemetry_refs[0] - 1)
+        last = _telemetry_refs[0] == 0
+    if last:
+        flight.disarm(final=True)
+    else:
+        flight.flush(final=True)
+    spans.export_env_trace(announce=False)
+    if last:
+        spans.use_env()
+
+
 def _count_error(payload: dict) -> None:
     """Account an error response by taxonomy kind — shared by every
     transport's respond path so ``serve.errors.<kind>`` counters cover
@@ -268,12 +322,19 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
                 "recorded": GRAPH.provenance(),
             },
             "remote": remote.state(),
+            "slo": metrics.slo_report(),
             "spans": spans.snapshot(),
             "tiers": metrics.tier_report(),
             "workers": workers.pool_state(),
         }
         payload.update(metrics.stats_sources())
         return (payload, True)
+    if op == "trace-dump":
+        # the flight recorder's on-demand surface: the live trace ring
+        # plus the bounded anomaly log, from a running serve/daemon/
+        # fleet process — a post-mortem that needs no kill and no
+        # pre-arranged `trace` wrapper
+        return ({"ok": True, "op": "trace-dump", **flight.dump()}, True)
     if op == "explain":
         import os as _os
 
@@ -415,10 +476,7 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
     if op == "job":
         from .runner import record_fenceable_roots
 
-        spec = req.get("job") if "job" in req else {
-            k: v for k, v in req.items() if k not in ("op",)
-        }
-        jobs = jobs_from_specs([spec], base_dir)
+        jobs = jobs_from_specs(specs_from_request(req), base_dir)
         record_fenceable_roots([
             root for root in jobs[0].writes()
             if not os.path.isdir(root)
@@ -488,7 +546,12 @@ def dispatch_request(req: dict, base_dir: str, out_lock,
         )
     except _AbandonedRequest:
         # the transport died mid-request (client disconnect): the work
-        # was abandoned cleanly — counted, never answered
+        # was abandoned cleanly — counted, never answered.  The trace
+        # shipping bucket is freed too (there is no one to ship to,
+        # and an orphaned bucket would squat a FIFO slot)
+        tctx = spans.parse_trace_field(req)
+        if tctx is not None:
+            spans.drain_trace(tctx[0])
         metrics.counter("serve.requests_abandoned").inc()
         return True
     finally:
@@ -499,6 +562,25 @@ def dispatch_request(req: dict, base_dir: str, out_lock,
             settle()
 
 
+def _slo_tenants(req: dict, base_dir: str) -> tuple:
+    """The per-tenant SLO labels a request's jobs would be charged to
+    (the ``serve.job.<tree-hash>`` project-namespace keys) — used to
+    attribute a deadline miss to its tenant(s).  Parsed only on the
+    timeout path, so the cost rides an already-lost request."""
+    specs = specs_from_request(req)
+    if specs is None:
+        return ()
+    try:
+        jobs = jobs_from_specs(specs, base_dir)
+    except (BatchManifestError, TypeError, ValueError):
+        return ()
+    from .runner import _scope_label
+
+    return tuple(sorted({
+        _scope_label((job.target(),)) for job in jobs
+    }))
+
+
 def _dispatch_inner(req, base_dir, out_lock, respond_locked,
                     deadline, abandoned, settle, handed_off):
     op = req.get("op") or ("job" if "command" in req else "?")
@@ -506,6 +588,12 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
     started = time.perf_counter()
     if abandoned is None:
         abandoned = threading.Event()
+    # distributed tracing: a request carrying a trace context adopts it
+    # for the handler's lifetime (spans tag + namespace under a fresh
+    # segment, parented onto the caller's span id) and ships the
+    # drained segment back on the response — the socket-boundary
+    # analogue of the workers' sealed-result drain
+    tctx = spans.parse_trace_field(req)
 
     def respond(payload: dict) -> None:
         with out_lock:
@@ -525,10 +613,36 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
                 raise _AbandonedRequest()
             respond_locked(payload)
 
+    def ship_trace(payload: dict) -> dict:
+        # EVERY final answer drains the request's shipping bucket —
+        # error and timeout answers included.  An undrained bucket
+        # would sit in spans._trace_buckets until FIFO eviction, and
+        # enough failed traced requests could evict a LIVE request's
+        # bucket (its response would then ship an empty segment); a
+        # timeout answer shipping the partial segment is also honest
+        # data (the client sees what ran before the abandonment)
+        if tctx is not None and spans.trace_enabled():
+            payload["trace_events"] = spans.drain_trace(tctx[0])
+        return payload
+
     def dispatch():
-        with spans.span(f"serve:{op}"):
-            return _handle(req, base_dir, emit=guarded_emit,
-                           abandoned=abandoned)
+        import contextlib
+
+        segment = (
+            spans.remote_segment(tctx[0], tctx[1], "serve")
+            if tctx is not None and spans.trace_enabled()
+            else contextlib.nullcontext()
+        )
+        with segment:
+            # the admission marker: even a request the server never
+            # finishes (SIGKILL mid-run) is visible in the flight ring
+            spans.instant(
+                f"serve.request:{op}",
+                args={"req": req_id} if req_id is not None else None,
+            )
+            with spans.span(f"serve:{op}"):
+                return _handle(req, base_dir, emit=guarded_emit,
+                               abandoned=abandoned)
 
     try:
         if deadline > 0:
@@ -541,7 +655,14 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
                     _box["exc"] = exc
                 finally:
                     # the handler's side effects end HERE — possibly
-                    # long after a timeout answer abandoned it
+                    # long after a timeout answer abandoned it.  An
+                    # ABANDONED traced handler's post-timeout spans
+                    # re-created a shipping bucket nobody will ever
+                    # answer with: free it now that the spans truly
+                    # stopped (never on the normal path — the main
+                    # thread ships the bucket after joining us)
+                    if abandoned.is_set() and tctx is not None:
+                        spans.drain_trace(tctx[0])
                     settle()
 
             worker = threading.Thread(
@@ -559,10 +680,21 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
                 with out_lock:
                     abandoned.set()
                 metrics.counter("serve.requests_abandoned").inc()
-                respond(_error(
+                # SLO accounting + flight capture: the miss is charged
+                # to the tenant(s) the request was serving, and the
+                # ring around the abandonment is snapshotted
+                tenants = _slo_tenants(req, base_dir)
+                for tenant in tenants:
+                    metrics.count_deadline_miss(tenant)
+                flight.anomaly("request.deadline", {
+                    "op": op, "id": req_id,
+                    "deadline_s": deadline,
+                    "tenants": list(tenants),
+                })
+                respond(ship_trace(_error(
                     f"deadline exceeded after {deadline:g}s",
                     req_id, kind="timeout",
-                ))
+                )))
                 return True
             if "exc" in box:
                 raise box["exc"]
@@ -572,14 +704,16 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
     except _AbandonedRequest:
         raise  # the transport is gone: counted by dispatch_request
     except BatchManifestError as exc:
-        respond(_error(str(exc), req_id))
+        respond(ship_trace(_error(str(exc), req_id)))
         return True
     except Exception as exc:  # must not kill the serving loop
         kind = _classify(exc)
         label = "internal error" if kind == "internal" else (
             f"{kind} error"
         )
-        respond(_error(f"{label}: {exc}", req_id, kind=kind))
+        respond(ship_trace(
+            _error(f"{label}: {exc}", req_id, kind=kind)
+        ))
         return True
     if req_id is not None:
         # the request id wins over a job spec's defaulted id
@@ -587,7 +721,10 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
     response.setdefault(
         "seconds", round(time.perf_counter() - started, 4)
     )
-    respond(response)
+    # ship the request's span segment home: exactly the events tagged
+    # with this trace (concurrent requests keep theirs), including any
+    # pool-worker events already ingested under the same trace id
+    respond(ship_trace(response))
     return keep_going
 
 
@@ -597,10 +734,9 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
     in_stream = in_stream if in_stream is not None else sys.stdin
     out_stream = out_stream if out_stream is not None else sys.stdout
     base_dir = os.getcwd()
-    # per-request spans are part of the protocol (the `stats` op reports
-    # them), so collection is on for the loop's lifetime regardless of
-    # OPERATOR_FORGE_PROFILE
-    spans.enable(True)
+    # spans + the always-on event ring + the flight recorder, for the
+    # loop's lifetime (refcounted: see retain_server_telemetry)
+    retain_server_telemetry()
     _drain.clear()
     installed = []
 
@@ -711,4 +847,7 @@ def serve_loop(in_stream=None, out_stream=None) -> int:
                     signal.signal(signum, previous)
                 except (ValueError, OSError):  # pragma: no cover
                     pass
-        spans.use_env()
+        # the drain-path export + refcounted global release: a
+        # `trace`-wrapped (or env-traced) server writes its timeline
+        # HERE, not only at the outermost main() exit
+        release_server_telemetry()

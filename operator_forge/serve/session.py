@@ -48,7 +48,7 @@ import json
 import threading
 import time
 
-from ..perf import metrics
+from ..perf import flight, metrics
 from .server import _AbandonedRequest, _count_error, _error
 
 #: hard ceiling on one request line — an 8 MiB JSON object is far past
@@ -130,6 +130,11 @@ class Session:
             # cancel the in-flight request too: a quiet-tree watch has
             # no next emit to fail at, so the poll must observe this
             abandoned.set()
+            # only a MID-REQUEST death is an anomaly worth a capsule —
+            # a clean EOF with nothing in flight is just a goodbye
+            flight.anomaly(
+                "session.disconnect", {"session": self.id}
+            )
 
     # -- reader ----------------------------------------------------------
 
@@ -180,6 +185,9 @@ class Session:
         """Answer an admission rejection immediately (reader thread):
         the PR 7 taxonomy's ``busy`` kind plus a retry_after hint."""
         metrics.counter("daemon.busy_rejections").inc()
+        flight.anomaly("serve.busy", {
+            "session": self.id, "reason": reason,
+        })
         payload = _error(reason, req.get("id"), kind="busy")
         payload["retry_after"] = RETRY_AFTER_S
         try:

@@ -194,6 +194,19 @@ assert telemetry["explain_names_change"].startswith("file "), (
     "explain does not name the changed file: %r"
     % telemetry["explain_names_change"]
 )
+# distributed trace + SLO (PR 15): one connected client->daemon->worker
+# timeline, per-tenant SLO keys in stable order, and a disarmed flight
+# anomaly site staying in span-noop territory.
+assert telemetry["distributed_ok"] is True, (
+    "distributed trace not connected: %d orphan(s) over %d events"
+    % (telemetry["distributed_orphans"], telemetry["distributed_events"])
+)
+assert telemetry["slo_ok"] is True, "per-tenant SLO keys malformed"
+assert telemetry["slo_tenants"] >= 2, telemetry["slo_tenants"]
+assert telemetry["flight_disabled_ok"] is True, (
+    "disarmed flight.anomaly costs %.0fns/call"
+    % telemetry["flight_disabled_per_call_ns"]
+)
 print(
     "observability contract OK: disabled %.0fns/call (%.4f%% of cold), "
     "enabled %.0fns/call (host-noise sensitive), on/off identity clean, "
@@ -204,6 +217,16 @@ print(
         telemetry["enabled_per_call_ns"],
         telemetry["explain_legs"],
         telemetry["explain_file"],
+    )
+)
+print(
+    "distributed trace OK: %d events over %d pid(s), 0 orphans; "
+    "SLO %d tenant(s) with p50/p99/p999+misses; flight site disarmed "
+    "%.0fns/call"
+    % (
+        telemetry["distributed_events"], telemetry["distributed_pids"],
+        telemetry["slo_tenants"],
+        telemetry["flight_disabled_per_call_ns"],
     )
 )
 
@@ -866,6 +889,203 @@ try:
             N, K, counters["fleet.evictions"],
             counters["fleet.redispatches"],
             counters["fleet.jobs_quarantined"], survivors,
+        )
+    )
+finally:
+    for proc, _sock in daemons:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    if coordinator.poll() is None:
+        coordinator.kill()
+        coordinator.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
+# Distributed trace + flight recorder step (PR 15): a REAL fleet of a
+# coordinator + 2 daemon subprocesses serves a CLIENT SUBPROCESS run
+# under `operator-forge trace`; the written Chrome trace must be ONE
+# connected timeline whose span parentage crosses all three processes
+# (client pid -> coordinator pid -> daemon pid).  Then a job is routed
+# to warm a daemon's flight ring, the daemon is SIGKILLed, and the
+# rolling flight capsule it left behind must HMAC-authenticate and
+# contain the served request's spans.  `stats --addr` must report the
+# live fleet's per-tenant SLO surface.
+echo "distributed trace contract: one timeline across a live 3-process fleet + SIGKILL flight capsule"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from operator_forge.perf import flight, spans
+from operator_forge.serve.daemon import DaemonClient
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-dtracestep-")
+coord_sock = os.path.join(tmp, "coord.sock")
+flight_dir = os.path.join(tmp, "flight")
+fixture = os.path.join("tests", "fixtures", "standalone")
+K = 2
+
+env = dict(os.environ)
+env.pop("OPERATOR_FORGE_FAULTS", None)
+env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+env.pop("OPERATOR_FORGE_TRACE", None)
+env["OPERATOR_FORGE_FLIGHT_DIR"] = flight_dir
+env["OPERATOR_FORGE_FLIGHT_S"] = "0.2"
+coordinator = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "fleet",
+     "--listen", coord_sock],
+    env=env, stderr=subprocess.PIPE, text=True,
+)
+daemons = []
+try:
+    shutil.copytree(fixture, os.path.join(tmp, "cfg"))
+    cfg = os.path.abspath(os.path.join(tmp, "cfg", "workload.yaml"))
+    for _ in range(400):
+        if os.path.exists(coord_sock):
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit("coordinator did not bind its socket")
+    for k in range(K):
+        sock = os.path.join(tmp, f"daemon-{k}.sock")
+        daemons.append((subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "daemon",
+             "--listen", sock, "--fleet", coord_sock],
+            env=env, stderr=subprocess.PIPE, text=True,
+        ), sock))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            with DaemonClient(coord_sock) as probe:
+                stats = probe.request({"op": "stats", "id": "s"})
+            if len(stats["fleet"]["members"]) == K:
+                break
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.1)
+    else:
+        raise SystemExit("daemons never registered with the fleet")
+
+    # the traced CLIENT SUBPROCESS: init/create-api/vet routed through
+    # the coordinator under `operator-forge trace`
+    out = os.path.join(tmp, "live", "out")
+    manifest = os.path.join(tmp, "jobs.yaml")
+    with open(manifest, "w") as fh:
+        json.dump({"jobs": [
+            {"command": "init", "workload_config": cfg,
+             "output_dir": out, "repo": "github.com/acme/traced"},
+            {"command": "create-api", "workload_config": cfg,
+             "output_dir": out},
+            {"command": "vet", "path": out},
+        ]}, fh)
+    trace_path = os.path.join(tmp, "fleet-trace.json")
+    client = subprocess.run(
+        [sys.executable, "-m", "operator_forge.cli.main", "trace",
+         "--out", trace_path, "batch", "--addr", coord_sock,
+         "--manifest", manifest],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert client.returncode == 0, client.stderr
+    with open(trace_path, encoding="utf-8") as fh:
+        events = json.load(fh)["traceEvents"]
+    verdict = spans.trace_connectivity(events)
+    assert verdict["ok"], (
+        "trace not connected: %r" % (verdict["orphans"][:3],)
+    )
+    pids = verdict["pids"]
+    assert len(pids) >= 3, (
+        "span parentage must cross client+coordinator+daemon "
+        "processes; saw pids %r" % (pids,)
+    )
+    names = {e["name"] for e in events}
+    assert "fleet:batch" in names and "serve:batch" in names, names
+    assert any(n.startswith("serve.job:") for n in names), names
+
+    # per-tenant SLO through the satellite: stats --addr on the live
+    # coordinator
+    slo_probe = subprocess.run(
+        [sys.executable, "-m", "operator_forge.cli.main", "stats",
+         "--addr", coord_sock, "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert slo_probe.returncode == 0, slo_probe.stderr
+    fleet_surface = json.loads(slo_probe.stdout)["fleet"]
+    assert fleet_surface["slo"], "no per-tenant SLO on the coordinator"
+    for entry in fleet_surface["slo"].values():
+        assert list(entry) == [
+            "count", "deadline_misses", "max", "p50", "p99", "p999",
+        ], entry
+
+    # one more (untraced) submission: routed to the same daemon by
+    # tree affinity, it guarantees the victim's flight ring holds
+    # serve.job spans regardless of shipping semantics (the ring also
+    # retains traced segments' copies, but the step should not depend
+    # on that)
+    plain = subprocess.run(
+        [sys.executable, "-m", "operator_forge.cli.main", "batch",
+         "--addr", coord_sock, "--manifest", manifest],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert plain.returncode == 0, plain.stderr
+
+    # SIGKILL the daemon that served the work: its rolling flight
+    # capsule must survive, authenticate, and hold the request's spans
+    victim = None
+    for proc, sock in daemons:
+        try:
+            with DaemonClient(sock) as probe:
+                dump = probe.request({"op": "trace-dump", "id": "d"})
+        except (OSError, ConnectionError):
+            continue
+        if any(
+            e["name"].startswith("serve.job:")
+            for e in dump.get("events", [])
+        ):
+            victim = proc
+            break
+    assert victim is not None, "no daemon holds the request's spans"
+    deadline = time.monotonic() + 60
+    capsule = None
+    while time.monotonic() < deadline:
+        for path in glob.glob(
+            os.path.join(flight_dir, "capsule-*-ring.json")
+        ):
+            try:
+                authenticated, doc = flight.read_capsule(path)
+            except (OSError, ValueError):
+                continue
+            if authenticated and any(
+                e["name"].startswith("serve.job:")
+                for e in doc["events"]
+            ):
+                capsule = path
+                break
+        if capsule:
+            break
+        time.sleep(0.1)
+    assert capsule, "no rolling capsule captured the served request"
+    victim.send_signal(signal.SIGKILL)
+    victim.wait(timeout=30)
+    authenticated, doc = flight.read_capsule(capsule)
+    assert authenticated, "post-SIGKILL capsule failed authentication"
+    assert any(
+        e["name"].startswith("serve.job:") for e in doc["events"]
+    ), "post-SIGKILL capsule lost the request's spans"
+    print(
+        "distributed trace step OK: %d events across %d processes, "
+        "connected; SLO %d tenant(s) via stats --addr; SIGKILLed "
+        "daemon left an authenticated flight capsule (%s)"
+        % (
+            verdict["events"], len(pids),
+            len(fleet_surface["slo"]), os.path.basename(capsule),
         )
     )
 finally:

@@ -71,15 +71,24 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
-def _fresh_perf_state():
+def _fresh_perf_state(tmp_path_factory):
     """Isolate the process-global perf state (content cache, spans,
-    trace ring, metrics registry) between tests: correctness must never
-    depend on what an earlier test happened to cache, and perf tests
-    configure modes explicitly."""
+    trace ring, metrics registry, flight recorder) between tests:
+    correctness must never depend on what an earlier test happened to
+    cache, and perf tests configure modes explicitly.  The flight
+    capsule directory is pointed at a per-test temp dir so server
+    tests (which arm the recorder) never litter the repo's default
+    cache dir — subprocess tests that need a specific dir set
+    ``OPERATOR_FORGE_FLIGHT_DIR`` themselves."""
     from operator_forge.perf import cache as perfcache
-    from operator_forge.perf import faults, metrics, spans, workers
+    from operator_forge.perf import faults, flight, metrics, spans, workers
 
     import sys
+
+    flight_prev = os.environ.get("OPERATOR_FORGE_FLIGHT_DIR")
+    os.environ["OPERATOR_FORGE_FLIGHT_DIR"] = str(
+        tmp_path_factory.mktemp("flight")
+    )
 
     def _clear_watch_state():
         # only if the serve layer is loaded: a watch cycle's recorded
@@ -96,17 +105,28 @@ def _fresh_perf_state():
         if remote_mod is not None:
             remote_mod.configure(None)
 
+    def _reset_server_telemetry_refs():
+        # a test that booted a server without stopping it must not
+        # leave the refcount high — later stops would then never
+        # release the process-global telemetry state
+        server_mod = sys.modules.get("operator_forge.serve.server")
+        if server_mod is not None:
+            server_mod._telemetry_refs[0] = 0
+
     perfcache.configure(None, None)
     perfcache.reset()
     spans.use_env()
     spans.reset()
     spans.clear_events()
+    spans.adopt_context(None)
     metrics.reset()
     workers.set_backend(None)
     workers.reset_degraded()
     faults.configure(None)
     faults.reset()
+    flight.reset()
     _reset_remote()
+    _reset_server_telemetry_refs()
     _clear_watch_state()
     yield
     perfcache.configure(None, None)
@@ -114,13 +134,20 @@ def _fresh_perf_state():
     spans.use_env()
     spans.reset()
     spans.clear_events()
+    spans.adopt_context(None)
     metrics.reset()
     workers.set_backend(None)
     workers.reset_degraded()
     faults.configure(None)
     faults.reset()
+    flight.reset()
     _reset_remote()
+    _reset_server_telemetry_refs()
     _clear_watch_state()
+    if flight_prev is None:
+        os.environ.pop("OPERATOR_FORGE_FLIGHT_DIR", None)
+    else:
+        os.environ["OPERATOR_FORGE_FLIGHT_DIR"] = flight_prev
 
 
 def list_samples(project: str, full_only: bool = False) -> list[str]:

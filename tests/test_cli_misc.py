@@ -554,6 +554,20 @@ class TestBench:
         assert telemetry["identity_telemetry_on_off"] is True
         assert telemetry["explain_identity"] is True
         assert telemetry["explain_names_change"].startswith("file ")
+        # ... the distributed-trace + SLO legs (PR 15): a traced
+        # daemon submission comes back as ONE connected timeline with
+        # cross-process span parentage, per-tenant SLO histograms
+        # carry the fixed field set in stable order, and the disarmed
+        # flight-recorder site stays in span-noop territory ...
+        assert telemetry["distributed_ok"] is True
+        assert telemetry["distributed_events"] > 0
+        assert telemetry["distributed_orphans"] == 0
+        assert telemetry["slo_ok"] is True
+        assert telemetry["slo_tenants"] >= 2
+        assert telemetry["slo_fields"] == [
+            "count", "deadline_misses", "max", "p50", "p99", "p999",
+        ]
+        assert telemetry["flight_disabled_ok"] is True
         # ... the chaos/self-healing section (PR 7): recovery identity
         # under injected faults, faults actually injected, fault-free
         # site overhead under the 1% bar ...

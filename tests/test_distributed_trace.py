@@ -1,0 +1,1022 @@
+"""Fleet-wide distributed tracing, flight recorder, SLO (PR 15).
+
+Four contracts:
+
+- **one connected timeline** — a traced submission that hops
+  client -> coordinator -> daemon -> pool worker produces a single
+  event set in the CLIENT's ring in which every server- and
+  worker-side span is transitively parented to the client's root span
+  (``spans.trace_connectivity``), with trace ids derived
+  deterministically from request ids (never entropy);
+- **flight recorder** — anomalies snapshot the always-on server ring
+  into HMAC-signed capsules; ``trace-dump`` serves the same ring live;
+  ``cache gc`` bounds the capsule footprint;
+- **per-tenant SLO** — request latency histograms keyed by the
+  ``serve.job.<tree-hash>`` project namespaces (p50/p99/p999 +
+  deadline misses) in ``stats`` and the fleet surface;
+- **byte identity** — tracing on vs off never changes an output byte
+  (spot-checked here; the full matrix lives in bench telemetry).
+"""
+
+import contextlib
+import glob
+import io
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import faults, flight, metrics, spans, workers
+from operator_forge.serve.daemon import DaemonClient, ForgeDaemon
+from operator_forge.serve.fleet import FleetCoordinator
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def steady_tree(tmp_path_factory):
+    base = tmp_path_factory.mktemp("dtrace")
+    config = os.path.join(str(base), "cfg", "workload.yaml")
+    shutil.copytree(
+        os.path.join(FIXTURES, "standalone"), os.path.dirname(config)
+    )
+    tree = os.path.join(str(base), "steady")
+    with contextlib.redirect_stdout(io.StringIO()):
+        for _ in range(2):
+            assert cli_main([
+                "init", "--workload-config", config,
+                "--repo", "github.com/acme/app", "--output-dir", tree,
+            ]) == 0
+            assert cli_main([
+                "create", "api", "--workload-config", config,
+                "--output-dir", tree,
+            ]) == 0
+    return tree
+
+
+@pytest.fixture
+def tree(steady_tree, tmp_path):
+    out = str(tmp_path / "proj")
+    shutil.copytree(steady_tree, out)
+    return out
+
+
+def _start_daemon(tmp_path, **kwargs) -> ForgeDaemon:
+    daemon = ForgeDaemon(
+        f"unix:{tmp_path}/dt-{time.monotonic_ns()}.sock", **kwargs
+    )
+    daemon.start()
+    return daemon
+
+
+def _wait_for(predicate, timeout=15.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class TestTraceContext:
+    def test_rpc_context_none_when_tracing_off(self):
+        spans.enable_tracing(False)
+        assert spans.rpc_context("k") is None
+
+    def test_rpc_context_trace_id_is_deterministic(self):
+        """Same request id, same trace id — the 'never Math.random'
+        rule: a re-sent idempotent request rejoins its trace."""
+        spans.enable_tracing(True)
+        a = spans.rpc_context("submission-1")
+        b = spans.rpc_context("submission-1")
+        c = spans.rpc_context("submission-2")
+        assert a["id"] == b["id"]
+        assert a["id"] != c["id"]
+        assert len(a["id"]) == 16 and int(a["id"], 16) >= 0
+
+    def test_rpc_context_parent_is_the_open_span(self):
+        spans.enable_tracing(True)
+        with spans.span("dt.outer"):
+            ctx = spans.rpc_context("k")
+            outer_id = spans.events_snapshot()  # span still open
+            assert isinstance(ctx["parent"], int) and ctx["parent"] > 0
+        (event,) = [
+            e for e in spans.events_snapshot()
+            if e["name"] == "dt.outer"
+        ]
+        assert event["args"]["id"] == ctx["parent"]
+
+    def test_remote_segment_tags_namespaces_and_parents(self):
+        spans.enable_tracing(True)
+        with spans.remote_segment("t" * 16, 7, "serve"):
+            with spans.span("dt.seg.outer"):
+                with spans.span("dt.seg.inner"):
+                    pass
+        events = {
+            e["name"]: e for e in spans.events_snapshot()
+            if e["args"].get("trace") == "t" * 16
+        }
+        outer = events["dt.seg.outer"]
+        inner = events["dt.seg.inner"]
+        assert isinstance(outer["args"]["id"], str)
+        assert outer["args"]["parent"] == 7  # segment root -> caller
+        assert inner["args"]["parent"] == outer["args"]["id"]
+        seg = outer["args"]["id"].split(":")[0]
+        assert inner["args"]["id"].startswith(seg + ":")
+
+    def test_segment_derivation_is_deterministic(self):
+        a = spans._derive_segment("t1", 5, "serve")
+        b = spans._derive_segment("t1", 5, "serve")
+        c = spans._derive_segment("t1", 6, "serve")
+        assert a == b != c
+
+    def test_drain_trace_partitions_the_ring(self):
+        spans.enable_tracing(True)
+        with spans.span("dt.keep"):
+            pass
+        with spans.remote_segment("tr-a", 0, "serve"):
+            with spans.span("dt.a"):
+                pass
+        with spans.remote_segment("tr-b", 0, "serve"):
+            with spans.span("dt.b"):
+                pass
+        drained = spans.drain_trace("tr-a")
+        assert [e["name"] for e in drained] == ["dt.a"]
+        # the shipping bucket is consumed (a second drain is empty)...
+        assert spans.drain_trace("tr-a") == []
+        # ...but the RING keeps its copies: the flight recorder and
+        # trace-dump still see traced work after it was answered
+        left = [e["name"] for e in spans.events_snapshot()]
+        assert "dt.keep" in left and "dt.b" in left and "dt.a" in left
+        # the other trace's bucket is untouched
+        assert [e["name"] for e in spans.drain_trace("tr-b")] == [
+            "dt.b"
+        ]
+
+    def test_drain_events_consumes_the_shipping_buckets_too(self):
+        """The worker-side shipping primitive must not leave bucket
+        copies behind — a pool worker ships via drain_events and never
+        calls drain_trace, so an un-cleared bucket would retain every
+        tagged event for the worker's lifetime."""
+        spans.enable_tracing(True)
+        with spans.remote_segment("tr-de", 0, "serve"):
+            with spans.span("dt.de"):
+                pass
+        drained = spans.drain_events()
+        assert any(e["name"] == "dt.de" for e in drained)
+        assert spans.drain_trace("tr-de") == []
+
+    def test_parse_trace_field_rejects_malformed(self):
+        assert spans.parse_trace_field({}) is None
+        assert spans.parse_trace_field({"trace": "x"}) is None
+        assert spans.parse_trace_field({"trace": {"id": 3}}) is None
+        assert spans.parse_trace_field(
+            {"trace": {"id": "t", "parent": {"no": 1}}}
+        ) == ("t", 0)
+        assert spans.parse_trace_field(
+            {"trace": {"id": "t", "parent": "s:4"}}
+        ) == ("t", "s:4")
+
+    def test_connectivity_flags_orphans(self):
+        ok = [
+            {"name": "root", "pid": 1, "args": {"id": 1, "parent": 0}},
+            {"name": "kid", "pid": 2, "args": {"id": "s:1",
+                                               "parent": 1}},
+        ]
+        verdict = spans.trace_connectivity(ok)
+        assert verdict["ok"] and verdict["roots"] == 1
+        assert verdict["pids"] == [1, 2]
+        broken = ok + [
+            {"name": "lost", "pid": 3,
+             "args": {"id": "x:9", "parent": "gone:1"}},
+        ]
+        verdict = spans.trace_connectivity(broken)
+        assert not verdict["ok"]
+        assert verdict["orphans"][0][0] == "lost"
+
+    def test_instant_events_join_the_graph(self):
+        spans.enable_tracing(True)
+        with spans.span("dt.holder"):
+            spans.instant("dt.marker", args={"k": "v"})
+        events = {e["name"]: e for e in spans.events_snapshot()}
+        marker = events["dt.marker"]
+        assert marker["ph"] == "i"
+        assert marker["args"]["parent"] == events["dt.holder"]["args"]["id"]
+        assert spans.trace_connectivity(
+            list(events.values())
+        )["ok"]
+
+    def test_concurrent_spans_and_drain_never_race(self):
+        """Appends share the ring lock with drain/snapshot iteration:
+        concurrent span closes while another thread drains must never
+        raise (deque-mutated-during-iteration) — the daemon hits this
+        shape on every pair of concurrent traced requests."""
+        import threading
+
+        spans.enable_tracing(True)
+        errors = []
+        stop = threading.Event()
+
+        def spin_spans():
+            try:
+                while not stop.is_set():
+                    with spans.span("dt.race"):
+                        pass
+            except BaseException as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def spin_drain():
+            try:
+                while not stop.is_set():
+                    spans.drain_trace("no-such-trace")
+                    spans.events_snapshot()
+            except BaseException as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=fn)
+            for fn in (spin_spans, spin_spans, spin_drain, spin_drain)
+        ]
+        for thread in threads:
+            thread.start()
+        time.sleep(0.5)
+        stop.set()
+        for thread in threads:
+            thread.join(5)
+        assert not errors, errors[:1]
+
+    def test_event_seq_counts_past_ring_saturation(self, monkeypatch):
+        """The flight recorder's churn signal must keep moving after
+        the ring saturates (its LENGTH pins at maxlen forever)."""
+        monkeypatch.setenv("OPERATOR_FORGE_TRACE_EVENTS", "8")
+        spans.enable_tracing(True)
+        for _ in range(20):
+            with spans.span("dt.sat"):
+                pass
+        assert len(spans.events_snapshot()) == 8
+        before = spans.event_seq()
+        with spans.span("dt.sat.more"):
+            pass
+        assert len(spans.events_snapshot()) == 8  # length unchanged
+        assert spans.event_seq() == before + 1    # churn still visible
+
+    def test_parallel_map_propagates_context(self, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "4")
+        from operator_forge.perf import parallel_map
+
+        spans.enable_tracing(True)
+
+        def task(i):
+            with spans.span("dt.pm", args={"i": i}):
+                return i
+
+        with spans.remote_segment("tr-pm", 0, "serve"):
+            with spans.span("dt.pm.submit"):
+                out = parallel_map(task, list(range(8)))
+        assert out == list(range(8))
+        tagged = [
+            e for e in spans.events_snapshot()
+            if e["name"] == "dt.pm"
+        ]
+        assert len(tagged) == 8
+        assert all(e["args"].get("trace") == "tr-pm" for e in tagged)
+        whole = [
+            e for e in spans.events_snapshot()
+            if e["args"].get("trace") == "tr-pm"
+        ]
+        assert spans.trace_connectivity(whole)["ok"]
+
+
+class TestDaemonDistributedTrace:
+    def test_traced_job_yields_one_connected_timeline(self, tree,
+                                                      tmp_path):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            spans.enable_tracing(True)
+            spans.clear_events()
+            with spans.span("dt.client"):
+                with DaemonClient(daemon.address()) as client:
+                    resp = client.request({
+                        "op": "job", "command": "vet", "path": tree,
+                        "id": "dt-j1",
+                    })
+            assert resp["ok"], resp
+            assert "trace_events" not in resp  # ingested, not leaked
+            events = spans.events_snapshot()
+            verdict = spans.trace_connectivity(events)
+            assert verdict["ok"], verdict
+            remote = {
+                e["name"] for e in events
+                if isinstance(e["args"]["id"], str)
+            }
+            # the daemon-side segment came home: dispatch, job, and
+            # gocheck spans all namespaced, all reachable from the root
+            assert "serve:job" in remote
+            assert any(n.startswith("serve.job:") for n in remote)
+            assert "gocheck.analyze" in remote
+            # in-process topology: the client skips re-ingesting its
+            # own process's shipped copies, so no span id appears
+            # twice in the merged ring
+            own_ids = [
+                e["args"]["id"] for e in events
+                if e["pid"] == os.getpid()
+                and isinstance(e["args"]["id"], str)
+            ]
+            assert len(own_ids) == len(set(own_ids))
+        finally:
+            daemon.stop()
+            spans.enable_tracing(None)
+
+    def test_untraced_client_gets_no_trace_payload(self, tree,
+                                                   tmp_path):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            spans.enable_tracing(False)
+            with DaemonClient(daemon.address()) as client:
+                resp = client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "plain",
+                })
+            assert resp["ok"]
+            assert "trace" not in resp and "trace_events" not in resp
+        finally:
+            daemon.stop()
+
+    def test_process_worker_spans_cross_pids_and_stay_parented(
+        self, steady_tree, tmp_path, monkeypatch
+    ):
+        """The acceptance bar: worker-side spans (separate PIDs) are
+        transitively parented to the client's root span."""
+        trees = []
+        for i in range(2):
+            out = str(tmp_path / f"p{i}")
+            shutil.copytree(steady_tree, out)
+            trees.append(out)
+        monkeypatch.setenv("OPERATOR_FORGE_JOBS", "4")
+        perfcache.configure(mode="mem")
+        workers.set_backend("process")
+        daemon = _start_daemon(tmp_path)
+        try:
+            spans.enable_tracing(True)
+            spans.clear_events()
+            with spans.span("dt.client"):
+                with DaemonClient(daemon.address()) as client:
+                    resp = client.request({"op": "batch", "jobs": [
+                        {"command": "vet", "path": trees[0],
+                         "id": "w0"},
+                        {"command": "vet", "path": trees[1],
+                         "id": "w1"},
+                    ], "id": "dt-batch"})
+            assert resp["ok"], resp
+            events = spans.events_snapshot()
+            verdict = spans.trace_connectivity(events)
+            assert verdict["ok"], verdict
+            worker_events = [
+                e for e in events if e["pid"] != os.getpid()
+            ]
+            if worker_events:  # fork available: the real bar
+                assert len(verdict["pids"]) >= 2
+                # worker segments carry the .p<pid> suffix, so two
+                # children can never collide
+                assert all(
+                    ".p" in str(e["args"]["id"])
+                    for e in worker_events
+                )
+        finally:
+            daemon.stop()
+            spans.enable_tracing(None)
+            workers.set_backend(None)
+
+    def test_tracing_never_changes_job_output(self, tree, tmp_path):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            spans.enable_tracing(False)
+            with DaemonClient(daemon.address()) as client:
+                plain = client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "idn",
+                })
+            spans.enable_tracing(True)
+            spans.clear_events()
+            with DaemonClient(daemon.address()) as client:
+                traced = client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "idn",
+                })
+            for key in ("rc", "stdout", "stderr"):
+                assert plain[key] == traced[key]
+        finally:
+            daemon.stop()
+            spans.enable_tracing(None)
+
+
+class TestFleetDistributedTrace:
+    def test_fleet_submission_traces_across_all_hops(
+        self, steady_tree, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_LEASE_S", "1")
+        tree = str(tmp_path / "proj")
+        shutil.copytree(steady_tree, tree)
+        perfcache.configure(mode="mem")
+        coordinator = FleetCoordinator(
+            f"unix:{tmp_path}/dtc.sock"
+        )
+        coordinator.start()
+        daemons = [
+            _start_daemon(tmp_path, fleet=coordinator.address())
+            for _ in range(2)
+        ]
+        try:
+            def registered():
+                with DaemonClient(coordinator.address()) as c:
+                    st = c.request({"op": "stats", "id": "r"})
+                return len(st.get("fleet", {}).get("members", {})) == 2
+
+            _wait_for(registered, message="2 daemons registered")
+            spans.enable_tracing(True)
+            spans.clear_events()
+            with spans.span("dt.fleet.client"):
+                with DaemonClient(coordinator.address()) as client:
+                    resp = client.request({"op": "batch", "jobs": [
+                        {"command": "vet", "path": tree, "id": "f0"},
+                        {"command": "lint", "path": tree, "id": "f1"},
+                    ], "id": "dt-fleet"})
+            assert resp["ok"], resp
+            events = spans.events_snapshot()
+            verdict = spans.trace_connectivity(events)
+            assert verdict["ok"], verdict
+            remote = {
+                e["name"] for e in events
+                if isinstance(e["args"]["id"], str)
+            }
+            # both hops contributed: the coordinator's routing span
+            # AND the daemon's serve segment, one tree
+            assert "fleet:batch" in remote
+            assert "serve:batch" in remote
+            assert any(n.startswith("serve.job:") for n in remote)
+        finally:
+            for daemon in daemons:
+                daemon.stop()
+            coordinator.stop()
+            spans.enable_tracing(None)
+
+    def test_fleet_stats_carries_per_tenant_slo(
+        self, steady_tree, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_LEASE_S", "1")
+        tree = str(tmp_path / "proj")
+        shutil.copytree(steady_tree, tree)
+        perfcache.configure(mode="mem")
+        coordinator = FleetCoordinator(f"unix:{tmp_path}/dts.sock")
+        coordinator.start()
+        daemon = _start_daemon(tmp_path, fleet=coordinator.address())
+        try:
+            def registered():
+                with DaemonClient(coordinator.address()) as c:
+                    st = c.request({"op": "stats", "id": "r"})
+                return len(st.get("fleet", {}).get("members", {})) == 1
+
+            _wait_for(registered, message="daemon registered")
+            with DaemonClient(coordinator.address()) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "slo1",
+                })["ok"]
+                st = client.request({"op": "stats", "id": "slo-st"})
+            fleet = st["fleet"]
+            assert "slo" in fleet and fleet["slo"]
+            tenant, entry = next(iter(fleet["slo"].items()))
+            assert list(entry) == [
+                "count", "deadline_misses", "max", "p50", "p99",
+                "p999",
+            ]
+            assert entry["count"] >= 1
+            assert list(fleet["slo"]) == sorted(fleet["slo"])
+        finally:
+            daemon.stop()
+            coordinator.stop()
+
+
+class TestFlightRecorder:
+    def test_anomaly_flush_writes_authenticated_capsule(self,
+                                                        tmp_path):
+        flight.configure(str(tmp_path / "fl"))
+        flight.arm()
+        spans.enable_tracing(True)
+        with spans.span("dt.capsule.work"):
+            pass
+        flight.anomaly("request.deadline", {"op": "job"})
+        flight.flush()
+
+        # the recorder thread may have raced this flush (and may also
+        # drop a rolling -ring capsule) — wait for the ANOMALY capsule
+        def anomaly_capsules():
+            return [
+                path for path in glob.glob(
+                    str(tmp_path / "fl" / "capsule-*.json")
+                )
+                if not path.endswith("-ring.json")
+            ]
+
+        _wait_for(anomaly_capsules, message="anomaly capsule")
+        caps = anomaly_capsules()
+        assert flight.verify_capsule(caps[0])
+        authenticated, doc = flight.read_capsule(caps[0])
+        assert authenticated
+        assert doc["kind"] == "request.deadline"
+        assert doc["anomalies"][-1]["kind"] == "request.deadline"
+        assert any(
+            e["name"] == "dt.capsule.work" for e in doc["events"]
+        )
+
+    def test_tampered_capsule_fails_authentication(self, tmp_path):
+        flight.configure(str(tmp_path / "fl"))
+        flight.arm()
+        spans.enable_tracing(True)
+        flight.anomaly("serve.busy", None)
+        flight.flush()
+        _wait_for(
+            lambda: glob.glob(
+                str(tmp_path / "fl" / "capsule-*.json")
+            ),
+            message="capsule to tamper with",
+        )
+        # stop the recorder first so no rewrite races the tampering;
+        # both the explicit flush and the recorder thread may have
+        # written one — tampering must break every copy
+        flight.disarm()
+        caps = glob.glob(str(tmp_path / "fl" / "capsule-*.json"))
+        for cap in caps:
+            with open(cap, "r+b") as fh:
+                data = fh.read()
+                fh.seek(len(data) - 2)
+                fh.write(b"~")
+            assert not flight.verify_capsule(cap)
+
+    def test_disarmed_anomaly_is_a_noop(self, tmp_path):
+        flight.configure(str(tmp_path / "fl"))
+        assert not flight.armed()
+        flight.anomaly("serve.busy", None)
+        assert flight.anomaly_log() == []
+        assert not flight.flush()
+        assert glob.glob(str(tmp_path / "fl" / "*")) == []
+
+    def test_keep_budget_bounds_capsules(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_KEEP", "3")
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_DEBOUNCE_S", "0")
+        flight.configure(str(tmp_path / "fl"))
+        flight.arm()
+        spans.enable_tracing(True)
+        for i in range(6):
+            flight.anomaly("fleet.redispatch", {"i": i})
+            flight.flush()
+        caps = glob.glob(str(tmp_path / "fl" / "capsule-*.json"))
+        assert len(caps) <= 3
+
+    def test_write_error_fault_counts_and_never_raises(
+        self, tmp_path
+    ):
+        flight.configure(str(tmp_path / "fl"))
+        flight.arm()
+        faults.configure("flight.write_error@capsule")
+        flight.anomaly("serve.busy", None)
+        flight.flush()  # one writer (this call or the recorder
+        #                 thread) attempts, fails, swallows
+        _wait_for(
+            lambda: metrics.counter(
+                "flight.write_errors"
+            ).value() >= 1,
+            message="write error counted",
+        )
+        assert ("flight.write_error", "capsule", 1) in faults.fired()
+        assert glob.glob(str(tmp_path / "fl" / "capsule-*.json")) == []
+        faults.configure(None)
+
+    def test_serve_deadline_abandonment_records_anomaly_and_miss(
+        self, tree, monkeypatch
+    ):
+        """A deadline-abandoned request leaves (a) a flight anomaly
+        whose capsule would hold the abandoned request's spans and (b)
+        an SLO deadline miss charged to its tenant."""
+        import threading
+
+        from operator_forge.serve import server as server_mod
+
+        flight.arm()
+        spans.enable_tracing(True)
+        spans.clear_events()
+        out_lock = threading.Lock()
+        answers = []
+
+        def respond_locked(payload):
+            answers.append(payload)
+
+        server_mod.dispatch_request(
+            {"op": "job", "command": "vet", "path": tree,
+             "id": "slow"},
+            os.path.dirname(tree), out_lock, respond_locked,
+            deadline=0.01,
+        )
+        assert answers and answers[0]["error_kind"] == "timeout"
+        kinds = [a["kind"] for a in flight.anomaly_log()]
+        assert "request.deadline" in kinds
+        slo = metrics.slo_report()
+        assert sum(
+            entry["deadline_misses"] for entry in slo.values()
+        ) == 1
+        # the admission marker for the abandoned request is in the
+        # ring — what a SIGKILL capsule would preserve
+        assert any(
+            e["name"] == "serve.request:job"
+            for e in spans.events_snapshot()
+        )
+        spans.enable_tracing(None)
+
+    def test_trace_dump_op_serves_the_live_ring(self, tree, tmp_path):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "td1",
+                })["ok"]
+                dump = client.request({"op": "trace-dump",
+                                       "id": "td2"})
+            assert dump["ok"] and dump["op"] == "trace-dump"
+            assert dump["armed"] is True
+            names = {e["name"] for e in dump["events"]}
+            assert any(n.startswith("serve.job:") for n in names)
+            assert isinstance(dump["anomalies"], list)
+        finally:
+            daemon.stop()
+
+    def test_cache_gc_sweeps_expired_capsules(self, tmp_path,
+                                              monkeypatch, capsys):
+        flight_dir = tmp_path / "cache" / "flight"
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_DIR",
+                           str(flight_dir))
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_KEEP", "2")
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_DEBOUNCE_S", "0")
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        flight.arm()
+        spans.enable_tracing(True)
+        for i in range(5):
+            flight.anomaly("fleet.redispatch", {"i": i})
+            with flight._lock:
+                flight._pending[0] = 1  # force a fresh capsule each
+            flight._write_anomaly_capsule("fleet.redispatch")
+        # over-stuff past the keep budget by writing directly
+        flight.disarm()
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_KEEP", "1")
+        assert cli_main(["cache", "gc"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        for key in ("flight_entries", "flight_bytes",
+                    "flight_removed", "flight_bytes_reclaimed"):
+            assert key in out
+        assert out["flight_entries"] <= 1
+        assert out["flight_removed"] >= 1
+        remaining = glob.glob(str(flight_dir / "capsule-*.json"))
+        assert len(remaining) <= 1
+        # TTL zero: a second gc expires even the survivor
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_TTL_S", "0")
+        assert cli_main(["cache", "gc"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["flight_entries"] == 0
+        assert glob.glob(str(flight_dir / "capsule-*.json")) == []
+
+
+class TestSloCardinality:
+    def test_tenants_past_the_cap_aggregate_into_overflow(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("OPERATOR_FORGE_SLO_TENANTS", "2")
+        for tenant in ("aaa", "bbb", "ccc", "ddd"):
+            metrics.observe_slo(tenant, 0.01)
+        metrics.count_deadline_miss("eee")  # also capped
+        slo = metrics.slo_report()
+        assert set(slo) == {"aaa", "bbb", metrics.SLO_OVERFLOW}
+        assert slo[metrics.SLO_OVERFLOW]["count"] == 2
+        assert slo[metrics.SLO_OVERFLOW]["deadline_misses"] == 1
+        # an already-tracked tenant keeps its own slot past the cap
+        metrics.observe_slo("aaa", 0.02)
+        assert metrics.slo_report()["aaa"]["count"] == 2
+
+    def test_miss_only_tenants_consume_cap_slots(self, monkeypatch):
+        """A tenant whose every request was abandoned has only a miss
+        counter — it must occupy a cap slot like any other (slo_report
+        emits a row per miss counter, so exempting them would be the
+        unbounded-growth hole the cap exists to close)."""
+        monkeypatch.setenv("OPERATOR_FORGE_SLO_TENANTS", "2")
+        metrics.count_deadline_miss("m1")
+        metrics.count_deadline_miss("m2")
+        metrics.count_deadline_miss("m3")
+        slo = metrics.slo_report()
+        assert set(slo) == {"m1", "m2", metrics.SLO_OVERFLOW}
+        assert slo[metrics.SLO_OVERFLOW]["deadline_misses"] == 1
+        # a tracked miss-only tenant keeps its slot for latencies too
+        metrics.observe_slo("m1", 0.01)
+        assert metrics.slo_report()["m1"]["count"] == 1
+
+    def test_error_answers_drain_the_shipping_bucket(self):
+        """A traced request answered through an ERROR path must still
+        consume its shipping bucket (and ship the partial segment):
+        orphaned buckets could FIFO-evict a live request's segment."""
+        import threading
+
+        from operator_forge.serve import server as server_mod
+
+        spans.enable_tracing(True)
+        answers = []
+        server_mod.dispatch_request(
+            {"op": "batch", "jobs": "not-a-list", "id": "bad",
+             "trace": {"id": "tr-err", "parent": 0}},
+            os.getcwd(), threading.Lock(),
+            lambda payload: answers.append(payload), 0.0,
+        )
+        assert answers and answers[0]["ok"] is False
+        # the segment (at least the admission marker) shipped on the
+        # error answer, and the bucket is gone
+        assert answers[0].get("trace_events")
+        assert spans.drain_trace("tr-err") == []
+        spans.enable_tracing(None)
+
+
+class TestServerTelemetryLifecycle:
+    def test_sibling_server_teardown_releases_telemetry_last(
+        self, tmp_path, monkeypatch
+    ):
+        """A process can host several servers (a coordinator plus
+        embedded daemons): telemetry teardown is refcounted, so the
+        FIRST server to finish stopping must not disarm the flight
+        recorder or the ring while a sibling's teardown is still
+        writing its own capsules — only the last one out releases.
+        (The drain itself is process-global by design — one shared
+        request_shutdown — so the siblings drain together; the
+        refcount governs the telemetry state during that teardown.)"""
+        monkeypatch.delenv("OPERATOR_FORGE_TRACE", raising=False)
+        first = _start_daemon(tmp_path)
+        second = _start_daemon(tmp_path)
+        assert flight.armed() and spans.trace_enabled()
+        first.stop()
+        # the sibling still owns the telemetry: its teardown capsules
+        # and any in-flight anomaly capture must find the recorder on
+        assert flight.armed() and spans.trace_enabled()
+        second.stop()
+        # the LAST teardown releases the process-global state
+        assert not flight.armed()
+        assert spans.trace_enabled() is False
+
+
+class TestCapsuleEventBudget:
+    def test_capsules_snapshot_a_bounded_tail(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_FLIGHT_EVENTS", "16")
+        flight.configure(str(tmp_path / "fl"))
+        flight.arm()
+        spans.enable_tracing(True)
+        for i in range(40):
+            with spans.span(f"dt.budget.{i}"):
+                pass
+        flight.anomaly("serve.busy", None)
+        flight.flush()
+        caps = [
+            path for path in glob.glob(
+                str(tmp_path / "fl" / "capsule-*.json")
+            )
+            if not path.endswith("-ring.json")
+        ]
+        assert caps
+        _auth, doc = flight.read_capsule(caps[0])
+        assert len(doc["events"]) <= 16
+        assert doc["events_dropped"] >= 24
+        # the TAIL survives: the newest span is in, the oldest is out
+        names = {e["name"] for e in doc["events"]}
+        assert "dt.budget.39" in names and "dt.budget.0" not in names
+
+
+class TestStatsSourceRegistry:
+    """The register_stats_source unit surface (it moved from server.py
+    to metrics.py in PR 14 and was only covered through daemon/fleet
+    e2e until now)."""
+
+    def test_registration_appears_in_report_and_stats_sources(self):
+        metrics.register_stats_source("zz-unit", lambda: {"k": 1})
+        try:
+            assert metrics.stats_sources()["zz-unit"] == {"k": 1}
+            assert metrics.report()["zz-unit"] == {"k": 1}
+        finally:
+            metrics.unregister_stats_source("zz-unit")
+
+    def test_sources_render_in_stable_name_order(self):
+        metrics.register_stats_source("b-src", lambda: 2)
+        metrics.register_stats_source("a-src", lambda: 1)
+        metrics.register_stats_source("c-src", lambda: 3)
+        try:
+            assert list(metrics.stats_sources()) == [
+                "a-src", "b-src", "c-src",
+            ]
+            report = metrics.report()
+            fixed = ["cache", "graph", "metrics", "slo", "spans",
+                     "tiers"]
+            assert list(report) == fixed + ["a-src", "b-src", "c-src"]
+        finally:
+            for name in ("a-src", "b-src", "c-src"):
+                metrics.unregister_stats_source(name)
+
+    def test_duplicate_name_last_registration_wins(self):
+        metrics.register_stats_source("dup-src", lambda: "first")
+        metrics.register_stats_source("dup-src", lambda: "second")
+        try:
+            assert metrics.stats_sources()["dup-src"] == "second"
+        finally:
+            metrics.unregister_stats_source("dup-src")
+
+    def test_unregister_on_close_removes_the_source(self):
+        metrics.register_stats_source("gone-src", lambda: 1)
+        metrics.unregister_stats_source("gone-src")
+        assert "gone-src" not in metrics.stats_sources()
+        # unregistering a never-registered name must not raise
+        metrics.unregister_stats_source("never-src")
+
+    def test_raising_source_is_skipped_not_fatal(self):
+        def boom():
+            raise RuntimeError("snapshot failed")
+
+        metrics.register_stats_source("boom-src", boom)
+        metrics.register_stats_source("ok-src", lambda: 7)
+        try:
+            rendered = metrics.stats_sources()
+            assert "boom-src" not in rendered
+            assert rendered["ok-src"] == 7
+        finally:
+            metrics.unregister_stats_source("boom-src")
+            metrics.unregister_stats_source("ok-src")
+
+    def test_daemon_registers_and_releases_its_source(self, tmp_path):
+        daemon = _start_daemon(tmp_path)
+        assert "daemon" in metrics.stats_sources()
+        daemon.stop()
+        assert "daemon" not in metrics.stats_sources()
+
+
+class TestStatsAddr:
+    def test_stats_addr_queries_a_running_daemon(self, tree, tmp_path,
+                                                 capsys):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "sa1",
+                })["ok"]
+            assert cli_main([
+                "stats", "--addr", daemon.address(), "--json",
+            ]) == 0
+            report = json.loads(capsys.readouterr().out)
+            # the DAEMON's accumulated numbers, not this process's
+            # empty registry: the job it just served is visible
+            assert report["metrics"]["counters"][
+                "serve.jobs_executed"] >= 1
+            assert report["slo"]
+            assert "daemon" in report
+            # protocol envelope stripped: same shape as local stats
+            assert "ok" not in report and "op" not in report
+        finally:
+            daemon.stop()
+
+    def test_stats_addr_human_mode_renders_slo(self, tree, tmp_path,
+                                               capsys):
+        perfcache.configure(mode="mem")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "sh1",
+                })["ok"]
+            assert cli_main(["stats", "--addr",
+                             daemon.address()]) == 0
+            out = capsys.readouterr().out
+            assert "slo tenants:" in out
+            assert "deadline_misses=" in out
+        finally:
+            daemon.stop()
+
+    def test_stats_addr_dead_server_fails_cleanly(self, tmp_path,
+                                                  capsys):
+        missing = str(tmp_path / "nobody.sock")
+        assert cli_main(["stats", "--addr", missing, "--json"]) == 1
+        assert "error:" in capsys.readouterr().err
+
+
+class TestSubprocessLifecycles:
+    def _spawn_daemon(self, tmp_path, extra_env=None):
+        sock = str(tmp_path / "sub.sock")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT
+        if extra_env:
+            env.update(extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main",
+             "daemon", "--listen", sock],
+            cwd=str(tmp_path), env=env,
+            stderr=subprocess.PIPE, text=True,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(sock):
+                return proc, sock
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        proc.kill()
+        raise AssertionError(
+            f"daemon did not bind: {proc.stderr.read()}"
+        )
+
+    def test_sigterm_drain_exports_env_trace(self, tree, tmp_path):
+        """The satellite: a trace-wrapped daemon writes its
+        OPERATOR_FORGE_TRACE file on clean (drain) shutdown."""
+        trace_path = str(tmp_path / "drain-trace.json")
+        proc, sock = self._spawn_daemon(
+            tmp_path, {"OPERATOR_FORGE_TRACE": trace_path},
+        )
+        try:
+            with DaemonClient(sock, timeout=120) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "dr1",
+                })["ok"]
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        assert rc == 0, proc.stderr.read()
+        assert os.path.exists(trace_path)
+        with open(trace_path, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert any(n.startswith("serve.job:") for n in names)
+
+    def test_sigkill_leaves_authenticated_capsule_with_request_spans(
+        self, tree, tmp_path
+    ):
+        """The acceptance bar: SIGKILL a daemon after it served work —
+        the rolling flight capsule survives, authenticates, and holds
+        the request's spans.  (SIGKILL runs no exit hook; the capsule
+        exists because the recorder exports periodically.)"""
+        flight_dir = str(tmp_path / "flightdir")
+        proc, sock = self._spawn_daemon(tmp_path, {
+            "OPERATOR_FORGE_FLIGHT_DIR": flight_dir,
+            "OPERATOR_FORGE_FLIGHT_S": "0.2",
+        })
+        try:
+            with DaemonClient(sock, timeout=120) as client:
+                assert client.request({
+                    "op": "job", "command": "vet", "path": tree,
+                    "id": "killme",
+                })["ok"]
+            # wait for a periodic export that already captured the
+            # served request (a tick can land mid-job and hold only
+            # its admission marker; the next tick rewrites in place)
+            def capsule_has_job_spans():
+                caps = glob.glob(
+                    os.path.join(flight_dir, "capsule-*-ring.json")
+                )
+                if not caps:
+                    return False
+                try:
+                    _auth, doc = flight.read_capsule(caps[0])
+                except (OSError, ValueError):
+                    return False  # mid-replace: retry
+                return any(
+                    e["name"].startswith("serve.job:")
+                    for e in doc["events"]
+                )
+
+            _wait_for(capsule_has_job_spans,
+                      message="rolling capsule with job spans")
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+        (cap,) = glob.glob(
+            os.path.join(flight_dir, "capsule-*-ring.json")
+        )
+        assert flight.verify_capsule(cap)
+        authenticated, doc = flight.read_capsule(cap)
+        assert authenticated and doc["kind"] == "periodic"
+        names = {e["name"] for e in doc["events"]}
+        assert any(n.startswith("serve.job:") for n in names)
+        assert any(n == "serve.request:job" for n in names)

@@ -148,7 +148,7 @@ class TestMembership:
                 fleet = stats["fleet"]
                 assert list(fleet) == [
                     "affinities", "counters", "lease_s", "listen",
-                    "members", "queued_requests",
+                    "members", "queued_requests", "slo",
                 ]
                 entry = fleet["members"]["d1"]
                 assert entry == {

@@ -322,8 +322,8 @@ class TestMetrics:
         metrics.counter("obs.cli").inc()
         assert cli_main(["stats", "--json"]) == 0
         report = json.loads(capsys.readouterr().out)
-        assert list(report) == ["cache", "graph", "metrics", "spans",
-                                "tiers"]
+        assert list(report) == ["cache", "graph", "metrics", "slo",
+                                "spans", "tiers"]
         assert report["tiers"]["mode"] in (None, "walk", "compile",
                                            "bytecode")
         assert list(report["graph"]) == ["dirty", "reused", "recomputed"]
@@ -357,6 +357,8 @@ class TestCacheGcJson:
         assert list(summary) == [
             "entries_removed", "bytes_reclaimed", "bytes_remaining",
             "quarantine_entries", "quarantine_bytes",
+            "flight_entries", "flight_bytes", "flight_removed",
+            "flight_bytes_reclaimed",
         ]
         assert summary["entries_removed"] == 0
         assert summary["quarantine_entries"] == 0
