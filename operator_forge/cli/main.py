@@ -553,7 +553,16 @@ _operator_forge() {
         completion)
             COMPREPLY=($(compgen -W "bash zsh fish" -- "$cur"));;
         *)
-            COMPREPLY=($(compgen -f -- "$cur"));;
+            case "$cur" in
+                OPERATOR_FORGE_RENDER=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_RENDER=ref OPERATOR_FORGE_RENDER=program" -- "$cur"));;
+                OPERATOR_FORGE_GOCHECK=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_GOCHECK=walk OPERATOR_FORGE_GOCHECK=compile OPERATOR_FORGE_GOCHECK=bytecode" -- "$cur"));;
+                OPERATOR_FORGE_CACHE=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_CACHE=off OPERATOR_FORGE_CACHE=mem OPERATOR_FORGE_CACHE=disk" -- "$cur"));;
+                *)
+                    COMPREPLY=($(compgen -f -- "$cur"));;
+            esac;;
     esac
 }
 complete -F _operator_forge operator-forge
@@ -1095,6 +1104,16 @@ def cmd_stats(args: argparse.Namespace) -> int:
             tiers.get("compile.reused", 0),
             tiers.get("bytecode.executed", 0),
             tiers.get("bytecode.deopt", 0),
+        )
+    )
+    print(
+        "render: mode=%s lowered=%d hydrated=%d executed=%d deopt=%d"
+        % (
+            tiers.get("render_mode"),
+            tiers.get("render.lowered", 0),
+            tiers.get("render.hydrated", 0),
+            tiers.get("render.executed", 0),
+            tiers.get("render.deopt", 0),
         )
     )
     slo = report.get("slo") or {}

@@ -13,4 +13,8 @@ contract:
 - all other scalars become typed Go literals.
 """
 
-from .generate import generate, generate_for_document  # noqa: F401
+from .generate import (  # noqa: F401
+    generate,
+    generate_for_document,
+    generate_for_document_lowered,
+)

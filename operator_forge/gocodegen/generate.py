@@ -141,6 +141,23 @@ def generate_for_document(doc: Document, var_name: str) -> str:
     )
 
 
+def generate_for_document_lowered(
+    doc: Document, var_name: str, content_key: str
+) -> str:
+    """The render-program tier over :func:`generate_for_document`: the
+    emitted Go source is a pure function of the document's source bytes
+    (``content_key``) and the variable name, so the emission lowers
+    once per content hash into the ``render.lower`` blob store and
+    replays across processes without re-walking the node tree."""
+    from ..scaffold import render
+
+    return render.lowered_blob(
+        "gocodegen.document",
+        (content_key, var_name),
+        lambda: generate_for_document(doc, var_name),
+    )
+
+
 def generate(manifest_yaml: str, var_name: str) -> str:
     """Parse one manifest document and generate its Go constructor source
     (the ocgk ``generate.Generate`` equivalent)."""

@@ -470,15 +470,22 @@ def tier_report() -> dict:
     import sys
 
     compiler = sys.modules.get("operator_forge.gocheck.compiler")
-    if compiler is None:
+    renderer = sys.modules.get("operator_forge.scaffold.render")
+    if compiler is None and renderer is None:
         return {"mode": None}
-    compiler.flush_counters()  # reconcile the lock-free tallies
+    out = {"mode": compiler.mode() if compiler is not None else None}
+    if compiler is not None:
+        compiler.flush_counters()  # reconcile the lock-free tallies
+    if renderer is not None:
+        renderer.flush_counters()
+        out["render_mode"] = renderer.mode()
     counts = counters_snapshot()
-    out = {"mode": compiler.mode()}
     for name in (
         "compile.lowered", "compile.promoted", "compile.hydrated",
         "compile.reused", "bytecode.executed", "bytecode.deopt",
         "sched.goroutines", "sched.leaked", "sched.deadlocks",
+        "render.lowered", "render.hydrated", "render.executed",
+        "render.deopt",
     ):
         out[name] = counts.get(name, 0)
     return out

@@ -215,6 +215,7 @@ _SHIPPED_ENV = (
     "OPERATOR_FORGE_JOBS",
     "OPERATOR_FORGE_GOCHECK",
     "OPERATOR_FORGE_GOCHECK_PROMOTE",
+    "OPERATOR_FORGE_RENDER",
     "OPERATOR_FORGE_PROFILE",
     "OPERATOR_FORGE_TRACE",
     "OPERATOR_FORGE_TRACE_EVENTS",
@@ -239,6 +240,7 @@ def _task_config() -> dict:
         "cache_root": cache._root_override,
         "gocheck_mode": compiler._forced,
         "gocheck_promote": compiler._forced_promote,
+        "render_mode": _render_forced(),
         "env": {k: os.environ.get(k) for k in _SHIPPED_ENV},
         # the programmatic tracing override (cmd_trace, tests) — env
         # shipping alone would miss it, and a worker forked mid-trace
@@ -255,6 +257,14 @@ def _task_config() -> dict:
         "remote": _remote_forced(),
         "gen": _reset_gen[0],
     }
+
+
+def _render_forced():
+    # lazy: the render tier only matters once scaffolding has loaded it
+    import sys
+
+    render = sys.modules.get("operator_forge.scaffold.render")
+    return None if render is None else render._forced
 
 
 def _remote_forced():
@@ -294,6 +304,12 @@ def _apply_config(cfg: dict) -> None:
     pf_cache.configure(cfg["cache_mode"], cfg["cache_root"])
     compiler.set_mode(cfg["gocheck_mode"])
     compiler.set_promote_after(cfg.get("gocheck_promote"))
+    if cfg.get("render_mode") != _render_forced():
+        # ship the parent's programmatic render-mode override (bench
+        # identity legs, tests) — env shipping alone would miss it
+        from ..scaffold import render
+
+        render.set_mode(cfg.get("render_mode"))
     if cfg["faults"] != faults.forced_spec():
         # only on change: configure() resets the worker's hit counters,
         # and a per-task reset would re-fire every :1 fault forever

@@ -101,6 +101,14 @@ def _publish(target: str, content: str) -> None:
     leave a torn file behind — a preserve-on-exists policy or a
     crash-retried batch group would adopt it, breaking the recovery
     byte-identity contract."""
+    if "\x00" in content:
+        # generated text never contains NUL; one slipping through means
+        # a render-lowering sentinel escaped a probe render — fail the
+        # write loudly instead of publishing corrupt output
+        raise ScaffoldError(
+            f"NUL byte in generated content for {target}: "
+            "render-lowering sentinel leaked into a production render"
+        )
     _sweep_stale_temps(os.path.dirname(target) or ".")
     tmp = f"{target}{_TMP_MARKER}-{os.getpid()}-{threading.get_ident()}"
     try:
@@ -180,6 +188,8 @@ class Scaffold:
         specs: list[FileSpec],
         fragments: Optional[list[Fragment]] = None,
     ) -> None:
+        from . import render
+
         specs = list(specs)
         fragments = list(fragments or [])
         self.specs = specs
@@ -198,8 +208,20 @@ class Scaffold:
                 outcomes = parallel_map(self._write_one, specs)
             for outcome in outcomes:
                 self._record(outcome)
-            for fragment in fragments:
-                self._insert(fragment)
+        with spans.span("fragment"):
+            if fragments and not self.dry_run and render.mode() != "ref":
+                self._insert_fused(fragments)
+            else:
+                # the pinned reference path: one read → splice →
+                # publish per fragment (and the dry-run classifier)
+                for fragment in fragments:
+                    self._insert(fragment)
+        # persist freshly lowered render programs while the process is
+        # still alive — pool workers and later cold processes hydrate
+        # from these manifests instead of re-lowering (the same
+        # mid-process flush point gocheck uses after a suite run);
+        # no-op when nothing new was lowered or the cache is off
+        render.flush_lowered()
 
     # -- files ----------------------------------------------------------
 
@@ -275,12 +297,14 @@ class Scaffold:
     @staticmethod
     def _fragment_present(lines: list[str], code: str) -> bool:
         """Idempotency: the fragment is already inserted when every
-        non-blank fragment line appears in the file."""
+        non-blank fragment line appears in the file.  The file's
+        stripped lines build ONE set (a per-fragment-line linear scan
+        was O(fragment_lines × file_lines) on every insert)."""
         fragment_lines = [l for l in code.rstrip("\n").split("\n") if l.strip()]
-        return bool(fragment_lines) and all(
-            any(l.strip() == existing.strip() for existing in lines)
-            for l in fragment_lines
-        )
+        if not fragment_lines:
+            return False
+        stripped = {existing.strip() for existing in lines}
+        return all(l.strip() in stripped for l in fragment_lines)
 
     def _find_marker(self, lines: list[str], fragment: Fragment) -> int | None:
         needle = MARKER_PREFIX + fragment.marker
@@ -335,3 +359,74 @@ class Scaffold:
         inserted = [indent + l if l.strip() else l for l in code.split("\n")]
         lines[marker_idx:marker_idx] = inserted
         _publish(target, "\n".join(lines))
+
+    def _insert_fused(self, fragments: list[Fragment]) -> None:
+        """All fragments in one pass: each target file is read ONCE,
+        every splice lands on the in-memory line list, and each dirty
+        target publishes ONCE — where the serial reference re-reads,
+        re-splits, and re-publishes the whole file per fragment.
+
+        Byte-equivalent to the serial path by construction: fragments
+        apply in list order against the same evolving file state the
+        serial path would re-read (splices at one marker stack in
+        order, later presence checks see earlier insertions), files
+        never spring into or out of existence mid-loop (specs are all
+        published before fragments run), and on the serial path's
+        error points — missing target, missing marker — every splice
+        a PRIOR fragment already made is published before the raise,
+        exactly the state the per-fragment publisher leaves behind."""
+        lines_by_target: dict[str, list[str]] = {}
+        sets_by_target: dict[str, set[str]] = {}
+        dirty: list[str] = []  # insertion-ordered dirty targets
+
+        def flush_dirty() -> None:
+            for path in dirty:
+                _publish(
+                    os.path.join(self.output_dir, path),
+                    "\n".join(lines_by_target[path]),
+                )
+
+        for fragment in fragments:
+            lines = lines_by_target.get(fragment.path)
+            if lines is None:
+                target = os.path.join(self.output_dir, fragment.path)
+                if not os.path.exists(target):
+                    flush_dirty()
+                    raise ScaffoldError(
+                        f"cannot insert at marker {fragment.marker!r}: "
+                        f"file {fragment.path} does not exist"
+                    )
+                with open(target, "r", encoding="utf-8") as handle:
+                    lines = handle.read().split("\n")
+                lines_by_target[fragment.path] = lines
+                sets_by_target[fragment.path] = {
+                    l.strip() for l in lines
+                }
+            marker_idx = self._find_marker(lines, fragment)
+            if marker_idx is None:
+                flush_dirty()
+                raise ScaffoldError(
+                    f"marker {fragment.marker!r} not found in "
+                    f"{fragment.path}"
+                )
+            code = fragment.code.rstrip("\n")
+            fragment_lines = [
+                l for l in code.split("\n") if l.strip()
+            ]
+            stripped = sets_by_target[fragment.path]
+            if fragment_lines and all(
+                l.strip() in stripped for l in fragment_lines
+            ):
+                continue
+            marker_line_ = lines[marker_idx]
+            indent = marker_line_[
+                : len(marker_line_) - len(marker_line_.lstrip())
+            ]
+            inserted = [
+                indent + l if l.strip() else l for l in code.split("\n")
+            ]
+            lines[marker_idx:marker_idx] = inserted
+            stripped.update(l.strip() for l in inserted)
+            if fragment.path not in dirty:
+                dirty.append(fragment.path)
+        flush_dirty()

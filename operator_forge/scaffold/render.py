@@ -1,0 +1,1323 @@
+"""Compiled render programs: the second execution tier for codegen.
+
+The template layer renders by composing Python f-strings over a
+:class:`~operator_forge.scaffold.context.WorkloadView` — every render
+re-walks the whole interpolation tree, re-evaluates every view property,
+and re-branches every conditional block, even though a 40-component
+monorepo renders the same template 40 times with only a handful of
+context fields changing.  This module applies the PR 11 tiering
+playbook (walk -> closures -> bytecode, ``gocheck/compiler.py``) to the
+emission path: each template render is lowered ONCE per context shape
+into a flat *render program* — precompiled segment concatenation over a
+constant pool, where static text segments interleave with context-field
+slot reads — and later renders with the same shape execute the program
+instead of re-walking the f-string tree.
+
+Lowering is record-and-replay with a sentinel probe:
+
+1. the reference renderer runs with the real context (output ``O1``);
+2. the template runs AGAIN against recording proxies whose string
+   fields carry unique sentinel values.  Every branch-feeding operation
+   (equality, ordering, truthiness, ``startswith``/``endswith``,
+   membership) computes on the REAL values — so the probe follows the
+   same branches as the reference render — and is recorded as a
+   replayable *guard*; string fields flowing into the output carry
+   their sentinels through (f-strings, ``join``, ``os.path.join``, and
+   ``+`` all preserve the sentinel bytes);
+3. the probe output is split on the sentinels into constant segments
+   and slot reads (attribute paths, with pure derived transforms like
+   ``.lower()`` encoded as replayable path steps); anything the probe
+   cannot follow — slicing, ``split``, dict-keying a field, an
+   unexpected exception — aborts lowering;
+4. the program is executed against the real context and compared to
+   ``O1`` byte-for-byte.  Any mismatch (an operation the proxies could
+   not observe) permanently deopts the template.
+
+A program hit requires every recorded guard to replay to the same
+outcome against the new context, so a program never executes for a
+context whose branch decisions could differ from the lowering context.
+Templates outside the subset deopt PERMANENTLY to the reference
+renderer (``render.deopt``) — the tier is an accelerator, never a
+correctness risk: the standing contract (byte-identity to a cache-off
+serial reference recompute across cache modes x workers x jobs) is
+asserted by tests/test_render_programs.py and the bench identity guard.
+
+Manifest transforms and the gocodegen document emitter lower through
+:func:`lowered_blob` — their output is a pure function of the manifest
+bytes, so the "program" is the pickled result keyed by content hash
+(the pickle roundtrip returns fresh copies, the same ownership contract
+``perf.cache.memoized`` gives).
+
+Programs are picklable and persist in cache manifests under the
+``render.lower`` namespace, exactly as ``gocheck/compiler.py`` persists
+its bytecode in ``gocheck.lower``: cold processes and pool workers
+hydrate *executable* programs on first use (``render.hydrated``)
+instead of re-lowering.  The registry is process-level (a JIT code
+cache), deliberately NOT cleared by ``perf.cache.reset()`` — programs
+key on content shape, not cache state.  Counters surface in
+``metrics.tier_report()``: ``render.lowered`` / ``render.hydrated`` /
+``render.executed`` / ``render.deopt``.
+
+``OPERATOR_FORGE_RENDER=ref|program`` selects the backend (default
+``program``); ``ref`` pins the original renderer as the reference.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import hashlib
+import inspect
+import keyword as _keyword
+import os
+import pickle
+import threading
+import itertools as _itertools
+from dataclasses import dataclass
+from itertools import islice as _islice
+
+from ..perf import spans
+
+_MODES = ("ref", "program")
+DEFAULT_MODE = "program"
+
+_forced = None
+
+
+def mode() -> str:
+    if _forced is not None:
+        return _forced
+    raw = os.environ.get("OPERATOR_FORGE_RENDER", DEFAULT_MODE)
+    raw = raw.strip().lower()
+    return raw if raw in _MODES else DEFAULT_MODE
+
+
+def set_mode(value=None) -> None:
+    """Programmatic override (``None`` restores env-driven selection)."""
+    global _forced
+    if value is not None and value not in _MODES:
+        raise ValueError(f"unknown render mode {value!r}; known: {_MODES}")
+    _forced = value
+
+
+# -- program model --------------------------------------------------------
+#
+# An op list is a tuple whose elements are either an ``int`` (index into
+# the constant pool) or a ``tuple`` (a slot: a context path).  A path is
+# ``(arg_index, step, ...)`` where each step is a ``str`` (attribute
+# read), an ``int`` (sequence index), or ``("@", name, *args)`` (a pure
+# method call — ``.lower()``, a no-arg accessor, a const-arg
+# ``.replace``).  Guards are replayable predicates over the same paths;
+# a program's signature is the tuple of its guard outcomes at lowering
+# time.
+
+
+@dataclass(frozen=True)
+class Program:
+    """One lowered render: flat ops over a constant pool, plus the
+    guard list + signature that scope which contexts may execute it.
+    Pure data — pickles into ``render.lower`` manifests."""
+
+    template_id: str
+    pool: tuple          # constant-pool text segments
+    guards: tuple        # replayable guard descriptors
+    sig: tuple           # expected guard outcomes
+    result: tuple        # result tree: ("s",ops) | ("f",...) | ("g",...) | ("L",(...))
+    shape: str           # content hash of (guards, sig) — the registry key
+
+
+class _OutOfSubset(Exception):
+    """Internal: the probe hit an operation it cannot record/replay —
+    the template (or this shape of it) stays on the reference path."""
+
+
+# sentinel bytes can never appear in rendered text (templates emit
+# UTF-8 Go/YAML/Make text, never NUL bytes), so a surviving "\x00" in a
+# constant segment always means a MANGLED sentinel — lowering aborts
+_SENTINEL = "\x00#%d#\x00"
+import re as _re  # noqa: E402
+
+_SENT_RE = _re.compile("\x00#(\\d+)#\x00")
+
+
+# -- registry -------------------------------------------------------------
+
+_lock = threading.Lock()
+_programs: dict = {}      # template_id -> list[Program]
+_blobs: dict = {}         # (template_id, digest) -> pickled bytes
+_deopted: set = set()     # template ids pinned to the reference renderer
+_dirty: set = set()       # template ids whose manifest needs persisting
+_hydrated: set = set()    # template ids whose manifest was consulted
+_runners: dict = {}       # (template_id, shape) -> compiled runner
+
+# program hits tally lock-free on the hot path (a GIL-atomic list-cell
+# bump, the same acceptable-race contract as gocheck's _reused_pending)
+# and reconcile into the real ``render.executed`` counter at
+# :func:`flush_counters` boundaries (tier reports, manifest flushes).
+_executed_pending = [0]
+
+
+def flush_counters() -> None:
+    """Reconcile the lock-free execution tally into ``render.executed``."""
+    from ..perf import metrics
+
+    pending, _executed_pending[0] = _executed_pending[0], 0
+    if pending:
+        metrics.counter("render.executed").inc(pending)
+
+
+def reset() -> None:
+    """Test isolation: drop every program, blob, deopt pin, and
+    hydration memo.  NOT wired into ``perf.cache.reset()`` on purpose —
+    programs are keyed on content shape, not cache state, and survive
+    cache resets exactly like the process's own compiled code."""
+    with _lock:
+        _programs.clear()
+        _blobs.clear()
+        _deopted.clear()
+        _dirty.clear()
+        _hydrated.clear()
+        _runners.clear()
+        _executed_pending[0] = 0
+
+
+def deopted() -> frozenset:
+    return frozenset(_deopted)
+
+
+def _deopt(template_id: str) -> None:
+    from ..perf import metrics
+
+    with _lock:
+        if template_id in _deopted:
+            return
+        _deopted.add(template_id)
+        _programs.pop(template_id, None)
+    metrics.counter("render.deopt").inc()
+
+
+# -- recording proxies ----------------------------------------------------
+
+
+# sentinel ids are allocated from ONE process-wide counter, never per
+# session: a probe that outlives its session (a memoized helper cached
+# it by string equality — _ProbeStr hashes and compares as its REAL
+# value, so ``lru_cache`` keyed on a field value can capture and later
+# return one) then carries a sid no other session will ever allocate,
+# so its sentinel surfaces as "unknown" during lowering instead of
+# silently aliasing another session's slot
+_sid_counter = _itertools.count()
+
+# the session currently recording a probe render on this thread (probe
+# renders are per-template-first-call and never nest)
+_active = threading.local()
+
+
+def _active_session():
+    return getattr(_active, "sess", None)
+
+
+class _Session:
+    """One lowering attempt: allocates sentinels, records guards, and
+    caches object wrappers by identity so a real object reached through
+    two paths wraps once (its first path is the replayed one)."""
+
+    def __init__(self):
+        self.guards: list = []
+        self.sig: list = []
+        self.slots: dict = {}      # sentinel id -> path
+        self.wrappers: dict = {}   # id(real) -> wrapper
+        self.pins: list = []       # keep reals alive so ids stay unique
+
+    def check_live(self) -> bool:
+        """True when this session is the one actively probing on this
+        thread; False for a stale proxy surfacing in a PRODUCTION
+        render (behave plainly, record nothing); raises when a stale
+        proxy surfaces inside ANOTHER session's probe render — its
+        paths are meaningless there and the lowering must abort."""
+        active = _active_session()
+        if active is self:
+            return True
+        if active is not None:
+            raise _OutOfSubset("stale probe in a live probe render")
+        return False
+
+    def record(self, guard: tuple, outcome) -> None:
+        if self.check_live():
+            self.guards.append(guard)
+            self.sig.append(outcome)
+
+    def probe_str(self, real: str, path: tuple) -> "_ProbeStr":
+        sid = next(_sid_counter)
+        probe = _ProbeStr(_SENTINEL % sid)
+        probe._real = real
+        probe._path = path
+        probe._sess = self
+        self.slots[sid] = path
+        return probe
+
+    def classify(self, real, path: tuple, depth: int = 0):
+        """Wrap ``real`` for the probe render: strings become sentinel
+        probes (slots), scalars become value guards, sequences and
+        objects become recording wrappers."""
+        if not self.check_live():
+            return real
+        if type(real) is str:
+            return self.probe_str(real, path)
+        if real is None:
+            self.record(("isnone", path), True)
+            return None
+        if isinstance(real, (bool, int, float, enum.Enum)):
+            self.record(("val", path), real)
+            return real
+        if isinstance(real, (list, tuple)):
+            self.record(("len", path), len(real))
+            return _RecSeq(real, path, self)
+        if isinstance(real, str):
+            # a str SUBCLASS carries behavior the probe can't model
+            raise _OutOfSubset(f"str subclass at {path!r}")
+        if callable(real) and not isinstance(real, type):
+            return _RecCall(real, path, self)
+        if depth > 12:
+            raise _OutOfSubset(f"wrap depth at {path!r}")
+        wrapper = self.wrappers.get(id(real))
+        if wrapper is None:
+            self.record(("isnone", path), False)
+            wrapper = _Rec(real, path, self)
+            self.wrappers[id(real)] = wrapper
+            self.pins.append(real)
+        return wrapper
+
+
+def _plain(value):
+    """The real value behind a possibly-wrapped one, or raise."""
+    if isinstance(value, _ProbeStr):
+        return value._real
+    if isinstance(value, (_Rec, _RecSeq, _RecCall)):
+        raise _OutOfSubset("object-valued operand")
+    return value
+
+
+def _operand_key(value, sess):
+    """How a guard references its right-hand operand: by path when it
+    is a probe of the SAME session, by literal otherwise (a foreign
+    session's paths mean nothing here — pin its real value instead)."""
+    if isinstance(value, _ProbeStr):
+        if value._sess is sess:
+            return ("p", value._path)
+        return ("l", value._real)
+    if isinstance(value, (_Rec, _RecSeq, _RecCall)):
+        raise _OutOfSubset("object-valued operand")
+    if isinstance(value, tuple):
+        return ("l", tuple(_plain(v) for v in value))
+    return ("l", value)
+
+
+class _ProbeStr(str):
+    """A string field under probe: its buffer is the sentinel (so
+    output flow is observable), its comparisons run on the REAL value
+    (so branches match the reference render) and record guards."""
+
+    _real: str
+    _path: tuple
+    _sess: "_Session"
+
+    # -- recorded predicates (replayable guards) ----------------------
+
+    def _cmp(self, op, other, fn):
+        if isinstance(other, (_Rec, _RecSeq, _RecCall)):
+            return NotImplemented
+        if isinstance(other, _ProbeStr):
+            out = fn(self._real, other._real)
+            self._sess.record(
+                (op, self._path, _operand_key(other, self._sess)), out
+            )
+            return out
+        if isinstance(other, str):
+            out = fn(self._real, other)
+            self._sess.record((op, self._path, ("l", other)), out)
+            return out
+        return NotImplemented
+
+    def __eq__(self, other):
+        return self._cmp("eq", other, lambda a, b: a == b)
+
+    def __ne__(self, other):
+        out = self.__eq__(other)
+        return NotImplemented if out is NotImplemented else not out
+
+    def __lt__(self, other):
+        return self._cmp("lt", other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._cmp("le", other, lambda a, b: a <= b)
+
+    def __gt__(self, other):
+        return self._cmp("gt", other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._cmp("ge", other, lambda a, b: a >= b)
+
+    def __hash__(self):
+        # hash the REAL value: set/dict membership then lands in the
+        # real value's bucket and resolves through __eq__, which
+        # records — `alias in seen` over probe strings stays in-subset
+        return hash(self._real)
+
+    def __bool__(self):
+        out = bool(self._real)
+        self._sess.record(("truthy", self._path), out)
+        return out
+
+    def __contains__(self, item):
+        key = _operand_key(item, self._sess)
+        needle = item._real if isinstance(item, _ProbeStr) else item
+        out = needle in self._real
+        self._sess.record(("contains", self._path, key), out)
+        return out
+
+    def startswith(self, prefix, *extra):
+        if extra:
+            raise _OutOfSubset("startswith with bounds")
+        key = _operand_key(prefix, self._sess)
+        real_prefix = (
+            prefix._real if isinstance(prefix, _ProbeStr) else prefix
+        )
+        out = self._real.startswith(real_prefix)
+        self._sess.record(("sw", self._path, key), out)
+        return out
+
+    def endswith(self, suffix, *extra):
+        if extra:
+            raise _OutOfSubset("endswith with bounds")
+        key = _operand_key(suffix, self._sess)
+        real_suffix = (
+            suffix._real if isinstance(suffix, _ProbeStr) else suffix
+        )
+        out = self._real.endswith(real_suffix)
+        self._sess.record(("ew", self._path, key), out)
+        return out
+
+    # -- output flow ---------------------------------------------------
+
+    def __format__(self, spec):
+        if not self._sess.check_live():
+            # stale probe in a production render: format the real value
+            return format(self._real, spec)
+        if not spec:
+            return str.__str__(self)  # sentinel flows into the output
+        # width/fill depends on the real length: fold the formatted
+        # real into the signature and emit it as constant text
+        out = format(self._real, spec)
+        self._sess.record(
+            ("val", self._path + (("@", "__format__", spec),)), out
+        )
+        return out
+
+    def __str__(self):
+        if not self._sess.check_live():
+            return self._real
+        return str.__str__(self)
+
+
+def _derived(name):
+    """Pure const-arg transforms stay slots: the result is a fresh
+    probe whose path appends a replayable ``("@", name, *args)`` step."""
+
+    def method(self, *args):
+        plain_args = []
+        for arg in args:
+            if not isinstance(arg, (str, int)) or isinstance(
+                arg, _ProbeStr
+            ):
+                raise _OutOfSubset(f"str.{name} argument")
+            plain_args.append(arg)
+        real = getattr(self._real, name)(*plain_args)
+        if not self._sess.check_live():
+            return real  # stale probe in production: plain result
+        return self._sess.probe_str(
+            real, self._path + (("@", name) + tuple(plain_args),)
+        )
+
+    return method
+
+
+for _name in (
+    "lower", "upper", "strip", "lstrip", "rstrip", "title",
+    "capitalize", "casefold", "replace", "removeprefix", "removesuffix",
+):
+    setattr(_ProbeStr, _name, _derived(_name))
+
+
+def _raising(name):
+    def method(self, *args, **kwargs):
+        raise _OutOfSubset(f"str.{name}")
+
+    return method
+
+
+for _name in (
+    "split", "rsplit", "join", "format", "format_map", "encode",
+    "zfill", "rjust", "ljust", "center", "find", "rfind", "index",
+    "rindex", "count", "partition", "rpartition", "splitlines",
+    "expandtabs", "translate", "swapcase", "__getitem__", "__iter__",
+    "__mod__", "__rmod__", "__mul__", "__rmul__",
+):
+    setattr(_ProbeStr, _name, _raising(_name))
+del _name
+
+
+class _Rec:
+    """Recording wrapper over one context object: every attribute read
+    is classified (slot / guard / nested wrapper) under an extended
+    path.  Properties evaluate on the REAL object, so derived values
+    (``controller_file``, ``plural``) surface as single slots."""
+
+    __slots__ = ("_real", "_path", "_sess")
+
+    def __init__(self, real, path, sess):
+        object.__setattr__(self, "_real", real)
+        object.__setattr__(self, "_path", path)
+        object.__setattr__(self, "_sess", sess)
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        real = getattr(self._real, name)
+        return self._sess.classify(real, self._path + (name,))
+
+    def __setattr__(self, name, value):
+        if not self._sess.check_live():
+            return setattr(self._real, name, value)
+        raise _OutOfSubset("attribute write during probe")
+
+    def __bool__(self):
+        out = bool(self._real)
+        self._sess.record(("truthy", self._path), out)
+        return out
+
+    def __iter__(self):
+        # custom iterable containers (rbac.Rules): guard the item
+        # count, classify items under iteration-index steps
+        items = list(self._real)
+        self._sess.record(("ilen", self._path), len(items))
+        for i, value in enumerate(items):
+            yield self._sess.classify(value, self._path + (("#", i),))
+
+
+class _RecCall:
+    """A bound method under probe: const-arg calls replay as path
+    steps; wrapper-valued arguments are outside the subset."""
+
+    __slots__ = ("_real", "_path", "_sess")
+
+    def __init__(self, real, path, sess):
+        self._real = real
+        self._path = path
+        self._sess = sess
+
+    def __call__(self, *args, **kwargs):
+        if not self._sess.check_live():
+            return self._real(*args, **kwargs)
+        if kwargs:
+            raise _OutOfSubset("keyword call during probe")
+        plain_args = []
+        for arg in args:
+            if isinstance(
+                arg, (_ProbeStr, _Rec, _RecSeq, _RecCall)
+            ) or not isinstance(
+                arg, (str, int, float, bool, type(None))
+            ):
+                raise _OutOfSubset("call argument during probe")
+            plain_args.append(arg)
+        out = self._real(*plain_args)
+        assert isinstance(self._path[-1], str)
+        step = ("@", self._path[-1]) + tuple(plain_args)
+        return self._sess.classify(out, self._path[:-1] + (step,))
+
+
+class _RecSeq:
+    """Recording wrapper over a list/tuple: length is guarded at wrap
+    time; elements classify under indexed paths."""
+
+    __slots__ = ("_real", "_path", "_sess")
+
+    def __init__(self, real, path, sess):
+        self._real = real
+        self._path = path
+        self._sess = sess
+
+    def __len__(self):
+        return len(self._real)
+
+    def __bool__(self):
+        return bool(self._real)
+
+    def __iter__(self):
+        for i, value in enumerate(self._real):
+            yield self._sess.classify(value, self._path + (i,))
+
+    def __getitem__(self, index):
+        if not self._sess.check_live():
+            return self._real[index]
+        if not isinstance(index, int):
+            raise _OutOfSubset("sequence slice during probe")
+        if index < 0:
+            index += len(self._real)
+        return self._sess.classify(
+            self._real[index], self._path + (index,)
+        )
+
+    def __contains__(self, item):
+        key = _operand_key(item, self._sess)
+        needle = item._real if isinstance(item, _ProbeStr) else item
+        out = needle in self._real
+        self._sess.record(("in", self._path, key), out)
+        return out
+
+    def __getattr__(self, name):
+        # list SUBCLASSES carry domain methods (ManifestCollection's
+        # all_child_resources); delegate like _Rec does
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        real = getattr(self._real, name)
+        return self._sess.classify(real, self._path + (name,))
+
+
+# -- path / guard replay --------------------------------------------------
+
+
+def _resolve(args: tuple, path: tuple):
+    cur = args[path[0]]
+    for step in path[1:]:
+        kind = type(step)
+        if kind is str:
+            cur = getattr(cur, step)
+        elif kind is int:
+            cur = cur[step]
+        elif step[0] == "@":  # ("@", name, *const_args)
+            cur = getattr(cur, step[1])(*step[2:])
+        else:  # ("#", i) — i-th element of a custom iterable
+            cur = next(_islice(iter(cur), step[1], None))
+    return cur
+
+
+def _operand(args: tuple, key: tuple):
+    return _resolve(args, key[1]) if key[0] == "p" else key[1]
+
+
+def _guard_outcome(args: tuple, guard: tuple):
+    kind = guard[0]
+    if kind == "val":
+        return _resolve(args, guard[1])
+    if kind == "eq":
+        return _resolve(args, guard[1]) == _operand(args, guard[2])
+    if kind == "truthy":
+        return bool(_resolve(args, guard[1]))
+    if kind == "isnone":
+        return _resolve(args, guard[1]) is None
+    if kind == "len":
+        return len(_resolve(args, guard[1]))
+    if kind == "ilen":
+        return sum(1 for _ in iter(_resolve(args, guard[1])))
+    if kind == "sw":
+        return _resolve(args, guard[1]).startswith(
+            _operand(args, guard[2])
+        )
+    if kind == "ew":
+        return _resolve(args, guard[1]).endswith(_operand(args, guard[2]))
+    if kind in ("contains", "in"):
+        return _operand(args, guard[2]) in _resolve(args, guard[1])
+    if kind == "lt":
+        return _resolve(args, guard[1]) < _operand(args, guard[2])
+    if kind == "le":
+        return _resolve(args, guard[1]) <= _operand(args, guard[2])
+    if kind == "gt":
+        return _resolve(args, guard[1]) > _operand(args, guard[2])
+    if kind == "ge":
+        return _resolve(args, guard[1]) >= _operand(args, guard[2])
+    raise ValueError(f"unknown guard kind {kind!r}")
+
+
+def program_sig(program: Program, args: tuple):
+    """Replay the program's guards against ``args``; ``None`` when a
+    guard cannot even be evaluated (structurally different context)."""
+    try:
+        return tuple(_guard_outcome(args, g) for g in program.guards)
+    except Exception:
+        return None
+
+
+# -- lowering (probe output -> program) -----------------------------------
+
+
+def _intern_const(pool: list, pool_map: dict, text: str) -> int:
+    if "\x00" in text:
+        raise _OutOfSubset("mangled sentinel in constant segment")
+    idx = pool_map.get(text)
+    if idx is None:
+        idx = pool_map[text] = len(pool)
+        pool.append(text)
+    return idx
+
+
+def _lower_text(sess: _Session, text, pool: list, pool_map: dict) -> tuple:
+    # read the raw buffer: lowering runs AFTER the session deactivates,
+    # where _ProbeStr.__str__ would hand back the real value and erase
+    # the sentinel — str.__str__ sees the sentinel bytes themselves
+    s = str.__str__(text) if isinstance(text, str) else str(text)
+    ops = []
+    last = 0
+    for match in _SENT_RE.finditer(s):
+        if match.start() > last:
+            ops.append(
+                _intern_const(pool, pool_map, s[last:match.start()])
+            )
+        path = sess.slots.get(int(match.group(1)))
+        if path is None:
+            raise _OutOfSubset("unknown sentinel")
+        ops.append(path)
+        last = match.end()
+    if last < len(s):
+        ops.append(_intern_const(pool, pool_map, s[last:]))
+    return tuple(ops)
+
+
+def _lower_result(sess: _Session, value, pool: list, pool_map: dict):
+    from .machinery import FileSpec, Fragment
+
+    if isinstance(value, str):
+        return ("s", _lower_text(sess, value, pool, pool_map))
+    if isinstance(value, FileSpec):
+        return (
+            "f",
+            _lower_text(sess, value.path, pool, pool_map),
+            _lower_text(sess, value.content, pool, pool_map),
+            value.if_exists.value,
+            bool(value.add_boilerplate),
+        )
+    if isinstance(value, Fragment):
+        return (
+            "g",
+            _lower_text(sess, value.path, pool, pool_map),
+            _lower_text(sess, value.marker, pool, pool_map),
+            _lower_text(sess, value.code, pool, pool_map),
+        )
+    if isinstance(value, (list, tuple)):
+        return (
+            "L",
+            tuple(
+                _lower_result(sess, item, pool, pool_map)
+                for item in value
+            ),
+        )
+    raise _OutOfSubset(f"result type {type(value).__name__}")
+
+
+# -- execution ------------------------------------------------------------
+
+_MISSING = object()
+
+
+def _exec_ops(ops: tuple, args: tuple, pool: tuple, cache: dict) -> str:
+    parts = []
+    for op in ops:
+        if type(op) is int:
+            parts.append(pool[op])
+        else:
+            value = cache.get(op, _MISSING)
+            if value is _MISSING:
+                value = _resolve(args, op)
+                if type(value) is not str:
+                    value = str(value)
+                cache[op] = value
+            parts.append(value)
+    return "".join(parts)
+
+
+def _exec_result(node, args: tuple, pool: tuple, cache: dict):
+    from .machinery import FileSpec, Fragment, IfExists
+
+    kind = node[0]
+    if kind == "s":
+        return _exec_ops(node[1], args, pool, cache)
+    if kind == "f":
+        return FileSpec(
+            path=_exec_ops(node[1], args, pool, cache),
+            content=_exec_ops(node[2], args, pool, cache),
+            if_exists=IfExists(node[3]),
+            add_boilerplate=node[4],
+        )
+    if kind == "g":
+        return Fragment(
+            path=_exec_ops(node[1], args, pool, cache),
+            marker=_exec_ops(node[2], args, pool, cache),
+            code=_exec_ops(node[3], args, pool, cache),
+        )
+    # "L"
+    return [
+        _exec_result(item, args, pool, cache) for item in node[1]
+    ]
+
+
+def execute(program: Program, args: tuple):
+    """Run a program against real context args.  Slot paths resolve
+    once per unique path per execution (a template reading
+    ``view.kind`` nine times costs one property evaluation here)."""
+    return _exec_result(program.result, args, program.pool, {})
+
+
+# -- runner compilation ----------------------------------------------------
+#
+# The interpreter above is the semantic reference, but walking paths
+# per guard per render costs more than the f-string tree it replaces.
+# Production renders go through a RUNNER: straight-line Python source
+# generated once per (template, shape) — every unique path prefix is a
+# single local, custom iterables materialize once, the guard signature
+# inlines into one tuple comparison, and each text builds in a single
+# ``join`` — then ``compile()``d, exactly how ``gocheck/compiler.py``
+# turns lowered spans into bytecode scanners.  Guard-phase failures
+# (structurally different context) return ``_NO_MATCH``; result-phase
+# failures propagate and deopt the template.
+
+_NO_MATCH = object()
+
+
+def _compile_runner(program: Program):
+    from .machinery import FileSpec, Fragment, IfExists
+
+    consts: list = []
+
+    def lit(value) -> str:
+        consts.append(value)
+        return f"_L[{len(consts) - 1}]"
+
+    lines: list = []
+    names: dict = {}   # path -> local variable name
+    mats: dict = {}    # path -> local holding list(iter(value))
+    counter = [0]
+
+    def fresh() -> str:
+        counter[0] += 1
+        return f"v{counter[0]}"
+
+    def safe(name: str) -> str:
+        if not name.isidentifier() or _keyword.iskeyword(name):
+            raise _OutOfSubset(f"unsafe name {name!r}")
+        return name
+
+    def ensure(path: tuple) -> str:
+        var = names.get(path)
+        if var is not None:
+            return var
+        if len(path) == 1:
+            var = fresh()
+            lines.append(f"{var} = args[{path[0]}]")
+        else:
+            step = path[-1]
+            kind = type(step)
+            var = fresh()
+            if kind is str:
+                lines.append(f"{var} = {ensure(path[:-1])}.{safe(step)}")
+            elif kind is int:
+                lines.append(f"{var} = {ensure(path[:-1])}[{step}]")
+            elif step[0] == "@":
+                call_args = ", ".join(lit(a) for a in step[2:])
+                lines.append(
+                    f"{var} = {ensure(path[:-1])}"
+                    f".{safe(step[1])}({call_args})"
+                )
+            else:  # ("#", i)
+                lines.append(f"{var} = {ensure_mat(path[:-1])}[{step[1]}]")
+        names[path] = var
+        return var
+
+    def ensure_mat(path: tuple) -> str:
+        var = mats.get(path)
+        if var is None:
+            src = ensure(path)
+            var = fresh()
+            lines.append(f"{var} = list(iter({src}))")
+            mats[path] = var
+        return var
+
+    def operand_expr(key: tuple) -> str:
+        return ensure(key[1]) if key[0] == "p" else lit(key[1])
+
+    def guard_expr(guard: tuple) -> str:
+        kind = guard[0]
+        if kind == "val":
+            return ensure(guard[1])
+        if kind == "isnone":
+            return f"({ensure(guard[1])} is None)"
+        if kind == "truthy":
+            return f"bool({ensure(guard[1])})"
+        if kind == "len":
+            return f"len({ensure(guard[1])})"
+        if kind == "ilen":
+            return f"len({ensure_mat(guard[1])})"
+        left = ensure(guard[1])
+        right = operand_expr(guard[2])
+        if kind == "eq":
+            return f"({left} == {right})"
+        if kind == "sw":
+            return f"{left}.startswith({right})"
+        if kind == "ew":
+            return f"{left}.endswith({right})"
+        if kind in ("contains", "in"):
+            return f"({right} in {left})"
+        op = {"lt": "<", "le": "<=", "gt": ">", "ge": ">="}.get(kind)
+        if op is None:
+            raise _OutOfSubset(f"unknown guard kind {kind!r}")
+        return f"({left} {op} {right})"
+
+    def ops_expr(ops: tuple) -> str:
+        parts = [
+            lit(program.pool[op]) if type(op) is int
+            else f"str({ensure(op)})"
+            for op in ops
+        ]
+        if not parts:
+            return "''"
+        if len(parts) == 1:
+            return parts[0]
+        return f"''.join(({', '.join(parts)}))"
+
+    def result_expr(node: tuple) -> str:
+        kind = node[0]
+        if kind == "s":
+            return ops_expr(node[1])
+        if kind == "f":
+            return (
+                f"_FileSpec(path={ops_expr(node[1])},"
+                f" content={ops_expr(node[2])},"
+                f" if_exists={lit(IfExists(node[3]))},"
+                f" add_boilerplate={bool(node[4])!r})"
+            )
+        if kind == "g":
+            return (
+                f"_Fragment(path={ops_expr(node[1])},"
+                f" marker={ops_expr(node[2])},"
+                f" code={ops_expr(node[3])})"
+            )
+        return f"[{', '.join(result_expr(item) for item in node[1])}]"
+
+    sig_parts = [guard_expr(g) for g in program.guards]
+    guard_lines = list(lines)
+    del lines[:]
+    returned = result_expr(program.result)
+    sig_tuple = (
+        "(" + ", ".join(sig_parts) + ("," if len(sig_parts) == 1 else "")
+        + ")"
+    )
+    src = [
+        "def _run(args):",
+        "    try:",
+    ]
+    src.extend("        " + line for line in guard_lines)
+    src.append(f"        if {sig_tuple} != {lit(program.sig)}:")
+    src.append("            return _NO_MATCH")
+    src.append("    except Exception:")
+    src.append("        return _NO_MATCH")
+    src.extend("    " + line for line in lines)
+    src.append(f"    return {returned}")
+    namespace: dict = {
+        "_L": tuple(consts),
+        "_FileSpec": FileSpec,
+        "_Fragment": Fragment,
+        "_NO_MATCH": _NO_MATCH,
+    }
+    exec(  # noqa: S102 — source is generated from our own program data
+        compile(
+            "\n".join(src),
+            f"<render:{program.template_id}:{program.shape}>",
+            "exec",
+        ),
+        namespace,
+    )
+    return namespace["_run"]
+
+
+def _runner(program: Program):
+    """The compiled runner for a program, built once per process and
+    shared across threads (keyed by (template, shape), exactly like the
+    interpreter registry)."""
+    key = (program.template_id, program.shape)
+    run = _runners.get(key)
+    if run is None:
+        run = _compile_runner(program)
+        with _lock:
+            _runners[key] = run
+    return run
+
+
+# -- the decorator --------------------------------------------------------
+
+
+def _shape_of(guards: tuple, sig: tuple) -> str:
+    try:
+        payload = pickle.dumps((guards, sig), protocol=4)
+    except Exception:
+        payload = repr((guards, sig)).encode("utf-8")
+    return hashlib.sha256(payload).hexdigest()[:20]
+
+
+# memoized string->string helpers (utils.names, marker-pattern
+# compilation) hash and compare probe strings as their REAL values, so
+# a probe render can deposit probes into their caches — and a later
+# render (probe OR production) would get them back.  Every probe render
+# is therefore followed by a flush of these caches; the global sid
+# counter turns any probe that slips through an unregistered cache into
+# an unknown sentinel (deopt) rather than a mis-aliased slot.
+_probe_cache_clears: list = []
+_default_clears = None
+
+
+def register_probe_cache(clear) -> None:
+    """Register a ``cache_clear`` callable to run after every probe
+    render (for memoized helpers that may capture probe strings)."""
+    _probe_cache_clears.append(clear)
+
+
+def _clear_probe_caches() -> None:
+    global _default_clears
+    if _default_clears is None:
+        from ..utils import names
+
+        clears = [
+            names.to_title.cache_clear,
+            names.title_words.cache_clear,
+            names.to_pascal_case.cache_clear,
+            names.to_file_name.cache_clear,
+            names.to_package_name.cache_clear,
+        ]
+        try:
+            from ..workload.fieldmarkers import _compile_replace
+
+            clears.append(_compile_replace.cache_clear)
+        except Exception:
+            pass
+        _default_clears = clears
+    for clear in _default_clears:
+        clear()
+    for clear in _probe_cache_clears:
+        try:
+            clear()
+        except Exception:
+            pass
+
+
+def _lower_and_run(template_id: str, fn, flat: tuple):
+    from ..perf import metrics
+
+    ref_out = fn(*flat)
+    with spans.span("render.lower"):
+        try:
+            sess = _Session()
+            _active.sess = sess
+            try:
+                wrapped = tuple(
+                    sess.classify(value, (i,))
+                    for i, value in enumerate(flat)
+                )
+                probe_out = fn(*wrapped)
+            finally:
+                _active.sess = None
+                _clear_probe_caches()
+            pool: list = []
+            pool_map: dict = {}
+            result = _lower_result(sess, probe_out, pool, pool_map)
+            guards = tuple(sess.guards)
+            sig = tuple(sess.sig)
+            program = Program(
+                template_id=template_id,
+                pool=tuple(pool),
+                guards=guards,
+                sig=sig,
+                result=result,
+                shape=_shape_of(guards, sig),
+            )
+            # the hard gate: both execution backends (the compiled
+            # runner production uses, and the interpretive reference
+            # semantics) must reproduce the reference output
+            # byte-for-byte for the lowering context, and the guards
+            # must replay deterministically.  A runner returning
+            # _NO_MATCH here means the just-recorded signature does
+            # not replay — equally disqualifying.
+            if _runner(program)(flat) != ref_out:
+                raise _OutOfSubset("runner verify mismatch")
+            if execute(program, flat) != ref_out:
+                raise _OutOfSubset("verify mismatch")
+            if program_sig(program, flat) != sig:
+                raise _OutOfSubset("non-deterministic guards")
+        except Exception:
+            _deopt(template_id)
+            return ref_out
+    with _lock:
+        if template_id not in _deopted:
+            known = _programs.setdefault(template_id, [])
+            if all(p.shape != program.shape for p in known):
+                known.append(program)
+                _dirty.add(template_id)
+    metrics.counter("render.lowered").inc()
+    return ref_out
+
+
+def compiled_render(template_id: str, subset: bool = True):
+    """Wrap a template function with the program tier.  ``subset=False``
+    declares the template out-of-subset up front (impure renders that
+    read the output tree): it deopts on first call and pins to the
+    reference renderer."""
+
+    def decorate(fn):
+        try:
+            signature = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return fn
+        # the hot path binds positionally without inspect: a render
+        # call passing every parameter positionally IS the bound tuple
+        n_params = len(signature.parameters)
+        positional_ok = all(
+            p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            for p in signature.parameters.values()
+        )
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if mode() != "program" or template_id in _deopted:
+                return fn(*args, **kwargs)
+            if not subset:
+                _deopt(template_id)
+                return fn(*args, **kwargs)
+            if positional_ok and not kwargs and len(args) == n_params:
+                flat = args
+            else:
+                try:
+                    bound = signature.bind(*args, **kwargs)
+                    bound.apply_defaults()
+                    flat = tuple(bound.arguments.values())
+                except TypeError:
+                    return fn(*args, **kwargs)
+            for value in flat:
+                # a decorated template called from another template's
+                # PROBE render sees recording proxies: run the raw
+                # function so the callee inlines into the caller's
+                # program instead of confusing its own tier
+                if isinstance(value, (_ProbeStr, _Rec, _RecSeq, _RecCall)):
+                    return fn(*args, **kwargs)
+            if template_id not in _hydrated:
+                _hydrate(template_id)
+            try:
+                for program in _programs.get(template_id, ()):
+                    out = _runner(program)(flat)
+                    if out is not _NO_MATCH:
+                        _executed_pending[0] += 1
+                        return out
+            except Exception:
+                _deopt(template_id)
+                return fn(*flat)
+            return _lower_and_run(template_id, fn, flat)
+
+        wrapper.__render_template_id__ = template_id
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return decorate
+
+
+# -- content-hash blob programs (transforms / emitters) -------------------
+
+
+def lowered_blob(template_id: str, key_parts: tuple, compute):
+    """The compile-once-per-content-hash tier for pure transforms whose
+    output is fully determined by their input bytes (the manifest
+    marker pass, the gocodegen document emitter).  The lowered artifact
+    is the pickled result; execution is the unpickle — every caller
+    owns a fresh copy, matching ``perf.cache.memoized`` semantics."""
+    if mode() != "program" or template_id in _deopted:
+        return compute()
+    if _active_session() is not None:
+        # inside another template's PROBE render the key parts (and the
+        # computed value) may carry sentinel probes — computing plainly
+        # keeps the caller's lowering observable and the blob store
+        # free of probe-keyed junk
+        return compute()
+    from ..perf import metrics
+    from ..perf.cache import hash_parts
+
+    try:
+        # canonical tagged hashing, never pickle: pickle bytes vary
+        # with object identity (a string shared between two slots
+        # memoizes into a back-reference), so the same logical doc
+        # would key differently across processes and defeat hydration
+        digest = hash_parts(key_parts)
+    except Exception:
+        return compute()
+    _hydrate(template_id)
+    blob = _blobs.get((template_id, digest))
+    if blob is not None:
+        try:
+            value = pickle.loads(blob)
+        except Exception:
+            _deopt(template_id)
+            return compute()
+        metrics.counter("render.executed").inc()
+        return value
+    value = compute()
+    try:
+        blob = pickle.dumps(value, protocol=4)
+    except Exception:
+        _deopt(template_id)
+        return value
+    with _lock:
+        if template_id not in _deopted:
+            _blobs[(template_id, digest)] = blob
+            _dirty.add(template_id)
+    metrics.counter("render.lowered").inc()
+    return value
+
+
+# -- cross-process manifests (``render.lower``) ---------------------------
+
+_RENDER_STAGE = "render.lower"
+
+
+def _manifest_key(template_id: str) -> str:
+    from ..perf.cache import __version__, hash_parts
+
+    # the generator version salts every key: a persisted program must
+    # never replay an older generator's emission
+    return hash_parts(_RENDER_STAGE, __version__, template_id)
+
+
+def _hydrate(template_id: str) -> int:
+    """Install every program a previous process persisted for this
+    template.  One manifest lookup per template per process (negative
+    results memoized); a no-op with the cache off."""
+    if template_id in _hydrated:
+        return 0
+    from ..perf import cache as pf_cache
+    from ..perf import metrics
+
+    cache = pf_cache.get_cache()
+    if cache.mode() == "off":
+        return 0
+    with _lock:
+        if template_id in _hydrated:
+            return 0
+        _hydrated.add(template_id)
+    manifest = cache.get(_RENDER_STAGE, _manifest_key(template_id))
+    if manifest is pf_cache.MISS or not isinstance(manifest, tuple):
+        return 0
+    if len(manifest) != 2:
+        return 0
+    programs, blobs = manifest
+    count = 0
+    with spans.span("render.hydrate"):
+        with _lock:
+            if template_id in _deopted:
+                return 0
+            known = _programs.setdefault(template_id, [])
+            shapes = {p.shape for p in known}
+            for program in programs if isinstance(programs, tuple) else ():
+                if (
+                    isinstance(program, Program)
+                    and program.template_id == template_id
+                    and program.shape not in shapes
+                ):
+                    known.append(program)
+                    shapes.add(program.shape)
+                    count += 1
+            if not known:
+                _programs.pop(template_id, None)
+            for digest, blob in (
+                blobs.items() if isinstance(blobs, dict) else ()
+            ):
+                key = (template_id, digest)
+                if isinstance(blob, bytes) and key not in _blobs:
+                    _blobs[key] = blob
+                    count += 1
+    if count:
+        metrics.counter("render.hydrated").inc(count)
+    return count
+
+
+def flush_lowered() -> int:
+    """Persist dirty template manifests (merged with any previously
+    recorded programs for the same template) into the ``render.lower``
+    namespace.  Called at process exit and from tests; cheap no-op when
+    nothing new was lowered.  Returns the manifests written."""
+    from ..perf import cache as pf_cache
+
+    cache = pf_cache.get_cache()
+    if cache.mode() == "off":
+        return 0
+    with _lock:
+        dirty = {
+            tid: (
+                tuple(_programs.get(tid, ())),
+                {
+                    digest: blob
+                    for (btid, digest), blob in _blobs.items()
+                    if btid == tid
+                },
+            )
+            for tid in _dirty
+            if tid not in _deopted
+        }
+        _dirty.clear()
+    written = 0
+    for tid, (programs, blobs) in dirty.items():
+        if not programs and not blobs:
+            continue
+        key = _manifest_key(tid)
+        previous = cache.get(_RENDER_STAGE, key, record_stats=False)
+        merged_programs = {p.shape: p for p in programs}
+        merged_blobs = dict(blobs)
+        if (
+            previous is not pf_cache.MISS
+            and isinstance(previous, tuple)
+            and len(previous) == 2
+        ):
+            prev_programs, prev_blobs = previous
+            for program in (
+                prev_programs if isinstance(prev_programs, tuple) else ()
+            ):
+                if (
+                    isinstance(program, Program)
+                    and program.shape not in merged_programs
+                ):
+                    merged_programs[program.shape] = program
+            for digest, blob in (
+                prev_blobs.items() if isinstance(prev_blobs, dict) else ()
+            ):
+                merged_blobs.setdefault(digest, blob)
+        value = (
+            tuple(
+                merged_programs[shape]
+                for shape in sorted(merged_programs)
+            ),
+            merged_blobs,
+        )
+        if previous is not pf_cache.MISS and value == previous:
+            continue
+        cache.put(_RENDER_STAGE, key, value)
+        written += 1
+    return written
+
+
+def _flush_at_exit() -> None:
+    try:
+        if flush_lowered():
+            import sys
+
+            remote = sys.modules.get("operator_forge.perf.remote")
+            if remote is not None:
+                remote.flush()
+    except Exception:
+        pass  # exit paths never raise over a best-effort persist
+
+
+import atexit  # noqa: E402
+
+atexit.register(_flush_at_exit)

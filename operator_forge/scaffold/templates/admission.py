@@ -26,6 +26,7 @@ import os
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec, Fragment, IfExists
 from ...utils.names import to_file_name
+from ..render import compiled_render
 
 
 def webhook_path(view: WorkloadView, kind_of: str) -> str:
@@ -42,6 +43,7 @@ def webhook_file_path(view: WorkloadView) -> str:
     )
 
 
+@compiled_render("admission.webhook_stub_file")
 def webhook_stub_file(
     view: WorkloadView,
     defaulting: bool,
@@ -204,6 +206,7 @@ def _webhook_entry(
 """
 
 
+@compiled_render("admission.webhook_manifests_file")
 def webhook_manifests_file(
     config: ProjectConfig,
     views: list[WorkloadView],
@@ -258,6 +261,7 @@ webhooks:
     )
 
 
+@compiled_render("admission.webhook_kustomization_file")
 def webhook_kustomization_file() -> FileSpec:
     """config/webhook/kustomization.yaml listing the admission manifests
     next to the service (overwrites the conversion-only variant)."""
@@ -271,6 +275,7 @@ def webhook_kustomization_file() -> FileSpec:
     )
 
 
+@compiled_render("admission.main_go_admission_fragments")
 def main_go_admission_fragments(view: WorkloadView) -> list[Fragment]:
     """Register the kind's webhook with the manager.  The api-types
     import fragment is repeated defensively (fragment insertion is

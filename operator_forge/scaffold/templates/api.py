@@ -15,8 +15,10 @@ from ...workload.api_fields import APIFields
 from ...workload.fieldmarkers import FieldType
 from ..context import WorkloadView
 from ..machinery import FileSpec, Fragment, IfExists
+from ..render import compiled_render
 
 
+@compiled_render("api.group_version_info")
 def group_version_info(view: WorkloadView) -> FileSpec:
     content = f'''// Package {view.version} contains API Schema definitions for the {view.group}
 // {view.version} API group.
@@ -73,6 +75,7 @@ def _dependency_entries(view: WorkloadView) -> list[str]:
     return entries
 
 
+@compiled_render("api.types_file")
 def types_file(view: WorkloadView) -> FileSpec:
     """The <kind>_types.go file (reference templates/api/types.go:50-196)."""
     kind = view.kind
@@ -237,6 +240,7 @@ def _struct_names(kind: str, fields: APIFields) -> list[str]:
     return names
 
 
+@compiled_render("api.deepcopy_file")
 def deepcopy_file(view: WorkloadView) -> FileSpec:
     """Generated deepcopy implementations for the kind and its nested spec
     structs (the reference defers this to controller-gen)."""
@@ -378,6 +382,7 @@ func (in *{kind}List) DeepCopyObject() runtime.Object {{
     )
 
 
+@compiled_render("api.kind_registry_files")
 def kind_registry_files(view: WorkloadView) -> list[FileSpec]:
     """apis/<group>/<kind>.go (+ _latest.go): version registry for a kind
     (reference templates/api/kind.go:34-188)."""
@@ -429,6 +434,7 @@ const {kind}LatestVersion = "{view.version}"
     ]
 
 
+@compiled_render("api.kind_registry_fragments")
 def kind_registry_fragments(view: WorkloadView) -> list[Fragment]:
     """Insert the current API version into an existing kind registry
     (reference templates/api/kind.go's Inserter markers
@@ -519,10 +525,21 @@ def _resource_condition_schema() -> dict:
 
 
 def _yaml_dump(data, indent: int = 0) -> str:
-    """Small deterministic YAML renderer for CRD documents."""
+    """Small deterministic YAML renderer for CRD documents.  A pure
+    function of the document dict, so the dump lowers once per content
+    hash into the ``render.lower`` blob store (the YAML representer
+    walk is one of the costliest pieces of a cold ``create api``)."""
     from operator_forge.utils import yamlcompat as pyyaml
 
-    return pyyaml.safe_dump(data, sort_keys=False, default_flow_style=False)
+    from ..render import lowered_blob
+
+    return lowered_blob(
+        "api.crd_yaml_dump",
+        (data,),
+        lambda: pyyaml.safe_dump(
+            data, sort_keys=False, default_flow_style=False
+        ),
+    )
 
 
 def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
@@ -594,6 +611,7 @@ def _merge_crd_versions(view: WorkloadView, crd: dict, output_dir: str) -> dict:
     return crd
 
 
+@compiled_render("api.crd_yaml", subset=False)
 def crd_yaml(
     view: WorkloadView, output_dir: str = "", conversion: bool = False
 ) -> FileSpec:
@@ -689,6 +707,7 @@ def sample_yaml(view: WorkloadView, required_only: bool = False) -> str:
     )
 
 
+@compiled_render("api.sample_file")
 def sample_file(view: WorkloadView) -> FileSpec:
     return FileSpec(
         path=f"config/samples/{view.sample_file_name}",

@@ -30,6 +30,7 @@ from __future__ import annotations
 from ...utils import to_file_name
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec
+from ..render import compiled_render
 
 
 def cli_files(
@@ -69,6 +70,7 @@ def _cmd_description(view: WorkloadView) -> str:
     return f"Manage {view.kind_lower} workload"
 
 
+@compiled_render("companion_cli._main_go")
 def _main_go(root: str, config: ProjectConfig) -> FileSpec:
     content = f'''package main
 
@@ -87,6 +89,7 @@ func main() {{
     return FileSpec(path=f"cmd/{root}/main.go", content=content)
 
 
+@compiled_render("companion_cli._root_go")
 def _root_go(root: str, config: ProjectConfig) -> FileSpec:
     description = config.cli_root_command_description or f"Manage {root} workloads"
     content = f'''package commands
@@ -119,6 +122,7 @@ func NewRootCommand() *cobra.Command {{
     return FileSpec(path=f"cmd/{root}/commands/root.go", content=content)
 
 
+@compiled_render("companion_cli._parent_cmd")
 def _parent_cmd(
     root: str, config: ProjectConfig, pkg: str, use: str, short: str
 ) -> FileSpec:
@@ -159,6 +163,7 @@ func Command() *cobra.Command {{
     )
 
 
+@compiled_render("companion_cli._init_sub")
 def _init_sub(root: str, view: WorkloadView) -> FileSpec:
     """Per-workload `init` subcommand: prints the sample CR manifest
     (reference templates/cli/cmd_init_sub.go)."""
@@ -206,6 +211,7 @@ func new{view.kind}SubCommand() *cobra.Command {{
     )
 
 
+@compiled_render("companion_cli._generate_sub")
 def _generate_sub(root: str, view: WorkloadView) -> FileSpec:
     """Per-workload `generate` subcommand: renders child resources from CR
     manifest files (reference templates/cli/cmd_generate_sub.go:49-332)."""
@@ -323,6 +329,7 @@ func new{view.kind}SubCommand() *cobra.Command {{
     )
 
 
+@compiled_render("companion_cli._version_sub")
 def _version_sub(root: str, view: WorkloadView) -> FileSpec:
     """Per-workload `version` subcommand
     (reference templates/cli/cmd_version_sub.go)."""

@@ -10,8 +10,10 @@ from __future__ import annotations
 from ...utils import to_file_name
 from ..context import WorkloadView
 from ..machinery import FileSpec
+from ..render import compiled_render
 
 
+@compiled_render("controller.controller_file")
 def controller_file(view: WorkloadView) -> FileSpec:
     kind = view.kind
     alias = view.api_import_alias
@@ -405,6 +407,7 @@ func (r *{kind}Reconciler) SetupWithManager(mgr ctrl.Manager) error {{
     return FileSpec(path=view.controller_file, content=content)
 
 
+@compiled_render("controller.reconcile_test_file")
 def reconcile_test_file(view: WorkloadView) -> FileSpec:
     """A real envtest case per kind: create the sample CR and require the
     reconciler to register its finalizer, run its create phases, and record
@@ -536,6 +539,7 @@ func Test{kind}Reconcile(t *testing.T) {{
     )
 
 
+@compiled_render("controller.suite_test_file")
 def suite_test_file(view: WorkloadView, kinds_in_group: list[str]) -> FileSpec:
     """Envtest-based suite test per controller group
     (reference templates/controller/controller_suitetest.go:31-171)."""

@@ -22,6 +22,7 @@ from ...utils import to_file_name
 from ...workload.fieldmarkers import FieldType
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec
+from ..render import compiled_render
 
 
 def e2e_files(
@@ -101,6 +102,7 @@ def tester_namespace(view: WorkloadView) -> str:
     )
 
 
+@compiled_render("e2e._common")
 def _common(views: list[WorkloadView], config: ProjectConfig) -> FileSpec:
     api_imports = []
     schemes = []
@@ -507,6 +509,7 @@ func apiVersionFor(group, version string) string {{
     )
 
 
+@compiled_render("e2e._workload_test")
 def _workload_test(
     view: WorkloadView, dep_views: list[WorkloadView] | None = None
 ) -> FileSpec:

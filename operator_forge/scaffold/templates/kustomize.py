@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec, IfExists
 from .project import leader_election_id
+from ..render import compiled_render, lowered_blob
 
 
 def _controller_manager_config(config: ProjectConfig) -> FileSpec:
@@ -34,6 +35,7 @@ leaderElection:
     )
 
 
+@compiled_render("kustomize.crd_kustomization")
 def crd_kustomization(views: list[WorkloadView]) -> FileSpec:
     resources = "\n".join(
         f"- bases/{view.crd_file_name}" for view in views
@@ -51,6 +53,7 @@ def crd_kustomization(views: list[WorkloadView]) -> FileSpec:
     )
 
 
+@compiled_render("kustomize.samples_kustomization")
 def samples_kustomization(views: list[WorkloadView]) -> FileSpec:
     resources = "\n".join(f"- {view.sample_file_name}" for view in views)
     content = f"## Sample custom resources\nresources:\n{resources}\n"
@@ -61,6 +64,7 @@ def samples_kustomization(views: list[WorkloadView]) -> FileSpec:
     )
 
 
+@compiled_render("kustomize.default_tree")
 def default_tree(config: ProjectConfig) -> list[FileSpec]:
     project = config.project_name
     namespace = f"{project}-system"
@@ -312,6 +316,7 @@ subjects:
     ]
 
 
+@compiled_render("kustomize.prometheus_tree")
 def prometheus_tree() -> list[FileSpec]:
     """config/prometheus: an optional ServiceMonitor for the controller's
     metrics endpoint (the kubebuilder kustomize plugin ships the same tree;
@@ -345,6 +350,7 @@ spec:
     ]
 
 
+@compiled_render("kustomize.manager_cluster_role")
 def manager_cluster_role(views: list[WorkloadView]) -> FileSpec:
     """config/rbac/role.yaml aggregated from every workload's inferred rules
     (the reference defers this to controller-gen reading the
@@ -394,6 +400,12 @@ def manager_cluster_role(views: list[WorkloadView]) -> FileSpec:
     }
     return FileSpec(
         path="config/rbac/role.yaml",
-        content=pyyaml.safe_dump(doc, sort_keys=False),
+        # the rules document is pure data: lower the representer walk
+        # once per content hash alongside the render programs
+        content=lowered_blob(
+            "kustomize.cluster_role_yaml",
+            (doc,),
+            lambda: pyyaml.safe_dump(doc, sort_keys=False),
+        ),
         add_boilerplate=False,
     )

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..context import ProjectConfig
 from ..machinery import FileSpec, IfExists
+from ..render import compiled_render
 
 CONTROLLER_RUNTIME_VERSION = "v0.14.6"
 K8S_VERSION = "v0.26.3"
@@ -32,12 +33,14 @@ def leader_election_id(config: ProjectConfig) -> str:
     return f"{digest:08x}.{domain}"
 
 
+@compiled_render("project.project_file")
 def project_file(config: ProjectConfig) -> FileSpec:
     return FileSpec(
         path="PROJECT", content=config.to_yaml(), add_boilerplate=False
     )
 
 
+@compiled_render("project.boilerplate")
 def boilerplate(license_header: str = "") -> FileSpec:
     content = license_header or (
         "/*\nCopyright 2026.\n\nLicensed under the Apache License, Version"
@@ -52,6 +55,7 @@ def boilerplate(license_header: str = "") -> FileSpec:
     )
 
 
+@compiled_render("project.dockerignore")
 def dockerignore() -> FileSpec:
     return FileSpec(
         path=".dockerignore",
@@ -61,6 +65,7 @@ def dockerignore() -> FileSpec:
     )
 
 
+@compiled_render("project.gitignore")
 def gitignore() -> FileSpec:
     return FileSpec(
         path=".gitignore",
@@ -74,6 +79,7 @@ def gitignore() -> FileSpec:
     )
 
 
+@compiled_render("project.go_mod")
 def go_mod(config: ProjectConfig) -> FileSpec:
     content = f"""module {config.repo}
 
@@ -92,6 +98,7 @@ require (
     return FileSpec(path="go.mod", content=content, add_boilerplate=False)
 
 
+@compiled_render("project.main_go")
 def main_go(config: ProjectConfig) -> FileSpec:
     election_id = leader_election_id(config)
 
@@ -211,6 +218,7 @@ func main() {{
     return FileSpec(path="main.go", content=content)
 
 
+@compiled_render("project.dockerfile")
 def dockerfile() -> FileSpec:
     content = f"""# Build the manager binary
 FROM golang:{GO_VERSION} as builder
@@ -240,6 +248,7 @@ ENTRYPOINT ["/manager"]
     return FileSpec(path="Dockerfile", content=content, add_boilerplate=False)
 
 
+@compiled_render("project.makefile")
 def makefile(config: ProjectConfig) -> FileSpec:
     cli_targets = ""
     if config.cli_root_command_name:
@@ -372,6 +381,7 @@ $(ENVTEST): $(LOCALBIN)
     return FileSpec(path="Makefile", content=content, add_boilerplate=False)
 
 
+@compiled_render("project.readme")
 def readme(config: ProjectConfig, workload_names: list[str]) -> FileSpec:
     cli_section = ""
     if config.cli_root_command_name:

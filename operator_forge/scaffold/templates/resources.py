@@ -12,6 +12,7 @@ from ...gocodegen.generate import uses_sprintf
 from ..context import WorkloadView
 from ..machinery import FileSpec, IfExists
 from .api import sample_yaml
+from ..render import compiled_render
 
 
 def _workload_args_decl(view: WorkloadView) -> str:
@@ -41,6 +42,7 @@ def _collection_import(view: WorkloadView) -> str:
     return ""
 
 
+@compiled_render("resources.resources_file")
 def resources_file(view: WorkloadView) -> FileSpec:
     """The resources.go file for a workload's resources package
     (reference templates/api/resources/resources.go:40-230)."""
@@ -256,6 +258,7 @@ func ConvertWorkload(component orchestrate.Workload) (*{alias}.{kind}, error) {{
 }}'''
 
 
+@compiled_render("resources.definition_files")
 def definition_files(view: WorkloadView) -> list[FileSpec]:
     """One Go file per source manifest, each containing the create funcs for
     the manifest's child resources
@@ -330,6 +333,7 @@ func {child.create_func_name()}(
     )
 
 
+@compiled_render("resources.mutate_hook")
 def mutate_hook(view: WorkloadView) -> FileSpec:
     """User-owned mutation hook, never overwritten on re-scaffold
     (reference templates/int/mutate/component.go, SkipFile)."""
@@ -360,6 +364,7 @@ func {kind}Mutate(
     )
 
 
+@compiled_render("resources.dependencies_hook")
 def dependencies_hook(view: WorkloadView) -> FileSpec:
     """User-owned dependency-check hook, never overwritten on re-scaffold
     (reference templates/int/dependencies/component.go, SkipFile)."""

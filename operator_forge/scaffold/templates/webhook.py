@@ -27,6 +27,7 @@ import re
 from ..context import ProjectConfig, WorkloadView
 from ..machinery import FileSpec, Fragment, IfExists
 from ...utils.names import to_file_name
+from ..render import compiled_render
 
 
 def other_versions(view: WorkloadView, output_dir: str) -> list[str]:
@@ -75,6 +76,7 @@ def hub_version(view: WorkloadView, output_dir: str) -> str:
     )
 
 
+@compiled_render("webhook.conversion_files", subset=False)
 def conversion_files(view: WorkloadView, output_dir: str) -> list[FileSpec]:
     """Hub + spoke conversion files for a multi-version kind; empty when the
     kind has a single scaffolded version.
@@ -208,6 +210,7 @@ func (dst *{kind}) ConvertFrom(srcRaw conversion.Hub) error {{
 # -- kustomize config trees ----------------------------------------------
 
 
+@compiled_render("webhook.webhook_config_tree")
 def webhook_config_tree(config: ProjectConfig) -> list[FileSpec]:
     """config/webhook + config/certmanager + the manager webhook patch."""
     project = config.project_name
@@ -395,6 +398,7 @@ def update_default_kustomization(output_dir: str, dry_run: bool = False) -> bool
     return True
 
 
+@compiled_render("webhook.main_go_webhook_fragment")
 def main_go_webhook_fragment(view: WorkloadView, hub: str) -> Fragment:
     """Register the hub type with the webhook builder so controller-runtime
     serves /convert for the kind."""

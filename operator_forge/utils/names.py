@@ -17,7 +17,29 @@ import functools
 # per field per template, and real configs reuse a small set of names
 
 
-@functools.lru_cache(maxsize=None)
+def _memo_str(fn):
+    """``lru_cache`` that only caches exact-``str`` arguments.
+
+    ``str`` subclasses hash and compare equal to their plain value, so a
+    vanilla ``lru_cache`` would serve a cached plain result to (or cache a
+    result from) an instrumented string such as the render-lowering probes
+    in ``scaffold/render.py`` — silently erasing the instrumentation.
+    Subclass inputs bypass the cache and run the raw function instead.
+    """
+    cached = functools.lru_cache(maxsize=None)(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        if all(type(a) is str for a in args):
+            return cached(*args)
+        return fn(*args)
+
+    wrapper.cache_clear = cached.cache_clear
+    wrapper.cache_info = cached.cache_info
+    return wrapper
+
+
+@_memo_str
 def to_title(s: str) -> str:
     """Uppercase the first letter of each space/punctuation-separated word.
 
@@ -41,7 +63,7 @@ def to_title(s: str) -> str:
     return "".join(out)
 
 
-@functools.lru_cache(maxsize=None)
+@_memo_str
 def title_words(s: str, seps: str = ".-_ :") -> str:
     """Title-case ``s`` and drop the separator characters.
 
@@ -54,7 +76,7 @@ def title_words(s: str, seps: str = ".-_ :") -> str:
     return result
 
 
-@functools.lru_cache(maxsize=None)
+@_memo_str
 def to_pascal_case(name: str) -> str:
     """kebab-case -> PascalCase (reference internal/utils/names.go:12-31)."""
     out = []
@@ -70,13 +92,13 @@ def to_pascal_case(name: str) -> str:
     return "".join(out)
 
 
-@functools.lru_cache(maxsize=None)
+@_memo_str
 def to_file_name(name: str) -> str:
     """kebab-case -> snake_case (reference internal/utils/names.go:33-37)."""
     return name.replace("-", "_").lower()
 
 
-@functools.lru_cache(maxsize=None)
+@_memo_str
 def to_package_name(name: str) -> str:
     """kebab-case -> flat lowercase (reference internal/utils/names.go:39-43)."""
     return name.replace("-", "").lower()

@@ -67,11 +67,21 @@ def _transform_manifest(content: str, marker_types: tuple) -> tuple:
         ]
         return new_content, markers
 
+    from ..scaffold import render
+
+    key = (content, tuple(mt.value for mt in marker_types))
     with spans.span("marker-inspect"):
+        # two tiers: the content-keyed stage cache (cleared with the
+        # perf cache) over the lowered-blob tier (a process-level JIT
+        # artifact persisted in the ``render.lower`` namespace), so a
+        # cache reset replays the pickled transform instead of
+        # re-walking the YAML
         return perfcache.memoized(
             "manifest-transform",
-            (content, tuple(mt.value for mt in marker_types)),
-            compute,
+            key,
+            lambda: render.lowered_blob(
+                "workload.manifest_transform", key, compute
+            ),
         )
 
 
@@ -104,14 +114,24 @@ def _build_children(content: str, filename: str) -> list:
                 )
             child = manifests_mod.ChildResource.from_object(obj)
             with spans.span("child-codegen"):
-                child.source_code = gocodegen.generate_for_document(
-                    docs[0], "resourceObj"
+                child.source_code = (
+                    gocodegen.generate_for_document_lowered(
+                        docs[0], "resourceObj", extracted
+                    )
                 )
             child.static_content = extracted
             children.append(child)
         return children
 
-    return perfcache.memoized("manifest-children", (content,), compute)
+    from ..scaffold import render
+
+    return perfcache.memoized(
+        "manifest-children",
+        (content,),
+        lambda: render.lowered_blob(
+            "workload.manifest_children", (content,), compute
+        ),
+    )
 
 
 class WorkloadKind(enum.Enum):

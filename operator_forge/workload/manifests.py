@@ -121,7 +121,15 @@ def _scan_resource_marker(content: str):
                 return result.obj
         return None
 
-    return perfcache.memoized("resource-marker-scan", (content,), compute)
+    from ..scaffold import render
+
+    return perfcache.memoized(
+        "resource-marker-scan",
+        (content,),
+        lambda: render.lowered_blob(
+            "workload.resource_marker_scan", (content,), compute
+        ),
+    )
 
 
 def _is_dynamic_name(name: str) -> bool:

@@ -1,0 +1,586 @@
+"""Compiled render program contract (PR 16 acceptance).
+
+The render tier — sentinel-probe record-and-replay lowering of
+template renders into flat segment programs, the content-hash blob
+store for pure transforms, manifest-carried cross-process hydration,
+and the fused marker-fragment splice — may only ever change HOW a
+scaffold is produced, never a single byte of WHAT it produces.  Every
+test here compares full output trees (or full file bytes) between the
+program tier and the pinned reference renderer, across cache modes,
+worker backends, process boundaries, and the fragment error paths.
+"""
+
+import contextlib
+import functools
+import hashlib
+import io
+import json
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+import operator_forge
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import metrics, workers
+from operator_forge.scaffold import render
+from operator_forge.scaffold.machinery import (
+    FileSpec,
+    Fragment,
+    Scaffold,
+    ScaffoldError,
+    marker_line,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(operator_forge.__file__))
+
+
+@pytest.fixture(autouse=True)
+def _restore_render_state():
+    """The render registries survive ``perf.cache.reset()`` on purpose
+    (programs are compiled code, not cache state), so this module
+    isolates them explicitly: every test starts with no programs, no
+    deopt pins, and env-driven mode selection."""
+    saved_env = os.environ.get("OPERATOR_FORGE_RENDER")
+    render.set_mode(None)
+    render.reset()
+    yield
+    render.set_mode(None)
+    render.reset()
+    if saved_env is None:
+        os.environ.pop("OPERATOR_FORGE_RENDER", None)
+    else:
+        os.environ["OPERATOR_FORGE_RENDER"] = saved_env
+
+
+def generate(config: str, out: str, repo: str = "github.com/acme/rendered"):
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(
+            ["init", "--workload-config", config,
+             "--repo", repo, "--output-dir", out]
+        ) == 0
+        assert cli_main(
+            ["create", "api", "--workload-config", config,
+             "--output-dir", out]
+        ) == 0
+
+
+def tree_digest(root: str) -> dict:
+    """relpath -> sha256 for every file under ``root`` (relpath-keyed
+    so trees under different parents compare equal)."""
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()
+            out[os.path.relpath(path, root)] = digest
+    assert out, f"no files generated under {root}"
+    return out
+
+
+class TestRenderIdentity:
+    @pytest.mark.parametrize("fixture", ["standalone", "kitchen-sink"])
+    def test_fixture_trees_identical(self, fixture, tmp_path):
+        """The program tier reproduces the reference renderer's output
+        tree byte for byte, and actually lowers (a ref-only run would
+        pass identity vacuously)."""
+        config = os.path.join(FIXTURES, fixture, "workload.yaml")
+        perfcache.configure(mode="off")
+        render.set_mode("ref")
+        generate(config, str(tmp_path / "ref"))
+        render.set_mode("program")
+        generate(config, str(tmp_path / "program"))
+        assert tree_digest(str(tmp_path / "ref")) == tree_digest(
+            str(tmp_path / "program")
+        )
+        render.flush_counters()
+        counts = metrics.counters_snapshot()
+        assert counts.get("render.lowered", 0) > 0
+        assert counts.get("render.executed", 0) > 0
+
+    def test_monorepo_lite_identical(self, tmp_path):
+        from monorepo_lite import write_monorepo_lite
+
+        config = write_monorepo_lite(str(tmp_path / "mono"), workloads=5)
+        perfcache.configure(mode="off")
+        render.set_mode("ref")
+        generate(config, str(tmp_path / "ref"), "github.com/acme/mono")
+        render.set_mode("program")
+        generate(config, str(tmp_path / "program"), "github.com/acme/mono")
+        assert tree_digest(str(tmp_path / "ref")) == tree_digest(
+            str(tmp_path / "program")
+        )
+
+    def test_cache_and_worker_matrix(self, tmp_path):
+        """The reduced in-suite matrix (commit-check runs the full
+        2×3×2 one) through the serve batch layer, so the process-pool
+        leg renders INSIDE pool workers: program output under each
+        cache mode and backend must match the forced-ref cache-off
+        serial reference."""
+        from operator_forge.serve.batch import run_batch
+        from operator_forge.serve.jobs import jobs_from_specs
+
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        saved_jobs = os.environ.get("OPERATOR_FORGE_JOBS")
+
+        def batch_digest(suffix: str) -> dict:
+            out = str(tmp_path / f"mx-{suffix}")
+            specs = [
+                {"command": "init", "workload_config": config,
+                 "output_dir": out, "repo": "github.com/acme/matrix"},
+                {"command": "create-api", "workload_config": config,
+                 "output_dir": out},
+            ]
+            results = run_batch(jobs_from_specs(specs, str(tmp_path)))
+            bad = [(r.id, r.stderr) for r in results if not r.ok]
+            assert not bad, f"identity job failed: {bad}"
+            digest = tree_digest(out)
+            shutil.rmtree(out)
+            return digest
+
+        def set_render(mode_name: str) -> None:
+            # pool workers resolve the mode from shipped env/config at
+            # job time, not from this process's override alone
+            render.set_mode(mode_name)
+            os.environ["OPERATOR_FORGE_RENDER"] = mode_name
+
+        try:
+            set_render("ref")
+            workers.set_backend("thread")
+            os.environ["OPERATOR_FORGE_JOBS"] = "1"
+            perfcache.configure(mode="off")
+            perfcache.reset()
+            reference = batch_digest("ref")
+
+            set_render("program")
+            for cache_mode, backend, jobs in (
+                ("off", "thread", "1"),
+                ("mem", "thread", "8"),
+                ("disk", "process", "8"),
+            ):
+                perfcache.configure(
+                    mode=cache_mode,
+                    root=str(tmp_path / "cache")
+                    if cache_mode == "disk" else None,
+                )
+                perfcache.reset()
+                workers.set_backend(backend)
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                got = batch_digest(f"{cache_mode}-{backend}")
+                assert got == reference, (
+                    f"cache={cache_mode} workers={backend} diverged"
+                )
+        finally:
+            workers.set_backend(None)
+            if saved_jobs is None:
+                os.environ.pop("OPERATOR_FORGE_JOBS", None)
+            else:
+                os.environ["OPERATOR_FORGE_JOBS"] = saved_jobs
+
+    def test_guarded_template_identity_across_args(self, tmp_path):
+        """An lru-cached helper inside a template body is the known
+        lowering hazard (it can capture a probe string keyed by its
+        real value).  The recorded equality guards must scope the
+        program to the lowering argument, so other arguments still
+        render correctly."""
+
+        @functools.lru_cache(maxsize=None)
+        def shout(name: str) -> str:
+            return name.upper()
+
+        @render.compiled_render("testmod.guarded_greet")
+        def greet(name: str) -> str:
+            if name == "x":
+                return "hi " + shout(name)
+            return "yo " + shout(name)
+
+        render.set_mode("program")
+        assert greet("x") == "hi X"
+        assert greet("y") == "yo Y"
+        assert greet("x") == "hi X"
+        assert greet("z") == "yo Z"
+        # the ref path agrees even with the helper's cache warm
+        assert greet.__wrapped__("x") == "hi X"
+
+
+class TestDeopt:
+    def test_subset_false_deopts_on_first_call(self):
+        @render.compiled_render("testmod.declared_impure", subset=False)
+        def impure(name: str) -> str:
+            return "hello " + name
+
+        render.set_mode("program")
+        before = metrics.counters_snapshot().get("render.deopt", 0)
+        assert impure("world") == "hello world"
+        after = metrics.counters_snapshot().get("render.deopt", 0)
+        assert after == before + 1
+        assert "testmod.declared_impure" in render.deopted()
+        # permanent: later calls neither re-deopt nor lower
+        assert impure("again") == "hello again"
+        final = metrics.counters_snapshot()
+        assert final.get("render.deopt", 0) == after
+        assert "testmod.declared_impure" not in render._programs
+
+    def test_out_of_subset_render_deopts_and_stays_correct(self):
+        """A template whose probe render cannot reproduce the
+        reference output (here: it reads external mutable state) fails
+        the verify gate, deopts permanently, and keeps returning the
+        reference result."""
+        calls = [0]
+
+        @render.compiled_render("testmod.stateful")
+        def stateful(name: str) -> str:
+            calls[0] += 1
+            return f"{name}:{calls[0]}"
+
+        render.set_mode("program")
+        before = metrics.counters_snapshot().get("render.deopt", 0)
+        # the wrapper runs the ref render (call 1) then the probe
+        # render (call 2); the verify mismatch pins the template
+        assert stateful("a") == "a:1"
+        assert "testmod.stateful" in render.deopted()
+        counts = metrics.counters_snapshot()
+        assert counts.get("render.deopt", 0) == before + 1
+        # deopted templates go straight to the reference renderer
+        assert stateful("b") == "b:3"
+        assert metrics.counters_snapshot().get("render.deopt", 0) == before + 1
+
+
+class TestProgramModel:
+    def test_program_pickle_roundtrip_and_execute(self):
+        render.set_mode("program")
+        perfcache.configure(mode="off")
+        from operator_forge.scaffold.templates import project
+
+        first = project.gitignore()
+        programs = render._programs.get("project.gitignore")
+        assert programs, "no-arg template did not lower"
+        program = programs[0]
+        clone = pickle.loads(pickle.dumps(program, 5))
+        assert clone == program  # frozen dataclass: full structural eq
+        assert render.execute(clone, ()) == first
+        assert project.gitignore.__wrapped__() == first
+
+    def test_blob_key_is_identity_insensitive(self):
+        """Regression: blob keys hash canonically, never via pickle —
+        pickle memoizes repeated references, so a doc sharing one
+        string object between two slots would key differently from an
+        equal doc built from distinct objects (and a cold process would
+        re-lower instead of hydrating)."""
+        render.set_mode("program")
+        shared = "watch-list"
+        doc_shared = {"verbs": [shared, shared]}
+        doc_copies = {"verbs": ["watch-list"[:5] + "-list", "watch" + "-list"]}
+        assert doc_shared == doc_copies
+        calls = []
+
+        def compute():
+            calls.append(1)
+            return "payload"
+
+        assert render.lowered_blob("testmod.blob", (doc_shared,), compute) \
+            == "payload"
+        assert render.lowered_blob("testmod.blob", (doc_copies,), compute) \
+            == "payload"
+        assert len(calls) == 1, "equal docs took two lowerings"
+
+    def test_blob_returns_fresh_copies(self):
+        """Blob execution unpickles per hit: every caller owns a fresh
+        copy (``perf.cache.memoized`` semantics), so mutating one
+        result can never poison the store."""
+        render.set_mode("program")
+        first = render.lowered_blob(
+            "testmod.blob_copy", ("k",), lambda: ["a", "b"]
+        )
+        first.append("mutated")
+        second = render.lowered_blob(
+            "testmod.blob_copy", ("k",), lambda: ["a", "b"]
+        )
+        assert second == ["a", "b"]
+        assert second is not first
+
+
+class TestCrossProcessHydration:
+    CHILD = """
+import contextlib, io, json, os, sys
+root, config, outdir = sys.argv[1:4]
+os.environ["OPERATOR_FORGE_CACHE"] = "disk"
+os.environ["OPERATOR_FORGE_CACHE_DIR"] = root
+os.environ["OPERATOR_FORGE_RENDER"] = "program"
+from operator_forge.cli.main import main as cli_main
+from operator_forge.perf import metrics
+from operator_forge.scaffold import render
+with contextlib.redirect_stdout(io.StringIO()):
+    assert cli_main(["init", "--workload-config", config,
+                     "--repo", "github.com/acme/hydra",
+                     "--output-dir", outdir]) == 0
+    assert cli_main(["create", "api", "--workload-config", config,
+                     "--output-dir", outdir]) == 0
+render.flush_counters()
+counts = metrics.counters_snapshot()
+print(json.dumps({k: v for k, v in counts.items()
+                  if k.startswith("render.")}))
+"""
+
+    def test_cold_process_hydrates_without_relowering(self, tmp_path):
+        """A priming process persists its programs into ``render.lower``
+        manifests; a genuinely cold process sharing the disk cache
+        reconstitutes them (render.hydrated counts the entries), lowers
+        NOTHING fresh, and emits the exact reference tree."""
+        config = os.path.join(FIXTURES, "standalone", "workload.yaml")
+        disk_root = str(tmp_path / "cache")
+        render.set_mode("program")
+        perfcache.configure(mode="disk", root=disk_root)
+        perfcache.reset()
+        generate(config, str(tmp_path / "prime"), "github.com/acme/hydra")
+        shutil.rmtree(str(tmp_path / "prime"))
+        render.flush_lowered()
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # nested one level deeper than the priming dir: the pipeline
+        # plan cache keys on the config's relpath from the output dir,
+        # and a same-depth dir would REPLAY the plan — writing the
+        # right bytes without ever invoking a render, which is exactly
+        # the path this test must not take
+        child_out = str(tmp_path / "deep" / "hydrated")
+        proc = subprocess.run(
+            [sys.executable, "-c", self.CHILD, disk_root, config, child_out],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        counts = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert counts.get("render.hydrated", 0) > 0, counts
+        assert counts.get("render.lowered", 0) == 0, (
+            f"cold process re-lowered despite populated manifests: {counts}"
+        )
+        assert counts.get("render.executed", 0) > 0, counts
+
+        perfcache.configure(mode="off")
+        render.set_mode("ref")
+        # same depth as the child's dir: PROJECT embeds the config's
+        # relpath from the output dir, so the reference must share it
+        ref_out = str(tmp_path / "deep" / "ref")
+        generate(config, ref_out, "github.com/acme/hydra")
+        assert tree_digest(child_out) == tree_digest(ref_out)
+
+    def test_manifest_entries_carry_programs_and_blobs(self, tmp_path):
+        config = os.path.join(FIXTURES, "kitchen-sink", "workload.yaml")
+        render.set_mode("program")
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        generate(config, str(tmp_path / "proj"))
+        render.flush_lowered()
+        cache = perfcache.get_cache()
+        found_programs = found_blobs = 0
+        template_ids = set(render._programs) | {
+            tid for (tid, _digest) in render._blobs
+        }
+        for tid in sorted(template_ids):
+            manifest = cache.get(
+                render._RENDER_STAGE, render._manifest_key(tid)
+            )
+            if manifest is perfcache.MISS:
+                continue
+            programs, blobs = manifest
+            for program in programs:
+                assert isinstance(program, render.Program)
+                assert program.template_id == tid
+                found_programs += 1
+            for digest, blob in blobs.items():
+                assert isinstance(digest, str) and isinstance(blob, bytes)
+                found_blobs += 1
+        assert found_programs > 0, "no Programs persisted in manifests"
+        assert found_blobs > 0, "no blobs persisted in manifests"
+
+    def test_in_process_hydration_after_registry_reset(self, tmp_path):
+        """The cold-process simulation without the subprocess: after
+        ``render.reset()`` drops every live program, the next decorated
+        call hydrates from the manifest instead of re-lowering."""
+        render.set_mode("program")
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        from operator_forge.scaffold.templates import project
+
+        first = project.gitignore()
+        render.flush_lowered()
+        render.reset()
+        before = metrics.counters_snapshot()
+        assert project.gitignore() == first
+        render.flush_counters()
+        after = metrics.counters_snapshot()
+        assert after.get("render.hydrated", 0) > before.get(
+            "render.hydrated", 0
+        )
+        assert after.get("render.lowered", 0) == before.get(
+            "render.lowered", 0
+        )
+
+
+def _marker(name: str) -> str:
+    return "\t" + marker_line(name)
+
+
+FRAGMENT_SPECS = [
+    FileSpec(
+        path="main.go",
+        content=(
+            "package main\n\nfunc main() {\n"
+            + _marker("imports") + "\n"
+            + _marker("hooks") + "\n}\n"
+        ),
+        add_boilerplate=False,
+    ),
+    FileSpec(
+        path="pkg/other.go",
+        content="package pkg\n\nfunc other() {\n" + _marker("hooks") + "\n}\n",
+        add_boilerplate=False,
+    ),
+]
+
+
+def _run_fragments(outdir: str, fragments: list, fused: bool):
+    """Execute the spec+fragment plan under the requested splice path
+    (the fused path is gated on the program renderer)."""
+    render.set_mode("program" if fused else "ref")
+    scaffold = Scaffold(output_dir=outdir)
+    scaffold.execute(list(FRAGMENT_SPECS), fragments)
+
+
+def _read_tree(outdir: str) -> dict:
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(outdir):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                out[os.path.relpath(path, outdir)] = fh.read()
+    return out
+
+
+class TestFusedFragments:
+    def test_fused_matches_serial(self, tmp_path):
+        """Stacked splices at one marker, fragments interleaved across
+        targets, and an idempotent duplicate: the fused one-read
+        one-publish path must leave every file byte-identical to the
+        serial per-fragment reference."""
+        fragments = [
+            Fragment(path="main.go", marker="imports", code='\t"fmt"\n'),
+            Fragment(path="pkg/other.go", marker="hooks", code="\tfirst()\n"),
+            Fragment(path="main.go", marker="imports", code='\t"os"\n'),
+            Fragment(path="main.go", marker="hooks", code="\tsetup()\n"),
+            # exact duplicate: the presence scan must skip it in both paths
+            Fragment(path="main.go", marker="imports", code='\t"fmt"\n'),
+            Fragment(path="pkg/other.go", marker="hooks", code="\tsecond()\n"),
+        ]
+        _run_fragments(str(tmp_path / "serial"), list(fragments), fused=False)
+        _run_fragments(str(tmp_path / "fused"), list(fragments), fused=True)
+        serial = _read_tree(str(tmp_path / "serial"))
+        fused = _read_tree(str(tmp_path / "fused"))
+        assert serial == fused
+        assert 'setup()' in serial["main.go"]
+        assert serial["main.go"].count('"fmt"') == 1
+
+    def test_marker_missing_fails_identically(self, tmp_path):
+        """Both paths raise the same error for an unknown marker, and
+        both publish every splice a PRIOR fragment already made."""
+        fragments = [
+            Fragment(path="main.go", marker="imports", code='\t"fmt"\n'),
+            Fragment(path="main.go", marker="nope", code="\tboom()\n"),
+        ]
+        messages = {}
+        for label, fused in (("serial", False), ("fused", True)):
+            outdir = str(tmp_path / label)
+            with pytest.raises(ScaffoldError) as err:
+                _run_fragments(outdir, list(fragments), fused=fused)
+            messages[label] = str(err.value)
+        assert messages["serial"] == messages["fused"]
+        serial = _read_tree(str(tmp_path / "serial"))
+        fused = _read_tree(str(tmp_path / "fused"))
+        assert serial == fused
+        assert '"fmt"' in serial["main.go"]
+
+    def test_missing_target_fails_identically(self, tmp_path):
+        fragments = [
+            Fragment(path="pkg/other.go", marker="hooks", code="\tpre()\n"),
+            Fragment(path="absent.go", marker="imports", code="\tx()\n"),
+        ]
+        messages = {}
+        for label, fused in (("serial", False), ("fused", True)):
+            outdir = str(tmp_path / label)
+            with pytest.raises(ScaffoldError) as err:
+                _run_fragments(outdir, list(fragments), fused=fused)
+            messages[label] = str(err.value)
+        assert messages["serial"] == messages["fused"]
+        assert _read_tree(str(tmp_path / "serial")) == _read_tree(
+            str(tmp_path / "fused")
+        )
+
+
+class TestSurfacesAndKnobs:
+    def test_tier_report_surfaces_render_counters(self):
+        render.set_mode("program")
+        perfcache.configure(mode="off")
+        from operator_forge.scaffold.templates import project
+
+        project.gitignore()
+        report = metrics.tier_report()
+        assert report["render_mode"] == "program"
+        assert report["render.lowered"] >= 1
+        for key in ("render.hydrated", "render.executed", "render.deopt"):
+            assert key in report
+
+    def test_cli_stats_prints_render_line(self, capsys):
+        assert cli_main(["stats"]) == 0
+        out = capsys.readouterr().out
+        render_lines = [
+            line for line in out.splitlines()
+            if line.startswith("render: mode=")
+        ]
+        assert render_lines, out
+        assert "lowered=" in render_lines[0]
+
+    def test_serve_stats_exposes_render_tier(self, tmp_path):
+        from operator_forge.serve.server import _handle
+
+        payload, keep = _handle({"op": "stats"}, str(tmp_path))
+        assert keep is True
+        assert payload["tiers"]["render_mode"] in render._MODES
+        assert "render.lowered" in payload["tiers"]
+
+    def test_cache_namespace_recorded(self, tmp_path):
+        """Hydration lookups land in the shared cache stats under the
+        ``render.lower`` namespace, so `operator-forge stats` and cache
+        gc/verify see the render tier like any other store client."""
+        render.set_mode("program")
+        perfcache.configure(mode="disk", root=str(tmp_path / "cache"))
+        perfcache.reset()
+        from operator_forge.scaffold.templates import project
+
+        project.gitignore()
+        render.flush_lowered()
+        assert "render.lower" in metrics.report()["cache"]
+
+    def test_env_knob_selects_mode(self):
+        render.set_mode(None)
+        os.environ["OPERATOR_FORGE_RENDER"] = "ref"
+        assert render.mode() == "ref"
+        before = metrics.counters_snapshot().get("render.lowered", 0)
+        from operator_forge.scaffold.templates import project
+
+        project.gitignore()
+        assert metrics.counters_snapshot().get(
+            "render.lowered", 0
+        ) == before
+        # unknown values fall back to the compiled default
+        os.environ["OPERATOR_FORGE_RENDER"] = "bogus"
+        assert render.mode() == render.DEFAULT_MODE
+        # the programmatic override outranks env (bench identity legs)
+        render.set_mode("ref")
+        os.environ["OPERATOR_FORGE_RENDER"] = "program"
+        assert render.mode() == "ref"
