@@ -560,6 +560,10 @@ _operator_forge() {
                     COMPREPLY=($(compgen -W "OPERATOR_FORGE_GOCHECK=walk OPERATOR_FORGE_GOCHECK=compile OPERATOR_FORGE_GOCHECK=bytecode" -- "$cur"));;
                 OPERATOR_FORGE_CACHE=*)
                     COMPREPLY=($(compgen -W "OPERATOR_FORGE_CACHE=off OPERATOR_FORGE_CACHE=mem OPERATOR_FORGE_CACHE=disk" -- "$cur"));;
+                OPERATOR_FORGE_DAEMON_SUPERSEDE=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_DAEMON_SUPERSEDE=on OPERATOR_FORGE_DAEMON_SUPERSEDE=off" -- "$cur"));;
+                OPERATOR_FORGE_DAEMON_EDITOR_BOOST=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_DAEMON_EDITOR_BOOST=on OPERATOR_FORGE_DAEMON_EDITOR_BOOST=off" -- "$cur"));;
                 *)
                     COMPREPLY=($(compgen -f -- "$cur"));;
             esac;;
@@ -1116,6 +1120,17 @@ def cmd_stats(args: argparse.Namespace) -> int:
             tiers.get("render.deopt", 0),
         )
     )
+    editor = report.get("editor") or {}
+    if editor:
+        print(
+            "editor: overlays=%d superseded=%d push_p50=%s push_p99=%s"
+            % (
+                editor.get("overlays", 0),
+                editor.get("superseded", 0)
+                + editor.get("superseded_inflight", 0),
+                editor.get("push_p50"), editor.get("push_p99"),
+            )
+        )
     slo = report.get("slo") or {}
     if slo:
         print("slo tenants:")

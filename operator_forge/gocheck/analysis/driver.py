@@ -32,6 +32,8 @@ import os
 from collections.abc import Mapping
 
 from ... import __version__
+from ...perf import cache as pf_cache
+from ...perf import overlay as pf_overlay
 from ...perf import parallel_map, spans
 from ...perf.depgraph import GRAPH
 from .. import cache
@@ -224,19 +226,23 @@ def analyze_project(root: str, analyzers=None) -> list:
     selected = resolve(analyzers)
     names = tuple(a.name for a in selected)
     key = None
+    state = None
     if cache.replay_enabled():
-        key = cache.analyze_key(root, names)
+        # one Go-surface walk serves the run key AND the project index
+        # below — the edit loop pays it once, not twice
+        state = cache.go_file_state(root)
+        key = cache.analyze_key(root, names, state=state)
         cached = cache.analyze_get(key)
         if cached is not None:
             return cached
     with spans.span("gocheck.analyze"):
-        diagnostics = _analyze_live(root, selected)
+        diagnostics = _analyze_live(root, selected, state)
     if key is not None:
         cache.analyze_put(key, diagnostics)
     return diagnostics
 
 
-def _analyze_live(root: str, selected) -> list:
+def _analyze_live(root: str, selected, state: tuple | None = None) -> list:
     file_analyzers = [a for a in selected if a.scope == "file"]
     project_analyzers = [a for a in selected if a.scope == "project"]
     need_index = any("index" in a.requires for a in selected)
@@ -244,7 +250,7 @@ def _analyze_live(root: str, selected) -> list:
     manifest = MANIFEST
     index = None
     if need_index:
-        index = project_index(root)
+        index = project_index(root, state)
         if index.module is not None:
             manifest = index.merged_manifest(MANIFEST)
     files = _go_files(root)
@@ -264,12 +270,20 @@ def _analyze_live(root: str, selected) -> list:
 
     def read_and_analyze(path: str, manifest_view) -> list:
         try:
-            with open(path, encoding="utf-8") as fh:
-                text = fh.read()
+            text = pf_overlay.read_text(path)
         except (OSError, UnicodeDecodeError) as exc:
             return [Diagnostic(path, 0, 0, "syntax", "error",
                                f"unreadable: {exc}")]
         return _analyze_one(path, text, file_analyzers, manifest_view)
+
+    def _file_key(path: str, sha: str) -> tuple:
+        # per-file node: keyed on the file's own bytes (+ the selected
+        # analyzers); cross-file facts it consulted ride along as
+        # recorded edges, validated against this run's surfaces.  The
+        # source edge is what the watch loop's reverse-dependency
+        # sweep invalidates on an edit.
+        return ("analyze.file", cache._SCHEMA, __version__, path, sha,
+                file_names)
 
     def analyze_file(path: str) -> list:
         if not replaying:
@@ -279,13 +293,6 @@ def _analyze_live(root: str, selected) -> list:
         sha = cache.file_sha_stat(path)
         if sha is None:
             return read_and_analyze(path, manifest)
-        # per-file node: keyed on the file's own bytes (+ the selected
-        # analyzers); cross-file facts it consulted ride along as
-        # recorded edges, validated against this run's surfaces.  The
-        # source edge is what the watch loop's reverse-dependency
-        # sweep invalidates on an edit.
-        key = ("analyze.file", cache._SCHEMA, __version__, path, sha,
-               file_names)
         recording = _RecordingManifest(
             manifest,
             lambda name: GRAPH.read(("pkg", name), surfaces.sig(name)),
@@ -296,15 +303,42 @@ def _analyze_live(root: str, selected) -> list:
             return read_and_analyze(path, recording)
 
         return GRAPH.memo(
-            "gocheck.analyze.file", key, current_sig_for(path, sha),
-            build,
+            "gocheck.analyze.file", _file_key(path, sha),
+            current_sig_for(path, sha), build,
         )
 
-    diagnostics: list = []
-    # per-file analysis is pure: fan out across OPERATOR_FORGE_JOBS,
+    # per-file analysis is pure: probe the replay table serially (a
+    # warm sweep is pure dict lookups — futures would cost more than
+    # the work), then fan the misses across OPERATOR_FORGE_JOBS,
     # collecting in input order so the report matches the serial loop
-    for file_diags in parallel_map(analyze_file, files):
-        diagnostics.extend(file_diags)
+    results: list = [None] * len(files)
+    pending = list(range(len(files)))
+    if replaying:
+        pending = []
+        for i, path in enumerate(files):
+            sha = cache.file_sha_stat(path)
+            if sha is None:
+                pending.append(i)
+                continue
+            hit = GRAPH.peek(
+                "gocheck.analyze.file", _file_key(path, sha),
+                current_sig_for(path, sha),
+            )
+            if hit is pf_cache.MISS:
+                pending.append(i)
+            else:
+                results[i] = hit
+    if len(pending) == 1:
+        results[pending[0]] = analyze_file(files[pending[0]])
+    elif pending:
+        for i, file_diags in zip(
+            pending, parallel_map(lambda i: analyze_file(files[i]), pending)
+        ):
+            results[i] = file_diags
+    diagnostics: list = []
+    for file_diags in results:
+        if file_diags:
+            diagnostics.extend(file_diags)
     pctx = ProjectContext(root, index, manifest, files)
     for analyzer in project_analyzers:
         diagnostics.extend(analyzer.run(pctx))

@@ -40,6 +40,7 @@ import threading
 
 from .. import __version__
 from ..perf import cache as pf_cache
+from ..perf import overlay as pf_overlay
 from ..perf import spans
 
 # bump to invalidate previously persisted gocheck entries when the
@@ -94,9 +95,16 @@ _sha_stat_mem: dict = {}  # path -> (mtime_ns, size, ino, hashed_at_ns, sha)
 
 
 def file_sha_stat(path: str):
-    """`perf.cache.file_sha` with a stat-validated memo (see above)."""
+    """`perf.cache.file_sha` with a stat-validated memo (see above).
+    An in-memory buffer overlay (PR 17) wins over the disk: its content
+    sha IS the file's sha while registered, so every content key built
+    on this function — tree states, check/analyze keys, per-file graph
+    nodes — sees the unsaved bytes exactly as if they had been saved."""
     import time
 
+    overlay_sha = pf_overlay.sha(path)
+    if overlay_sha is not None:
+        return overlay_sha
     try:
         st = os.stat(path)
     except OSError:
@@ -241,6 +249,11 @@ def tree_state(root: str) -> tuple:
     path: the interpreter reads Go sources, CRD YAML, and go.mod, all
     of which live under the project tree."""
     out = []
+    # walk-produced paths always extend the spelled root, so the
+    # relative path is a slice — os.path.relpath's abspath/normpath
+    # round trip per file is pure overhead on this hot loop
+    prefix = root if root.endswith(os.sep) else root + os.sep
+    plen = len(prefix)
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
         for name in sorted(filenames):
@@ -250,8 +263,18 @@ def tree_state(root: str) -> tuple:
             if not os.path.isfile(path):
                 continue
             sha = file_sha_stat(path)
-            out.append((os.path.relpath(path, root).replace(os.sep, "/"),
-                        sha))
+            rel = (path[plen:] if path.startswith(prefix)
+                   else os.path.relpath(path, root))
+            out.append((rel.replace(os.sep, "/"), sha))
+    # an overlaid file that vanished from disk still contributes its
+    # buffer bytes (the walk found the on-disk ones already, with their
+    # overlay shas via file_sha_stat)
+    seen = {rel for rel, _sha in out}
+    extra = [
+        (os.path.relpath(path, root).replace(os.sep, "/"), sha)
+        for path, sha in pf_overlay.paths_under(root)
+    ]
+    out.extend(sorted(e for e in extra if e[0] not in seen))
     return tuple(out)
 
 
@@ -265,14 +288,28 @@ def go_file_state(root: str) -> tuple:
     gomod = os.path.join(root, "go.mod")
     if os.path.isfile(gomod):
         out.append(("go.mod", file_sha_stat(gomod)))
+    prefix = root if root.endswith(os.sep) else root + os.sep
+    plen = len(prefix)
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = prune_go_dirs(dirnames)
         for name in sorted(filenames):
             if not name.endswith(".go") or name.startswith(("_", ".")):
                 continue
             path = os.path.join(dirpath, name)
-            out.append((os.path.relpath(path, root).replace(os.sep, "/"),
-                        file_sha_stat(path)))
+            rel = (path[plen:] if path.startswith(prefix)
+                   else os.path.relpath(path, root))
+            out.append((rel.replace(os.sep, "/"), file_sha_stat(path)))
+    # vanished-but-overlaid Go files keep contributing their bytes
+    seen = {rel for rel, _sha in out}
+    for path, sha in pf_overlay.paths_under(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        name = os.path.basename(path)
+        if rel in seen:
+            continue
+        if rel == "go.mod" or (
+            name.endswith(".go") and not name.startswith(("_", "."))
+        ):
+            out.append((rel, sha))
     return tuple(sorted(out))
 
 
@@ -363,14 +400,18 @@ def check_key(root: str, files=None, **flags) -> str:
                 sorted(flags.items()))
 
 
-def analyze_key(root: str, analyzers: tuple) -> str:
+def analyze_key(root: str, analyzers: tuple, state: tuple | None = None):
     """Cache key for one analyzer-driver run: the Go surface's file-hash
     set (diagnostics are a pure function of pruned .go bytes + go.mod)
     plus the selected analyzer names in run order.  The root — spelled
     and resolved — is part of the key because diagnostics embed
-    caller-spelled paths."""
+    caller-spelled paths.  ``state`` lets a caller that already walked
+    the Go surface (:func:`go_file_state`) pass it along instead of
+    paying a second walk."""
+    if state is None:
+        state = go_file_state(root)
     return _key("analyze", root, os.path.abspath(root),
-                go_file_state(root), tuple(analyzers))
+                state, tuple(analyzers))
 
 
 def analyze_get(key: str):

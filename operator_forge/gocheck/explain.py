@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 
+from ..perf import overlay as pf_overlay
 from .gopkg import ProjectRuntime
 from .structural import parse_imports, prune_go_dirs
 
@@ -49,6 +50,11 @@ _file_imports_memo: dict = {}
 
 
 def _imports_of(path: str, mtime_ns: int, size: int):
+    overlay_text = pf_overlay.get(path)
+    if overlay_text is not None:
+        # overlay bytes bypass the (mtime, size) memo: the disk stat no
+        # longer describes the content the checks will actually read
+        return tuple(p for _alias, p in parse_imports(overlay_text))
     key = (mtime_ns, size)
     hit = _file_imports_memo.get(path)
     if hit is not None and hit[0] == key:

@@ -1825,9 +1825,10 @@ class _OsModule:
     def ReadFile(path):
         import os as _os
 
+        from ..perf import overlay as pf_overlay
+
         try:
-            with open(path, "rb") as fh:
-                return (fh.read(), None)
+            return (pf_overlay.read_bytes(path), None)
         except OSError as exc:
             return (None, GoError(
                 f"open {path}: {_os.strerror(exc.errno) if exc.errno else exc}"
@@ -2965,14 +2966,15 @@ class Interp:
     def load_dir(self, pkg_dir: str) -> None:
         import os
 
+        from ..perf import overlay as pf_overlay
+
         for name in sorted(os.listdir(pkg_dir)):
             if not name.endswith(".go") or name.endswith("_test.go"):
                 continue
-            with open(os.path.join(pkg_dir, name), encoding="utf-8") as fh:
-                self.load_source(
-                    fh.read(), os.path.join(pkg_dir, name),
-                    defer_values=True,
-                )
+            path = os.path.join(pkg_dir, name)
+            self.load_source(
+                pf_overlay.read_text(path), path, defer_values=True,
+            )
         self.eval_pending_values()
         self.run_inits()
 

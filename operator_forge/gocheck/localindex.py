@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from ..perf import overlay as pf_overlay
 from .kinds import check_call_kinds, param_kind_of
 from .structural import parse_imports, prune_go_dirs
 from .tokens import IDENT, KEYWORD, OP, STRING, GoTokenError, Token, tokenize
@@ -579,14 +580,18 @@ class ProjectIndex:
     def _build(self) -> None:
         if self.module is None:
             return  # no go.mod: nothing to index
-        for dirpath, dirnames, filenames in os.walk(self.root):
+        root = self.root
+        prefix = root if root.endswith(os.sep) else root + os.sep
+        plen = len(prefix)
+        for dirpath, dirnames, filenames in os.walk(root):
             dirnames[:] = prune_go_dirs(dirnames)
             for name in sorted(filenames):
                 if not name.endswith(".go") or name.startswith(("_", ".")):
                     continue
                 path = os.path.join(dirpath, name)
-                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
-                self._scan_file(rel, path)
+                rel = (path[plen:] if path.startswith(prefix)
+                       else os.path.relpath(path, root))
+                self._scan_file(rel.replace(os.sep, "/"), path)
         self._derive()
 
     @property
@@ -605,8 +610,7 @@ class ProjectIndex:
         import hashlib
 
         try:
-            with open(path, encoding="utf-8") as fh:
-                text = fh.read()
+            text = pf_overlay.read_text(path)
             scan = _FileScan(path, text)
             # content hash alongside the scan: the per-scan caches
             # (localcalls, load surfaces) key on it
@@ -1332,11 +1336,10 @@ def _check_call(idx, scan, own, env, parts, nargs, spread,
 def _read_module_path(root: str) -> str | None:
     gomod = os.path.join(root, "go.mod")
     try:
-        with open(gomod, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if line.startswith("module "):
-                    return line.split()[1]
+        for line in pf_overlay.read_text(gomod).splitlines():
+            line = line.strip()
+            if line.startswith("module "):
+                return line.split()[1]
     except OSError:
         return None
     return None

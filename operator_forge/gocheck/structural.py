@@ -18,6 +18,7 @@ import re
 from collections import defaultdict
 from functools import lru_cache
 
+from ..perf import overlay as pf_overlay
 from .tokens import KEYWORDS as _GO_KEYWORDS
 
 _IMPORT_BLOCK_RE = re.compile(r"import\s*\(\s*\n(.*?)\n\)", re.DOTALL)
@@ -231,8 +232,7 @@ def _load_packages(root: str) -> tuple[dict, list[str]]:
                 continue
             path = os.path.join(dirpath, f)
             try:
-                with open(path, "r", encoding="utf-8") as fh:
-                    text = fh.read()
+                text = pf_overlay.read_text(path)
             except (OSError, UnicodeDecodeError):
                 continue  # the parse pass reports unreadable files
             clean = strip_strings_and_comments(text)
@@ -267,8 +267,8 @@ def package_toplevel_decls(package_dir: str) -> set[str]:
     for f in os.listdir(package_dir):
         if not f.endswith(".go") or f.startswith(("_", ".")):
             continue
-        with open(os.path.join(package_dir, f), "r", encoding="utf-8") as fh:
-            cleans.append(strip_strings_and_comments(fh.read()))
+        text = pf_overlay.read_text(os.path.join(package_dir, f))
+        cleans.append(strip_strings_and_comments(text))
     return _toplevel_decls(cleans)
 
 
@@ -304,8 +304,7 @@ def check_unresolved_qualifiers(package_dir: str) -> list[str]:
         if not f.endswith(".go") or f.startswith(("_", ".")):
             continue
         path = os.path.join(package_dir, f)
-        with open(path, "r", encoding="utf-8") as fh:
-            text = fh.read()
+        text = pf_overlay.read_text(path)
         files.append((path, text, strip_strings_and_comments(text)))
     return _unresolved_qualifiers(files, _toplevel_decls([c for _, _, c in files]))
 
@@ -379,8 +378,7 @@ def _dir_structure(dirpath: str, names: list) -> tuple[list, list]:
     for name in names:
         path = os.path.join(dirpath, name)
         try:
-            with open(path, "r", encoding="utf-8") as fh:
-                text = fh.read()
+            text = pf_overlay.read_text(path)
         except (OSError, UnicodeDecodeError):
             continue
         clean = strip_strings_and_comments(text)

@@ -241,8 +241,9 @@ def check_project_value_semantics(root: str) -> list[str]:
             if not name.endswith(".go") or name.endswith("_test.go"):
                 continue
             path = os.path.join(dirpath, name)
-            with open(path, encoding="utf-8") as fh:
-                problems.extend(
-                    check_value_semantics(fh.read(), path)
-                )
+            from ..perf import overlay as pf_overlay
+
+            problems.extend(
+                check_value_semantics(pf_overlay.read_text(path), path)
+            )
     return problems

@@ -36,6 +36,7 @@ import os
 
 import yaml
 
+from ..perf import overlay as pf_overlay
 from . import envtest
 from .gopkg import ProjectRuntime
 from .interp import (
@@ -664,16 +665,16 @@ class EnvtestWorld:
         for fname in sorted(os.listdir(path)):
             if not fname.endswith((".yaml", ".yml")):
                 continue
-            with open(os.path.join(path, fname), encoding="utf-8") as fh:
-                for doc in yaml.safe_load_all(fh.read()):
-                    if isinstance(doc, dict) and doc.get("kind") == (
-                        "CustomResourceDefinition"
-                    ):
-                        kind = ((doc.get("spec") or {}).get("names")
-                                or {}).get("kind")
-                        if kind:
-                            self.installed_kinds.add(kind)
-                            count += 1
+            text = pf_overlay.read_text(os.path.join(path, fname))
+            for doc in yaml.safe_load_all(text):
+                if isinstance(doc, dict) and doc.get("kind") == (
+                    "CustomResourceDefinition"
+                ):
+                    kind = ((doc.get("spec") or {}).get("names")
+                            or {}).get("kind")
+                    if kind:
+                        self.installed_kinds.add(kind)
+                        count += 1
         return count
 
     def start_operator(self):
@@ -683,8 +684,7 @@ class EnvtestWorld:
         and the (cooperative) manager start."""
         interp = self.runtime.ensure_package("<main>")
         path = os.path.join(self.proj, "main.go")
-        with open(path, encoding="utf-8") as fh:
-            interp.load_source(fh.read(), path)
+        interp.load_source(pf_overlay.read_text(path), path)
         self.runtime.register_types("<main>")
         interp.run_inits()
         interp.call("main")
@@ -882,8 +882,7 @@ class EmittedSuite:
             if not fname.endswith("_test.go"):
                 continue
             path = os.path.join(world.pkg_dir, fname)
-            with open(path, encoding="utf-8") as fh:
-                self.interp.load_source(fh.read(), path)
+            self.interp.load_source(pf_overlay.read_text(path), path)
         world.runtime.register_types(rel)
         self.interp.run_inits()  # test-file init funcs run at import too
         self.test_names = [

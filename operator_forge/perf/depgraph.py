@@ -234,6 +234,28 @@ class DepGraph:
             self.read(dep_key, dep_sig)
         return value
 
+    def peek(self, namespace: str, key: tuple, current_sig_of):
+        """The in-memory hit path of :meth:`memo` alone: the node's
+        value when it is present and validates, else
+        :data:`~operator_forge.perf.cache.MISS` — no build, no
+        persistent-cache consultation, no cause recording.  A caller
+        with many candidate keys (the per-file analysis sweep) probes
+        them serially and fans out only the misses, so a warm replay
+        never pays thread-pool scheduling for pure table lookups.  A
+        hit performs exactly :meth:`memo`'s hit bookkeeping, so the
+        reuse counters cannot tell the two paths apart."""
+        if pf_cache.get_cache().mode() == "off":
+            return pf_cache.MISS
+        with self._lock:
+            node = self._nodes.get(key)
+        if node is None:
+            return pf_cache.MISS
+        if self._first_stale(node.deps, current_sig_of) is not None:
+            return pf_cache.MISS
+        self.count("reused")
+        pf_cache.get_cache()._count(namespace, "hits")
+        return self._replay(node.value, node.deps)
+
     # -- the one-stop memoization entry point ----------------------------
 
     def memo(self, namespace: str, key: tuple, current_sig_of, build,

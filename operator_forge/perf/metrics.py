@@ -491,16 +491,45 @@ def tier_report() -> dict:
     return out
 
 
+def editor_report() -> dict:
+    """The editor-loop surface (PR 17) in stable key order: live
+    overlay count, overlay registrations, supersede counts (queued vs
+    in-flight), and the push-diagnostics cycle latency summary.  Lazy
+    like :func:`tier_report`: a process that never imported the overlay
+    store reports zeros without importing it here."""
+    import sys
+
+    overlay = sys.modules.get("operator_forge.perf.overlay")
+    counts = counters_snapshot()
+    with _lock:
+        push = _histograms.get("editor.push_cycle.seconds")
+    push_summary = push.summary() if push is not None else None
+    return {
+        "overlays": overlay.count() if overlay is not None else 0,
+        "overlay_sets": counts.get("editor.overlay_sets", 0),
+        "boost_delays": counts.get("editor.boost_delays", 0),
+        "push_cycles": push_summary["count"] if push_summary else 0,
+        "push_p50": push_summary["p50"] if push_summary else None,
+        "push_p99": push_summary["p99"] if push_summary else None,
+        "superseded": counts.get("editor.superseded", 0),
+        "superseded_inflight": counts.get(
+            "editor.superseded_inflight", 0
+        ),
+    }
+
+
 def report() -> dict:
     """The whole observability surface in one stable-ordered document:
-    cache attribution, graph counters, the metrics registry, the
-    execution-tier ladder, and the span table (the serve ``stats`` op
-    and ``operator-forge stats`` both render this)."""
+    cache attribution, the editor-loop surface, graph counters, the
+    metrics registry, the execution-tier ladder, and the span table
+    (the serve ``stats`` op and ``operator-forge stats`` both render
+    this)."""
     from . import spans
     from .depgraph import GRAPH
 
     out = {
         "cache": cache_report(),
+        "editor": editor_report(),
         "graph": GRAPH.counters(),
         "metrics": snapshot(),
         "slo": slo_report(),

@@ -1,0 +1,291 @@
+"""In-memory buffer overlays — the editor's unsaved bytes (PR 17).
+
+An *overlay* maps an absolute file path to the content an editor holds
+in a dirty buffer.  While registered, the whole checking path behaves
+exactly as if the file had those bytes on disk: the content-addressed
+cache keys (:func:`operator_forge.gocheck.cache.file_sha_stat`,
+``tree_state``, ``go_file_state``), the dependency-graph source nodes,
+and every gocheck read site resolve through the overlay first — so a
+vet of unsaved content is byte-identical to a save-then-vet of the same
+bytes, and the replay/identity contract survives without the overlay
+ever touching the filesystem.
+
+Design constraints:
+
+- **zero cost when unused** — the hot paths (``file_sha_stat`` on a
+  10k-file tree, every source read) probe :func:`get`/:func:`sha`,
+  which bail on a plain truthiness check of the store before taking
+  any lock;
+- **session-scoped** — the daemon registers overlays under the owning
+  session's id and clears them when the session closes, so one
+  editor's unsaved buffers can never leak into another client's view
+  of the tree after it disconnects;
+- **push wakeups** — every mutation bumps a generation counter and
+  notifies a condition; the ``subscribe`` op's poll waits on it, so an
+  overlay edit wakes the push-diagnostics loop immediately instead of
+  waiting out the watch interval;
+- **worker shipping** — :func:`snapshot_for_shipping` / :func:`adopt`
+  move the store into process-pool workers per task (the
+  ``perf.workers`` config channel), so the thread/process identity
+  matrix holds with overlays active.
+
+Overlays target *existing paths* (registering one for a path that does
+not exist on disk is a ``bad_request`` at the protocol layer); a file
+that vanishes after registration still contributes its overlay bytes to
+``tree_state``/``go_file_state`` so the content keys stay coherent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+_cond = threading.Condition()
+#: abspath -> (text, sha, version, owner)
+_overlays: dict = {}
+#: basenames of every overlaid path — the pre-normalization probe: a
+#: query whose final component is a plain name that matches no overlay
+#: basename cannot be overlaid under any spelling, so the hot lookup
+#: (``file_sha_stat`` on every walked file) skips ``os.path.abspath``
+_names: set = set()
+_gen = [0]
+_next_version = [0]
+
+
+def _norm(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def _refresh_names_locked() -> None:
+    _names.clear()
+    _names.update(p.rsplit(os.sep, 1)[-1] for p in _overlays)
+
+
+def _maybe(path: str) -> bool:
+    """Whether *path* could name an overlaid file without normalizing
+    it.  Only a plain final component proves a negative — ``""``,
+    ``"."`` and ``".."`` tails change under abspath, so they fall
+    through to the normalized lookup."""
+    tail = path.rsplit(os.sep, 1)[-1]
+    return tail in _names or tail in ("", ".", "..")
+
+
+def _bump_locked() -> None:
+    _gen[0] += 1
+    _cond.notify_all()
+
+
+def _invalidate(path: str) -> int:
+    """Sweep the dependency graph for an overlay mutation: the file's
+    source node (keyed the way the per-file analysis nodes record
+    their edges — the absolute path the driver's walk produced)."""
+    from .depgraph import GRAPH
+
+    return GRAPH.invalidate([("src", path)])
+
+
+def set_overlay(path: str, text: str, owner=None) -> dict:
+    """Register (or replace) the overlay for *path*; returns
+    ``{"version", "sha", "dirtied", "overlays"}``.  Invalidation runs
+    outside the store lock (the graph has its own)."""
+    path = _norm(path)
+    sha = hashlib.sha256(text.encode("utf-8")).hexdigest()
+    with _cond:
+        _next_version[0] += 1
+        version = _next_version[0]
+        _overlays[path] = (text, sha, version, owner)
+        _names.add(path.rsplit(os.sep, 1)[-1])
+        count = len(_overlays)
+        _bump_locked()
+    dirtied = _invalidate(path)
+    return {
+        "version": version, "sha": sha,
+        "dirtied": dirtied, "overlays": count,
+    }
+
+
+def clear_overlay(path: str) -> bool:
+    """Drop the overlay for *path* (the gopls didClose analogue); the
+    next read sees the disk bytes again.  Returns whether one was
+    registered."""
+    path = _norm(path)
+    with _cond:
+        existed = _overlays.pop(path, None) is not None
+        if existed:
+            _refresh_names_locked()
+            _bump_locked()
+    if existed:
+        _invalidate(path)
+    return existed
+
+
+def clear_owner(owner) -> list:
+    """Drop every overlay registered under *owner* (a daemon session
+    closing) and invalidate each path; returns the cleared paths."""
+    with _cond:
+        cleared = [
+            path for path, entry in _overlays.items()
+            if entry[3] == owner
+        ]
+        for path in cleared:
+            del _overlays[path]
+        if cleared:
+            _refresh_names_locked()
+            _bump_locked()
+    for path in cleared:
+        _invalidate(path)
+    return cleared
+
+
+def get(path: str):
+    """Overlay text for *path*, or ``None``.  The no-overlay fast path
+    is one truthiness check — no lock, no normalization — and with
+    overlays registered, a basename probe rules out the common miss
+    before paying ``os.path.abspath``."""
+    if not _overlays:
+        return None
+    entry = _overlays.get(path)
+    if entry is None:
+        if not _maybe(path):
+            return None
+        entry = _overlays.get(_norm(path))
+    return None if entry is None else entry[0]
+
+
+def sha(path: str):
+    """Overlay content sha for *path*, or ``None`` (same fast path as
+    :func:`get`)."""
+    if not _overlays:
+        return None
+    entry = _overlays.get(path)
+    if entry is None:
+        if not _maybe(path):
+            return None
+        entry = _overlays.get(_norm(path))
+    return None if entry is None else entry[1]
+
+
+def count() -> int:
+    return len(_overlays)
+
+
+def owned(owner) -> int:
+    """How many overlays *owner* holds — the daemon's interactive-
+    session test (a session with live overlays is an editor, and its
+    vets get dispatch priority)."""
+    if not _overlays:
+        return 0
+    with _cond:
+        return sum(1 for e in _overlays.values() if e[3] == owner)
+
+
+def paths_under(root: str) -> list:
+    """Sorted ``(abspath, sha)`` of overlays inside *root* — merged
+    into ``tree_state``/``go_file_state`` so an overlaid file that
+    vanished from disk still contributes its bytes to content keys."""
+    if not _overlays:
+        return []
+    root = _norm(root)
+    prefix = root + os.sep
+    with _cond:
+        return sorted(
+            (path, entry[1]) for path, entry in _overlays.items()
+            if path == root or path.startswith(prefix)
+        )
+
+
+def signatures_under(root: str) -> dict:
+    """``{relpath: ("overlay", version)}`` for overlays inside *root*
+    — merged into the watch/subscribe snapshot so an overlay edit (or
+    clear) reads as a tree change and triggers the minimal re-run."""
+    if not _overlays:
+        return {}
+    root = _norm(root)
+    prefix = root + os.sep
+    out: dict = {}
+    with _cond:
+        for path, entry in _overlays.items():
+            if path == root or path.startswith(prefix):
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                out[rel] = ("overlay", entry[2])
+    return out
+
+
+def generation() -> int:
+    """Monotonic mutation counter — the subscribe wakeup's edge."""
+    return _gen[0]
+
+
+def wait_change(seen: int, timeout: float) -> int:
+    """Block until the generation moves past *seen* (an overlay was
+    set or cleared) or *timeout* elapses; returns the current
+    generation either way."""
+    with _cond:
+        if _gen[0] == seen:
+            _cond.wait(timeout)
+        return _gen[0]
+
+
+def read_text(path: str, encoding: str = "utf-8", errors=None) -> str:
+    """Overlay-aware file read: the overlay's text when one is
+    registered, the disk bytes otherwise (raising exactly like
+    ``open`` on a missing/unreadable file)."""
+    text = get(path)
+    if text is not None:
+        return text
+    with open(path, encoding=encoding, errors=errors) as fh:
+        return fh.read()
+
+
+def read_bytes(path: str) -> bytes:
+    """Overlay-aware binary read (the interpreted ``os.ReadFile``)."""
+    text = get(path)
+    if text is not None:
+        return text.encode("utf-8")
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def snapshot_for_shipping():
+    """``{path: text}`` for the workers config channel, or ``None``
+    when the store is empty (so an overlay-free task ships nothing and
+    the worker pays nothing)."""
+    if not _overlays:
+        return None
+    with _cond:
+        return {path: entry[0] for path, entry in _overlays.items()}
+
+
+def adopt(mapping) -> None:
+    """Replace the store wholesale (process-pool worker side of
+    :func:`snapshot_for_shipping`); owners are not shipped — a worker's
+    overlays live exactly one task."""
+    with _cond:
+        changed = (
+            {p: e[0] for p, e in _overlays.items()} != dict(mapping or {})
+        )
+        if not changed:
+            return
+        _overlays.clear()
+        for path, text in (mapping or {}).items():
+            _next_version[0] += 1
+            sha_ = hashlib.sha256(text.encode("utf-8")).hexdigest()
+            _overlays[_norm(path)] = (
+                text, sha_, _next_version[0], None,
+            )
+        _refresh_names_locked()
+        _bump_locked()
+
+
+def clear_all() -> list:
+    """Drop every overlay (tests, teardown); returns cleared paths."""
+    with _cond:
+        cleared = list(_overlays)
+        _overlays.clear()
+        _names.clear()
+        if cleared:
+            _bump_locked()
+    for path in cleared:
+        _invalidate(path)
+    return cleared

@@ -255,6 +255,11 @@ def _task_config() -> dict:
         "faults": faults.forced_spec(),
         # the programmatic remote-cache address override, same reason
         "remote": _remote_forced(),
+        # live buffer overlays (PR 17): a process worker must see the
+        # same unsaved bytes the parent's content keys were computed
+        # from, or the thread/process identity matrix would split.
+        # None (no store loaded / store empty) ships nothing
+        "overlays": _overlay_snapshot(),
         "gen": _reset_gen[0],
     }
 
@@ -273,6 +278,17 @@ def _remote_forced():
 
     remote = sys.modules.get("operator_forge.perf.remote")
     return remote._forced_addr if remote is not None else None
+
+
+def _overlay_snapshot():
+    # lazy: the overlay store only matters once the editor tier (or a
+    # test) has loaded it — a batch-only process pays nothing
+    import sys
+
+    overlay = sys.modules.get("operator_forge.perf.overlay")
+    return (
+        overlay.snapshot_for_shipping() if overlay is not None else None
+    )
 
 
 def _apply_config(cfg: dict) -> None:
@@ -320,6 +336,19 @@ def _apply_config(cfg: dict) -> None:
         from . import remote
 
         remote.configure(cfg["remote"])
+    overlays = cfg.get("overlays")
+    if overlays:
+        from . import overlay
+
+        overlay.adopt(overlays)
+    else:
+        # clear any previous task's overlays without importing the
+        # store into a worker that never saw one
+        import sys as _sys
+
+        overlay = _sys.modules.get("operator_forge.perf.overlay")
+        if overlay is not None and overlay.count():
+            overlay.adopt({})
     if cfg["gen"] != _worker_seen_gen[0]:
         _worker_seen_gen[0] = cfg["gen"]
         pf_cache.reset()

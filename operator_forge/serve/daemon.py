@@ -61,11 +61,17 @@ import time
 
 from ..perf import cache as pf_cache
 from ..perf import env_number, flight, metrics, n_jobs, spans
+from ..perf import overlay as pf_overlay
 from ..perf.remote import parse_listen
 from . import runner
 from . import server
 from .batch import _overlaps
-from .jobs import BatchManifestError, jobs_from_specs, specs_from_request
+from .jobs import (
+    BatchManifestError,
+    jobs_from_specs,
+    specs_from_request,
+    supersede_key,
+)
 from .server import dispatch_request, request_timeout
 from .session import CONNECT_RETRY_AFTER_S, Session
 
@@ -120,6 +126,45 @@ def idle_gc_interval() -> float:
         "OPERATOR_FORGE_DAEMON_IDLE_GC_S", DEFAULT_IDLE_GC_S,
         minimum=None,
     )
+
+
+def supersede_enabled() -> bool:
+    """Whether the editor-loop supersede path is on
+    (``OPERATOR_FORGE_DAEMON_SUPERSEDE``; default on — set ``0``/
+    ``off``/``false`` to disable, which is also how bench measures the
+    no-supersede counterfactual)."""
+    value = os.environ.get(
+        "OPERATOR_FORGE_DAEMON_SUPERSEDE", ""
+    ).strip().lower()
+    return value not in ("0", "off", "false", "no")
+
+
+def editor_boost_enabled() -> bool:
+    """Whether interactive requests get dispatch priority
+    (``OPERATOR_FORGE_DAEMON_EDITOR_BOOST``; default on — set ``0``/
+    ``off``/``false`` to disable).  With the boost on, dispatchers
+    defer *starting* new batch work while an editor-tier request is in
+    flight; batch work already running finishes normally, so an
+    edit-one-file re-vet executes nearly uncontended instead of
+    timesharing with every background batch client."""
+    value = os.environ.get(
+        "OPERATOR_FORGE_DAEMON_EDITOR_BOOST", ""
+    ).strip().lower()
+    return value not in ("0", "off", "false", "no")
+
+
+def _interactive_request(req: dict, session) -> bool:
+    """Whether *req* rides the editor tier: the ``overlay`` op itself,
+    or short-lived work (a job) issued by a session that holds live
+    overlays.  Long-running ops (watch/subscribe/batch) never count —
+    marking a forever-subscription interactive would pause batch
+    dispatch for the life of the subscription."""
+    op = req.get("op") or ("job" if "command" in req else None)
+    if op == "overlay":
+        return True
+    if op != "job":
+        return False
+    return pf_overlay.owned(session.id) > 0
 
 
 def lock_timeout() -> float:
@@ -177,6 +222,14 @@ def _request_roots(req: dict, base_dir: str) -> tuple:
     return tuple(reads), tuple(writes)
 
 
+def _trie_node() -> dict:
+    """One path-trie node: children by path component, plus four
+    counts — readers/writers whose held root ends exactly here
+    (``sr``/``sw``) and readers/writers anywhere in this subtree,
+    self included (``tr``/``tw``)."""
+    return {"c": {}, "sr": 0, "sw": 0, "tr": 0, "tw": 0}
+
+
 class _PathLocks:
     """All-or-nothing read/write locks over directory roots (nested
     dirs overlap, like the batch scheduler's conflict rule): writers
@@ -185,13 +238,93 @@ class _PathLocks:
     requests can never deadlock holding halves of each other's roots,
     and BOUNDED: a conflict that does not clear within the timeout
     returns ``None`` so the caller answers ``busy`` instead of parking
-    a dispatcher thread forever behind a long-lived holder."""
+    a dispatcher thread forever behind a long-lived holder.
+
+    Conflict detection is a component-wise path TRIE (PR 17): the old
+    linear sweep compared every held root against every requested root
+    on every acquire attempt — O(held × requested × path length), and
+    every blocked waiter re-runs it on each 0.25s poll, so a busy
+    daemon (hundreds of held roots at monorepo scale) paid a
+    super-linear admission cost (ROADMAP item 4's suspect, confirmed
+    by bench's ``editor.path_locks`` before/after probe).  The trie
+    answers one root's conflict in O(path components): a held WRITE on
+    any proper ancestor conflicts (``sw``), a held read on an ancestor
+    conflicts with a write request (``sr``), and the requested root's
+    own node aggregates everything held at-or-below it (``tw``/``tr``).
+    Component-boundary semantics are exactly the linear sweep's
+    :func:`~operator_forge.serve.batch._overlaps` rule —
+    :meth:`_conflicts_linear` is kept as the executable reference
+    (tests assert equivalence on randomized root sets; bench times
+    both)."""
 
     def __init__(self):
         self._cond = threading.Condition()
         self._held: list = []  # (root, is_write)
+        self._trie = _trie_node()
+
+    @staticmethod
+    def _parts(root: str) -> list:
+        # no empty-component filtering: "/" splits to ['', ''] and
+        # "/x" to ['', 'x'], which diverge at depth 1 — matching
+        # _overlaps("/", "/x") == False exactly
+        return root.split(os.sep)
+
+    def _trie_add(self, root: str, is_write: bool) -> None:
+        sub = "tw" if is_write else "tr"
+        node = self._trie
+        node[sub] += 1
+        for part in self._parts(root):
+            child = node["c"].get(part)
+            if child is None:
+                child = node["c"][part] = _trie_node()
+            child[sub] += 1
+            node = child
+        node["sw" if is_write else "sr"] += 1
+
+    def _trie_remove(self, root: str, is_write: bool) -> None:
+        sub = "tw" if is_write else "tr"
+        node = self._trie
+        node[sub] -= 1
+        chain = []
+        for part in self._parts(root):
+            chain.append((node, part))
+            node = node["c"][part]
+            node[sub] -= 1
+        node["sw" if is_write else "sr"] -= 1
+        # prune empty branches so a long-lived daemon's trie tracks the
+        # live held set, not every root ever locked
+        for parent, part in reversed(chain):
+            child = parent["c"][part]
+            if child["c"] or child["tr"] or child["tw"]:
+                break
+            del parent["c"][part]
+
+    def _conflict_one(self, root: str, is_write: bool) -> bool:
+        node = self._trie
+        for part in self._parts(root):
+            # node covers a PROPER prefix of root here: any held
+            # writer there excludes us; a held reader excludes writes
+            if node["sw"] or (is_write and node["sr"]):
+                return True
+            node = node["c"].get(part)
+            if node is None:
+                return False  # no held root shares this prefix
+        # root's own node: everything held at-or-below overlaps
+        return bool(node["tw"] or (is_write and node["tr"]))
 
     def _conflicts(self, reads, writes) -> bool:
+        for w in writes:
+            if self._conflict_one(w, True):
+                return True
+        for r in reads:
+            if self._conflict_one(r, False):
+                return True
+        return False
+
+    def _conflicts_linear(self, reads, writes) -> bool:
+        """The pre-trie reference sweep — kept for the equivalence
+        tests and bench's before/after note, not called on the hot
+        path."""
         for root, held_write in self._held:
             for w in writes:
                 if _overlaps(root, w):
@@ -226,8 +359,10 @@ class _PathLocks:
                 self._cond.wait(wait)
             for root in reads:
                 self._held.append((root, False))
+                self._trie_add(root, False)
             for root in writes:
                 self._held.append((root, True))
+                self._trie_add(root, True)
         return (reads, writes)
 
     def release(self, token) -> None:
@@ -237,8 +372,10 @@ class _PathLocks:
         with self._cond:
             for root in reads:
                 self._held.remove((root, False))
+                self._trie_remove(root, False)
             for root in writes:
                 self._held.remove((root, True))
+                self._trie_remove(root, True)
             self._cond.notify_all()
 
 
@@ -271,6 +408,9 @@ class ForgeDaemon:
         self._sessions: list = []
         self._queued = 0  # global pending count, guarded by _cond
         self._rr = 0      # round-robin cursor, guarded by _cond
+        # editor-tier requests in flight, guarded by _cond: while
+        # nonzero, dispatchers defer starting new batch work
+        self._interactive = 0
         self._next_sid = 0
         self._locks = _PathLocks()
         self._stop_lock = threading.Lock()
@@ -439,8 +579,47 @@ class ForgeDaemon:
     # -- admission (reader threads) --------------------------------------
 
     def _enqueue(self, session: Session, req: dict) -> None:
+        if req.get("op") == "overlay":
+            # session-scope the overlay: the daemon stamps ownership
+            # (overwriting anything the client claimed) so the store
+            # can be cleared when THIS session closes
+            req["_owner"] = session.id
+        key = (
+            supersede_key(req, self.base_dir)
+            if supersede_enabled() else None
+        )
         rejected = None
+        stale: list = []
         with self._cond:
+            if key is not None:
+                # supersede-in-queue: a newer request for the same
+                # buffer makes every queued older sibling stale —
+                # remove them BEFORE the admission checks, so an
+                # editor typing fast recycles its own queue slots
+                # instead of tripping the busy backpressure
+                kept = []
+                for entry in session.queue:
+                    if supersede_key(
+                        entry[0], self.base_dir
+                    ) == key:
+                        stale.append(entry[0])
+                    else:
+                        kept.append(entry)
+                if stale:
+                    session.queue[:] = kept
+                    self._queued -= len(stale)
+                if (
+                    key[0] != "overlay"
+                    and session.busy
+                    and session.current_key == key
+                    and session.current_superseded is not None
+                ):
+                    # the in-flight request is the same buffer's older
+                    # vet: wake the dispatcher's sliced join so it
+                    # answers `superseded` instead of running stale
+                    # work to completion (overlay writes are never
+                    # abandoned mid-application)
+                    session.current_superseded.set()
             if server.draining():
                 rejected = "daemon is draining"
             elif len(session.queue) >= session_queue_depth():
@@ -458,6 +637,14 @@ class ForgeDaemon:
                 self._queued += 1
                 metrics.counter("daemon.requests").inc()
                 self._cond.notify()
+        for old_req in stale:
+            # a queued-then-superseded request never dispatched: no
+            # SLO charge, and its trace shipping bucket (if the traced
+            # client pre-created one) is freed — nobody will answer it
+            tctx = spans.parse_trace_field(old_req)
+            if tctx is not None:
+                spans.drain_trace(tctx[0])
+            session.reject_superseded(old_req)
         if rejected is not None:
             session.reject_busy(req, rejected)
 
@@ -490,6 +677,9 @@ class ForgeDaemon:
                 f"daemon.session.{session.id}.queue_depth"
             )
             metrics.counter("daemon.sessions_closed").inc()
+            # a disconnected editor's unsaved buffers must not leak
+            # into other clients' view of the tree
+            pf_overlay.clear_owner(session.id)
             session.close()
 
     # -- the fair scheduler ----------------------------------------------
@@ -520,6 +710,28 @@ class ForgeDaemon:
                 # re-checked on a timer as the backstop
                 self._cond.wait(0.5)
 
+    def _yield_to_editor(self, session) -> None:
+        """Park a batch dispatch while editor-tier work is in flight.
+        Bounded (1s total) so a slow interactive request degrades batch
+        latency instead of starving it; progress is guaranteed because
+        the wait condition is strictly ``_interactive > 0`` and every
+        increment is paired with a ``finally`` decrement."""
+        deadline = time.monotonic() + 1.0
+        waited = False
+        with self._cond:
+            while (
+                self._interactive > 0
+                and not self._stop_event.is_set()
+                and not session.dead.is_set()
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                waited = True
+                self._cond.wait(min(0.05, remaining))
+        if waited:
+            metrics.counter("editor.boost_delays").inc()
+
     def _dispatch_loop(self) -> None:
         while True:
             work = self._next_work()
@@ -533,6 +745,29 @@ class ForgeDaemon:
             session.current_abandoned = abandoned
             if session.dead.is_set():
                 abandoned.set()
+            # in-flight supersede identity: published under the
+            # scheduler lock so the reader thread's admission path can
+            # match a newer same-buffer request against it.  Overlay
+            # writes are queue-supersede only (never abandoned once
+            # they may have started mutating the store)
+            key = (
+                supersede_key(req, self.base_dir)
+                if supersede_enabled() else None
+            )
+            superseded = None
+            if key is not None and key[0] != "overlay":
+                superseded = threading.Event()
+                with self._cond:
+                    session.current_key = key
+                    session.current_superseded = superseded
+            interactive = False
+            if editor_boost_enabled():
+                interactive = _interactive_request(req, session)
+                if interactive:
+                    with self._cond:
+                        self._interactive += 1
+                else:
+                    self._yield_to_editor(session)
             keep_going = True
             try:
                 if abandoned.is_set():
@@ -572,10 +807,15 @@ class ForgeDaemon:
                                 lambda _t=token:
                                 self._locks.release(_t)
                             ),
+                            superseded=superseded,
                         )
             finally:
                 session.current_abandoned = None
                 with self._cond:
+                    if interactive:
+                        self._interactive -= 1
+                    session.current_key = None
+                    session.current_superseded = None
                     session.busy = False
                     session.requests_total += 1
                     self._cond.notify_all()
@@ -717,6 +957,7 @@ class ForgeDaemon:
             metrics.unregister_gauge(
                 f"daemon.session.{session.id}.queue_depth"
             )
+            pf_overlay.clear_owner(session.id)
             session.close()
         thread = self._accept_thread
         if thread is not None and thread is not current:
@@ -909,7 +1150,7 @@ class DaemonClient:
     #: ops that carry a distributed-trace context when the CLIENT is
     #: tracing — the submissions whose server-side work belongs on the
     #: client's timeline (control ops like ping/heartbeat stay bare)
-    _TRACED_OPS = ("job", "batch", "watch")
+    _TRACED_OPS = ("job", "batch", "watch", "subscribe")
 
     def _attach_trace(self, payload: dict) -> None:
         """Stamp an outgoing request with this process's trace context

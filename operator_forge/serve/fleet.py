@@ -1197,6 +1197,7 @@ class FleetCoordinator:
                     "fleet.steals", "fleet.suspects",
                 )
             },
+            "editor": metrics.editor_report(),
             "lease_s": self.lease_s(),
             "listen": self.address(),
             "members": {k: members[k] for k in sorted(members)},

@@ -192,9 +192,57 @@ def specs_from_request(req: dict):
                 if k not in ENVELOPE_KEYS
             }
         ]
-    if op in ("batch", "watch"):
+    if op in ("batch", "watch", "subscribe"):
         return req.get("jobs")
     return None
+
+
+def supersede_key(req: dict, base_dir: str):
+    """The coalescing identity of an editor-loop request, or ``None``
+    when the request must never be superseded.
+
+    Two requests from the *same session* with the same key describe the
+    same buffer's state at different instants — only the newest matters,
+    so the daemon answers the older one with the ``superseded`` taxonomy
+    kind instead of burning a dispatcher slot on stale work:
+
+    - ``("overlay", abspath)`` — overlay registrations for one path.
+      Queue-supersede only: an in-flight overlay write has already
+      mutated the store, so it is never abandoned mid-application.
+    - ``("vet", command, abspath, analyzers)`` — a single read-only
+      vet/lint job.  Safe to supersede both queued and in-flight (the
+      work is pure; abandoning it loses nothing but stale diagnostics).
+
+    Everything else — generation jobs, tests, batches, watches, fences
+    — returns ``None``: superseding work with side effects or multiple
+    targets would change observable state.
+    """
+    op = req.get("op") or ("job" if "command" in req else None)
+    if op == "overlay":
+        path = req.get("path")
+        if not isinstance(path, str) or not path:
+            return None
+        return ("overlay", os.path.abspath(_resolve(base_dir, path)))
+    if op != "job":
+        return None
+    specs = specs_from_request(req)
+    if not specs or len(specs) != 1 or not isinstance(specs[0], dict):
+        return None
+    spec = specs[0]
+    command = _ALIASES.get(
+        str(spec.get("command", "")).strip(),
+        str(spec.get("command", "")).strip(),
+    )
+    if command not in ("vet", "lint"):
+        return None
+    path = str(spec.get("path", ""))
+    if not path:
+        return None
+    return (
+        "vet", command,
+        os.path.abspath(_resolve(base_dir, path)),
+        str(spec.get("analyzers", "")),
+    )
 
 
 def jobs_from_specs(specs, base_dir: str) -> list:

@@ -17,10 +17,19 @@ the response, never interleaved with the protocol stream):
 - ``{"op": "watch", "jobs": [<specs...>], "cycles": N}`` — the edit
   loop: run the jobs, then poll their input trees (``interval``
   seconds, default 0.5) and re-run the minimal set on every change.
-  The one *streaming* op: each cycle emits its own response line
+  A *streaming* op: each cycle emits its own response line
   (``"op": "watch"``, per-cycle ``graph`` reuse counts), and a final
   ``{"op": "watch", "done": true, "cycles": N}`` line closes the
   request;
+- ``{"op": "overlay", "path": P, "content": TEXT}`` — register an
+  in-memory buffer overlay (PR 17, the gopls ``didChange`` analogue):
+  until cleared (``"clear": true``) every content key, dependency-graph
+  node, and read site sees TEXT as if the file had those bytes on
+  disk, so a vet of unsaved content is byte-identical to a save+vet;
+- ``{"op": "subscribe", "jobs": [<specs...>]}`` — push diagnostics
+  (the gopls ``publishDiagnostics`` analogue): streams one line per
+  converged minimal re-run, with overlay edits waking the loop
+  immediately; ``cycles`` omitted means "until disconnect/drain";
 - ``{"op": "stats"}`` — per-namespace cache hit/miss counters with
   ratios (stable key order, incl. quarantine footprint and remote-hit
   attribution), the dependency graph's cumulative
@@ -118,11 +127,18 @@ from .runner import run_job
 #: - ``busy`` — admission control rejected the request (a daemon
 #:   session's queue, or the global admission queue, is full); the
 #:   response carries a ``retry_after`` hint in seconds
+#: - ``superseded`` — a newer request from the same session made this
+#:   one stale (an editor's next keystroke for the same buffer): the
+#:   old request is answered without burning a dispatcher slot and the
+#:   client should simply await the newer request's answer.  NOT a
+#:   failure of the server — no SLO deadline miss is charged
 #: - ``timeout`` — the per-request deadline expired
 #: - ``infra`` — the execution substrate failed (dead process pool,
 #:   pickle transport, I/O)
 #: - ``internal`` — an unclassified server-side bug
-ERROR_KINDS = ("bad_request", "busy", "timeout", "infra", "internal")
+ERROR_KINDS = (
+    "bad_request", "busy", "superseded", "timeout", "infra", "internal",
+)
 
 
 class _AbandonedRequest(Exception):
@@ -315,6 +331,7 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
             compiler.flush_counters()  # compile.reused is tallied lazily
         payload = {
             "ok": True, "op": "stats", "cache": metrics.cache_report(),
+            "editor": metrics.editor_report(),
             "graph": GRAPH.counters(),
             "metrics": metrics.snapshot(),
             "provenance": {
@@ -434,6 +451,112 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
         )
         return ({"ok": True, "op": "watch", "done": True,
                  "cycles": ran}, True)
+    if op == "overlay":
+        # the editor's unsaved buffer (gopls didChange analogue): the
+        # registered content flows through every content key and read
+        # site as if the file had those bytes on disk
+        from ..perf import overlay as pf_overlay
+
+        path = req.get("path")
+        if not isinstance(path, str) or not path:
+            return (_error("overlay: path is required", req_id), True)
+        if not os.path.isabs(path):
+            path = os.path.normpath(os.path.join(base_dir, path))
+        if req.get("clear"):
+            cleared = pf_overlay.clear_overlay(path)
+            return ({"ok": True, "op": "overlay", "path": path,
+                     "cleared": cleared,
+                     "overlays": pf_overlay.count()}, True)
+        content = req.get("content")
+        if not isinstance(content, str):
+            return (_error(
+                "overlay: content must be a string "
+                "(or pass \"clear\": true)", req_id), True)
+        if not os.path.isfile(path):
+            # overlays target existing files: an overlay for a path
+            # that is not on disk would make tree walks and content
+            # keys disagree about the project's file set
+            return (_error(
+                f"overlay: {path} does not exist on disk", req_id),
+                True)
+        info = pf_overlay.set_overlay(
+            path, content, owner=req.get("_owner"),
+        )
+        metrics.counter("editor.overlay_sets").inc()
+        return ({"ok": True, "op": "overlay", "path": path,
+                 **info}, True)
+    if op == "subscribe":
+        # push diagnostics (gopls publishDiagnostics analogue): stream
+        # one line per converged minimal re-run, with overlay edits
+        # waking the loop immediately instead of waiting out the poll
+        # interval.  `cycles` is optional — omitted means "until the
+        # client disconnects or the server drains"
+        from ..perf import overlay as pf_overlay
+        from .watch import watch_loop
+
+        jobs = jobs_from_specs(req.get("jobs"), base_dir)
+        cycles = req.get("cycles")
+        if cycles is not None and (
+            not isinstance(cycles, int) or cycles < 1
+        ):
+            return (_error(
+                "subscribe: cycles must be a positive integer",
+                req_id), True)
+        try:
+            interval = float(req.get("interval", 0.5))
+        except (TypeError, ValueError):
+            return (_error("subscribe: interval must be a number",
+                           req_id), True)
+        if not (0 < interval < float("inf")):
+            return (_error(
+                "subscribe: interval must be a positive number",
+                req_id), True)
+
+        def emit_push(payload: dict) -> None:
+            payload["op"] = "subscribe"
+            payload["ok"] = bool(payload["ok"])
+            if req_id is not None:
+                payload["id"] = req_id
+            metrics.histogram("editor.push_cycle.seconds").observe(
+                payload.get("seconds", 0.0)
+            )
+            if emit is not None:
+                emit(payload)
+
+        # the generation edge is captured ONCE, before the first
+        # cycle runs, and only advanced to values wait_change actually
+        # returned: an overlay op landing while a cycle runs (or
+        # between the cycle's emit and the next poll) still reads as
+        # newer-than-seen, so the wake fires on the very next poll
+        # instead of being silently absorbed until the interval expires
+        seen = [pf_overlay.generation()]
+
+        def push_poll() -> bool:
+            # like the watch op's drain-aware poll, but additionally
+            # parked on the overlay generation: a `overlay` op from
+            # any session wakes this immediately, so the next cycle's
+            # diagnostics push the moment the edit lands
+            deadline = time.monotonic() + interval
+            while not _drain.is_set():
+                if abandoned is not None and abandoned.is_set():
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return True
+                cur = pf_overlay.wait_change(
+                    seen[0], min(0.1, remaining)
+                )
+                if cur != seen[0]:
+                    seen[0] = cur
+                    return True
+            return False
+
+        ran = watch_loop(
+            jobs, emit_push, cycles=cycles, interval=interval,
+            poll=push_poll,
+        )
+        return ({"ok": True, "op": "subscribe", "done": True,
+                 "cycles": ran}, True)
     if op == "fence":
         # the fleet coordinator's zombie fence (PR 14): on the daemon
         # transport this request's `roots`+`reset` are write-locked by
@@ -504,7 +627,8 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
 
 def dispatch_request(req: dict, base_dir: str, out_lock,
                      respond_locked, deadline: float,
-                     abandoned=None, on_settled=None) -> bool:
+                     abandoned=None, on_settled=None,
+                     superseded=None) -> bool:
     """Dispatch ONE parsed request through the shared machinery —
     deadline boxing, the error taxonomy, id echo, ``seconds`` stamping,
     streaming-emit abandonment — and answer it via ``respond_locked``
@@ -518,6 +642,16 @@ def dispatch_request(req: dict, base_dir: str, out_lock,
     suppression) cannot drift between them.  ``abandoned`` optionally
     supplies the request's cancellation Event (a daemon session passes
     one it can set when the client disconnects mid-request).
+
+    ``superseded`` (PR 17) optionally supplies an Event a newer
+    same-buffer request sets: the handler is then always deadline-boxed
+    (even with no deadline configured) and, should the event fire while
+    the work is still running, the request is abandoned and answered
+    with the ``superseded`` taxonomy kind — crucially WITHOUT charging
+    an SLO deadline miss or recording a ``request.deadline`` anomaly
+    (stale editor work is not a server failure).  Only passed for
+    requests :func:`operator_forge.serve.jobs.supersede_key` declared
+    in-flight-abandonable (pure read-only vets).
 
     ``on_settled`` is called EXACTLY ONCE when the handler's side
     effects are actually over: on normal completion, on error — or,
@@ -542,7 +676,7 @@ def dispatch_request(req: dict, base_dir: str, out_lock,
     try:
         return _dispatch_inner(
             req, base_dir, out_lock, respond_locked, deadline,
-            abandoned, settle, handed_off,
+            abandoned, settle, handed_off, superseded,
         )
     except _AbandonedRequest:
         # the transport died mid-request (client disconnect): the work
@@ -582,7 +716,8 @@ def _slo_tenants(req: dict, base_dir: str) -> tuple:
 
 
 def _dispatch_inner(req, base_dir, out_lock, respond_locked,
-                    deadline, abandoned, settle, handed_off):
+                    deadline, abandoned, settle, handed_off,
+                    superseded=None):
     op = req.get("op") or ("job" if "command" in req else "?")
     req_id = req.get("id")
     started = time.perf_counter()
@@ -645,7 +780,7 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
                                abandoned=abandoned)
 
     try:
-        if deadline > 0:
+        if deadline > 0 or superseded is not None:
             box: dict = {}
 
             def run_boxed(_box=box, _dispatch=dispatch):
@@ -670,8 +805,42 @@ def _dispatch_inner(req, base_dir, out_lock, respond_locked,
             )
             worker.start()
             handed_off[0] = True
-            worker.join(deadline)
-            if worker.is_alive():
+            # the join is sliced so a supersede lands in ~50ms instead
+            # of waiting out the full deadline (with no supersede Event
+            # the slicing is behaviorally identical to one long join)
+            expires = (
+                time.monotonic() + deadline if deadline > 0 else None
+            )
+            timed_out = False
+            while worker.is_alive():
+                if superseded is not None and superseded.is_set():
+                    # a newer same-buffer request made this one stale:
+                    # abandon it (output suppression, unwind-at-next-
+                    # emit — same mechanism as the deadline) and answer
+                    # with the superseded kind.  NOT a deadline miss:
+                    # no SLO charge, no request.deadline anomaly — the
+                    # server did nothing wrong, the work just aged out
+                    with out_lock:
+                        alive = worker.is_alive()
+                        if alive:
+                            abandoned.set()
+                    if not alive:
+                        break  # finished first: answer the real result
+                    metrics.counter("editor.superseded_inflight").inc()
+                    respond(ship_trace(_error(
+                        "superseded by a newer request for the "
+                        "same buffer", req_id, kind="superseded",
+                    )))
+                    return True
+                slice_s = 0.05
+                if expires is not None:
+                    remaining = expires - time.monotonic()
+                    if remaining <= 0:
+                        timed_out = True
+                        break
+                    slice_s = min(slice_s, remaining)
+                worker.join(slice_s)
+            if timed_out and worker.is_alive():
                 # the handler keeps running detached until its next
                 # emit unwinds it; its response (and any late stream
                 # lines) are dropped.  The flag is set under out_lock

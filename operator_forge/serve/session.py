@@ -91,6 +91,15 @@ class Session:
         #: the in-flight request's abandonment Event (shared with
         #: dispatch_request) so a disconnect can cancel it mid-stream
         self.current_abandoned = None
+        #: the in-flight request's supersede identity
+        #: (:func:`~operator_forge.serve.jobs.supersede_key`) — set by
+        #: the scheduler under the daemon lock so the reader thread's
+        #: admission path can match a newer same-buffer request
+        self.current_key = None
+        #: the in-flight request's supersede Event (observed by the
+        #: dispatcher's sliced join); ``None`` when the current request
+        #: is not in-flight-abandonable
+        self.current_superseded = None
         #: reader thread saw EOF — no further requests will arrive
         self.read_done = False
         self.requests_total = 0
@@ -190,6 +199,23 @@ class Session:
         })
         payload = _error(reason, req.get("id"), kind="busy")
         payload["retry_after"] = RETRY_AFTER_S
+        try:
+            self.respond(payload)
+        except _AbandonedRequest:
+            pass
+
+    def reject_superseded(self, req: dict) -> None:
+        """Answer a queued request a newer same-buffer request just
+        made stale (PR 17): the ``superseded`` taxonomy kind, counted
+        under ``editor.superseded``.  Not a failure — no retry hint
+        (the newer request's answer is the one to await), no anomaly
+        capsule, and never an SLO deadline miss (the request never
+        dispatched)."""
+        metrics.counter("editor.superseded").inc()
+        payload = _error(
+            "superseded by a newer request for the same buffer",
+            req.get("id"), kind="superseded",
+        )
         try:
             self.respond(payload)
         except _AbandonedRequest:

@@ -39,6 +39,7 @@ import os
 import time
 
 from ..perf import faults, metrics
+from ..perf import overlay as pf_overlay
 from ..perf.depgraph import GRAPH
 from .batch import plan_groups
 from .runner import run_group
@@ -98,6 +99,11 @@ def snapshot(roots) -> dict:
                     continue  # vanished mid-scan: the real race
                 rel = os.path.relpath(path, root).replace(os.sep, "/")
                 files[rel] = (st.st_mtime_ns, st.st_size)
+        if pf_overlay.count():
+            # an overlaid file's signature is its overlay version, not
+            # its disk stat: setting, editing, or clearing an overlay
+            # reads as a tree change and triggers the minimal re-run
+            files.update(pf_overlay.signatures_under(root))
         out[root] = files
     return out
 
@@ -248,13 +254,36 @@ def watch_loop(jobs, emit, cycles=None, interval: float = 0.5,
     ``False`` return stops the loop).  Returns the number of cycles
     run."""
     roots = watch_roots(jobs)
+    write_roots = []
+    for job in jobs:
+        for root in job.writes():
+            if root not in write_roots:
+                write_roots.append(root)
+
+    def absorb_own_writes(state: dict) -> None:
+        # a cycle's own output (init/create regenerating its tree) must
+        # not read as an external edit on the next poll — a watch whose
+        # manifest writes would otherwise hot-loop on itself.  Only the
+        # write roots re-snapshot; an external edit to a READ root that
+        # raced the cycle still diffs against the pre-cycle baseline.
+        if not write_roots:
+            return
+        cur = _snapshot_with_retry(write_roots)
+        if cur is not None:
+            state.update(cur)
+
     ran = 0
+    # baseline BEFORE the first cycle: an edit landing while cycle 0
+    # runs (an overlay op racing the subscribe prime, say) diffs
+    # against the pre-cycle state and fires one redundant (but
+    # correct) cycle instead of being silently absorbed into the
+    # baseline and lost.  An unreadable first snapshot primes empty:
+    # the next successful poll then reads every file as changed —
+    # same redundant-cycle recovery, never a dead loop.
+    state = _snapshot_with_retry(roots) or {}
     emit(watch_cycle(jobs, ran))
     ran += 1
-    # an unreadable first snapshot primes empty: the next successful
-    # poll then reads every file as changed — one redundant (but
-    # correct) cycle instead of a dead loop
-    state = _snapshot_with_retry(roots) or {}
+    absorb_own_writes(state)
     while cycles is None or ran < cycles:
         if poll is not None:
             if poll() is False:
@@ -273,6 +302,7 @@ def watch_loop(jobs, emit, cycles=None, interval: float = 0.5,
         dirtied = _invalidate(changed, removed)
         emit(watch_cycle(jobs, ran, changed, removed, dirtied))
         ran += 1
+        absorb_own_writes(state)
     return ran
 
 

@@ -828,8 +828,8 @@ class TestStatsSourceRegistry:
                 "a-src", "b-src", "c-src",
             ]
             report = metrics.report()
-            fixed = ["cache", "graph", "metrics", "slo", "spans",
-                     "tiers"]
+            fixed = ["cache", "editor", "graph", "metrics", "slo",
+                     "spans", "tiers"]
             assert list(report) == fixed + ["a-src", "b-src", "c-src"]
         finally:
             for name in ("a-src", "b-src", "c-src"):

@@ -1,0 +1,610 @@
+"""Editor loop (PR 17): overlays, supersede cancellation, push.
+
+The interactive tier may only ever change WHEN work runs — stale
+requests answered ``superseded`` instead of executed, push cycles woken
+by overlay edits instead of poll intervals — never WHAT it produces:
+vetting an overlay must be byte-identical to vetting the same bytes
+saved to disk.  These tests cover the overlay store and its content-key
+integration, the path-lock trie's equivalence with the linear reference
+sweep, supersede-in-queue and in-flight supersede (including the
+deadline interplay: a superseded request charges NO SLO deadline miss
+and frees its trace shipping bucket), the one-in-flight accounting
+after a supersede burst, and the subscribe op's immediate wakeup.
+"""
+
+import json
+import os
+import random
+import shutil
+import threading
+import time
+
+import pytest
+
+from operator_forge.cli.main import main as cli_main
+from operator_forge.gocheck import cache as gc_cache
+from operator_forge.perf import metrics
+from operator_forge.perf import overlay as pf_overlay
+from operator_forge.perf import spans
+from operator_forge.serve.daemon import (
+    DaemonClient,
+    ForgeDaemon,
+    _PathLocks,
+)
+from operator_forge.serve.jobs import jobs_from_specs, supersede_key
+from operator_forge.serve import server
+from operator_forge.serve.server import dispatch_request
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlays():
+    # the drain flag is module-global and cleared only at server boot;
+    # a daemon stopped by an EARLIER test leaves it set, and a direct
+    # dispatch_request here would end its watch stream after one cycle
+    server._drain.clear()
+    yield
+    pf_overlay.clear_all()
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    """One generated standalone project shared by the module (the
+    tests only vet/lint it — read-only work)."""
+    base = tmp_path_factory.mktemp("editor-loop")
+    cfg = str(base / "cfg")
+    shutil.copytree(os.path.join(FIXTURES, "standalone"), cfg)
+    config = os.path.join(cfg, "workload.yaml")
+    out = str(base / "proj")
+    assert cli_main([
+        "init", "--workload-config", config, "--output-dir", out,
+        "--repo", "github.com/acme/editor",
+    ]) == 0
+    assert cli_main([
+        "create", "api", "--workload-config", config,
+        "--output-dir", out,
+    ]) == 0
+    return out
+
+
+def _a_go_file(project: str) -> str:
+    for root, _dirs, files in sorted(os.walk(project)):
+        for name in sorted(files):
+            if name == "main.go":
+                return os.path.join(root, name)
+    raise AssertionError("no main.go in generated project")
+
+
+def _deadline_misses() -> int:
+    return sum(
+        v for k, v in metrics.counters_snapshot().items()
+        if k.startswith("slo.") and k.endswith(".deadline_misses")
+    )
+
+
+def _counter(name: str) -> int:
+    return metrics.counters_snapshot().get(name, 0)
+
+
+def _start_daemon(tmp_path) -> ForgeDaemon:
+    daemon = ForgeDaemon(
+        f"unix:{tmp_path}/editor-{time.monotonic_ns()}.sock"
+    )
+    daemon.start()
+    return daemon
+
+
+def _wait_for(predicate, timeout=10.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class TestOverlayStore:
+    def test_content_keys_follow_overlay(self, project):
+        path = _a_go_file(project)
+        disk_sha = gc_cache.file_sha_stat(path)
+        assert disk_sha
+        info = pf_overlay.set_overlay(path, "package main\n// edited\n")
+        assert gc_cache.file_sha_stat(path) == info["sha"] != disk_sha
+        assert pf_overlay.clear_overlay(path)
+        assert gc_cache.file_sha_stat(path) == disk_sha
+
+    def test_vanished_file_still_contributes(self, tmp_path):
+        path = str(tmp_path / "gone.go")
+        with open(path, "w") as fh:
+            fh.write("package gone\n")
+        info = pf_overlay.set_overlay(path, "package gone\n// v2\n")
+        os.unlink(path)
+        # the overlay's bytes keep the content keys coherent even
+        # though the disk file vanished after registration
+        assert gc_cache.file_sha_stat(path) == info["sha"]
+        assert dict(pf_overlay.paths_under(str(tmp_path))) == {
+            os.path.abspath(path): info["sha"],
+        }
+        sigs = pf_overlay.signatures_under(str(tmp_path))
+        assert sigs == {"gone.go": ("overlay", info["version"])}
+
+    def test_owner_scoping(self, tmp_path):
+        a = str(tmp_path / "a.go")
+        b = str(tmp_path / "b.go")
+        for p in (a, b):
+            with open(p, "w") as fh:
+                fh.write("package x\n")
+        pf_overlay.set_overlay(a, "package x // a\n", owner="sess-a")
+        pf_overlay.set_overlay(b, "package x // b\n", owner="sess-b")
+        cleared = pf_overlay.clear_owner("sess-a")
+        assert cleared == [os.path.abspath(a)]
+        assert pf_overlay.get(a) is None
+        assert pf_overlay.get(b) is not None
+
+    def test_wait_change_wakes_immediately(self, tmp_path):
+        path = str(tmp_path / "w.go")
+        with open(path, "w") as fh:
+            fh.write("package w\n")
+        seen = pf_overlay.generation()
+        timer = threading.Timer(
+            0.1, pf_overlay.set_overlay, (path, "package w // 2\n")
+        )
+        started = time.monotonic()
+        timer.start()
+        try:
+            gen = pf_overlay.wait_change(seen, timeout=10.0)
+        finally:
+            timer.join()
+        assert gen != seen
+        assert time.monotonic() - started < 5.0
+
+    def test_read_text_and_bytes(self, tmp_path):
+        path = str(tmp_path / "r.go")
+        with open(path, "w") as fh:
+            fh.write("disk\n")
+        assert pf_overlay.read_text(path) == "disk\n"
+        pf_overlay.set_overlay(path, "buffer\n")
+        assert pf_overlay.read_text(path) == "buffer\n"
+        assert pf_overlay.read_bytes(path) == b"buffer\n"
+
+    def test_shipping_roundtrip(self, tmp_path):
+        assert pf_overlay.snapshot_for_shipping() is None
+        path = str(tmp_path / "s.go")
+        with open(path, "w") as fh:
+            fh.write("package s\n")
+        pf_overlay.set_overlay(path, "package s // dirty\n", owner="x")
+        snap = pf_overlay.snapshot_for_shipping()
+        assert snap == {os.path.abspath(path): "package s // dirty\n"}
+        pf_overlay.clear_all()
+        pf_overlay.adopt(snap)
+        assert pf_overlay.get(path) == "package s // dirty\n"
+        pf_overlay.adopt({})
+        assert pf_overlay.count() == 0
+
+
+class TestSupersedeKey:
+    def test_vet_and_lint_keys(self, tmp_path):
+        base = str(tmp_path)
+        vet = {"command": "vet", "path": "proj"}
+        key = supersede_key(vet, base)
+        assert key == (
+            "vet", "vet", os.path.abspath(os.path.join(base, "proj")),
+            "",
+        )
+        lint = {"op": "job", "job": {
+            "command": "lint", "path": "proj", "analyzers": "a,b",
+        }}
+        assert supersede_key(lint, base)[1] == "lint"
+        assert supersede_key(lint, base) != key
+
+    def test_overlay_key(self, tmp_path):
+        base = str(tmp_path)
+        req = {"op": "overlay", "path": "x/main.go", "content": ""}
+        assert supersede_key(req, base) == (
+            "overlay",
+            os.path.abspath(os.path.join(base, "x/main.go")),
+        )
+        assert supersede_key({"op": "overlay"}, base) is None
+
+    def test_side_effecting_work_never_superseded(self, tmp_path):
+        base = str(tmp_path)
+        assert supersede_key(
+            {"command": "test", "path": "proj"}, base
+        ) is None
+        assert supersede_key({"op": "batch", "jobs": [
+            {"command": "vet", "path": "proj"},
+        ]}, base) is None
+        assert supersede_key({"op": "ping"}, base) is None
+        assert supersede_key(
+            {"command": "init", "workload_config": "w", "output_dir": "o"},
+            base,
+        ) is None
+
+
+class TestPathLockTrie:
+    def _hold(self, locks, root, is_write):
+        locks._held.append((root, is_write))
+        locks._trie_add(root, is_write)
+
+    def _unhold(self, locks, root, is_write):
+        locks._held.remove((root, is_write))
+        locks._trie_remove(root, is_write)
+
+    def test_randomized_equivalence(self):
+        rng = random.Random(0xED170)
+        comps = ["a", "b", "c", "repo", "x"]
+        pool = ["/"] + [
+            os.sep + os.sep.join(
+                rng.choice(comps) for _ in range(rng.randint(1, 4))
+            )
+            for _ in range(40)
+        ]
+        locks = _PathLocks()
+        held: list = []
+        for step in range(600):
+            if held and rng.random() < 0.4:
+                entry = held.pop(rng.randrange(len(held)))
+                self._unhold(locks, *entry)
+            else:
+                entry = (rng.choice(pool), rng.random() < 0.5)
+                held.append(entry)
+                self._hold(locks, *entry)
+            reads = tuple(
+                rng.choice(pool) for _ in range(rng.randint(0, 2))
+            )
+            writes = tuple(
+                rng.choice(pool) for _ in range(rng.randint(0, 2))
+            )
+            assert locks._conflicts(reads, writes) == \
+                locks._conflicts_linear(reads, writes), (
+                    f"step {step}: held={held} reads={reads} "
+                    f"writes={writes}"
+                )
+        for entry in held:
+            self._unhold(locks, *entry)
+        assert locks._trie == {
+            "c": {}, "sr": 0, "sw": 0, "tr": 0, "tw": 0,
+        }
+
+    def test_component_boundary_rules(self):
+        locks = _PathLocks()
+        self._hold(locks, "/repo/app", True)
+        # nested and equal roots conflict; component-boundary siblings
+        # ("/repo/app2") do not — the _overlaps rule exactly
+        assert locks._conflicts((), ("/repo/app",))
+        assert locks._conflicts(("/repo/app/sub",), ())
+        assert locks._conflicts((), ("/repo",))
+        assert not locks._conflicts(("/repo/app2",), ("/repo/other",))
+        self._unhold(locks, "/repo/app", True)
+        # readers exclude writers only
+        self._hold(locks, "/repo/app", False)
+        assert not locks._conflicts(("/repo/app",), ())
+        assert locks._conflicts((), ("/repo/app",))
+        self._unhold(locks, "/repo/app", False)
+
+    def test_acquire_release_maintains_both_structures(self):
+        locks = _PathLocks()
+        token = locks.acquire(("/r/a",), ("/r/b",), timeout=1.0)
+        assert token is not None
+        assert locks.acquire((), ("/r/b/x",), timeout=0.05) is None
+        locks.release(token)
+        assert locks._held == []
+        token = locks.acquire((), ("/r/b/x",), timeout=1.0)
+        assert token is not None
+        locks.release(token)
+
+
+class TestInflightSupersede:
+    def _dispatch(self, req, base_dir, deadline, superseded):
+        responses: list = []
+        out_lock = threading.Lock()
+
+        def respond_locked(payload):
+            responses.append(payload)
+
+        done = threading.Event()
+        result: dict = {}
+
+        def run():
+            try:
+                result["keep_going"] = dispatch_request(
+                    req, base_dir, out_lock, respond_locked,
+                    deadline, superseded=superseded,
+                )
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return responses, done, result
+
+    def test_superseded_no_slo_miss(self, project):
+        misses_before = _deadline_misses()
+        inflight_before = _counter("editor.superseded_inflight")
+        superseded = threading.Event()
+        req = {"op": "watch", "id": "w1", "cycles": 3, "interval": 10,
+               "jobs": [{"command": "vet", "path": project}]}
+        responses, done, result = self._dispatch(
+            req, os.path.dirname(project), 0.0, superseded,
+        )
+        # let the first cycle land, then supersede mid-poll
+        _wait_for(lambda: len(responses) >= 1, timeout=120,
+                  message="first watch cycle")
+        superseded.set()
+        assert done.wait(30)
+        final = responses[-1]
+        assert final["ok"] is False
+        assert final["error_kind"] == "superseded"
+        assert final["id"] == "w1"
+        assert result["keep_going"] is True
+        assert _counter("editor.superseded_inflight") == \
+            inflight_before + 1
+        # crucially: a superseded request is NOT a deadline miss
+        assert _deadline_misses() == misses_before
+
+    def test_supersede_beats_deadline(self, project):
+        """With both a deadline and a supersede in play, the supersede
+        answers first and the timeout path (SLO miss, anomaly) never
+        fires."""
+        misses_before = _deadline_misses()
+        superseded = threading.Event()
+        req = {"op": "watch", "id": "w2", "cycles": 3, "interval": 30,
+               "jobs": [{"command": "vet", "path": project}]}
+        responses, done, result = self._dispatch(
+            req, os.path.dirname(project), 120.0, superseded,
+        )
+        _wait_for(lambda: len(responses) >= 1, timeout=120,
+                  message="first watch cycle")
+        started = time.monotonic()
+        superseded.set()
+        assert done.wait(30)
+        # the sliced join answered within ~a slice, not the deadline
+        assert time.monotonic() - started < 10
+        assert responses[-1]["error_kind"] == "superseded"
+        assert _deadline_misses() == misses_before
+
+    def test_finished_work_wins_the_race(self, project):
+        """A supersede that lands after the handler finished answers
+        the real result — completed work is never thrown away."""
+        superseded = threading.Event()
+        req = {"command": "vet", "id": "v1", "path": project}
+        responses, done, result = self._dispatch(
+            req, os.path.dirname(project), 0.0, superseded,
+        )
+        assert done.wait(120)
+        superseded.set()  # too late: already answered
+        assert responses[-1]["ok"] is True
+        assert responses[-1]["id"] == "v1"
+
+
+class TestDaemonSupersede:
+    def _prime(self, client, project):
+        """Warm the project's caches with one vet so queued-supersede
+        timing does not depend on a cold first run."""
+        first = client.request({"command": "vet", "path": project})
+        assert first["ok"], first
+
+    def test_queue_supersede_frees_trace_and_accounting(
+        self, tmp_path, project
+    ):
+        misses_before = _deadline_misses()
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                self._prime(client, project)
+                # pre-created shipping bucket for the doomed request:
+                # the supersede must free it (nobody will answer it)
+                spans._trace_buckets["tr-editor-doomed"] = [
+                    {"name": "seed"},
+                ]
+                # occupy the session, then pipeline two same-key vets
+                # while it is busy: the older one is still QUEUED when
+                # the newer arrives, so it answers `superseded`
+                client.send({
+                    "op": "watch", "id": "busy", "cycles": 1,
+                    "interval": 0.05,
+                    "jobs": [{"command": "vet", "path": project}],
+                })
+                raw = b""
+                for req in (
+                    {"id": "old", "command": "vet", "path": project,
+                     "trace": {"id": "tr-editor-doomed", "parent": 0}},
+                    {"id": "new", "command": "vet", "path": project},
+                ):
+                    raw += (json.dumps(req) + "\n").encode("utf-8")
+                client._sock.sendall(raw)
+                by_id: dict = {}
+                while "old" not in by_id or "new" not in by_id:
+                    line = client.read()
+                    assert line is not None, by_id
+                    if line.get("id") in ("old", "new"):
+                        by_id[line["id"]] = line
+                assert by_id["old"]["ok"] is False
+                assert by_id["old"]["error_kind"] == "superseded"
+                assert by_id["new"]["ok"] is True
+                assert by_id["new"]["rc"] == 0
+                # the doomed request's shipping bucket was drained
+                assert "tr-editor-doomed" not in spans._trace_buckets
+                # no SLO deadline miss was charged for the supersede
+                assert _deadline_misses() == misses_before
+                # one-in-flight accounting is consistent afterwards:
+                # nothing queued, nothing in flight, session lives on
+                _wait_for(
+                    lambda: not daemon._queued,
+                    message="global queue drained",
+                )
+                stats = client.request({"op": "stats"})
+                states = list(stats["daemon"]["sessions"].values())
+                assert all(s["queue_depth"] == 0 for s in states)
+                # at most the stats request itself is in flight
+                assert sum(s["in_flight"] for s in states) <= 1
+                assert stats["editor"]["superseded"] >= 1
+                assert client.request({"op": "ping"})["ok"]
+        finally:
+            daemon.stop()
+
+    def test_supersede_knob_off(self, tmp_path, project, monkeypatch):
+        monkeypatch.setenv("OPERATOR_FORGE_DAEMON_SUPERSEDE", "0")
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                self._prime(client, project)
+                raw = b""
+                for rid in ("k0", "k1", "k2"):
+                    raw += (json.dumps({
+                        "id": rid, "command": "vet", "path": project,
+                    }) + "\n").encode("utf-8")
+                client._sock.sendall(raw)
+                answers = [client.read() for _ in range(3)]
+                # with the knob off every request runs to completion
+                assert [a["id"] for a in answers] == ["k0", "k1", "k2"]
+                assert all(a["ok"] for a in answers)
+        finally:
+            daemon.stop()
+
+    def test_overlay_vet_identity(self, tmp_path, project):
+        """Lint of an overlay is byte-identical to lint of the same
+        bytes saved to disk (the vet-on-unsaved contract)."""
+        daemon = _start_daemon(tmp_path)
+        target = _a_go_file(project)
+        original = open(target).read()
+        edited = original + "\n// unsaved trailing comment\n"
+        try:
+            with DaemonClient(daemon.address()) as client:
+                resp = client.request({
+                    "op": "overlay", "path": target, "content": edited,
+                })
+                assert resp["ok"], resp
+                overlaid = client.request({
+                    "op": "job", "job": {
+                        "command": "lint", "path": project,
+                    },
+                })
+                assert overlaid["ok"], overlaid
+                resp = client.request({
+                    "op": "overlay", "path": target, "clear": True,
+                })
+                assert resp["ok"] and resp["cleared"]
+                with open(target, "w") as fh:
+                    fh.write(edited)
+                saved = client.request({
+                    "op": "job", "job": {
+                        "command": "lint", "path": project,
+                    },
+                })
+                assert saved["ok"], saved
+                assert overlaid["stdout"] == saved["stdout"]
+                assert overlaid["rc"] == saved["rc"]
+        finally:
+            with open(target, "w") as fh:
+                fh.write(original)
+            daemon.stop()
+
+    def test_subscribe_wakes_on_overlay_edit(self, tmp_path, project):
+        """A subscribe parked on a 30s interval pushes within a couple
+        of seconds of an overlay edit from another session."""
+        daemon = _start_daemon(tmp_path)
+        target = _a_go_file(project)
+        original = open(target).read()
+        try:
+            with DaemonClient(daemon.address()) as sub, \
+                    DaemonClient(daemon.address()) as editor:
+                self._prime(sub, project)
+                push_before = _counter("editor.overlay_sets")
+
+                def edit():
+                    time.sleep(0.4)
+                    resp = editor.request({
+                        "op": "overlay", "path": target,
+                        "content": original + "\n// push me\n",
+                    })
+                    assert resp["ok"], resp
+
+                poker = threading.Thread(target=edit)
+                poker.start()
+                started = time.monotonic()
+                sub.send({
+                    "op": "subscribe", "id": "sub1", "cycles": 2,
+                    "interval": 30,
+                    "jobs": [{"command": "vet", "path": project}],
+                })
+                lines = []
+                while True:
+                    line = sub.read()
+                    assert line is not None
+                    lines.append(line)
+                    if line.get("done"):
+                        break
+                elapsed = time.monotonic() - started
+                poker.join()
+                # 2 cycles + the done line, every one tagged subscribe
+                assert [ln["op"] for ln in lines] == ["subscribe"] * 3
+                assert lines[-1]["cycles"] == 2
+                # the second cycle fired on the overlay wake, not the
+                # 30s interval
+                assert elapsed < 15, f"no push wake ({elapsed:.1f}s)"
+                assert "main.go" in " ".join(lines[1]["changed"])
+                stats = sub.request({"op": "stats"})
+                assert stats["editor"]["push_cycles"] >= 2
+                assert stats["editor"]["push_p99"] is not None
+                assert stats["editor"]["overlay_sets"] > push_before
+        finally:
+            daemon.stop()
+
+    def test_disconnect_clears_owned_overlays(self, tmp_path, project):
+        daemon = _start_daemon(tmp_path)
+        target = _a_go_file(project)
+        try:
+            editor = DaemonClient(daemon.address())
+            resp = editor.request({
+                "op": "overlay", "path": target,
+                "content": open(target).read() + "\n// mine\n",
+            })
+            assert resp["ok"], resp
+            assert pf_overlay.count() == 1
+            editor.close()
+            # the daemon clears the dead session's overlays, so its
+            # unsaved buffers never leak into other clients' views
+            _wait_for(
+                lambda: pf_overlay.count() == 0,
+                message="owner overlays cleared on disconnect",
+            )
+        finally:
+            daemon.stop()
+
+    def test_overlay_requires_existing_file(self, tmp_path, project):
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                resp = client.request({
+                    "op": "overlay",
+                    "path": os.path.join(project, "nope.go"),
+                    "content": "package main\n",
+                })
+                assert resp["ok"] is False
+                assert resp["error_kind"] == "bad_request"
+                resp = client.request({"op": "overlay", "path": ""})
+                assert resp["ok"] is False
+        finally:
+            daemon.stop()
+
+
+class TestEditorStatsSurface:
+    EXPECTED_KEYS = [
+        "overlays", "overlay_sets", "boost_delays", "push_cycles",
+        "push_p50", "push_p99", "superseded", "superseded_inflight",
+    ]
+
+    def test_report_keys_stable(self):
+        report = metrics.editor_report()
+        assert list(report) == self.EXPECTED_KEYS
+        assert "editor" in metrics.report()
+
+    def test_serve_stats_carries_editor(self, tmp_path):
+        daemon = _start_daemon(tmp_path)
+        try:
+            with DaemonClient(daemon.address()) as client:
+                stats = client.request({"op": "stats"})
+                assert list(stats["editor"]) == self.EXPECTED_KEYS
+        finally:
+            daemon.stop()

@@ -147,8 +147,8 @@ class TestMembership:
                     stats = client.request({"op": "stats"})
                 fleet = stats["fleet"]
                 assert list(fleet) == [
-                    "affinities", "counters", "lease_s", "listen",
-                    "members", "queued_requests", "slo",
+                    "affinities", "counters", "editor", "lease_s",
+                    "listen", "members", "queued_requests", "slo",
                 ]
                 entry = fleet["members"]["d1"]
                 assert entry == {

@@ -322,8 +322,8 @@ class TestMetrics:
         metrics.counter("obs.cli").inc()
         assert cli_main(["stats", "--json"]) == 0
         report = json.loads(capsys.readouterr().out)
-        assert list(report) == ["cache", "graph", "metrics", "slo",
-                                "spans", "tiers"]
+        assert list(report) == ["cache", "editor", "graph", "metrics",
+                                "slo", "spans", "tiers"]
         assert report["tiers"]["mode"] in (None, "walk", "compile",
                                            "bytecode")
         assert list(report["graph"]) == ["dirty", "reused", "recomputed"]
