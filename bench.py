@@ -995,6 +995,266 @@ def concurrency_section(tmp: str, standalone_steady: str) -> dict:
     }
 
 
+#: the racy package injected into the standalone tree for the sanitize
+#: section's identity matrix: an unsynchronized field bump under a
+#: WaitGroup-only fence, plus the test that owns the verdict.  Struct
+#: literals spell out every field (the interpreter does not
+#: zero-initialize).
+SANITIZE_RACY_GO = '''package racecase
+
+import "sync"
+
+type Tally struct {
+	n int
+}
+
+func Bump(workers int) int {
+	t := &Tally{n: 0}
+	var wg sync.WaitGroup
+	for i := 0; i < workers; i++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			t.n = t.n + 1
+		}()
+	}
+	wg.Wait()
+	return t.n
+}
+'''
+
+SANITIZE_RACY_TEST_GO = '''package racecase
+
+import "testing"
+
+func TestBump(t *testing.T) {
+	if got := Bump(3); got != 3 {
+		t.Fatalf("got %d", got)
+	}
+}
+'''
+
+SANITIZER_ANALYZERS = ("nilness", "unusedwrite", "deadcode",
+                       "syncchecks")
+
+
+def sanitize_section(tmp: str, standalone_steady: str,
+                     kitchen_sink_steady: str) -> dict:
+    """The sanitizer tier (PR 19), four guards in one section:
+
+    - **overhead** — the storm suite EXECUTING (cache off) with the
+      race detector off vs on; the armed detector must stay within 3x
+      and must not flip a single verdict (zero dynamic false
+      positives on a correctly synchronized suite);
+    - **identity matrix** — a seeded racy package's suite report
+      (race verdicts embedded in the failures) byte-identical across
+      seeds x tiers x cache modes x thread/process worker backends,
+      every leg cleared so it executes.  The knobs travel as env vars
+      so process-pool workers see the same configuration;
+    - **zero static false positives** — the sanitizer analyzers
+      (nilness/unusedwrite/deadcode/syncchecks) report nothing over
+      the emitted kitchen-sink and monorepo-lite trees;
+    - **positives stay positive** — every monorepo-lite racy corpus
+      workload reports under the detector."""
+    import contextlib
+    import io
+    import sys as _sys
+
+    from operator_forge.gocheck import sanitize
+    from operator_forge.gocheck.analysis import analyze_project
+    from operator_forge.gocheck.interp import Interp
+    from operator_forge.gocheck.world import run_project_tests
+    from operator_forge.perf import metrics, workers
+
+    proj_clean = os.path.join(tmp, "sanitize-clean")
+    shutil.copytree(standalone_steady, proj_clean)
+    with open(os.path.join(proj_clean, "pkg", "orchestrate",
+                           "zz_storm_test.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(CONCURRENCY_STORM_TEST_GO)
+    proj_racy = os.path.join(tmp, "sanitize-racy")
+    shutil.copytree(standalone_steady, proj_racy)
+    racy_pkg = os.path.join(proj_racy, "internal", "racecase")
+    os.makedirs(racy_pkg, exist_ok=True)
+    with open(os.path.join(racy_pkg, "worker.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(SANITIZE_RACY_GO)
+    with open(os.path.join(racy_pkg, "worker_test.go"), "w",
+              encoding="utf-8") as fh:
+        fh.write(SANITIZE_RACY_TEST_GO)
+
+    # every knob travels through the environment so the process-pool
+    # legs configure their workers identically (fork inherits environ)
+    knobs = ("OPERATOR_FORGE_GOCHECK_RACE", "OPERATOR_FORGE_GOCHECK",
+             "OPERATOR_FORGE_GOCHECK_SEED", "OPERATOR_FORGE_JOBS")
+    saved = {name: os.environ.get(name) for name in knobs}
+    disk_root = tempfile.mkdtemp(prefix="operator-forge-sanbench-")
+    off_cpu, on_cpu = [], []
+    try:
+        pf_cache.configure(mode="off")
+        os.environ["OPERATOR_FORGE_GOCHECK"] = "bytecode"
+        os.environ["OPERATOR_FORGE_GOCHECK_SEED"] = "0"
+        os.environ["OPERATOR_FORGE_JOBS"] = "1"
+
+        os.environ["OPERATOR_FORGE_GOCHECK_RACE"] = "off"
+        for _ in range(CHECK_RUNS):
+            pf_cache.reset()
+            start = time.process_time()
+            off_results = run_project_tests(proj_clean)
+            off_cpu.append(time.process_time() - start)
+        os.environ["OPERATOR_FORGE_GOCHECK_RACE"] = "on"
+        for _ in range(CHECK_RUNS):
+            pf_cache.reset()
+            start = time.process_time()
+            on_results = run_project_tests(proj_clean)
+            on_cpu.append(time.process_time() - start)
+        clean_green = all(
+            r.code == 0 for r in on_results if not r.skipped
+        )
+        verdicts_unchanged = _result_signature(
+            on_results
+        ) == _result_signature(off_results)
+        counters = {
+            name: value
+            for name, value in metrics.counters_snapshot().items()
+            if name.startswith("sanitize.")
+        }
+
+        # the identity matrix over the seeded racy package
+        pf_cache.reset()
+        reference = _result_signature(run_project_tests(proj_racy))
+        racy_reports = sum(
+            1
+            for _rel, _code, _ran, failures, _skip, _err, _leaks
+            in reference
+            for _name, msgs in failures
+            for msg in msgs
+            if "DATA RACE on" in msg
+        )
+        guards = {}
+        for cache_mode in GUARD_MODES:
+            signatures = []
+            for leg, (tier, jobs, backend, seed) in enumerate((
+                ("walk", "1", "thread", "7"),
+                ("compile", "8", "thread", "0"),
+                ("bytecode", "8", "process", "0"),
+                ("bytecode", "1", "thread", "11"),
+            )):
+                pf_cache.configure(
+                    mode=cache_mode,
+                    root=os.path.join(disk_root, f"leg{leg}")
+                    if cache_mode == "disk" else None,
+                )
+                pf_cache.reset()
+                os.environ["OPERATOR_FORGE_GOCHECK"] = tier
+                os.environ["OPERATOR_FORGE_GOCHECK_SEED"] = seed
+                os.environ["OPERATOR_FORGE_JOBS"] = jobs
+                workers.set_backend(backend)
+                signatures.append(
+                    _result_signature(run_project_tests(proj_racy))
+                )
+            guards[cache_mode] = all(
+                sig == reference for sig in signatures
+            )
+
+        # static zero-false-positive legs over the emitted trees
+        workers.set_backend(None)
+        os.environ["OPERATOR_FORGE_JOBS"] = "1"
+        pf_cache.configure(mode="off")
+        pf_cache.reset()
+        ks_findings = len(analyze_project(
+            kitchen_sink_steady, analyzers=SANITIZER_ANALYZERS
+        ))
+        _sys.path.insert(0, os.path.join(FIXTURES, os.pardir))
+        try:
+            from monorepo_lite import (
+                write_monorepo_lite,
+                write_racy_workloads,
+            )
+        finally:
+            _sys.path.pop(0)
+        mono_workloads = 4 if FAST else 12
+        config = write_monorepo_lite(
+            os.path.join(tmp, "sanitize-mono-config"),
+            workloads=mono_workloads,
+        )
+        mono_tree = os.path.join(tmp, "sanitize-mono")
+        with contextlib.redirect_stdout(io.StringIO()):
+            for _ in range(2):  # two generations reach the fixed point
+                rc = cli_main([
+                    "init", "--workload-config", config,
+                    "--repo", "github.com/bench/sanmono",
+                    "--output-dir", mono_tree,
+                ])
+                assert rc == 0, "sanitize monorepo-lite init failed"
+                rc = cli_main([
+                    "create", "api", "--workload-config", config,
+                    "--output-dir", mono_tree,
+                ])
+                assert rc == 0, "sanitize monorepo-lite create failed"
+        pf_cache.reset()
+        mono_findings = len(analyze_project(
+            mono_tree, analyzers=SANITIZER_ANALYZERS
+        ))
+
+        # positives stay positive: the racy corpus all reports
+        corpus = 2 if FAST else 6
+        corpus_raced = 0
+        for i, path in enumerate(write_racy_workloads(
+            os.path.join(tmp, "sanitize-corpus"), corpus
+        )):
+            interp = Interp()
+            with open(path, encoding="utf-8") as fh:
+                interp.load_source(fh.read(), os.path.basename(path))
+            interp.call(f"Run{i:02d}", 3)
+            if interp.sched.take_races():
+                corpus_raced += 1
+            interp.sched.sweep()
+    finally:
+        workers.set_backend(None)
+        sanitize.set_race(None)
+        for name, value in saved.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+        pf_cache.configure(mode="mem")
+        shutil.rmtree(disk_root, ignore_errors=True)
+
+    off_med = statistics.median(off_cpu)
+    on_med = statistics.median(on_cpu)
+    overhead = on_med / off_med if off_med > 0 else 0.0
+    return {
+        "fixture": "standalone + storm suite / racy package / "
+        "monorepo-lite",
+        "runs": CHECK_RUNS,
+        "race_off_cpu_s_median": round(off_med, 4),
+        "race_on_cpu_s_median": round(on_med, 4),
+        "race_overhead_x": round(overhead, 2),
+        "race_overhead_ok": overhead < 3,
+        "race_on_suite_green": clean_green,
+        "race_verdicts_unchanged": verdicts_unchanged,
+        "racy_reports_found": racy_reports,
+        "identity_by_cache_mode": guards,
+        "static_zero_findings": {
+            "kitchen_sink": ks_findings == 0,
+            "monorepo_lite": mono_findings == 0,
+            "monorepo_workloads": mono_workloads,
+        },
+        "racy_corpus": {
+            "workloads": corpus,
+            "all_race": corpus_raced == corpus,
+        },
+        "counters": counters,
+        "headline": "the armed happens-before detector on an EXECUTING "
+        "clean suite (cache off) within 3x of race-off, zero verdicts "
+        "flipped; a seeded racy package's report byte-identical across "
+        "seeds x tiers x cache modes x thread/process workers; the "
+        "sanitizer analyzers silent on every emitted tree; every racy "
+        "corpus workload reports",
+    }
+
+
 def analyze_section(tree: str) -> dict:
     """The analyzer-framework benchmark: ``analyze_project`` (all
     registered analyzers) over the kitchen-sink steady tree, cold
@@ -3329,6 +3589,14 @@ def main() -> None:
         # identity, and the planted-site <1% micro-guard
         concurrency = concurrency_section(tmp, steady["standalone"])
 
+        # the sanitizer tier: race-on vs race-off executing overhead,
+        # the racy-package identity matrix (seeds × tiers × cache ×
+        # thread/process workers), static zero-false-positive legs,
+        # and the racy-corpus positives gate
+        sanitize_report = sanitize_section(
+            tmp, steady["standalone"], steady["kitchen-sink"]
+        )
+
         # the editor loop: overlay edit + re-vet p99 under 8 batch
         # clients, supersede burst + counterfactual, push latency,
         # path-lock trie microbench, overlay-vet identity matrix.
@@ -3403,6 +3671,7 @@ def main() -> None:
                 "fleet": fleet,
                 "tiered": tiered,
                 "concurrency": concurrency,
+                "sanitize": sanitize_report,
                 "editor": editor,
                 "noise_floor": "within one invocation the CPU median "
                 "repeats to ~3%; separate invocations on this VM differ "
@@ -3744,6 +4013,56 @@ def main() -> None:
                 "concurrency overhead guard FAILED: planted scheduler "
                 "sites exceed 1%% of the storm-suite cold run "
                 "(channel-free suites execute zero sites)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not sanitize_report["race_overhead_ok"]:
+            print(
+                "sanitize overhead guard FAILED: race-on executing "
+                "storm suite over the 3x bar vs race-off: %.2fx"
+                % sanitize_report["race_overhead_x"],
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            not sanitize_report["race_on_suite_green"]
+            or not sanitize_report["race_verdicts_unchanged"]
+        ):
+            print(
+                "sanitize false-positive guard FAILED: the armed "
+                "detector flipped a verdict on a correctly "
+                "synchronized suite",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if (
+            not all(sanitize_report["identity_by_cache_mode"].values())
+            or sanitize_report["racy_reports_found"] <= 0
+        ):
+            print(
+                "sanitize identity guard FAILED: race reports diverged "
+                "across seed/tier/cache/worker legs (or the racy "
+                "package reported nothing)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not all(
+            ok for ok in (
+                sanitize_report["static_zero_findings"]["kitchen_sink"],
+                sanitize_report["static_zero_findings"]["monorepo_lite"],
+            )
+        ):
+            print(
+                "sanitize analyzer guard FAILED: nonzero "
+                "nilness/unusedwrite/deadcode/syncchecks findings on "
+                "an emitted tree",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if not sanitize_report["racy_corpus"]["all_race"]:
+            print(
+                "sanitize corpus guard FAILED: a known-racy workload "
+                "did not report under the detector",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -558,6 +558,8 @@ _operator_forge() {
                     COMPREPLY=($(compgen -W "OPERATOR_FORGE_RENDER=ref OPERATOR_FORGE_RENDER=program" -- "$cur"));;
                 OPERATOR_FORGE_GOCHECK=*)
                     COMPREPLY=($(compgen -W "OPERATOR_FORGE_GOCHECK=walk OPERATOR_FORGE_GOCHECK=compile OPERATOR_FORGE_GOCHECK=bytecode" -- "$cur"));;
+                OPERATOR_FORGE_GOCHECK_RACE=*)
+                    COMPREPLY=($(compgen -W "OPERATOR_FORGE_GOCHECK_RACE=on OPERATOR_FORGE_GOCHECK_RACE=off" -- "$cur"));;
                 OPERATOR_FORGE_CACHE=*)
                     COMPREPLY=($(compgen -W "OPERATOR_FORGE_CACHE=off OPERATOR_FORGE_CACHE=mem OPERATOR_FORGE_CACHE=disk" -- "$cur"));;
                 OPERATOR_FORGE_DAEMON_SUPERSEDE=*)
@@ -878,7 +880,17 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 1
     fleet = stats.get("fleet")
+    tiers = stats.get("tiers") or {}
+    # stable-order sanitizer surface, mirroring the tiers/editor lines
+    sanitize = {
+        "checked": tiers.get("sanitize.checked", 0),
+        "clock_merges": tiers.get("sanitize.clock_merges", 0),
+        "races": tiers.get("sanitize.races", 0),
+    }
     if args.json:
+        if fleet is not None:
+            fleet = dict(fleet)
+            fleet["sanitize"] = sanitize
         print(_json.dumps(stats if fleet is None else fleet))
         return 0 if fleet is not None else 1
     if fleet is None:
@@ -906,6 +918,11 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
             f"{name.split('.', 1)[1]}={counters[name]}"
             for name in sorted(counters)
         )
+    )
+    print(
+        "sanitize: checked=%d clock_merges=%d races=%d"
+        % (sanitize["checked"], sanitize["clock_merges"],
+           sanitize["races"])
     )
     return 0
 
@@ -1131,6 +1148,17 @@ def cmd_stats(args: argparse.Namespace) -> int:
                 editor.get("push_p50"), editor.get("push_p99"),
             )
         )
+    from ..gocheck import sanitize as _sanitize
+
+    print(
+        "sanitize: race=%s checked=%d clock_merges=%d races=%d"
+        % (
+            _sanitize.race_mode(),
+            tiers.get("sanitize.checked", 0),
+            tiers.get("sanitize.clock_merges", 0),
+            tiers.get("sanitize.races", 0),
+        )
+    )
     slo = report.get("slo") or {}
     if slo:
         print("slo tenants:")

@@ -20,6 +20,10 @@ loopclosure file   go/defer closures capturing range variables
 errcheck   file    discarded error results of manifest functions
 copylocks  file    lock-carrying types passed/returned by value
 structtag  file    malformed/duplicate json:/yaml: struct tags
+nilness    file    straight-line nil derefs through local call graphs
+unusedwrite file   struct-value field writes never read again
+deadcode   file    code after terminating if/else chains or for{} loops
+syncchecks file    copied locks, WaitGroup Add/Done misuse, double unlock
 structural project package-level imports/duplicates/qualifiers
 localcalls project intra-project call checks over the index
 ========== ======= ===========================================
@@ -43,6 +47,7 @@ from .core import (  # noqa: F401
 from . import legacy  # noqa: F401,E402  (syntax, lint, typecheck, ...)
 from . import dataflow  # noqa: F401,E402  (shadow, ineffassign, ...)
 from . import apichecks  # noqa: F401,E402  (errcheck, copylocks, ...)
+from . import sanitizers  # noqa: F401,E402  (nilness, syncchecks, ...)
 
 from .driver import (  # noqa: F401,E402
     FileContext,

@@ -1,0 +1,605 @@
+"""Sanitizer-tier static analyzers: nilness, unusedwrite, deadcode,
+syncchecks.
+
+The static half of the sanitizer tier (the dynamic half is the
+happens-before race detector, ``gocheck.sanitize``): the `go vet
+-nilness` / staticcheck `unusedwrite` / `deadcode` classes plus the
+sync-primitive misuse patterns the race detector can only catch when a
+schedule actually exercises them.  Like every pass in this package the
+analyzers are conservative by construction — token-level uncertainty
+(captured names, address-taking, opaque control flow) suppresses a
+finding, never invents one — which is what lets the monorepo-lite
+zero-findings gate hold over every clean emitted tree.
+"""
+
+from __future__ import annotations
+
+from ..tokens import IDENT, KEYWORD, OP
+from .apichecks import _match_paren
+from .core import Analyzer, Diagnostic, register
+from .dataflow import _stmt_terminates
+from .facts import (
+    CONTROL_KEYWORDS,
+    captured_names,
+    enclosing_func,
+    func_literals_within,
+    scopes_of,
+)
+
+_SYNC_TYPES = ("Mutex", "RWMutex", "WaitGroup")
+
+
+def _named_funcs(parser) -> dict:
+    """name -> body span for package-level named function declarations
+    (methods and literals excluded — the nilness call graph is the
+    file's plain functions, resolvable without type information)."""
+    toks = parser.toks
+    out = {}
+    for span in parser.func_spans:
+        if enclosing_func(parser, span[0] - 1) is not None:
+            continue  # nested literal
+        # walk back from the body brace to this span's `func` keyword
+        k = span[0] - 1
+        while k >= 0 and not (
+            toks[k].kind == KEYWORD and toks[k].value == "func"
+        ):
+            k -= 1
+        if k < 0:
+            continue
+        if not (toks[k + 1].kind == IDENT):
+            continue  # literal assigned to a var
+        if toks[k + 2].kind == OP and toks[k + 2].value == ".":
+            continue
+        if k + 2 < len(toks) and toks[k + 2].kind == OP and (
+            toks[k + 2].value == ")"
+        ):
+            continue
+        if toks[k + 1].value == "func":  # pragma: no cover - defensive
+            continue
+        # a receiver group between `func` and the name makes it a
+        # method: the name token would follow a `)`
+        prev = toks[k + 1 - 1]
+        if prev.kind == OP and prev.value == ")":
+            continue
+        out.setdefault(toks[k + 1].value, span)
+    return out
+
+
+def _returns_of(parser, span) -> list:
+    """Token indices of `return` keywords directly in *span*, excluding
+    nested function literals."""
+    toks = parser.toks
+    nested = func_literals_within(parser, span)
+    out = []
+    for j in range(span[0], span[1] + 1):
+        t = toks[j]
+        if t.kind == KEYWORD and t.value == "return":
+            if any(s < j < e for s, e in nested):
+                continue
+            out.append(j)
+    return out
+
+
+def _always_nil_funcs(parser) -> set:
+    """Names of file-local functions whose every return statement is
+    literally ``return nil`` — the interprocedural nil sources."""
+    toks = parser.toks
+    out = set()
+    for name, span in _named_funcs(parser).items():
+        returns = _returns_of(parser, span)
+        if not returns:
+            continue
+        if all(
+            toks[j + 1].kind == IDENT and toks[j + 1].value == "nil"
+            and toks[j + 2].kind == OP and toks[j + 2].value == ";"
+            for j in returns
+        ):
+            out.add(name)
+    return out
+
+
+def _run_nilness(ctx):
+    """A local bound to nil — directly, or through a call to a
+    file-local function every one of whose returns is ``return nil`` —
+    then dereferenced (``x.``) on the same straight-line path with no
+    intervening write, nil check, or control flow.  Interprocedural in
+    the ``go vet -nilness`` sense: the nil fact flows through the local
+    call graph."""
+    parser = ctx.parser
+    scopes = scopes_of(parser)
+    toks = parser.toks
+    nil_funcs = _always_nil_funcs(parser)
+    write_index = {i: op for i, op in parser.plain_assigns}
+    out = []
+    for i, op in parser.plain_assigns:
+        if op not in ("=", ":="):
+            continue
+        name = toks[i].value
+        if name == "_":
+            continue
+        span = enclosing_func(parser, i)
+        if span is None:
+            continue
+        # classify the RHS: `nil` or a bare always-nil local call
+        r = i + 2
+        source = None
+        if (
+            toks[r].kind == IDENT and toks[r].value == "nil"
+            and toks[r + 1].kind == OP and toks[r + 1].value == ";"
+        ):
+            source = "assigned nil"
+        elif (
+            toks[r].kind == IDENT and toks[r].value in nil_funcs
+            and toks[r + 1].kind == OP and toks[r + 1].value == "("
+        ):
+            close = _match_paren(toks, r + 1)
+            if close > 0 and toks[close + 1].kind == OP and (
+                toks[close + 1].value == ";"
+            ):
+                source = f"{toks[r].value} always returns nil"
+        if source is None:
+            continue
+        if name in captured_names(parser, span):
+            continue  # a closure could rebind it
+        if any(
+            toks[j - 1].kind == OP and toks[j - 1].value == "&"
+            for j in scopes.uses_by_name.get(name, ())
+            if span[0] <= j <= span[1]
+        ):
+            continue  # address taken: writes can alias
+        # straight-line forward scan from the statement's end
+        j = r + 1
+        while j <= span[1] and not (
+            toks[j].kind == OP and toks[j].value == ";"
+        ):
+            j += 1
+        j += 1
+        while j <= span[1]:
+            t = toks[j]
+            if t.kind == KEYWORD and t.value in CONTROL_KEYWORDS:
+                break
+            if t.kind == OP and t.value in ("{", "}"):
+                break
+            if t.kind == IDENT and t.value == name and not (
+                toks[j - 1].kind == OP and toks[j - 1].value == "."
+            ):
+                if j in write_index or j in scopes.decl_set:
+                    break  # rebound before any deref
+                nxt = toks[j + 1]
+                if nxt.kind == OP and nxt.value == ".":
+                    tok = toks[j]
+                    out.append(Diagnostic(
+                        ctx.path, tok.line, tok.col, "nilness",
+                        "warning",
+                        f"nil dereference of {name} ({source} at line "
+                        f"{toks[i].line})",
+                    ))
+                break  # any other use (comparison, arg) ends the fact
+            j += 1
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _run_unusedwrite(ctx):
+    """A field write through a local struct *value* (`x := T{...}`;
+    never `&T{}`, never address-taken, never captured) after which the
+    variable is never read again: the write can reach no one
+    (staticcheck's unusedwrite)."""
+    parser = ctx.parser
+    scopes = scopes_of(parser)
+    toks = parser.toks
+    out = []
+    for i, op in parser.plain_assigns:
+        if op != ":=":
+            continue
+        name = toks[i].value
+        if name == "_":
+            continue
+        # RHS must be a composite literal value: `T{` or `pkg.T{`
+        r = i + 2
+        if not (toks[r].kind == IDENT):
+            continue
+        if toks[r + 1].kind == OP and toks[r + 1].value == ".":
+            lit_open = r + 3
+        else:
+            lit_open = r + 1
+        if not (
+            toks[lit_open - 1].kind == IDENT
+            and toks[lit_open].kind == OP and toks[lit_open].value == "{"
+        ):
+            continue
+        span = enclosing_func(parser, i)
+        if span is None:
+            continue
+        if name in captured_names(parser, span):
+            continue
+        uses = [
+            j for j in scopes.uses_by_name.get(name, ())
+            if span[0] <= j <= span[1] and j > i
+        ]
+        if any(
+            toks[j - 1].kind == OP and toks[j - 1].value == "&"
+            for j in uses
+        ):
+            continue  # aliased: the write is observable elsewhere
+        group = scopes.group_of(i)
+        for j in uses:
+            if scopes.resolve(j, name) != group:
+                continue
+            if not (
+                toks[j + 1].kind == OP and toks[j + 1].value == "."
+                and toks[j + 2].kind == IDENT
+                and toks[j + 3].kind == OP and toks[j + 3].value == "="
+            ):
+                continue  # only plain field stores are provably writes
+            # a later use of x (read, another write, return) keeps it
+            later = [u for u in uses if u > j]
+            if later:
+                continue
+            tok = toks[j]
+            out.append(Diagnostic(
+                ctx.path, tok.line, tok.col, "unusedwrite", "warning",
+                f"unused write to field {toks[j + 2].value}: {name} is "
+                "never read afterwards",
+            ))
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _branch_block(parser, if_i: int):
+    """The body block span of the `if` at *if_i* (header composite
+    literals are brace-free in Go, so the first depth-0 `{` opens the
+    body), or None when the shape is unexpected."""
+    toks = parser.toks
+    opens = {s: e for s, e in parser.blocks}
+    depth = 0
+    j = if_i + 1
+    while j < len(toks):
+        t = toks[j]
+        if t.kind == OP and t.value in ("(", "["):
+            depth += 1
+        elif t.kind == OP and t.value in (")", "]"):
+            depth -= 1
+        elif depth == 0 and t.kind == OP and t.value == "{":
+            end = opens.get(j)
+            return (j, end) if end is not None else None
+        j += 1
+    return None
+
+
+def _group_spans(parser) -> dict:
+    groups: dict = {}
+    for gid, start in parser.stmt_groups:
+        groups.setdefault(gid, []).append(start)
+    return groups
+
+
+def _block_group(groups: dict, open_i: int, close_i: int):
+    """The sibling group forming the direct statement list of block
+    (open_i, close_i): the contained group with the earliest first
+    statement (nested groups start strictly later)."""
+    best = None
+    for starts in groups.values():
+        if open_i < starts[0] and starts[-1] < close_i:
+            if best is None or starts[0] < best[0]:
+                best = starts
+    return best
+
+
+def _block_terminates(parser, groups, open_i, close_i, depth=0) -> bool:
+    if depth > 20:
+        return False  # pragma: no cover - pathological nesting
+    starts = _block_group(groups, open_i, close_i)
+    if not starts:
+        return False  # empty branch falls through
+    last = starts[-1]
+    if _stmt_terminates(parser, last, close_i):
+        return True
+    toks = parser.toks
+    if toks[last].kind == KEYWORD and toks[last].value == "if":
+        return _chain_terminates(parser, groups, last, depth + 1)
+    return False
+
+
+def _chain_terminates(parser, groups, if_i: int, depth=0) -> bool:
+    """Whether every branch of the if/else chain at *if_i* ends in a
+    control-transferring statement — so nothing falls through to the
+    chain's follower."""
+    toks = parser.toks
+    body = _branch_block(parser, if_i)
+    if body is None:
+        return False
+    if not _block_terminates(parser, groups, body[0], body[1], depth):
+        return False
+    j = body[1] + 1
+    if not (toks[j].kind == KEYWORD and toks[j].value == "else"):
+        return False  # no else: the false path falls through
+    nxt = toks[j + 1]
+    if nxt.kind == KEYWORD and nxt.value == "if":
+        return _chain_terminates(parser, groups, j + 1, depth + 1)
+    if nxt.kind == OP and nxt.value == "{":
+        opens = {s: e for s, e in parser.blocks}
+        end = opens.get(j + 1)
+        if end is None:
+            return False
+        return _block_terminates(parser, groups, j + 1, end, depth)
+    return False
+
+
+def _loop_never_exits(parser, for_i: int) -> bool:
+    """`for { ... }` with no break and no goto anywhere in the body —
+    control can only leave through return/panic, never to the
+    follower."""
+    toks = parser.toks
+    if not (toks[for_i + 1].kind == OP and toks[for_i + 1].value == "{"):
+        return False  # has a condition: may exit normally
+    opens = {s: e for s, e in parser.blocks}
+    end = opens.get(for_i + 1)
+    if end is None:
+        return False
+    return not any(
+        toks[j].kind == KEYWORD and toks[j].value in ("break", "goto")
+        for j in range(for_i + 2, end)
+    )
+
+
+def _run_deadcode(ctx):
+    """Statements no path can reach because the preceding statement
+    always transfers control — a fully terminating if/else chain or an
+    exit-free `for {}` loop.  Disjoint from `unreachable`, which only
+    sees direct terminator statements."""
+    parser = ctx.parser
+    toks = parser.toks
+    groups = _group_spans(parser)
+    out = []
+    for gid in sorted(groups):
+        starts = groups[gid]
+        for a, b in zip(starts, starts[1:]):
+            if _stmt_terminates(parser, a, b):
+                continue  # unreachable's territory
+            t = toks[a]
+            dead = False
+            if t.kind == KEYWORD and t.value == "if":
+                dead = _chain_terminates(parser, groups, a)
+            elif t.kind == KEYWORD and t.value == "for":
+                dead = _loop_never_exits(parser, a)
+            if not dead:
+                continue
+            if (
+                toks[b].kind == IDENT
+                and toks[b + 1].kind == OP
+                and toks[b + 1].value == ":"
+            ):
+                continue  # labeled: reachable via goto
+            tok = toks[b]
+            out.append(Diagnostic(
+                ctx.path, tok.line, tok.col, "deadcode", "warning",
+                "unreachable code: every path through the preceding "
+                "statement transfers control",
+            ))
+            break  # one report per group
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+def _sync_locals(ctx, span) -> dict:
+    """name -> sync type for `var NAME sync.{Mutex,RWMutex,WaitGroup}`
+    declarations directly inside *span* (alias-resolved, shadow-aware)."""
+    toks = ctx.parser.toks
+    aliases = {
+        alias for alias, path in ctx.imports.items()
+        if path == "sync" and alias not in ctx.shadowed
+    }
+    if not aliases:
+        return {}
+    out = {}
+    for j in range(span[0], span[1] - 3):
+        if not (toks[j].kind == KEYWORD and toks[j].value == "var"):
+            continue
+        if not (
+            toks[j + 1].kind == IDENT
+            and toks[j + 2].kind == IDENT
+            and toks[j + 2].value in aliases
+            and toks[j + 3].kind == OP and toks[j + 3].value == "."
+            and toks[j + 4].kind == IDENT
+            and toks[j + 4].value in _SYNC_TYPES
+        ):
+            continue
+        out[toks[j + 1].value] = toks[j + 4].value
+    return out
+
+
+def _run_syncchecks(ctx):
+    """Sync-primitive misuse the race detector can only catch when a
+    schedule happens to exercise it:
+
+    - a mutex/WaitGroup copied by value after its first use (the copy
+      has its own state — `go vet -copylocks` for locals);
+    - `WaitGroup.Add` inside the goroutine it counts (`Wait` can run
+      before the spawned `Add`);
+    - a counted goroutine whose body never calls `Done` (the counted
+      path can never drain);
+    - a straight-line double unlock (fatal at runtime in Go).
+    """
+    parser = ctx.parser
+    toks = parser.toks
+    out = []
+    for span in parser.func_spans:
+        if enclosing_func(parser, span[0] - 1) is not None:
+            continue  # literals are scanned as part of their parent
+        sync_vars = _sync_locals(ctx, span)
+        if not sync_vars:
+            continue
+        waitgroups = {
+            n for n, t in sync_vars.items() if t == "WaitGroup"
+        }
+        # -- copy after first use ------------------------------------
+        for name, tname in sorted(sync_vars.items()):
+            first_use = None
+            for j in range(span[0], span[1]):
+                if (
+                    toks[j].kind == IDENT and toks[j].value == name
+                    and toks[j + 1].kind == OP
+                    and toks[j + 1].value == "."
+                    and not (toks[j - 1].kind == OP
+                             and toks[j - 1].value == ".")
+                ):
+                    first_use = j
+                    break
+            if first_use is None:
+                continue
+            for j in range(first_use + 1, span[1]):
+                t = toks[j]
+                if not (t.kind == IDENT and t.value == name):
+                    continue
+                prev, nxt = toks[j - 1], toks[j + 1]
+                if nxt.kind == OP and nxt.value == ".":
+                    continue  # method use, not a copy
+                if prev.kind == OP and prev.value in ("&", ".", "*"):
+                    continue  # pointer or selector: no copy
+                if prev.kind == KEYWORD and prev.value == "var":
+                    continue
+                out.append(Diagnostic(
+                    ctx.path, t.line, t.col, "syncchecks", "warning",
+                    f"{name} copied by value after first use: a "
+                    f"sync.{tname} must not be copied",
+                ))
+                break  # one report per variable
+        # -- Add inside the spawned goroutine + missing Done ---------
+        go_stmts = [
+            (kw, stop) for kw, stop in parser.go_defer
+            if span[0] <= kw <= span[1]
+            and toks[kw].kind == KEYWORD and toks[kw].value == "go"
+        ]
+        groups = _group_spans(parser)
+        for kw, stop in go_stmts:
+            lits = func_literals_within(parser, (kw, stop))
+            if not lits:
+                continue
+            lit = min(lits)  # the outermost spawned literal
+            for name in sorted(waitgroups):
+                added_inside = any(
+                    toks[j].kind == IDENT and toks[j].value == name
+                    and toks[j + 1].kind == OP
+                    and toks[j + 1].value == "."
+                    and toks[j + 2].kind == IDENT
+                    and toks[j + 2].value == "Add"
+                    for j in range(lit[0], lit[1] - 2)
+                )
+                if added_inside:
+                    tok = toks[kw]
+                    out.append(Diagnostic(
+                        ctx.path, tok.line, tok.col, "syncchecks",
+                        "warning",
+                        f"{name}.Add called inside the goroutine it "
+                        f"counts: {name}.Wait may return before the "
+                        "goroutine is counted; call Add before go",
+                    ))
+            # the statement directly before this `go` in its sibling
+            # group: a bare `NAME.Add(...)` counts THIS goroutine
+            prev_start = None
+            for starts in groups.values():
+                if kw in starts:
+                    k = starts.index(kw)
+                    prev_start = starts[k - 1] if k > 0 else None
+                    break
+            if prev_start is None:
+                continue
+            p = prev_start
+            if not (
+                toks[p].kind == IDENT and toks[p].value in waitgroups
+                and toks[p + 1].kind == OP and toks[p + 1].value == "."
+                and toks[p + 2].kind == IDENT
+                and toks[p + 2].value == "Add"
+            ):
+                continue
+            name = toks[p].value
+            mentioned = any(
+                toks[j].kind == IDENT and toks[j].value == name
+                for j in range(lit[0], lit[1] + 1)
+            )
+            if not mentioned:
+                tok = toks[kw]
+                out.append(Diagnostic(
+                    ctx.path, tok.line, tok.col, "syncchecks",
+                    "warning",
+                    f"goroutine counted by {name}.Add never calls "
+                    f"{name}.Done: {name}.Wait cannot drain this path",
+                ))
+        # -- straight-line double unlock -----------------------------
+        mutexes = {
+            n for n, t in sync_vars.items() if t in ("Mutex", "RWMutex")
+        }
+        state: dict = {}
+        for j in range(span[0], span[1]):
+            t = toks[j]
+            if t.kind == KEYWORD and t.value in CONTROL_KEYWORDS:
+                state.clear()  # another path may re-lock
+                continue
+            if t.kind == OP and t.value in ("{", "}"):
+                state.clear()
+                continue
+            if not (
+                t.kind == IDENT and t.value in mutexes
+                and toks[j + 1].kind == OP and toks[j + 1].value == "."
+                and toks[j + 2].kind == IDENT
+            ):
+                continue
+            method = toks[j + 2].value
+            if method == "Unlock":
+                if state.get(t.value) == "unlocked":
+                    out.append(Diagnostic(
+                        ctx.path, t.line, t.col, "syncchecks",
+                        "warning",
+                        f"double unlock of {t.value}: already unlocked "
+                        "on every path reaching this statement",
+                    ))
+                    state.pop(t.value, None)
+                else:
+                    state[t.value] = "unlocked"
+            elif method in ("Lock", "RLock", "RUnlock", "TryLock"):
+                state.pop(t.value, None)
+    out.sort(key=lambda d: (d.line, d.col))
+    return out
+
+
+NILNESS = register(Analyzer(
+    name="nilness",
+    doc="straight-line nil dereferences, including through calls to "
+        "file-local functions that always return nil (go vet -nilness)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_nilness,
+    severity="warning",
+))
+
+UNUSEDWRITE = register(Analyzer(
+    name="unusedwrite",
+    doc="struct field writes through a local value never read again "
+        "(staticcheck unusedwrite)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_unusedwrite,
+    severity="warning",
+))
+
+DEADCODE = register(Analyzer(
+    name="deadcode",
+    doc="statements after a fully terminating if/else chain or an "
+        "exit-free for{} loop (beyond the unreachable pass)",
+    scope="file",
+    requires=("parse", "facts"),
+    run=_run_deadcode,
+    severity="warning",
+))
+
+SYNCCHECKS = register(Analyzer(
+    name="syncchecks",
+    doc="sync misuse: locks copied after use, WaitGroup.Add inside "
+        "the counted goroutine, counted paths missing Done, double "
+        "unlock",
+    scope="file",
+    requires=("parse", "text"),
+    run=_run_syncchecks,
+    severity="warning",
+))

@@ -45,8 +45,9 @@ from ..perf import spans
 
 # bump to invalidate previously persisted gocheck entries when the
 # cached record shapes (not the checker's behavior) change
-_SCHEMA = 5  # 5: suite reports carry goroutine leaks; OP_GO carries
-# the spawn line (concurrency runtime)
+_SCHEMA = 6  # 6: suite reports carry race-detector verdicts (sanitize
+# tier); 5: suite reports carry goroutine leaks; OP_GO carries the
+# spawn line (concurrency runtime)
 
 _lock = threading.Lock()
 _scan_mem: dict = {}    # (sha, path) -> pristine _FileScan

@@ -46,6 +46,14 @@ from .tokens import (
     Token,
 )
 
+from . import sanitize as _san
+
+#: the race detector's one-word fast-path gate (nonzero while any
+#: scheduler in the process is recording) — checked before every
+#: instrumented memory access and call-stack push, so programs that
+#: never spawn a goroutine pay a single list-index test
+_RACE_ACTIVE = _san.ACTIVE
+
 
 class GoInterpError(Exception):
     """Interpreter failure: unsupported syntax or a runtime fault."""
@@ -969,6 +977,7 @@ class Scheduler:
         self._progress_tick = 0
         self._spin: dict = {}      # select site -> (count, tick)
         self._sweeping = False
+        self.race = None           # RaceState, armed at first spawn
 
     # -- fault plumbing (sched.preempt) ---------------------------------
 
@@ -996,6 +1005,13 @@ class Scheduler:
         self.goroutines.append(g)
         self.runq.append(g)
         self.spawned += 1
+        if self.race is None and _san.race_enabled():
+            # recording arms at the first spawn: everything before it
+            # happens-before every child via clock inheritance, so a
+            # single-flow program records nothing
+            self.race = _san.RaceState(self)
+        if self.race is not None:
+            self.race.on_spawn(self.current.gid, g.gid)
         from ..perf import metrics
 
         metrics.counter("sched.goroutines").inc()
@@ -1017,6 +1033,10 @@ class Scheduler:
         g.event.set()
 
     def _thread_main(self, g: _Goroutine) -> None:
+        if self.race is not None:
+            # each goroutine runs on its own thread: binding here makes
+            # the thread-local lookup THE goroutine->state association
+            _san.bind_thread(self.race)
         try:
             self._park(g)
         except GoroutineExit:
@@ -1178,11 +1198,23 @@ class Scheduler:
         out, self.failures = self.failures, []
         return out
 
+    def take_races(self) -> list:
+        """Drain the race detector's accumulated reports (sorted
+        rendered strings; empty when the detector is off or armed with
+        nothing to report)."""
+        if self.race is None:
+            return []
+        return self.race.take_reports()
+
     def sweep(self) -> list:
         """End-of-suite leak sweep: every goroutine still alive is
         reported ``goroutine <gid> [<state/reason>] spawned at <site>``
         and its thread is unwound (no defers, like Go's process exit).
         Returns the deterministic leak report lines."""
+        if self.race is not None:
+            # end of program: stop recording and flush counters (race
+            # reports stay drainable via take_races)
+            self.race.detach()
         leaked = [
             g for g in self.goroutines
             if g is not self.main and g.state != "done"
@@ -1275,8 +1307,18 @@ class Scheduler:
     def yield_point(self):
         self._fire_due_timers()
         self.drain()
-        for hook in list(self.hooks):
-            hook(self)
+        r = self.race
+        if r is not None:
+            # hooks (the envtest world's reconcile pump) execute on
+            # whatever goroutine hit the yield point; their accesses
+            # must not be attributed to it
+            r.paused += 1
+        try:
+            for hook in list(self.hooks):
+                hook(self)
+        finally:
+            if r is not None:
+                r.paused -= 1
 
     def sleep(self, duration_ns):
         self.now_ns += max(int(duration_ns), 0)
@@ -1335,7 +1377,10 @@ class GoChan:
     are strict FIFO; which *goroutine* runs next is the scheduler's
     seeded decision."""
 
-    __slots__ = ("sched", "capacity", "buf", "closed", "sendq", "recvq")
+    __slots__ = (
+        "sched", "capacity", "buf", "closed", "sendq", "recvq",
+        "race_clock",
+    )
 
     def __init__(self, sched: Scheduler, capacity: int = 0):
         self.sched = sched
@@ -1344,6 +1389,10 @@ class GoChan:
         self.closed = False
         self.sendq: list = []
         self.recvq: list = []
+        # one conservative vector clock per channel: every send (and
+        # close) releases into it, every receive acquires from it —
+        # extra happens-before edges only suppress race reports
+        self.race_clock = None
 
     def __len__(self):
         return len(self.buf)
@@ -1358,12 +1407,18 @@ class GoChan:
         if self.closed:
             raise GoPanic("send on closed channel")
         r = _claim(self.recvq)
+        rs = sched.race
         if r is not None:
+            if rs is not None:
+                self.race_clock = rs.release(self.race_clock)
+                rs.acquire(self.race_clock, r.gid)
             _commit_recv(r, self, value, True)
             sched.unblock(r)
             sched.progress()
             return True
         if self.capacity and len(self.buf) < self.capacity:
+            if rs is not None:
+                self.race_clock = rs.release(self.race_clock)
             self.buf.append(value)
             sched.progress()
             return True
@@ -1373,22 +1428,33 @@ class GoChan:
         """One non-blocking receive attempt (never yields): a (value,
         ok) box, or None when nothing is deliverable yet."""
         sched = self.sched
+        rs = sched.race
         if self.buf:
             value = self.buf.pop(0)
             s = _claim(self.sendq)
             if s is not None:
                 # a parked sender refills the freed buffer slot
+                if rs is not None:
+                    self.race_clock = rs.release(self.race_clock, s.gid)
                 self.buf.append(_commit_send(s, self))
                 sched.unblock(s)
+            if rs is not None:
+                rs.acquire(self.race_clock)
             sched.progress()
             return (value, True)
         s = _claim(self.sendq)
         if s is not None:
+            if rs is not None:
+                self.race_clock = rs.release(self.race_clock, s.gid)
             value = _commit_send(s, self)
             sched.unblock(s)
+            if rs is not None:
+                rs.acquire(self.race_clock)
             sched.progress()
             return (value, True)
         if self.closed:
+            if rs is not None:
+                rs.acquire(self.race_clock)
             return (None, False)
         return None
 
@@ -1429,6 +1495,9 @@ class GoChan:
             raise GoPanic("close of closed channel")
         self.closed = True
         sched = self.sched
+        if sched.race is not None:
+            # close releases: a receive observing the close acquires
+            self.race_clock = sched.race.release(self.race_clock)
         for r in list(self.recvq):
             sched.unblock(r)
         self.recvq.clear()
@@ -1584,8 +1653,13 @@ class _WaitGroupBase:
         self.sched = sched
         self.counter = 0
         self.waiters: list = []
+        self.race_clock = None
 
     def Add(self, delta):
+        if int(delta) < 0 and self.sched.race is not None:
+            # Done releases; the returning Wait acquires the merge of
+            # every counted goroutine's clock
+            self.race_clock = self.sched.race.release(self.race_clock)
         self.counter += int(delta)
         if self.counter < 0:
             raise GoPanic("sync: negative WaitGroup counter")
@@ -1603,6 +1677,8 @@ class _WaitGroupBase:
         while self.counter > 0:
             self.waiters.append(self.sched.current)
             self.sched.block("sync.WaitGroup.Wait")
+        if self.sched.race is not None:
+            self.sched.race.acquire(self.race_clock)
 
 
 class _MutexBase:
@@ -1610,6 +1686,7 @@ class _MutexBase:
         self.sched = sched
         self.holder = None
         self.waiters: list = []
+        self.race_clock = None
 
     def Lock(self):
         self.sched.fault_point("mutex.lock")
@@ -1618,16 +1695,22 @@ class _MutexBase:
             self.waiters.append(me)
             self.sched.block("sync.Mutex.Lock")
         self.holder = me
+        if self.sched.race is not None:
+            self.sched.race.acquire(self.race_clock)
 
     def TryLock(self):
         if self.holder is not None:
             return False
         self.holder = self.sched.current
+        if self.sched.race is not None:
+            self.sched.race.acquire(self.race_clock)
         return True
 
     def Unlock(self):
         if self.holder is None:
             raise GoPanic("sync: unlock of unlocked mutex")
+        if self.sched.race is not None:
+            self.race_clock = self.sched.race.release(self.race_clock)
         self.holder = None
         if self.waiters:
             self.sched.unblock(self.waiters.pop(0))
@@ -1643,6 +1726,7 @@ class _RWMutexBase:
         self.readers = 0
         self.holder = None
         self.waiters: list = []
+        self.race_clock = None
 
     def _wake_all(self):
         for w in self.waiters:
@@ -1657,10 +1741,14 @@ class _RWMutexBase:
             self.waiters.append(me)
             self.sched.block("sync.RWMutex.Lock")
         self.holder = me
+        if self.sched.race is not None:
+            self.sched.race.acquire(self.race_clock)
 
     def Unlock(self):
         if self.holder is None:
             raise GoPanic("sync: unlock of unlocked RWMutex")
+        if self.sched.race is not None:
+            self.race_clock = self.sched.race.release(self.race_clock)
         self.holder = None
         if self.waiters:
             self._wake_all()
@@ -1670,10 +1758,16 @@ class _RWMutexBase:
             self.waiters.append(self.sched.current)
             self.sched.block("sync.RWMutex.RLock")
         self.readers += 1
+        if self.sched.race is not None:
+            self.sched.race.acquire(self.race_clock)
 
     def RUnlock(self):
         if self.readers <= 0:
             raise GoPanic("sync: RUnlock of unlocked RWMutex")
+        if self.sched.race is not None:
+            # a reader's clock must reach the next writer's acquire,
+            # ordering its reads before the writer's writes
+            self.race_clock = self.sched.race.release(self.race_clock)
         self.readers -= 1
         if self.readers == 0 and self.waiters:
             self._wake_all()
@@ -1685,9 +1779,14 @@ class _OnceBase:
         self.done = False
         self._running = False
         self._waiters: list = []
+        self.race_clock = None
 
     def Do(self, fn):
         if self.done:
+            if self.sched.race is not None:
+                # the first Do's completion happens-before every later
+                # (and concurrent) caller's return
+                self.sched.race.acquire(self.race_clock)
             return
         if self._running:
             # Go semantics: later callers BLOCK until the first Do
@@ -1695,6 +1794,8 @@ class _OnceBase:
             while not self.done:
                 self._waiters.append(self.sched.current)
                 self.sched.block("sync.Once.Do")
+            if self.sched.race is not None:
+                self.sched.race.acquire(self.race_clock)
             return
         self._running = True
         try:
@@ -1704,6 +1805,10 @@ class _OnceBase:
             elif callable(fn):
                 fn()
         finally:
+            if self.sched.race is not None:
+                self.race_clock = self.sched.race.release(
+                    self.race_clock
+                )
             self.done = True
             self._running = False
             if self._waiters:
@@ -3041,6 +3146,12 @@ class Interp:
         runner = None
         if compiler.mode() != "walk":
             runner = compiler.compiled_block(scan, lo, hi)
+        pushed = False
+        if _RACE_ACTIVE[0]:
+            # access-site attribution for race reports: all tiers call
+            # through here, so the label stack is tier-invariant
+            _san.push_func(fn.get("name") or "func")
+            pushed = True
         try:
             if runner is not None:
                 runner(ev, env)
@@ -3056,6 +3167,9 @@ class Interp:
         except BaseException:
             ev.run_defers()
             raise
+        finally:
+            if pushed:
+                _san.pop_func()
         ev.run_defers()
         return None
 
@@ -4006,11 +4120,22 @@ class _Eval:
                     obj.fields["APIVersion"] = value.APIVersion
                     obj.fields["Kind"] = value.Kind
                     return
+                if _RACE_ACTIVE[0]:
+                    st = _san.tls_state()
+                    if st is not None:
+                        st.note_write(obj, name, f"{obj.tname}.{name}")
                 obj.fields[name] = value
             else:
                 setattr(obj, name, value)
             return
         if kind == "index":
+            if _RACE_ACTIVE[0] and isinstance(target[1], (dict, list)):
+                st = _san.tls_state()
+                if st is not None:
+                    st.note_write(
+                        target[1], target[2],
+                        _san.index_label(target[1], target[2]),
+                    )
             target[1][target[2]] = value
             return
         if kind == "star":
@@ -4585,6 +4710,17 @@ class _Eval:
             runner = getattr(callee, "compiled", None)
             if runner is not None and compiler.mode() == "walk":
                 runner = None
+            pushed = False
+            if _RACE_ACTIVE[0]:
+                # a literal has no name: label it by its body's static
+                # file:line (token lines are tier/seed-invariant)
+                import os as _os
+
+                path = getattr(callee.scan, "path", None) or "<go>"
+                _san.push_func(
+                    f"func@{_os.path.basename(path)}:{toks[lo].line}"
+                )
+                pushed = True
             try:
                 if runner is not None:
                     runner(ev, env)
@@ -4600,6 +4736,9 @@ class _Eval:
             except BaseException:
                 ev.run_defers()
                 raise
+            finally:
+                if pushed:
+                    _san.pop_func()
             ev.run_defers()
             return None
         if isinstance(callee, TypeRef):
@@ -4668,6 +4807,10 @@ def _apply_binop(op, a, b):
 def _get_attr(obj, name):
     if isinstance(obj, GoStruct):
         if name in obj.fields:
+            if _RACE_ACTIVE[0]:
+                st = _san.tls_state()
+                if st is not None:
+                    st.note_read(obj, name, f"{obj.tname}.{name}")
             return obj.fields[name]
         if name == "TypeMeta" and isinstance(obj, GoObject):
             return _TypeMetaView(obj)
@@ -4692,6 +4835,10 @@ def _go_index(obj, key):
         # nil map read yields the zero value; the emitted code only
         # indexes nil maps of strings (annotations/labels)
         return ""
+    if _RACE_ACTIVE[0] and isinstance(obj, (dict, list)):
+        st = _san.tls_state()
+        if st is not None:
+            st.note_read(obj, key, _san.index_label(obj, key))
     if isinstance(obj, dict):
         # missing key yields the zero value, same as a nil map — the
         # emitted code's string-map lookups compare against ""

@@ -1,0 +1,352 @@
+"""Happens-before race detection for the deterministic concurrency
+runtime — the ``go test -race`` / ThreadSanitizer analogue (PARITY
+§6p), sized to the interpreted subset.
+
+Every goroutine carries a vector clock.  Synchronization edges merge
+clocks exactly where Go's memory model defines them:
+
+- ``go`` spawn: the child inherits the parent's clock (everything the
+  parent did happens-before the child's first statement);
+- channel operations: a send releases the sender's clock into the
+  channel, a receive acquires it (one conservative clock per channel —
+  extra happens-before edges can only *suppress* reports, preserving
+  the zero-false-positive contract);
+- ``sync.Mutex`` / ``sync.RWMutex``: unlock releases, lock acquires;
+- ``sync.WaitGroup``: ``Done`` releases, a returning ``Wait`` acquires;
+- ``sync.Once``: the first ``Do`` releases on completion, every other
+  caller acquires.
+
+Shadow state per (object, field/index) records the last write epoch and
+per-goroutine read epochs; an unordered write/write or write/read pair
+yields a deterministic ``GoRace`` report naming both access sites
+(enclosing functions), both goroutine spawn sites, and the
+synchronization path that failed to order them.  Reports are
+canonicalized (the two access descriptors sort independently of which
+interleaving surfaced the pair first) and deduplicated, so the rendered
+bytes are identical across seeds, execution tiers (walk/compile/
+bytecode all funnel memory traffic through ``interp._get_attr`` /
+``_go_index`` / ``_Eval._write_target``), cache modes, and worker
+backends.
+
+Recording activates at the first ``go`` spawn (a single-flow program
+pays one pointer check per instrumented operation) and pauses while
+scheduler yield-point hooks run — the envtest world's reconcile pump
+executes on whatever goroutine hit the yield point and must not be
+attributed to it.
+
+Knob: ``OPERATOR_FORGE_GOCHECK_RACE=on|off`` (default on), overridable
+programmatically via :func:`set_race` for the bench identity matrices.
+Counters: ``sanitize.races`` / ``sanitize.checked`` /
+``sanitize.clock_merges`` in ``tier_report()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "GoRace", "RaceState", "race_enabled", "race_mode", "set_race",
+]
+
+_forced = [None]  # programmatic override; None -> env decides
+
+
+def race_enabled() -> bool:
+    """Whether the race detector arms on the next spawn (the env knob,
+    or the programmatic :func:`set_race` override)."""
+    if _forced[0] is not None:
+        return _forced[0]
+    raw = os.environ.get(
+        "OPERATOR_FORGE_GOCHECK_RACE", "on"
+    ).strip().lower()
+    return raw not in ("off", "0", "false", "no")
+
+
+def race_mode() -> str:
+    """``on`` / ``off`` — the cache-key component (race verdicts ride
+    in suite reports, so race-on and race-off runs must never replay
+    into each other)."""
+    return "on" if race_enabled() else "off"
+
+
+def set_race(value=None) -> None:
+    """Programmatic knob override (``None`` restores env selection)."""
+    _forced[0] = None if value is None else bool(value)
+
+
+#: process-wide count of schedulers currently recording — the one-word
+#: fast-path gate the interpreter's hot memory/call paths check before
+#: paying the thread-local lookup
+ACTIVE = [0]
+
+_tls = threading.local()
+
+
+def tls_state():
+    """The recording state bound to the calling thread (each goroutine
+    runs on its own parked thread, so this IS the per-goroutine
+    association), or None."""
+    return getattr(_tls, "state", None)
+
+
+def bind_thread(state) -> None:
+    _tls.state = state
+
+
+def push_func(label: str) -> None:
+    """Enter *label* on the calling thread's function stack (the
+    access-site attribution for race reports — statement lines are not
+    tier-invariant, enclosing function labels are)."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(label)
+
+
+def pop_func() -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+def _current_func() -> str:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else "main"
+
+
+def index_label(obj, key) -> str:
+    """Deterministic shadow-cell label for an indexed access: the
+    container kind plus the key (object identity never leaks in)."""
+    kind = "map" if isinstance(obj, dict) else "slice"
+    if isinstance(key, str):
+        return f'{kind}["{key}"]'
+    return f"{kind}[{key}]"
+
+
+class GoRace:
+    """One deterministic data-race report: a canonical multi-line
+    rendering (stable across seeds, tiers, cache modes, and workers)
+    plus the structured fields it was built from."""
+
+    __slots__ = ("label", "first", "second", "text")
+
+    def __init__(self, label: str, access_a: tuple, access_b: tuple):
+        # each access is (kind, func_label, goroutine_where); the pair
+        # is canonicalized — writes before reads, then lexicographic —
+        # so WHICH interleaving surfaced the pair first never leaks
+        # into the rendered bytes
+        order = sorted(
+            (access_a, access_b),
+            key=lambda a: (a[0] != "write", a[1], a[2]),
+        )
+        self.label = label
+        self.first, self.second = order
+        k1, f1, w1 = self.first
+        k2, f2, w2 = self.second
+        self.text = "\n".join([
+            f"DATA RACE on {label}",
+            f"  {k1} in {f1} ({w1})",
+            f"  conflicting {k2} in {f2} ({w2})",
+            "  synchronization: the accessing goroutines share no "
+            "release/acquire chain — no channel send/recv, mutex or "
+            "RWMutex unlock/lock, WaitGroup Done/Wait, Once, or go "
+            "spawn edge orders the first access before the second",
+        ])
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return self.text
+
+
+class _Cell:
+    """Shadow state for one (object, field/index): the last write
+    epoch and the read epochs since (FastTrack-style)."""
+
+    __slots__ = ("wgid", "wtick", "wfunc", "reads")
+
+    def __init__(self):
+        self.wgid = None
+        self.wtick = 0
+        self.wfunc = ""
+        self.reads = {}  # gid -> (tick, func_label)
+
+
+class RaceState:
+    """Vector clocks, shadow cells, and race reports for ONE scheduler
+    (one interpreted program).  Created lazily at the first spawn,
+    detached by the end-of-suite sweep."""
+
+    def __init__(self, sched):
+        self.sched = sched
+        self.clocks = {0: {0: 1}}   # gid -> vector clock
+        self.cells = {}             # (id(obj), key) -> _Cell
+        self.pins = []              # keep shadowed objects alive: id()
+        #                             reuse would alias unrelated cells
+        self.reports = []
+        self.seen = set()
+        self.checked = 0
+        self.merges = 0
+        self.races = 0
+        self.paused = 0
+        self.live = True
+        ACTIVE[0] += 1
+        bind_thread(self)
+
+    # -- clocks ----------------------------------------------------------
+
+    def _clock(self, gid: int) -> dict:
+        c = self.clocks.get(gid)
+        if c is None:
+            c = self.clocks[gid] = {gid: 1}
+        return c
+
+    def _tick(self, gid: int) -> None:
+        c = self._clock(gid)
+        c[gid] = c.get(gid, 0) + 1
+
+    def on_spawn(self, parent_gid: int, child_gid: int) -> None:
+        """``go`` edge: the child starts with the parent's knowledge;
+        both tick so later parent work is unordered with the child."""
+        parent = self._clock(parent_gid)
+        child = dict(parent)
+        child[child_gid] = 1
+        self.clocks[child_gid] = child
+        self._tick(parent_gid)
+        self.merges += 1
+        bind_thread(self)  # the spawner's thread records for this state
+
+    def release(self, store, gid=None) -> dict:
+        """Merge goroutine *gid*'s clock into a sync object's *store*
+        clock (returning the new store) and tick the goroutine."""
+        if gid is None:
+            gid = self.sched.current.gid
+        c = self._clock(gid)
+        if store is None:
+            store = dict(c)
+        else:
+            for k, v in c.items():
+                if store.get(k, 0) < v:
+                    store[k] = v
+        self._tick(gid)
+        self.merges += 1
+        return store
+
+    def acquire(self, store, gid=None) -> None:
+        """Merge a sync object's *store* clock into goroutine *gid*'s."""
+        if store is None:
+            return
+        if gid is None:
+            gid = self.sched.current.gid
+        c = self._clock(gid)
+        for k, v in store.items():
+            if c.get(k, 0) < v:
+                c[k] = v
+        self.merges += 1
+
+    # -- shadow accesses -------------------------------------------------
+
+    def _ordered(self, clock: dict, gid: int, tick: int) -> bool:
+        return clock.get(gid, 0) >= tick
+
+    def _where(self, gid: int) -> str:
+        if gid == 0:
+            return "main goroutine"
+        goroutines = self.sched.goroutines
+        site = goroutines[gid].site if gid < len(goroutines) else "<go>"
+        return f"goroutine spawned at {site}"
+
+    def _report(self, label, access_a, access_b) -> None:
+        race = GoRace(label, access_a, access_b)
+        if race.text in self.seen:
+            return
+        self.seen.add(race.text)
+        self.reports.append(race)
+        self.races += 1
+
+    def note_write(self, obj, key, label: str) -> None:
+        if self.paused or not self.live:
+            return
+        try:
+            cell_key = (id(obj), key)
+            cell = self.cells.get(cell_key)
+        except TypeError:
+            return  # unhashable index — out of scope
+        gid = self.sched.current.gid
+        clock = self._clock(gid)
+        self.checked += 1
+        func = _current_func()
+        if cell is None:
+            cell = _Cell()
+            self.cells[cell_key] = cell
+            self.pins.append(obj)
+        else:
+            if cell.wgid is not None and cell.wgid != gid and not (
+                self._ordered(clock, cell.wgid, cell.wtick)
+            ):
+                self._report(
+                    label,
+                    ("write", cell.wfunc, self._where(cell.wgid)),
+                    ("write", func, self._where(gid)),
+                )
+            for rgid, (rtick, rfunc) in cell.reads.items():
+                if rgid != gid and not self._ordered(clock, rgid, rtick):
+                    self._report(
+                        label,
+                        ("write", func, self._where(gid)),
+                        ("read", rfunc, self._where(rgid)),
+                    )
+        cell.wgid = gid
+        cell.wtick = clock.get(gid, 1)
+        cell.wfunc = func
+        cell.reads.clear()
+
+    def note_read(self, obj, key, label: str) -> None:
+        if self.paused or not self.live:
+            return
+        try:
+            cell_key = (id(obj), key)
+            cell = self.cells.get(cell_key)
+        except TypeError:
+            return
+        gid = self.sched.current.gid
+        clock = self._clock(gid)
+        self.checked += 1
+        func = _current_func()
+        if cell is None:
+            cell = _Cell()
+            self.cells[cell_key] = cell
+            self.pins.append(obj)
+        elif cell.wgid is not None and cell.wgid != gid and not (
+            self._ordered(clock, cell.wgid, cell.wtick)
+        ):
+            self._report(
+                label,
+                ("write", cell.wfunc, self._where(cell.wgid)),
+                ("read", func, self._where(gid)),
+            )
+        cell.reads[gid] = (clock.get(gid, 1), func)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def take_reports(self) -> list:
+        """Drain accumulated race reports as sorted rendered strings
+        (sorted: accumulation order is schedule-dependent, the drained
+        bytes must not be)."""
+        out = sorted(r.text for r in self.reports)
+        self.reports = []
+        return out
+
+    def detach(self) -> None:
+        """End of program: stop recording, flush counters."""
+        if not self.live:
+            return
+        self.live = False
+        ACTIVE[0] -= 1
+        from ..perf import metrics
+
+        if self.checked:
+            metrics.counter("sanitize.checked").inc(self.checked)
+        if self.merges:
+            metrics.counter("sanitize.clock_merges").inc(self.merges)
+        if self.races:
+            metrics.counter("sanitize.races").inc(self.races)

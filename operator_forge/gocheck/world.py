@@ -441,6 +441,11 @@ class GoTestM:
                 t.messages.append(
                     f"goroutine (spawned at {site}): {msg}"
                 )
+            # the race detector's verdicts fail the owning test, like
+            # `go test -race` (reports are canonical sorted strings)
+            for report in sched.take_races():
+                t.failed = True
+                t.messages.append(report)
             self.ran.append(name)
             if t.failed:
                 code = 1
@@ -929,6 +934,11 @@ class EmittedSuite:
             # setup/teardown): the suite still fails, spawn-site tagged
             m.failures.append((f"goroutine@{site}", [msg]))
             code = code or 1
+        for report in sched.take_races():
+            # races surfacing outside any test body (suite teardown,
+            # leaked goroutines racing during the sweep)
+            m.failures.append(("race", [report]))
+            code = code or 1
         return (code, m)
 
 
@@ -1127,6 +1137,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
     from ..perf import parallel_map, spans
     from . import cache as gocheck_cache
     from . import compiler
+    from . import sanitize
 
     from .interp import current_seed
 
@@ -1137,7 +1148,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
         key = gocheck_cache.check_key(
             root, files=state, include_e2e=include_e2e,
             run_filter=run_filter or "", mode=compiler.mode(),
-            seed=current_seed(),
+            seed=current_seed(), race=sanitize.race_mode(),
         )
         cached = gocheck_cache.check_get(key)
         if cached is not None:
@@ -1207,7 +1218,7 @@ def run_project_tests(root: str, include_e2e: bool = False,
             pkg_key = (
                 "check.pkg", gocheck_cache._SCHEMA, _version, root,
                 root_abs, rel, bool(include_e2e), run_filter or "", mode,
-                current_seed(),
+                current_seed(), sanitize.race_mode(),
             )
             live: list = []
 

@@ -486,6 +486,7 @@ def tier_report() -> dict:
         "sched.goroutines", "sched.leaked", "sched.deadlocks",
         "render.lowered", "render.hydrated", "render.executed",
         "render.deopt",
+        "sanitize.checked", "sanitize.clock_merges", "sanitize.races",
     ):
         out[name] = counts.get(name, 0)
     return out

@@ -547,6 +547,63 @@ print(
     )
 )
 
+# sanitizer tier (PR 19): the armed happens-before detector stays
+# within 3x of race-off on an EXECUTING clean suite without flipping a
+# verdict; the seeded racy package's report (race verdicts embedded in
+# the suite failures) is byte-identical across seed/tier/cache/worker
+# legs and actually reports; the sanitizer analyzers
+# (nilness/unusedwrite/deadcode/syncchecks) stay silent over the
+# emitted kitchen-sink and monorepo-lite trees; and every racy corpus
+# workload reports under the detector.
+sanitize = detail["sanitize"]
+assert sanitize["race_overhead_ok"] is True, (
+    "race-on executing suite over the 3x bar vs race-off: %.2fx"
+    % sanitize["race_overhead_x"]
+)
+assert sanitize["race_on_suite_green"] is True, (
+    "the armed detector failed a correctly synchronized suite"
+)
+assert sanitize["race_verdicts_unchanged"] is True, (
+    "arming the detector changed a clean suite's report"
+)
+assert sanitize["racy_reports_found"] > 0, (
+    "the seeded racy package reported no race"
+)
+for cache_mode, ok in sanitize["identity_by_cache_mode"].items():
+    assert ok is True, (
+        f"race-report identity failed (cache={cache_mode})"
+    )
+assert sanitize["static_zero_findings"]["kitchen_sink"] is True, (
+    "sanitizer analyzers reported findings on the kitchen-sink tree"
+)
+assert sanitize["static_zero_findings"]["monorepo_lite"] is True, (
+    "sanitizer analyzers reported findings on the monorepo-lite tree"
+)
+assert sanitize["racy_corpus"]["all_race"] is True, (
+    "a known-racy corpus workload did not report"
+)
+assert sanitize["counters"].get("sanitize.checked", 0) > 0, (
+    "the armed detector checked no accesses"
+)
+print(
+    "sanitize contract OK: race-off=%.3fs race-on=%.3fs (x%.2f, bar "
+    "3x), clean suite green with %d accesses checked / %d clock "
+    "merges, racy package reported %d race(s) byte-identically in %d "
+    "cache modes (thread+process legs), analyzers silent on both "
+    "emitted trees, corpus %d/%d racing"
+    % (
+        sanitize["race_off_cpu_s_median"],
+        sanitize["race_on_cpu_s_median"],
+        sanitize["race_overhead_x"],
+        sanitize["counters"].get("sanitize.checked", 0),
+        sanitize["counters"].get("sanitize.clock_merges", 0),
+        sanitize["racy_reports_found"],
+        len(sanitize["identity_by_cache_mode"]),
+        sanitize["racy_corpus"]["workloads"],
+        sanitize["racy_corpus"]["workloads"],
+    )
+)
+
 # editor loop (PR 17): warm edit-one-file re-vet on kitchen-sink under
 # the latency bar (p99 from the per-tenant SLO histogram, 8 concurrent
 # background batch clients on the same daemon); the supersede burst
@@ -1847,6 +1904,15 @@ for knob in "OPERATOR_FORGE_DAEMON_SUPERSEDE=on" "OPERATOR_FORGE_DAEMON_SUPERSED
     fi
 done
 echo "completions OK: OPERATOR_FORGE_DAEMON_SUPERSEDE|EDITOR_BOOST=on|off present"
+
+# ... and the race-detector knob with both of its values.
+for knob in "OPERATOR_FORGE_GOCHECK_RACE=on" "OPERATOR_FORGE_GOCHECK_RACE=off"; do
+    if ! (cd "$repo_root" && "${PYTHON:-python3}" -m operator_forge.cli.main completion bash | grep -q "$knob"); then
+        echo "completions missing '$knob'" >&2
+        exit 1
+    fi
+done
+echo "completions OK: OPERATOR_FORGE_GOCHECK_RACE=on|off present"
 
 # Analyzer zero-findings gate over the reference corpus (when the
 # checkout is mounted): the corpus compiles, so every analyzer —

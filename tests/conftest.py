@@ -55,7 +55,9 @@ def pytest_configure(config):
             diags = analyze_source(
                 "package golden\n" + text,
                 os.path.relpath(path, golden_root),
-                analyzers=("syntax", "lint", "shadow", "structtag"),
+                analyzers=("syntax", "lint", "shadow", "structtag",
+                           "nilness", "unusedwrite", "deadcode",
+                           "syncchecks"),
             )
             problems.extend(
                 dataclasses.replace(

@@ -121,18 +121,95 @@ metadata:
 """
 
 
+#: known-racy Go workloads for the sanitizer corpus (``with_races``):
+#: each template is a self-contained package whose exported entry
+#: point races deterministically under the happens-before detector —
+#: alternating a shared-map race and a struct-field race, so the
+#: corpus covers both shadow-cell shapes.  Struct literals spell out
+#: every field (the interpreter does not zero-initialize).
+_RACY_MAP_TEMPLATE = '''package race{index:02d}
+
+import "sync"
+
+// Run{index:02d} tallies into a shared map with no lock: a seeded
+// write/write race for the sanitizer corpus.
+func Run{index:02d}(workers int) int {{
+	totals := map[string]int{{"n": 0}}
+	var wg sync.WaitGroup
+	for i := 0; i < workers; i++ {{
+		wg.Add(1)
+		go func() {{
+			defer wg.Done()
+			totals["n"] = totals["n"] + {delta}
+		}}()
+	}}
+	wg.Wait()
+	return totals["n"]
+}}
+'''
+
+_RACY_FIELD_TEMPLATE = '''package race{index:02d}
+
+import "sync"
+
+type state{index:02d} struct {{
+	n int
+}}
+
+// Run{index:02d} bumps a shared struct field with no lock: a seeded
+// write/write race for the sanitizer corpus.
+func Run{index:02d}(workers int) int {{
+	s := &state{index:02d}{{n: 0}}
+	var wg sync.WaitGroup
+	for i := 0; i < workers; i++ {{
+		wg.Add(1)
+		go func() {{
+			defer wg.Done()
+			s.n = s.n + {delta}
+		}}()
+	}}
+	wg.Wait()
+	return s.n
+}}
+'''
+
+
+def write_racy_workloads(dst: str, count: int) -> list:
+    """Write *count* known-racy Go workloads under ``dst/racy/`` and
+    return their paths: the positive half of the sanitizer's corpus
+    gate (every one must report a race; every clean emitted tree must
+    report none).  Byte-deterministic for a given count."""
+    racy_dir = os.path.join(dst, "racy")
+    os.makedirs(racy_dir, exist_ok=True)
+    paths = []
+    for i in range(count):
+        template = (
+            _RACY_MAP_TEMPLATE if i % 2 == 0 else _RACY_FIELD_TEMPLATE
+        )
+        path = os.path.join(racy_dir, f"race{i:02d}.go")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(template.format(index=i, delta=(i % 3) + 1))
+        paths.append(path)
+    return paths
+
+
 def _camel(name: str) -> str:
     return name[0].lower() + name[1:].replace("-", "")
 
 
-def write_monorepo_lite(dst: str, workloads: int = 40) -> str:
+def write_monorepo_lite(dst: str, workloads: int = 40,
+                        with_races: int = 0) -> str:
     """Write the fixture family under *dst* (created if needed) and
     return the path of the collection ``workload.yaml``.  *workloads*
     counts the collection itself plus its components (minimum 2).
+    *with_races* additionally emits that many known-racy Go workloads
+    under ``dst/racy/`` (see :func:`write_racy_workloads`).
     Byte-deterministic for a given size."""
     if workloads < 2:
         raise ValueError("monorepo-lite needs at least 2 workloads")
     os.makedirs(dst, exist_ok=True)
+    if with_races:
+        write_racy_workloads(dst, with_races)
     components = workloads - 1
     component_files = []
     for i in range(components):

@@ -1429,3 +1429,200 @@ def apply_analyzer_mutant(proj: str, mutant: dict) -> tuple[str, str]:
         )
         mutated = mutated.replace(old, new, 1)
     return original, mutated
+
+
+# -- sanitizer kill oracles (PR 19) ----------------------------------------
+#
+# Seeded codegen regressions of the synchronization discipline, each
+# killed deterministically by exactly one sanitizer: the happens-before
+# race detector (``killed_by: "race"`` — run the harness, expect
+# reports) or one syncchecks pattern (``killed_by: "syncchecks"`` —
+# static, no execution needed).  The baseline harness is clean under
+# both, which is what makes each kill attributable.
+#
+# NOTE: the interpreter does not zero-initialize missing composite
+# literal fields, so every struct literal spells its fields out.
+
+RACE_HARNESS_GO = '''package worker
+
+import "sync"
+
+type Status struct {
+	phase string
+	count int
+}
+
+// Tally aggregates worker results into a shared map under a mutex.
+func Tally(workers int) int {
+	totals := map[string]int{"done": 0}
+	var mu sync.Mutex
+	var wg sync.WaitGroup
+	for i := 0; i < workers; i++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			mu.Lock()
+			totals["done"] = totals["done"] + 1
+			mu.Unlock()
+		}()
+	}
+	wg.Wait()
+	return totals["done"]
+}
+
+// Reconcile updates shared status from parallel reconcilers.
+func Reconcile(workers int) int {
+	status := &Status{phase: "pending", count: 0}
+	var mu sync.Mutex
+	var wg sync.WaitGroup
+	for i := 0; i < workers; i++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			mu.Lock()
+			status.count = status.count + 1
+			status.phase = "ready"
+			mu.Unlock()
+		}()
+	}
+	wg.Wait()
+	return status.count
+}
+'''
+
+RACE_MUTANTS = [
+    {
+        "construct": "dropped-mutex-map",
+        "detail": "the mutex around the shared tally map was dropped: "
+                  "unordered read-modify-write on the map entry",
+        "replacements": [(
+            "\t\t\tmu.Lock()\n"
+            "\t\t\ttotals[\"done\"] = totals[\"done\"] + 1\n"
+            "\t\t\tmu.Unlock()\n",
+            "\t\t\ttotals[\"done\"] = totals[\"done\"] + 1\n",
+        )],
+        "killed_by": "race",
+    },
+    {
+        "construct": "status-write-outside-lock",
+        "detail": "the status phase write moved outside the reconcile "
+                  "lock: unordered write/write on Status.phase",
+        "replacements": [(
+            "\t\t\tstatus.phase = \"ready\"\n"
+            "\t\t\tmu.Unlock()\n",
+            "\t\t\tmu.Unlock()\n"
+            "\t\t\tstatus.phase = \"ready\"\n",
+        )],
+        "killed_by": "race",
+    },
+    {
+        "construct": "add-inside-goroutine",
+        "detail": "WaitGroup.Add moved into the spawned goroutine: "
+                  "Wait may return before the goroutine is counted",
+        "replacements": [(
+            "\t\twg.Add(1)\n"
+            "\t\tgo func() {\n"
+            "\t\t\tdefer wg.Done()\n"
+            "\t\t\tmu.Lock()\n"
+            "\t\t\ttotals[\"done\"]",
+            "\t\tgo func() {\n"
+            "\t\t\twg.Add(1)\n"
+            "\t\t\tdefer wg.Done()\n"
+            "\t\t\tmu.Lock()\n"
+            "\t\t\ttotals[\"done\"]",
+        )],
+        "killed_by": "syncchecks",
+    },
+    {
+        "construct": "missing-done",
+        "detail": "the counted reconcile goroutine lost its "
+                  "`defer wg.Done()`: Wait can never drain that path",
+        "replacements": [(
+            "\t\t\tdefer wg.Done()\n"
+            "\t\t\tmu.Lock()\n"
+            "\t\t\tstatus.count",
+            "\t\t\tmu.Lock()\n"
+            "\t\t\tstatus.count",
+        )],
+        "killed_by": "syncchecks",
+    },
+    {
+        "construct": "double-unlock",
+        "detail": "the reconcile critical section unlocks twice: "
+                  "fatal at runtime in Go",
+        "replacements": [(
+            "\t\t\tstatus.phase = \"ready\"\n"
+            "\t\t\tmu.Unlock()\n",
+            "\t\t\tstatus.phase = \"ready\"\n"
+            "\t\t\tmu.Unlock()\n"
+            "\t\t\tmu.Unlock()\n",
+        )],
+        "killed_by": "syncchecks",
+    },
+    {
+        "construct": "mutex-copy",
+        "detail": "the tally guard copied by value after first use: "
+                  "the copy has its own state and guards nothing",
+        "replacements": [(
+            "\twg.Wait()\n\treturn totals[",
+            "\twg.Wait()\n"
+            "\tguard := mu\n"
+            "\tguard.Lock()\n"
+            "\tguard.Unlock()\n"
+            "\treturn totals[",
+        )],
+        "killed_by": "syncchecks",
+    },
+]
+
+
+def apply_race_mutant(mutant: dict) -> str:
+    """RACE_HARNESS_GO with one RACE_MUTANTS entry applied; asserts
+    every replacement site exists so harness drift surfaces loudly."""
+    mutated = RACE_HARNESS_GO
+    for old, new in mutant["replacements"]:
+        assert old in mutated, (
+            f"race mutant site missing: {old!r}"
+        )
+        mutated = mutated.replace(old, new, 1)
+    return mutated
+
+
+def run_race_harness(src: str) -> tuple:
+    """(fingerprint, race reports) for one harness source with the
+    race detector force-armed — the dynamic kill oracle's verdict
+    input.  Reports come back as the detector's canonical sorted
+    strings, so equality here IS byte identity."""
+    from operator_forge.gocheck import sanitize
+    from operator_forge.gocheck.interp import GoInterpError, Interp
+
+    sanitize.set_race(True)
+    try:
+        interp = Interp()
+        interp.load_source(src, "worker.go")
+        fingerprint = []
+        for label, call in (
+            ("tally", lambda: interp.call("Tally", 3)),
+            ("reconcile", lambda: interp.call("Reconcile", 3)),
+        ):
+            try:
+                fingerprint.append((label, _freeze(call())))
+            except GoInterpError as exc:
+                fingerprint.append((label, f"!{type(exc).__name__}"))
+        races = tuple(interp.sched.take_races())
+        interp.sched.sweep()
+        return (tuple(fingerprint), races)
+    finally:
+        sanitize.set_race(None)
+
+
+def race_kill_verdict(baseline: tuple, mutated: tuple) -> str | None:
+    """Which sanitizer verdict killed a dynamic race mutant: ``race``
+    (the detector reported), ``fingerprint`` (output drift), or None
+    for a survivor."""
+    fingerprint, races = mutated
+    if races:
+        return "race"
+    if fingerprint != baseline[0]:
+        return "fingerprint"
+    return None

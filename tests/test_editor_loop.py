@@ -589,6 +589,131 @@ class TestDaemonSupersede:
             daemon.stop()
 
 
+_RACY_HELPER_GO = """
+// raceHelper regressed: the WaitGroup is counted inside the goroutine
+// it counts (the PR 19 sanitizer's syncchecks class).
+func raceHelper() {
+	var raceWg sync.WaitGroup
+	go func() {
+		raceWg.Add(1)
+		raceWg.Done()
+	}()
+	raceWg.Wait()
+}
+"""
+
+
+class TestSanitizerPush:
+    """PR 19: a racy overlay edit pushes a sanitizer diagnostic via
+    subscribe, and a superseded lint never answers a phantom race."""
+
+    def _racy_edit(self, original: str) -> str:
+        assert "import (" in original
+        return original.replace(
+            "import (\n", 'import (\n\t"sync"\n', 1
+        ) + _RACY_HELPER_GO
+
+    def test_racy_overlay_pushes_diagnostic(self, tmp_path, project):
+        daemon = _start_daemon(tmp_path)
+        target = _a_go_file(project)
+        original = open(target).read()
+        try:
+            with DaemonClient(daemon.address()) as sub, \
+                    DaemonClient(daemon.address()) as editor:
+                # clean baseline primes the caches
+                clean = sub.request({"op": "job", "job": {
+                    "command": "lint", "path": project,
+                    "analyzers": "syncchecks",
+                }})
+                assert clean["ok"], clean
+                assert "syncchecks" not in clean["stdout"]
+
+                def edit():
+                    time.sleep(0.4)
+                    resp = editor.request({
+                        "op": "overlay", "path": target,
+                        "content": self._racy_edit(original),
+                    })
+                    assert resp["ok"], resp
+
+                poker = threading.Thread(target=edit)
+                poker.start()
+                sub.send({
+                    "op": "subscribe", "id": "race-sub", "cycles": 2,
+                    "interval": 30,
+                    "jobs": [{"command": "lint", "path": project,
+                              "analyzers": "syncchecks"}],
+                })
+                lines = []
+                while True:
+                    line = sub.read()
+                    assert line is not None
+                    lines.append(line)
+                    if line.get("done"):
+                        break
+                poker.join()
+                # the second cycle is the overlay wake: its lint result
+                # carries the syncchecks diagnostic for the racy edit
+                pushed = lines[1]["results"][0]
+                assert "syncchecks" in pushed["stdout"], pushed
+                assert "raceWg.Add called inside the goroutine" in (
+                    pushed["stdout"]
+                )
+        finally:
+            daemon.stop()
+
+    def test_superseded_lint_never_phantom_race(self, tmp_path, project):
+        """A lint superseded mid-queue answers `superseded` — no
+        diagnostics, no partial race report — while the superseding
+        request reports the real findings."""
+        daemon = _start_daemon(tmp_path)
+        target = _a_go_file(project)
+        original = open(target).read()
+        try:
+            with DaemonClient(daemon.address()) as client:
+                prime = client.request({"op": "job", "job": {
+                    "command": "lint", "path": project,
+                    "analyzers": "syncchecks",
+                }})
+                assert prime["ok"], prime
+                resp = client.request({
+                    "op": "overlay", "path": target,
+                    "content": self._racy_edit(original),
+                })
+                assert resp["ok"], resp
+                # occupy the session, then pipeline two same-key lints:
+                # the older is still queued when the newer arrives
+                client.send({
+                    "op": "watch", "id": "busy", "cycles": 1,
+                    "interval": 0.05,
+                    "jobs": [{"command": "vet", "path": project}],
+                })
+                raw = b""
+                for rid in ("old-lint", "new-lint"):
+                    raw += (json.dumps({
+                        "op": "job", "id": rid, "job": {
+                            "command": "lint", "path": project,
+                            "analyzers": "syncchecks",
+                        },
+                    }) + "\n").encode("utf-8")
+                client._sock.sendall(raw)
+                by_id: dict = {}
+                while "old-lint" not in by_id or "new-lint" not in by_id:
+                    line = client.read()
+                    assert line is not None, by_id
+                    if line.get("id") in ("old-lint", "new-lint"):
+                        by_id[line["id"]] = line
+                old = by_id["old-lint"]
+                assert old["ok"] is False
+                assert old["error_kind"] == "superseded"
+                # never a phantom finding on the superseded answer
+                assert "syncchecks" not in json.dumps(old)
+                new = by_id["new-lint"]
+                assert "syncchecks" in new["stdout"]
+        finally:
+            daemon.stop()
+
+
 class TestEditorStatsSurface:
     EXPECTED_KEYS = [
         "overlays", "overlay_sets", "boost_delays", "push_cycles",
