@@ -857,8 +857,12 @@ def cmd_fleet(args: argparse.Namespace) -> int:
     The Bazel --remote_executor analogue."""
     from ..serve.fleet import serve_fleet
 
+    elastic = None
+    if args.max:
+        elastic = {"min": args.min, "max": args.max}
     return serve_fleet(
-        args.listen, lease=args.lease, clients=args.clients
+        args.listen, lease=args.lease, clients=args.clients,
+        elastic=elastic,
     )
 
 
@@ -897,19 +901,35 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
         print("error: no fleet surface in the stats payload "
               "(is this a coordinator?)", file=sys.stderr)
         return 1
+    scale = fleet.get("scale") or {}
+    scale_note = (
+        f" autoscale={scale.get('min', 0)}..{scale.get('max', 0)}"
+        if scale.get("max") else ""
+    )
     print(
         f"fleet: {fleet['listen']} lease={fleet['lease_s']:g}s "
         f"members={len(fleet['members'])} "
         f"queued={fleet['queued_requests']} "
-        f"affinities={fleet['affinities']}"
+        f"affinities={fleet['affinities']} "
+        f"populated={fleet.get('populated_namespaces', 0)}"
+        f"{scale_note}"
     )
     for member_id, m in fleet["members"].items():
+        artifact = m.get("artifact") or {}
         print(
             f"  {member_id}  {m['addr']}  {m['state']}"
-            f"{' degraded' if m['degraded'] else ''}  "
+            f"{' degraded' if m['degraded'] else ''}"
+            f"{' spawned' if m.get('spawned') else ''}  "
             f"lease_age={m['lease_age_s']:.2f}s  "
             f"in_flight={m['in_flight']}/{m['capacity']}  "
-            f"queued={m['queued']}  dispatched={m['dispatched']}"
+            f"queued={m['queued']}  dispatched={m['dispatched']}  "
+            f"namespaces={m.get('namespaces', 0)}  "
+            "artifact["
+            + " ".join(
+                f"{key}={artifact.get(key, 0)}"
+                for key in sorted(artifact)
+            )
+            + "]"
         )
     counters = fleet["counters"]
     print(
@@ -1546,6 +1566,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--clients", type=int, default=None, metavar="N",
         help="concurrent-connection ceiling (default: "
              "OPERATOR_FORGE_FLEET_CLIENTS, 128)",
+    )
+    p_fleet.add_argument(
+        "--min", type=int, default=0, metavar="N",
+        help="autoscaler pool floor: keep at least N daemons "
+             "registered, spawning coordinator-owned ones when short "
+             "(default: OPERATOR_FORGE_FLEET_MIN)",
+    )
+    p_fleet.add_argument(
+        "--max", type=int, default=0, metavar="N",
+        help="autoscaler pool ceiling; 0 disables elasticity "
+             "(default: OPERATOR_FORGE_FLEET_MAX).  Spawned daemons "
+             "get private cache roots and share artifacts only "
+             "through OPERATOR_FORGE_REMOTE_CACHE",
     )
     p_fleet.set_defaults(func=cmd_fleet)
 

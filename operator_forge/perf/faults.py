@@ -78,6 +78,19 @@ kind                 planted site           effect when fired
                                             sleeps past the fleet dispatch
                                             deadline (hung daemon:
                                             deadline-then-re-dispatch path)
+``fleet.partition``  ``link``               the daemon's fleet link drops its
+                                            next beats WITHOUT closing the
+                                            connection (severed network): the
+                                            lease ages through suspect into
+                                            eviction, and the rejoin goes
+                                            through the stale-lease refusal
+                                            then re-register path
+``fleet.steal_kill`` ``steal``              the coordinator's dispatch
+                                            connection is severed after a
+                                            STOLEN submission was sent (the
+                                            target died mid-steal, its tree
+                                            half-hydrated: fence +
+                                            re-dispatch path)
 ``flight.write_error`` ``capsule``          the flight-recorder capsule write
                                             raises (full/readonly disk): the
                                             recorder must count and carry
@@ -126,6 +139,8 @@ KINDS = (
     "fleet.daemon_crash",
     "fleet.heartbeat_lost",
     "fleet.dispatch_hang",
+    "fleet.partition",
+    "fleet.steal_kill",
     "flight.write_error",
 )
 

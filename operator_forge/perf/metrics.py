@@ -350,6 +350,26 @@ def cache_report() -> dict:
     return out
 
 
+def artifact_report() -> dict:
+    """This process's artifact-plane attribution in stable key order:
+    how much of its work came off the remote cache tier (hit/miss/
+    corrupt/put round trips) and how many worker-shipped closure
+    hydrations it performed (``compile.hydrated`` + ``render.hydrated``
+    — the cold-worker ~15-19x proof).  The daemon ships this in every
+    fleet heartbeat so ``fleet-status`` can attribute the shared
+    remote tier per member; the serve ``stats`` op reports it for the
+    local process."""
+    counts = counters_snapshot()
+    return {
+        "hydrated": counts.get("compile.hydrated", 0)
+        + counts.get("render.hydrated", 0),
+        "remote_corrupt": counts.get("cache.remote_corrupt", 0),
+        "remote_hits": counts.get("cache.remote_hits", 0),
+        "remote_misses": counts.get("cache.remote_misses", 0),
+        "remote_puts": counts.get("cache.remote_puts", 0),
+    }
+
+
 #: overflow tenant label once the cardinality cap is hit
 SLO_OVERFLOW = "overflow"
 

@@ -1,0 +1,108 @@
+"""Shared stream-socket address plumbing for every network surface.
+
+The daemon, the fleet coordinator, and the remote cache server all
+speak over the same two transports — a unix socket (``unix:/path`` or
+any bare path) or TCP (``host:port`` / ``:port``) — and each grew its
+own copy of the parse/bind/connect boilerplate.  This module is the
+single shared implementation: one parser, one listener factory (stale
+unix-path unlink, ``SO_REUSEADDR`` for TCP, optional accept deadline),
+one client-side connector (deadline on both the connect and subsequent
+reads), and one bound-address formatter (resolving a TCP port-0 bind
+to the real port).  ``operator_forge.perf.remote.parse_listen`` stays
+as a re-export for the PR 9 import surface.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+
+def parse_listen(addr: str):
+    """Parse a listen/connect address: ``unix:/path`` (or any string
+    containing a path separator) selects a unix socket, ``host:port``
+    (or ``:port``) TCP."""
+    addr = addr.strip()
+    if not addr:
+        raise ValueError("empty remote cache address")
+    if addr.startswith("unix:"):
+        return ("unix", addr[len("unix:"):])
+    if os.sep in addr or "/" in addr:
+        return ("unix", addr)
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        raise ValueError(
+            f"remote cache address {addr!r} must be unix:/path, a "
+            "socket path, or host:port"
+        )
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise ValueError(
+            f"remote cache address {addr!r}: port must be an integer"
+        ) from None
+    return ("tcp", host or "127.0.0.1", port_n)
+
+
+def bind_listener(addr, backlog: int = 64, accept_timeout=None):
+    """Bind and return a listening socket for ``addr`` (a string in
+    :func:`parse_listen` syntax, or an already-parsed spec tuple).  A
+    unix bind unlinks a stale socket path first; a TCP bind sets
+    ``SO_REUSEADDR``.  ``accept_timeout`` (seconds) makes ``accept``
+    poll instead of block forever — how the daemon and coordinator
+    notice a shutdown flag."""
+    spec = parse_listen(addr) if isinstance(addr, str) else addr
+    if spec[0] == "unix":
+        try:
+            os.unlink(spec[1])
+        except OSError:
+            pass
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.bind(spec[1])
+            sock.listen(backlog)
+        except BaseException:
+            sock.close()
+            raise
+    else:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((spec[1], spec[2]))
+            sock.listen(backlog)
+        except BaseException:
+            sock.close()
+            raise
+    if accept_timeout is not None:
+        sock.settimeout(accept_timeout)
+    return sock
+
+
+def bound_address(spec, listener) -> str:
+    """The actual bound address for a listener made from ``spec`` —
+    resolves a TCP port-0 bind to the kernel-assigned port."""
+    if spec[0] == "unix":
+        return spec[1]
+    host, port = listener.getsockname()[:2]
+    return f"{host}:{port}"
+
+
+def connect_stream(addr, timeout=None):
+    """Connect to ``addr`` (:func:`parse_listen` syntax or a parsed
+    spec) and return the socket, with ``timeout`` applied to both the
+    connect and subsequent reads.  Raises the usual ``OSError`` family
+    on failure; the partially-opened socket is always closed."""
+    spec = parse_listen(addr) if isinstance(addr, str) else addr
+    if spec[0] == "unix":
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            if timeout is not None:
+                sock.settimeout(timeout)
+            sock.connect(spec[1])
+        except BaseException:
+            sock.close()
+            raise
+        return sock
+    sock = socket.create_connection((spec[1], spec[2]), timeout=timeout)
+    sock.settimeout(timeout)
+    return sock

@@ -64,6 +64,8 @@ import time
 from . import env_number
 from . import cache as pf_cache
 from . import faults
+from .netaddr import bind_listener, bound_address, connect_stream
+from .netaddr import parse_listen  # noqa: F401  (re-export: PR 9 surface)
 
 ENV_ADDR = "OPERATOR_FORGE_REMOTE_CACHE"
 
@@ -122,32 +124,6 @@ def idle_timeout_s() -> float:
         "OPERATOR_FORGE_CACHE_SERVER_IDLE_S", DEFAULT_IDLE_S,
         minimum=None,
     )
-
-
-def parse_listen(addr: str):
-    """Parse a listen/connect address: ``unix:/path`` (or any string
-    containing a path separator) selects a unix socket, ``host:port``
-    (or ``:port``) TCP."""
-    addr = addr.strip()
-    if not addr:
-        raise ValueError("empty remote cache address")
-    if addr.startswith("unix:"):
-        return ("unix", addr[len("unix:"):])
-    if os.sep in addr or "/" in addr:
-        return ("unix", addr)
-    host, sep, port = addr.rpartition(":")
-    if not sep:
-        raise ValueError(
-            f"remote cache address {addr!r} must be unix:/path, a "
-            "socket path, or host:port"
-        )
-    try:
-        port_n = int(port)
-    except ValueError:
-        raise ValueError(
-            f"remote cache address {addr!r}: port must be an integer"
-        ) from None
-    return ("tcp", host or "127.0.0.1", port_n)
 
 
 # -- framing ---------------------------------------------------------------
@@ -276,10 +252,7 @@ class CacheServer:
 
     # the actual bound address (resolves TCP port 0)
     def address(self) -> str:
-        if self.spec[0] == "unix":
-            return self.spec[1]
-        host, port = self._listener.getsockname()[:2]
-        return f"{host}:{port}"
+        return bound_address(self.spec, self._listener)
 
     def start(self) -> None:
         """Bind and serve in a background accept thread (embedded use:
@@ -292,20 +265,7 @@ class CacheServer:
         self._accept_thread.start()
 
     def _bind(self) -> None:
-        if self.spec[0] == "unix":
-            path = self.spec[1]
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
-        else:
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            sock.bind((self.spec[1], self.spec[2]))
-        sock.listen(64)
-        self._listener = sock
+        self._listener = bind_listener(self.spec, backlog=64)
 
     def serve_forever(self) -> None:
         """Blocking accept loop (the CLI path); :meth:`stop` from a
@@ -332,6 +292,13 @@ class CacheServer:
 
     def stop(self) -> None:
         self._closing = True
+        try:
+            # closing an fd does NOT wake a thread parked in accept()
+            # on Linux — shutdown the listening socket first so the
+            # embedded accept thread unblocks and exits (join below)
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass
         try:
             self._listener.close()
         except (OSError, AttributeError):
@@ -619,18 +586,7 @@ def _connect():
         # test or bench leg flipping configuration): a plain transport
         # failure, handled by the normal retry/drop paths
         raise ConnectionError("remote cache not configured")
-    spec = parse_listen(addr)
-    deadline = timeout_s()
-    if spec[0] == "unix":
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(deadline)
-        sock.connect(spec[1])
-    else:
-        sock = socket.create_connection(
-            (spec[1], spec[2]), timeout=deadline
-        )
-        sock.settimeout(deadline)
-    return sock
+    return connect_stream(addr, timeout=timeout_s())
 
 
 def _roundtrip_locked(body: bytes):

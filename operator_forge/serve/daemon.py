@@ -60,9 +60,11 @@ import threading
 import time
 
 from ..perf import cache as pf_cache
-from ..perf import env_number, flight, metrics, n_jobs, spans
+from ..perf import env_number, faults, flight, metrics, n_jobs, spans
 from ..perf import overlay as pf_overlay
-from ..perf.remote import parse_listen
+from ..perf import remote as pf_remote
+from ..perf.netaddr import bind_listener, bound_address, connect_stream
+from ..perf.netaddr import parse_listen
 from . import runner
 from . import server
 from .batch import _overlaps
@@ -420,31 +422,17 @@ class ForgeDaemon:
     # -- lifecycle -------------------------------------------------------
 
     def address(self) -> str:
-        if self.spec[0] == "unix":
-            return self.spec[1]
-        host, port = self._listener.getsockname()[:2]
-        return f"{host}:{port}"
+        return bound_address(self.spec, self._listener)
 
     def _bind(self) -> None:
-        if self.spec[0] == "unix":
-            path = self.spec[1]
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
-        else:
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            sock.bind((self.spec[1], self.spec[2]))
-        sock.listen(min(128, self._max_clients * 2))
-        # a bounded accept timeout: neither close() nor shutdown()
+        # the bounded accept timeout: neither close() nor shutdown()
         # reliably wakes a thread blocked in accept() (AF_UNIX on
         # Linux), so the accept loop wakes on its own to observe the
         # drain flag — worst-case drain latency is one poll
-        sock.settimeout(0.5)
-        self._listener = sock
+        self._listener = bind_listener(
+            self.spec, backlog=min(128, self._max_clients * 2),
+            accept_timeout=0.5,
+        )
 
     def _boot(self) -> None:
         # per-request serve:* spans, the always-on event ring (the
@@ -850,6 +838,7 @@ class ForgeDaemon:
         client = None
         member_id = None
         backoff = 0
+        partition_skips = 0
         while not self._stop_event.is_set():
             try:
                 if client is None:
@@ -873,6 +862,19 @@ class ForgeDaemon:
                         interval = max(0.05, float(lease) / 3.0)
                     metrics.counter("daemon.fleet_registrations").inc()
                     backoff = 0
+                if faults.should_fire("fleet.partition", "link"):
+                    # deterministic network partition: the next beats
+                    # are dropped WITHOUT closing the link (exactly
+                    # what a severed network looks like from the
+                    # coordinator), so the lease ages through suspect
+                    # into eviction; the rejoin then goes through the
+                    # stale-lease refusal → re-register path below
+                    partition_skips = 7  # 7/3 lease: past the 2-lease evict
+                if partition_skips > 0:
+                    partition_skips -= 1
+                    if self._stop_event.wait(interval):
+                        break
+                    continue
                 in_flight, queued = self._fleet_load()
                 ack = client.request({
                     "op": "fleet.heartbeat",
@@ -882,6 +884,13 @@ class ForgeDaemon:
                     "degraded": bool(
                         workers.pool_state()["degraded"]
                     ),
+                    # per-daemon artifact-plane attribution: how much
+                    # of this member's work came off the remote tier,
+                    # and which per-project namespaces it has served —
+                    # the coordinator's locality-placement signal
+                    "artifact": metrics.artifact_report(),
+                    "namespaces": list(runner.served_scopes()),
+                    "remote_active": pf_remote.active(),
                 })
                 if not ack.get("ok"):
                     raise ConnectionError(
@@ -1075,20 +1084,7 @@ class DaemonClient:
         self._connect_with_retry()
 
     def _connect_once(self) -> None:
-        spec = parse_listen(self._addr)
-        if spec[0] == "unix":
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            if self._timeout:
-                sock.settimeout(self._timeout)
-            try:
-                sock.connect(spec[1])
-            except BaseException:
-                sock.close()
-                raise
-        else:
-            sock = socket.create_connection(
-                (spec[1], spec[2]), timeout=self._timeout
-            )
+        sock = connect_stream(self._addr, timeout=self._timeout)
         self._sock = sock
         self._reader = sock.makefile("r", encoding="utf-8")
 

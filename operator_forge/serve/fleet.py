@@ -25,11 +25,36 @@ Architecture:
   partitions replay records by), so repeat work over one tree lands on
   the daemon whose mem-tier already holds that tree's records.  Cold
   keys (and keys whose preferred daemon is suspect, degraded, or at
-  capacity) *work-steal*: the least-loaded healthy daemon takes them,
-  deterministically (load, then member id).  Submissions whose trees
-  overlap an in-flight dispatch are forced onto that dispatch's daemon,
-  where the PR 10 path locks serialize them — the fleet-level analogue
-  of the daemon's cross-session conflict rule;
+  capacity) *work-steal* deterministically, weighing remote-cache
+  locality between health and load: a member that has served the
+  namespace (heartbeat-reported) ranks first, then — when the
+  namespace is known populated in the shared remote tier — any
+  remote-active member (it cold-hydrates over the network at the
+  remote tier's cold-worker speedup), then everyone else; ties break
+  by load then member id.  Submissions whose trees overlap an
+  in-flight dispatch are forced onto that dispatch's daemon, where the
+  PR 10 path locks serialize them — the fleet-level analogue of the
+  daemon's cross-session conflict rule;
+- **shared-nothing artifact plane** — daemons share artifacts ONLY
+  through the PR 9 remote cache: every crash-retry root reset runs
+  behind the daemon-side ``fence`` op (the retry's target clears the
+  roots on its own filesystem), and the coordinator's residual local
+  sweep is gated by its own created-from-absence containment — on a
+  fleet whose daemons live on other hosts (or in private roots
+  simulating them) that sweep is structurally empty, so the
+  coordinator never touches a daemon's disk;
+- **elasticity** — with ``OPERATOR_FORGE_FLEET_MAX`` set (or the CLI's
+  ``--min``/``--max``), the monitor loop doubles as an autoscaler:
+  queue depth per healthy member and the PR 15 per-tenant SLO signal
+  (p99 over ``OPERATOR_FORGE_FLEET_SCALE_P99_S``, or deadline-miss
+  growth) spawn daemon subprocesses — each with a PRIVATE
+  ``OPERATOR_FORGE_CACHE_DIR``, so a cold spawn hydrates from the
+  shared remote tier, never a sibling's disk — and a fleet that sits
+  fully idle for ``OPERATOR_FORGE_FLEET_IDLE_S`` retires one
+  coordinator-spawned daemon per window (evict-then-drain: in-flight
+  work is answered first).  Scale events ride the same heartbeat/
+  suspect/evict machinery as crash churn, so byte-identity holds
+  across them by construction;
 - **re-dispatch** — submissions are idempotent: deterministic job ids
   (PR 3's manifest model, :func:`~operator_forge.serve.jobs.specs_key`)
   over content-keyed replay mean re-running a submission reproduces its
@@ -55,9 +80,13 @@ Architecture:
   propagated to the client;
 - **chaos sites** — ``fleet.daemon_crash@dispatch`` (the dispatch
   connection severed after the job is sent), ``fleet.heartbeat_lost@
-  lease`` (a received beat dropped without refreshing the lease), and
+  lease`` (a received beat dropped without refreshing the lease),
   ``fleet.dispatch_hang@route`` (the dispatch sleeps past the
-  ``OPERATOR_FORGE_FLEET_DISPATCH_S`` deadline) extend
+  ``OPERATOR_FORGE_FLEET_DISPATCH_S`` deadline), ``fleet.partition@
+  link`` (daemon-side: beats stop without the connection closing —
+  suspect, evict, stale-lease refusal, re-register), and
+  ``fleet.steal_kill@steal`` (a STOLEN dispatch's connection severed
+  after the send, mid-hydration) extend
   :mod:`operator_forge.perf.faults`; every one is recoverable, so
   chaos runs — including SIGKILL of a real daemon subprocess mid-batch
   — must stay byte-identical to a cache-off serial recompute (bench
@@ -82,11 +111,14 @@ import json
 import os
 import shutil
 import socket
+import subprocess
+import sys
+import tempfile
 import threading
 import time
 
 from ..perf import env_number, faults, flight, metrics, spans
-from ..perf.remote import parse_listen
+from ..perf.netaddr import bind_listener, bound_address, parse_listen
 from . import server
 from .batch import _overlaps, run_batch
 from .daemon import DaemonClient
@@ -96,7 +128,12 @@ from .jobs import (
     specs_from_request,
     specs_key,
 )
-from .runner import _scope_label, run_job
+from .runner import (
+    _scope_label,
+    is_fenceable_root,
+    record_fenceable_roots,
+    run_job,
+)
 from .server import dispatch_request
 from .session import CONNECT_RETRY_AFTER_S, Session
 
@@ -106,6 +143,12 @@ DEFAULT_MAX_CLIENTS = 128
 DEFAULT_GLOBAL_QUEUE = 256
 #: deterministic backoff step between re-dispatch attempts (seconds)
 _BACKOFF_S = 0.05
+#: per-daemon artifact-plane attribution carried by heartbeats, in the
+#: stable key order ``fleet-status --json`` surfaces them
+_ARTIFACT_KEYS = (
+    "hydrated", "remote_corrupt", "remote_hits", "remote_misses",
+    "remote_puts",
+)
 
 
 def lease_seconds() -> float:
@@ -179,6 +222,47 @@ def _hang_seconds() -> float:
     return env_number("OPERATOR_FORGE_FAULT_HANG_S", 30.0)
 
 
+# -- elasticity knobs ------------------------------------------------------
+
+
+def fleet_min() -> int:
+    """Autoscaler pool floor (``OPERATOR_FORGE_FLEET_MIN``, default 0).
+    The coordinator keeps at least this many daemons registered,
+    spawning its own when short."""
+    return env_number("OPERATOR_FORGE_FLEET_MIN", 0, cast=int, minimum=0)
+
+
+def fleet_max() -> int:
+    """Autoscaler pool ceiling (``OPERATOR_FORGE_FLEET_MAX``, default 0
+    = the autoscaler is OFF and the fleet keeps its PR 14 fixed-size
+    behavior)."""
+    return env_number("OPERATOR_FORGE_FLEET_MAX", 0, cast=int, minimum=0)
+
+
+def scale_queue_threshold() -> float:
+    """Queue pressure that triggers scale-up: queued submissions per
+    healthy member (``OPERATOR_FORGE_FLEET_SCALE_QUEUE``, default 2)."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_SCALE_QUEUE", 2.0, minimum=0.1
+    )
+
+
+def scale_p99_threshold() -> float:
+    """SLO pressure that triggers scale-up: any tenant's p99 above this
+    many seconds (``OPERATOR_FORGE_FLEET_SCALE_P99_S``; 0 or unset
+    disables the latency leg — deadline-miss growth still counts)."""
+    return env_number("OPERATOR_FORGE_FLEET_SCALE_P99_S", 0.0)
+
+
+def scale_idle_seconds() -> float:
+    """How long the fleet must sit fully idle (nothing queued, nothing
+    in flight anywhere) before ONE coordinator-spawned daemon is
+    retired (``OPERATOR_FORGE_FLEET_IDLE_S``, default 10)."""
+    return env_number(
+        "OPERATOR_FORGE_FLEET_IDLE_S", 10.0, minimum=0.5
+    )
+
+
 class _Member:
     """One registered daemon: its lease, load, and dispatch state."""
 
@@ -186,7 +270,7 @@ class _Member:
         "id", "addr", "capacity", "session", "registered_at",
         "last_beat", "suspect", "degraded", "queued",
         "reported_in_flight", "in_flight", "dispatched",
-        "active_roots",
+        "active_roots", "namespaces", "artifact", "remote_active",
     )
 
     def __init__(self, member_id: str, addr: str, capacity: int,
@@ -205,6 +289,9 @@ class _Member:
         self.in_flight = 0       # coordinator-side dispatch count
         self.dispatched = 0      # lifetime submissions routed here
         self.active_roots = []   # [(reads, writes)] per live dispatch
+        self.namespaces = set()  # scope labels this daemon has served
+        self.artifact = {}       # heartbeat artifact-plane attribution
+        self.remote_active = False  # daemon has a remote cache wired
 
 
 def _conflicts(reads, writes, held_reads, held_writes) -> bool:
@@ -224,7 +311,8 @@ def _conflicts(reads, writes, held_reads, held_writes) -> bool:
 class FleetCoordinator:
     """The coordinator: listener + sessions + health-driven scheduler."""
 
-    def __init__(self, listen: str, lease: float = None, clients=None):
+    def __init__(self, listen: str, lease: float = None, clients=None,
+                 elastic: dict = None):
         self.spec = parse_listen(listen)
         self._lease = lease
         self._max_clients = clients if clients else max_clients()
@@ -247,6 +335,21 @@ class FleetCoordinator:
         #: or a daemon could be handed a tree the coordinator itself
         #: is still writing
         self._local_roots: list = []
+        #: scope labels known populated in the shared remote tier
+        #: (heartbeats + successful dispatches to remote-active
+        #: members) — the locality half of placement
+        self._populated: set = set()
+        #: the autoscaler's pool: listen addr -> subprocess.Popen of
+        #: coordinator-spawned daemons.  ``elastic`` overrides the
+        #: OPERATOR_FORGE_FLEET_MIN/MAX env knobs ({"min", "max",
+        #: "env"}); None falls through to the environment
+        self._elastic = dict(elastic) if elastic else None
+        self._spawned: dict = {}
+        self._spawn_dir = None
+        self._spawn_seq = 0
+        self._last_spawn = 0.0
+        self._idle_since = None
+        self._slo_misses_seen = 0
         self._stop_lock = threading.Lock()
         self._stopped = False
         self._stop_done = threading.Event()
@@ -257,30 +360,16 @@ class FleetCoordinator:
     # -- lifecycle -------------------------------------------------------
 
     def address(self) -> str:
-        if self.spec[0] == "unix":
-            return self.spec[1]
-        host, port = self._listener.getsockname()[:2]
-        return f"{host}:{port}"
+        return bound_address(self.spec, self._listener)
 
     def _bind(self) -> None:
-        if self.spec[0] == "unix":
-            path = self.spec[1]
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
-        else:
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            sock.bind((self.spec[1], self.spec[2]))
-        sock.listen(min(128, self._max_clients * 2))
         # the accept loop wakes on its own to observe the drain flag
         # (close/shutdown do not reliably break a blocked AF_UNIX
         # accept — the daemon's listener carries the same note)
-        sock.settimeout(0.5)
-        self._listener = sock
+        self._listener = bind_listener(
+            self.spec, backlog=min(128, self._max_clients * 2),
+            accept_timeout=0.5,
+        )
 
     def _boot(self) -> None:
         # spans + the always-on event ring (the flight recorder's
@@ -444,6 +533,23 @@ class FleetCoordinator:
             member.queued = int(req.get("queued") or 0)
             member.reported_in_flight = int(req.get("in_flight") or 0)
             member.degraded = bool(req.get("degraded"))
+            member.remote_active = bool(req.get("remote_active"))
+            artifact = req.get("artifact")
+            if isinstance(artifact, dict):
+                member.artifact = {
+                    key: int(artifact.get(key) or 0)
+                    for key in _ARTIFACT_KEYS
+                }
+            namespaces = req.get("namespaces")
+            if isinstance(namespaces, list):
+                labels = {str(n) for n in namespaces[:256]}
+                member.namespaces |= labels
+                if member.remote_active:
+                    # write-behind means every namespace a
+                    # remote-active daemon has served is (or is about
+                    # to be) populated in the shared tier — the signal
+                    # cold-route placement weighs
+                    self._populated |= labels
         self._answer(session, {
             "ok": True, "op": "fleet.heartbeat",
             **({"id": req_id} if req_id is not None else {}),
@@ -489,6 +595,161 @@ class FleetCoordinator:
                             "lease_age_s": round(age, 3),
                         })
                 self._cond.notify_all()
+            try:
+                self._autoscale()
+            except Exception:
+                # the autoscaler must never take the health monitor
+                # down with it — a failed spawn just retries next tick
+                pass
+
+    # -- elasticity (monitor thread) -------------------------------------
+
+    def _scale_bounds(self) -> tuple:
+        """``(min, max)`` daemon-pool bounds; ``(0, 0)`` means the
+        autoscaler is off (the PR 14 fixed-fleet behavior)."""
+        if self._elastic is not None:
+            lo = int(self._elastic.get("min") or 0)
+            hi = int(self._elastic.get("max") or 0)
+        else:
+            lo, hi = fleet_min(), fleet_max()
+        if hi <= 0:
+            return (0, 0)
+        return (max(0, lo), max(hi, lo))
+
+    def _reap_spawned(self) -> None:
+        for addr, proc in list(self._spawned.items()):
+            if proc.poll() is not None:
+                self._spawned.pop(addr, None)
+
+    def _spawn_member(self) -> None:
+        """Spawn one daemon subprocess.  Shared-nothing by
+        construction: each spawn gets a PRIVATE
+        ``OPERATOR_FORGE_CACHE_DIR``, so the only artifact state it
+        shares with the rest of the fleet is the remote cache it
+        inherits through the environment — a cold spawn hydrates its
+        trees from the shared tier, never from a sibling's disk."""
+        if self._spawn_dir is None:
+            self._spawn_dir = tempfile.mkdtemp(prefix="forge-fleet-")
+        self._spawn_seq += 1
+        tag = f"a{self._spawn_seq}"
+        listen = os.path.join(self._spawn_dir, f"{tag}.sock")
+        env = dict(os.environ)
+        env.update((self._elastic or {}).get("env") or {})
+        env["OPERATOR_FORGE_CACHE_DIR"] = os.path.join(
+            self._spawn_dir, f"{tag}-cache"
+        )
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "operator_forge.cli.main",
+                    "daemon", "--listen", listen,
+                    "--fleet", self.address(),
+                ],
+                cwd=self.base_dir, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            metrics.counter("fleet.spawn_failures").inc()
+            return
+        self._spawned[listen] = proc
+        self._last_spawn = time.monotonic()
+        metrics.counter("fleet.scale_ups").inc()
+        flight.anomaly("fleet.scale_up", {"listen": listen})
+
+    def _retire_member(self, member: _Member) -> None:
+        """Retire one coordinator-spawned daemon: evicted first (no
+        new dispatches route there), then drained on a background
+        thread — its in-flight work is answered before it exits."""
+        with self._cond:
+            live = self._members.get(member.id)
+            if live is not None:
+                self._evict_locked(live, counted=False)
+        metrics.counter("fleet.scale_downs").inc()
+        flight.anomaly("fleet.scale_down", {
+            "member": member.id, "addr": member.addr,
+        })
+        threading.Thread(
+            target=self._drain_member, args=(member,), daemon=True,
+            name=f"fleet-retire-{member.id}",
+        ).start()
+
+    def _autoscale(self) -> None:
+        """One autoscaler tick (rides the monitor loop's lease/4
+        cadence): spawn on queue or SLO pressure, retire on sustained
+        idleness, always within ``[min, max]``."""
+        self._reap_spawned()
+        lo, hi = self._scale_bounds()
+        if hi <= 0:
+            return
+        now = time.monotonic()
+        with self._cond:
+            members = list(self._members.values())
+            queued = self._queued + sum(m.queued for m in members)
+            busy = self._queued > 0 or any(
+                m.in_flight or m.queued or m.reported_in_flight
+                for m in members
+            )
+            member_addrs = {m.addr for m in members}
+        healthy = [m for m in members if not m.suspect]
+        # a spawn that has not registered yet still counts, or every
+        # tick until its first heartbeat would spawn another
+        pending = sum(
+            1 for addr, proc in self._spawned.items()
+            if addr not in member_addrs and proc.poll() is None
+        )
+        count = len(members) + pending
+        # scale-up pressure: queue depth per healthy member, any
+        # tenant's p99 over the knob, or deadline-miss growth.  The
+        # SLO legs only count while work is in the system: percentiles
+        # are cumulative, and a sticky over-bar p99 on an idle fleet
+        # would flap spawn/retire forever
+        pressure = count < lo
+        if not pressure and count < hi and (busy or queued > 0):
+            depth = queued / max(1, len(healthy))
+            pressure = queued > 0 and (
+                not healthy or depth >= scale_queue_threshold()
+            )
+            if not pressure:
+                p99_bar = scale_p99_threshold()
+                slo = metrics.slo_report()
+                misses = sum(
+                    row.get("deadline_misses", 0)
+                    for row in slo.values()
+                )
+                if misses > self._slo_misses_seen:
+                    self._slo_misses_seen = misses
+                    pressure = True
+                elif p99_bar > 0 and any(
+                    row.get("p99", 0.0) > p99_bar
+                    for row in slo.values()
+                ):
+                    pressure = True
+        if pressure and count < hi:
+            # one spawn per tick, rate-limited so a crash-looping
+            # daemon binary cannot fork-bomb the host
+            if now - self._last_spawn >= 1.0:
+                self._spawn_member()
+            return
+        # scale-down: the fleet must sit FULLY idle for the idle
+        # window, and only coordinator-spawned members retire
+        if busy:
+            self._idle_since = None
+            return
+        if self._idle_since is None:
+            self._idle_since = now
+            return
+        if now - self._idle_since < scale_idle_seconds():
+            return
+        if len(members) <= lo:
+            return
+        victims = [m for m in members if m.addr in self._spawned]
+        if not victims:
+            return
+        # newest spawn retires first (LIFO): the longest-lived daemons
+        # hold the warmest mem-tiers
+        victim = max(victims, key=lambda m: m.registered_at)
+        self._idle_since = now  # one retirement per idle window
+        self._retire_member(victim)
 
     # -- admission (reader threads) --------------------------------------
 
@@ -652,18 +913,25 @@ class FleetCoordinator:
     # -- routing ---------------------------------------------------------
 
     def _route(self, affinity_key: str, reads, writes, excluded):
-        """Pick (and charge) a member for one dispatch attempt, or
-        ``None`` when no member is routable.  Caller releases via
+        """Pick (and charge) a member for one dispatch attempt;
+        returns ``(member, stolen)`` — ``(None, False)`` when no
+        member is routable, ``stolen`` True when the work-steal branch
+        chose (a steal or cold route).  Caller releases via
         :meth:`_release`.  Deterministic: overlap-forced first (trees
         already in flight stay on their daemon, whose path locks
-        serialize them), then healthy affinity, then the least-loaded
-        healthy candidate (work-stealing), ties broken by member id."""
+        serialize them), then healthy affinity, then the best-ranked
+        healthy candidate (work-stealing) — rank weighs remote-cache
+        locality between load classes: a member that has served the
+        namespace holds it warm; when the namespace is known-populated
+        in the shared remote tier, any remote-active member hydrates
+        at the remote tier's cold-worker speedup; anything else
+        recomputes cold — ties broken by load then member id."""
         with self._cond:
             # a quarantined submission running in-process holds its
             # trees too: overlapping work must wait, not route
             for held_reads, held_writes in self._local_roots:
                 if _conflicts(reads, writes, held_reads, held_writes):
-                    return None
+                    return None, False
             # a submission overlapping an in-flight dispatch MUST land
             # on that dispatch's member — two daemons writing one tree
             # would bypass every path lock in the system
@@ -674,17 +942,18 @@ class FleetCoordinator:
                     if _conflicts(reads, writes,
                                   held_reads, held_writes):
                         if member.id in excluded:
-                            return None  # its attempt failed: let the
-                            # re-dispatch loop back off and re-route
+                            # its attempt failed: let the re-dispatch
+                            # loop back off and re-route
+                            return None, False
                         return self._charge_locked(
                             member, affinity_key, reads, writes
-                        )
+                        ), False
             candidates = [
                 m for m in self._members.values()
                 if m.id not in excluded
             ]
             if not candidates:
-                return None
+                return None, False
             preferred = self._members.get(
                 self._affinity.get(affinity_key, "")
             )
@@ -696,19 +965,41 @@ class FleetCoordinator:
                 and preferred.in_flight < preferred.capacity
             ):
                 chosen = preferred
+                stolen = False
             else:
                 # work-stealing: a degraded daemon sheds load before
                 # it fails, a suspect one is routed only as last
-                # resort, the least-loaded healthy member wins
-                chosen = min(candidates, key=lambda m: (
-                    m.suspect, m.degraded,
-                    m.in_flight + m.queued, m.id,
-                ))
+                # resort, a member at capacity (the saturated affinity
+                # owner is still a candidate) yields to any member
+                # with a free slot — that IS the steal — and among
+                # members with headroom artifact locality outranks raw
+                # load: hydrating from the shared remote tier beats a
+                # cold recompute on an idler member
+                populated = affinity_key in self._populated
+
+                def _rank(m):
+                    if affinity_key in m.namespaces:
+                        locality = 0
+                    elif populated and m.remote_active:
+                        locality = 1
+                    else:
+                        locality = 2
+                    return (m.suspect, m.degraded,
+                            m.in_flight >= m.capacity, locality,
+                            m.in_flight + m.queued, m.id)
+
+                chosen = min(candidates, key=_rank)
+                stolen = True
                 if preferred is not None and chosen is not preferred:
                     metrics.counter("fleet.steals").inc()
+                if (
+                    affinity_key in chosen.namespaces
+                    or (populated and chosen.remote_active)
+                ):
+                    metrics.counter("fleet.locality_routes").inc()
             return self._charge_locked(
                 chosen, affinity_key, reads, writes
-            )
+            ), stolen
 
     def _charge_locked(self, member: _Member, affinity_key: str,
                        reads, writes) -> _Member:
@@ -757,6 +1048,11 @@ class FleetCoordinator:
         fresh_roots = [
             root for root in writes if not os.path.isdir(root)
         ]
+        # the coordinator's own created-from-absence observation: any
+        # local fallback sweep of these roots (quarantine, dead-member
+        # retry) runs under the same fenceable-root containment the
+        # daemon-side fence op enforces
+        record_fenceable_roots(fresh_roots)
         if op == "job":
             forward_req = {"op": "job", "job": jobs[0].to_spec()}
         else:
@@ -786,11 +1082,15 @@ class FleetCoordinator:
         while True:
             if attempt:
                 time.sleep(_BACKOFF_S * attempt)  # deterministic
-                if reset_next:
-                    for root in fresh_roots:
-                        shutil.rmtree(root, ignore_errors=True)
+            # the crash-retry reset is DEFERRED until the retry's
+            # target is routed: the target daemon fence-resets the
+            # roots on ITS filesystem (shared-nothing: the coordinator
+            # never reaches into a daemon's disk), then the local
+            # containment-gated sweep covers the shared-fs topology
+            reset_pending = reset_next and attempt > 0
             reset_next = True
             member = None
+            stolen = False
             if pinned is not None:
                 stale = pinned
                 pinned = None
@@ -827,13 +1127,13 @@ class FleetCoordinator:
                         need_fence = True
                         continue
                     else:
-                        for root in fresh_roots:
-                            shutil.rmtree(root, ignore_errors=True)
+                        self._reset_roots(fresh_roots)
                         need_fence = False
             if member is None:
                 need_fence = False
-                member = self._route(affinity_key, reads, writes,
-                                     excluded)
+                member, stolen = self._route(
+                    affinity_key, reads, writes, excluded
+                )
             if member is None:
                 if not self._members:
                     if dispatch_failed:
@@ -871,6 +1171,15 @@ class FleetCoordinator:
                 excluded.clear()
                 attempt += 1
                 continue
+            if reset_pending and not need_fence:
+                # the deferred crash-retry reset: fence the retry's
+                # target only when a dispatch actually died mid-run
+                # (pure busy backpressure never created the roots)
+                self._reset_roots(
+                    fresh_roots,
+                    member=member if dispatch_failed else None,
+                    reads=reads, writes=writes,
+                )
             if need_fence:
                 # the previous attempt may still be running on this
                 # member as a zombie: the fence queues behind its path
@@ -910,7 +1219,9 @@ class FleetCoordinator:
                     raise socket.timeout(
                         "injected fault: fleet.dispatch_hang@route"
                     )
-                response = self._dispatch_once(member, forward_req)
+                response = self._dispatch_once(
+                    member, forward_req, stolen=stolen
+                )
             except (OSError, ConnectionError, ValueError):
                 # the dispatch failed with the submission possibly
                 # mid-run.  The fencing decision is a fresh liveness
@@ -988,6 +1299,17 @@ class FleetCoordinator:
                 metrics.counter("fleet.busy_retries").inc()
                 continue
             break
+        with self._cond:
+            live = self._members.get(member.id)
+            if live is not None:
+                # the dispatch landed: the member now holds this
+                # namespace warm, and — write-behind — a remote-active
+                # member has populated it in the shared tier, which is
+                # what lets a future cold route (or a daemon that never
+                # saw this tree) hydrate over the network
+                live.namespaces.add(affinity_key)
+                if live.remote_active:
+                    self._populated.add(affinity_key)
         elapsed = time.perf_counter() - started
         metrics.histogram("fleet.dispatch.seconds").observe(elapsed)
         metrics.counter("fleet.dispatches").inc()
@@ -1000,6 +1322,26 @@ class FleetCoordinator:
         else:
             response.pop("id", None)
         return response
+
+    def _reset_roots(self, fresh_roots, member: _Member = None,
+                     reads=(), writes=()) -> None:
+        """The shared-nothing crash-retry reset.  Output roots absent
+        at admission are cleared before a re-dispatch — WITHOUT the
+        coordinator reaching into any daemon's filesystem: when the
+        retry's target is known, its ``fence`` op resets the roots on
+        the daemon's own disk (a no-op for roots it never observed
+        created-from-absence); the local sweep then covers the
+        shared-filesystem topology, gated by the coordinator's own
+        fenceable-root containment — on a true shared-nothing fleet
+        the roots never existed on this host and the sweep is
+        structurally empty."""
+        if not fresh_roots:
+            return
+        if member is not None:
+            self._fence_member(member, reads, writes, fresh_roots)
+        for root in fresh_roots:
+            if os.path.isdir(root) and is_fenceable_root(root):
+                shutil.rmtree(root, ignore_errors=True)
 
     def _probe_member(self, member: _Member) -> bool:
         """The fencing probe: is the daemon at ``member.addr`` alive
@@ -1049,7 +1391,8 @@ class FleetCoordinator:
         finally:
             client.close()
 
-    def _dispatch_once(self, member: _Member, forward_req: dict):
+    def _dispatch_once(self, member: _Member, forward_req: dict,
+                       stolen: bool = False):
         """One dispatch round trip to a member daemon.  Raises on any
         transport failure (the caller's re-dispatch loop owns
         recovery); a fresh connection per dispatch keeps failure
@@ -1066,6 +1409,16 @@ class FleetCoordinator:
                 # what makes the re-dispatch safe
                 raise ConnectionError(
                     "injected fault: fleet.daemon_crash@dispatch"
+                )
+            if stolen and faults.fire("steal", "fleet.steal_kill"):
+                # kill-during-steal: the steal/cold-route target dies
+                # AFTER the stolen submission was sent, its tree still
+                # hydrating from the remote tier — the fence +
+                # re-dispatch path must leave no half-hydrated root
+                # behind.  The site only counts stolen dispatches, so
+                # nth-hit selection is deterministic over steals
+                raise ConnectionError(
+                    "injected fault: fleet.steal_kill@steal"
                 )
             response = client.read()
             if response is None:
@@ -1135,8 +1488,10 @@ class FleetCoordinator:
             self._local_roots.append(hold)
         try:
             if not fenced:
-                for root in fresh_roots:
-                    shutil.rmtree(root, ignore_errors=True)
+                # the coordinator IS the executor here, so the local
+                # reset is legitimate — and containment-gated like
+                # every other sweep
+                self._reset_roots(fresh_roots)
             started = time.perf_counter()
             if op == "job":
                 response = run_job(jobs[0]).to_dict()
@@ -1167,10 +1522,15 @@ class FleetCoordinator:
 
     def _stats_payload(self) -> dict:
         now = time.monotonic()
+        lo, hi = self._scale_bounds()
         with self._cond:
             members = {
                 m.id: {
                     "addr": m.addr,
+                    "artifact": {
+                        key: m.artifact.get(key, 0)
+                        for key in _ARTIFACT_KEYS
+                    },
                     "capacity": m.capacity,
                     "degraded": bool(m.degraded),
                     "dispatched": m.dispatched,
@@ -1178,13 +1538,20 @@ class FleetCoordinator:
                     "lease_age_s": round(
                         max(0.0, now - m.last_beat), 3
                     ),
+                    "namespaces": len(m.namespaces),
                     "queued": m.queued,
+                    "spawned": m.addr in self._spawned,
                     "state": "suspect" if m.suspect else "healthy",
                 }
                 for m in self._members.values()
             }
             queued = self._queued
             affinities = len(self._affinity)
+            populated = len(self._populated)
+            spawned_live = sum(
+                1 for proc in self._spawned.values()
+                if proc.poll() is None
+            )
         return {
             "affinities": affinities,
             "counters": {
@@ -1192,16 +1559,24 @@ class FleetCoordinator:
                 for name in (
                     "fleet.busy_retries", "fleet.dispatches",
                     "fleet.evictions", "fleet.heartbeats",
-                    "fleet.jobs_quarantined", "fleet.recoveries",
-                    "fleet.redispatches", "fleet.registrations",
-                    "fleet.steals", "fleet.suspects",
+                    "fleet.jobs_quarantined", "fleet.locality_routes",
+                    "fleet.recoveries", "fleet.redispatches",
+                    "fleet.registrations", "fleet.scale_downs",
+                    "fleet.scale_ups", "fleet.steals",
+                    "fleet.suspects",
                 )
             },
             "editor": metrics.editor_report(),
             "lease_s": self.lease_s(),
             "listen": self.address(),
             "members": {k: members[k] for k in sorted(members)},
+            "populated_namespaces": populated,
             "queued_requests": queued,
+            "scale": {
+                "max": hi,
+                "min": lo,
+                "spawned_live": spawned_live,
+            },
             "slo": metrics.slo_report(),
         }
 
@@ -1270,6 +1645,26 @@ class FleetCoordinator:
             thread.start()
         for thread in drainers:
             thread.join(90.0)
+        # coordinator-spawned daemons were drained above (they were
+        # registered members); anything still running gets an
+        # escalating terminate/kill so the fleet never leaks processes
+        for addr, proc in list(self._spawned.items()):
+            try:
+                proc.wait(10.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    try:
+                        proc.wait(5.0)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
+        self._spawned.clear()
+        if self._spawn_dir is not None:
+            shutil.rmtree(self._spawn_dir, ignore_errors=True)
+            self._spawn_dir = None
         for session in sessions:
             try:
                 session.respond(
@@ -1299,17 +1694,20 @@ class FleetCoordinator:
         self._stop_done.set()
 
 
-def serve_fleet(listen: str, lease: float = None, clients=None) -> int:
+def serve_fleet(listen: str, lease: float = None, clients=None,
+                elastic: dict = None) -> int:
     """The ``operator-forge fleet`` entry point: bind, print one status
     line on stderr, coordinate until SIGTERM/SIGINT (or a client's
     shutdown op), then drain the whole fleet and exit 0."""
-    import sys
-
-    coordinator = FleetCoordinator(listen, lease=lease, clients=clients)
+    coordinator = FleetCoordinator(
+        listen, lease=lease, clients=clients, elastic=elastic
+    )
     coordinator._bind()
+    lo, hi = coordinator._scale_bounds()
+    scale_note = f", autoscale {lo}..{hi}" if hi else ""
     print(
         f"fleet: coordinating on {coordinator.address()} "
-        f"(lease {coordinator.lease_s():g}s)",
+        f"(lease {coordinator.lease_s():g}s{scale_note})",
         file=sys.stderr, flush=True,
     )
     installed = []

@@ -89,6 +89,39 @@ def _job_stage(job: Job) -> str:
         return _STAGE
     return f"{_STAGE}.{_scope_label((job.target(),))}"
 
+
+# -- served scopes (fleet locality signal) --------------------------------
+#
+# Which per-project scope labels this process has served, most recent
+# last, FIFO-bounded like the fenceable-root registry.  The daemon
+# ships the list in its fleet heartbeats: a member that has served a
+# tree before holds its replay records warm (mem/disk tiers), and —
+# with the remote tier active — has populated the shared cache-server
+# namespace for it, so the coordinator's steal/cold-route placement
+# can weigh cache locality alongside load.
+
+_SCOPES_MAX = 256
+
+_scopes_lock = threading.Lock()
+_scopes: dict = {}  # label -> True, insertion-ordered
+
+
+def _record_scope(label: str) -> None:
+    if not _project_scoped[0]:
+        return
+    with _scopes_lock:
+        _scopes.pop(label, None)
+        _scopes[label] = True
+        while len(_scopes) > _SCOPES_MAX:
+            del _scopes[next(iter(_scopes))]
+
+
+def served_scopes() -> tuple:
+    """Scope labels (per-project namespace hashes) this process has
+    served, most recent last, bounded at 256."""
+    with _scopes_lock:
+        return tuple(_scopes)
+
 # -- fenceable roots (the fleet's zombie fence) ---------------------------
 #
 # The ``fence`` op resets output roots so a re-dispatched submission
@@ -244,6 +277,7 @@ def run_job(job: Job) -> JobResult:
 
     cache = pf_cache.get_cache()
     stage = _job_stage(job)
+    _record_scope(_scope_label((job.target(),)))
     key = None
     pre_out: tuple = ()
     if cache.mode() != "off":

@@ -330,7 +330,9 @@ def _handle(req: dict, base_dir: str, emit=None, abandoned=None) -> tuple:
         if compiler is not None:
             compiler.flush_counters()  # compile.reused is tallied lazily
         payload = {
-            "ok": True, "op": "stats", "cache": metrics.cache_report(),
+            "ok": True, "op": "stats",
+            "artifact": metrics.artifact_report(),
+            "cache": metrics.cache_report(),
             "editor": metrics.editor_report(),
             "graph": GRAPH.counters(),
             "metrics": metrics.snapshot(),

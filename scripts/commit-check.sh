@@ -460,6 +460,53 @@ print(
     )
 )
 
+# elastic shared-nothing fleet (PR 20): the autoscaled K=4 pool must
+# clear the same core-gated bar over the floor daemon, the scale
+# events (floor + pressure ups, idle down, kill-during-steal) must
+# all have happened with byte-identity intact, and the cold respawns
+# must have hydrated from the remote tier
+elastic = detail["elastic_fleet"]
+assert elastic["scaling_x"] >= elastic["scaling_bar"], (
+    "elastic K=4 below the %.1fx bar (host has %d core(s)) over the "
+    "floor daemon: %.2f"
+    % (elastic["scaling_bar"], elastic["host_cores"],
+       elastic["scaling_x"])
+)
+assert elastic["identity"] is True, (
+    "an elastic-fleet response diverged from the cache-off serial "
+    "recompute"
+)
+assert elastic["scale_ups"] >= 2 and elastic["scale_downs"] >= 1, (
+    "elastic scale events missing: %d up(s) / %d down(s)"
+    % (elastic["scale_ups"], elastic["scale_downs"])
+)
+assert elastic["steal_kill_recovered"] is True, (
+    "kill-during-steal was not recovered by re-dispatch"
+)
+assert elastic["shared_nothing"]["identity"] is True, (
+    "shared-nothing re-run diverged: %r" % elastic["shared_nothing"]
+)
+assert elastic["shared_nothing"]["remote_puts"] > 0, (
+    "warm daemons never populated the remote tier"
+)
+assert elastic["shared_nothing"]["hydration_gets"] > 0, (
+    "cold respawns never consulted the remote tier"
+)
+print(
+    "elastic fleet contract OK: floor %.1f -> autoscaled %.1f jobs/s "
+    "(x%.1f), %d scale-up(s) / %d scale-down(s), kill-during-steal "
+    "recovered, shared-nothing hydration %d put(s) / %d get(s)"
+    % (
+        elastic["single_daemon_jobs_per_s"],
+        elastic["fleet_jobs_per_s"],
+        elastic["scaling_x"],
+        elastic["scale_ups"],
+        elastic["scale_downs"],
+        elastic["shared_nothing"]["remote_puts"],
+        elastic["shared_nothing"]["hydration_gets"],
+    )
+)
+
 # tiered execution (PR 11): walk/compile/bytecode reports must be
 # identical on kitchen-sink (the bench also re-checks the matrix in
 # check_section's five tier×jobs legs per cache mode) and on the
@@ -614,11 +661,20 @@ print(
 # saved, per cache mode; and the path-lock trie agrees with the linear
 # reference sweep on every probe.
 editor = detail["editor"]
+# the p99 bound is core-gated by the bench (100ms with >=2 cores,
+# 250ms tail floor on 1-core hosts where the 8-client p99 is a
+# scheduler-quantum lottery); the sub-100ms steady-state claim is the
+# p50 bound, enforced on every host
 assert editor["warm_revet_p99_ms"] < editor["warm_revet_bound_ms"], (
     "warm overlay re-vet p99 %.1fms over the %.0fms bar (p50 %.1fms, "
-    "%d background clients)"
+    "%d background clients, %d core(s))"
     % (editor["warm_revet_p99_ms"], editor["warm_revet_bound_ms"],
-       editor["warm_revet_p50_ms"], editor["background_clients"])
+       editor["warm_revet_p50_ms"], editor["background_clients"],
+       editor["host_cores"])
+)
+assert editor["warm_revet_p50_ms"] < editor["warm_revet_p50_bound_ms"], (
+    "warm overlay re-vet p50 %.1fms over the %.0fms steady-state bar"
+    % (editor["warm_revet_p50_ms"], editor["warm_revet_p50_bound_ms"])
 )
 assert editor["supersede"]["superseded"] > 0, (
     "the overlay-edit burst superseded nothing"
@@ -1066,6 +1122,264 @@ finally:
     if coordinator.poll() is None:
         coordinator.kill()
         coordinator.wait(timeout=10)
+    shutil.rmtree(tmp, ignore_errors=True)
+PYEOF
+)
+
+# Elastic fleet step (PR 20): a REAL coordinator (--min 1 --max 3)
+# plus a REAL cache-server subprocess; the coordinator spawns its own
+# daemon subprocesses on disjoint private cache roots under client
+# load, retires back to the floor on idle, and one spawned daemon is
+# SIGKILLed mid-batch.  Every client's trees must match its own
+# cache-off serial recompute, the scale-event counters must show the
+# floor + pressure spawns and an idle retirement, and the spawned
+# daemons must have populated the shared remote tier.
+echo "elastic fleet contract: coordinator-owned daemons + cache-server"
+(cd "$repo_root" && "${PYTHON:-python3}" - <<'PYEOF'
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from bench import tree_digest
+from operator_forge.perf import cache as pf_cache
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.daemon import DaemonClient
+from operator_forge.serve.jobs import jobs_from_specs
+
+tmp = tempfile.mkdtemp(prefix="operator-forge-elasticstep-")
+coord_sock = os.path.join(tmp, "coord.sock")
+cache_sock = os.path.join(tmp, "artifact.sock")
+fixture = os.path.join("tests", "fixtures", "standalone")
+repo_root = os.getcwd()
+N = 6
+
+
+def specs_for(i, flavor):
+    cfg = os.path.abspath(os.path.join(tmp, f"cfg-{i}", "workload.yaml"))
+    out = os.path.join(tmp, flavor, f"client-{i}", "out")
+    return [
+        {"command": "init", "workload_config": cfg, "output_dir": out,
+         "repo": f"github.com/acme/elastic{i}"},
+        {"command": "create-api", "workload_config": cfg,
+         "output_dir": out},
+        {"command": "vet", "path": out},
+    ], out
+
+
+def norm(text, out):
+    return re.sub(r"\d+\.\d+s", "<t>", text.replace(out, "<out>"))
+
+
+def fleet_stats():
+    with DaemonClient(coord_sock) as probe:
+        return probe.request({"op": "stats", "id": "s"})["fleet"]
+
+
+def pid_of_member(addr):
+    # the spawned daemon is the coordinator's child; find it by its
+    # listen socket on the command line
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as fh:
+                cmdline = fh.read().decode(errors="replace")
+        except OSError:
+            continue
+        if addr in cmdline and "daemon" in cmdline:
+            return int(entry)
+    return None
+
+
+env = dict(os.environ)
+env.pop("OPERATOR_FORGE_FAULTS", None)
+env.pop("OPERATOR_FORGE_SERVE_TIMEOUT", None)
+server = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "cache-server",
+     "--listen", cache_sock, "--dir", os.path.join(tmp, "store")],
+    env=env, stderr=subprocess.DEVNULL,
+)
+# the coordinator's environment is what its spawned daemons inherit:
+# the shared remote tier, disk-tier private roots (the coordinator
+# assigns each spawn its own cache dir), and an import path that
+# works from the spawn scratch directory
+coord_env = dict(env)
+coord_env.update({
+    "OPERATOR_FORGE_REMOTE_CACHE": cache_sock,
+    "OPERATOR_FORGE_CACHE": "disk",
+    "OPERATOR_FORGE_CACHE_DIR": os.path.join(tmp, "coord-cache"),
+    "OPERATOR_FORGE_JOBS": "2",
+    "OPERATOR_FORGE_DAEMON_WORKERS": "2",
+    "OPERATOR_FORGE_FLEET_IDLE_S": "1.0",
+    "OPERATOR_FORGE_FLEET_SCALE_P99_S": "0.0001",
+    "PYTHONPATH": repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    ),
+})
+coordinator = subprocess.Popen(
+    [sys.executable, "-m", "operator_forge.cli.main", "fleet",
+     "--listen", coord_sock, "--min", "1", "--max", "3"],
+    env=coord_env, stderr=subprocess.PIPE, text=True,
+)
+try:
+    for i in range(N):
+        shutil.copytree(fixture, os.path.join(tmp, f"cfg-{i}"))
+    for _ in range(400):
+        if os.path.exists(coord_sock) and os.path.exists(cache_sock):
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit("coordinator or cache-server did not bind")
+
+    # the floor spawn: a member the coordinator started on its own
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            stats = fleet_stats()
+            if len(stats["members"]) >= 1:
+                break
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.1)
+    else:
+        raise SystemExit("the autoscaler never spawned the floor daemon")
+    assert stats["scale"] == {"max": 3, "min": 1,
+                              "spawned_live": len(stats["members"])}, stats
+
+    # the cache-off serial reference, one tree per client
+    pf_cache.configure(mode="off")
+    refs = {}
+    for i in range(N):
+        specs, out = specs_for(i, "ref")
+        results = run_batch(jobs_from_specs(specs, tmp))
+        assert all(r.ok for r in results), f"reference {i} failed"
+        refs[i] = (
+            tree_digest(out),
+            [(r.command, r.rc, norm(r.stdout, out)) for r in results],
+        )
+    pf_cache.configure(mode="mem")
+
+    # concurrent CLIENT PROCESSES: the load the autoscaler grows under
+    clients = []
+    for i in range(N):
+        specs, out = specs_for(i, "live")
+        manifest = os.path.join(tmp, f"jobs-{i}.yaml")
+        with open(manifest, "w") as fh:
+            json.dump({"jobs": specs}, fh)  # JSON is valid YAML
+        clients.append((i, out, subprocess.Popen(
+            [sys.executable, "-m", "operator_forge.cli.main", "batch",
+             "--addr", coord_sock, "--manifest", manifest, "--json"],
+            env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )))
+
+    # pressure must grow the pool past the floor while the load runs
+    deadline = time.monotonic() + 120
+    grown = 0
+    while time.monotonic() < deadline:
+        try:
+            grown = len(fleet_stats()["members"])
+        except (OSError, ConnectionError):
+            grown = grown
+        if grown >= 2:
+            break
+        time.sleep(0.1)
+    assert grown >= 2, "the autoscaler never scaled up under load"
+
+    # SIGKILL one coordinator-spawned daemon holding work in flight
+    victim_pid = None
+    deadline = time.monotonic() + 120
+    while victim_pid is None and time.monotonic() < deadline:
+        try:
+            for m in fleet_stats()["members"].values():
+                if m["in_flight"] and m.get("spawned"):
+                    victim_pid = pid_of_member(m["addr"])
+                    if victim_pid:
+                        break
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.05)
+    assert victim_pid is not None, "no in-flight spawned daemon to kill"
+    os.kill(victim_pid, signal.SIGKILL)
+
+    for i, out, proc in clients:
+        stdout, stderr = proc.communicate(timeout=600)
+        assert proc.returncode == 0, f"client {i} failed: {stderr}"
+        lines = [json.loads(l) for l in stdout.strip().splitlines()]
+        got = [
+            (l["command"], l["rc"], norm(l["stdout"], out))
+            for l in lines[:-1]
+        ]
+        ref_digest, ref_results = refs[i]
+        assert got == ref_results, f"client {i} results diverged"
+        assert tree_digest(out) == ref_digest, (
+            f"client {i} tree diverged from its cache-off serial "
+            "recompute (elastic fleet, daemon SIGKILL mid-batch)"
+        )
+
+    # the artifact plane flowed: spawned daemons write-behind into the
+    # shared tier, and the heartbeats attribute it per daemon
+    deadline = time.monotonic() + 60
+    puts = 0
+    while time.monotonic() < deadline:
+        stats = fleet_stats()
+        puts = sum(
+            m["artifact"]["remote_puts"]
+            for m in stats["members"].values()
+        )
+        if puts > 0 and stats["populated_namespaces"] > 0:
+            break
+        time.sleep(0.2)
+    assert puts > 0, "spawned daemons never populated the remote tier"
+
+    # idle: the pool retires back toward the floor
+    deadline = time.monotonic() + 90
+    counters = fleet_stats()["counters"]
+    while time.monotonic() < deadline:
+        counters = fleet_stats()["counters"]
+        if counters["fleet.scale_downs"] >= 1:
+            break
+        time.sleep(0.2)
+    assert counters["fleet.scale_ups"] >= 2, counters
+    assert counters["fleet.scale_downs"] >= 1, counters
+    assert counters["fleet.evictions"] >= 1, counters
+    assert (
+        counters["fleet.redispatches"]
+        + counters["fleet.jobs_quarantined"]
+    ) >= 1, counters
+
+    # SIGTERM drains the coordinator AND the daemons it owns
+    coordinator.send_signal(signal.SIGTERM)
+    rc = coordinator.wait(timeout=120)
+    stderr = coordinator.stderr.read()
+    assert rc == 0, f"coordinator exit {rc}: {stderr}"
+    assert "drained" in stderr, f"no coordinator drain line: {stderr}"
+    print(
+        "elastic fleet step OK: %d clients byte-identical through a "
+        "coordinator-owned pool (%d scale-up(s), %d scale-down(s), "
+        "%d eviction(s), %d re-dispatch(es), %d quarantined, %d "
+        "remote put(s)), one spawned daemon SIGKILLed mid-batch, "
+        "SIGTERM drained the coordinator to exit 0"
+        % (
+            N, counters["fleet.scale_ups"],
+            counters["fleet.scale_downs"],
+            counters["fleet.evictions"],
+            counters["fleet.redispatches"],
+            counters["fleet.jobs_quarantined"], puts,
+        )
+    )
+finally:
+    if coordinator.poll() is None:
+        coordinator.kill()
+        coordinator.wait(timeout=10)
+    server.kill()
+    server.wait(timeout=10)
     shutil.rmtree(tmp, ignore_errors=True)
 PYEOF
 )

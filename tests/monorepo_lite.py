@@ -26,7 +26,7 @@ name: {name}
 kind: ComponentWorkload
 spec:
   api:
-    group: mono
+    group: {group}
     version: v1alpha1
     kind: {kind}
     clusterScoped: false
@@ -43,8 +43,8 @@ apiVersion: apps/v1
 kind: Deployment
 metadata:
   name: {name}-server
-  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
-  namespace: mono-system
+  # +operator-builder:collection:field:name={ns_field},type=string,default="{namespace}"
+  namespace: {namespace}
 spec:
   replicas: {replicas}  # +operator-builder:field:name={camel}Replicas,default={replicas},type=int
   selector:
@@ -70,8 +70,8 @@ apiVersion: v1
 kind: Service
 metadata:
   name: {name}-svc
-  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
-  namespace: mono-system
+  # +operator-builder:collection:field:name={ns_field},type=string,default="{namespace}"
+  namespace: {namespace}
 spec:
   selector:
     app: {name}
@@ -86,8 +86,8 @@ apiVersion: v1
 kind: ConfigMap
 metadata:
   name: {name}-config
-  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
-  namespace: mono-system
+  # +operator-builder:collection:field:name={ns_field},type=string,default="{namespace}"
+  namespace: {namespace}
 data:
   # +operator-builder:field:name={camel}LogLevel,type=string,default="info"
   log-level: "info"
@@ -95,29 +95,29 @@ data:
 """
 
 _COLLECTION_TEMPLATE = """\
-name: mono
+name: {tenant}
 kind: WorkloadCollection
 spec:
   api:
     domain: example.io
-    group: mono
+    group: {tenant}
     version: v1alpha1
-    kind: MonoPlatform
+    kind: {collection_kind}
     clusterScoped: true
   companionCliRootcmd:
-    name: monoctl
-    description: Manage the mono platform
+    name: {tenant}ctl
+    description: Manage the {tenant} platform
   componentFiles:
 {component_files}  resources:
-  - mono-ns.yaml
+  - {tenant}-ns.yaml
 """
 
 _NS_YAML = """\
 apiVersion: v1
 kind: Namespace
 metadata:
-  # +operator-builder:collection:field:name=monoNamespace,type=string,default="mono-system"
-  name: mono-system
+  # +operator-builder:collection:field:name={ns_field},type=string,default="{namespace}"
+  name: {namespace}
 """
 
 
@@ -198,18 +198,34 @@ def _camel(name: str) -> str:
 
 
 def write_monorepo_lite(dst: str, workloads: int = 40,
-                        with_races: int = 0) -> str:
+                        with_races: int = 0,
+                        tenant: str = "mono") -> str:
     """Write the fixture family under *dst* (created if needed) and
     return the path of the collection ``workload.yaml``.  *workloads*
     counts the collection itself plus its components (minimum 2).
     *with_races* additionally emits that many known-racy Go workloads
-    under ``dst/racy/`` (see :func:`write_racy_workloads`).
-    Byte-deterministic for a given size."""
+    under ``dst/racy/`` (see :func:`write_racy_workloads`).  *tenant*
+    names the collection (its API group, companion CLI, namespace, and
+    collection field markers all derive from it), so a multi-tenant
+    fleet bench can generate N DISTINCT corpora — distinct project
+    namespaces, distinct remote-cache keys — instead of N copies of
+    one.  Byte-deterministic for a given size; the default tenant
+    reproduces the historical bytes exactly."""
     if workloads < 2:
         raise ValueError("monorepo-lite needs at least 2 workloads")
+    if not tenant.replace("-", "").isalnum() or not tenant[0].isalpha():
+        raise ValueError(
+            f"tenant {tenant!r} must be alphanumeric (dashes allowed, "
+            "leading letter) — it becomes an API group and a kind"
+        )
     os.makedirs(dst, exist_ok=True)
     if with_races:
         write_racy_workloads(dst, with_races)
+    namespace = f"{tenant}-system"
+    ns_field = f"{_camel(tenant)}Namespace"
+    collection_kind = (
+        tenant[0].upper() + tenant[1:].replace("-", "") + "Platform"
+    )
     components = workloads - 1
     component_files = []
     for i in range(components):
@@ -220,16 +236,18 @@ def write_monorepo_lite(dst: str, workloads: int = 40,
         # the dependency surface without cycles
         deps = f'"{f"svc{i - 1:02d}"}"' if (i % 4 == 3 and i > 0) else ""
         component = _COMPONENT_TEMPLATE.format(
-            name=name, kind=kind, dependencies=deps,
+            name=name, kind=kind, dependencies=deps, group=tenant,
         )
         deploy = _DEPLOY_TEMPLATE.format(
             name=name, camel=camel,
             replicas=(i % 5) + 1, minor=i % 10,
             port=8000 + i, cpu=100 + 50 * (i % 4), mem=128 * ((i % 3) + 1),
+            namespace=namespace, ns_field=ns_field,
         )
         if i % 3 == 0:
             deploy += _CONFIG_EXTRA.format(
                 name=name, camel=camel, retries=(i % 7) + 1,
+                namespace=namespace, ns_field=ns_field,
             )
         with open(os.path.join(dst, f"{name}-component.yaml"), "w",
                   encoding="utf-8") as fh:
@@ -238,12 +256,13 @@ def write_monorepo_lite(dst: str, workloads: int = 40,
                   encoding="utf-8") as fh:
             fh.write(deploy)
         component_files.append(f"  - {name}-component.yaml\n")
-    with open(os.path.join(dst, "mono-ns.yaml"), "w",
+    with open(os.path.join(dst, f"{tenant}-ns.yaml"), "w",
               encoding="utf-8") as fh:
-        fh.write(_NS_YAML)
+        fh.write(_NS_YAML.format(namespace=namespace, ns_field=ns_field))
     config = os.path.join(dst, "workload.yaml")
     with open(config, "w", encoding="utf-8") as fh:
         fh.write(_COLLECTION_TEMPLATE.format(
             component_files="".join(component_files),
+            tenant=tenant, collection_kind=collection_kind,
         ))
     return config

@@ -148,14 +148,25 @@ class TestMembership:
                 fleet = stats["fleet"]
                 assert list(fleet) == [
                     "affinities", "counters", "editor", "lease_s",
-                    "listen", "members", "queued_requests", "slo",
+                    "listen", "members", "populated_namespaces",
+                    "queued_requests", "scale", "slo",
                 ]
                 entry = fleet["members"]["d1"]
                 assert entry == {
-                    "addr": "/nowhere/fake.sock", "capacity": 3,
+                    "addr": "/nowhere/fake.sock",
+                    "artifact": {
+                        "hydrated": 0, "remote_corrupt": 0,
+                        "remote_hits": 0, "remote_misses": 0,
+                        "remote_puts": 0,
+                    },
+                    "capacity": 3,
                     "degraded": True, "dispatched": 0, "in_flight": 0,
                     "lease_age_s": entry["lease_age_s"],
-                    "queued": 2, "state": "healthy",
+                    "namespaces": 0, "queued": 2, "spawned": False,
+                    "state": "healthy",
+                }
+                assert fleet["scale"] == {
+                    "max": 0, "min": 0, "spawned_live": 0,
                 }
                 assert entry["lease_age_s"] < coordinator.lease_s()
                 assert fleet["counters"]["fleet.registrations"] == 1

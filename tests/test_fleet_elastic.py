@@ -1,0 +1,396 @@
+"""Elastic shared-nothing fleet on the remote-cache artifact plane
+(PR 20 acceptance).
+
+Two promises stack on the PR 14 fleet contract here.  Shared-nothing:
+daemons on disjoint private cache roots share artifacts ONLY through
+the remote cache server — the coordinator never touches a daemon's
+filesystem (root resets ride the daemon-side ``fence`` op), and a cold
+daemon hydrates its trees over the network.  Elastic: the coordinator
+spawns and retires its own daemon subprocesses from queue/SLO pressure
+and idleness, riding the same lease machinery as crash churn.  Both
+hold the standing bar: byte-identity to a cache-off serial recompute —
+across scale events, a network partition with a stale-lease rejoin,
+and SIGKILL mid-steal while the stolen tree is half-hydrated.
+"""
+
+import os
+import threading
+import time
+
+from operator_forge.perf import cache as perfcache
+from operator_forge.perf import faults, metrics, remote, workers
+from operator_forge.serve.batch import run_batch
+from operator_forge.serve.daemon import DaemonClient
+from operator_forge.serve.jobs import jobs_from_specs
+
+from test_fleet import (
+    REPO_ROOT,
+    _chain_specs,
+    _config_copy,
+    _reap,
+    _spawn_daemon,
+    _start_coordinator,
+    _wait_for,
+    _wait_members,
+)
+from test_perf_cache import assert_identical_trees
+
+
+def _counter(name):
+    return metrics.counter(name).value()
+
+
+def _serial_reference(base, config, names, monkeypatch):
+    """The cache-off serial recompute every fleet answer must match."""
+    perfcache.configure(mode="off")
+    monkeypatch.setenv("OPERATOR_FORGE_JOBS", "1")
+    workers.set_backend("thread")
+    refs = {}
+    for name in names:
+        ref = os.path.join(base, "ref", name)
+        results = run_batch(
+            jobs_from_specs(_chain_specs(config, ref), base)
+        )
+        assert all(r.ok for r in results)
+        refs[name] = ref
+    perfcache.configure(mode="mem")
+    workers.set_backend(None)
+    monkeypatch.delenv("OPERATOR_FORGE_JOBS")
+    return refs
+
+
+def _drive(coordinator, base, config, outcomes, name):
+    out = os.path.join(base, "live", name)
+    with DaemonClient(coordinator.address()) as client:
+        outcomes[name] = (out, client.request({
+            "op": "batch", "id": name,
+            "jobs": _chain_specs(config, out),
+        }))
+    return out
+
+
+class TestSharedNothingArtifactPlane:
+    def test_disjoint_roots_hydration_and_kill_mid_steal(
+        self, tmp_path, monkeypatch
+    ):
+        """K daemons on disjoint private cache roots, the remote cache
+        server the ONLY shared artifact state: a tenant mix must be
+        byte-identical to the serial reference; heartbeats must
+        attribute the artifact plane per daemon (write-behind puts,
+        populated namespaces); and after every warm daemon is
+        SIGKILLed, fresh cold daemons must serve the same tenants
+        byte-identically again — consulting the shared tier, surviving
+        a SIGKILL mid-steal while the stolen tree is half-hydrated."""
+        base = str(tmp_path)
+        config = _config_copy(base, "sn")
+        refs = _serial_reference(
+            base, config, ("t0", "t1"), monkeypatch
+        )
+
+        server = remote.CacheServer(
+            f"unix:{base}/artifact.sock",
+            root=os.path.join(base, "artifact-store"),
+        )
+        server.start()
+        coordinator = _start_coordinator(tmp_path, lease=0.9)
+        procs = []
+        try:
+            def member_env(tag):
+                return {
+                    "OPERATOR_FORGE_CACHE": "disk",
+                    "OPERATOR_FORGE_CACHE_DIR": os.path.join(
+                        base, f"private-{tag}"
+                    ),
+                    "OPERATOR_FORGE_REMOTE_CACHE": server.address(),
+                    "OPERATOR_FORGE_JOBS": "2",
+                }
+
+            for tag in ("d1", "d2"):
+                proc, _sock = _spawn_daemon(
+                    tmp_path, coordinator, tag, member_env(tag)
+                )
+                procs.append(proc)
+            _wait_members(coordinator, 2)
+
+            outcomes = {}
+            threads = [
+                threading.Thread(
+                    target=_drive,
+                    args=(coordinator, base, config, outcomes, name),
+                )
+                for name in ("t0", "t1")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            for name in ("t0", "t1"):
+                out, resp = outcomes[name]
+                assert resp["ok"], (name, resp)
+                assert_identical_trees(refs[name], out)
+
+            # per-daemon artifact-plane attribution, via heartbeats:
+            # write-behind populated the shared tier, and the
+            # coordinator learned which namespaces are populated
+            def attributed():
+                payload = coordinator._stats_payload()
+                puts = sum(
+                    m["artifact"]["remote_puts"]
+                    for m in payload["members"].values()
+                )
+                return puts > 0 and payload["populated_namespaces"] > 0
+
+            _wait_for(attributed, message="heartbeat artifact "
+                                          "attribution + populated "
+                                          "namespaces")
+
+            # every warm daemon dies: the fleet's only memory of the
+            # tenants is now the remote tier
+            for proc in procs:
+                proc.kill()
+            _wait_members(coordinator, 0)
+
+            gets_before = _counter("cache_server.gets")
+            redispatch_before = (
+                _counter("fleet.redispatches")
+                + _counter("fleet.jobs_quarantined")
+            )
+            cold = {}
+            for tag in ("d3", "d4"):
+                proc, sock = _spawn_daemon(
+                    tmp_path, coordinator, tag, member_env(tag)
+                )
+                procs.append(proc)
+                cold[sock] = proc
+            _wait_members(coordinator, 2)
+
+            outcomes = {}
+            threads = [
+                threading.Thread(
+                    target=_drive, args=(coordinator, base, config,
+                                         outcomes, name),
+                )
+                for name in ("t0-cold", "t1-cold")
+            ]
+            for t in threads:
+                t.start()
+            # SIGKILL whichever cold daemon holds an in-flight stolen
+            # dispatch — mid-steal, its private tree half-hydrated.
+            # Shared-nothing is what makes this safe: nothing of the
+            # dead daemon's disk is ever consulted again
+            victim = {}
+
+            def find_victim():
+                members = coordinator._stats_payload()["members"]
+                for m in members.values():
+                    if m["in_flight"] and m["addr"] in cold:
+                        victim["proc"] = cold[m["addr"]]
+                        return True
+                return False
+
+            _wait_for(find_victim, timeout=60,
+                      message="an in-flight stolen dispatch")
+            victim["proc"].kill()
+            for t in threads:
+                t.join(180)
+            for name in ("t0-cold", "t1-cold"):
+                out, resp = outcomes[name]
+                assert resp["ok"], (name, resp)
+                assert_identical_trees(refs[name.split("-")[0]], out)
+            # the cold round consulted the shared tier, and the kill
+            # was recovered by re-dispatch or quarantine
+            assert _counter("cache_server.gets") > gets_before
+            assert (
+                _counter("fleet.redispatches")
+                + _counter("fleet.jobs_quarantined")
+            ) > redispatch_before
+        finally:
+            coordinator.stop()
+            _reap(*procs)
+            server.stop()
+
+
+class TestElasticAutoscaler:
+    def test_scale_up_on_pressure_scale_down_idle_identical(
+        self, tmp_path, monkeypatch
+    ):
+        """min=1/max=2: the coordinator spawns its own first daemon to
+        meet the floor, a second under SLO pressure while client load
+        runs, then retires back to the floor once the fleet sits idle
+        — every answer byte-identical to the serial reference."""
+        base = str(tmp_path)
+        config = _config_copy(base, "el")
+        refs = _serial_reference(
+            base, config,
+            [f"e{i}" for i in range(4)], monkeypatch,
+        )
+        monkeypatch.setenv("OPERATOR_FORGE_FLEET_IDLE_S", "1.0")
+        # any completed dispatch trips the latency leg: the test's
+        # point is the scale event, not the threshold calibration
+        monkeypatch.setenv(
+            "OPERATOR_FORGE_FLEET_SCALE_P99_S", "0.0001"
+        )
+        ups_before = _counter("fleet.scale_ups")
+        downs_before = _counter("fleet.scale_downs")
+        coordinator = _start_coordinator(
+            tmp_path, lease=0.8,
+            elastic={
+                "min": 1, "max": 2,
+                "env": {
+                    "PYTHONPATH": REPO_ROOT,
+                    "OPERATOR_FORGE_JOBS": "2",
+                },
+            },
+        )
+        try:
+            # the floor spawn: no daemon was ever started by the test
+            _wait_for(
+                lambda: len(
+                    coordinator._stats_payload()["members"]
+                ) == 1,
+                timeout=60, message="the floor spawn to register",
+            )
+            assert _counter("fleet.scale_ups") >= ups_before + 1
+
+            outcomes = {}
+            for i in range(4):
+                _drive(coordinator, base, config, outcomes, f"e{i}")
+            # SLO pressure sampled while the submissions ran (and keep
+            # the fleet busy until the second spawn registers)
+            deadline = time.monotonic() + 60
+            i = 4
+            while (
+                len(coordinator._stats_payload()["members"]) < 2
+                and time.monotonic() < deadline
+            ):
+                name = f"e{i}"
+                refs[name] = refs["e0"]
+                _drive(coordinator, base, config, outcomes, name)
+                i += 1
+            assert len(
+                coordinator._stats_payload()["members"]
+            ) == 2, "autoscaler never reached max under pressure"
+            assert _counter("fleet.scale_ups") >= ups_before + 2
+
+            for name, (out, resp) in outcomes.items():
+                assert resp["ok"], (name, resp)
+                assert_identical_trees(refs[name], out)
+
+            # idle: one spawned daemon retires per idle window, down
+            # to the floor — and no further
+            _wait_for(
+                lambda: len(
+                    coordinator._stats_payload()["members"]
+                ) == 1,
+                timeout=30, message="scale-down to the pool floor",
+            )
+            assert _counter("fleet.scale_downs") >= downs_before + 1
+            payload = coordinator._stats_payload()
+            assert payload["scale"]["min"] == 1
+            assert payload["scale"]["max"] == 2
+            time.sleep(2.5)  # two more idle windows: the floor holds
+            assert len(
+                coordinator._stats_payload()["members"]
+            ) == 1
+            # one more submission after the scale-down stays identical
+            out = _drive(coordinator, base, config, outcomes, "post")
+            assert outcomes["post"][1]["ok"]
+            assert_identical_trees(refs["e0"], out)
+        finally:
+            coordinator.stop()
+
+
+class TestPartitionChaos:
+    def test_partition_suspect_evict_stale_lease_rejoin_identical(
+        self, tmp_path, monkeypatch
+    ):
+        """``fleet.partition@link``: the daemon's beats stop without
+        its connection closing (a severed network, not a dead host).
+        The lease must age through suspect into eviction, the rejoin
+        must be refused as a stale lease and re-register, and the
+        rejoined daemon must serve byte-identically."""
+        base = str(tmp_path)
+        config = _config_copy(base, "part")
+        refs = _serial_reference(base, config, ("p0",), monkeypatch)
+        before = {
+            name: _counter(f"fleet.{name}")
+            for name in ("suspects", "evictions", "registrations")
+        }
+        coordinator = _start_coordinator(tmp_path, lease=0.6)
+        proc = None
+        try:
+            proc, _sock = _spawn_daemon(
+                tmp_path, coordinator, "part-d1", {
+                    "OPERATOR_FORGE_FAULTS": "fleet.partition@link:1",
+                    "OPERATOR_FORGE_JOBS": "2",
+                },
+            )
+            _wait_members(coordinator, 1)
+            # the partition rides out: suspect, evict, then the first
+            # post-partition beat is refused and the link re-registers
+            _wait_for(
+                lambda: (
+                    _counter("fleet.registrations")
+                    >= before["registrations"] + 2
+                    and len(
+                        coordinator._stats_payload()["members"]
+                    ) == 1
+                ),
+                timeout=30,
+                message="stale-lease rejoin after the partition",
+            )
+            assert _counter("fleet.suspects") >= before["suspects"] + 1
+            assert (
+                _counter("fleet.evictions") >= before["evictions"] + 1
+            )
+            assert proc.poll() is None, "daemon died; partition must " \
+                                        "not kill the process"
+            outcomes = {}
+            out = _drive(coordinator, base, config, outcomes, "p0")
+            assert outcomes["p0"][1]["ok"], outcomes["p0"][1]
+            assert_identical_trees(refs["p0"], out)
+        finally:
+            coordinator.stop()
+            _reap(proc)
+
+
+class TestStealKillChaos:
+    def test_steal_kill_fault_fences_and_redispatches_identical(
+        self, tmp_path, monkeypatch
+    ):
+        """``fleet.steal_kill@steal``: the dispatch connection is
+        severed right after a STOLEN submission was sent — the target
+        may be mid-hydration.  The probe finds it alive, so the retry
+        pins it behind the fence (no coordinator-side reset), and the
+        answer must match the serial reference."""
+        base = str(tmp_path)
+        config = _config_copy(base, "steal")
+        refs = _serial_reference(base, config, ("s0",), monkeypatch)
+        redispatch_before = _counter("fleet.redispatches")
+        coordinator = _start_coordinator(tmp_path)
+        procs = []
+        faults.configure("fleet.steal_kill@steal:1")
+        try:
+            for tag in ("sk-d1", "sk-d2"):
+                proc, _sock = _spawn_daemon(
+                    tmp_path, coordinator, tag,
+                    {"OPERATOR_FORGE_JOBS": "2"},
+                )
+                procs.append(proc)
+            _wait_members(coordinator, 2)
+            outcomes = {}
+            # a cold affinity key routes through the steal branch, so
+            # the first dispatch is the stolen one the fault severs
+            out = _drive(coordinator, base, config, outcomes, "s0")
+            assert outcomes["s0"][1]["ok"], outcomes["s0"][1]
+            assert_identical_trees(refs["s0"], out)
+            assert ("fleet.steal_kill", "steal", 1) in faults.fired()
+            assert (
+                _counter("fleet.redispatches") > redispatch_before
+            )
+            for proc in procs:
+                assert proc.poll() is None
+        finally:
+            faults.configure(None)
+            coordinator.stop()
+            _reap(*procs)

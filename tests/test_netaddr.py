@@ -1,0 +1,109 @@
+"""Shared stream-socket address plumbing (PR 20 satellite).
+
+One parser + one listener factory + one connector, shared by the
+remote cache server, the daemon, and the fleet coordinator — the
+triplicated bind/connect boilerplate those surfaces used to carry.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from operator_forge.perf.netaddr import (
+    bind_listener,
+    bound_address,
+    connect_stream,
+    parse_listen,
+)
+
+
+class TestParseListen:
+    def test_unix_prefix_and_bare_paths(self):
+        assert parse_listen("unix:/tmp/x.sock") == ("unix", "/tmp/x.sock")
+        assert parse_listen("/tmp/x.sock") == ("unix", "/tmp/x.sock")
+        assert parse_listen("rel/dir.sock") == ("unix", "rel/dir.sock")
+
+    def test_tcp_host_port_and_default_host(self):
+        assert parse_listen("0.0.0.0:9999") == ("tcp", "0.0.0.0", 9999)
+        assert parse_listen(":0") == ("tcp", "127.0.0.1", 0)
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_listen("")
+        with pytest.raises(ValueError):
+            parse_listen("justaname")
+        with pytest.raises(ValueError):
+            parse_listen("host:notaport")
+
+    def test_shared_surface_is_one_function(self):
+        # the daemon, fleet, and remote modules must all resolve to
+        # THIS parser — the deduplication the satellite exists for
+        from operator_forge.perf import netaddr, remote
+        from operator_forge.serve import daemon, fleet
+
+        assert remote.parse_listen is netaddr.parse_listen
+        assert daemon.parse_listen is netaddr.parse_listen
+        assert fleet.parse_listen is netaddr.parse_listen
+
+
+class TestBindConnect:
+    def _echo_once(self, listener):
+        def serve():
+            conn, _ = listener.accept()
+            with conn:
+                data = conn.recv(64)
+                conn.sendall(data.upper())
+
+        thread = threading.Thread(target=serve, daemon=True)
+        thread.start()
+        return thread
+
+    def test_unix_round_trip_and_stale_path_unlink(self, tmp_path):
+        path = str(tmp_path / "echo.sock")
+        first = bind_listener(f"unix:{path}")
+        first.close()
+        # the stale path is still on disk: a re-bind must not raise
+        listener = bind_listener(f"unix:{path}", accept_timeout=5.0)
+        try:
+            assert bound_address(("unix", path), listener) == path
+            thread = self._echo_once(listener)
+            sock = connect_stream(path, timeout=5.0)
+            with sock:
+                sock.sendall(b"ping")
+                assert sock.recv(64) == b"PING"
+            thread.join(5.0)
+        finally:
+            listener.close()
+
+    def test_tcp_port_zero_resolves_and_connects(self):
+        spec = parse_listen("127.0.0.1:0")
+        listener = bind_listener(spec, accept_timeout=5.0)
+        try:
+            addr = bound_address(spec, listener)
+            host, port = addr.rsplit(":", 1)
+            assert host == "127.0.0.1" and int(port) > 0
+            thread = self._echo_once(listener)
+            sock = connect_stream(addr, timeout=5.0)
+            with sock:
+                assert sock.gettimeout() == 5.0
+                sock.sendall(b"ok")
+                assert sock.recv(64) == b"OK"
+            thread.join(5.0)
+        finally:
+            listener.close()
+
+    def test_connect_failure_raises_oserror(self, tmp_path):
+        with pytest.raises(OSError):
+            connect_stream(str(tmp_path / "nobody-home.sock"),
+                           timeout=0.5)
+
+    def test_accept_timeout_polls(self, tmp_path):
+        listener = bind_listener(
+            f"unix:{tmp_path}/poll.sock", accept_timeout=0.05
+        )
+        try:
+            with pytest.raises(socket.timeout):
+                listener.accept()
+        finally:
+            listener.close()
